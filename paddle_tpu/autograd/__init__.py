@@ -1,0 +1,153 @@
+"""Autograd public API.
+
+Reference: `python/paddle/autograd/` — `backward()`, `PyLayer` custom-grad
+(`autograd/py_layer.py:21,192`), `paddle.grad` partial grads
+(`imperative/partial_grad_engine.cc`), `paddle.no_grad`.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+
+from ..core import framework
+from ..core import tape as tape_mod
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tape_mod.backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """Context manager AND decorator, like paddle.no_grad."""
+
+    def __enter__(self):
+        self._cm = framework.no_grad_guard()
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._cm = framework.enable_grad_guard()
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+
+def is_grad_enabled():
+    return framework.grad_enabled()
+
+
+def set_grad_enabled(mode: bool):
+    framework._state.grad_enabled = bool(mode)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False, name=None):
+    """paddle.grad: partial gradients of outputs wrt inputs.
+
+    Reference: `imperative/partial_grad_engine.cc` PartialGradEngine.
+    Implemented by running the tape backward with grad capture restricted to
+    ``inputs``; the tape is retained unless retain_graph=False is explicit.
+    """
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    rg = True if retain_graph is None else retain_graph
+
+    # snapshot existing .grad, run backward, read new grads, restore
+    saved = [t.grad for t in inputs]
+    for t in inputs:
+        t.grad = None
+    tape_mod.backward(list(outputs), grad_tensors=grad_outputs, retain_graph=rg)
+    grads = []
+    for t, old in zip(inputs, saved):
+        g = t.grad
+        if g is None and not allow_unused:
+            from ..ops import zeros_like
+
+            g = zeros_like(t)
+        grads.append(g)
+        t.grad = old
+    return grads
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op (reference `python/paddle/autograd/py_layer.py:21`).
+
+    Subclass defines ``forward(ctx, *args)`` and ``backward(ctx, *grads)``
+    over Tensors.  The backward is recorded on the tape as an opaque node.
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with framework.no_grad_guard():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = framework.grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        if needs_grad:
+            new_outs = [Tensor(o._array, stop_gradient=False) for o in outs]
+
+            def vjp_fn(cots):
+                if not isinstance(cots, tuple):
+                    cots = (cots,)
+                cot_tensors = [Tensor(c) for c in cots]
+                with framework.no_grad_guard():
+                    gin = cls.backward(ctx, *cot_tensors)
+                if not isinstance(gin, (list, tuple)):
+                    gin = [gin]
+                arrays = []
+                gi = iter(gin)
+                for t in tensor_inputs:
+                    g = next(gi, None)
+                    arrays.append(None if g is None else g._array)
+                return arrays
+
+            node = tape_mod.TapeNode(
+                vjp_fn, tensor_inputs, new_outs, out_is_tuple=len(new_outs) > 1
+            )
+            tape_mod.default_tape().record(node)
+            outs = new_outs
+        return outs[0] if single else tuple(outs)
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError

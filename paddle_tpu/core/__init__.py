@@ -1,0 +1,17 @@
+"""Core runtime: Tensor over jax.Array, dtype/place/flags, autograd tape.
+
+TPU-native replacement of reference layers 1-3 (platform / memory /
+framework core, SURVEY.md §1): device identity is a Place resolving to a
+`jax.Device`; memory management is delegated to PJRT (no allocator stack
+needed — reference `memory/allocation/allocator_facade.h:38` becomes XLA's
+buffer manager); the framework core is the dispatch+tape pair in place of
+OperatorBase/OpRegistry per-kernel dispatch.
+"""
+from .dtype import (bfloat16, bool_, complex64, complex128, float16, float32,
+                    float64, get_default_dtype, int8, int16, int32, int64,
+                    set_default_dtype, uint8)
+from .flags import get_flags, set_flags
+from .place import (CPUPlace, CUDAPlace, Place, TPUPlace, XPUPlace, get_device,
+                    is_compiled_with_tpu, set_device)
+from .tensor import Tensor, to_tensor
+from .framework import seed

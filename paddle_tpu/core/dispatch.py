@@ -1,0 +1,130 @@
+"""Eager op dispatch.
+
+Reference hot path: `core.ops.*` generated pybind functions →
+`imperative::Tracer::TraceOp` (`imperative/tracer.cc:144`) → kernel dispatch →
+optional grad-node creation (`tracer.cc:231`).
+
+TPU-native replacement: every op is a pure jnp/lax function.  ``dispatch``
+executes it eagerly (XLA compiles+caches each unique op/shape signature), and
+when any differentiable input requires grad it runs the op under ``jax.vjp``
+and records the pullback on the tape — the moral equivalent of
+CreateGradOpNode, with JAX deriving the grad op instead of a hand-registered
+GradOpMaker.  AMP autocast (reference `imperative/amp_auto_cast.cc`) is
+applied here for ops that declare a cast policy.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import flags, framework
+from .tape import TapeNode, default_tape
+from .tensor import Tensor
+
+# AMP op policies, mirroring the reference white/black lists
+# (`imperative/amp_auto_cast.cc` AmpOperators): 'white' ops run in the
+# autocast dtype (matmul/conv — MXU ops), 'black' ops are forced to fp32
+# (softmax/norm/reductions where bf16 accumulation hurts).
+WHITE = "white"
+BLACK = "black"
+
+
+def _autocast_arrays(arrays, policy):
+    st = framework.amp_state()
+    if not st.amp_enabled or policy is None:
+        return arrays
+    if policy == WHITE:
+        target = st.amp_dtype or jnp.bfloat16
+        return [
+            a.astype(target)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            else a
+            for a in arrays
+        ]
+    if policy == BLACK:
+        return [
+            a.astype(jnp.float32)
+            if hasattr(a, "dtype") and a.dtype in (jnp.bfloat16, jnp.float16)
+            else a
+            for a in arrays
+        ]
+    return arrays
+
+
+def dispatch(jfn, *inputs, amp_policy=None, nondiff=(), **static_kwargs):
+    """Execute ``jfn(*arrays, **static_kwargs)`` with autograd recording.
+
+    ``inputs`` may be Tensors, arrays, or python scalars.  Tensor inputs are
+    differentiable unless their position is listed in ``nondiff`` (e.g. an
+    integer index operand).  Returns Tensor or tuple of Tensors.
+    """
+    tensors = [x for x in inputs if isinstance(x, Tensor)]
+    arrays = [x._array if isinstance(x, Tensor) else x for x in inputs]
+    arrays = _autocast_arrays(arrays, amp_policy)
+
+    needs_grad = framework.grad_enabled() and any(
+        not t.stop_gradient for t in tensors
+    )
+
+    if static_kwargs:
+        fn = lambda *a: jfn(*a, **static_kwargs)
+    else:
+        fn = jfn
+
+    if not needs_grad:
+        out = fn(*arrays)
+        return _wrap_out(out, stop_gradient=True)
+
+    # positions of differentiable inputs
+    diff_pos = [
+        i
+        for i, x in enumerate(inputs)
+        if isinstance(x, Tensor) and i not in nondiff
+        and jnp.issubdtype(x._array.dtype, jnp.inexact)
+    ]
+    if not diff_pos:
+        out = fn(*arrays)
+        return _wrap_out(out, stop_gradient=True)
+
+    const = list(arrays)
+
+    def fn_of_diff(*diff_args):
+        a = list(const)
+        for p, v in zip(diff_pos, diff_args):
+            a[p] = v
+        return fn(*a)
+
+    diff_arrays = [arrays[p] for p in diff_pos]
+    out, vjp_fn = jax.vjp(fn_of_diff, *diff_arrays)
+
+    wrapped = _wrap_out(out, stop_gradient=False)
+    outs = wrapped if isinstance(wrapped, tuple) else (wrapped,)
+    node = TapeNode(
+        vjp_fn,
+        [inputs[p] for p in diff_pos],
+        list(outs),
+        out_is_tuple=isinstance(wrapped, tuple),
+    )
+    default_tape().record(node)
+
+    if flags.flag("check_nan_inf"):
+        _check_nan_inf(outs)
+    return wrapped
+
+
+def _wrap_out(out, stop_gradient):
+    if isinstance(out, tuple):
+        return tuple(Tensor(o, stop_gradient=stop_gradient) for o in out)
+    return Tensor(out, stop_gradient=stop_gradient)
+
+
+def _check_nan_inf(outs):
+    # reference: FLAGS_check_nan_inf → CheckVarHasNanOrInf
+    # (`framework/details/nan_inf_utils.h:29`)
+    for t in outs:
+        a = t._array
+        if jnp.issubdtype(a.dtype, jnp.inexact) and not framework.in_trace():
+            if bool(jnp.any(~jnp.isfinite(a))):
+                raise FloatingPointError(
+                    f"NaN or Inf detected in op output (shape={a.shape})"
+                )

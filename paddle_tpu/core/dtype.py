@@ -1,0 +1,92 @@
+"""Dtype system.
+
+Mirrors the reference's POD dtype enum (reference framework.proto:106-141:
+BOOL, INT16, INT32, INT64, FP16, FP32, FP64, UINT8, INT8, BF16, COMPLEX64,
+COMPLEX128) but maps every dtype onto a canonical ``jnp.dtype``.  On TPU the
+preferred compute type is bfloat16; float32 remains the default parameter
+dtype, as in the reference (`python/paddle/fluid/framework.py` default dtype
+handling).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical names -> jnp dtypes
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+# Aliases used across the reference python API.
+_ALIASES = {
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+    "bf16": "bfloat16",
+    "float": "float32",
+    "double": "float64",
+    "int": "int32",
+    "long": "int64",
+}
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def set_default_dtype(d):
+    _DEFAULT_DTYPE[0] = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np dtype, jnp dtype) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name not in _NAME_TO_DTYPE:
+            raise ValueError(f"unsupported dtype string: {dtype!r}")
+        return jnp.dtype(_NAME_TO_DTYPE[name])
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return str(jnp.dtype(dtype))
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.integer)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.complexfloating)

@@ -1,0 +1,61 @@
+"""Global runtime flag registry.
+
+Reference: gflags knobs in `paddle/fluid/platform/flags.cc:33-603` exposed to
+Python through `pybind/global_value_getter_setter.cc` as
+`paddle.set_flags`/`get_flags`.  Here flags are a plain process-global
+registry; flags may also be seeded from the environment as ``FLAGS_<name>``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    env = os.environ.get(f"FLAGS_{name}")
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _REGISTRY[name] = value
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        k = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        if k not in _REGISTRY:
+            raise KeyError(f"unknown flag {k!r}")
+        _REGISTRY[k] = v
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for k in names:
+        key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        out[k] = _REGISTRY[key]
+    return out
+
+
+def flag(name: str):
+    return _REGISTRY[name]
+
+
+# Core flags (subset of reference's platform/flags.cc that is meaningful on
+# TPU; CUDA/cudnn-specific knobs are intentionally absent).
+define_flag("check_nan_inf", False, "check every op output for NaN/Inf")
+define_flag("benchmark", False, "sync + log after every eager op")
+define_flag("deterministic", False, "force deterministic reductions")
+define_flag("eager_jit_ops", True, "allow per-op jit caching in eager mode")
+define_flag("amp_dtype", "bfloat16", "autocast compute dtype (TPU: bfloat16)")
+define_flag("allocator_strategy", "pjrt", "memory is managed by PJRT")
+define_flag("log_level", 0, "VLOG-style verbosity")

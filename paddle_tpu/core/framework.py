@@ -1,0 +1,133 @@
+"""Process-global framework state: grad mode, trace mode, RNG.
+
+The reference keeps equivalent state in `imperative::Tracer` (has_grad flag,
+`imperative/tracer.cc:144`) and the dygraph/static mode switch in
+`python/paddle/fluid/framework.py`.  Here there are two orthogonal modes:
+
+* **grad mode** — whether eager ops record onto the autograd tape
+  (`no_grad` disables, like `tracer.has_grad=False`).
+* **trace mode** — set while a `to_static`/jit trace is being captured.  In
+  trace mode ops do NOT build the eager tape (gradients come from `jax.grad`
+  over the captured pure function) and randomness draws from an explicitly
+  threaded key so the captured program is a pure function.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.trace_mode = False
+        self.trace_rng_key = None  # threaded PRNG key during jit tracing
+        # buffer mutations captured during a trace (id(tensor) -> traced array)
+        # so that e.g. BatchNorm running-stat updates become explicit outputs
+        # of the compiled program instead of leaking tracers (reference:
+        # batch_norm_op writes MeanOut/VarianceOut in-kernel).
+        self.trace_writes = None
+        self.amp_enabled = False
+        self.amp_dtype = None
+        self.amp_level = "O1"
+
+
+_state = _State()
+
+
+def grad_enabled() -> bool:
+    return _state.grad_enabled and not _state.trace_mode
+
+
+def in_trace() -> bool:
+    return _state.trace_mode
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    prev = _state.grad_enabled
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad_guard():
+    prev = _state.grad_enabled
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def trace_guard(rng_key=None, writes=None):
+    prev = (_state.trace_mode, _state.trace_rng_key, _state.trace_writes)
+    _state.trace_mode = True
+    _state.trace_rng_key = rng_key
+    _state.trace_writes = writes if writes is not None else {}
+    try:
+        yield
+    finally:
+        _state.trace_mode, _state.trace_rng_key, _state.trace_writes = prev
+
+
+def record_trace_write(tensor, array):
+    if _state.trace_writes is not None:
+        _state.trace_writes[id(tensor)] = array
+        return True
+    return False
+
+
+def get_trace_write(tensor):
+    if _state.trace_writes is not None:
+        return _state.trace_writes.get(id(tensor))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# RNG.  Eager mode: a stateful splitting generator (paddle.seed semantics).
+# Trace mode: keys are split off the threaded trace key so that the captured
+# program stays pure (a fresh key is fed per invocation by the jit wrapper).
+# ---------------------------------------------------------------------------
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.PRNGKey(seed)
+
+    def seed(self, seed: int):
+        self._key = jax.random.PRNGKey(seed)
+
+    def next_key(self):
+        if _state.trace_mode:
+            if _state.trace_rng_key is None:
+                raise RuntimeError(
+                    "random op inside a jit trace but no rng key was threaded; "
+                    "call the compiled function through paddle_tpu.jit"
+                )
+            _state.trace_rng_key, sub = jax.random.split(_state.trace_rng_key)
+            return sub
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+default_generator = Generator(np.random.SeedSequence().entropy % (2**31))
+
+
+def seed(s: int):
+    default_generator.seed(int(s))
+    return default_generator
+
+
+def get_rng_key():
+    return default_generator.next_key()
+
+
+# AMP state accessors (used by core.dispatch autocast and paddle_tpu.amp)
+def amp_state():
+    return _state

@@ -1,0 +1,156 @@
+"""Eager autograd tape.
+
+Reference: the dygraph autograd engine builds a `GradOpNode` DAG during
+forward (`imperative/tracer.cc:231` CreateGradOpNode) and executes it in
+reverse with dependency counting (`imperative/basic_engine.cc:39,235,305`),
+merging duplicate gradients through `GradientAccumulator`.
+
+TPU-native design: each eager op records one `TapeNode` holding the `jax.vjp`
+pullback of its (pure jnp) compute function.  `backward()` walks the recorded
+nodes in reverse execution order, pushing cotangents from output uids to
+input tensors; leaves with ``stop_gradient=False`` receive their accumulated
+cotangent as ``.grad``.  No per-node scheduling machinery is needed — the
+tape is already a topological order.
+
+Lifetime: nodes hold inputs strongly (they are needed to chain/accumulate)
+but outputs only weakly, keyed by a monotonically increasing tensor uid (so
+CPython id reuse cannot corrupt the walk).  When every output of a node has
+died, no live root can reach it, so a periodic sweep drops it — this plays
+the role of the reference's shared_ptr graph ownership, where dropping the
+last VarBase frees its GradOpNode; without it a forward-only loop that
+forgets `no_grad` would pin every activation.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Callable, List, Optional
+
+
+class TapeNode:
+    __slots__ = (
+        "vjp_fn",
+        "input_refs",
+        "output_wrefs",
+        "output_uids",
+        "_out_protos",
+        "out_is_tuple",
+        "released",
+    )
+
+    def __init__(self, vjp_fn, inputs, outputs, out_is_tuple=False):
+        self.vjp_fn = vjp_fn
+        self.input_refs = inputs
+        self.output_wrefs = [weakref.ref(t) for t in outputs]
+        self.output_uids = [t._uid for t in outputs]
+        self._out_protos = [(t._array.shape, t._array.dtype) for t in outputs]
+        self.out_is_tuple = out_is_tuple
+        self.released = False
+
+    def dead(self) -> bool:
+        return self.released or all(r() is None for r in self.output_wrefs)
+
+
+_SWEEP_INTERVAL = 256
+
+
+class Tape:
+    def __init__(self):
+        self.nodes: List[TapeNode] = []
+        self._since_sweep = 0
+
+    def record(self, node: TapeNode):
+        self.nodes.append(node)
+        self._since_sweep += 1
+        if self._since_sweep >= _SWEEP_INTERVAL:
+            self.sweep()
+
+    def sweep(self):
+        """Drop nodes unreachable from any live tensor (all outputs died)."""
+        self._since_sweep = 0
+        # iterate until fixpoint is unnecessary in one pass per sweep: dropping
+        # a node releases its input refs, which may kill upstream outputs —
+        # they get collected on the next sweep.
+        self.nodes = [n for n in self.nodes if not n.dead()]
+
+    def clear(self):
+        self.nodes.clear()
+        self._since_sweep = 0
+
+
+_TAPE = Tape()
+
+
+def default_tape() -> Tape:
+    return _TAPE
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Run reverse-mode over the recorded tape from `tensors` roots."""
+    import jax.numpy as jnp
+
+    from .tensor import Tensor
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    if not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # cotangent accumulator keyed by tensor uid
+    cot = {}
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            g_arr = jnp.ones_like(t._array)
+        else:
+            g_arr = g._array if isinstance(g, Tensor) else jnp.asarray(g)
+        cot[t._uid] = cot.get(t._uid, 0) + g_arr
+
+    tape = default_tape()
+    for node in reversed(tape.nodes):
+        if node.released:
+            continue
+        out_cots = [cot.get(uid) for uid in node.output_uids]
+        if all(c is None for c in out_cots):
+            continue
+        full = []
+        for c, proto in zip(out_cots, node._out_protos):
+            c = c if c is not None else jnp.zeros(proto[0], proto[1])
+            if hasattr(c, "dtype") and c.dtype != proto[1]:
+                c = c.astype(proto[1])
+            full.append(c)
+        in_cots = node.vjp_fn(tuple(full) if node.out_is_tuple else full[0])
+        for t, g in zip(node.input_refs, in_cots):
+            if g is None:
+                continue
+            cot[t._uid] = cot.get(t._uid, 0) + g
+        if not retain_graph:
+            node.released = True
+            node.vjp_fn = None
+
+    # deposit grads once per distinct tensor (GradientAccumulator role)
+    seen = set()
+    for node in tape.nodes:
+        for t in node.input_refs:
+            if t._uid not in seen:
+                seen.add(t._uid)
+                _maybe_set_grad(t, cot)
+    for t in tensors:
+        if t._uid not in seen:
+            seen.add(t._uid)
+            _maybe_set_grad(t, cot)
+
+    if not retain_graph:
+        tape.clear()
+
+
+def _maybe_set_grad(t, cot):
+    from .tensor import Tensor
+
+    g = cot.get(t._uid)
+    if g is None or t.stop_gradient:
+        return
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad._array + g, stop_gradient=True)

@@ -1,0 +1,239 @@
+"""Tensor: an imperative handle over a `jax.Array`.
+
+Reference: dygraph `VarBase` (`paddle/fluid/imperative/layer.h:66`) — a named,
+grad-tracking variable holding a LoDTensor.  Here the payload is a
+`jax.Array` (device-resident, XLA-managed); autograd linkage is recorded on
+the process tape (see core/tape.py) rather than per-variable GradOpNodes.
+
+Paddle semantics preserved:
+* ``stop_gradient`` defaults to True; parameters set it False
+  (`python/paddle/fluid/framework.py` Variable.stop_gradient).
+* ``.backward()`` / ``.grad`` / ``clear_grad``.
+* numpy() / item() / astype / reshape / transpose / indexing.
+Most op methods are attached by ``paddle_tpu.ops`` at import time (the
+reference attaches these via `varbase_patch_methods.py` monkey patching).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+from . import framework
+
+
+import itertools
+
+_UID = itertools.count(1)
+
+
+class Tensor:
+    # let Tensor win against np arrays in binary ops
+    __array_priority__ = 100
+
+    __slots__ = ("_array", "stop_gradient", "grad", "name", "trainable",
+                 "persistable", "_uid", "__weakref__")
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        self._uid = next(_UID)
+        if isinstance(data, Tensor):
+            data = data._array
+        dt = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+        if isinstance(data, (jax.Array, jax.core.Tracer)):
+            arr = data.astype(dt) if dt is not None and data.dtype != dt else data
+        else:
+            npdata = np.asarray(data)
+            if dt is None and npdata.dtype == np.float64:
+                dt = dtype_mod.get_default_dtype()
+            arr = jnp.asarray(npdata, dtype=dt)
+        self._array = arr
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self.name = name
+        self.trainable = not stop_gradient
+        self.persistable = False
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._array.shape)
+
+    @property
+    def ndim(self):
+        return self._array.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._array.shape)) if self._array.shape else 1
+
+    @property
+    def dtype(self):
+        return self._array.dtype
+
+    @property
+    def place(self):
+        from .place import expected_place
+
+        return expected_place()
+
+    @property
+    def T(self):
+        from .. import ops
+
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    def numpy(self):
+        return np.asarray(self._array)
+
+    def item(self):
+        return self._array.item()
+
+    def tolist(self):
+        return np.asarray(self._array).tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._array.shape[0]
+
+    def __repr__(self):
+        grad_s = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}"
+            f"{grad_s},\n       {np.asarray(self._array)!r})"
+        )
+
+    def __bool__(self):
+        return bool(self._array)
+
+    def __int__(self):
+        return int(self._array)
+
+    def __float__(self):
+        return float(self._array)
+
+    def __hash__(self):
+        return id(self)
+
+    def __deepcopy__(self, memo):
+        # a deep copy must get a FRESH uid: the autograd tape keys cotangents
+        # by uid, so a copied parameter sharing its source's uid would absorb
+        # or lose the source's gradients (e.g. copy.deepcopy of encoder
+        # layers in TransformerEncoder)
+        import copy as _copy
+
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        new._uid = next(_UID)
+        new._array = self._array  # jax arrays are immutable; share
+        new.stop_gradient = self.stop_gradient
+        new.grad = None
+        new.name = self.name
+        new.trainable = self.trainable
+        new.persistable = self.persistable
+        for slot in ("optimize_attr", "regularizer", "is_bias", "mesh_axes"):
+            if hasattr(self, slot):
+                setattr(new, slot, _copy.deepcopy(getattr(self, slot), memo))
+        return new
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from . import tape
+
+        tape.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor(self._array, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self):
+        from .. import ops
+
+        return ops.assign(self)
+
+    # in-place value replacement (reference: VarBase set_value / share_data)
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._array
+        arr = jnp.asarray(value, dtype=self._array.dtype)
+        if tuple(arr.shape) != tuple(self._array.shape):
+            raise ValueError(
+                f"set_value shape mismatch {arr.shape} vs {self._array.shape}"
+            )
+        # under a jit trace, record the write instead of storing a tracer
+        # (it becomes an explicit output of the compiled program)
+        if framework.in_trace() and framework.record_trace_write(self, arr):
+            return
+        self._array = arr
+
+    def copy_(self, other):
+        self.set_value(other)
+        return self
+
+    # -- conversion ---------------------------------------------------------
+    def astype(self, dtype):
+        from .. import ops
+
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def cpu(self):
+        return self
+
+    def to(self, *args, **kwargs):
+        return self
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._array)
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        from .dispatch import dispatch
+
+        if isinstance(idx, Tensor):
+            idx = idx._array
+        elif isinstance(idx, tuple):
+            idx = tuple(i._array if isinstance(i, Tensor) else i for i in idx)
+        return dispatch(lambda a: a[idx], self)
+
+    def __setitem__(self, idx, value):
+        if isinstance(idx, Tensor):
+            idx = idx._array
+        elif isinstance(idx, tuple):
+            idx = tuple(i._array if isinstance(i, Tensor) else i for i in idx)
+        v = value._array if isinstance(value, Tensor) else value
+        new = self._array.at[idx].set(v)
+        # route through the same trace-write machinery as set_value so a
+        # `t[idx] = x` inside a jit trace becomes a program output instead of
+        # leaking a tracer; in eager mode this is an in-place update that
+        # (like the reference's inplace ops) detaches prior autograd history.
+        if framework.in_trace() and framework.record_trace_write(self, new):
+            return
+        self._array = new
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor equivalent (`python/paddle/tensor/creation.py`)."""
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def unwrap(x):
+    return x._array if isinstance(x, Tensor) else x
+
+
+def wrap(arr, stop_gradient=True):
+    return Tensor(arr, stop_gradient=stop_gradient)

@@ -1,0 +1,15 @@
+"""`paddle.distributed` equivalent (SURVEY.md §2.3)."""
+from . import collective
+from .collective import (Group, ReduceOp, all_gather, all_gather_object,
+                         all_reduce, alltoall, barrier, broadcast, irecv,
+                         isend, new_group, recv, reduce, reduce_scatter,
+                         scatter, send, wait)
+from .parallel import (ParallelEnv, get_rank, get_world_size,
+                       init_parallel_env)
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       build_mesh, get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+from . import fleet
+from .fleet.data_parallel import DataParallel
+from . import spawn as _spawn_mod
+from .spawn import spawn

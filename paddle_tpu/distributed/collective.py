@@ -1,0 +1,258 @@
+"""Collective communication API.
+
+Reference: `python/paddle/distributed/collective.py:346-1576`
+(all_reduce/all_gather/broadcast/reduce/scatter/alltoall/send/recv/barrier
+over per-ring NCCL communicators) and the static-graph `c_*` collective op
+library (`paddle/fluid/operators/collective/`).
+
+TPU-native (SURVEY.md §5 "Distributed communication backend"): a group is a
+mesh axis name.  Inside a `shard_map`/pjit trace these lower to XLA
+collectives over ICI (`lax.psum`, `all_gather`, `ppermute`, `all_to_all`);
+in the eager single-controller mode data is either replicated (collectives
+are the identity) or the API operates on a sharded global array, where XLA
+already holds the global view — matching the semantics the reference gets
+from NCCL ranks.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = a mesh axis name (replaces ring_id)."""
+
+    def __init__(self, axis_name: str = "dp", ranks=None, id=0):
+        self.axis_name = axis_name
+        self.ranks = ranks
+        self.id = id
+
+    @property
+    def nranks(self):
+        from .topology import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None and self.axis_name in hcg.mesh.shape:
+            return int(hcg.mesh.shape[self.axis_name])
+        return len(self.ranks) if self.ranks else 1
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name})"
+
+
+_GROUPS = {}
+_GROUP_COUNTER = [0]
+
+
+def new_group(ranks=None, backend=None, axis_name=None):
+    _GROUP_COUNTER[0] += 1
+    g = Group(axis_name or "dp", ranks, id=_GROUP_COUNTER[0])
+    _GROUPS[g.id] = g
+    return g
+
+
+def _axis(group) -> Optional[str]:
+    if group is None:
+        return "dp"
+    if isinstance(group, Group):
+        return group.axis_name
+    if isinstance(group, str):
+        return group
+    return "dp"
+
+
+def _in_spmd_trace(arr) -> bool:
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _axis_in_scope(name) -> bool:
+    try:
+        lax.axis_size(name)
+        return True
+    except Exception:
+        return False
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """reference collective.py:346 / c_allreduce_sum op."""
+    name = _axis(group)
+    arr = unwrap(tensor)
+    if _in_spmd_trace(arr) and _axis_in_scope(name):
+        def f(a):
+            if op == ReduceOp.SUM:
+                return lax.psum(a, name)
+            if op == ReduceOp.MAX:
+                return lax.pmax(a, name)
+            if op == ReduceOp.MIN:
+                return lax.pmin(a, name)
+            if op == ReduceOp.AVG:
+                return lax.pmean(a, name)
+            if op == ReduceOp.PROD:
+                return jnp.exp(lax.psum(jnp.log(a), name))
+            raise ValueError(op)
+
+        out = dispatch(f, tensor)
+        if isinstance(tensor, Tensor):
+            tensor.set_value(out._array) if not _in_spmd_trace(out._array) else None
+        return out
+    # eager single-controller: replicated value — allreduce is identity
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    name = _axis(group)
+    arr = unwrap(tensor)
+    if _in_spmd_trace(arr) and _axis_in_scope(name):
+        out = dispatch(lambda a: lax.all_gather(a, name), tensor)
+        if tensor_list is not None:
+            n = lax.axis_size(name)
+            from ..ops import unbind
+
+            parts = unbind(out, 0)
+            tensor_list.extend(parts)
+        return out
+    if tensor_list is not None:
+        tensor_list.append(tensor)
+    return tensor
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    name = _axis(group)
+    arr = unwrap(tensor if tensor_list is None else tensor_list[0])
+    if _in_spmd_trace(arr) and _axis_in_scope(name):
+        src = tensor if tensor_list is None else tensor_list
+        if isinstance(src, (list, tuple)):
+            from ..ops import concat
+
+            src = concat(list(src), axis=0)
+        return dispatch(
+            lambda a: lax.psum_scatter(a, name, scatter_dimension=0, tiled=True),
+            src,
+        )
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # replicated in single-controller mode; under shard_map use the value of
+    # rank `src` along the axis
+    name = _axis(group)
+    arr = unwrap(tensor)
+    if _in_spmd_trace(arr) and _axis_in_scope(name):
+        n = lax.axis_size(name)
+
+        def f(a):
+            idx = lax.axis_index(name)
+            # all-gather then select src slice: XLA lowers to a broadcast
+            g = lax.all_gather(a, name)
+            return g[src]
+
+        out = dispatch(f, tensor)
+        return out
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    name = _axis(group)
+    if tensor_list:
+        arr = unwrap(tensor_list[0])
+        if _in_spmd_trace(arr) and _axis_in_scope(name):
+            from ..ops import stack
+
+            stacked = stack(list(tensor_list), axis=0)
+
+            def f(a):
+                return a[lax.axis_index(name)]
+
+            return dispatch(f, stacked)
+        return tensor_list[0]
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """reference alltoall op (`operators/collective/alltoall_op.cu.cc`)."""
+    name = _axis(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        from ..ops import concat
+
+        x = concat(list(in_tensor_list), axis=0)
+    else:
+        x = in_tensor_list
+    arr = unwrap(x)
+    if _in_spmd_trace(arr) and _axis_in_scope(name):
+        n = lax.axis_size(name)
+
+        def f(a):
+            parts = a.reshape((n, a.shape[0] // n) + a.shape[1:])
+            return lax.all_to_all(parts, name, split_axis=0, concat_axis=0,
+                                  tiled=False).reshape(a.shape)
+
+        out = dispatch(f, x)
+        if out_tensor_list is not None:
+            from ..ops import split as _split
+
+            out_tensor_list.extend(_split(out, n, axis=0))
+        return out
+    if out_tensor_list is not None and isinstance(in_tensor_list, (list, tuple)):
+        out_tensor_list.extend(in_tensor_list)
+    return x
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    # point-to-point maps to ppermute under shard_map (see fleet pipeline);
+    # eager mode is single-controller so send/recv are no-ops
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+def barrier(group=None):
+    (jax.device_put(jnp.zeros(())) + 0).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    unwrap(tensor).block_until_ready() if hasattr(unwrap(tensor), "block_until_ready") else None
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference `paddle.distributed.split` (collective.py:1282) — megatron
+    style sharded fc/embedding; here delegated to fleet mp_layers."""
+    from .fleet import meta_parallel as mp
+
+    raise NotImplementedError(
+        "use paddle_tpu.distributed.fleet.meta_parallel.ColumnParallelLinear /"
+        " RowParallelLinear / VocabParallelEmbedding"
+    )

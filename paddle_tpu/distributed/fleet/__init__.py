@@ -1,0 +1,19 @@
+"""fleet — distributed training API (reference
+`python/paddle/distributed/fleet/`)."""
+from . import meta_parallel, utils
+from .base import Fleet, PaddleCloudRoleMaker, RoleMakerBase, fleet
+from .data_parallel import DataParallel
+from .sharded_step import ShardedTrainStep
+from .strategy import DistributedStrategy
+
+# module-level singleton API, matching `fleet.init(...)` usage
+init = fleet.init
+distributed_optimizer = fleet.distributed_optimizer
+distributed_model = fleet.distributed_model
+build_train_step = fleet.build_train_step
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+barrier_worker = fleet.barrier_worker
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+minimize = fleet.minimize

@@ -1,0 +1,155 @@
+"""fleet singleton.
+
+Reference: `python/paddle/distributed/fleet/base/fleet_base.py:139`
+(fleet.init), `:783` (distributed_optimizer), `:836` (distributed_model),
+`:1288` (minimize) and the StrategyCompiler meta-optimizer chain
+(`fleet/base/strategy_compiler.py:91,173`).
+
+TPU-native: `init` builds the 4-D mesh topology; `distributed_model` +
+`distributed_optimizer` wire the model/optimizer into a ShardedTrainStep
+whose jit shardings express the strategy — the "meta-optimizer chain" is the
+(zero_stage, grad_accum, mesh axes, recompute) configuration of that one
+compiled step rather than a sequence of program rewrites.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..topology import (HybridCommunicateGroup, build_mesh,
+                        set_hybrid_communicate_group)
+from .strategy import DistributedStrategy
+
+
+class RoleMakerBase:
+    def __init__(self, is_collective=True):
+        self._is_collective = is_collective
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def worker_num(self):
+        return jax.process_count()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """reference `fleet/base/role_maker.py:530` — parses PADDLE_* env."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        super().__init__(is_collective)
+        import os
+
+        self._trainer_id = int(os.environ.get("PADDLE_TRAINER_ID",
+                                              jax.process_index()))
+        self._trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                                jax.process_count()))
+
+    def worker_index(self):
+        return self._trainer_id
+
+    def worker_num(self):
+        return self._trainers_num
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._optimizer = None
+        self._user_optimizer = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        h = self._strategy.hybrid_configs
+        dp = int(h.get("dp_degree", 1))
+        mp = int(h.get("mp_degree", 1))
+        pp = int(h.get("pp_degree", 1))
+        sp = int(h.get("sp_degree", 1))
+        sharding = int(h.get("sharding_degree", 1))
+        ndev = len(jax.devices())
+        total = dp * mp * pp * sp * max(sharding, 1)
+        if dp == 1 and mp == 1 and pp == 1 and sp == 1 and sharding <= 1:
+            dp = ndev  # pure DP over all devices by default
+        mesh = build_mesh(dp=dp * max(sharding, 1), pp=pp, sp=sp, mp=mp)
+        self._hcg = HybridCommunicateGroup(mesh=mesh, sharding=sharding)
+        set_hybrid_communicate_group(self._hcg)
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def mesh(self):
+        return self._hcg.mesh if self._hcg else None
+
+    def worker_index(self):
+        return self._role_maker.worker_index() if self._role_maker else 0
+
+    def worker_num(self):
+        return self._role_maker.worker_num() if self._role_maker else 1
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def barrier_worker(self):
+        from ..collective import barrier
+
+        barrier()
+
+    # -- strategy wiring ----------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        self._user_optimizer = optimizer
+        return optimizer
+
+    def distributed_model(self, model):
+        from .data_parallel import DataParallel
+        from .meta_parallel.pipeline_parallel import PipelineLayer
+
+        if self._hcg is None:
+            self.init()
+        if isinstance(model, PipelineLayer):
+            from .meta_parallel.pipeline_parallel import PipelineParallel
+
+            return PipelineParallel(model, self._hcg, self._strategy)
+        return DataParallel(model, hcg=self._hcg, strategy=self._strategy)
+
+    def build_train_step(self, model, loss_fn, optimizer=None):
+        """TPU-native entry: compile the strategy into one sharded step."""
+        from .sharded_step import ShardedTrainStep
+
+        opt = optimizer or self._user_optimizer
+        st = self._strategy or DistributedStrategy()
+        zero = int(st.sharding_configs.get("stage", 1)) if st.sharding else 0
+        k = int(st.gradient_merge_configs.get("k_steps", 1)) if st.gradient_merge else 1
+        inner = model.network if hasattr(model, "network") else model
+        inner = getattr(inner, "_layers", inner)
+        return ShardedTrainStep(inner, loss_fn, opt, self._hcg.mesh,
+                                zero_stage=zero, grad_accum=k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self._user_optimizer is None:
+            raise RuntimeError("call fleet.distributed_optimizer first")
+        return self._user_optimizer.minimize(loss)
+
+    # -- persistence hooks (reference fleet save/load) ----------------------
+    def save_persistables(self, executor=None, dirname=None, main_program=None):
+        pass
+
+    def stop_worker(self):
+        pass
+
+
+fleet = Fleet()

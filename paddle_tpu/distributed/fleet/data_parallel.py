@@ -1,0 +1,66 @@
+"""DataParallel wrapper.
+
+Reference: `python/paddle/fluid/dygraph/parallel.py:382` (paddle.DataParallel
+wrapping a Layer, broadcasting params via `sync_params_buffers` `:347`, and
+bucketed fused allreduce through the C++ `Reducer`, `imperative/reducer.h:130`).
+
+TPU-native: in the single-controller SPMD model, parameters live as global
+(replicated) arrays, so there is nothing to broadcast; gradient reduction is
+inserted by XLA when the train step is jit-compiled with the batch sharded
+over 'dp'.  The Reducer's bucketing/overlap role is performed by the XLA
+scheduler (async all-reduce overlapped with remaining backward — the same
+overlap the Reducer implements manually with comm streams).  The wrapper
+therefore (a) preserves the reference API, and (b) marks the model so
+fleet.build_train_step shards the batch.
+"""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 hcg=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self.find_unused_parameters = find_unused_parameters
+        # reference sync_params_buffers: ensure all ranks start identical.
+        # Single-controller: params are already one global (replicated)
+        # array — identity by construction.
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # reference DataParallel.scale_loss divides by nranks before
+        # allreduce-sum; XLA's mean-over-global-batch does this implicitly
+        return loss
+
+    def apply_collective_grads(self):
+        # grads are reduced inside the compiled step; nothing to flush
+        pass
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    @property
+    def parameters_(self):
+        return self._layers.parameters()
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+def sync_params_buffers(model, comm_group=None, src_rank=0,
+                        is_model_parallel=False):
+    """reference `parallel.py:347` — broadcast params from src.  Identity in
+    single-controller mode (one global array)."""
+    return model

@@ -1,0 +1,5 @@
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, TensorParallel,
+                        VocabParallelEmbedding)
+from .pipeline_parallel import (LayerDesc, PipelineLayer, PipelineParallel,
+                                SegmentLayers, SharedLayerDesc)

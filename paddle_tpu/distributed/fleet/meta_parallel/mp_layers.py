@@ -1,0 +1,158 @@
+"""Tensor-parallel layers.
+
+Reference: `python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+mp_layers.py` — VocabParallelEmbedding (:30), ColumnParallelLinear (:97),
+RowParallelLinear (:170), ParallelCrossEntropy (:249), backed by the
+c_embedding / c_identity+c_allreduce_sum / c_concat/c_split /
+c_softmax_with_cross_entropy collective ops.
+
+TPU-native (GSPMD): the layers are ordinary matmuls whose weights carry
+``mesh_axes`` PartitionSpecs; when the train step jits over the mesh, XLA
+partitions the matmul over 'mp' and inserts exactly the collectives the
+reference codes by hand (identity forward + all-reduce backward for column
+parallel; all-reduce forward for row parallel; the vocab-parallel softmax-CE
+becomes a sharded logits matmul + global reduction).  Activation shardings
+are pinned with `with_sharding_constraint` so the partitioner cannot undo
+the intended layout.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ....core import framework
+from ....core.dispatch import WHITE, dispatch
+from ....core.tensor import Tensor, unwrap
+from ....nn import functional as F
+from ....nn import initializer as init
+from ....nn.layer.layers import Layer
+from ...topology import get_hybrid_communicate_group
+
+
+def _constrain(x, *spec):
+    """with_sharding_constraint when tracing under a mesh; no-op otherwise."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return x
+
+    def f(a):
+        try:
+            from jax.sharding import PartitionSpec
+
+            return lax.with_sharding_constraint(
+                a, jax.sharding.NamedSharding(hcg.mesh, PartitionSpec(*spec))
+            )
+        except Exception:
+            return a
+
+    if framework.in_trace():
+        return dispatch(f, x)
+    return x
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] sharded on out ('mp'); output stays mp-sharded unless
+    gather_output (reference mp_layers.py:97)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=init.XavierUniform(),
+        )
+        self.weight.mesh_axes = (None, "mp")
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.mesh_axes = ("mp",)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if not self.gather_output:
+            # pin activation sharding: last dim stays split over mp
+            out = _constrain(out, *([None] * (out.ndim - 1)), "mp")
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] sharded on in ('mp'); input expected mp-split;
+    output is the full (all-reduced) tensor (reference mp_layers.py:170)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=init.XavierUniform(),
+        )
+        self.weight.mesh_axes = ("mp", None)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _constrain(x, *([None] * (x.ndim - 1)), "mp")
+        out = F.linear(x, self.weight, self.bias)
+        return _constrain(out, *([None] * out.ndim))
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded over vocab dim (reference mp_layers.py:30 /
+    c_embedding op)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=init.Normal(0.0, 0.02),
+        )
+        self.weight.mesh_axes = ("mp", None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return _constrain(out, *([None] * out.ndim))
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax cross-entropy (reference mp_layers.py:249 /
+    `c_softmax_with_cross_entropy_op.cu`): logits arrive vocab-sharded over
+    'mp'; the log-sum-exp reduction spans the full vocab because XLA sees the
+    global logical array."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        logits = _constrain(input, *([None] * (input.ndim - 1)), "mp")
+        return F.cross_entropy(logits, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+class TensorParallel(Layer):
+    """Model wrapper for TP runs (reference
+    `meta_parallel/tensor_parallel.py:25`): in single-controller SPMD the
+    parameter broadcast it performs is unnecessary; forwarding is identity."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)

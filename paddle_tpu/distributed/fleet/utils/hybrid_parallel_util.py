@@ -1,0 +1,17 @@
+"""Hybrid-parallel gradient sync helpers.
+
+Reference: `fleet/utils/hybrid_parallel_util.py:117` fused_allreduce_gradients
+— manual bucketed allreduce of grads across the DP group for dygraph hybrid
+runs.  TPU-native: gradient reduction happens inside the compiled sharded
+step (XLA all-reduce over 'dp'), so this is the identity; it exists so
+reference training scripts run unchanged.
+"""
+from __future__ import annotations
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    return parameter_list
+
+
+def sharding_reduce_gradients(parameter_list, hcg=None):
+    return parameter_list

@@ -1,0 +1,42 @@
+"""Activation recompute (gradient checkpointing).
+
+Reference: `python/paddle/distributed/fleet/utils/recompute.py:63` —
+RecomputeFunction(PyLayer) stashes RNG state, drops activations, and replays
+forward during backward.
+
+TPU-native: under a jit trace this is exactly `jax.checkpoint` (XLA
+rematerialization — RNG replay is automatic because keys are explicit).
+In eager mode the function simply runs (the eager tape keeps residuals;
+memory savings only materialize on the compiled path, which is the one that
+matters on TPU).
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core import framework
+from ....core.dispatch import dispatch
+from ....core.tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    if framework.in_trace():
+        tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+        const = list(args)
+
+        def inner(*arrs):
+            call = list(const)
+            for p, a in zip(tensor_pos, arrs):
+                call[p] = Tensor(a)
+            out = function(*call, **kwargs)
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            return tuple(o._array if isinstance(o, Tensor) else o for o in outs)
+
+        ck = jax.checkpoint(inner)
+        out = dispatch(ck, *[args[i] for i in tensor_pos])
+        if isinstance(out, tuple) and len(out) == 1:
+            return out[0]
+        return out
+    return function(*args, **kwargs)

@@ -1,0 +1,81 @@
+"""Parallel environment bootstrap.
+
+Reference: `python/paddle/distributed/parallel.py:58` init_parallel_env
+(gloo KV store + NCCL id broadcast + ncclCommInitRank) and ParallelEnv
+(`fluid/dygraph/parallel.py:71`, PADDLE_TRAINER_ID env conventions).
+
+TPU-native: multi-host bootstrap is `jax.distributed.initialize` (PJRT
+coordination service = the KV-store role); intra-host devices need no
+process-per-device — one controller owns all local chips and SPMD partitions
+work across them (SURVEY.md §2.3 row 1).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+class ParallelEnv:
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+        self._world = int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world
+
+    @property
+    def nranks(self):
+        return self._world
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]
+
+
+_INITIALIZED = [False]
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None):
+    """Initialize the distributed runtime.  Single-host: no-op (the
+    controller already owns all chips).  Multi-host: wires up the PJRT
+    coordination service."""
+    if _INITIALIZED[0]:
+        return ParallelEnv()
+    addr = coordinator_address or os.environ.get("PADDLE_MASTER") \
+        or os.environ.get("COORDINATOR_ADDRESS")
+    nproc = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = process_id if process_id is not None else int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if addr and nproc > 1:
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=nproc, process_id=pid
+        )
+    _INITIALIZED[0] = True
+    return ParallelEnv()
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    return jax.process_count()

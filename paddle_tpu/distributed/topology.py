@@ -1,0 +1,171 @@
+"""Hybrid parallel topology on a named-axis device mesh.
+
+Reference: `python/paddle/distributed/fleet/base/topology.py:36`
+(CommunicateTopology — N-D cartesian rank mesh) and `:117`
+(HybridCommunicateGroup — builds dp/mp/pp/sharding communication groups).
+
+TPU-native: the N-D rank grid IS a `jax.sharding.Mesh` with named axes
+('dp','pp','sp','mp', plus 'sharding' folded into dp).  There are no
+explicit communicator rings — a "group" is just a mesh axis name, and XLA
+inserts the collectives (SURVEY.md §2.3 row 1).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+# canonical axis order: outermost (slowest, cross-ICI-friendly last) first.
+# dp outermost so data-parallel gradient reduction rides the full mesh;
+# mp innermost so tensor-parallel collectives use nearest neighbors.
+AXIS_ORDER = ("dp", "pp", "sp", "mp")
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        self._world = int(np.prod(dims))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **args):
+        idx = [args[n] for n in self._parallel_names]
+        return int(np.ravel_multi_index(idx, self._dims))
+
+    def get_coord(self, rank):
+        return tuple(int(i) for i in np.unravel_index(rank, self._dims))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        ranks = []
+        for r in range(self._world):
+            if self.get_coord(r)[axis] == index:
+                ranks.append(r)
+        return ranks
+
+    def get_comm_list(self, axis_name):
+        """List of rank-groups along `axis_name` (reference topology.py)."""
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [d for i, d in enumerate(self._dims) if i != axis]
+        groups = []
+        for flat in range(int(np.prod(other_dims)) if other_dims else 1):
+            coords = list(np.unravel_index(flat, other_dims)) if other_dims else []
+            group = []
+            for k in range(self._dims[axis]):
+                full = coords[:axis] + [k] + coords[axis:]
+                group.append(self.get_rank(**dict(zip(self._parallel_names, full))))
+            groups.append(group)
+        return groups
+
+
+def build_mesh(dp: int = 1, pp: int = 1, sp: int = 1, mp: int = 1,
+               devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices) if devices is not None else jax.devices()
+    need = dp * pp * sp * mp
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {dp}x{pp}x{sp}x{mp}={need} exceeds {len(devices)} devices"
+        )
+    arr = np.asarray(devices[:need]).reshape(dp, pp, sp, mp)
+    return Mesh(arr, AXIS_ORDER)
+
+
+class HybridCommunicateGroup:
+    """reference `topology.py:117`: owns the 4-D topology and exposes
+    per-strategy group info.  Group handles are mesh axis names."""
+
+    def __init__(self, topology: CommunicateTopology = None, mesh: Mesh = None,
+                 dp=1, pp=1, sp=1, mp=1, sharding=1):
+        if mesh is None:
+            # sharding degree folds into dp for mesh purposes (ZeRO shards
+            # optimizer state over the data axis, reference sharding_optimizer)
+            mesh = build_mesh(dp=dp * sharding, pp=pp, sp=sp, mp=mp)
+        self._mesh = mesh
+        self._dp = int(mesh.shape["dp"])
+        self._pp = int(mesh.shape["pp"])
+        self._sp = int(mesh.shape["sp"])
+        self._mp = int(mesh.shape["mp"])
+        self._sharding = sharding
+        self._topo = CommunicateTopology(
+            ("data", "pipe", "sharding", "model"),
+            (self._dp // max(sharding, 1), self._pp, max(sharding, 1), self._mp),
+        )
+        self.global_rank = jax.process_index()
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    # -- degree queries (reference API) -------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp
+
+    def get_model_parallel_world_size(self):
+        return self._mp
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding if self._sharding > 1 else self._dp
+
+    def get_sequence_parallel_world_size(self):
+        return self._sp
+
+    def get_parallel_mode(self):
+        if self._mp == 1 and self._pp == 1 and self._sharding <= 1:
+            return "data_parallel"
+        if self._sharding > 1 and self._mp == 1 and self._pp == 1:
+            return "sharding_parallel"
+        if self._mp > 1 and self._pp == 1:
+            return "tensor_parallel"
+        return "pipeline_parallel"
+
+    # rank queries are meaningful per-process in multi-host runs
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    # -- sharding helpers ---------------------------------------------------
+    def named_sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self._mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self._mesh, PartitionSpec())
+
+    def data_sharding(self, rest_ndim: int = 0) -> NamedSharding:
+        return NamedSharding(self._mesh, PartitionSpec("dp"))
+
+    def topology(self):
+        return self._topo
+
+
+_HCG: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _HCG
+    _HCG = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _HCG

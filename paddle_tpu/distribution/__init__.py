@@ -1,0 +1,142 @@
+"""Probability distributions (reference `python/paddle/distribution.py`:
+Distribution, Normal, Uniform, Categorical)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import framework
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x, jnp.float32))
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        from ..ops import exp
+
+        return exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def sample(self, shape=(), seed=0):
+        key = framework.get_rng_key()
+        base_shape = jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape)
+        )
+        full = tuple(shape) + base_shape
+        eps = jax.random.normal(key, full, jnp.float32)
+        return Tensor(unwrap(self.loc) + unwrap(self.scale) * eps)
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def entropy(self):
+        return dispatch(
+            lambda s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+            self.scale,
+        )
+
+    def log_prob(self, value):
+        return dispatch(
+            lambda v, m, s: -((v - m) ** 2) / (2 * s * s) - jnp.log(s)
+            - 0.5 * math.log(2 * math.pi),
+            _t(value), self.loc, self.scale,
+        )
+
+    def kl_divergence(self, other):
+        return dispatch(
+            lambda m1, s1, m2, s2: jnp.log(s2 / s1)
+            + (s1 * s1 + (m1 - m2) ** 2) / (2 * s2 * s2) - 0.5,
+            self.loc, self.scale, other.loc, other.scale,
+        )
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+
+    def sample(self, shape=(), seed=0):
+        key = framework.get_rng_key()
+        base_shape = jnp.broadcast_shapes(
+            tuple(self.low.shape), tuple(self.high.shape)
+        )
+        full = tuple(shape) + base_shape
+        u = jax.random.uniform(key, full, jnp.float32)
+        return Tensor(unwrap(self.low) + (unwrap(self.high) - unwrap(self.low)) * u)
+
+    def entropy(self):
+        return dispatch(lambda lo, hi: jnp.log(hi - lo), self.low, self.high)
+
+    def log_prob(self, value):
+        return dispatch(
+            lambda v, lo, hi: jnp.where(
+                (v >= lo) & (v < hi), -jnp.log(hi - lo), -jnp.inf
+            ),
+            _t(value), self.low, self.high,
+        )
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+
+    def sample(self, shape=()):
+        key = framework.get_rng_key()
+        out = jax.random.categorical(
+            key, unwrap(self.logits), shape=tuple(shape) + tuple(self.logits.shape[:-1])
+        )
+        return Tensor(out.astype(jnp.int64))
+
+    def entropy(self):
+        return dispatch(
+            lambda l: -jnp.sum(
+                jax.nn.softmax(l, -1) * jax.nn.log_softmax(l, -1), axis=-1
+            ),
+            self.logits,
+        )
+
+    def log_prob(self, value):
+        return dispatch(
+            lambda l, v: jnp.take_along_axis(
+                jax.nn.log_softmax(l, -1), v.astype(jnp.int32)[..., None], axis=-1
+            ).squeeze(-1),
+            self.logits, _t(value), nondiff=(1,),
+        )
+
+    def probs(self, value):
+        from ..ops import exp
+
+        return exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        return dispatch(
+            lambda a, b: jnp.sum(
+                jax.nn.softmax(a, -1)
+                * (jax.nn.log_softmax(a, -1) - jax.nn.log_softmax(b, -1)),
+                axis=-1,
+            ),
+            self.logits, other.logits,
+        )

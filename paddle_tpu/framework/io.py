@@ -1,0 +1,57 @@
+"""paddle.save / paddle.load.
+
+Reference: `python/paddle/framework/io.py:222` — pickled nested state dicts
+with tensor payloads.  Here tensors serialize as numpy arrays inside a
+pickle; layout is a plain nested dict so checkpoints are portable and
+inspectable.  Large-scale sharded checkpointing is provided separately via
+orbax in `paddle_tpu.distributed.fleet.checkpoint`.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": obj.numpy(),
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"])
+            t.stop_gradient = obj.get("stop_gradient", True)
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_unpack(v, return_numpy) for v in obj]
+        return out if isinstance(obj, list) else tuple(out)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

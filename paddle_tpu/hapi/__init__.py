@@ -1,0 +1,3 @@
+from . import callbacks
+from .model import Model
+from .summary import summary

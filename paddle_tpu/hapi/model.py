@@ -1,0 +1,239 @@
+"""paddle.Model high-level API.
+
+Reference: `python/paddle/hapi/model.py:878` (Model.prepare/fit/evaluate/
+predict/save/load, `fit` at :1523).  TPU-native: `prepare()` builds one
+fused jit train step (`paddle_tpu.jit.TrainStep` — forward+backward+update
+in a single donated XLA executable) instead of per-op dygraph dispatch.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..metric import Metric
+from . import callbacks as cbks
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._optimizer = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self.stop_training = False
+
+    # -- setup --------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        if optimizer is not None and optimizer._parameters is None:
+            optimizer._parameters = self.network.parameters()
+        self._train_step = None  # rebuilt lazily
+
+    def _loss_fn(self, net, *batch):
+        *xs, y = batch
+        out = net(*xs)
+        loss = self._loss(out, y)
+        if isinstance(loss, (list, tuple)):
+            from ..ops import add_n
+
+            loss = add_n([l for l in loss])
+        return loss
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            from ..jit import TrainStep
+
+            self._train_step = TrainStep(self.network, self._loss_fn,
+                                         self._optimizer)
+
+    # -- imperative single-batch APIs ---------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        self._ensure_train_step()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        batch = list(inputs) + list(labels)
+        loss = self._train_step(*batch)
+        from ..optimizer.lr import LRScheduler
+
+        return [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..autograd import no_grad
+
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        with no_grad():
+            out = self.network(*inputs)
+            loss = self._loss(out, labels[0]) if self._loss else None
+        metrics = []
+        for m in self._metrics:
+            res = m.compute(out, labels[0])
+            m.update(res)
+            metrics.append(m.accumulate())
+        return ([float(loss.numpy())] if loss is not None else []), metrics
+
+    def predict_batch(self, inputs):
+        from ..autograd import no_grad
+
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            out = self.network(*inputs)
+        return out
+
+    # -- loops --------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cb_list = [cbks.ProgBarLogger(log_freq, verbose=verbose)]
+        cb_list.append(cbks.LRScheduler())
+        if save_dir:
+            cb_list.append(cbks.ModelCheckpoint(save_freq, save_dir))
+        if callbacks:
+            cb_list += list(callbacks)
+        cbs = cbks.CallbackList(cb_list)
+        cbs.set_model(self)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbs.set_params({"epochs": epochs, "steps": steps, "verbose": verbose})
+
+        self.stop_training = False
+        cbs.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbs.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                losses = self.train_batch(ins, labs)
+                logs = {"loss": losses[0]}
+                cbs.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          num_workers=num_workers)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+                cbs.on_eval_end(logs={k.replace("eval_", ""): v
+                                      for k, v in logs.items()})
+            cbs.on_epoch_end(epoch, logs)
+            if self.stop_training or (num_iters is not None and it_count >= num_iters):
+                break
+        cbs.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+
+        loader = DataLoader(eval_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(eval_data, Dataset) else eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            ins, labs = self._split_batch(batch)
+            l, _ = self.eval_batch(ins, labs)
+            if l:
+                losses.append(l[0])
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            if isinstance(names, str):
+                names, vals = [names], [vals]
+            elif not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+
+        loader = DataLoader(test_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(test_data, Dataset) else test_data
+        outputs = []
+        for batch in loader:
+            ins = batch[0] if isinstance(batch, (list, tuple)) else batch
+            out = self.predict_batch([ins])
+            outputs.append(out.numpy() if isinstance(out, Tensor) else out)
+        if stack_outputs:
+            return [np.concatenate(outputs)]
+        return [outputs]
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return [batch], []
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+
+        if training:
+            fsave(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                fsave(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from .. import jit
+
+            jit.save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+
+        state = fload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+        self._train_step = None
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+
+        return summary(self.network, input_size, dtype)

@@ -1,0 +1,22 @@
+"""Model summary (reference `python/paddle/hapi/model_summary.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def summary(net, input_size=None, dtypes=None):
+    total_params = 0
+    trainable_params = 0
+    lines = ["-" * 64, f"{'Layer':<30}{'Param shape':<22}{'#':>10}", "=" * 64]
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total_params += n
+        if p.trainable:
+            trainable_params += n
+        lines.append(f"{name:<30}{str(p.shape):<22}{n:>10}")
+    lines += ["=" * 64,
+              f"Total params: {total_params:,}",
+              f"Trainable params: {trainable_params:,}",
+              "-" * 64]
+    print("\n".join(lines))
+    return {"total_params": total_params, "trainable_params": trainable_params}

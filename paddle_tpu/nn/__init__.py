@@ -1,0 +1,39 @@
+"""`paddle.nn` equivalent surface (reference Appendix B of SURVEY.md)."""
+from . import functional
+from . import initializer
+from .layer.layers import Layer, Parameter
+from .layer.container import LayerDict, LayerList, ParameterList, Sequential
+from .layer.common import (AlphaDropout, Bilinear, ChannelShuffle,
+                           CosineSimilarity, Dropout, Dropout2D, Dropout3D,
+                           Embedding, Flatten, Fold, Identity, Linear, Pad1D,
+                           Pad2D, Pad3D, PairwiseDistance, PixelShuffle,
+                           PixelUnshuffle, Unfold, Upsample,
+                           UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D)
+from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
+                         Conv3D, Conv3DTranspose)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                         GroupNorm, InstanceNorm1D, InstanceNorm2D,
+                         InstanceNorm3D, LayerNorm, LocalResponseNorm,
+                         SpectralNorm, SyncBatchNorm)
+from .layer.activation import (CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid,
+                               Hardswish, Hardtanh, LeakyReLU, LogSigmoid,
+                               LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
+                               RReLU, SELU, Sigmoid, Silu, Softmax, Softplus,
+                               Softshrink, Softsign, Swish, Tanh, Tanhshrink,
+                               ThresholdedReLU)
+from .layer.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
+                         CrossEntropyLoss, CTCLoss, HingeEmbeddingLoss,
+                         HSigmoidLoss, KLDivLoss, L1Loss, MarginRankingLoss,
+                         MSELoss, NLLLoss, SmoothL1Loss, TripletMarginLoss)
+from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,
+                            AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+                            AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
+                            AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
+                            MaxPool3D)
+from .layer.transformer import (MultiHeadAttention, Transformer,
+                                TransformerDecoder, TransformerDecoderLayer,
+                                TransformerEncoder, TransformerEncoderLayer)
+from .layer.rnn import (BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase,
+                        SimpleRNN, SimpleRNNCell)
+
+from ..utils.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue

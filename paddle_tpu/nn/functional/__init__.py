@@ -1,0 +1,11 @@
+"""`paddle.nn.functional` equivalent surface."""
+from ...ops.manipulation import pad  # noqa: F401
+from ...ops.creation import one_hot as _one_hot_op  # noqa: F401
+
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401

@@ -1,0 +1,188 @@
+"""Activation functionals.
+
+Reference: `operators/activation_op.cc` (incl. the REGISTER_ACTIVATION macro
+family, `activation_op.cc:712+`) and `python/paddle/nn/functional/activation.py`.
+All map to VPU elementwise HLO; XLA fuses them into adjacent matmuls/convs
+(replacing the reference's `fused_*_activation` ops).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import BLACK, dispatch
+from ...core.tensor import Tensor, unwrap
+
+
+def relu(x, name=None):
+    return dispatch(jax.nn.relu, x)
+
+
+def relu6(x, name=None):
+    return dispatch(lambda a: jnp.clip(a, 0, 6), x)
+
+
+def relu_(x):
+    out = relu(x)
+    x.set_value(out._array)
+    return x
+
+
+def sigmoid(x, name=None):
+    return dispatch(jax.nn.sigmoid, x)
+
+
+def log_sigmoid(x, name=None):
+    return dispatch(jax.nn.log_sigmoid, x)
+
+
+def tanh(x, name=None):
+    return dispatch(jnp.tanh, x)
+
+
+def tanhshrink(x, name=None):
+    return dispatch(lambda a: a - jnp.tanh(a), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return dispatch(lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return dispatch(lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return dispatch(lambda a: jax.nn.elu(a, alpha), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return dispatch(lambda a: jax.nn.celu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return dispatch(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def silu(x, name=None):
+    return dispatch(jax.nn.silu, x)
+
+
+def swish(x, name=None):
+    return dispatch(jax.nn.silu, x)
+
+
+def mish(x, name=None):
+    return dispatch(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return dispatch(lambda a: jnp.clip(a, min, max), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return dispatch(lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return dispatch(lambda a: a * jnp.clip(a + 3, 0, 6) / 6, x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return dispatch(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return dispatch(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        x,
+    )
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return dispatch(
+        lambda a: jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta), x
+    )
+
+
+def softsign(x, name=None):
+    return dispatch(jax.nn.soft_sign, x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return dispatch(lambda a: jnp.where(a > threshold, a, 0.0), x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    return dispatch(lambda a: jax.nn.softmax(a, axis=axis), x, amp_policy=BLACK)
+
+
+def softmax_(x, axis=-1):
+    out = softmax(x, axis)
+    x.set_value(out._array)
+    return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return dispatch(lambda a: jax.nn.log_softmax(a, axis=axis), x, amp_policy=BLACK)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import framework
+
+    key = framework.get_rng_key()
+
+    def f(a):
+        g = -jnp.log(-jnp.log(jax.random.uniform(key, a.shape) + 1e-20) + 1e-20)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return dispatch(f, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        shape = list(a.shape)
+        c = shape[axis]
+        shape[axis] = c // groups
+        shape.insert(axis + 1, groups)
+        return jnp.max(a.reshape(shape), axis=axis + 1)
+
+    return dispatch(f, x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return dispatch(f, x, weight)
+
+
+def glu(x, axis=-1, name=None):
+    return dispatch(lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    from ...core import framework
+
+    if not training:
+        slope = (lower + upper) / 2
+        return dispatch(lambda a: jnp.where(a >= 0, a, a * slope), x)
+    key = framework.get_rng_key()
+
+    def f(a):
+        slopes = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+        return jnp.where(a >= 0, a, a * slopes)
+
+    return dispatch(f, x)

@@ -1,0 +1,354 @@
+"""Common functionals: linear, dropout, embedding, interpolate, etc.
+
+Reference: `operators/matmul_v2_op.*`+`elementwise_add` (linear),
+`operators/dropout_op.*`, `lookup_table_v2_op.*` (embedding),
+`interpolate_v2` family, `pixel_shuffle_op`, `unfold_op`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import framework
+from ...core.dispatch import WHITE, dispatch
+from ...core.tensor import Tensor, unwrap
+
+
+def linear(x, weight, bias=None, name=None):
+    # paddle weight layout: [in_features, out_features]
+    def f(a, w, *b):
+        out = jnp.matmul(a, w)
+        if b:
+            out = out + b[0]
+        return out
+
+    if bias is not None:
+        return dispatch(f, x, weight, bias, amp_policy=WHITE)
+    return dispatch(f, x, weight, amp_policy=WHITE)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0:
+        # downscale_in_infer (the reference's legacy default) scales by the
+        # keep probability at inference instead of upscaling at train time
+        if mode == "downscale_in_infer" and p > 0:
+            return dispatch(lambda a: a * (1.0 - p), x)
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = framework.get_rng_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return dispatch(f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    key = framework.get_rng_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p**2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return dispatch(f, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(w, i):
+        out = jnp.take(w, i, axis=0)
+        if padding_idx is not None:
+            out = jnp.where((i == padding_idx)[..., None], 0.0, out)
+        return out
+
+    # note arg order: weight differentiable, index not
+    def g(w):
+        return f(w, unwrap(x).astype(jnp.int32))
+
+    return dispatch(g, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(y, *pd):
+        k = y.shape[-1]
+        if pd:
+            return (1 - epsilon) * y + epsilon * pd[0]
+        return (1 - epsilon) * y + epsilon / k
+
+    if prior_dist is not None:
+        return dispatch(f, label, prior_dist)
+    return dispatch(f, label)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return dispatch(f, x1, x2)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return dispatch(f, x, y)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi:
+            out = out + bi[0]
+        return out
+
+    if bias is not None:
+        return dispatch(f, x1, x2, weight, bias)
+    return dispatch(f, x1, x2, weight)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return dispatch(f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        return a.reshape(n, c * r * r, h // r, w // r)
+
+    return dispatch(f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+    return dispatch(f, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference `operators/unfold_op.*` / math/im2col)."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        cols = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                patch = a[
+                    :,
+                    :,
+                    i * dl[0] : i * dl[0] + oh * st[0] : st[0],
+                    j * dl[1] : j * dl[1] + ow * st[1] : st[1],
+                ]
+                cols.append(patch)
+        out = jnp.stack(cols, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return dispatch(f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    out_h, out_w = output_sizes
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = out_h + 2 * pd[0], out_w + 2 * pd[1]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        a = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[
+                    :,
+                    :,
+                    i * dl[0] : i * dl[0] + oh * st[0] : st[0],
+                    j * dl[1] : j * dl[1] + ow * st[1] : st[1],
+                ].add(a[:, :, i, j])
+        return out[:, :, pd[0] : pd[0] + out_h, pd[1] : pd[1] + out_w]
+
+    return dispatch(f, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    """Reference `operators/interpolate_v2_op.*` (nearest/bilinear/bicubic/
+    trilinear/linear/area) via jax.image.resize."""
+    a = unwrap(x)
+    channel_first = data_format.startswith("NC")
+    nd = a.ndim - 2
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.numpy().tolist()]
+        out_spatial = [int(unwrap(s)) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+        spatial = a.shape[2:] if channel_first else a.shape[1:-1]
+        out_spatial = [int(s * f) for s, f in zip(spatial, sf)]
+
+    method = {
+        "nearest": "nearest",
+        "bilinear": "bilinear",
+        "bicubic": "bicubic",
+        "trilinear": "trilinear",
+        "linear": "linear",
+        "area": "linear",
+    }[mode]
+    if method == "trilinear":
+        method = "linear"
+
+    def f(arr):
+        if channel_first:
+            out_shape = arr.shape[:2] + tuple(out_spatial)
+        else:
+            out_shape = (arr.shape[0],) + tuple(out_spatial) + (arr.shape[-1],)
+        return jax.image.resize(arr, out_shape, method=method).astype(arr.dtype)
+
+    return dispatch(f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def f(th):
+        n, c, h, w = [int(s) for s in (out_shape if not isinstance(out_shape, Tensor) else out_shape.numpy())]
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) / h * 2 - 1
+            xs = (jnp.arange(w) + 0.5) / w * 2 - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # h,w,3
+        return jnp.einsum("nij,hwj->nhwi", th, base)
+
+    return dispatch(f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            ix = (gx + 1) / 2 * (w - 1)
+            iy = (gy + 1) / 2 * (h - 1)
+        else:
+            ix = ((gx + 1) * w - 1) / 2
+            iy = ((gy + 1) * h - 1) / 2
+        if mode == "nearest":
+            ix_r = jnp.clip(jnp.round(ix), 0, w - 1).astype(jnp.int32)
+            iy_r = jnp.clip(jnp.round(iy), 0, h - 1).astype(jnp.int32)
+            return a[jnp.arange(n)[:, None, None], :, iy_r, ix_r].transpose(0, 3, 1, 2)
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+        wx1, wy1 = ix - x0, iy - y0
+        wx0, wy0 = 1 - wx1, 1 - wy1
+
+        def gather(yy, xx):
+            valid = (xx >= 0) & (xx <= w - 1) & (yy >= 0) & (yy <= h - 1)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            v = a[jnp.arange(n)[:, None, None], :, yc, xc]  # n,hg,wg,c
+            if padding_mode == "zeros":
+                v = jnp.where(valid[..., None], v, 0.0)
+            return v
+
+        out = (
+            gather(y0, x0) * (wy0 * wx0)[..., None]
+            + gather(y0, x1) * (wy0 * wx1)[..., None]
+            + gather(y1, x0) * (wy1 * wx0)[..., None]
+            + gather(y1, x1) * (wy1 * wx1)[..., None]
+        )
+        return out.transpose(0, 3, 1, 2)
+
+    return dispatch(f, x, grid)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        left = jnp.concatenate([a[:, 1:, :fold_c], jnp.zeros_like(a[:, -1:, :fold_c])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(a[:, :1, fold_c:2 * fold_c]), a[:, :-1, fold_c:2 * fold_c]], axis=1)
+        rest = a[:, :, 2 * fold_c:]
+        out = jnp.concatenate([left, right, rest], axis=2)
+        return out.reshape(nt, c, h, w)
+
+    return dispatch(f, x)
+
+
+def npair_loss(*args, **kwargs):
+    from .loss import npair_loss as _n
+
+    return _n(*args, **kwargs)

@@ -1,0 +1,173 @@
+"""Convolution functionals.
+
+Reference: `operators/conv_op.*` + cudnn kernels (`conv_cudnn_op.cu`) and
+`operators/conv_transpose_op.*`.  TPU-native: `lax.conv_general_dilated`,
+which XLA tiles onto the MXU; AMP white-listed (bf16).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import WHITE, dispatch
+from ...core.tensor import unwrap
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _padding(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    return [tuple(p) for p in padding]
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    pad = _padding(padding, nd)
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        dn_in = "NC" + "DHW"[3 - nd:]
+    else:
+        dn_in = "N" + "DHW"[3 - nd:] + "C"
+    spatial = "DHW"[3 - nd:]
+    dn = lax.conv_dimension_numbers(
+        unwrap(x).shape,
+        unwrap(weight).shape,
+        (dn_in, "OI" + spatial, dn_in),
+    )
+
+    def f(a, w, *b):
+        out = lax.conv_general_dilated(
+            a,
+            w,
+            window_strides=stride,
+            padding=pad,
+            rhs_dilation=dilation,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None,
+        )
+        if out.dtype != a.dtype:
+            out = out.astype(a.dtype)
+        if b:
+            bias_shape = [1] * out.ndim
+            c_axis = 1 if dn_in.startswith("NC") else out.ndim - 1
+            bias_shape[c_axis] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    if bias is not None:
+        return dispatch(f, x, weight, bias, amp_policy=WHITE)
+    return dispatch(f, x, weight, amp_policy=WHITE)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, nd, data_format):
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    out_pad = _pair(output_padding, nd)
+    pad = _padding(padding, nd)
+    if isinstance(pad, str):
+        raise ValueError("string padding not supported for conv_transpose")
+    dn_in = "NC" + "DHW"[3 - nd:] if data_format.startswith("NC") else "N" + "DHW"[3 - nd:] + "C"
+    spatial = "DHW"[3 - nd:]
+    # weight layout in paddle conv_transpose: [in, out//groups, *k] -> "IO"
+    dn = lax.conv_dimension_numbers(
+        unwrap(x).shape,
+        unwrap(weight).shape,
+        (dn_in, "IO" + spatial, dn_in),
+    )
+
+    def f(a, w, *b):
+        # gradient-of-conv formulation: lhs_dilation=stride
+        k = [(w.shape[2 + i] - 1) * dilation[i] for i in range(nd)]
+        tpad = [
+            (k[i] - pad[i][0], k[i] - pad[i][1] + out_pad[i]) for i in range(nd)
+        ]
+        out = lax.conv_general_dilated(
+            a,
+            jnp.flip(w, axis=tuple(range(2, 2 + nd))),
+            window_strides=(1,) * nd,
+            padding=tpad,
+            lhs_dilation=stride,
+            rhs_dilation=dilation,
+            dimension_numbers=dn,
+            feature_group_count=1 if groups == 1 else groups,
+        )
+        if b:
+            bias_shape = [1] * out.ndim
+            c_axis = 1 if dn_in.startswith("NC") else out.ndim - 1
+            bias_shape[c_axis] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    if groups != 1:
+        # grouped transpose: split and concat (rare path)
+        from ...ops import concat as cat
+        from ...ops import split as sp
+
+        xs = sp(x, groups, axis=1 if data_format.startswith("NC") else -1)
+        ws = sp(weight, groups, axis=0)
+        outs = [
+            _conv_transpose_nd(xi, wi, None, stride, padding, output_padding,
+                               dilation, 1, nd, data_format)
+            for xi, wi in zip(xs, ws)
+        ]
+        out = cat(outs, axis=1 if data_format.startswith("NC") else -1)
+        if bias is not None:
+            from ...ops import reshape
+
+            bshape = [1] * out.ndim
+            c_axis = 1 if data_format.startswith("NC") else out.ndim - 1
+            bshape[c_axis] = bias.shape[0]
+            out = out + reshape(bias, bshape)
+        return out
+
+    if bias is not None:
+        return dispatch(f, x, weight, bias, amp_policy=WHITE)
+    return dispatch(f, x, weight, amp_policy=WHITE)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCDHW", output_size=None, name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format)

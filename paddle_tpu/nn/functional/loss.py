@@ -1,0 +1,305 @@
+"""Loss functionals.
+
+Reference: `operators/softmax_with_cross_entropy_op.*`, `cross_entropy_op.*`,
+`bce_loss_op.*`, `huber_loss_op.*`, `kldiv_loss_op.*`, `nll_loss_op.*`, etc.;
+python `python/paddle/nn/functional/loss.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import BLACK, dispatch
+from ...core.tensor import Tensor, unwrap
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, name=None):
+    w = unwrap(weight) if weight is not None else None
+
+    def f(logits, lbl):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) if use_softmax \
+            else jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        if soft_label:
+            loss = -jnp.sum(lbl * logp, axis=axis)
+        else:
+            li = lbl.astype(jnp.int32)
+            if li.ndim == logp.ndim:
+                li = jnp.squeeze(li, axis=axis)
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(li, axis), axis=axis
+            ).squeeze(axis)
+            mask = (li != ignore_index)
+            if w is not None:
+                cw = w[li]
+                loss = loss * cw
+                if reduction == "mean":
+                    return jnp.sum(jnp.where(mask, loss, 0.0)) / jnp.maximum(
+                        jnp.sum(jnp.where(mask, cw, 0.0)), 1e-12
+                    )
+            loss = jnp.where(mask, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+
+    if soft_label:
+        return dispatch(f, input, label, amp_policy=BLACK)
+    return dispatch(f, input, label, nondiff=(1,), amp_policy=BLACK)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return dispatch(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label)
+
+
+def square_error_cost(input, label):
+    return dispatch(lambda a, b: jnp.square(a - b), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return dispatch(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = a - b
+        loss = jnp.where(
+            jnp.abs(d) < delta, 0.5 * d * d / delta, jnp.abs(d) - 0.5 * delta
+        ) * delta
+        return _reduce(loss, reduction)
+
+    return dispatch(f, input, label)
+
+
+def huber_loss(input, label, delta=1.0):
+    def f(a, b):
+        d = a - b
+        return jnp.where(jnp.abs(d) <= delta, 0.5 * d * d,
+                         delta * (jnp.abs(d) - 0.5 * delta))
+
+    return dispatch(f, input, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    w = unwrap(weight) if weight is not None else None
+
+    def f(logp, lbl):
+        li = lbl.astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, li[..., None] if logp.ndim == li.ndim + 1
+                                    else li, axis=1 if logp.ndim > 1 else 0)
+        if logp.ndim == li.ndim + 1:
+            loss = loss.squeeze(1)
+        mask = li != ignore_index
+        if w is not None:
+            cw = w[li]
+            loss = loss * cw
+            if reduction == "mean":
+                return jnp.sum(jnp.where(mask, loss, 0.0)) / jnp.maximum(
+                    jnp.sum(jnp.where(mask, cw, 0.0)), 1e-12
+                )
+        loss = jnp.where(mask, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+
+    return dispatch(f, input, label, nondiff=(1,))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    if weight is not None:
+        return dispatch(f, input, label, weight)
+    return dispatch(f, input, label)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    pw = unwrap(pos_weight) if pos_weight is not None else None
+
+    def f(z, y, *w):
+        logp = jax.nn.log_sigmoid(z)
+        lognp = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * y * logp + (1 - y) * lognp)
+        else:
+            loss = -(y * logp + (1 - y) * lognp)
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    if weight is not None:
+        return dispatch(f, logit, label, weight)
+    return dispatch(f, logit, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    if normalizer is not None:
+        return dispatch(f, logit, label, normalizer)
+    return dispatch(f, logit, label)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return dispatch(f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+
+    return dispatch(f, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+
+    return dispatch(f, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return dispatch(f, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-06, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, -1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, -1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, -1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return dispatch(f, input, positive, negative)
+
+
+def log_loss(input, label, epsilon=0.0001, name=None):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return dispatch(f, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, l):
+        sim = a @ p.T
+        batch = l.reshape(-1, 1)
+        target = (batch == batch.T).astype(jnp.float32)
+        target = target / jnp.sum(target, axis=1, keepdims=True)
+        ce = jnp.mean(
+            -jnp.sum(target * jax.nn.log_softmax(sim, axis=1), axis=1)
+        )
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return ce + reg
+
+    return dispatch(f, anchor, positive, labels, nondiff=(2,))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference `operators/warpctc_op.*`), pure-XLA forward-alpha
+    recursion over `lax.scan`."""
+    def f(lp, lbl):
+        # lp: [T, B, C] log-softmaxed; lbl: [B, S]
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        S = lbl.shape[1]
+        # extended labels: blank,l1,blank,l2,...,blank -> length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        ext_len = 2 * label_lengths_arr + 1
+
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(S > 0, lp[0, jnp.arange(B), ext[:, 1]], neg_inf)
+        )
+
+        same = jnp.concatenate(
+            [jnp.ones((B, 2), dtype=bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        in_len = input_lengths_arr  # [B]
+
+        def step(carry, xs):
+            alpha = carry
+            lp_t, t = xs
+            a0 = alpha
+            a1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a2 = jnp.where(same, neg_inf, a2)
+            m = jnp.maximum(jnp.maximum(a0, a1), a2)
+            new = m + jnp.log(
+                jnp.exp(a0 - m) + jnp.exp(a1 - m) + jnp.exp(a2 - m) + 1e-30
+            )
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            new = new + emit
+            # freeze alpha for sequences already past their input length
+            active = (t < in_len)[:, None]
+            return jnp.where(active, new, alpha), None
+
+        ts = jnp.arange(1, T)
+        alphaT, _ = jax.lax.scan(step, alpha0, (lp[1:], ts))
+        # gather at positions ext_len-1 and ext_len-2
+        idx1 = jnp.clip(ext_len - 1, 0, 2 * S)
+        idx2 = jnp.clip(ext_len - 2, 0, 2 * S)
+        b = jnp.arange(B)
+        ll = jnp.logaddexp(alphaT[b, idx1], alphaT[b, idx2])
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(label_lengths_arr, 1))
+        return _reduce(loss, reduction)
+
+    label_lengths_arr = unwrap(label_lengths).astype(jnp.int32)
+    input_lengths_arr = unwrap(input_lengths).astype(jnp.int32)
+    return dispatch(f, log_probs, labels, nondiff=(1,))

@@ -1,0 +1,166 @@
+"""Normalization functionals.
+
+Reference: `operators/batch_norm_op.*`, `layer_norm_op.*`, `group_norm_op.*`,
+`instance_norm_op.*`, `norm_op.*` (all cudnn/CUDA); here each is a few fused
+VPU lines.  Norm statistics are AMP-black (fp32).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import BLACK, dispatch
+from ...core.tensor import Tensor, unwrap
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return dispatch(f, x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    c_axis = 1 if data_format.startswith("NC") else unwrap(x).ndim - 1
+    reduce_axes = tuple(i for i in range(unwrap(x).ndim) if i != c_axis)
+    use_batch_stats = training and not use_global_stats
+
+    bshape = [1] * unwrap(x).ndim
+    bshape[c_axis] = -1
+
+    if use_batch_stats:
+        def f(a, *wb):
+            mean = jnp.mean(a.astype(jnp.float32), axis=reduce_axes)
+            var = jnp.var(a.astype(jnp.float32), axis=reduce_axes)
+            out = (a - mean.reshape(bshape).astype(a.dtype)) * jax.lax.rsqrt(
+                var.reshape(bshape) + epsilon
+            ).astype(a.dtype)
+            if wb:
+                out = out * wb[0].reshape(bshape) + wb[1].reshape(bshape)
+            return out
+
+        # update running stats eagerly (buffers; reference batch_norm_op
+        # updates MeanOut/VarianceOut in the same kernel)
+        a = unwrap(x).astype(jnp.float32)
+        mean = jnp.mean(a, axis=reduce_axes)
+        var = jnp.var(a, axis=reduce_axes)
+        if running_mean is not None and isinstance(running_mean, Tensor):
+            # set_value handles the in-trace case (records the write as a
+            # compiled-program output) — no exception guard needed
+            running_mean.set_value(
+                momentum * running_mean._array + (1 - momentum) * mean
+            )
+            running_var.set_value(
+                momentum * running_var._array + (1 - momentum) * var
+            )
+    else:
+        rm, rv = unwrap(running_mean), unwrap(running_var)
+
+        def f(a, *wb):
+            out = (a - rm.reshape(bshape).astype(a.dtype)) * jax.lax.rsqrt(
+                rv.reshape(bshape) + epsilon
+            ).astype(a.dtype)
+            if wb:
+                out = out * wb[0].reshape(bshape) + wb[1].reshape(bshape)
+            return out
+
+    if weight is not None:
+        return dispatch(f, x, weight, bias)
+    return dispatch(f, x)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(normalized_shape)
+    axes = tuple(range(-nd, 0))
+
+    def f(a, *wb):
+        a32 = a.astype(jnp.float32)
+        mean = jnp.mean(a32, axis=axes, keepdims=True)
+        var = jnp.var(a32, axis=axes, keepdims=True)
+        out = ((a32 - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        if wb:
+            out = out * wb[0] + wb[1]
+        return out
+
+    if weight is not None:
+        return dispatch(f, x, weight, bias)
+    return dispatch(f, x)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    c_axis = 1 if data_format.startswith("NC") else unwrap(x).ndim - 1
+
+    def f(a, *wb):
+        shape = a.shape
+        if c_axis != 1:
+            a = jnp.moveaxis(a, c_axis, 1)
+        n, c = a.shape[0], a.shape[1]
+        g = a.reshape(n, num_groups, c // num_groups, *a.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(g.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((g.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        out = out.reshape(a.shape)
+        if wb:
+            bshape = [1, c] + [1] * (a.ndim - 2)
+            out = out * wb[0].reshape(bshape) + wb[1].reshape(bshape)
+        if c_axis != 1:
+            out = jnp.moveaxis(out, 1, c_axis)
+        return out
+
+    if weight is not None:
+        return dispatch(f, x, weight, bias)
+    return dispatch(f, x)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    nd = unwrap(x).ndim
+    channel_first = data_format.startswith("NC")
+    # per-(sample, channel) stats over the spatial dims only
+    axes = tuple(range(2, nd)) if channel_first else tuple(range(1, nd - 1))
+
+    def f(a, *wb):
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps)).astype(a.dtype)
+        if wb:
+            if channel_first:
+                bshape = [1, -1] + [1] * (nd - 2)
+            else:
+                bshape = [1] * (nd - 1) + [-1]
+            out = out * wb[0].reshape(bshape) + wb[1].reshape(bshape)
+        return out
+
+    if weight is not None:
+        return dispatch(f, x, weight, bias)
+    return dispatch(f, x)
+
+
+def local_response_norm(x, size, alpha=0.0001, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        # across-channel LRN (reference operators/lrn_op.cc)
+        c_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[c_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        # sliding window sum over channel axis
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            idx = [slice(None)] * a.ndim
+            idx[c_axis] = slice(i, i + a.shape[c_axis])
+            acc = acc + padded[tuple(idx)]
+        div = jnp.power(k + alpha * acc, beta)
+        return a / div
+
+    return dispatch(f, x)

@@ -1,0 +1,162 @@
+"""Pooling functionals via `lax.reduce_window`.
+
+Reference: `operators/pool_op.*`, `operators/math/pooling.cu`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import dispatch
+from ...core.tensor import unwrap
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _pool_nd(x, kernel, stride, padding, nd, mode, ceil_mode=False,
+             exclusive=True, data_format="NCHW"):
+    kernel = _tup(kernel, nd)
+    stride = _tup(stride if stride is not None else kernel, nd)
+    if isinstance(padding, str):
+        pad_spatial = padding.upper()
+    else:
+        p = _tup(padding, nd) if not isinstance(padding, (list, tuple)) or all(
+            isinstance(i, int) for i in padding
+        ) else padding
+        if isinstance(p, tuple) and len(p) == nd:
+            pad_spatial = [(i, i) for i in p]
+        else:
+            pad_spatial = [tuple(i) for i in p]
+
+    channel_first = data_format.startswith("NC")
+
+    def f(a):
+        if channel_first:
+            window = (1, 1) + kernel
+            strides = (1, 1) + stride
+        else:
+            window = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+        if isinstance(pad_spatial, str):
+            pads = pad_spatial
+        else:
+            if channel_first:
+                pads = [(0, 0), (0, 0)] + list(pad_spatial)
+            else:
+                pads = [(0, 0)] + list(pad_spatial) + [(0, 0)]
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return lax.reduce_window(a, init, lax.max, window, strides, pads)
+        # avg
+        summed = lax.reduce_window(a.astype(jnp.float32), 0.0, lax.add, window, strides, pads)
+        if exclusive and not isinstance(pads, str):
+            ones = jnp.ones_like(a, dtype=jnp.float32)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+            return (summed / counts).astype(a.dtype)
+        return (summed / float(np.prod(kernel))).astype(a.dtype)
+
+    return dispatch(f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "max", ceil_mode,
+                    data_format="NCL")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, "max", ceil_mode,
+                    data_format=data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "max", ceil_mode,
+                    data_format=data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "avg",
+                    ceil_mode, exclusive, data_format="NCL")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, "avg",
+                    ceil_mode, exclusive, data_format=data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "avg",
+                    ceil_mode, exclusive, data_format=data_format)
+
+
+def _adaptive_pool_nd(x, output_size, nd, mode, data_format):
+    channel_first = data_format.startswith("NC")
+    out_sizes = _tup(output_size, nd)
+
+    def f(a):
+        spatial_start = 2 if channel_first else 1
+        out = a
+        # adaptive pooling = for each spatial dim, segment into output bins
+        for d in range(nd):
+            axis = spatial_start + d
+            in_size = out.shape[axis]
+            o = out_sizes[d] if out_sizes[d] is not None else in_size
+            if in_size % o == 0:
+                k = in_size // o
+                shape = list(out.shape)
+                shape[axis] = o
+                shape.insert(axis + 1, k)
+                r = out.reshape(shape)
+                out = jnp.max(r, axis=axis + 1) if mode == "max" else jnp.mean(r, axis=axis + 1)
+            else:
+                # general bins via cumulative windows
+                starts = (np.arange(o) * in_size) // o
+                ends = ((np.arange(o) + 1) * in_size + o - 1) // o
+                segs = []
+                for s, e in zip(starts, ends):
+                    sl = [slice(None)] * out.ndim
+                    sl[axis] = slice(int(s), int(e))
+                    block = out[tuple(sl)]
+                    r = jnp.max(block, axis=axis, keepdims=True) if mode == "max" else jnp.mean(
+                        block, axis=axis, keepdims=True
+                    )
+                    segs.append(r)
+                out = jnp.concatenate(segs, axis=axis)
+        return out
+
+    return dispatch(f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool_nd(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool_nd(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool_nd(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(x, output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(x, output_size, 3, "max", "NCDHW")

@@ -1,0 +1,206 @@
+"""Weight initializers.
+
+Reference: `python/paddle/fluid/initializer.py:99-869` (Constant, Uniform,
+Normal, TruncatedNormal, Xavier, MSRA/Kaiming, Bilinear, NumpyArrayInitializer)
+re-exported as `paddle.nn.initializer`.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import framework
+
+
+def _fan_in_out(shape):
+    shape = list(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def _init(self, shape, dtype):
+        raise NotImplementedError
+
+    def __call__(self, param, block=None):
+        param.set_value(self._init(param.shape, param.dtype))
+        return param
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _init(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def _init(self, shape, dtype):
+        key = framework.get_rng_key()
+        return jax.random.uniform(key, shape, dtype, self.low, self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def _init(self, shape, dtype):
+        key = framework.get_rng_key()
+        return jax.random.normal(key, shape, dtype) * self.std + self.mean
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def _init(self, shape, dtype):
+        key = framework.get_rng_key()
+        return (
+            jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype) * self.std
+            + self.mean
+        )
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _init(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        key = framework.get_rng_key()
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _init(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        key = framework.get_rng_key()
+        return jax.random.normal(key, shape, dtype) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def _init(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2))
+        limit = gain * math.sqrt(3.0 / fi)
+        key = framework.get_rng_key()
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def _init(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2))
+        std = gain / math.sqrt(fi)
+        key = framework.get_rng_key()
+        return jax.random.normal(key, shape, dtype) * std
+
+
+MSRAInitializer = KaimingNormal
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def _init(self, shape, dtype):
+        arr = jnp.asarray(np.asarray(self.value), dtype=dtype)
+        if list(arr.shape) != list(shape):
+            arr = arr.reshape(shape)
+        return arr
+
+
+NumpyArrayInitializer = Assign
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init (reference initializer.py:693)."""
+
+    def _init(self, shape, dtype):
+        weight = np.zeros(shape, dtype="float32")
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape[2:])):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            v = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            weight[:, :, y, x] = v
+        return jnp.asarray(weight, dtype=dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def _init(self, shape, dtype):
+        key = framework.get_rng_key()
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        a = jax.random.normal(key, (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def _init(self, shape, dtype):
+        w = np.zeros(shape, dtype="float32")
+        out_per_group = shape[0] // self.groups
+        for g in range(self.groups):
+            for i in range(min(out_per_group, shape[1])):
+                idx = (g * out_per_group + i, i) + tuple(s // 2 for s in shape[2:])
+                w[idx] = 1.0
+        return jnp.asarray(w, dtype=dtype)
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity in ("sigmoid", "linear", "conv1d", "conv2d", "conv3d"):
+        return 1.0
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = param if param is not None else 0.01
+        return math.sqrt(2.0 / (1 + a**2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    raise ValueError(nonlinearity)

@@ -1,0 +1,71 @@
+"""Activation layer classes (reference `python/paddle/nn/layer/activation.py`)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+
+def _act_layer(fn_name, **defaults):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            names = list(defaults.keys())
+            self._kw = dict(defaults)
+            for i, a in enumerate(args):
+                self._kw[names[i]] = a
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kw[k] = v
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kw)
+
+        def extra_repr(self):
+            return ", ".join(f"{k}={v}" for k, v in self._kw.items())
+
+    return _Act
+
+
+ReLU = _act_layer("relu")
+ReLU6 = _act_layer("relu6")
+Sigmoid = _act_layer("sigmoid")
+LogSigmoid = _act_layer("log_sigmoid")
+Tanh = _act_layer("tanh")
+Tanhshrink = _act_layer("tanhshrink")
+GELU = _act_layer("gelu", approximate=False)
+LeakyReLU = _act_layer("leaky_relu", negative_slope=0.01)
+ELU = _act_layer("elu", alpha=1.0)
+CELU = _act_layer("celu", alpha=1.0)
+SELU = _act_layer("selu")
+Silu = _act_layer("silu")
+Swish = _act_layer("swish")
+Mish = _act_layer("mish")
+Hardtanh = _act_layer("hardtanh", min=-1.0, max=1.0)
+Hardsigmoid = _act_layer("hardsigmoid")
+Hardswish = _act_layer("hardswish")
+Hardshrink = _act_layer("hardshrink", threshold=0.5)
+Softshrink = _act_layer("softshrink", threshold=0.5)
+Softplus = _act_layer("softplus", beta=1.0, threshold=20.0)
+Softsign = _act_layer("softsign")
+ThresholdedReLU = _act_layer("thresholded_relu", threshold=1.0)
+Softmax = _act_layer("softmax", axis=-1)
+LogSoftmax = _act_layer("log_softmax", axis=-1)
+Maxout = _act_layer("maxout", groups=2, axis=1)
+GLU = _act_layer("glu", axis=-1)
+RReLU = _act_layer("rrelu", lower=0.125, upper=1.0 / 3.0)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        from .. import initializer as I
+
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init),
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

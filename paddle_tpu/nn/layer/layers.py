@@ -1,0 +1,334 @@
+"""Layer: base class of all neural network modules.
+
+Reference: `python/paddle/fluid/dygraph/layers.py` (Layer with parameter /
+sublayer / buffer registries, forward hooks, state_dict, train/eval) and
+`ParamBase` (`fluid/framework.py`).  The TPU-native addition is
+``functional_state`` / ``load_functional_state``: a Layer's parameters and
+buffers form a flat pytree so the whole module can be staged into a pure
+jit-compiled function (see paddle_tpu.jit).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ...core import dtype as dtype_mod
+from ...core import framework
+from ...core.tensor import Tensor
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference ParamBase, `fluid/framework.py`).
+
+    ``mesh_axes`` is the TPU-native addition: an optional PartitionSpec-style
+    tuple naming the mesh axis each dim is sharded over (e.g. ``(None,'mp')``
+    for a column-parallel weight).  fleet's sharded train step reads it to
+    build NamedShardings — replacing the reference's program-rewriting
+    tensor-parallel optimizers.
+    """
+
+    __slots__ = ("optimize_attr", "regularizer", "is_bias", "mesh_axes")
+
+    def __init__(self, data, dtype=None, name=None, is_bias=False):
+        super().__init__(data, dtype=dtype, stop_gradient=False, name=name)
+        self.trainable = True
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_bias = is_bias
+        self.mesh_axes = None
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype_mod.convert_dtype(dtype)
+        self._parameters: Dict[str, Optional[Parameter]] = collections.OrderedDict()
+        self._sub_layers: Dict[str, "Layer"] = collections.OrderedDict()
+        self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = 0
+        self._name = name_scope or type(self).__name__.lower()
+
+    # -- attribute routing (reference layers.py __setattr__) ---------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() first")
+            params[name] = value
+            for d in (layers, buffers):
+                d is not None and d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() first")
+            layers[name] = value
+            for d in (params, buffers):
+                d is not None and d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            elif isinstance(value, Tensor):
+                params[name].set_value(value)
+            else:
+                raise TypeError(f"cannot assign {type(value)} to parameter {name}")
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                object.__setattr__(self, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for d in ("_parameters", "_sub_layers", "_buffers"):
+            reg = self.__dict__.get(d)
+            if reg is not None and name in reg:
+                return reg[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for d in (self._parameters, self._sub_layers, self._buffers):
+            if name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + list(
+            self._sub_layers
+        ) + list(self._buffers)
+
+    # -- construction helpers ----------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ) -> Parameter:
+        from .. import initializer as init
+
+        dtype = dtype_mod.convert_dtype(dtype) if dtype else self._dtype
+        if default_initializer is None:
+            if is_bias:
+                default_initializer = init.Constant(0.0)
+            else:
+                default_initializer = init.XavierUniform()
+        # ParamAttr-like dict/attr support
+        initializer = default_initializer
+        name = None
+        if attr is not None:
+            initializer = getattr(attr, "initializer", None) or initializer
+            name = getattr(attr, "name", None)
+        arr = initializer._init(shape, dtype)
+        return Parameter(arr, dtype=dtype, name=name, is_bias=is_bias)
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        lines = []
+        for name, layer in self._sub_layers.items():
+            mod_str = repr(layer)
+            mod_str = "\n  ".join(mod_str.split("\n"))
+            lines.append(f"({name}): {mod_str}")
+        main = f"{type(self).__name__}({self.extra_repr()}"
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    # -- iteration ----------------------------------------------------------
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None) -> Iterator:
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from layer.named_sublayers(
+                prefix=sub_prefix, include_self=True, layers_set=layers_set
+            )
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator:
+        seen = set()
+        for layer_prefix, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{layer_prefix}.{name}" if layer_prefix else name), p
+            if not include_sublayers:
+                break
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True) -> Iterator:
+        seen = set()
+        for layer_prefix, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{layer_prefix}.{name}" if layer_prefix else name), b
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers=True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def children(self):
+        return iter(self._sub_layers.values())
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def apply(self, fn):
+        for layer in self.children():
+            layer.apply(fn)
+        fn(self)
+        return self
+
+    # -- modes --------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip(".")):
+            dest[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix.rstrip(".")):
+            short = name.rsplit(".", 1)[-1]
+            # find owning layer to check persistability
+            dest[name] = b
+        # drop non-persistable buffers
+        for lp, layer in self.named_sublayers(include_self=True):
+            for bname in layer._non_persistable_buffer_names:
+                full = f"{lp}.{bname}" if lp else bname
+                dest.pop(full, None)
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+            tgt = own[k]
+            if list(arr.shape) != tgt.shape:
+                raise ValueError(
+                    f"shape mismatch for {k}: {list(arr.shape)} vs {tgt.shape}"
+                )
+            tgt.set_value(arr.astype(np.dtype(tgt.dtype.name) if hasattr(tgt.dtype, "name") else arr.dtype))
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dt = dtype_mod.convert_dtype(dtype)
+            for p in self.parameters():
+                p._array = p._array.astype(dt)
+            for b in self.buffers():
+                if dtype_mod.is_floating(b.dtype):
+                    b._array = b._array.astype(dt)
+        return self
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    # -- functional staging (TPU-native; used by paddle_tpu.jit) -----------
+    def functional_state(self) -> Tuple[Dict[str, Tensor], Dict[str, Tensor]]:
+        params = {k: p for k, p in self.named_parameters()}
+        buffers = {k: b for k, b in self.named_buffers()}
+        return params, buffers
+
+    def full_name(self):
+        return self._name

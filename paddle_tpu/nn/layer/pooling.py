@@ -1,0 +1,94 @@
+"""Pooling layer classes (reference `python/paddle/nn/layer/pooling.py`)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+
+def _pool_layer(fn_name, adaptive=False):
+    class _Pool(Layer):
+        def __init__(self, kernel_size=None, stride=None, padding=0,
+                     output_size=None, **kwargs):
+            super().__init__()
+            self._adaptive = adaptive
+            if adaptive:
+                self.output_size = output_size if output_size is not None else kernel_size
+            else:
+                self.kernel_size = kernel_size
+                self.stride = stride
+                self.padding = padding
+            self._kwargs = {k: v for k, v in kwargs.items()
+                            if k not in ("name", "return_mask", "ceil_mode",
+                                         "exclusive", "divisor_override",
+                                         "data_format")}
+
+        def forward(self, x):
+            if self._adaptive:
+                return getattr(F, fn_name)(x, self.output_size)
+            return getattr(F, fn_name)(x, self.kernel_size, self.stride,
+                                       self.padding)
+
+    return _Pool
+
+
+MaxPool1D = _pool_layer("max_pool1d")
+MaxPool2D = _pool_layer("max_pool2d")
+MaxPool3D = _pool_layer("max_pool3d")
+AvgPool1D = _pool_layer("avg_pool1d")
+AvgPool2D = _pool_layer("avg_pool2d")
+AvgPool3D = _pool_layer("avg_pool3d")
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size)

@@ -1,0 +1,283 @@
+"""RNN layers.
+
+Reference: `python/paddle/nn/layer/rnn.py` (RNNCellBase, SimpleRNNCell,
+LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN/LSTM/GRU) with CUDA kernels in
+`operators/rnn_op.*`/cudnn LSTM.  TPU-native: the time loop is a
+`lax.scan`, which XLA compiles to a single fused loop — no cudnn descriptor
+machinery, and the whole multi-layer stack jits into one computation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor, unwrap
+from .. import functional as F
+from .. import initializer as init
+from .layers import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops import zeros
+
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape[0], (list, tuple)):
+            return tuple(zeros([batch] + list(s), dtype="float32") for s in shape)
+        return zeros([batch] + list(shape), dtype="float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / np.sqrt(hidden_size)
+        u = init.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = dispatch(f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        u = init.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def f(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, fo, g, o = jnp.split(gates, 4, axis=-1)
+            i, fo, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fo), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = fo * cc + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h_new, c_new = dispatch(f, inputs, h, c, self.weight_ih, self.weight_hh,
+                                self.bias_ih, self.bias_hh)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        u = init.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ig = jnp.split(gi, 3, axis=-1)
+            hr, hz, hg = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            g = jnp.tanh(ig + r * hg)
+            return (1 - z) * g + z * h
+
+        h = dispatch(f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Run a cell over time (reference rnn.py class RNN); the loop is python
+    in eager mode but fuses into one XLA while-loop under jit."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import stack
+
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        states = initial_states
+        outputs = []
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in order:
+            x_t = inputs[:, t] if time_axis == 1 else inputs[t]
+            out, states = self.cell(x_t, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        out = stack(outputs, axis=time_axis)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import concat
+
+        s_fw, s_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        from .container import LayerList
+
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        self.num_directions = bidirect
+
+        Cell = {"RNN_TANH": SimpleRNNCell, "RNN_RELU": SimpleRNNCell,
+                "LSTM": LSTMCell, "GRU": GRUCell}[mode]
+        kwargs = {}
+        if mode == "RNN_RELU":
+            kwargs["activation"] = "relu"
+        elif mode == "RNN_TANH":
+            kwargs["activation"] = "tanh"
+
+        self._all_layers = LayerList()
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 else hidden_size * bidirect
+            if bidirect == 2:
+                self._all_layers.append(BiRNN(
+                    Cell(in_size, hidden_size, **kwargs),
+                    Cell(in_size, hidden_size, **kwargs), time_major))
+            else:
+                self._all_layers.append(RNN(
+                    Cell(in_size, hidden_size, **kwargs),
+                    time_major=time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import stack
+
+        out = inputs
+        final_h, final_c = [], []
+        for i, rnn_l in enumerate(self._all_layers):
+            out, st = rnn_l(out)
+            if self.mode == "LSTM":
+                if self.num_directions == 2:
+                    (h_f, c_f), (h_b, c_b) = st
+                    final_h += [h_f, h_b]
+                    final_c += [c_f, c_b]
+                else:
+                    h, c = st
+                    final_h.append(h)
+                    final_c.append(c)
+            else:
+                if self.num_directions == 2:
+                    final_h += list(st)
+                else:
+                    final_h.append(st)
+            if self.dropout > 0 and i < len(self._all_layers) - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        h_stack = stack(final_h, axis=0)
+        if self.mode == "LSTM":
+            return out, (h_stack, stack(final_c, axis=0))
+        return out, h_stack
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)

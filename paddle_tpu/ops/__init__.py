@@ -1,0 +1,89 @@
+"""Functional op library.
+
+This package is the TPU-native replacement for the reference's operator
+library (`paddle/fluid/operators/`, ~700 op types, SURVEY.md §2.1 "Dense op
+library"): every op is a pure jnp/lax function executed through
+`core.dispatch` (eager + autograd tape) and equally usable under a jit trace.
+Tensor methods and Python operators are attached here, mirroring the
+reference's `varbase_patch_methods.py` monkey-patching.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+
+from . import creation, linalg, logic, manipulation, math, random, search, stat
+
+
+def _attach_methods():
+    import builtins
+
+    from . import math as m
+    from . import manipulation as mp
+    from . import creation as cr
+    from . import logic as lg
+    from . import search as se
+    from . import stat as st
+    from . import linalg as la
+
+    method_sources = {}
+    for mod in (m, mp, cr, lg, se, st, la):
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if callable(fn) and getattr(fn, "__module__", None) == mod.__name__:
+                method_sources.setdefault(name, fn)
+
+    skip = {"is_tensor", "meshgrid", "broadcast_tensors", "shape"}
+    for name, fn in method_sources.items():
+        if name in skip or hasattr(Tensor, name):
+            continue
+        setattr(Tensor, name, fn)
+
+    # Python operator protocol
+    Tensor.__add__ = lambda s, o: m.add(s, o)
+    Tensor.__radd__ = lambda s, o: m.add(o if isinstance(o, Tensor) else Tensor(o), s)
+    Tensor.__sub__ = lambda s, o: m.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: m.subtract(o if isinstance(o, Tensor) else Tensor(o), s)
+    Tensor.__mul__ = lambda s, o: m.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: m.multiply(o if isinstance(o, Tensor) else Tensor(o), s)
+    Tensor.__truediv__ = lambda s, o: m.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: m.divide(o if isinstance(o, Tensor) else Tensor(o), s)
+    Tensor.__floordiv__ = lambda s, o: m.floor_divide(s, o)
+    Tensor.__mod__ = lambda s, o: m.remainder(s, o)
+    Tensor.__pow__ = lambda s, o: m.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: m.pow(o if isinstance(o, Tensor) else Tensor(o), s)
+    Tensor.__neg__ = lambda s: m.neg(s)
+    Tensor.__abs__ = lambda s: m.abs(s)
+    Tensor.__matmul__ = lambda s, o: m.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: m.matmul(o if isinstance(o, Tensor) else Tensor(o), s)
+    Tensor.__eq__ = lambda s, o: lg.equal(s, o)
+    Tensor.__ne__ = lambda s, o: lg.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: lg.less_than(s, o)
+    Tensor.__le__ = lambda s, o: lg.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: lg.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: lg.greater_equal(s, o)
+    Tensor.__invert__ = lambda s: lg.logical_not(s)
+    Tensor.__and__ = lambda s, o: lg.bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: lg.bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: lg.bitwise_xor(s, o)
+    # keep identity-based hashing despite __eq__ override
+    Tensor.__hash__ = lambda s: id(s)
+
+    # paddle-style dim helpers
+    Tensor.dim = lambda s: s.ndim
+    Tensor.rank = lambda s: s.ndim
+    Tensor.numel = lambda s: s.size
+
+
+_attach_methods()
+del _attach_methods

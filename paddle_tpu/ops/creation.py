@@ -1,0 +1,144 @@
+"""Creation ops.
+
+Reference ops: fill_constant, fill_any_like, gaussian_random, uniform_random,
+arange/range, linspace, eye, tril_triu, diag_v2, one_hot_v2, assign
+(`paddle/fluid/operators/fill_constant_op.cc`, `range_op.cc`, …); Python API
+`python/paddle/tensor/creation.py`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, to_tensor, unwrap
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default
+    return dtype_mod.convert_dtype(dtype)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    if isinstance(shape, (int,)):
+        return [shape]
+    return [int(unwrap(s)) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), _dt(dtype, dtype_mod.get_default_dtype())))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_list(shape), _dt(dtype, dtype_mod.get_default_dtype())))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = unwrap(fill_value)
+    return Tensor(jnp.full(_shape_list(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(unwrap(x), dtype=_dt(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(unwrap(x), dtype=_dt(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(unwrap(x), unwrap(fill_value), dtype=_dt(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype, dtype_mod.get_default_dtype())))
+
+
+def assign(x, output=None):
+    out = dispatch(lambda a: a + 0, x) if isinstance(x, Tensor) else to_tensor(x)
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def clone(x):
+    return assign(x)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1:
+            d = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(*d.shape, k=offset, dtype=bool)
+                d = jnp.where(mask, d, padding_value)
+            return d
+        return jnp.diagonal(a, offset=offset)
+
+    return dispatch(f, x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        return out.at[..., r, c].set(a)
+
+    return dispatch(f, x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return dispatch(lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return dispatch(lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def meshgrid(*args, **kwargs):
+    arrays = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(a) for a in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+def one_hot(x, num_classes, name=None):
+    import jax
+
+    return Tensor(jax.nn.one_hot(unwrap(x), num_classes, dtype=dtype_mod.get_default_dtype()))
+
+
+def complex(real, imag, name=None):
+    return dispatch(lambda r, i: r + 1j * i, real, imag)

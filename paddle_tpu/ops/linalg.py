@@ -1,0 +1,157 @@
+"""Linear algebra ops.
+
+Reference: `operators/cholesky_op.*`, `inverse_op.*`, `matrix_power`,
+`p_norm_op.*`, `svd`, `eigh` etc.; Python API `python/paddle/tensor/linalg.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(a):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(a * a))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return dispatch(f, x)
+
+
+def p_norm(x, p=2, axis=-1, keepdim=False):
+    return norm(x, p, axis, keepdim)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(dispatch(jnp.subtract, x, y), p)
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return dispatch(f, x)
+
+
+def inverse(x, name=None):
+    return dispatch(jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return dispatch(lambda a: jnp.linalg.pinv(a, rcond=rcond, hermitian=hermitian), x)
+
+
+def matrix_power(x, n, name=None):
+    return dispatch(lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    a = unwrap(x)
+    return Tensor(jnp.linalg.matrix_rank(a, tol=tol))
+
+
+def det(x, name=None):
+    return dispatch(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    a = unwrap(x)
+    sign, logdet = jnp.linalg.slogdet(a)
+    return Tensor(jnp.stack([sign, logdet]))
+
+
+def svd(x, full_matrices=False, name=None):
+    a = unwrap(x)
+    u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
+
+
+def qr(x, mode="reduced", name=None):
+    a = unwrap(x)
+    q, r = jnp.linalg.qr(a, mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def eigh(x, UPLO="L", name=None):
+    a = unwrap(x)
+    w, v = jnp.linalg.eigh(a, UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return Tensor(jnp.linalg.eigvalsh(unwrap(x), UPLO=UPLO))
+
+
+def eig(x, name=None):
+    import numpy as np
+
+    w, v = np.linalg.eig(np.asarray(unwrap(x)))
+    return Tensor(w), Tensor(v)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    a, b = unwrap(x), unwrap(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def solve(x, y, name=None):
+    return dispatch(jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        if transpose:
+            a = jnp.swapaxes(a, -1, -2)
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, unit_diagonal=unitriangular
+        )
+
+    return dispatch(f, x, y)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return dispatch(f, x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next((i for i, s in enumerate(a.shape) if s == 3), -1)
+        return jnp.cross(a, b, axis=ax)
+
+    return dispatch(f, x, y)
+
+
+def bilinear_tensor_product(x, y, weight, bias=None):
+    def f(a, b, w, *bi):
+        # w: [out, dx, dy]
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi:
+            out = out + bi[0]
+        return out
+
+    if bias is not None:
+        return dispatch(f, x, y, weight, bias)
+    return dispatch(f, x, y, weight)
+
+
+def histogramdd(*args, **kwargs):
+    raise NotImplementedError

@@ -1,0 +1,80 @@
+"""Comparison / logical / bitwise ops.
+
+Reference: `operators/controlflow/compare_op.cc` macro family, logical ops,
+`python/paddle/tensor/logic.py`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def _cmp(jfn):
+    def op(x, y, name=None):
+        return Tensor(jfn(unwrap(x), unwrap(y)))
+
+    return op
+
+
+equal = _cmp(jnp.equal)
+not_equal = _cmp(jnp.not_equal)
+less_than = _cmp(jnp.less)
+less_equal = _cmp(jnp.less_equal)
+greater_than = _cmp(jnp.greater)
+greater_equal = _cmp(jnp.greater_equal)
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+def logical_and(x, y, out=None, name=None):
+    return Tensor(jnp.logical_and(unwrap(x), unwrap(y)))
+
+
+def logical_or(x, y, out=None, name=None):
+    return Tensor(jnp.logical_or(unwrap(x), unwrap(y)))
+
+
+def logical_xor(x, y, out=None, name=None):
+    return Tensor(jnp.logical_xor(unwrap(x), unwrap(y)))
+
+
+def logical_not(x, out=None, name=None):
+    return Tensor(jnp.logical_not(unwrap(x)))
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return Tensor(jnp.bitwise_and(unwrap(x), unwrap(y)))
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return Tensor(jnp.bitwise_or(unwrap(x), unwrap(y)))
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return Tensor(jnp.bitwise_xor(unwrap(x), unwrap(y)))
+
+
+def bitwise_not(x, out=None, name=None):
+    return Tensor(jnp.bitwise_not(unwrap(x)))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return Tensor(jnp.all(unwrap(x), axis=ax, keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return Tensor(jnp.any(unwrap(x), axis=ax, keepdims=keepdim))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

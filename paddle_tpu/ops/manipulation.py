@@ -1,0 +1,422 @@
+"""Shape/layout manipulation ops.
+
+Reference: reshape2/transpose2/concat/split/stack/slice/strided_slice/
+gather/gather_nd/scatter/tile/expand_v2/flip/roll/squeeze2/unsqueeze2/...
+(`paddle/fluid/operators/*.cc`); Python API
+`python/paddle/tensor/manipulation.py`.  All are pure layout ops for XLA.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        v = v.tolist()
+    if isinstance(v, (list, tuple)):
+        return [int(unwrap(i)) if isinstance(i, Tensor) else int(i) for i in v]
+    return int(v)
+
+
+def cast(x, dtype):
+    dt = dtype_mod.convert_dtype(dtype)
+    return dispatch(lambda a: a.astype(dt), x)
+
+
+def reshape(x, shape, name=None):
+    shape = _ints(shape)
+    return dispatch(lambda a: jnp.reshape(a, shape), x)
+
+
+def transpose(x, perm, name=None):
+    perm = _ints(perm)
+    return dispatch(lambda a: jnp.transpose(a, perm), x)
+
+
+def t(x, name=None):
+    return dispatch(lambda a: a.T, x)
+
+
+def concat(x, axis=0, name=None):
+    axis = int(unwrap(axis))
+    xs = list(x)
+    return dispatch(lambda *a: jnp.concatenate(a, axis=axis), *xs)
+
+
+def stack(x, axis=0, name=None):
+    xs = list(x)
+    return dispatch(lambda *a: jnp.stack(a, axis=axis), *xs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis))
+    n = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sections = num_or_sections
+        outs = dispatch(
+            lambda a: tuple(jnp.split(a, sections, axis=axis)), x
+        )
+    else:
+        secs = _ints(num_or_sections)
+        # allow one -1 to infer
+        if -1 in secs:
+            known = builtins.sum(s for s in secs if s != -1)
+            secs = [n - known if s == -1 else s for s in secs]
+        idx = []
+        acc = 0
+        for s in secs[:-1]:
+            acc += s
+            idx.append(acc)
+        outs = dispatch(lambda a: tuple(jnp.split(a, idx, axis=axis)), x)
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return dispatch(lambda a: jnp.squeeze(a), x)
+    axes = _ints(axis if isinstance(axis, (list, tuple)) else [axis])
+    def f(a):
+        sq = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axis=sq) if sq else a
+    return dispatch(f, x)
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis if isinstance(axis, (list, tuple)) else [axis])
+    def f(a):
+        for ax in sorted(axes):
+            a = jnp.expand_dims(a, ax)
+        return a
+    return dispatch(f, x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1 :]
+        return jnp.reshape(a, new_shape)
+
+    return dispatch(f, x)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return dispatch(lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    shape = _ints(shape)
+    def f(a):
+        tgt = list(shape)
+        for i, s in enumerate(tgt):
+            if s == -1:
+                tgt[i] = a.shape[i - len(tgt) + a.ndim]
+        return jnp.broadcast_to(a, tgt)
+    return dispatch(f, x)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return dispatch(lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = jnp.broadcast_arrays(*[unwrap(i) for i in inputs])
+    return [Tensor(a) for a in arrs]
+
+
+def flip(x, axis, name=None):
+    axes = _ints(axis if isinstance(axis, (list, tuple)) else [axis])
+    return dispatch(lambda a: jnp.flip(a, axis=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return dispatch(lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return dispatch(lambda a: jnp.rot90(a, k=k, axes=axes), x)
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(unwrap(axis)) if axis is not None else 0
+    return dispatch(
+        lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=axis),
+        x,
+        index,
+        nondiff=(1,),
+    )
+
+
+def gather_nd(x, index, name=None):
+    def f(a, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+
+    return dispatch(f, x, index, nondiff=(1,))
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return dispatch(
+        lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices, nondiff=(1,)
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        dims = [jnp.arange(s).reshape([-1 if d == k else 1 for k in range(i.ndim)])
+                for d, s in enumerate(i.shape)]
+        idx = tuple(i if d == axis else jnp.broadcast_to(dims[d], i.shape) for d in range(i.ndim))
+        if reduce == "assign":
+            return a.at[idx].set(v)
+        if reduce == "add":
+            return a.at[idx].add(v)
+        if reduce == "multiply":
+            return a.at[idx].multiply(v)
+        raise ValueError(reduce)
+
+    return dispatch(f, arr, indices, values, nondiff=(1,))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        z = a.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+
+    return dispatch(f, x, index, updates, nondiff=(1,))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+
+    return dispatch(f, x, index, updates, nondiff=(1,))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def f(i, u):
+        out = jnp.zeros(_ints(shape), u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return out.at[idx].add(u)
+
+    return dispatch(f, index, updates, nondiff=(0,))
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    def f(a, i):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, i]
+
+    return dispatch(f, x, index, nondiff=(1,))
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        idx = [builtins.slice(None)] * a.ndim
+        idx[axis] = i
+        return a.at[tuple(idx)].add(v)
+
+    return dispatch(f, x, index, value, nondiff=(1,))
+
+
+def masked_select(x, mask, name=None):
+    # dynamic-shaped output: host fallback (reference keeps this on CPU too
+    # for small control work; not a jit-path op)
+    import numpy as np
+
+    a, m = np.asarray(unwrap(x)), np.asarray(unwrap(mask))
+    return Tensor(a[m.astype(bool)])
+
+
+def masked_fill(x, mask, value, name=None):
+    v = unwrap(value)
+    return dispatch(lambda a, m: jnp.where(m.astype(bool), v, a), x, mask, nondiff=(1,))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return dispatch(
+        lambda c, a, b: jnp.where(c.astype(bool), a, b), condition, x, y, nondiff=(0,)
+    )
+
+
+def nonzero(x, as_tuple=False):
+    import numpy as np
+
+    a = np.asarray(unwrap(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(np.asarray(i)) for i in nz)
+    return Tensor(np.stack(nz, axis=-1).astype(np.int64))
+
+
+def slice(input, axes, starts, ends, name=None):
+    axes, starts, ends = _ints(axes), _ints(starts), _ints(ends)
+
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(s, e)
+        return a[tuple(idx)]
+
+    return dispatch(f, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
+
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(s, e, st)
+        return a[tuple(idx)]
+
+    return dispatch(f, x)
+
+
+def unbind(input, axis=0, name=None):
+    n = input.shape[axis]
+    outs = dispatch(
+        lambda a: tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis)),
+        input,
+    )
+    return list(outs)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, name=None):
+    import numpy as np
+
+    a = np.asarray(unwrap(x))
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    import numpy as np
+
+    a = np.asarray(unwrap(x))
+    if axis is None:
+        a = a.reshape(-1)
+    keep = np.concatenate([[True], a[1:] != a[:-1]]) if a.ndim == 1 else None
+    out = a[keep]
+    res = [Tensor(out)]
+    if return_inverse:
+        res.append(Tensor(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        res.append(Tensor(np.diff(np.append(idx, a.shape[0]))))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad = _ints(pad)
+
+    def f(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle convention: pad applies to last len(pad)//2 dims,
+            # in reverse order pairs, honoring data_format for 4D/5D
+            width = [(0, 0)] * nd
+            npairs = len(pad) // 2
+            if data_format.startswith("NC") and nd >= 3:
+                dims = list(range(2, nd))
+            else:
+                dims = list(range(1, nd - 1))
+            dims = dims[-npairs:] if npairs <= len(dims) else list(range(nd - npairs, nd))
+            for k, d in enumerate(dims):
+                width[d] = (pad[2 * k], pad[2 * k + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode="constant", constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+
+    return dispatch(f, x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+
+    def f(i):
+        in_shard = (i // size) == shard_id
+        return jnp.where(in_shard, i % size, ignore_value)
+
+    return dispatch(f, input)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return dispatch(lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return dispatch(lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return dispatch(lambda a: jnp.swapaxes(a, axis1, axis2), x)
+
+
+def as_complex(x, name=None):
+    return dispatch(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return dispatch(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return dispatch(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size))
+
+
+def shape(input):
+    return Tensor(jnp.asarray(input.shape, dtype=jnp.int32))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _ints(shape)
+    offs = _ints(offsets) if offsets is not None else [0] * len(shp)
+
+    def f(a):
+        idx = tuple(builtins.slice(o, o + s) for o, s in zip(offs, shp))
+        return a[idx]
+
+    return dispatch(f, x)

@@ -1,0 +1,336 @@
+"""Math ops (elementwise + reductions + matmul family).
+
+Reference: `paddle/fluid/operators/elementwise/` (10.6k LoC of CUDA broadcast
+kernels), `operators/reduce_ops/`, `operators/matmul_v2_op.*`,
+`operators/activation_op.cc` — all collapse to jnp/lax calls that XLA fuses
+and tiles onto the VPU/MXU; Python API `python/paddle/tensor/math.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.dispatch import BLACK, WHITE, dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def _ew(jfn):
+    def op(x, name=None):
+        return dispatch(jfn, x)
+
+    return op
+
+
+def _binary(jfn):
+    def op(x, y, name=None):
+        return dispatch(jfn, x, y)
+
+    return op
+
+
+# -- elementwise binary -----------------------------------------------------
+add = _binary(jnp.add)
+subtract = _binary(jnp.subtract)
+multiply = _binary(jnp.multiply)
+divide = _binary(jnp.divide)
+floor_divide = _binary(jnp.floor_divide)
+remainder = _binary(jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow = _binary(jnp.power)
+maximum = _binary(jnp.maximum)
+minimum = _binary(jnp.minimum)
+fmax = _binary(jnp.fmax)
+fmin = _binary(jnp.fmin)
+atan2 = _binary(jnp.arctan2)
+hypot = _binary(jnp.hypot)
+logaddexp = _binary(jnp.logaddexp)
+heaviside = _binary(jnp.heaviside)
+gcd = _binary(jnp.gcd)
+lcm = _binary(jnp.lcm)
+nextafter = _binary(jnp.nextafter)
+copysign = _binary(jnp.copysign)
+ldexp = _binary(lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)))
+inner = _binary(jnp.inner)
+outer = _binary(jnp.outer)
+kron = _binary(jnp.kron)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = unwrap(scale), unwrap(bias)
+
+    def f(a):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out
+
+    out = dispatch(f, x)
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def add_n(inputs, name=None):
+    import functools
+
+    if isinstance(inputs, Tensor):
+        return inputs
+    return dispatch(lambda *xs: functools.reduce(jnp.add, xs), *inputs)
+
+
+def lerp(x, y, weight, name=None):
+    if not isinstance(weight, Tensor):
+        return dispatch(lambda a, b: a + weight * (b - a), x, y)
+    return dispatch(lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+# -- elementwise unary ------------------------------------------------------
+abs = _ew(jnp.abs)
+sqrt = _ew(jnp.sqrt)
+rsqrt = _ew(lambda a: jax.lax.rsqrt(a))
+square = _ew(jnp.square)
+exp = _ew(jnp.exp)
+expm1 = _ew(jnp.expm1)
+log = _ew(jnp.log)
+log2 = _ew(jnp.log2)
+log10 = _ew(jnp.log10)
+log1p = _ew(jnp.log1p)
+sin = _ew(jnp.sin)
+cos = _ew(jnp.cos)
+tan = _ew(jnp.tan)
+asin = _ew(jnp.arcsin)
+acos = _ew(jnp.arccos)
+atan = _ew(jnp.arctan)
+sinh = _ew(jnp.sinh)
+cosh = _ew(jnp.cosh)
+tanh = _ew(jnp.tanh)
+asinh = _ew(jnp.arcsinh)
+acosh = _ew(jnp.arccosh)
+atanh = _ew(jnp.arctanh)
+floor = _ew(jnp.floor)
+ceil = _ew(jnp.ceil)
+round = _ew(jnp.round)
+trunc = _ew(jnp.trunc)
+frac = _ew(lambda a: a - jnp.trunc(a))
+sign = _ew(jnp.sign)
+neg = _ew(jnp.negative)
+reciprocal = _ew(jnp.reciprocal)
+erf = _ew(jax.scipy.special.erf)
+erfinv = _ew(jax.scipy.special.erfinv)
+lgamma = _ew(jax.scipy.special.gammaln)
+digamma = _ew(jax.scipy.special.digamma)
+sigmoid = _ew(jax.nn.sigmoid)
+logit = _ew(jax.scipy.special.logit)
+angle = _ew(jnp.angle)
+conj = _ew(jnp.conj)
+real = _ew(jnp.real)
+imag = _ew(jnp.imag)
+deg2rad = _ew(jnp.deg2rad)
+rad2deg = _ew(jnp.rad2deg)
+rsqrt_ = rsqrt
+i0 = _ew(jax.scipy.special.i0)
+i1 = _ew(jax.scipy.special.i1)
+nan_to_num = lambda x, nan=0.0, posinf=None, neginf=None, name=None: dispatch(
+    lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x
+)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = unwrap(min) if min is not None else None
+    hi = unwrap(max) if max is not None else None
+    return dispatch(lambda a: jnp.clip(a, lo, hi), x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return dispatch(lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def increment(x, value=1.0, name=None):
+    out = dispatch(lambda a: a + value, x)
+    x.set_value(out._array)
+    return x
+
+
+# -- reductions -------------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = dtype_mod.convert_dtype(dtype) if dtype else None
+    return dispatch(lambda a: jnp.sum(a, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return dispatch(lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), x, amp_policy=BLACK)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return dispatch(lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return dispatch(lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    dt = dtype_mod.convert_dtype(dtype) if dtype else None
+    return dispatch(lambda a: jnp.prod(a, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return dispatch(
+        lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim),
+        x,
+        amp_policy=BLACK,
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1))
+        return jnp.cumsum(a, axis=int(axis))
+
+    return dispatch(f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return dispatch(lambda a: jnp.cumprod(a, axis=dim), x)
+
+
+def cummax(x, axis=None, name=None):
+    a = unwrap(x)
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.maximum, a, axis=axis)
+    # index of the running max: latest position where the element equals the
+    # running max (scan over (value, index) pairs keeps argmax semantics)
+    idx0 = jnp.arange(a.shape[axis]).reshape(
+        [-1 if d == axis % a.ndim else 1 for d in range(a.ndim)]
+    )
+    idx0 = jnp.broadcast_to(idx0, a.shape)
+
+    def pick(l, r):
+        lv, li = l
+        rv, ri = r
+        take_r = rv >= lv
+        return jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li)
+
+    _, idx = jax.lax.associative_scan(pick, (a, idx0), axis=axis)
+    return Tensor(vals), Tensor(idx.astype(jnp.int64))
+
+
+def cummin(x, axis=None, name=None):
+    a = unwrap(x)
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.minimum, a, axis=axis)
+    idx0 = jnp.arange(a.shape[axis]).reshape(
+        [-1 if d == axis % a.ndim else 1 for d in range(a.ndim)]
+    )
+    idx0 = jnp.broadcast_to(idx0, a.shape)
+
+    def pick(l, r):
+        lv, li = l
+        rv, ri = r
+        take_r = rv <= lv
+        return jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li)
+
+    _, idx = jax.lax.associative_scan(pick, (a, idx0), axis=axis)
+    return Tensor(vals), Tensor(idx.astype(jnp.int64))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.count_nonzero(unwrap(x), axis=_axis(axis), keepdims=keepdim))
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return dispatch(lambda a: jnp.nansum(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return dispatch(lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+# -- matmul family (MXU ops — AMP white list) -------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return dispatch(f, x, y, amp_policy=WHITE)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return dispatch(lambda a, b: jnp.sum(a * b, axis=-1), x, y, amp_policy=WHITE)
+
+
+def mv(x, y, name=None):
+    return matmul(x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return dispatch(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y, amp_policy=WHITE
+    )
+
+
+def multiplex(inputs, index, name=None):
+    idx = unwrap(index).reshape(-1)
+    return dispatch(
+        lambda *xs: jnp.stack(xs, axis=0)[idx, jnp.arange(idx.shape[0])], *inputs
+    )
+
+
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(unwrap(x)))
+
+
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(unwrap(x)))
+
+
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(unwrap(x)))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)

@@ -1,0 +1,2 @@
+"""Pallas TPU kernels for the hot ops (reference `operators/fused/` CUDA
+kernels → Pallas; SURVEY.md §7 build stage 8)."""

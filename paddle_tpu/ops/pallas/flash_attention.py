@@ -1,0 +1,117 @@
+"""Flash attention Pallas kernel (TPU).
+
+Replaces the reference's fused inference attention
+(`operators/fused/multihead_matmul_op.cu`) and the composed
+matmul+softmax+matmul training path with a tiled online-softmax kernel that
+keeps the running statistics in VMEM (per /opt/skills/guides/pallas_guide.md).
+Falls back to the XLA composed form when shapes don't fit the tile grid.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _xla_reference(q, k, v, mask, is_causal, scale):
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
+    logits = logits.astype(jnp.float32)
+    if is_causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((ql, kl), dtype=bool), k=kl - ql)
+        logits = jnp.where(causal, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_k, scale,
+                 causal, block_q, q_offset_grid):
+    # grid: (batch*heads, num_q_blocks); process all K blocks in a loop
+    q = q_ref[...].astype(jnp.float32) * scale  # [block_q, d]
+    m = jnp.full((block_q,), -1e30, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    qi = pl.program_id(1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)[:, 0]
+
+    num_k = seq_k // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = pl.load(k_ref, (pl.dslice(j * block_k, block_k), slice(None))).astype(jnp.float32)
+        v_blk = pl.load(v_ref, (pl.dslice(j * block_k, block_k), slice(None))).astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)[0]
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask, logits, -1e30)
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(logits - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m, l, acc))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention_fwd(q, k, v, mask=None, is_causal=False, scale=None,
+                        block_q=256, block_k=256):
+    """q,k,v: [B,H,S,D].  Uses the Pallas kernel when mask is None and shapes
+    tile; otherwise the XLA composed reference."""
+    if (not _HAS_PALLAS or mask is not None
+            or q.shape[-2] % block_q or k.shape[-2] % block_k
+            or jax.default_backend() != "tpu"):
+        return _xla_reference(q, k, v, mask, is_causal, scale)
+
+    b, h, sq, d = q.shape
+    sk = k.shape[-2]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+
+    kernel = functools.partial(
+        _attn_kernel, block_k=block_k, seq_k=sk, scale=s, causal=is_causal,
+        block_q=block_q, q_offset_grid=None,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d)

@@ -1,0 +1,129 @@
+"""Random ops.
+
+Reference: gaussian_random/uniform_random/randint/randperm/bernoulli/
+multinomial/dropout ops (`operators/gaussian_random_op.cc` etc.), seeded by a
+per-device generator.  TPU-native: JAX counter-based PRNG; eager mode draws
+split keys from the global stateful generator (`paddle.seed`), trace mode
+threads an explicit key (see core/framework.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core import framework
+from ..core.tensor import Tensor, unwrap
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().tolist())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(unwrap(s)) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype):
+    return dtype_mod.convert_dtype(dtype) if dtype is not None else dtype_mod.get_default_dtype()
+
+
+def seed(s):
+    return framework.seed(s)
+
+
+def get_rng_state():
+    return framework.default_generator._key
+
+
+def set_rng_state(key):
+    framework.default_generator._key = key
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = framework.get_rng_key()
+    return Tensor(
+        jax.random.uniform(key, _shape(shape), _dt(dtype), minval=float(unwrap(min)), maxval=float(unwrap(max)))
+    )
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = framework.get_rng_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = unwrap(mean), unwrap(std)
+        shp = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ())
+        )
+        return Tensor(jax.random.normal(key, shp, dtype_mod.get_default_dtype()) * s + m)
+    return Tensor(
+        jax.random.normal(key, _shape(shape), dtype_mod.get_default_dtype()) * std + mean
+    )
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    key = framework.get_rng_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)) * std + mean)
+
+
+def randn(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, dtype)
+
+
+def randint(low=0, high=None, shape=[1], dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = framework.get_rng_key()
+    dt = dtype_mod.convert_dtype(dtype) if dtype else jnp.int64
+    return Tensor(jax.random.randint(key, _shape(shape), int(low), int(high), dtype=dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = framework.get_rng_key()
+    return Tensor(jax.random.permutation(key, n).astype(dtype_mod.convert_dtype(dtype)))
+
+
+def shuffle(x, axis=0):
+    key = framework.get_rng_key()
+    return Tensor(jax.random.permutation(key, unwrap(x), axis=axis, independent=False))
+
+
+def bernoulli(x, name=None):
+    key = framework.get_rng_key()
+    p = unwrap(x)
+    return Tensor(jax.random.bernoulli(key, p).astype(p.dtype))
+
+
+def poisson(x, name=None):
+    key = framework.get_rng_key()
+    lam = unwrap(x)
+    return Tensor(jax.random.poisson(key, lam).astype(lam.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = framework.get_rng_key()
+    p = unwrap(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if p.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(num_samples,))
+    else:
+        out = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                     shape=(p.shape[0], num_samples))
+    return Tensor(out.astype(jnp.int64))
+
+
+def normal_like(x, mean=0.0, std=1.0, name=None):
+    key = framework.get_rng_key()
+    a = unwrap(x)
+    return Tensor(jax.random.normal(key, a.shape, a.dtype) * std + mean)

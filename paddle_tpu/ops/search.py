@@ -1,0 +1,103 @@
+"""Search/sort ops: argmax/argmin/argsort/sort/topk/kthvalue/searchsorted/mode.
+
+Reference: `operators/arg_max_op.cc`, `argsort_op.cc`, `top_k_v2_op.*`;
+Python API `python/paddle/tensor/search.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    a = unwrap(x)
+    out = jnp.argmax(a, axis=axis, keepdims=keepdim if axis is not None else False)
+    return Tensor(out.astype(dtype_mod.convert_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    a = unwrap(x)
+    out = jnp.argmin(a, axis=axis, keepdims=keepdim if axis is not None else False)
+    return Tensor(out.astype(dtype_mod.convert_dtype(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    a = unwrap(x)
+    idx = jnp.argsort(-a if descending else a, axis=axis)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return dispatch(f, x)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(unwrap(k))
+
+    def f(a):
+        ax = axis % a.ndim
+        if ax != a.ndim - 1:
+            a2 = jnp.moveaxis(a, ax, -1)
+        else:
+            a2 = a
+        vals, idx = jax.lax.top_k(a2 if largest else -a2, k)
+        if not largest:
+            vals = -vals
+        if ax != a.ndim - 1:
+            vals = jnp.moveaxis(vals, -1, ax)
+        return vals
+
+    vals = dispatch(f, x)
+
+    a = unwrap(x)
+    ax = axis % a.ndim
+    a2 = jnp.moveaxis(a, ax, -1) if ax != a.ndim - 1 else a
+    _, idx = jax.lax.top_k(a2 if largest else -a2, k)
+    if ax != a.ndim - 1:
+        idx = jnp.moveaxis(idx, -1, ax)
+    return vals, Tensor(idx.astype(jnp.int64))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    a = unwrap(x)
+    s = jnp.sort(a, axis=axis)
+    i = jnp.argsort(a, axis=axis)
+    vals = jnp.take(s, k - 1, axis=axis)
+    idx = jnp.take(i, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return Tensor(vals), Tensor(idx.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import scipy.stats as st
+    import numpy as np
+
+    a = np.asarray(unwrap(x))
+    m = st.mode(a, axis=axis, keepdims=keepdim)
+    return Tensor(m.mode.astype(a.dtype)), Tensor(np.asarray(m.count))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    seq, v = unwrap(sorted_sequence), unwrap(values)
+    side = "right" if right else "left"
+    if seq.ndim == 1:
+        out = jnp.searchsorted(seq, v, side=side)
+    else:
+        out = jax.vmap(lambda s, val: jnp.searchsorted(s, val, side=side))(
+            seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)

@@ -1,0 +1,86 @@
+"""Statistics ops: std/var/median/quantile/histogram.
+
+Reference python API `python/paddle/tensor/stat.py`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return dispatch(
+        lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+    )
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return dispatch(
+        lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+    )
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return dispatch(lambda a: jnp.median(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return dispatch(lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return dispatch(
+        lambda a: jnp.quantile(a, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim), x
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return dispatch(
+        lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim), x
+    )
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = unwrap(input)
+    if min == 0 and max == 0:
+        mn, mx = a.min(), a.max()
+    else:
+        mn, mx = min, max
+    hist, _ = jnp.histogram(a, bins=bins, range=(float(mn), float(mx)))
+    return Tensor(hist.astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = unwrap(x)
+    w = unwrap(weights) if weights is not None else None
+    length = int(jnp.maximum(a.max() + 1 if a.size else 0, minlength))
+    return Tensor(jnp.bincount(a, weights=w, minlength=minlength, length=length))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return dispatch(lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return dispatch(
+        lambda a: jnp.cov(
+            a,
+            rowvar=rowvar,
+            ddof=1 if ddof else 0,
+            fweights=unwrap(fweights) if fweights is not None else None,
+            aweights=unwrap(aweights) if aweights is not None else None,
+        ),
+        x,
+    )

@@ -1,0 +1,247 @@
+"""Optimizers.
+
+Reference: `python/paddle/optimizer/` + CUDA update kernels
+`operators/optimizers/` (sgd, momentum, adam w/ multi-precision master
+weights, adamw, adagrad, adadelta, adamax, rmsprop, lamb, lars).
+Each optimizer here is a pure functional update rule (see optimizer.py base);
+master-weight AMP semantics are achieved by keeping params fp32 and casting
+to bf16 inside the jit'd forward (the XLA-native version of the reference's
+`multi_precision` adam kernels).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import lr
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    """reference `operators/optimizers/sgd_op.cc`."""
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _update_param(self, p, g, slot, lr, step):
+        return p - lr * g, slot
+
+
+class Momentum(Optimizer):
+    """reference `operators/optimizers/momentum_op.h` (use_nesterov attr)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_slot(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def _update_param(self, p, g, slot, lr, step):
+        v = self._momentum * slot["velocity"] + g
+        if self._use_nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """reference `operators/optimizers/adam_op.h` (incl. beta pow accumulators)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_slot(self, p):
+        return {
+            "moment1": jnp.zeros_like(p),
+            "moment2": jnp.zeros_like(p),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _update_param(self, p, g, slot, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slot["moment1"] + (1 - b1) * g
+        v = b2 * slot["moment2"] + (1 - b2) * g * g
+        b1p = slot["beta1_pow"] * b1
+        b2p = slot["beta2_pow"] * b2
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        denom = jnp.sqrt(v) + eps * jnp.sqrt(1 - b2p)
+        new_p = p - (lr_t * (m / denom)).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference python wrapper `optimizer/adamw.py`
+    over adam op with coeff)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_weight_decay(self):
+        return True
+
+    def _update_param(self, p, g, slot, lr, step):
+        new_p, new_slot = super()._update_param(p, g, slot, lr, step)
+        if self._weight_decay:
+            new_p = new_p - (lr * self._weight_decay * p).astype(p.dtype)
+        return new_p, new_slot
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_slot(self, p):
+        return {"moment": jnp.full_like(p, self._init_acc)}
+
+    def _update_param(self, p, g, slot, lr, step):
+        m = slot["moment"] + g * g
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_slot(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p),
+                "avg_squared_update": jnp.zeros_like(p)}
+
+    def _update_param(self, p, g, slot, lr, step):
+        rho, eps = self._rho, self._epsilon
+        asg = rho * slot["avg_squared_grad"] + (1 - rho) * g * g
+        update = g * jnp.sqrt(slot["avg_squared_update"] + eps) / jnp.sqrt(asg + eps)
+        asu = rho * slot["avg_squared_update"] + (1 - rho) * update * update
+        return p - lr * update, {"avg_squared_grad": asg,
+                                 "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slot(self, p):
+        return {"moment": jnp.zeros_like(p), "inf_norm": jnp.zeros_like(p),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _update_param(self, p, g, slot, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slot["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * slot["inf_norm"], jnp.abs(g))
+        b1p = slot["beta1_pow"] * b1
+        new_p = p - (lr / (1 - b1p)) * m / (u + eps)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_slot(self, p):
+        return {"mean_square": jnp.zeros_like(p),
+                "mean_grad": jnp.zeros_like(p),
+                "momentum": jnp.zeros_like(p)}
+
+    def _update_param(self, p, g, slot, lr, step):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * slot["mean_square"] + (1 - rho) * g * g
+        mg = rho * slot["mean_grad"] + (1 - rho) * g if self._centered else slot["mean_grad"]
+        denom = ms - mg * mg if self._centered else ms
+        mom = self._momentum * slot["momentum"] + lr * g / jnp.sqrt(denom + eps)
+        return p - mom, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Lamb(Optimizer):
+    """reference `operators/optimizers/lamb_op.h`."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slot(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _update_param(self, p, g, slot, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slot["moment1"] + (1 - b1) * g
+        v = b2 * slot["moment2"] + (1 - b2) * g * g
+        b1p = slot["beta1_pow"] * b1
+        b2p = slot["beta2_pow"] * b2
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + self._lamb_wd * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r.astype(jnp.float32))))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v,
+                                    "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class Lars(Momentum):
+    """reference `operators/optimizers/lars_momentum_op.*`."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, epsilon=1e-9,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, momentum, parameters, False, None,
+                         grad_clip, name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._lars_eps = epsilon
+
+    def _update_param(self, p, g, slot, lr, step):
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + self._lars_wd * w_norm + self._lars_eps),
+            1.0,
+        )
+        eff_g = g + self._lars_wd * p
+        v = self._momentum * slot["velocity"] + lr * local_lr * eff_g
+        return p - v, {"velocity": v}
+
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "Adadelta", "Adamax", "RMSProp", "Lamb", "Lars", "lr"]

@@ -1,0 +1,179 @@
+"""Optimizer base.
+
+Reference: `python/paddle/optimizer/optimizer.py` (accumulator management,
+regularization, grad clip, lr scheduling) over per-param CUDA update ops
+(`operators/optimizers/`).  TPU-native: every optimizer defines a pure
+functional core ``_update_param(p, g, state, lr) -> (new_p, new_state)``
+usable in two modes:
+
+* imperative ``step()`` — applies the core eagerly per parameter (XLA caches
+  the tiny update executable per shape);
+* staged — ``apply_gradients(params, grads, state, lr)`` maps the core over a
+  whole param pytree inside a jit'd train step (used by paddle_tpu.jit and
+  fleet), where XLA fuses all updates into one fused kernel sweep — the moral
+  equivalent of the reference's fused coalesce_tensor + single kernel path.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..core import framework
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if weight_decay is None:
+            self._weight_decay = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        else:  # L2Decay object
+            self._weight_decay = float(getattr(weight_decay, "_coeff",
+                                               getattr(weight_decay, "coeff", 0.0)))
+        # per-parameter slot state keyed by id(param)
+        self._state: Dict[int, dict] = {}
+        self._step_count = 0
+
+    # -- learning rate ------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- functional core (override) ----------------------------------------
+    def _init_slot(self, param_array) -> dict:
+        return {}
+
+    def _update_param(self, p, g, slot, lr, step):
+        raise NotImplementedError
+
+    # -- decay/clip helpers --------------------------------------------------
+    def _decoupled_weight_decay(self) -> bool:
+        return False
+
+    def _apply_decay(self, p, g):
+        """L2 regularization folded into the gradient (reference
+        `regularizer.py` appends scaled param to grad)."""
+        if self._weight_decay and not self._decoupled_weight_decay():
+            return g + self._weight_decay * p
+        return g
+
+    # -- imperative step ----------------------------------------------------
+    def step(self):
+        params = self._parameters
+        if params is None:
+            raise ValueError("optimizer constructed without parameters")
+        lr = self.get_lr()
+        self._step_count += 1
+        with framework.no_grad_guard():
+            pgs = [(p, p.grad) for p in params
+                   if p.grad is not None and p.trainable]
+            if self._grad_clip is not None:
+                pgs = self._grad_clip(pgs)
+            for p, g in pgs:
+                if g is None:
+                    continue
+                key = id(p)
+                if key not in self._state:
+                    self._state[key] = self._init_slot(p._array)
+                garr = self._apply_decay(p._array, g._array.astype(p._array.dtype))
+                new_p, new_slot = self._update_param(
+                    p._array, garr, self._state[key], lr, self._step_count
+                )
+                p._array = new_p
+                self._state[key] = new_slot
+
+    minimize = None  # assigned below
+
+    def clear_grad(self, set_to_zero=False):
+        params = self._parameters or []
+        for p in params:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    # -- staged/pytree form (used under jit; pure) ---------------------------
+    def init_state(self, params: dict):
+        import jax
+
+        return {
+            k: self._init_slot(v._array if isinstance(v, Tensor) else v)
+            for k, v in params.items()
+        }
+
+    def apply_gradients(self, params: dict, grads: dict, state: dict, lr,
+                        step=1):
+        """Pure pytree update: params/grads dict[str]->array."""
+        new_params, new_state = {}, {}
+        if self._grad_clip is not None:
+            keys = [k for k in params if grads.get(k) is not None]
+            clipped = self._grad_clip.clip_arrays([grads[k] for k in keys])
+            grads = dict(grads)
+            for k, c in zip(keys, clipped):
+                grads[k] = c
+        for k, p in params.items():
+            g = grads.get(k)
+            if g is None:
+                new_params[k] = p
+                new_state[k] = state.get(k, {})
+                continue
+            g = self._apply_decay(p, g.astype(p.dtype))
+            np_, ns_ = self._update_param(p, g, state.get(k) or self._init_slot(p), lr, step)
+            new_params[k] = np_
+            new_state[k] = ns_
+        return new_params, new_state
+
+    # -- checkpointing -------------------------------------------------------
+    def state_dict(self):
+        out = {"step": self._step_count}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        if self._parameters is not None:
+            for i, p in enumerate(self._parameters):
+                slot = self._state.get(id(p))
+                if slot:
+                    for sk, sv in slot.items():
+                        out[f"param{i}.{sk}"] = Tensor(sv) if not isinstance(sv, Tensor) else sv
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("step", 0)
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        if self._parameters is not None:
+            for i, p in enumerate(self._parameters):
+                slot = {}
+                prefix = f"param{i}."
+                for k, v in state.items():
+                    if isinstance(k, str) and k.startswith(prefix):
+                        arr = v._array if isinstance(v, Tensor) else jnp.asarray(v)
+                        slot[k[len(prefix):]] = arr
+                if slot:
+                    self._state[id(p)] = slot
+
+
+def _minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+    loss.backward()
+    self.step()
+    self.clear_grad()
+    return None, [(p, p.grad) for p in (self._parameters or [])]
+
+
+Optimizer.minimize = _minimize

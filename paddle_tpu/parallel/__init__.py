@@ -1,0 +1,9 @@
+"""Low-level SPMD parallel engine: mesh schedules implemented with
+`shard_map` + explicit XLA collectives (ppermute/all_gather/psum).
+
+This package holds the kernels that need explicit per-device programs rather
+than GSPMD annotations: the 1F1B pipeline schedule and ring attention
+(sequence parallelism).  fleet routes to these when pp>1 / sp>1.
+"""
+from .pipeline import pipeline_spmd_step  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,95 @@
+"""SPMD pipeline-parallel schedule over the 'pp' mesh axis.
+
+Reference semantics: `SectionWorker::TrainFiles` micro-batch schedules
+(`paddle/fluid/framework/section_worker.cc:99` F-then-B, `:144` 1F1B) with
+NCCL p2p `send_v2`/`recv_v2` between stages.
+
+TPU-native (SURVEY.md §7 row "send_v2/recv_v2 PP"): homogeneous stage
+parameters are STACKED on a leading axis sharded over 'pp', and the whole
+microbatch schedule runs inside one jit as a per-device program:
+each schedule tick applies the local stage and rotates activations to the
+next stage with `lax.ppermute` (collective-permute on ICI).  Reverse-mode AD
+through the schedule (ppermute transposes to the reverse ring) yields the
+backward pipeline automatically, so fwd+bwd+update is ONE XLA program —
+no per-microbatch host scheduling like the reference's SectionWorker loop.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_local(stage_fn: Callable, stage_params, microbatches,
+                   axis_name: str = "pp"):
+    """Per-device schedule body (call inside shard_map).
+
+    stage_fn(params, x) -> y with y.shape == x.shape (homogeneous stages).
+    stage_params: this device's stage parameters (leading 'pp' dim removed).
+    microbatches: [M, mb, ...] — replicated input; stage 0 ingests them.
+    Returns [M, mb, ...] outputs, replicated (psum).
+    """
+    L = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    T = M + L - 1
+    perm = [(i, (i + 1) % L) for i in range(L)]
+
+    state = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
+    outputs = jnp.zeros(microbatches.shape, jnp.float32)
+
+    def tick(t, carry):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (while t < M)
+        inject = microbatches[jnp.clip(t, 0, M - 1)]
+        state = jnp.where(rank == 0, jnp.where(t < M, inject, state), state)
+        new_state = stage_fn(stage_params, state)
+        # last stage emits microbatch t-(L-1)
+        out_idx = t - (L - 1)
+        emit = (rank == L - 1) & (out_idx >= 0)
+        outputs = jnp.where(
+            emit,
+            outputs.at[jnp.clip(out_idx, 0, M - 1)].set(
+                new_state.astype(jnp.float32)
+            ),
+            outputs,
+        )
+        state = lax.ppermute(new_state, axis_name, perm)
+        return state, outputs
+
+    # static unroll: T is static (M, L known at trace time); unrolling lets
+    # XLA overlap each tick's collective-permute with the next tick's matmuls
+    carry = (state, outputs)
+    for t in range(T):
+        carry = tick(t, carry)
+    _, outputs = carry
+    # only the last stage wrote; make the result visible on all pp ranks
+    return lax.psum(outputs, axis_name)
+
+
+def pipeline_spmd_step(stage_fn: Callable, stacked_params, microbatches, mesh,
+                       axis_name: str = "pp", params_pspec=None):
+    """Global entry: stacked_params pytree with leading dim = pp size."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    if params_pspec is None:
+        params_pspec = jax.tree_util.tree_map(
+            lambda a: P(axis_name, *([None] * (a.ndim - 1))), stacked_params
+        )
+
+    def local(params, mb):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        return pipeline_local(stage_fn, params, mb, axis_name)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(params_pspec, P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    return fn(stacked_params, microbatches)

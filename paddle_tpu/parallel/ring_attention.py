@@ -1,0 +1,101 @@
+"""Ring attention — sequence/context parallelism.
+
+Net-new capability (reference has NO SP/CP — SURVEY.md §2.3 row "Sequence/
+context parallel": the TPU build must add it).  Design: Q/K/V are sharded
+over the 'sp' mesh axis on the sequence dim; each device holds its local Q
+block and rotates K/V blocks around the ring with `lax.ppermute`, folding
+each visiting block into a numerically-stable online softmax (flash-style
+running max / running sum), so attention over a sequence of length S uses
+O(S/sp) memory per chip and the K/V transfers ride the ICI ring concurrently
+with compute.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attn(q, k, v, scale, mask):
+    # q:[B,H,Sq,D] k,v:[B,H,Sk,D]; returns (out_unnorm, row_max, row_sum)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    m = jnp.max(logits, axis=-1)
+    p = jnp.exp(logits - m[..., None])
+    s = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    return o, m, s
+
+
+def ring_attention_local(q, k, v, axis_name: str = "sp", causal: bool = False,
+                         scale=None):
+    """Per-device body; call inside shard_map with q/k/v sharded on the seq
+    dim over `axis_name`.  q,k,v: [B, H, S_local, D]."""
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    s_local = q.shape[2]
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        # K/V block currently held came from rank (rank - i) mod n
+        src = (rank - i) % n
+        if causal:
+            q_pos = rank * s_local + jnp.arange(s_local)
+            k_pos = src * s_local + jnp.arange(s_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            mask = mask[None, None]
+        else:
+            mask = None
+        o_blk, m_blk, l_blk = _block_attn(q, k_cur, v_cur, s, mask)
+        m_new = jnp.maximum(m_acc, m_blk)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        o_acc = o_acc * alpha[..., None].astype(o_acc.dtype) + \
+            o_blk * beta[..., None].astype(o_blk.dtype)
+        l_acc = l_acc * alpha + l_blk * beta
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return o_acc, m_new, l_acc, k_nxt, v_nxt
+
+    b, h = q.shape[0], q.shape[1]
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    o, m, l, _, _ = _unrolled(step, n, (o0, m0, l0, k, v))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def _unrolled(step, n, carry):
+    # unrolled ring (n is a static mesh size; unrolling lets XLA overlap the
+    # ppermute of step i+1 with the matmuls of step i)
+    for i in range(n):
+        carry = step(i, carry)
+    return carry
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = False,
+                   scale=None):
+    """Global entry: q,k,v are global arrays [B,H,S,D]; returns attention
+    computed with the ring schedule, sharded over `axis_name` on S."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        partial(ring_attention_local, axis_name=axis_name, causal=causal,
+                scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)

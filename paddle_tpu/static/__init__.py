@@ -1,0 +1,120 @@
+"""`paddle.static` equivalent.
+
+Reference: `python/paddle/static/` re-exports the Program/Executor static
+graph stack (`fluid/framework.py`, `fluid/executor.py:916`,
+`fluid/backward.py:1369`).
+
+TPU-native stance (SURVEY.md §7): there is no interpreted ProgramDesc — a
+"static program" IS a jit-captured pure function.  This module provides the
+reference's API shape on top of that: `InputSpec`, a minimal `Program` facade
+(a recorded callable + captured state), program_guard/default programs for
+source compatibility, and save/load_inference_model mapping onto
+`paddle_tpu.jit.save/load` (serialized StableHLO + weights).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .input_spec import InputSpec
+from ..core.place import CPUPlace, TPUPlace
+
+
+class Program:
+    """Facade for API parity.  Holds nothing until a function is captured."""
+
+    def __init__(self):
+        self.random_seed = None
+        self._captured = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+
+        return copy.copy(self)
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_main, _default_startup
+    prev = (_default_main, _default_startup)
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = prev
+
+
+class Executor:
+    """API-parity executor (reference `fluid/executor.py:916`): in this
+    framework `run` simply invokes a python callable captured via paddle_tpu
+    jit; feed/fetch become the callable's inputs/outputs."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program):
+            feed = feed or {}
+            outs = program(**feed)
+            return outs if isinstance(outs, (list, tuple)) else [outs]
+        return []
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save(layer, path, input_spec=...) — the deployable "
+        "format is serialized StableHLO + weights"
+    )
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from .. import jit
+
+    layer = jit.load(path_prefix)
+    return layer
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+def cpu_places(device_count=None):
+    return [CPUPlace(0)]
+
+
+def cuda_places(device_ids=None):
+    import jax
+
+    n = len(jax.devices())
+    ids = device_ids if device_ids is not None else range(n)
+    return [TPUPlace(i) for i in ids]
+
+
+xpu_places = cuda_places
+
+
+class WeightNormParamAttr:
+    def __init__(self, *args, **kwargs):
+        pass

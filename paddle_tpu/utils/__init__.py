@@ -1,0 +1,11 @@
+from . import clip
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+
+
+def try_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

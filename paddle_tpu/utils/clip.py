@@ -1,0 +1,97 @@
+"""Gradient clipping.
+
+Reference: `python/paddle/fluid/clip.py:152,243,345` — ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm (the hybrid-parallel-aware global-norm
+variant lives in fleet's sharding helper; here the same class works under
+pjit because the norm reduction is traced into the sharded step).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    # functional form used by jit'd optimizer cores: grads is a list of arrays
+    def clip_arrays(self, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def clip_arrays(self, grads):
+        return [jnp.clip(g, self.min, self.max) if g is not None else None
+                for g in grads]
+
+    def __call__(self, params_grads):
+        from ..core.tensor import Tensor
+
+        return [
+            (p, Tensor(jnp.clip(g._array, self.min, self.max)) if g is not None else None)
+            for p, g in params_grads
+        ]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def clip_arrays(self, grads):
+        out = []
+        for g in grads:
+            if g is None:
+                out.append(None)
+                continue
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+    def __call__(self, params_grads):
+        from ..core.tensor import Tensor
+
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, None))
+                continue
+            (ga,) = self.clip_arrays([g._array])
+            out.append((p, Tensor(ga)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def clip_arrays(self, grads):
+        sq = sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads if g is not None
+        )
+        global_norm = jnp.sqrt(sq)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        return [
+            None if g is None else (g.astype(jnp.float32) * scale).astype(g.dtype)
+            for g in grads
+        ]
+
+    def __call__(self, params_grads):
+        from ..core.tensor import Tensor
+
+        grads = [None if g is None else g._array for _, g in params_grads]
+        clipped = self.clip_arrays(grads)
+        return [
+            (p, None if c is None else Tensor(c))
+            for (p, _), c in zip(params_grads, clipped)
+        ]
+
+
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

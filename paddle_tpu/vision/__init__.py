@@ -1,0 +1,2 @@
+from . import datasets, models, transforms
+from .models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152

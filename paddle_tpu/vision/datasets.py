@@ -1,0 +1,153 @@
+"""Vision datasets (reference `python/paddle/vision/datasets/`).
+
+Zero-egress environment: downloads are unavailable, so each dataset reads
+local files if present (same formats as the reference loaders) and otherwise
+raises; `FakeData` provides deterministic synthetic data for tests/benches.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, num_samples=1000, image_shape=(3, 224, 224),
+                 num_classes=1000, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.asarray(rng.randint(0, self.num_classes), dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(Dataset):
+    """reference `vision/datasets/mnist.py` — same IDX file format."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        if image_path is None or not os.path.exists(image_path):
+            raise FileNotFoundError(
+                "MNIST files not available (no network); pass image_path/"
+                "label_path to local IDX files, or use datasets.FakeData"
+            )
+        with gzip.open(image_path, "rb") if image_path.endswith(".gz") else open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+        with gzip.open(label_path, "rb") if label_path.endswith(".gz") else open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), dtype=np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 255.0
+        label = np.asarray(self.labels[idx], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    """reference `vision/datasets/cifar.py` — same pickle batch format."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "CIFAR archive not available (no network); pass data_file, "
+                "or use datasets.FakeData"
+            )
+        images, labels = [], []
+        with tarfile.open(data_file, "r:gz") as tf:
+            names = [m for m in tf.getmembers()
+                     if ("data_batch" in m.name if mode == "train" else "test_batch" in m.name)]
+            for m in sorted(names, key=lambda x: x.name):
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                images.append(d[b"data"])
+                labels += list(d[b"labels"])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+class DatasetFolder(Dataset):
+    """reference `vision/datasets/folder.py`."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(extensions):
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError:
+            raise RuntimeError("PIL unavailable; use .npy images")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(target, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+ImageFolder = DatasetFolder

@@ -1,0 +1,6 @@
+from .lenet import LeNet
+from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2
+from .resnet import (BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34,
+                     resnet50, resnet101, resnet152, resnext50_32x4d,
+                     wide_resnet50_2)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19

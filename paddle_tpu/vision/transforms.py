@@ -1,0 +1,175 @@
+"""Vision transforms (reference `python/paddle/vision/transforms/`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        if arr.ndim == 2:
+            arr = arr[None] if self.data_format == "CHW" else arr[..., None]
+        elif self.data_format == "CHW" and arr.shape[-1] in (1, 3, 4):
+            arr = arr.transpose(2, 0, 1)
+        return Tensor(arr)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img, dtype=np.float32)
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)
+            s = self.std.reshape(-1, 1, 1)
+        else:
+            m, s = self.mean, self.std
+        out = (arr - m) / s
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def _apply_image(self, img):
+        import jax
+        import jax.numpy as jnp
+
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img, dtype=np.float32)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        if chw:
+            out_shape = (arr.shape[0],) + tuple(self.size)
+        elif arr.ndim == 3:
+            out_shape = tuple(self.size) + (arr.shape[-1],)
+        else:
+            out_shape = tuple(self.size)
+        out = np.asarray(jax.image.resize(jnp.asarray(arr), out_shape, "bilinear"))
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+            out = arr[..., ::-1].copy()
+            return Tensor(out) if isinstance(img, Tensor) else out
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+            ax = -2
+            out = np.flip(arr, axis=ax).copy()
+            return Tensor(out) if isinstance(img, Tensor) else out
+        return img
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        if self.padding:
+            p = self.padding
+            pads = [(0, 0)] * arr.ndim
+            pads[h_ax] = (p, p)
+            pads[w_ax] = (p, p)
+            arr = np.pad(arr, pads)
+        h, w = arr.shape[h_ax], arr.shape[w_ax]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        sl = [slice(None)] * arr.ndim
+        sl[h_ax] = slice(i, i + th)
+        sl[w_ax] = slice(j, j + tw)
+        out = arr[tuple(sl)]
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def _apply_image(self, img):
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        h, w = arr.shape[h_ax], arr.shape[w_ax]
+        th, tw = self.size
+        i = (h - th) // 2
+        j = (w - tw) // 2
+        sl = [slice(None)] * arr.ndim
+        sl[h_ax] = slice(i, i + th)
+        sl[w_ax] = slice(j, j + tw)
+        out = arr[tuple(sl)]
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+        out = arr.transpose(self.order)
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+    out = arr[..., ::-1].copy()
+    return Tensor(out) if isinstance(img, Tensor) else out

@@ -1,0 +1,32 @@
+"""Test config: force a deterministic 8-device CPU mesh before jax loads
+(SURVEY.md §4 — multi-device tests simulated via
+xla_force_host_platform_device_count, like the reference's multi-process
+localhost simulation in test_dist_base.py)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# sitecustomize may have imported jax before this conftest ran (the axon TPU
+# plugin registers at interpreter startup), in which case the env vars above
+# were read too late — force the settings through the live config instead.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+
+    paddle_tpu.seed(42)
+    np.random.seed(42)
+    yield

@@ -1,0 +1,116 @@
+"""Autograd tape tests, including numeric-gradient checks (SURVEY.md §4.1:
+the reference's OpTest check_grad compares analytic vs finite-difference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """central finite differences of scalar f wrt numpy x"""
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x + x).sum()
+        y.backward()
+        assert np.allclose(_np(x.grad), [5.0, 7.0])
+
+    def test_matmul_grad(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 2).astype(np.float32)
+        ta = paddle.to_tensor(a, stop_gradient=False)
+        tb = paddle.to_tensor(b, stop_gradient=False)
+        loss = paddle.matmul(ta, tb).sum()
+        loss.backward()
+        assert np.allclose(_np(ta.grad), np.ones((3, 2)) @ b.T, atol=1e-5)
+        assert np.allclose(_np(tb.grad), a.T @ np.ones((3, 2)), atol=1e-5)
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y1 = x * 2
+        y2 = x * 3
+        (y1 + y2).backward()
+        assert np.allclose(_np(x.grad), [5.0])
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0])  # stop_gradient=True
+        z = (x * y).sum()
+        z.backward()
+        assert np.allclose(_np(x.grad), [2.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = (x * x).detach()
+        z = y * x
+        z.backward()
+        assert np.allclose(_np(x.grad), [9.0])
+
+    def test_numeric_check_tanh_softmax(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+
+        def f_np(xv):
+            t = paddle.to_tensor(xv.astype(np.float32))
+            return float(_np(paddle.nn.functional.softmax(paddle.tanh(t)).sum(axis=1).mean()))
+
+        t = paddle.to_tensor(x, stop_gradient=False)
+        out = paddle.nn.functional.softmax(paddle.tanh(t)).sum(axis=1).mean()
+        out.backward()
+        ng = numeric_grad(f_np, x.astype(np.float64), eps=1e-4)
+        assert np.allclose(_np(t.grad), ng, atol=1e-2)
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_paddle_grad_api(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (g,) = paddle.grad(y, x)
+        assert np.allclose(_np(g), [4.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        g1 = _np(x.grad).copy()
+        x.clear_grad()
+        y.backward()
+        assert np.allclose(_np(x.grad), g1)
+
+
+class TestPyLayer:
+    def test_custom_fwd_bwd(self):
+        class Double(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 2
+
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = Double.apply(x)
+        assert np.allclose(_np(y), [6.0])
+        y.backward()
+        assert np.allclose(_np(x.grad), [2.0])

@@ -1,0 +1,232 @@
+"""Layer tests (reference test style: output shapes + numpy reference
+values; dygraph eager path)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestLinear:
+    def test_forward(self):
+        layer = nn.Linear(4, 3)
+        x = paddle.randn([2, 4])
+        y = layer(x)
+        assert y.shape == [2, 3]
+        ref = _np(x) @ _np(layer.weight) + _np(layer.bias)
+        assert np.allclose(_np(y), ref, atol=1e-5)
+
+    def test_backward_to_params(self):
+        layer = nn.Linear(4, 3)
+        x = paddle.randn([2, 4])
+        loss = layer(x).sum()
+        loss.backward()
+        assert layer.weight.grad is not None
+        assert layer.bias.grad is not None
+        assert layer.weight.grad.shape == [4, 3]
+
+
+class TestConvPool:
+    def test_conv2d_shape(self):
+        conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+        x = paddle.randn([2, 3, 16, 16])
+        y = conv(x)
+        assert y.shape == [2, 8, 16, 16]
+
+    def test_conv2d_vs_numpy(self):
+        conv = nn.Conv2D(1, 1, 3, padding=0, bias_attr=False)
+        w = np.random.rand(1, 1, 3, 3).astype(np.float32)
+        conv.weight.set_value(w)
+        x = np.random.rand(1, 1, 5, 5).astype(np.float32)
+        y = conv(paddle.to_tensor(x))
+        ref = np.zeros((1, 1, 3, 3), np.float32)
+        for i in range(3):
+            for j in range(3):
+                ref[0, 0, i, j] = (x[0, 0, i:i+3, j:j+3] * w[0, 0]).sum()
+        assert np.allclose(_np(y), ref, atol=1e-5)
+
+    def test_grouped_depthwise(self):
+        conv = nn.Conv2D(4, 4, 3, padding=1, groups=4)
+        y = conv(paddle.randn([1, 4, 8, 8]))
+        assert y.shape == [1, 4, 8, 8]
+
+    def test_conv_transpose(self):
+        deconv = nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)
+        y = deconv(paddle.randn([1, 3, 8, 8]))
+        assert y.shape == [1, 6, 16, 16]
+
+    def test_pools(self):
+        x = paddle.randn([1, 3, 8, 8])
+        assert nn.MaxPool2D(2, 2)(x).shape == [1, 3, 4, 4]
+        assert nn.AvgPool2D(2, 2)(x).shape == [1, 3, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 3, 1, 1]
+        a = np.random.rand(1, 1, 4, 4).astype(np.float32)
+        out = nn.MaxPool2D(2, 2)(paddle.to_tensor(a))
+        ref = a.reshape(1, 1, 2, 2, 2, 2).max((3, 5))
+        assert np.allclose(_np(out), ref)
+
+
+class TestNorm:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.randn([4, 3, 5, 5]) * 2 + 1
+        bn.train()
+        y = bn(x)
+        out = _np(y)
+        assert abs(out.mean()) < 1e-4
+        assert abs(out.std() - 1) < 0.05
+        # running stats updated
+        assert not np.allclose(_np(bn._mean), 0)
+        bn.eval()
+        y2 = bn(x)
+        assert y2.shape == [4, 3, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = paddle.randn([2, 4, 8])
+        y = _np(ln(x))
+        assert np.allclose(y.mean(-1), 0, atol=1e-5)
+        assert np.allclose(y.std(-1), 1, atol=2e-2)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        y = gn(paddle.randn([2, 4, 6, 6]))
+        assert y.shape == [2, 4, 6, 6]
+
+
+class TestEmbeddingDropout:
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        y = emb(idx)
+        assert y.shape == [2, 2, 4]
+        assert np.allclose(_np(y)[0, 0], _np(emb.weight)[1])
+
+    def test_embedding_grad(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([1, 1, 2]))
+        loss = emb(idx).sum()
+        loss.backward()
+        g = _np(emb.weight.grad)
+        assert np.allclose(g[1], 2.0)
+        assert np.allclose(g[2], 1.0)
+        assert np.allclose(g[3], 0.0)
+
+    def test_dropout_modes(self):
+        do = nn.Dropout(0.5)
+        x = paddle.ones([100, 100])
+        do.train()
+        y = _np(do(x))
+        frac = (y == 0).mean()
+        assert 0.4 < frac < 0.6
+        do.eval()
+        assert np.allclose(_np(do(x)), 1.0)
+
+
+class TestActivationsLosses:
+    def test_activations(self):
+        x = paddle.to_tensor([-1.0, 0.0, 2.0])
+        assert np.allclose(_np(nn.ReLU()(x)), [0, 0, 2])
+        assert np.allclose(_np(nn.Sigmoid()(x)),
+                           1 / (1 + np.exp([1.0, 0.0, -2.0])), atol=1e-6)
+        y = _np(nn.Softmax()(x))
+        assert abs(y.sum() - 1) < 1e-5
+
+    def test_cross_entropy(self):
+        logits = paddle.to_tensor(np.random.randn(4, 5).astype(np.float32),
+                                  stop_gradient=False)
+        labels = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        loss = nn.CrossEntropyLoss()(logits, labels)
+        l = _np(logits)
+        p = np.exp(l) / np.exp(l).sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), [0, 1, 2, 3]]).mean()
+        assert np.allclose(_np(loss), ref, atol=1e-5)
+        loss.backward()
+        assert logits.grad is not None
+
+    def test_mse_l1(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([2.0, 4.0])
+        assert np.allclose(_np(nn.MSELoss()(a, b)), 2.5)
+        assert np.allclose(_np(nn.L1Loss()(a, b)), 1.5)
+
+    def test_bce_with_logits(self):
+        z = paddle.to_tensor([0.5, -0.5])
+        y = paddle.to_tensor([1.0, 0.0])
+        loss = nn.BCEWithLogitsLoss()(z, y)
+        ref = -(np.log(1 / (1 + np.exp(-0.5))) + np.log(1 - 1 / (1 + np.exp(0.5)))) / 2
+        assert np.allclose(_np(loss), ref, atol=1e-6)
+
+
+class TestContainers:
+    def test_sequential_layerlist(self):
+        seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        y = seq(paddle.randn([3, 4]))
+        assert y.shape == [3, 2]
+        assert len(list(seq.parameters())) == 4
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        assert len(list(ll.parameters())) == 6
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+        m2 = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+        m2.set_state_dict(m1.state_dict())
+        x = paddle.randn([2, 4])
+        assert np.allclose(_np(m1(x)), _np(m2(x)), atol=1e-6)
+
+    def test_named_parameters(self):
+        m = nn.Sequential(nn.Linear(2, 2))
+        names = [n for n, _ in m.named_parameters()]
+        assert "0.weight" in names and "0.bias" in names
+
+
+class TestTransformer:
+    def test_mha(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 5, 16])
+        y = mha(x, x, x)
+        assert y.shape == [2, 5, 16]
+
+    def test_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        y = enc(paddle.randn([2, 5, 16]))
+        assert y.shape == [2, 5, 16]
+        loss = y.sum()
+        loss.backward()
+        grads = [p.grad for p in enc.parameters()]
+        assert all(g is not None for g in grads)
+
+    def test_full_transformer(self):
+        t = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32, dropout=0.0)
+        src = paddle.randn([2, 6, 16])
+        tgt = paddle.randn([2, 4, 16])
+        out = t(src, tgt)
+        assert out.shape == [2, 4, 16]
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = paddle.randn([2, 5, 8])
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 16]
+        assert h.shape == [2, 2, 16]
+
+    def test_gru_bidirect(self):
+        gru = nn.GRU(8, 16, direction="bidirect")
+        out, h = gru(paddle.randn([2, 5, 8]))
+        assert out.shape == [2, 5, 32]
+
+    def test_lstm_grad(self):
+        lstm = nn.LSTM(4, 8)
+        out, _ = lstm(paddle.randn([2, 3, 4]))
+        out.sum().backward()
+        for p in lstm.parameters():
+            assert p.grad is not None

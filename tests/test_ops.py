@@ -1,0 +1,194 @@
+"""Op unit tests — numpy-reference style (SURVEY.md §4.1: the reference's
+OpTest compares kernels against numpy; here ops run through dispatch+XLA)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert _np(paddle.zeros([2, 3])).sum() == 0
+        assert _np(paddle.ones([2, 3])).sum() == 6
+        assert np.allclose(_np(paddle.full([2, 2], 3.5)), 3.5)
+
+    def test_arange_linspace(self):
+        assert np.allclose(_np(paddle.arange(5)), np.arange(5))
+        assert np.allclose(_np(paddle.arange(1, 10, 2)), np.arange(1, 10, 2))
+        assert np.allclose(_np(paddle.linspace(0, 1, 5)), np.linspace(0, 1, 5))
+
+    def test_eye_tril_triu(self):
+        assert np.allclose(_np(paddle.eye(3)), np.eye(3))
+        x = np.random.rand(4, 4).astype(np.float32)
+        assert np.allclose(_np(paddle.tril(paddle.to_tensor(x))), np.tril(x))
+        assert np.allclose(_np(paddle.triu(paddle.to_tensor(x), 1)), np.triu(x, 1))
+
+    def test_to_tensor_dtypes(self):
+        t = paddle.to_tensor([1, 2, 3])
+        assert "int" in str(t.dtype)
+        t = paddle.to_tensor([1.0, 2.0])
+        assert str(t.dtype) == "float32"
+
+
+class TestMath:
+    def test_binary_ops(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        assert np.allclose(_np(ta + tb), a + b, atol=1e-6)
+        assert np.allclose(_np(ta - tb), a - b, atol=1e-6)
+        assert np.allclose(_np(ta * tb), a * b, atol=1e-6)
+        assert np.allclose(_np(ta / tb), a / b, atol=1e-5)
+        assert np.allclose(_np(ta ** 2), a ** 2, atol=1e-5)
+        assert np.allclose(_np(paddle.maximum(ta, tb)), np.maximum(a, b))
+
+    def test_scalar_broadcast(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        assert np.allclose(_np(a + 1), [2.0, 3.0])
+        assert np.allclose(_np(2 * a), [2.0, 4.0])
+        assert np.allclose(_np(1 - a), [0.0, -1.0])
+
+    def test_unary(self):
+        a = np.random.rand(5).astype(np.float32) + 0.1
+        t = paddle.to_tensor(a)
+        assert np.allclose(_np(paddle.sqrt(t)), np.sqrt(a), atol=1e-6)
+        assert np.allclose(_np(paddle.exp(t)), np.exp(a), atol=1e-5)
+        assert np.allclose(_np(paddle.log(t)), np.log(a), atol=1e-6)
+        assert np.allclose(_np(paddle.tanh(t)), np.tanh(a), atol=1e-6)
+        assert np.allclose(_np(paddle.abs(-t)), a, atol=1e-6)
+
+    def test_reductions(self):
+        a = np.random.rand(3, 4, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        assert np.allclose(_np(paddle.sum(t)), a.sum(), atol=1e-5)
+        assert np.allclose(_np(paddle.sum(t, axis=1)), a.sum(1), atol=1e-5)
+        assert np.allclose(_np(paddle.mean(t, axis=[0, 2])), a.mean((0, 2)), atol=1e-6)
+        assert np.allclose(_np(paddle.max(t, axis=0)), a.max(0))
+        assert np.allclose(_np(paddle.min(t)), a.min())
+        assert np.allclose(_np(paddle.prod(t, axis=2)), a.prod(2), atol=1e-5)
+
+    def test_matmul(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+        assert np.allclose(_np(out), a @ b, atol=1e-5)
+        # batched + transpose flags
+        a2 = np.random.rand(2, 3, 4).astype(np.float32)
+        b2 = np.random.rand(2, 5, 4).astype(np.float32)
+        out2 = paddle.matmul(paddle.to_tensor(a2), paddle.to_tensor(b2),
+                             transpose_y=True)
+        assert np.allclose(_np(out2), a2 @ b2.transpose(0, 2, 1), atol=1e-5)
+
+    def test_clip_cumsum(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        assert np.allclose(_np(paddle.clip(t, -0.5, 0.5)), np.clip(a, -0.5, 0.5))
+        assert np.allclose(_np(paddle.cumsum(t, axis=1)), np.cumsum(a, 1), atol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        assert _np(paddle.reshape(t, [6, 4])).shape == (6, 4)
+        assert _np(paddle.transpose(t, [2, 0, 1])).shape == (4, 2, 3)
+        assert _np(paddle.flatten(t, 1)).shape == (2, 12)
+
+    def test_concat_split_stack(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 3).astype(np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        assert _np(paddle.concat([ta, tb], axis=0)).shape == (4, 3)
+        assert _np(paddle.stack([ta, tb], axis=0)).shape == (2, 2, 3)
+        parts = paddle.split(paddle.to_tensor(np.random.rand(6, 3).astype(np.float32)), 3)
+        assert len(parts) == 3 and parts[0].shape == [2, 3]
+        parts = paddle.split(paddle.to_tensor(np.random.rand(6, 3).astype(np.float32)),
+                             [1, 2, 3], axis=0)
+        assert [p.shape[0] for p in parts] == [1, 2, 3]
+
+    def test_squeeze_unsqueeze_tile(self):
+        a = np.random.rand(1, 3, 1).astype(np.float32)
+        t = paddle.to_tensor(a)
+        assert _np(paddle.squeeze(t)).shape == (3,)
+        assert _np(paddle.unsqueeze(t, 0)).shape == (1, 1, 3, 1)
+        assert _np(paddle.tile(paddle.to_tensor([1.0, 2.0]), [2, 2])).shape == (2, 4)
+
+    def test_gather_scatter(self):
+        a = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        out = paddle.gather(paddle.to_tensor(a), paddle.to_tensor(idx))
+        assert np.allclose(_np(out), a[idx])
+        nd_idx = np.array([[0, 1], [2, 2]])
+        out = paddle.gather_nd(paddle.to_tensor(a), paddle.to_tensor(nd_idx))
+        assert np.allclose(_np(out), a[[0, 2], [1, 2]])
+
+    def test_where_indexing(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        out = paddle.where(t > 0, t, paddle.zeros_like(t))
+        assert np.allclose(_np(out), np.where(a > 0, a, 0))
+        assert np.allclose(_np(t[1]), a[1])
+        assert np.allclose(_np(t[:, 2]), a[:, 2])
+        assert np.allclose(_np(t[1:3, ::2]), a[1:3, ::2])
+
+    def test_pad(self):
+        a = np.random.rand(1, 2, 3, 3).astype(np.float32)
+        out = paddle.ops.pad(paddle.to_tensor(a), [1, 1, 2, 2])
+        assert _np(out).shape == (1, 2, 5, 7)
+
+
+class TestLogicSearch:
+    def test_compare(self):
+        a = paddle.to_tensor([1.0, 2.0, 3.0])
+        b = paddle.to_tensor([2.0, 2.0, 2.0])
+        assert _np(a < b).tolist() == [True, False, False]
+        assert _np(a == b).tolist() == [False, True, False]
+        assert bool(_np(paddle.ops.all(b == b)).all())
+
+    def test_argmax_topk_sort(self):
+        a = np.random.rand(3, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        assert np.allclose(_np(paddle.argmax(t, axis=1)), a.argmax(1))
+        vals, idx = paddle.topk(t, 2, axis=1)
+        ref = np.sort(a, 1)[:, ::-1][:, :2]
+        assert np.allclose(_np(vals), ref, atol=1e-6)
+        assert np.allclose(_np(paddle.sort(t, axis=1)), np.sort(a, 1))
+
+    def test_unique_nonzero(self):
+        a = np.array([1, 2, 2, 3, 3, 3])
+        out = paddle.unique(paddle.to_tensor(a))
+        assert np.allclose(_np(out), [1, 2, 3])
+        nz = paddle.nonzero(paddle.to_tensor([0.0, 1.0, 0.0, 2.0]))
+        assert _np(nz).reshape(-1).tolist() == [1, 3]
+
+
+class TestRandom:
+    def test_shapes_and_determinism(self):
+        paddle.seed(7)
+        a = paddle.ops.uniform([3, 4])
+        paddle.seed(7)
+        b = paddle.ops.uniform([3, 4])
+        assert np.allclose(_np(a), _np(b))
+        assert _np(paddle.randn([2, 2])).shape == (2, 2)
+        r = _np(paddle.randint(0, 10, [100]))
+        assert r.min() >= 0 and r.max() < 10
+        p = _np(paddle.randperm(10))
+        assert sorted(p.tolist()) == list(range(10))
+
+
+class TestStatLinalg:
+    def test_std_var(self):
+        a = np.random.rand(10, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        assert np.allclose(_np(paddle.ops.var(t)), a.var(ddof=1), atol=1e-5)
+        assert np.allclose(_np(paddle.ops.std(t, axis=0)), a.std(0, ddof=1), atol=1e-5)
+
+    def test_norm_inverse(self):
+        a = np.random.rand(3, 3).astype(np.float32) + np.eye(3, dtype=np.float32) * 3
+        t = paddle.to_tensor(a)
+        assert np.allclose(_np(paddle.ops.norm(t)), np.linalg.norm(a), atol=1e-5)
+        assert np.allclose(_np(paddle.ops.inverse(t)), np.linalg.inv(a), atol=1e-4)
