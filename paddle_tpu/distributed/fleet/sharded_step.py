@@ -210,7 +210,16 @@ class ShardedTrainStep:
         )
         if self._opt_state is None:
             self.place_state()
-            self._opt_state = self.optimizer.init_state(self._params)
+            state = self.optimizer.init_state(self._params)
+            # place optimizer slots on their (possibly dp-sharded) shardings —
+            # zeros_like inherits the param placement, which differs under
+            # ZeRO-1/2 where moments shard but params stay replicated
+            shardings = self._opt_shardings(state)
+            self._opt_state = {
+                k: {sk: jax.device_put(sv, shardings[k][sk])
+                    for sk, sv in slots.items()}
+                for k, slots in state.items()
+            }
         if self._compiled is None:
             self._compiled = self._build(len(batch_arrs))
         self._step += 1

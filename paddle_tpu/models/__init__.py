@@ -1,0 +1,4 @@
+"""Flagship model zoo (reference: ERNIE/GPT-class language models trained
+via fleet, plus the paddle.vision CNNs re-exported here)."""
+from .gpt import GPT, GPTConfig, gpt_loss_fn
+from ..vision.models import LeNet, ResNet, resnet50

@@ -1,0 +1,134 @@
+"""GPT/ERNIE-class decoder-only language model — the flagship model family.
+
+Reference capability anchor: the reference trains ERNIE/GPT-scale models via
+fleet hybrid parallelism (SURVEY.md §2.3, BASELINE.md configs 2-4); its
+transformer building blocks live in `python/paddle/nn/layer/transformer.py`
+and the TP variants in `fleet/meta_parallel/parallel_layers/mp_layers.py`.
+
+This implementation is TPU-first: pre-LN blocks whose attention routes
+through `F.scaled_dot_product_attention` (Pallas flash-attention on TPU),
+bf16-friendly, with `mesh_axes` annotations on every weight so the same
+module runs dense (single chip) or tensor-parallel under
+`fleet.build_train_step` without code changes.  Homogeneous blocks keep the
+model stackable for the pipeline schedule (paddle_tpu/parallel/pipeline.py).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed.fleet.meta_parallel import (ColumnParallelLinear,
+                                               ParallelCrossEntropy,
+                                               RowParallelLinear,
+                                               VocabParallelEmbedding)
+from ..nn import functional as F
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    intermediate_size: int = None
+    dropout: float = 0.0
+    tie_embeddings: bool = True
+    use_parallel_layers: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.ln1 = nn.LayerNorm(h)
+        self.ln2 = nn.LayerNorm(h)
+        self.num_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+        Col = ColumnParallelLinear if cfg.use_parallel_layers else None
+        if cfg.use_parallel_layers:
+            self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+            self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
+            self.fc1 = ColumnParallelLinear(h, cfg.intermediate_size,
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(cfg.intermediate_size, h,
+                                         input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(h, 3 * h)
+            self.out_proj = nn.Linear(h, h)
+            self.fc1 = nn.Linear(h, cfg.intermediate_size)
+            self.fc2 = nn.Linear(cfg.intermediate_size, h)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        from ..ops import concat, reshape, transpose
+
+        b, s, h = x.shape
+        y = self.ln1(x)
+        qkv = self.qkv(y)  # [b, s, 3h] (mp-sharded on last dim under TP)
+        qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        qkv = transpose(qkv, [2, 0, 3, 1, 4])  # [3, b, H, s, d]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        attn = transpose(attn, [0, 2, 1, 3])
+        attn = reshape(attn, [b, s, h])
+        x = x + self.dropout(self.out_proj(attn))
+        y = self.ln2(x)
+        x = x + self.dropout(self.fc2(F.gelu(self.fc1(y), approximate=True)))
+        return x
+
+
+class GPT(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.use_parallel_layers:
+            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        else:
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+        if not cfg.tie_embeddings:
+            if cfg.use_parallel_layers:
+                self.lm_head = ColumnParallelLinear(
+                    cfg.hidden_size, cfg.vocab_size, has_bias=False,
+                    gather_output=True)
+            else:
+                self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                         bias_attr=False)
+        # scaled init for residual projections (GPT-2 convention)
+        std = 0.02 / math.sqrt(2 * cfg.num_layers)
+        from ..nn import initializer as init
+
+        for blk in self.blocks:
+            init.Normal(0.0, std)(blk.out_proj.weight)
+            init.Normal(0.0, std)(blk.fc2.weight)
+
+    def forward(self, input_ids):
+        from ..ops import arange, matmul
+
+        b, s = input_ids.shape
+        pos = arange(s, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        if self.cfg.tie_embeddings:
+            logits = matmul(x, self.wte.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(x)
+        return logits
+
+
+def gpt_loss_fn(model, input_ids, labels):
+    """Next-token cross entropy (the fleet train-step loss callable)."""
+    logits = model(input_ids)
+    loss = F.cross_entropy(logits, labels, reduction="mean")
+    return loss

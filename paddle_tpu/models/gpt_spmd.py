@@ -1,0 +1,274 @@
+"""GPT hybrid-parallel SPMD train step: dp x pp x sp x mp in ONE program.
+
+This is the TPU-native counterpart of the reference's 4-D hybrid runs
+(`fleet/base/topology.py` HybridCommunicateGroup + sharding/tp/pp meta
+optimizers + SectionWorker 1F1B, SURVEY.md §2.3): a single `shard_map` over
+the ('dp','pp','sp','mp') mesh whose per-device program implements
+
+* data parallel   — tokens sharded over 'dp'; grads psum over 'dp'
+* tensor parallel — qkv/mlp column+row splits over 'mp' with psum at row
+                    outputs; vocab-parallel embedding + cross-entropy
+                    (reference `c_softmax_with_cross_entropy`)
+* sequence parallel — activations sharded over 'sp' on the seq dim; ring
+                    attention rotates K/V blocks over the 'sp' ring
+                    (net-new vs reference, SURVEY.md §5 long-context)
+* pipeline parallel — homogeneous blocks stacked over 'pp'; microbatch
+                    schedule rotates activations with collective-permute
+                    (reference `section_worker.cc` schedules); stage 0
+                    embeds tokens, the last stage computes the loss
+* optimizer update — SGD applied to local shards (ZeRO-flavored: each
+                    device updates only the slice it owns)
+
+jax.grad differentiates the entire per-device schedule; the transpose of
+ppermute is the reverse ring, which IS the backward pipeline pass.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.ring_attention import ring_attention_local
+from .gpt import GPTConfig
+
+
+# ---------------------------------------------------------------------------
+# parameter pytree (global logical shapes) + PartitionSpecs
+# ---------------------------------------------------------------------------
+def param_specs(cfg: GPTConfig) -> Dict[str, P]:
+    return {
+        # embeddings: vocab table mp-sharded on vocab dim (vocab-parallel)
+        "wte": P("mp", None),
+        "wpe": P(),
+        # stacked blocks: leading dim L sharded over pp
+        "ln1_w": P("pp", None), "ln1_b": P("pp", None),
+        "w_qkv": P("pp", None, "mp"), "b_qkv": P("pp", "mp"),
+        "w_out": P("pp", "mp", None), "b_out": P("pp", None),
+        "ln2_w": P("pp", None), "ln2_b": P("pp", None),
+        "w_fc1": P("pp", None, "mp"), "b_fc1": P("pp", "mp"),
+        "w_fc2": P("pp", "mp", None), "b_fc2": P("pp", None),
+        "lnf_w": P(), "lnf_b": P(),
+        "lm_head": P(None, "mp"),  # [H, V] vocab-sharded
+    }
+
+
+def init_params(cfg: GPTConfig, key) -> Dict[str, jnp.ndarray]:
+    H, L, F, V, S = (cfg.hidden_size, cfg.num_layers, cfg.intermediate_size,
+                     cfg.vocab_size, cfg.max_seq_len)
+    ks = jax.random.split(key, 8)
+    std = 0.02
+    rstd = std / math.sqrt(2 * L)
+    return {
+        "wte": jax.random.normal(ks[0], (V, H), jnp.float32) * std,
+        "wpe": jax.random.normal(ks[1], (S, H), jnp.float32) * std,
+        "ln1_w": jnp.ones((L, H)), "ln1_b": jnp.zeros((L, H)),
+        "w_qkv": jax.random.normal(ks[2], (L, H, 3 * H)) * std,
+        "b_qkv": jnp.zeros((L, 3 * H)),
+        "w_out": jax.random.normal(ks[3], (L, H, H)) * rstd,
+        "b_out": jnp.zeros((L, H)),
+        "ln2_w": jnp.ones((L, H)), "ln2_b": jnp.zeros((L, H)),
+        "w_fc1": jax.random.normal(ks[4], (L, H, F)) * std,
+        "b_fc1": jnp.zeros((L, F)),
+        "w_fc2": jax.random.normal(ks[5], (L, F, H)) * rstd,
+        "b_fc2": jnp.zeros((L, H)),
+        "lnf_w": jnp.ones((H,)), "lnf_b": jnp.zeros((H,)),
+        "lm_head": jax.random.normal(ks[6], (H, V)) * std,
+    }
+
+
+# grads of pp-sharded entries reduce over (dp, sp); everything else over
+# (dp, sp, pp) — non-pp params get their partial only on the stage that uses
+# them (wte/wpe on stage 0, lnf/lm_head on the last), so the pp-psum
+# reassembles the true total instead of overcounting.
+_PP_SHARDED = {"ln1_w", "ln1_b", "w_qkv", "b_qkv", "w_out", "b_out",
+               "ln2_w", "ln2_b", "w_fc1", "b_fc1", "w_fc2", "b_fc2"}
+
+
+def _layernorm(x, w, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.var(x32, -1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+def _block(x, p, li, num_heads_local, compute_dtype):
+    """One transformer block on local shards. x: [b, s_local, H]."""
+    b, s, H = x.shape
+    d = H // (num_heads_local * int(lax.axis_size("mp")))
+    hd = x.shape[-1]  # H
+
+    y = _layernorm(x, p["ln1_w"][li], p["ln1_b"][li])
+    qkv = (y.astype(compute_dtype) @ p["w_qkv"][li].astype(compute_dtype)
+           ) + p["b_qkv"][li].astype(compute_dtype)
+    # local: [b, s, 3*H/mp] -> [b, heads_local, s, d] x3
+    hl = num_heads_local
+    head_dim = qkv.shape[-1] // (3 * hl)
+    qkv = qkv.reshape(b, s, 3, hl, head_dim).transpose(2, 0, 3, 1, 4)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    attn = ring_attention_local(q, k, v, axis_name="sp", causal=True)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, hl * head_dim)
+    out = attn @ p["w_out"][li].astype(compute_dtype)
+    out = lax.psum(out, "mp") + p["b_out"][li].astype(compute_dtype)
+    x = x + out.astype(x.dtype)
+
+    y = _layernorm(x, p["ln2_w"][li], p["ln2_b"][li])
+    h1 = jax.nn.gelu(
+        y.astype(compute_dtype) @ p["w_fc1"][li].astype(compute_dtype)
+        + p["b_fc1"][li].astype(compute_dtype), approximate=True)
+    h2 = h1 @ p["w_fc2"][li].astype(compute_dtype)
+    h2 = lax.psum(h2, "mp") + p["b_fc2"][li].astype(compute_dtype)
+    return x + h2.astype(x.dtype)
+
+
+def _embed(tokens, p, sp_rank, s_local, compute_dtype):
+    """Vocab-parallel embedding + position (local seq positions)."""
+    V_local = p["wte"].shape[0]
+    mp_rank = lax.axis_index("mp")
+    lo = mp_rank * V_local
+    local_tok = tokens - lo
+    in_shard = (local_tok >= 0) & (local_tok < V_local)
+    safe = jnp.clip(local_tok, 0, V_local - 1)
+    emb = jnp.where(in_shard[..., None], p["wte"][safe], 0.0)
+    emb = lax.psum(emb, "mp")
+    pos = sp_rank * s_local + jnp.arange(s_local)
+    return (emb + p["wpe"][pos]).astype(compute_dtype)
+
+
+def _vocab_parallel_ce(logits, labels):
+    """Cross entropy over mp-sharded vocab (reference
+    c_softmax_with_cross_entropy_op semantics). logits: [b, s, V/mp]."""
+    logits = logits.astype(jnp.float32)
+    V_local = logits.shape[-1]
+    mp_rank = lax.axis_index("mp")
+    lo = mp_rank * V_local
+    # stability max is gradient-free (pmax has no JVP rule; as a constant
+    # shift it cancels in the softmax anyway)
+    m = lax.stop_gradient(lax.pmax(jnp.max(logits, -1), "mp"))
+    lse = jnp.log(lax.psum(jnp.sum(jnp.exp(logits - m[..., None]), -1), "mp")) + m
+    local_lab = labels - lo
+    in_shard = (local_lab >= 0) & (local_lab < V_local)
+    safe = jnp.clip(local_lab, 0, V_local - 1)
+    tgt = jnp.where(in_shard,
+                    jnp.take_along_axis(logits, safe[..., None], -1)[..., 0],
+                    0.0)
+    tgt = lax.psum(tgt, "mp")
+    return lse - tgt  # [b, s] per-token nll
+
+
+def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 1,
+                          lr: float = 1e-3, compute_dtype=jnp.bfloat16):
+    """Returns jitted step(params, tokens, labels) -> (loss, new_params).
+
+    tokens/labels: global [B, S] int32, B % (dp*num_micro) == 0,
+    S % sp == 0, heads % mp == 0, L % pp == 0, V % mp == 0.
+    """
+    pp = int(mesh.shape["pp"])
+    mp = int(mesh.shape["mp"])
+    layers_per_stage = cfg.num_layers // pp
+    heads_local = cfg.num_heads // mp
+    specs = param_specs(cfg)
+
+    def device_fn(params, tokens, labels):
+        # local views: tokens [B/dp, S/sp]
+        pp_rank = lax.axis_index("pp")
+        sp_rank = lax.axis_index("sp")
+        s_local = tokens.shape[1]
+        M = num_micro
+        mb = tokens.shape[0] // M
+        micro_tok = tokens.reshape(M, mb, s_local)
+        micro_lab = labels.reshape(M, mb, s_local)
+        n_tokens_global = (tokens.shape[0] * s_local
+                           * int(lax.axis_size("dp")) * int(lax.axis_size("sp")))
+
+        def loss_fn(prm):
+            def stage(state):
+                for li in range(layers_per_stage):
+                    state = _block(state, prm, li, heads_local, compute_dtype)
+                return state
+
+            perm = [(i, (i + 1) % pp) for i in range(pp)]
+            T = M + pp - 1
+            state = jnp.zeros((mb, s_local, cfg.hidden_size), compute_dtype)
+            total = jnp.zeros((), jnp.float32)
+            for t in range(T):
+                tok_t = micro_tok[min(t, M - 1)]
+                embedded = _embed(tok_t, prm, sp_rank, s_local, compute_dtype)
+                state = jnp.where((pp_rank == 0) & (t < M), embedded, state)
+                state = stage(state)
+                # last stage emits loss for microbatch t-(pp-1)
+                o = t - (pp - 1)
+                xf = _layernorm(state, prm["lnf_w"], prm["lnf_b"])
+                logits = xf.astype(compute_dtype) @ prm["lm_head"].astype(compute_dtype)
+                nll = _vocab_parallel_ce(logits, micro_lab[max(min(o, M - 1), 0)])
+                emit = (pp_rank == pp - 1) & (o >= 0)
+                total = total + jnp.where(emit, jnp.sum(nll), 0.0)
+                if pp > 1:
+                    state = lax.ppermute(state, "pp", perm)
+            # per-device partial: sum over local tokens / global token count
+            return total / n_tokens_global
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # reassemble true totals: dp+sp always; pp only for stage-private
+        # (non-pp-stacked) params.  loss itself: psum over dp/sp partials,
+        # and over pp (only the last stage contributed).
+        loss = lax.psum(loss, ("dp", "sp", "pp"))
+        def reduce_g(name, g):
+            axes = ("dp", "sp") if name in _PP_SHARDED else ("dp", "sp", "pp")
+            return lax.psum(g, axes)
+
+        grads = {k: reduce_g(k, g) for k, g in grads.items()}
+        new_params = {k: (p - lr * grads[k]).astype(p.dtype)
+                      for k, p in params.items()}
+        return loss, new_params
+
+    pspecs = {k: specs[k] for k in specs}
+    from jax import shard_map
+
+    fn = shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(pspecs, P("dp", "sp"), P("dp", "sp")),
+        out_specs=(P(), pspecs),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def reference_loss(cfg: GPTConfig, params, tokens, labels):
+    """Single-device dense forward for correctness checks (no parallelism)."""
+    H = cfg.hidden_size
+    x = params["wte"][tokens] + params["wpe"][jnp.arange(tokens.shape[1])]
+
+    def ln(x, w, b, eps=1e-5):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + eps) * w + b
+
+    for li in range(cfg.num_layers):
+        y = ln(x, params["ln1_w"][li], params["ln1_b"][li])
+        b_, s_, _ = y.shape
+        qkv = y @ params["w_qkv"][li] + params["b_qkv"][li]
+        qkv = qkv.reshape(b_, s_, 3, cfg.num_heads, H // cfg.num_heads)
+        qkv = qkv.transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(H // cfg.num_heads)
+        mask = jnp.tril(jnp.ones((s_, s_), bool))
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, -1)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b_, s_, H)
+        x = x + attn @ params["w_out"][li] + params["b_out"][li]
+        y = ln(x, params["ln2_w"][li], params["ln2_b"][li])
+        h = jax.nn.gelu(y @ params["w_fc1"][li] + params["b_fc1"][li],
+                        approximate=True)
+        x = x + h @ params["w_fc2"][li] + params["b_fc2"][li]
+    x = ln(x, params["lnf_w"], params["lnf_b"])
+    logits = x @ params["lm_head"]
+    logp = jax.nn.log_softmax(logits, -1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+    return jnp.mean(nll)

@@ -74,7 +74,7 @@ def pipeline_spmd_step(stage_fn: Callable, stacked_params, microbatches, mesh,
                        axis_name: str = "pp", params_pspec=None):
     """Global entry: stacked_params pytree with leading dim = pp size."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     if params_pspec is None:
         params_pspec = jax.tree_util.tree_map(
@@ -90,6 +90,6 @@ def pipeline_spmd_step(stage_fn: Callable, stacked_params, microbatches, mesh,
         mesh=mesh,
         in_specs=(params_pspec, P()),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
     return fn(stacked_params, microbatches)

@@ -87,7 +87,7 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = False,
     """Global entry: q,k,v are global arrays [B,H,S,D]; returns attention
     computed with the ring schedule, sharded over `axis_name` on S."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec = P(None, None, axis_name, None)
     fn = shard_map(
@@ -96,6 +96,6 @@ def ring_attention(q, k, v, mesh, axis_name: str = "sp", causal: bool = False,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(q, k, v)

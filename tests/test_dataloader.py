@@ -1,0 +1,102 @@
+"""io.DataLoader tests (reference `test_dataloader_*.py` family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BatchSampler, ConcatDataset, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           RandomSampler, SequenceSampler, Subset,
+                           TensorDataset, WeightedRandomSampler, random_split)
+
+
+class RangeDs(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i, i + 1]), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+class TestSamplers:
+    def test_sequence_random(self):
+        ds = RangeDs(10)
+        assert list(SequenceSampler(ds)) == list(range(10))
+        r = list(RandomSampler(ds))
+        assert sorted(r) == list(range(10))
+
+    def test_batch_sampler(self):
+        ds = RangeDs(10)
+        bs = BatchSampler(ds, batch_size=3, drop_last=False)
+        batches = list(bs)
+        assert len(batches) == 4
+        assert len(batches[-1]) == 1
+        bs = BatchSampler(ds, batch_size=3, drop_last=True)
+        assert len(list(bs)) == 3
+
+    def test_distributed_batch_sampler(self):
+        ds = RangeDs(20)
+        seen = []
+        for rank in range(4):
+            s = DistributedBatchSampler(ds, batch_size=5, num_replicas=4,
+                                        rank=rank)
+            for b in s:
+                seen += b
+        assert sorted(seen) == list(range(20))
+
+    def test_weighted(self):
+        w = WeightedRandomSampler([0.0, 0.0, 1.0], 10)
+        assert all(i == 2 for i in w)
+
+
+class TestDataLoader:
+    def test_basic_iteration(self):
+        dl = DataLoader(RangeDs(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 2]
+        assert str(y.dtype).startswith("int")
+
+    def test_shuffle_epoch_differs(self):
+        dl = DataLoader(RangeDs(50), batch_size=50, shuffle=True)
+        (x1, _), = list(dl)
+        (x2, _), = list(dl)
+        assert not np.allclose(x1.numpy(), x2.numpy())
+
+    def test_threaded_workers_same_content(self):
+        ds = RangeDs(17)
+        dl0 = DataLoader(ds, batch_size=5, num_workers=0)
+        dl2 = DataLoader(ds, batch_size=5, num_workers=2)
+        a = np.concatenate([b[0].numpy() for b in dl0])
+        b = np.concatenate([b[0].numpy() for b in dl2])
+        assert np.allclose(a, b)
+
+    def test_iterable_dataset(self):
+        class It(IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32([i]), np.int64(0)
+
+        dl = DataLoader(It(), batch_size=3)
+        batches = list(dl)
+        assert [b[0].shape[0] for b in batches] == [3, 3, 1]
+
+    def test_tensor_dataset_and_splits(self):
+        xs = np.arange(12, dtype=np.float32).reshape(6, 2)
+        ys = np.arange(6)
+        td = TensorDataset([xs, ys])
+        assert len(td) == 6
+        a, b = random_split(td, [4, 2])
+        assert len(a) == 4 and len(b) == 2
+        cat = ConcatDataset([td, td])
+        assert len(cat) == 12
+        assert np.allclose(cat[7][0], td[1][0])
+
+    def test_custom_collate(self):
+        dl = DataLoader(RangeDs(4), batch_size=2,
+                        collate_fn=lambda items: np.stack([i[0] for i in items]).sum())
+        out = list(dl)
+        assert len(out) == 2
