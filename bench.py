@@ -1,0 +1,81 @@
+"""Flagship benchmark: GPT decoder LM pretrain throughput (tokens/sec/chip).
+
+Runs the framework's own fused train step (paddle_tpu.jit.TrainStep — one
+donated XLA executable for forward+backward+optimizer, the TPU-native
+replacement for the reference's per-op dygraph dispatch; see SURVEY.md §3.1)
+on a GPT-base-class model in bf16 AMP.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+BASELINE.md: the reference publishes no numbers (vs_baseline fixed at 1.0);
+the north-star metric is tokens/sec/chip (BASELINE.json config 2).
+
+Env knobs: BENCH_SMOKE=1 shrinks the model for a CPU smoke run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main():
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, jit, nn, optimizer
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    paddle.seed(0)
+    if smoke:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128,
+                        use_parallel_layers=False)
+        batch, seq, steps, warmup = 2, 128, 4, 2
+    else:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024,
+                        use_parallel_layers=False)
+        batch, seq, steps, warmup = 8, 1024, 20, 3
+
+    model = GPT(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                          weight_decay=0.01)
+
+    def loss_fn(m, tokens, labels):
+        with amp.auto_cast(enable=True, dtype="bfloat16"):
+            logits = m(tokens)
+        return nn.functional.cross_entropy(
+            logits.astype("float32"), labels, reduction="mean")
+
+    step = jit.train_step(model, loss_fn, opt)
+
+    rng = np.random.default_rng(0)
+    tokens = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    for _ in range(warmup):
+        loss = step(tokens, labels)
+    jax.block_until_ready(loss._array)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(tokens, labels)
+    jax.block_until_ready(loss._array)
+    dt = time.perf_counter() - t0
+
+    tok_per_s = batch * seq * steps / dt
+    print(json.dumps({
+        "metric": "gpt_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(tok_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

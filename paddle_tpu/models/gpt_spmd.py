@@ -146,9 +146,10 @@ def _vocab_parallel_ce(logits, labels):
     V_local = logits.shape[-1]
     mp_rank = lax.axis_index("mp")
     lo = mp_rank * V_local
-    # stability max is gradient-free (pmax has no JVP rule; as a constant
-    # shift it cancels in the softmax anyway)
-    m = lax.stop_gradient(lax.pmax(jnp.max(logits, -1), "mp"))
+    # stability max is gradient-free (pmax has no JVP rule, so stop_gradient
+    # must be applied to its INPUT — zero tangents skip the missing rule; as a
+    # constant shift it cancels in the softmax anyway)
+    m = lax.pmax(lax.stop_gradient(jnp.max(logits, -1)), "mp")
     lse = jnp.log(lax.psum(jnp.sum(jnp.exp(logits - m[..., None]), -1), "mp")) + m
     local_lab = labels - lo
     in_shard = (local_lab >= 0) & (local_lab < V_local)
