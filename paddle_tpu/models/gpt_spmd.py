@@ -81,14 +81,6 @@ def init_params(cfg: GPTConfig, key) -> Dict[str, jnp.ndarray]:
     }
 
 
-# grads of pp-sharded entries reduce over (dp, sp); everything else over
-# (dp, sp, pp) — non-pp params get their partial only on the stage that uses
-# them (wte/wpe on stage 0, lnf/lm_head on the last), so the pp-psum
-# reassembles the true total instead of overcounting.
-_PP_SHARDED = {"ln1_w", "ln1_b", "w_qkv", "b_qkv", "w_out", "b_out",
-               "ln2_w", "ln2_b", "w_fc1", "b_fc1", "w_fc2", "b_fc2"}
-
-
 def _layernorm(x, w, b, eps=1e-5):
     x32 = x.astype(jnp.float32)
     mu = jnp.mean(x32, -1, keepdims=True)
@@ -105,10 +97,13 @@ def _block(x, p, li, num_heads_local, compute_dtype):
     y = _layernorm(x, p["ln1_w"][li], p["ln1_b"][li])
     qkv = (y.astype(compute_dtype) @ p["w_qkv"][li].astype(compute_dtype)
            ) + p["b_qkv"][li].astype(compute_dtype)
-    # local: [b, s, 3*H/mp] -> [b, heads_local, s, d] x3
+    # local: [b, s, 3*H/mp] -> [b, heads_local, s, d] x3.  The packed qkv
+    # axis is HEAD-MAJOR ((heads, 3, d)) so that contiguous mp shards hold
+    # whole heads — sharding a (3, heads, d)-ordered axis would hand each
+    # rank fragments of q, k and v.
     hl = num_heads_local
     head_dim = qkv.shape[-1] // (3 * hl)
-    qkv = qkv.reshape(b, s, 3, hl, head_dim).transpose(2, 0, 3, 1, 4)
+    qkv = qkv.reshape(b, s, hl, 3, head_dim).transpose(3, 0, 2, 1, 4)
     q, k, v = qkv[0], qkv[1], qkv[2]
     attn = ring_attention_local(q, k, v, axis_name="sp", causal=True)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, hl * head_dim)
@@ -214,15 +209,14 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 1,
             return total / n_tokens_global
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
-        # reassemble true totals: dp+sp always; pp only for stage-private
-        # (non-pp-stacked) params.  loss itself: psum over dp/sp partials,
-        # and over pp (only the last stage contributed).
+        # Under shard_map's VMA tracking (check_vma=True) each param is
+        # device-INVARIANT over every mesh axis absent from its
+        # PartitionSpec; the transpose of that implicit pbroadcast is an
+        # automatic psum of the cotangent over exactly those axes.  So
+        # `grads` is already fully reduced — an explicit psum here would
+        # double-count.  Only the loss (a per-device partial, varying over
+        # dp/sp/pp) needs reassembly.
         loss = lax.psum(loss, ("dp", "sp", "pp"))
-        def reduce_g(name, g):
-            axes = ("dp", "sp") if name in _PP_SHARDED else ("dp", "sp", "pp")
-            return lax.psum(g, axes)
-
-        grads = {k: reduce_g(k, g) for k, g in grads.items()}
         new_params = {k: (p - lr * grads[k]).astype(p.dtype)
                       for k, p in params.items()}
         return loss, new_params
@@ -235,7 +229,7 @@ def build_spmd_train_step(cfg: GPTConfig, mesh: Mesh, num_micro: int = 1,
         mesh=mesh,
         in_specs=(pspecs, P("dp", "sp"), P("dp", "sp")),
         out_specs=(P(), pspecs),
-        check_vma=False,
+        check_vma=True,
     )
     return jax.jit(fn)
 
@@ -254,8 +248,9 @@ def reference_loss(cfg: GPTConfig, params, tokens, labels):
         y = ln(x, params["ln1_w"][li], params["ln1_b"][li])
         b_, s_, _ = y.shape
         qkv = y @ params["w_qkv"][li] + params["b_qkv"][li]
-        qkv = qkv.reshape(b_, s_, 3, cfg.num_heads, H // cfg.num_heads)
-        qkv = qkv.transpose(2, 0, 3, 1, 4)
+        # head-major (heads, 3, d) packing — must match _block's layout
+        qkv = qkv.reshape(b_, s_, cfg.num_heads, 3, H // cfg.num_heads)
+        qkv = qkv.transpose(3, 0, 2, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(H // cfg.num_heads)
         mask = jnp.tril(jnp.ones((s_, s_), bool))
