@@ -1,0 +1,169 @@
+// Host-side auto-growth best-fit arena allocator.
+//
+// TPU-native role: the device side is owned by PJRT, but the host side
+// still needs a pooled, aligned staging arena for DataLoader batches and
+// checkpoint IO (the reference's AutoGrowthBestFitAllocator,
+// paddle/fluid/memory/allocation/auto_growth_best_fit_allocator.h:29, plus
+// the mmap shared-memory allocator used by DataLoader workers,
+// memory/allocation/mmap_allocator.cc).  Algorithm: free blocks kept in a
+// size-ordered multimap (best fit); adjacent free blocks coalesce; arena
+// grows in configurable chunks; large requests get dedicated chunks.
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include "enforce.h"
+
+namespace ptrt {
+
+namespace {
+constexpr size_t kAlignment = 256;  // matches TPU-friendly host staging
+
+inline size_t AlignUp(size_t n) {
+  return (n + kAlignment - 1) & ~(kAlignment - 1);
+}
+}  // namespace
+
+class Arena {
+ public:
+  explicit Arena(size_t chunk_size) : chunk_size_(AlignUp(chunk_size)) {}
+
+  ~Arena() {
+    for (auto& c : chunks_) std::free(c);
+  }
+
+  void* Alloc(size_t size) {
+    size = AlignUp(size ? size : 1);
+    std::lock_guard<std::mutex> g(mu_);
+    // best fit: smallest free block that can hold `size`
+    auto it = free_by_size_.lower_bound(size);
+    if (it == free_by_size_.end()) {
+      Grow(size);
+      it = free_by_size_.lower_bound(size);
+      PTRT_ENFORCE(it != free_by_size_.end(), kResourceExhausted,
+                   "arena growth failed for %zu bytes", size);
+    }
+    char* base = it->second;
+    size_t block = it->first;
+    free_by_size_.erase(it);
+    free_by_addr_.erase(base);
+    if (block - size >= kAlignment) {  // split the tail back into the pool
+      InsertFree(base + size, block - size);
+      block = size;
+    }
+    allocated_[base] = block;
+    in_use_ += block;
+    peak_ = std::max(peak_, in_use_);
+    return base;
+  }
+
+  void Free(void* p) {
+    if (p == nullptr) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = allocated_.find(static_cast<char*>(p));
+    PTRT_ENFORCE(it != allocated_.end(), kInvalidArgument,
+                 "free of pointer not owned by arena");
+    char* base = it->first;
+    size_t size = it->second;
+    allocated_.erase(it);
+    in_use_ -= size;
+    // coalesce with next neighbour
+    auto next = free_by_addr_.find(base + size);
+    if (next != free_by_addr_.end()) {
+      size += next->second;
+      EraseFree(next->first, next->second);
+    }
+    // coalesce with previous neighbour
+    auto prev = free_by_addr_.lower_bound(base);
+    if (prev != free_by_addr_.begin()) {
+      --prev;
+      if (prev->first + prev->second == base) {
+        base = prev->first;
+        size += prev->second;
+        EraseFree(prev->first, prev->second);
+      }
+    }
+    InsertFree(base, size);
+  }
+
+  size_t in_use() const { return in_use_; }
+  size_t peak() const { return peak_; }
+  size_t reserved() const { return reserved_; }
+
+ private:
+  void Grow(size_t min_size) {
+    size_t n = std::max(chunk_size_, AlignUp(min_size));
+    void* mem = nullptr;
+    // aligned chunk so every carved block inherits kAlignment
+    if (posix_memalign(&mem, kAlignment, n) != 0) {
+      PTRT_ENFORCE(false, kResourceExhausted,
+                   "posix_memalign(%zu) failed", n);
+    }
+    chunks_.push_back(mem);
+    reserved_ += n;
+    InsertFree(static_cast<char*>(mem), n);
+  }
+
+  void InsertFree(char* base, size_t size) {
+    free_by_addr_[base] = size;
+    free_by_size_.emplace(size, base);
+  }
+
+  void EraseFree(char* base, size_t size) {
+    free_by_addr_.erase(base);
+    auto range = free_by_size_.equal_range(size);
+    for (auto i = range.first; i != range.second; ++i) {
+      if (i->second == base) {
+        free_by_size_.erase(i);
+        return;
+      }
+    }
+  }
+
+  std::mutex mu_;
+  size_t chunk_size_;
+  std::vector<void*> chunks_;
+  std::multimap<size_t, char*> free_by_size_;
+  std::map<char*, size_t> free_by_addr_;  // ordered for coalescing
+  std::unordered_map<char*, size_t> allocated_;
+  size_t in_use_ = 0, peak_ = 0, reserved_ = 0;
+};
+
+}  // namespace ptrt
+
+extern "C" {
+
+void* ptrt_arena_create(size_t chunk_size) {
+  return new ptrt::Arena(chunk_size ? chunk_size : (64u << 20));
+}
+
+void ptrt_arena_destroy(void* arena) {
+  delete static_cast<ptrt::Arena*>(arena);
+}
+
+int ptrt_arena_alloc(void* arena, size_t size, void** out) {
+  PTRT_C_API_BEGIN
+  *out = static_cast<ptrt::Arena*>(arena)->Alloc(size);
+  PTRT_C_API_END
+}
+
+int ptrt_arena_free(void* arena, void* p) {
+  PTRT_C_API_BEGIN
+  static_cast<ptrt::Arena*>(arena)->Free(p);
+  PTRT_C_API_END
+}
+
+void ptrt_arena_stats(void* arena, size_t* in_use, size_t* peak,
+                      size_t* reserved) {
+  auto* a = static_cast<ptrt::Arena*>(arena);
+  if (in_use) *in_use = a->in_use();
+  if (peak) *peak = a->peak();
+  if (reserved) *reserved = a->reserved();
+}
+
+}  // extern "C"

@@ -1,0 +1,192 @@
+// Background-prefetch data pipeline: bounded blocking queue of byte
+// buffers filled by worker threads running a producer callback.
+//
+// TPU-native counterpart of the reference's double-buffered reader + shared
+// memory worker transport (operators/reader/buffered_reader.cc,
+// pybind/reader_py.cc, memory/allocation/mmap_allocator.cc): batches are
+// materialized into arena-backed host buffers off the main thread so the
+// step loop only ever dequeues ready, contiguous, aligned storage (which
+// jax/dlpack can wrap zero-copy for device transfer).
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "enforce.h"
+
+namespace ptrt {
+
+struct Batch {
+  void* data = nullptr;
+  size_t size = 0;
+  int64_t index = -1;  // producer-assigned ordinal; -1 = end of stream
+};
+
+// Producer callback contract (ctypes from Python or native):
+//   int producer(int64_t index, void** out_data, size_t* out_size, void* ud)
+// returns 0 with *out_data/out_size set (buffer ownership passes to queue
+// consumer), or nonzero for end-of-stream.
+using ProducerFn = int (*)(int64_t, void**, size_t*, void*);
+
+class PrefetchQueue {
+ public:
+  PrefetchQueue(size_t capacity, int n_workers, ProducerFn producer,
+                void* user_data, bool ordered)
+      : capacity_(capacity ? capacity : 2),
+        producer_(producer),
+        user_data_(user_data),
+        ordered_(ordered) {
+    if (n_workers <= 0) n_workers = 1;
+    for (int i = 0; i < n_workers; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~PrefetchQueue() { Shutdown(); }
+
+  void Shutdown() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (stopped_) return;
+      stopped_ = true;
+    }
+    not_full_.notify_all();
+    not_empty_.notify_all();
+    for (auto& w : workers_) w.join();
+    workers_.clear();
+  }
+
+  // Blocks for the next batch.  Returns false at end of stream.
+  bool Pop(Batch* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [this] {
+      return stopped_ || !ReadyFront().empty() || (eos_ && inflight_ == 0);
+    });
+    auto& q = ReadyFront();
+    if (q.empty()) return false;  // stream exhausted or shutdown
+    *out = q.front();
+    q.pop_front();
+    not_full_.notify_all();
+    return true;
+  }
+
+ private:
+  // In ordered mode batches must be delivered by ordinal even when workers
+  // finish out of order; out-of-order completions park in pending_.
+  std::deque<Batch>& ReadyFront() {
+    if (!ordered_) return queue_;
+    while (!pending_.empty()) {
+      bool moved = false;
+      for (auto it = pending_.begin(); it != pending_.end(); ++it) {
+        if (it->index == next_ready_) {
+          queue_.push_back(*it);
+          pending_.erase(it);
+          next_ready_++;
+          moved = true;
+          break;
+        }
+      }
+      if (!moved) break;
+    }
+    // After EOS no more ordinals will ever arrive, so batches parked past a
+    // gap (e.g. index 6 completed while index 5 hit end-of-stream) would be
+    // stranded and their buffers leaked; flush them in ascending order.
+    if (eos_ && inflight_ == 0 && !pending_.empty()) {
+      std::sort(pending_.begin(), pending_.end(),
+                [](const Batch& a, const Batch& b) { return a.index < b.index; });
+      for (auto& b : pending_) queue_.push_back(b);
+      pending_.clear();
+    }
+    return queue_;
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      int64_t my_index;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        not_full_.wait(lk, [this] {
+          return stopped_ ||
+                 (!eos_ && queue_.size() + pending_.size() + inflight_ <
+                               capacity_);
+        });
+        if (stopped_ || eos_) return;
+        my_index = next_index_++;
+        inflight_++;
+      }
+      void* data = nullptr;
+      size_t size = 0;
+      int rc = producer_(my_index, &data, &size, user_data_);
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        inflight_--;
+        if (rc != 0) {
+          eos_ = true;
+        } else {
+          Batch b{data, size, my_index};
+          if (ordered_ && my_index != next_ready_) {
+            pending_.push_back(b);
+          } else {
+            queue_.push_back(b);
+            if (ordered_) next_ready_++;
+          }
+        }
+      }
+      not_empty_.notify_all();
+      if (rc != 0) {
+        not_full_.notify_all();
+        return;
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable not_full_, not_empty_;
+  std::deque<Batch> queue_;    // ready, in delivery order
+  std::deque<Batch> pending_;  // completed out of order (ordered mode)
+  std::vector<std::thread> workers_;
+  size_t capacity_;
+  ProducerFn producer_;
+  void* user_data_;
+  bool ordered_;
+  bool stopped_ = false;
+  bool eos_ = false;
+  int64_t next_index_ = 0;  // next ordinal handed to a worker
+  int64_t next_ready_ = 0;  // next ordinal eligible for the ready queue
+  int inflight_ = 0;
+};
+
+}  // namespace ptrt
+
+extern "C" {
+
+void* ptrt_prefetch_create(size_t capacity, int n_workers,
+                           int (*producer)(int64_t, void**, size_t*, void*),
+                           void* user_data, int ordered) {
+  return new ptrt::PrefetchQueue(capacity, n_workers, producer, user_data,
+                                 ordered != 0);
+}
+
+void ptrt_prefetch_destroy(void* q) {
+  delete static_cast<ptrt::PrefetchQueue*>(q);
+}
+
+// Returns 1 and fills (data, size, index) on success; 0 at end of stream.
+int ptrt_prefetch_pop(void* q, void** data, size_t* size, int64_t* index) {
+  ptrt::Batch b;
+  if (!static_cast<ptrt::PrefetchQueue*>(q)->Pop(&b)) return 0;
+  if (data) *data = b.data;
+  if (size) *size = b.size;
+  if (index) *index = b.index;
+  return 1;
+}
+
+void ptrt_prefetch_shutdown(void* q) {
+  static_cast<ptrt::PrefetchQueue*>(q)->Shutdown();
+}
+
+}  // extern "C"

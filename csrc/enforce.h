@@ -1,0 +1,95 @@
+// Error machinery for the native TPU runtime.
+//
+// TPU-native counterpart of the reference's PADDLE_ENFORCE stack
+// (paddle/fluid/platform/enforce.h, errors.h, error_codes.proto): typed
+// error codes + message capture, surfaced to Python as a (code, message)
+// pair through the C API boundary instead of C++ exceptions crossing it.
+#pragma once
+
+#include <cstdarg>
+#include <cstdio>
+#include <stdexcept>
+#include <string>
+
+namespace ptrt {
+
+// Mirrors the reference's error_codes.proto enumeration.
+enum class ErrorCode : int {
+  kOk = 0,
+  kInvalidArgument = 1,
+  kNotFound = 2,
+  kOutOfRange = 3,
+  kAlreadyExists = 4,
+  kResourceExhausted = 5,
+  kPreconditionNotMet = 6,
+  kPermissionDenied = 7,
+  kExecutionTimeout = 8,
+  kUnimplemented = 9,
+  kUnavailable = 10,
+  kFatal = 11,
+  kExternal = 12,
+};
+
+class EnforceError : public std::runtime_error {
+ public:
+  EnforceError(ErrorCode code, const std::string& msg)
+      : std::runtime_error(msg), code_(code) {}
+  ErrorCode code() const { return code_; }
+
+ private:
+  ErrorCode code_;
+};
+
+inline std::string FormatMessage(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  char buf[2048];
+  vsnprintf(buf, sizeof(buf), fmt, ap);
+  va_end(ap);
+  return std::string(buf);
+}
+
+}  // namespace ptrt
+
+#define PTRT_ENFORCE(cond, code, ...)                                   \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      throw ::ptrt::EnforceError(                                       \
+          ::ptrt::ErrorCode::code,                                      \
+          ::ptrt::FormatMessage(__VA_ARGS__) +                          \
+              ::ptrt::FormatMessage(" [%s:%d, cond: %s]", __FILE__,     \
+                                    __LINE__, #cond));                  \
+    }                                                                   \
+  } while (0)
+
+// Thread-local last-error slot so C API functions can return status codes
+// while Python retrieves the message (pattern of PJRT C APIs).
+namespace ptrt {
+struct LastError {
+  int code = 0;
+  std::string message;
+};
+LastError& last_error();
+
+inline int CaptureError(const EnforceError& e) {
+  last_error().code = static_cast<int>(e.code());
+  last_error().message = e.what();
+  return static_cast<int>(e.code());
+}
+inline int CaptureError(const std::exception& e) {
+  last_error().code = static_cast<int>(ErrorCode::kFatal);
+  last_error().message = e.what();
+  return static_cast<int>(ErrorCode::kFatal);
+}
+}  // namespace ptrt
+
+#define PTRT_C_API_BEGIN try {
+#define PTRT_C_API_END                          \
+  }                                             \
+  catch (const ::ptrt::EnforceError& e) {       \
+    return ::ptrt::CaptureError(e);             \
+  }                                             \
+  catch (const std::exception& e) {             \
+    return ::ptrt::CaptureError(e);             \
+  }                                             \
+  return 0;

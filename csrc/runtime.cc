@@ -1,0 +1,212 @@
+// Core native runtime: flags registry, trace-event profiler, monitor stats,
+// last-error storage.
+//
+// Reference counterparts:
+//  * flags        — paddle/fluid/platform/flags.cc (FLAGS_* gflags) exported
+//                   to Python via pybind/global_value_getter_setter.cc
+//  * profiler     — platform/profiler.h RecordEvent/EnableProfiler +
+//                   chrome-trace export of platform/profiler.proto timelines
+//  * monitor      — platform/monitor.cc named int64 stat registry
+// On TPU the device timeline comes from XLA/PJRT xplane instead of CUPTI;
+// this recorder covers the host side and merges with JAX profiler output.
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "enforce.h"
+
+namespace ptrt {
+
+LastError& last_error() {
+  static thread_local LastError e;
+  return e;
+}
+
+// ---------------------------------------------------------------------------
+// Flags registry (string -> string; typed accessors layered in Python)
+// ---------------------------------------------------------------------------
+class Flags {
+ public:
+  static Flags& Get() {
+    static Flags f;
+    return f;
+  }
+
+  void Set(const std::string& k, const std::string& v) {
+    std::lock_guard<std::mutex> g(mu_);
+    flags_[k] = v;
+  }
+
+  bool GetValue(const std::string& k, std::string* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = flags_.find(k);
+    if (it == flags_.end()) return false;
+    *out = it->second;
+    return true;
+  }
+
+  std::vector<std::string> Keys() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<std::string> ks;
+    for (auto& kv : flags_) ks.push_back(kv.first);
+    return ks;
+  }
+
+ private:
+  std::mutex mu_;
+  std::map<std::string, std::string> flags_;
+};
+
+// ---------------------------------------------------------------------------
+// Monitor: named int64 counters (STAT_* registry of platform/monitor.cc)
+// ---------------------------------------------------------------------------
+class Monitor {
+ public:
+  static Monitor& Get() {
+    static Monitor m;
+    return m;
+  }
+  void Add(const std::string& k, int64_t v) {
+    std::lock_guard<std::mutex> g(mu_);
+    stats_[k] += v;
+  }
+  int64_t Value(const std::string& k) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = stats_.find(k);
+    return it == stats_.end() ? 0 : it->second;
+  }
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<std::string, int64_t> stats_;
+};
+
+// ---------------------------------------------------------------------------
+// Trace recorder: lock-striped per-thread event buffers, chrome-trace JSON
+// ---------------------------------------------------------------------------
+struct TraceEvent {
+  std::string name;
+  uint64_t ts_ns;   // start, steady clock
+  uint64_t dur_ns;  // 0 for instant events
+  uint32_t tid;
+};
+
+class Tracer {
+ public:
+  static Tracer& Get() {
+    static Tracer t;
+    return t;
+  }
+
+  void Enable() { enabled_.store(true, std::memory_order_relaxed); }
+  void Disable() { enabled_.store(false, std::memory_order_relaxed); }
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+
+  static uint64_t NowNs() {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  void Record(const char* name, uint64_t start_ns, uint64_t dur_ns) {
+    if (!enabled()) return;
+    static std::atomic<uint32_t> next_tid{0};
+    static thread_local uint32_t tid = next_tid.fetch_add(1);
+    std::lock_guard<std::mutex> g(mu_);
+    events_.push_back({name, start_ns, dur_ns, tid});
+  }
+
+  // Chrome trace-event JSON ("traceEvents" array, microsecond units).
+  std::string ExportJson() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string out = "{\"traceEvents\":[";
+    bool first = true;
+    for (auto& e : events_) {
+      if (!first) out += ",";
+      first = false;
+      char buf[256];
+      snprintf(buf, sizeof(buf),
+               "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,\"tid\":%u,"
+               "\"ts\":%.3f,\"dur\":%.3f}",
+               e.name.c_str(), e.tid, e.ts_ns / 1e3, e.dur_ns / 1e3);
+      out += buf;
+    }
+    out += "]}";
+    return out;
+  }
+
+  void Clear() {
+    std::lock_guard<std::mutex> g(mu_);
+    events_.clear();
+  }
+
+  size_t size() {
+    std::lock_guard<std::mutex> g(mu_);
+    return events_.size();
+  }
+
+ private:
+  std::atomic<bool> enabled_{false};
+  std::mutex mu_;
+  std::vector<TraceEvent> events_;
+};
+
+}  // namespace ptrt
+
+extern "C" {
+
+// ---- error ----
+int ptrt_last_error_code() { return ptrt::last_error().code; }
+const char* ptrt_last_error_message() {
+  return ptrt::last_error().message.c_str();
+}
+
+// ---- flags ----
+void ptrt_flag_set(const char* key, const char* value) {
+  ptrt::Flags::Get().Set(key, value);
+}
+// Returns 1 and copies into buf if present, else 0.
+int ptrt_flag_get(const char* key, char* buf, size_t buflen) {
+  std::string v;
+  if (!ptrt::Flags::Get().GetValue(key, &v)) return 0;
+  snprintf(buf, buflen, "%s", v.c_str());
+  return 1;
+}
+
+// ---- monitor ----
+void ptrt_stat_add(const char* key, int64_t v) {
+  ptrt::Monitor::Get().Add(key, v);
+}
+int64_t ptrt_stat_value(const char* key) {
+  return ptrt::Monitor::Get().Value(key);
+}
+
+// ---- tracer ----
+void ptrt_tracer_enable() { ptrt::Tracer::Get().Enable(); }
+void ptrt_tracer_disable() { ptrt::Tracer::Get().Disable(); }
+uint64_t ptrt_now_ns() { return ptrt::Tracer::NowNs(); }
+void ptrt_trace_record(const char* name, uint64_t start_ns, uint64_t dur_ns) {
+  ptrt::Tracer::Get().Record(name, start_ns, dur_ns);
+}
+size_t ptrt_trace_count() { return ptrt::Tracer::Get().size(); }
+void ptrt_trace_clear() { ptrt::Tracer::Get().Clear(); }
+// Caller provides a buffer; returns needed size (call twice: probe + fill).
+size_t ptrt_trace_export(char* buf, size_t buflen) {
+  std::string j = ptrt::Tracer::Get().ExportJson();
+  if (buf != nullptr && buflen > 0) {
+    size_t n = std::min(buflen - 1, j.size());
+    memcpy(buf, j.data(), n);
+    buf[n] = 0;
+  }
+  return j.size() + 1;
+}
+
+}  // extern "C"

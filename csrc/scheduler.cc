@@ -1,0 +1,170 @@
+// Dependency-counted DAG scheduler on a worker thread pool.
+//
+// TPU-native counterpart of the reference's SSA-graph executors
+// (FastThreadedSSAGraphExecutor, framework/details/fast_threaded_ssa_graph_executor.h:32):
+// nodes whose dependency count reaches zero are pushed to a shared queue and
+// executed by a pool of workers; used by the Python side to drive host-side
+// pipelines (data loading, checkpoint sharding, multi-executable dispatch)
+// where XLA itself does not schedule.  Node bodies are C callbacks (ctypes
+// trampolines from Python, or native functions).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+#include "enforce.h"
+
+namespace ptrt {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n) : stop_(false) {
+    if (n <= 0) n = std::max(1u, std::thread::hardware_concurrency());
+    for (int i = 0; i < n; ++i) {
+      workers_.emplace_back([this] { Loop(); });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  void Submit(std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      q_.push(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+  int size() const { return static_cast<int>(workers_.size()); }
+
+ private:
+  void Loop() {
+    for (;;) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stop_ || !q_.empty(); });
+        if (stop_ && q_.empty()) return;
+        fn = std::move(q_.front());
+        q_.pop();
+      }
+      fn();
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::queue<std::function<void()>> q_;
+  std::vector<std::thread> workers_;
+  bool stop_;
+};
+
+using NodeFn = void (*)(void* user_data);
+
+struct Node {
+  NodeFn fn = nullptr;
+  void* user_data = nullptr;
+  std::vector<int> outs;           // nodes depending on this one
+  std::atomic<int> pending_deps{0};
+  int n_deps = 0;
+};
+
+// A graph is built once and can be run many times (dependency counts reset
+// each run) — mirroring the reference executor's prepared-graph reuse.
+class Graph {
+ public:
+  int AddNode(NodeFn fn, void* user_data) {
+    nodes_.emplace_back(new Node);
+    nodes_.back()->fn = fn;
+    nodes_.back()->user_data = user_data;
+    return static_cast<int>(nodes_.size()) - 1;
+  }
+
+  void AddEdge(int from, int to) {
+    PTRT_ENFORCE(from >= 0 && from < (int)nodes_.size() && to >= 0 &&
+                     to < (int)nodes_.size(),
+                 kInvalidArgument, "edge (%d,%d) out of range", from, to);
+    nodes_[from]->outs.push_back(to);
+    nodes_[to]->n_deps++;
+  }
+
+  void Run(ThreadPool* pool) {
+    std::atomic<int> remaining(static_cast<int>(nodes_.size()));
+    std::mutex done_mu;
+    std::condition_variable done_cv;
+
+    for (auto& n : nodes_)
+      n->pending_deps.store(n->n_deps, std::memory_order_relaxed);
+
+    std::function<void(int)> run_node = [&](int id) {
+      Node* n = nodes_[id].get();
+      if (n->fn != nullptr) n->fn(n->user_data);
+      for (int out : n->outs) {
+        if (nodes_[out]->pending_deps.fetch_sub(1) == 1) {
+          pool->Submit([&run_node, out] { run_node(out); });
+        }
+      }
+      {
+        // decrement under the mutex: the waiter owns done_mu whenever it
+        // checks `remaining`, so it cannot observe 0 and destroy these
+        // stack-locals before this worker has released the lock
+        std::lock_guard<std::mutex> g(done_mu);
+        if (remaining.fetch_sub(1) == 1) done_cv.notify_all();
+      }
+    };
+
+    for (size_t i = 0; i < nodes_.size(); ++i) {
+      if (nodes_[i]->n_deps == 0) {
+        int id = static_cast<int>(i);
+        pool->Submit([&run_node, id] { run_node(id); });
+      }
+    }
+    std::unique_lock<std::mutex> lk(done_mu);
+    done_cv.wait(lk, [&] { return remaining.load() == 0; });
+  }
+
+  size_t size() const { return nodes_.size(); }
+
+ private:
+  std::vector<std::unique_ptr<Node>> nodes_;
+};
+
+}  // namespace ptrt
+
+extern "C" {
+
+void* ptrt_pool_create(int n_threads) { return new ptrt::ThreadPool(n_threads); }
+void ptrt_pool_destroy(void* pool) { delete static_cast<ptrt::ThreadPool*>(pool); }
+int ptrt_pool_size(void* pool) { return static_cast<ptrt::ThreadPool*>(pool)->size(); }
+
+void* ptrt_graph_create() { return new ptrt::Graph(); }
+void ptrt_graph_destroy(void* g) { delete static_cast<ptrt::Graph*>(g); }
+
+int ptrt_graph_add_node(void* g, void (*fn)(void*), void* user_data) {
+  return static_cast<ptrt::Graph*>(g)->AddNode(fn, user_data);
+}
+
+int ptrt_graph_add_edge(void* g, int from, int to) {
+  PTRT_C_API_BEGIN
+  static_cast<ptrt::Graph*>(g)->AddEdge(from, to);
+  PTRT_C_API_END
+}
+
+int ptrt_graph_run(void* g, void* pool) {
+  PTRT_C_API_BEGIN
+  static_cast<ptrt::Graph*>(g)->Run(static_cast<ptrt::ThreadPool*>(pool));
+  PTRT_C_API_END
+}
+
+}  // extern "C"

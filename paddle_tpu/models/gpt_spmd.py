@@ -91,9 +91,6 @@ def _layernorm(x, w, b, eps=1e-5):
 def _block(x, p, li, num_heads_local, compute_dtype):
     """One transformer block on local shards. x: [b, s_local, H]."""
     b, s, H = x.shape
-    d = H // (num_heads_local * int(lax.axis_size("mp")))
-    hd = x.shape[-1]  # H
-
     y = _layernorm(x, p["ln1_w"][li], p["ln1_b"][li])
     qkv = (y.astype(compute_dtype) @ p["w_qkv"][li].astype(compute_dtype)
            ) + p["b_qkv"][li].astype(compute_dtype)
