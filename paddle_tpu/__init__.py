@@ -31,6 +31,13 @@ from . import metric
 from . import vision
 from . import distributed
 from . import distribution
+import importlib as _importlib
+
+# `from .ops import *` above leaks `ops.linalg` under the name `linalg`;
+# rebind to the public namespace module (paddle_tpu/linalg.py) explicitly.
+linalg = _importlib.import_module(".linalg", __name__)
+from . import regularizer
+from .framework.param_attr import ParamAttr
 from .framework.io import load, save
 from .hapi.model import Model
 from . import hapi

@@ -57,11 +57,14 @@ def _load():
     global _lib
     with _lib_lock:
         if _lib is not None:
-            return _lib
+            # False = a previous attempt failed; don't re-run cmake/ninja on
+            # every facade call.
+            return None if _lib is False else _lib
         path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
         if path is None:
             path = _try_build()
         if path is None:
+            _lib = False
             return None
         lib = ctypes.CDLL(path)
         # ---- signatures ----
@@ -230,17 +233,22 @@ class PrefetchQueue:
         self._lib = lib
         self._arena = arena or Arena(16 << 20)
         self._producer = producer
+        self._error = None  # first producer exception, re-raised in pop()
+        self._outstanding = set()  # arena ptrs handed to the queue, not yet popped
 
         def _produce(index, out_data, out_size, _ud):
             try:
                 payload = producer(index)
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — surfaced via pop()
+                if self._error is None:
+                    self._error = e
                 return 1
             if payload is None:
                 return 1
             buf = bytes(payload)
             ptr = self._arena.alloc(len(buf))
             ctypes.memmove(ptr, buf, len(buf))
+            self._outstanding.add(ptr)
             out_data[0] = ptr
             out_size[0] = len(buf)
             return 0
@@ -258,16 +266,25 @@ class PrefetchQueue:
                                          ctypes.byref(size),
                                          ctypes.byref(index))
         if not ok:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
             return None
         out = ctypes.string_at(data.value, size.value)
+        self._outstanding.discard(data.value)
         self._arena.free(data.value)
         return out
 
     def close(self):
         if getattr(self, "_h", None):
+            # shutdown joins workers, so no producer callback is running
+            # after it returns; safe to release batches never popped.
             self._lib.ptrt_prefetch_shutdown(self._h)
             self._lib.ptrt_prefetch_destroy(self._h)
             self._h = None
+            for ptr in self._outstanding:
+                self._arena.free(ptr)
+            self._outstanding.clear()
 
     def __del__(self):
         try:

@@ -32,8 +32,10 @@ class Tensor:
     # let Tensor win against np arrays in binary ops
     __array_priority__ = 100
 
+    # 'regularizer' lives here (not on Parameter) so plain tensors promoted
+    # to trainable leaves can carry one too; Parameter must not redeclare it.
     __slots__ = ("_array", "stop_gradient", "grad", "name", "trainable",
-                 "persistable", "_uid", "__weakref__")
+                 "persistable", "regularizer", "_uid", "__weakref__")
 
     def __init__(self, data, dtype=None, stop_gradient=True, name=None):
         self._uid = next(_UID)

@@ -1,0 +1,38 @@
+"""ParamAttr — per-parameter creation attributes.
+
+Reference: `python/paddle/fluid/param_attr.py` (ParamAttr, WeightNormParamAttr):
+name, initializer, learning_rate multiplier, regularizer, trainable flag,
+do_model_average.  Consumed by `Layer.create_parameter`
+(`fluid/dygraph/layers.py`).
+"""
+from __future__ import annotations
+
+__all__ = ["ParamAttr"]
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = float(learning_rate)
+        self.regularizer = regularizer
+        self.trainable = bool(trainable)
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(arg):
+        """Normalize user input (None | str | bool | initializer | ParamAttr)
+        to a ParamAttr, mirroring reference `ParamAttr._to_attr`."""
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if arg is False:
+            return False  # means "no parameter" (e.g. bias_attr=False)
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        # assume an initializer object
+        return ParamAttr(initializer=arg)

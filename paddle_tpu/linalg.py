@@ -1,0 +1,19 @@
+"""`paddle.linalg` namespace.
+
+Reference: `python/paddle/linalg.py` re-exports the linear-algebra subset of
+the tensor API (cholesky, inv, norm, ...).  All implementations live in
+`paddle_tpu/ops/linalg.py` and lower to XLA linalg HLOs.
+"""
+from .ops.linalg import (cholesky, cholesky_solve, cond, det, dist, eig,
+                         eigh, eigvalsh, inverse, lstsq, matrix_power,
+                         matrix_rank, multi_dot, norm, p_norm, pinv, qr,
+                         slogdet, solve, svd, triangular_solve)
+
+inv = inverse
+
+__all__ = [
+    "cholesky", "cholesky_solve", "cond", "det", "dist", "eig", "eigh",
+    "eigvalsh", "inv", "inverse", "lstsq", "matrix_power", "matrix_rank",
+    "multi_dot", "norm", "p_norm", "pinv", "qr", "slogdet", "solve", "svd",
+    "triangular_solve",
+]

@@ -29,7 +29,7 @@ class Parameter(Tensor):
     tensor-parallel optimizers.
     """
 
-    __slots__ = ("optimize_attr", "regularizer", "is_bias", "mesh_axes")
+    __slots__ = ("optimize_attr", "is_bias", "mesh_axes")
 
     def __init__(self, data, dtype=None, name=None, is_bias=False):
         super().__init__(data, dtype=dtype, stop_gradient=False, name=name)
@@ -135,14 +135,25 @@ class Layer:
                 default_initializer = init.Constant(0.0)
             else:
                 default_initializer = init.XavierUniform()
-        # ParamAttr-like dict/attr support
-        initializer = default_initializer
-        name = None
-        if attr is not None:
-            initializer = getattr(attr, "initializer", None) or initializer
-            name = getattr(attr, "name", None)
+        # ParamAttr support (reference fluid/param_attr.py; str/initializer/
+        # ParamAttr all accepted, False handled by callers as "no param")
+        from ...framework.param_attr import ParamAttr
+
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            raise ValueError("attr=False means no parameter; caller must "
+                             "handle it before create_parameter")
+        initializer = attr.initializer or default_initializer
         arr = initializer._init(shape, dtype)
-        return Parameter(arr, dtype=dtype, name=name, is_bias=is_bias)
+        p = Parameter(arr, dtype=dtype, name=attr.name, is_bias=is_bias)
+        if attr.regularizer is not None:
+            p.regularizer = attr.regularizer
+        if not attr.trainable:
+            p.stop_gradient = True
+            p.trainable = False
+        if attr.learning_rate != 1.0:
+            p.optimize_attr["learning_rate"] = attr.learning_rate
+        return p
 
     def add_parameter(self, name, parameter):
         self._parameters[name] = parameter

@@ -155,3 +155,32 @@ def bilinear_tensor_product(x, y, weight, bias=None):
 
 def histogramdd(*args, **kwargs):
     raise NotImplementedError
+
+
+def cond(x, p=None, name=None):
+    """Condition number.  Reference: `python/paddle/tensor/linalg.py` cond."""
+    def f(a):
+        if p is None or p == 2 or p == "fro":
+            s = jnp.linalg.svd(a, compute_uv=False)
+            if p == "fro":
+                return jnp.sqrt(jnp.sum(s * s, axis=-1)) * jnp.sqrt(
+                    jnp.sum((1.0 / s) ** 2, axis=-1))
+            return s[..., 0] / s[..., -1]
+        if p == -2:
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return s[..., -1] / s[..., 0]
+        return jnp.linalg.norm(a, ord=p, axis=(-2, -1)) * jnp.linalg.norm(
+            jnp.linalg.inv(a), ord=p, axis=(-2, -1))
+
+    return dispatch(f, x)
+
+
+def multi_dot(x, name=None):
+    """Chained matmul; reference `python/paddle/tensor/linalg.py` multi_dot."""
+    def f(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = jnp.matmul(out, a)
+        return out
+
+    return dispatch(f, *x)

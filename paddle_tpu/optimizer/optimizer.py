@@ -68,9 +68,14 @@ class Optimizer:
     def _decoupled_weight_decay(self) -> bool:
         return False
 
-    def _apply_decay(self, p, g):
-        """L2 regularization folded into the gradient (reference
-        `regularizer.py` appends scaled param to grad)."""
+    def _apply_decay(self, p, g, param_obj=None):
+        """Regularization folded into the gradient (reference
+        `regularizer.py` appends scaled param to grad).  A per-parameter
+        regularizer on ParamAttr overrides the optimizer-level decay
+        (reference `optimizer.py` `_create_regularization_of_grad`)."""
+        reg = getattr(param_obj, "regularizer", None) if param_obj is not None else None
+        if reg is not None:
+            return g + reg(p)
         if self._weight_decay and not self._decoupled_weight_decay():
             return g + self._weight_decay * p
         return g
@@ -93,7 +98,8 @@ class Optimizer:
                 key = id(p)
                 if key not in self._state:
                     self._state[key] = self._init_slot(p._array)
-                garr = self._apply_decay(p._array, g._array.astype(p._array.dtype))
+                garr = self._apply_decay(p._array,
+                                         g._array.astype(p._array.dtype), p)
                 new_p, new_slot = self._update_param(
                     p._array, garr, self._state[key], lr, self._step_count
                 )

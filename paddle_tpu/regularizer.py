@@ -1,0 +1,40 @@
+"""Weight-decay regularizers.
+
+Reference: `python/paddle/fluid/regularizer.py:50,157` — `L1Decay`/`L2Decay`
+append a scaled penalty gradient to each parameter's gradient before the
+optimizer update.  TPU-native: the regularizer is a pure function
+``grad(p)`` folded into the (jit-compiled) optimizer update sweep instead of
+a separate appended op, so XLA fuses it with the update kernel.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __call__(self, param):
+        """Return the penalty gradient for `param` (a jax array)."""
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    """loss += coeff * sum(|p|); grad contribution = coeff * sign(p)."""
+
+    def __call__(self, param):
+        return self._coeff * jnp.sign(param)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """loss += 0.5 * coeff * sum(p^2); grad contribution = coeff * p."""
+
+    def __call__(self, param):
+        return self._coeff * param

@@ -1,0 +1,92 @@
+"""ParamAttr / regularizer / paddle.linalg namespace tests.
+
+Mirrors reference tests: test_param_attr (fluid/param_attr.py),
+test_regularizer.py, python/paddle/tensor/linalg.py API tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, regularizer
+
+
+class TestParamAttr:
+    def test_to_attr_forms(self):
+        a = paddle.ParamAttr(name="w", learning_rate=0.5,
+                             regularizer=regularizer.L2Decay(1e-4),
+                             trainable=True)
+        assert a.name == "w" and a.learning_rate == 0.5
+        assert paddle.ParamAttr._to_attr(None).name is None
+        assert paddle.ParamAttr._to_attr("foo").name == "foo"
+        assert paddle.ParamAttr._to_attr(False) is False
+        assert paddle.ParamAttr._to_attr(a) is a
+
+    def test_linear_with_param_attr(self):
+        lin = nn.Linear(
+            4, 3,
+            weight_attr=paddle.ParamAttr(
+                name="fc_w", initializer=nn.initializer.Constant(0.5),
+                regularizer=regularizer.L2Decay(0.1)),
+            bias_attr=paddle.ParamAttr(initializer=nn.initializer.Constant(1.0)))
+        np.testing.assert_allclose(lin.weight.numpy(), 0.5)
+        np.testing.assert_allclose(lin.bias.numpy(), 1.0)
+        assert getattr(lin.weight, "regularizer", None) is not None
+
+    def test_non_trainable(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.w = self.create_parameter(
+                    [2, 2], attr=paddle.ParamAttr(trainable=False))
+
+        m = M()
+        assert m.w.stop_gradient
+
+
+class TestRegularizer:
+    def test_l2_decay_changes_update(self):
+        # Two identical params; one carries an L2 regularizer -> larger step.
+        x = np.ones((3, 3), np.float32)
+        p1 = paddle.to_tensor(x)
+        p1.stop_gradient = False
+        p1.trainable = True
+        p2 = paddle.to_tensor(x)
+        p2.stop_gradient = False
+        p2.trainable = True
+        p2.regularizer = regularizer.L2Decay(10.0)
+        from paddle_tpu.optimizer import SGD
+
+        for p in (p1, p2):
+            opt = SGD(learning_rate=0.1, parameters=[p])
+            p.grad = paddle.to_tensor(np.zeros((3, 3), np.float32))
+            opt.step()
+        np.testing.assert_allclose(p1.numpy(), 1.0)
+        np.testing.assert_allclose(p2.numpy(), 1.0 - 0.1 * 10.0, rtol=1e-6)
+
+    def test_l1_decay_sign(self):
+        g = regularizer.L1Decay(0.5)(np.array([-2.0, 0.0, 3.0], np.float32))
+        np.testing.assert_allclose(np.asarray(g), [-0.5, 0.0, 0.5])
+
+
+class TestLinalgNamespace:
+    def test_api_surface(self):
+        for name in ("cholesky", "cond", "det", "eig", "eigh", "inv",
+                     "lstsq", "matrix_power", "matrix_rank", "multi_dot",
+                     "norm", "pinv", "qr", "slogdet", "solve", "svd",
+                     "triangular_solve"):
+            assert hasattr(paddle.linalg, name), name
+
+    def test_values(self):
+        a = np.array([[4.0, 1.0], [1.0, 3.0]], np.float32)
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(
+            paddle.linalg.inv(x).numpy(), np.linalg.inv(a), atol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.det(x).numpy(), np.linalg.det(a), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.cond(x).numpy(), np.linalg.cond(a), rtol=1e-4)
+        chain = [paddle.to_tensor(np.random.RandomState(i).rand(3, 3)
+                                  .astype(np.float32)) for i in range(3)]
+        ref = chain[0].numpy() @ chain[1].numpy() @ chain[2].numpy()
+        np.testing.assert_allclose(
+            paddle.linalg.multi_dot(chain).numpy(), ref, rtol=1e-4)
