@@ -342,6 +342,12 @@ def trace_record(name: str, start_ns: int, dur_ns: int):
         lib.ptrt_trace_record(name.encode(), start_ns, dur_ns)
 
 
+def trace_clear():
+    lib = _load()
+    if lib is not None:
+        lib.ptrt_trace_clear()
+
+
 def now_ns() -> int:
     lib = _load()
     if lib is None:

@@ -86,7 +86,9 @@ def default_tape() -> Tape:
 
 def backward(tensors, grad_tensors=None, retain_graph=False):
     """Run reverse-mode over the recorded tape from `tensors` roots."""
+    import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from .tensor import Tensor
 
@@ -115,6 +117,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             continue
         full = []
         for c, proto in zip(out_cots, node._out_protos):
+            if not jnp.issubdtype(proto[1], jnp.inexact):
+                # integer/bool outputs (e.g. valid counts, argmax indices)
+                # take float0 cotangents per jax.vjp's contract
+                full.append(np.zeros(proto[0], jax.dtypes.float0))
+                continue
             c = c if c is not None else jnp.zeros(proto[0], proto[1])
             if hasattr(c, "dtype") and c.dtype != proto[1]:
                 c = c.astype(proto[1])

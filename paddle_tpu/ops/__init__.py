@@ -19,8 +19,10 @@ from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 
-from . import creation, linalg, logic, manipulation, math, random, search, stat
+from . import (creation, linalg, logic, manipulation, math, random, search,
+               sequence, stat)
 
 
 def _attach_methods():

@@ -1,0 +1,182 @@
+"""Sequence operators — the LoD (ragged) family, TPU-native.
+
+Reference: `paddle/fluid/operators/sequence_ops/` (6.2 k LoC of LoD-walking
+CPU/CUDA kernels) and the LoDTensor ragged representation
+(`framework/lod_tensor.h:109`).
+
+TPU-native re-design: LoD offsets do not compile on a static-shape compiler,
+so the canonical ragged representation here is **(padded dense tensor,
+lengths vector)** — exactly what `sequence_pad`/`sequence_unpad` convert
+to/from in the reference.  Every op takes either a padded [B, T, ...] batch
+with a [B] lengths tensor, which jits cleanly and vectorizes on the VPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, unwrap
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
+    "sequence_reverse", "sequence_softmax", "sequence_expand",
+    "sequence_first_step", "sequence_last_step",
+]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths [..] -> mask [.., maxlen].
+    Reference: `operators/sequence_ops/sequence_mask_op.*`."""
+    import numpy as np
+
+    from ..core import dtype as dtype_mod
+
+    lengths = unwrap(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(jax.device_get(lengths)).max())
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def f(ln):
+        rng = jnp.arange(maxlen)
+        return (rng[None, :] < ln.reshape(-1, 1)).reshape(
+            tuple(ln.shape) + (maxlen,)).astype(dt)
+
+    return dispatch(f, x, nondiff=(0,))
+
+
+def sequence_pad(x, lengths, pad_value=0.0, maxlen=None, name=None):
+    """List of variable-length rows packed as [sum(L), ...] + lengths ->
+    padded [B, maxlen, ...].  Reference: `sequence_pad_op.*` (input LoD ->
+    padded + Length output)."""
+    ln = unwrap(lengths)
+    b = int(ln.shape[0])
+    flat = unwrap(x)
+    if maxlen is None:
+        import numpy as np
+
+        maxlen = int(np.asarray(jax.device_get(ln)).max())
+
+    def f(xv, lv):
+        starts = jnp.concatenate([jnp.zeros((1,), lv.dtype),
+                                  jnp.cumsum(lv)[:-1]])
+        idx = starts[:, None] + jnp.arange(maxlen)[None, :]  # [B, T]
+        valid = jnp.arange(maxlen)[None, :] < lv[:, None]
+        gathered = xv[jnp.clip(idx, 0, xv.shape[0] - 1)]
+        mask = valid.reshape(valid.shape + (1,) * (xv.ndim - 1))
+        return jnp.where(mask, gathered, pad_value)
+
+    return dispatch(f, x, lengths, nondiff=(1,)), Tensor(ln)
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded [B, T, ...] + lengths -> packed [sum(L), ...].
+    Reference: `sequence_unpad_op.*` (with `sequence_unpad_grad`).  Output
+    length is data-dependent, so lengths sync to host (eager flush point,
+    like the reference's LoD re-pack), but the gather itself goes through
+    dispatch so gradients flow back to `x`."""
+    import numpy as np
+
+    xv = unwrap(x)
+    ln = np.asarray(jax.device_get(unwrap(length)), np.int64)
+    t = xv.shape[1]
+    flat_idx = np.concatenate(
+        [i * t + np.arange(int(ln[i])) for i in range(xv.shape[0])]
+    ).astype(np.int32) if len(ln) else np.zeros((0,), np.int32)
+
+    def f(arr):
+        return arr.reshape((-1,) + arr.shape[2:])[jnp.asarray(flat_idx)]
+
+    return dispatch(f, x)
+
+
+def sequence_pool(x, lengths, pool_type="sum", name=None):
+    """Padded [B, T, ...] + lengths -> [B, ...] pooled over valid steps.
+    Reference: `sequence_pool_op.*` / `operators/math/sequence_pooling.*`
+    (SUM/MEAN/MAX/SQRT/LAST/FIRST)."""
+    pt = pool_type.lower()
+
+    def f(xv, lv):
+        t = xv.shape[1]
+        mask = (jnp.arange(t)[None, :] < lv[:, None])
+        m = mask.reshape(mask.shape + (1,) * (xv.ndim - 2)).astype(xv.dtype)
+        if pt == "sum":
+            return (xv * m).sum(axis=1)
+        if pt == "average" or pt == "mean":
+            return (xv * m).sum(axis=1) / jnp.maximum(
+                lv.astype(xv.dtype), 1).reshape((-1,) + (1,) * (xv.ndim - 2))
+        if pt == "sqrt":
+            return (xv * m).sum(axis=1) / jnp.sqrt(jnp.maximum(
+                lv.astype(xv.dtype), 1)).reshape((-1,) + (1,) * (xv.ndim - 2))
+        if pt == "max":
+            neg = jnp.where(m > 0, xv, jnp.finfo(xv.dtype).min)
+            res = neg.max(axis=1)
+            # zero-length sequences pool to 0, matching pad semantics
+            nz = (lv > 0).reshape((-1,) + (1,) * (xv.ndim - 2))
+            return jnp.where(nz, res, jnp.zeros_like(res))
+        if pt == "last":
+            idx = jnp.maximum(lv - 1, 0)
+            return jnp.take_along_axis(
+                xv, idx.reshape((-1, 1) + (1,) * (xv.ndim - 2)), axis=1
+            ).squeeze(1)
+        if pt == "first":
+            return xv[:, 0]
+        raise ValueError(f"unknown pool_type {pool_type}")
+
+    return dispatch(f, x, lengths, nondiff=(1,))
+
+
+def sequence_first_step(x, lengths, name=None):
+    return sequence_pool(x, lengths, "first")
+
+
+def sequence_last_step(x, lengths, name=None):
+    return sequence_pool(x, lengths, "last")
+
+
+def sequence_reverse(x, lengths, name=None):
+    """Reverse each sequence within its valid length (padding stays put).
+    Reference: `sequence_reverse_op.*`."""
+    def f(xv, lv):
+        t = xv.shape[1]
+        rng = jnp.arange(t)[None, :]
+        rev = lv[:, None] - 1 - rng
+        idx = jnp.where(rng < lv[:, None], rev, rng).astype(jnp.int32)
+        idx = idx.reshape(idx.shape + (1,) * (xv.ndim - 2))
+        return jnp.take_along_axis(xv, idx, axis=1)
+
+    return dispatch(f, x, lengths, nondiff=(1,))
+
+
+def sequence_softmax(x, lengths, name=None):
+    """Masked softmax over the time axis.
+    Reference: `sequence_softmax_op.*` (per-sequence softmax over LoD rows)."""
+    def f(xv, lv):
+        t = xv.shape[1]
+        mask = jnp.arange(t)[None, :] < lv[:, None]
+        mask = mask.reshape(mask.shape + (1,) * (xv.ndim - 2))
+        # finite mask value: softmax over an all -inf row yields NaN, which
+        # 0*NaN would leak through the where in the backward pass
+        z = jnp.where(mask, xv, jnp.finfo(xv.dtype).min)
+        out = jax.nn.softmax(z, axis=1)
+        return jnp.where(mask, out, 0.0)
+
+    return dispatch(f, x, lengths, nondiff=(1,))
+
+
+def sequence_expand(x, repeat_times, name=None):
+    """Repeat each row i of x `repeat_times[i]` times (static repeats).
+    Reference: `sequence_expand_op.*` expands rows per the target LoD; the
+    TPU form takes explicit per-row repeat counts (host-known, so shapes
+    stay static)."""
+    import numpy as np
+
+    reps = np.asarray(jax.device_get(unwrap(repeat_times)), np.int64) \
+        if not isinstance(repeat_times, (list, tuple)) else \
+        np.asarray(repeat_times, np.int64)
+
+    def f(xv):
+        return jnp.repeat(xv, jnp.asarray(reps), axis=0,
+                          total_repeat_length=int(reps.sum()))
+
+    return dispatch(f, x)

@@ -1,0 +1,148 @@
+"""Profiler surface.
+
+Reference: `paddle/fluid/platform/profiler.{h,cc}` (`EnableProfiler`,
+`DisableProfiler`, RAII `RecordEvent`, aggregated event tables, chrome-trace
+timeline via `profiler.proto`) + `platform/device_tracer.cc` (CUPTI device
+activity) + Python context managers `python/paddle/fluid/profiler.py`.
+
+TPU-native split:
+- **Host events** go through the native C++ tracer (csrc/runtime.cc Tracer:
+  lock-free-ish append buffer, chrome-trace JSON export) via RecordEvent.
+- **Device timeline** is XLA/PJRT's own tracing (TraceMe/xplane): wrapped by
+  `start_trace`/`stop_trace` below (`jax.profiler`), viewable in
+  TensorBoard/XProf — the moral replacement for the CUPTI DeviceTracer.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from collections import defaultdict
+
+from .core import native
+from .core.native import RecordEvent, now_ns  # re-export  # noqa: F401
+
+__all__ = [
+    "RecordEvent", "profiler", "start_profiler", "stop_profiler",
+    "reset_profiler", "start_trace", "stop_trace", "trace",
+    "summary_string", "export_chrome_tracing",
+]
+
+_state = {"device": False}
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    """Begin host event collection (reference `EnableProfiler`,
+    `fluid/profiler.py start_profiler`).  `state`/`tracer_option` are
+    accepted for API compatibility; device-side tracing is a separate
+    concern on TPU — use `start_trace`/`trace` for the XLA timeline."""
+    native.trace_clear()
+    native.tracer_enable()
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    """Stop collection; print the aggregated table; optionally dump a
+    chrome-trace timeline json to `profile_path` (reference
+    `DisableProfiler` + timeline proto export)."""
+    native.tracer_disable()
+    text = summary_string(sorted_key=sorted_key)
+    print(text)
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    return text
+
+
+def reset_profiler():
+    native.trace_clear()
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None):
+    """Context-manager form (reference `fluid/profiler.py profiler`)."""
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+# ---------------------------------------------------------------------------
+# aggregation / export
+# ---------------------------------------------------------------------------
+def _events():
+    data = json.loads(native.trace_export_json())
+    return data.get("traceEvents", [])
+
+
+def summary_string(sorted_key="total") -> str:
+    """Aggregated per-event table: calls, total/avg/min/max ms, ratio —
+    the layout of the reference's `PrintProfiler` table."""
+    agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])  # n, tot, mn, mx
+    for ev in _events():
+        if ev.get("ph") != "X":
+            continue
+        dur_ms = ev.get("dur", 0) / 1000.0  # chrome trace dur is us
+        a = agg[ev.get("name", "?")]
+        a[0] += 1
+        a[1] += dur_ms
+        a[2] = min(a[2], dur_ms)
+        a[3] = max(a[3], dur_ms)
+    total = sum(a[1] for a in agg.values()) or 1.0
+    keyfn = {
+        "total": lambda kv: -kv[1][1],
+        "calls": lambda kv: -kv[1][0],
+        "max": lambda kv: -kv[1][3],
+        "min": lambda kv: kv[1][2],
+        "ave": lambda kv: -(kv[1][1] / max(kv[1][0], 1)),
+    }.get(sorted_key, lambda kv: -kv[1][1])
+    lines = [
+        "-------------------------     Profiling Report     "
+        "-------------------------",
+        f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+        f"{'Min(ms)':>10}{'Max(ms)':>10}{'Ratio':>8}",
+    ]
+    for name, (n, tot, mn, mx) in sorted(agg.items(), key=keyfn):
+        lines.append(
+            f"{name:<40}{n:>8}{tot:>12.4f}{tot / max(n, 1):>10.4f}"
+            f"{mn if n else 0:>10.4f}{mx:>10.4f}{tot / total:>8.2%}")
+    return "\n".join(lines)
+
+
+def export_chrome_tracing(path: str):
+    """Write the host timeline as chrome://tracing JSON (reference timeline
+    proto → `tools/timeline.py` equivalent, emitted directly)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(native.trace_export_json())
+
+
+# ---------------------------------------------------------------------------
+# device (XLA) tracing
+# ---------------------------------------------------------------------------
+def start_trace(log_dir: str):
+    """Start an XLA/PJRT device trace (xplane, TensorBoard-viewable) —
+    the TPU replacement for the reference's CUPTI DeviceTracer
+    (`platform/device_tracer.cc:57`)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    _state["device"] = True
+
+
+def stop_trace():
+    import jax
+
+    if _state["device"]:
+        jax.profiler.stop_trace()
+        _state["device"] = False
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()

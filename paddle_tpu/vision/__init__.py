@@ -1,2 +1,2 @@
-from . import datasets, models, transforms
+from . import datasets, models, ops, transforms
 from .models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152

@@ -1,0 +1,258 @@
+"""Detection + sequence op family tests (numpy references).
+
+Mirrors reference OpTest files: test_iou_similarity_op, test_box_coder_op,
+test_prior_box_op, test_yolo_box_op, test_roi_align_op,
+test_multiclass_nms_op, test_sequence_{mask,pad,pool,reverse,softmax}.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def np_iou(a, b):
+    out = np.zeros((a.shape[0], b.shape[0]), np.float32)
+    for i in range(a.shape[0]):
+        for j in range(b.shape[0]):
+            ix1 = max(a[i, 0], b[j, 0]); iy1 = max(a[i, 1], b[j, 1])
+            ix2 = min(a[i, 2], b[j, 2]); iy2 = min(a[i, 3], b[j, 3])
+            iw = max(ix2 - ix1, 0); ih = max(iy2 - iy1, 0)
+            inter = iw * ih
+            ua = ((a[i, 2] - a[i, 0]) * (a[i, 3] - a[i, 1]) +
+                  (b[j, 2] - b[j, 0]) * (b[j, 3] - b[j, 1]) - inter)
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+class TestIoUBoxOps:
+    def test_iou_similarity(self):
+        rng = np.random.RandomState(0)
+        a = np.sort(rng.rand(5, 4).astype(np.float32), axis=-1)[:, [0, 1, 3, 2]][:, [0, 1, 2, 3]]
+        # build valid boxes: x1<x2, y1<y2
+        a = np.stack([
+            rng.rand(5), rng.rand(5), rng.rand(5) + 1.0, rng.rand(5) + 1.0
+        ], axis=1).astype(np.float32)
+        b = np.stack([
+            rng.rand(7), rng.rand(7), rng.rand(7) + 1.0, rng.rand(7) + 1.0
+        ], axis=1).astype(np.float32)
+        got = vops.iou_similarity(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(got.numpy(), np_iou(a, b), atol=1e-5)
+
+    def test_box_clip(self):
+        boxes = np.array([[-1.0, -2.0, 10.0, 20.0]], np.float32)
+        im_info = np.array([8.0, 6.0, 1.0], np.float32)  # H, W, scale
+        got = vops.box_clip(paddle.to_tensor(boxes),
+                            paddle.to_tensor(im_info)).numpy()
+        np.testing.assert_allclose(got, [[0.0, 0.0, 5.0, 7.0]])
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(1)
+        priors = np.stack([
+            rng.rand(6), rng.rand(6), rng.rand(6) + 1.0, rng.rand(6) + 1.0
+        ], axis=1).astype(np.float32)
+        var = np.full((6, 4), 0.1, np.float32)
+        target = np.stack([
+            rng.rand(3), rng.rand(3), rng.rand(3) + 1.0, rng.rand(3) + 1.0
+        ], axis=1).astype(np.float32)
+        enc = vops.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                             paddle.to_tensor(target),
+                             code_type="encode_center_size")
+        dec = vops.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                             enc, code_type="decode_center_size")
+        # decoding the encoding of target against the same priors recovers it
+        got = dec.numpy()  # [M, N, 4]
+        for n in range(6):
+            np.testing.assert_allclose(got[:, n, :], target, atol=1e-4)
+
+    def test_prior_box(self):
+        x = paddle.zeros([1, 3, 4, 4])
+        img = paddle.zeros([1, 3, 32, 32])
+        boxes, variances = vops.prior_box(
+            x, img, min_sizes=[8.0], aspect_ratios=[2.0], flip=True,
+            clip=True)
+        assert boxes.shape == [4, 4, 3, 4]  # 1 + 2 aspect ratios
+        assert variances.shape == [4, 4, 3, 4]
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 1).all()
+        # center of cell (0,0) is at (0.5*8)/32 = 0.125
+        np.testing.assert_allclose((b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2,
+                                   0.125, atol=1e-5)
+
+
+class TestYoloRoi:
+    def test_yolo_box_shapes_and_range(self):
+        rng = np.random.RandomState(2)
+        n, na, c, h, w = 2, 2, 3, 4, 4
+        x = rng.randn(n, na * (5 + c), h, w).astype(np.float32)
+        img_size = np.array([[32, 32], [64, 48]], np.int32)
+        boxes, scores = vops.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img_size),
+            anchors=[10, 13, 16, 30], class_num=c, conf_thresh=0.0,
+            downsample_ratio=8)
+        assert boxes.shape == [n, na * h * w, 4]
+        assert scores.shape == [n, na * h * w, c]
+        s = scores.numpy()
+        assert (s >= 0).all() and (s <= 1).all()
+        b = boxes.numpy()
+        assert (b[0, :, [0, 2]] <= 31.0 + 1e-4).all()
+
+    def test_roi_align_constant(self):
+        # constant feature map -> every aligned output equals the constant
+        x = np.full((1, 2, 8, 8), 3.5, np.float32)
+        rois = np.array([[1.0, 1.0, 5.0, 5.0], [0.0, 0.0, 7.0, 7.0]],
+                        np.float32)
+        out = vops.roi_align(paddle.to_tensor(x), paddle.to_tensor(rois),
+                             paddle.to_tensor(np.array([2], np.int32)),
+                             output_size=2, spatial_scale=1.0)
+        assert out.shape == [2, 2, 2, 2]
+        np.testing.assert_allclose(out.numpy(), 3.5, atol=1e-5)
+
+    def test_roi_align_gradient(self):
+        x = paddle.to_tensor(np.random.RandomState(3).rand(1, 1, 6, 6)
+                             .astype(np.float32))
+        x.stop_gradient = False
+        rois = paddle.to_tensor(np.array([[0.5, 0.5, 4.5, 4.5]], np.float32))
+        out = vops.roi_align(x, rois,
+                             paddle.to_tensor(np.array([1], np.int32)),
+                             output_size=2)
+        out.sum().backward()
+        assert x.grad is not None
+        assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+
+class TestNMS:
+    def test_nms_basic(self):
+        boxes = np.array([
+            [0, 0, 10, 10],
+            [1, 1, 11, 11],   # overlaps box 0 heavily
+            [20, 20, 30, 30],
+        ], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = vops.nms(paddle.to_tensor(boxes), 0.5,
+                        scores=paddle.to_tensor(scores)).numpy()
+        np.testing.assert_array_equal(np.sort(keep), [0, 2])
+
+    def test_multiclass_nms_static_shape(self):
+        rng = np.random.RandomState(4)
+        n, m, c = 1, 10, 3
+        centers = rng.rand(m, 2) * 20
+        wh = rng.rand(m, 2) * 4 + 2
+        boxes = np.concatenate([centers - wh / 2, centers + wh / 2],
+                               axis=1).astype(np.float32)
+        bboxes = np.broadcast_to(boxes, (n, m, 4)).copy()
+        scores = rng.rand(n, c, m).astype(np.float32)
+        out, counts = vops.multiclass_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            score_threshold=0.3, nms_top_k=5, keep_top_k=8,
+            nms_threshold=0.4)
+        assert out.shape == [n, 8, 6]
+        cnt = int(counts.numpy()[0])
+        o = out.numpy()[0]
+        assert 0 < cnt <= 8
+        # valid rows have labels in range and descending scores
+        assert (o[:cnt, 0] >= 0).all() and (o[:cnt, 0] < c).all()
+        assert (np.diff(o[:cnt, 1]) <= 1e-6).all()
+        # padded rows are -1
+        assert (o[cnt:, 0] == -1).all()
+
+
+class TestSequenceOps:
+    def test_sequence_mask(self):
+        got = paddle.sequence_mask(
+            paddle.to_tensor(np.array([1, 3, 2], np.int32)), maxlen=4).numpy()
+        np.testing.assert_array_equal(
+            got, [[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+
+    def test_sequence_pad_unpad_roundtrip(self):
+        flat = np.arange(12, dtype=np.float32).reshape(6, 2)
+        lengths = np.array([2, 1, 3], np.int64)
+        padded, ln = paddle.sequence_pad(paddle.to_tensor(flat),
+                                         paddle.to_tensor(lengths),
+                                         pad_value=-1.0)
+        assert padded.shape == [3, 3, 2]
+        p = padded.numpy()
+        np.testing.assert_allclose(p[0, :2], flat[:2])
+        np.testing.assert_allclose(p[1, :1], flat[2:3])
+        np.testing.assert_allclose(p[2, :3], flat[3:6])
+        assert (p[0, 2:] == -1).all() and (p[1, 1:] == -1).all()
+        back = paddle.sequence_unpad(padded, paddle.to_tensor(lengths))
+        np.testing.assert_allclose(back.numpy(), flat)
+
+    def test_sequence_pool_modes(self):
+        x = np.array([[[1.0], [2.0], [5.0]],
+                      [[3.0], [9.0], [9.0]]], np.float32)
+        ln = np.array([3, 1], np.int64)
+        xt, lt = paddle.to_tensor(x), paddle.to_tensor(ln)
+        np.testing.assert_allclose(
+            paddle.sequence_pool(xt, lt, "sum").numpy(), [[8.0], [3.0]])
+        np.testing.assert_allclose(
+            paddle.sequence_pool(xt, lt, "mean").numpy(),
+            [[8.0 / 3], [3.0]], rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.sequence_pool(xt, lt, "max").numpy(), [[5.0], [3.0]])
+        np.testing.assert_allclose(
+            paddle.sequence_pool(xt, lt, "last").numpy(), [[5.0], [3.0]])
+        np.testing.assert_allclose(
+            paddle.sequence_pool(xt, lt, "first").numpy(), [[1.0], [3.0]])
+
+    def test_sequence_reverse(self):
+        x = np.arange(8, dtype=np.float32).reshape(2, 4, 1)
+        ln = np.array([3, 4], np.int64)
+        got = paddle.sequence_reverse(paddle.to_tensor(x),
+                                      paddle.to_tensor(ln)).numpy()
+        np.testing.assert_allclose(got[0, :, 0], [2, 1, 0, 3])
+        np.testing.assert_allclose(got[1, :, 0], [7, 6, 5, 4])
+
+    def test_sequence_softmax(self):
+        x = np.zeros((1, 4), np.float32)
+        ln = np.array([2], np.int64)
+        got = paddle.sequence_softmax(paddle.to_tensor(x),
+                                      paddle.to_tensor(ln)).numpy()
+        np.testing.assert_allclose(got, [[0.5, 0.5, 0.0, 0.0]], atol=1e-6)
+
+    def test_sequence_expand(self):
+        x = np.array([[1.0], [2.0]], np.float32)
+        got = paddle.sequence_expand(paddle.to_tensor(x), [2, 3]).numpy()
+        np.testing.assert_allclose(got[:, 0], [1, 1, 2, 2, 2])
+
+    def test_sequence_unpad_gradient(self):
+        x = paddle.to_tensor(np.ones((2, 3, 2), np.float32))
+        x.stop_gradient = False
+        ln = paddle.to_tensor(np.array([2, 3], np.int64))
+        out = paddle.sequence_unpad(x, ln)
+        assert out.shape == [5, 2]
+        out.sum().backward()
+        g = x.grad.numpy()
+        assert g[0, :2].sum() == 4 and g[0, 2].sum() == 0
+
+    def test_sequence_pool_zero_length(self):
+        x = np.ones((2, 3, 1), np.float32)
+        ln = np.array([0, 2], np.int64)
+        got = paddle.sequence_pool(paddle.to_tensor(x),
+                                   paddle.to_tensor(ln), "max").numpy()
+        assert np.isfinite(got).all() and got[0, 0] == 0.0
+
+    def test_multiclass_nms_backward(self):
+        rng = np.random.RandomState(5)
+        scores = paddle.to_tensor(rng.rand(1, 2, 6).astype(np.float32))
+        scores.stop_gradient = False
+        boxes = paddle.to_tensor(
+            np.concatenate([rng.rand(1, 6, 2) * 10,
+                            rng.rand(1, 6, 2) * 10 + 12], axis=2)
+            .astype(np.float32))
+        out, counts = vops.multiclass_nms(
+            boxes, scores, score_threshold=0.1, nms_top_k=4, keep_top_k=5,
+            nms_threshold=0.5)
+        assert out.shape == [1, 5, 6]
+        out.sum().backward()  # int outputs must not break the tape
+        assert scores.grad is not None
+
+    def test_sequence_pool_gradient(self):
+        x = paddle.to_tensor(np.ones((2, 3, 2), np.float32))
+        x.stop_gradient = False
+        ln = paddle.to_tensor(np.array([2, 3], np.int64))
+        paddle.sequence_pool(x, ln, "mean").sum().backward()
+        g = x.grad.numpy()
+        # padding positions get zero grad
+        assert g[0, 2].sum() == 0 and g[0, 0].sum() > 0

@@ -1,0 +1,60 @@
+"""Profiler surface tests (reference platform/profiler + fluid/profiler.py)."""
+import json
+import os
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.core import native
+
+
+needs_native = pytest.mark.skipif(not native.native_available(),
+                                  reason="native runtime unavailable")
+
+
+@needs_native
+class TestProfiler:
+    def test_record_and_summary(self):
+        profiler.start_profiler()
+        with profiler.RecordEvent("matmul_step"):
+            time.sleep(0.002)
+        with profiler.RecordEvent("matmul_step"):
+            time.sleep(0.001)
+        with profiler.RecordEvent("io"):
+            time.sleep(0.001)
+        native.tracer_disable()
+        text = profiler.summary_string(sorted_key="total")
+        assert "matmul_step" in text and "io" in text
+        assert "Calls" in text
+        # matmul_step called twice
+        line = next(l for l in text.splitlines() if l.startswith("matmul_step"))
+        assert "2" in line.split()[1]
+        profiler.reset_profiler()
+
+    def test_chrome_trace_export(self, tmp_path):
+        profiler.start_profiler()
+        with profiler.RecordEvent("evt"):
+            time.sleep(0.001)
+        path = str(tmp_path / "timeline.json")
+        profiler.stop_profiler(profile_path=path)
+        data = json.loads(open(path).read())
+        evts = [e for e in data["traceEvents"] if e.get("name") == "evt"]
+        assert evts and evts[0]["ph"] == "X" and evts[0]["dur"] > 0
+        profiler.reset_profiler()
+
+    def test_context_manager(self, capsys):
+        with profiler.profiler():
+            with profiler.RecordEvent("inside"):
+                pass
+        out = capsys.readouterr().out
+        assert "Profiling Report" in out
+        profiler.reset_profiler()
+
+    def test_disabled_records_nothing(self):
+        profiler.reset_profiler()
+        native.tracer_disable()
+        with profiler.RecordEvent("ghost"):
+            pass
+        assert "ghost" not in profiler.summary_string()
