@@ -1,6 +1,6 @@
 """fleet — distributed training API (reference
 `python/paddle/distributed/fleet/`)."""
-from . import meta_parallel, utils
+from . import meta_optimizers, meta_parallel, utils
 from .base import Fleet, PaddleCloudRoleMaker, RoleMakerBase, fleet
 from .data_parallel import DataParallel
 from .sharded_step import ShardedTrainStep

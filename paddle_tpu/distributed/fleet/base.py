@@ -110,6 +110,12 @@ class Fleet:
     def distributed_optimizer(self, optimizer, strategy=None):
         if strategy is not None:
             self._strategy = strategy
+        st = self._strategy or DistributedStrategy()
+        from .meta_optimizers import StrategyCompiler
+
+        optimizer, applied = StrategyCompiler().generate_optimizer(
+            optimizer, st)
+        self._applied_meta_optimizers = applied
         self._user_optimizer = optimizer
         return optimizer
 

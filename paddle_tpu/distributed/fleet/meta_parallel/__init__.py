@@ -1,5 +1,6 @@
 from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                         RowParallelLinear, TensorParallel,
                         VocabParallelEmbedding)
+from .moe_layer import MoELayer, moe_forward, moe_gating
 from .pipeline_parallel import (LayerDesc, PipelineLayer, PipelineParallel,
                                 SegmentLayers, SharedLayerDesc)

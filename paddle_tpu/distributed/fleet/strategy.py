@@ -38,7 +38,12 @@ class DistributedStrategy:
         self.lamb = False
         self.lars = False
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 4}
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.999]}
+        self.fp16_allreduce = False
+        self.lookahead = False
+        self.lookahead_configs = {"alpha": 0.5, "k": 5}
         self.a_sync = False
         self.a_sync_configs = {"k_steps": -1}
         self.heter_ccl_mode = False

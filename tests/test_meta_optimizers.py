@@ -1,0 +1,154 @@
+"""Fleet meta-optimizer tests.
+
+Reference tests: test_fleet_gradient_merge_meta_optimizer.py,
+test_fleet_dgc_meta_optimizer.py, test_fleet_localsgd_meta_optimizer.py,
+test_fleet_fp16_allreduce_meta_optimizer.py, test_lookahead.py,
+test_ema.py, test_fleet_base (StrategyCompiler chain).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.optimizer import SGD, Adam, Lamb
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    DGCOptimizer, ExponentialMovingAverage, FP16AllReduceOptimizer,
+    GradientMergeOptimizer, LocalSGDOptimizer, LookaheadOptimizer,
+    ModelAverage, StrategyCompiler)
+
+
+def make_param(value=1.0, shape=(4,)):
+    p = paddle.to_tensor(np.full(shape, value, np.float32))
+    p.stop_gradient = False
+    p.trainable = True
+    return p
+
+
+def set_grad(p, value):
+    p.grad = paddle.to_tensor(np.full(tuple(p.shape), value, np.float32))
+
+
+class TestGradientMerge:
+    def test_applies_every_k_steps(self):
+        p = make_param()
+        opt = GradientMergeOptimizer(SGD(learning_rate=0.1, parameters=[p]),
+                                     k_steps=2, avg=True)
+        set_grad(p, 1.0)
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), 1.0)  # accumulated, no update
+        set_grad(p, 3.0)
+        opt.step()
+        # avg grad = 2.0 -> p = 1 - 0.1*2
+        np.testing.assert_allclose(p.numpy(), 0.8, rtol=1e-6)
+
+
+class TestDGC:
+    def test_sparsifies_and_keeps_residual(self):
+        p = make_param(shape=(10,))
+        opt = DGCOptimizer(SGD(learning_rate=1.0, parameters=[p]),
+                           sparsity=0.9)  # keep top 10% = 1 entry
+        g = np.zeros(10, np.float32)
+        g[3] = 5.0
+        g[7] = 1.0
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        got = p.numpy()
+        # only the top entry applied
+        assert got[3] == pytest.approx(1.0 - 5.0)
+        assert got[7] == pytest.approx(1.0)
+        # residual applied later once it dominates
+        p.grad = paddle.to_tensor(np.zeros(10, np.float32))
+        opt.step()
+        assert p.numpy()[7] != 1.0  # residual momentum pushed entry 7 out
+
+
+class TestLocalSGD:
+    def test_single_process_steps(self):
+        p = make_param()
+        opt = LocalSGDOptimizer(SGD(learning_rate=0.1, parameters=[p]),
+                                k_steps=2)
+        for _ in range(2):
+            set_grad(p, 1.0)
+            opt.step()
+        np.testing.assert_allclose(p.numpy(), 0.8, rtol=1e-6)
+
+
+class TestFP16AllReduce:
+    def test_grad_cast_roundtrip(self):
+        p = make_param()
+        opt = FP16AllReduceOptimizer(SGD(learning_rate=1.0, parameters=[p]))
+        set_grad(p, 0.5)
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), 0.5, atol=1e-2)
+
+
+class TestLookahead:
+    def test_slow_weights_interpolate(self):
+        p = make_param(0.0)
+        opt = LookaheadOptimizer(SGD(learning_rate=1.0, parameters=[p]),
+                                 alpha=0.5, k=2)
+        for _ in range(2):
+            set_grad(p, -1.0)  # fast weights +1 per step
+            opt.step()
+        # fast reached 2.0; slow = 0 + 0.5*(2-0) = 1.0; fast reset to slow
+        np.testing.assert_allclose(p.numpy(), 1.0, rtol=1e-6)
+
+
+class TestAveraging:
+    def test_model_average_apply_restore(self):
+        p = make_param(0.0)
+        opt = ModelAverage(SGD(learning_rate=1.0, parameters=[p]))
+        for v in (-1.0, -1.0):  # p goes 1.0 then 2.0
+            set_grad(p, v)
+            opt.step()
+        with opt.apply():
+            np.testing.assert_allclose(p.numpy(), 1.5)  # avg(1,2)
+        np.testing.assert_allclose(p.numpy(), 2.0)
+
+    def test_ema(self):
+        p = make_param(1.0)
+        ema = ExponentialMovingAverage(decay=0.5, parameters=[p])
+        ema.update()
+        p._array = p._array * 0 + 3.0
+        ema.update()
+        with ema.apply():
+            val = float(p.numpy()[0])
+            assert 1.0 < val < 3.0
+        assert float(p.numpy()[0]) == 3.0
+
+
+class TestStrategyCompiler:
+    def test_chain_selection_and_exclusion(self):
+        st = DistributedStrategy()
+        st.gradient_merge = True
+        st.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        st.dgc = True
+        st.localsgd = True  # excluded: conflicts with dgc
+        st.lookahead = True
+        p = make_param()
+        opt, applied = StrategyCompiler().generate_optimizer(
+            SGD(learning_rate=0.1, parameters=[p]), st)
+        assert applied == ["gradient_merge", "dgc", "lookahead"]
+        assert isinstance(opt, GradientMergeOptimizer)
+
+    def test_lamb_swap(self):
+        st = DistributedStrategy()
+        st.lamb = True
+        p = make_param()
+        opt, applied = StrategyCompiler().generate_optimizer(
+            SGD(learning_rate=0.1, parameters=[p]), st)
+        assert "lamb" in applied
+        assert isinstance(opt, Lamb)
+
+    def test_fleet_distributed_optimizer_wires_compiler(self):
+        from paddle_tpu.distributed import fleet
+
+        st = DistributedStrategy()
+        st.gradient_merge = True
+        st.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        fleet.init(strategy=st)
+        p = make_param()
+        opt = fleet.distributed_optimizer(
+            SGD(learning_rate=0.1, parameters=[p]))
+        assert isinstance(opt, GradientMergeOptimizer)
