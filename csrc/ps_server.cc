@@ -1,0 +1,478 @@
+// Parameter-server core: TCP server + client with dense/sparse tables and
+// server-side optimizers.
+//
+// Reference counterpart: paddle/fluid/distributed/service/ — BrpcPsServer
+// (brpc_ps_server.cc), BrpcPsClient (brpc_ps_client.cc), tables
+// (distributed/table/common_dense_table.cc, common_sparse_table.cc,
+// sparse_geo_table.cc), SURVEY.md §2.1 "PS core".  The TPU build replaces
+// brpc/protobuf with a dependency-free length-prefixed binary protocol over
+// raw TCP sockets (same transport class the reference uses for comm-id
+// rendezvous, platform/gen_comm_id_helper.cc) — dense compute stays on TPU,
+// tables live in host memory here.
+//
+// Protocol (little-endian):
+//   request : u32 body_len | u8 op | u32 table | u64 n | payload
+//   response: u32 body_len | u8 status | payload
+// Ops: 1 PULL_DENSE  2 PUSH_DENSE_GRAD  3 SET_DENSE
+//      4 PULL_SPARSE 5 PUSH_SPARSE_GRAD 6 BARRIER 7 STOP 8 PUSH_DENSE_DELTA
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace ptrt {
+namespace ps {
+
+enum Op : uint8_t {
+  kPullDense = 1,
+  kPushDenseGrad = 2,
+  kSetDense = 3,
+  kPullSparse = 4,
+  kPushSparseGrad = 5,
+  kBarrier = 6,
+  kStop = 7,
+  kPushDenseDelta = 8,
+};
+
+// ---------------------------------------------------------------------------
+// tables
+// ---------------------------------------------------------------------------
+struct DenseTable {
+  std::vector<float> param;
+  std::vector<float> accum;  // adagrad accumulator (lazy)
+  float lr = 0.01f;
+  int optimizer = 0;  // 0 = sgd, 1 = adagrad, 2 = sum (GEO delta apply)
+  std::mutex mu;
+};
+
+struct SparseTable {
+  std::unordered_map<uint64_t, std::vector<float>> rows;
+  size_t dim = 0;
+  float lr = 0.01f;
+  std::mutex mu;
+};
+
+// ---------------------------------------------------------------------------
+// socket helpers
+// ---------------------------------------------------------------------------
+static bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool WriteFull(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool SendResponse(int fd, uint8_t status, const void* payload,
+                         size_t bytes) {
+  uint32_t len = static_cast<uint32_t>(1 + bytes);
+  if (!WriteFull(fd, &len, 4)) return false;
+  if (!WriteFull(fd, &status, 1)) return false;
+  return bytes == 0 || WriteFull(fd, payload, bytes);
+}
+
+// ---------------------------------------------------------------------------
+// server
+// ---------------------------------------------------------------------------
+class Server {
+ public:
+  Server() = default;
+
+  int Start(int port, int n_trainers) {
+    n_trainers_ = n_trainers > 0 ? n_trainers : 1;
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -1;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0)
+      return -1;
+    if (port == 0) {
+      socklen_t len = sizeof(addr);
+      ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+      port = ntohs(addr.sin_port);
+    }
+    if (::listen(listen_fd_, 64) != 0) return -1;
+    stopped_.store(false);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return port;
+  }
+
+  void CreateDenseTable(uint32_t id, uint64_t size, float lr, int opt) {
+    std::lock_guard<std::mutex> g(tables_mu_);
+    auto& t = dense_[id];
+    t = std::make_unique<DenseTable>();
+    t->param.assign(size, 0.0f);
+    t->lr = lr;
+    t->optimizer = opt;
+  }
+
+  void CreateSparseTable(uint32_t id, uint64_t dim, float lr) {
+    std::lock_guard<std::mutex> g(tables_mu_);
+    auto& t = sparse_[id];
+    t = std::make_unique<SparseTable>();
+    t->dim = dim;
+    t->lr = lr;
+  }
+
+  // Safe from any thread (incl. a worker handling kStop): flags shutdown
+  // and unblocks accept/barrier, but joins nothing.
+  void RequestStop() {
+    if (stopped_.exchange(true)) return;
+    {
+      std::lock_guard<std::mutex> g(barrier_mu_);
+      barrier_generation_++;
+      barrier_count_ = 0;
+    }
+    barrier_cv_.notify_all();
+    std::lock_guard<std::mutex> g(listen_mu_);
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+  }
+
+  bool stopped() const { return stopped_.load(); }
+
+  // Owner-side full shutdown: joins all threads.  Must only be called from
+  // outside the server's own worker threads.
+  void Stop() {
+    RequestStop();
+    if (join_done_.exchange(true)) return;
+    if (accept_thread_.joinable()) accept_thread_.join();
+    {
+      std::lock_guard<std::mutex> g(listen_mu_);
+      if (listen_fd_ >= 0) {
+        ::close(listen_fd_);
+        listen_fd_ = -1;
+      }
+    }
+    std::vector<std::thread> workers;
+    {
+      std::lock_guard<std::mutex> g(workers_mu_);
+      workers.swap(workers_);
+      // unblock workers parked in recv() on live client connections —
+      // a client that never disconnects must not deadlock shutdown
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+      conn_fds_.clear();
+    }
+    for (auto& w : workers)
+      if (w.joinable()) w.join();
+  }
+
+  ~Server() { Stop(); }
+
+ private:
+  void AcceptLoop() {
+    while (!stopped_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(workers_mu_);
+      conn_fds_.push_back(fd);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  DenseTable* GetDense(uint32_t id) {
+    std::lock_guard<std::mutex> g(tables_mu_);
+    auto it = dense_.find(id);
+    return it == dense_.end() ? nullptr : it->second.get();
+  }
+
+  SparseTable* GetSparse(uint32_t id) {
+    std::lock_guard<std::mutex> g(tables_mu_);
+    auto it = sparse_.find(id);
+    return it == sparse_.end() ? nullptr : it->second.get();
+  }
+
+  void Serve(int fd) {
+    std::vector<char> body;
+    while (!stopped_.load()) {
+      uint32_t body_len = 0;
+      if (!ReadFull(fd, &body_len, 4)) break;
+      if (body_len < 13 || body_len > (1u << 30)) break;
+      body.resize(body_len);
+      if (!ReadFull(fd, body.data(), body_len)) break;
+      uint8_t op = static_cast<uint8_t>(body[0]);
+      uint32_t table;
+      uint64_t n;
+      std::memcpy(&table, body.data() + 1, 4);
+      std::memcpy(&n, body.data() + 5, 8);
+      const char* payload = body.data() + 13;
+      size_t payload_len = body_len - 13;
+      if (!Handle(fd, op, table, n, payload, payload_len)) break;
+      if (op == kStop) break;
+    }
+    {
+      // prune before close so Stop() can't shutdown() a recycled fd number
+      std::lock_guard<std::mutex> g(workers_mu_);
+      conn_fds_.erase(std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
+                      conn_fds_.end());
+    }
+    ::close(fd);
+  }
+
+  bool Handle(int fd, uint8_t op, uint32_t table, uint64_t n,
+              const char* payload, size_t payload_len) {
+    switch (op) {
+      case kPullDense: {
+        DenseTable* t = GetDense(table);
+        if (!t) return SendResponse(fd, 1, nullptr, 0);
+        std::lock_guard<std::mutex> g(t->mu);
+        return SendResponse(fd, 0, t->param.data(),
+                            t->param.size() * sizeof(float));
+      }
+      case kSetDense: {
+        DenseTable* t = GetDense(table);
+        if (!t || payload_len != t->param.size() * sizeof(float))
+          return SendResponse(fd, 1, nullptr, 0);
+        std::lock_guard<std::mutex> g(t->mu);
+        std::memcpy(t->param.data(), payload, payload_len);
+        return SendResponse(fd, 0, nullptr, 0);
+      }
+      case kPushDenseGrad:
+      case kPushDenseDelta: {
+        DenseTable* t = GetDense(table);
+        if (!t || payload_len != t->param.size() * sizeof(float))
+          return SendResponse(fd, 1, nullptr, 0);
+        const float* g = reinterpret_cast<const float*>(payload);
+        std::lock_guard<std::mutex> lk(t->mu);
+        size_t m = t->param.size();
+        if (op == kPushDenseDelta || t->optimizer == 2) {
+          for (size_t i = 0; i < m; ++i) t->param[i] += g[i];
+        } else if (t->optimizer == 1) {  // adagrad
+          if (t->accum.size() != m) t->accum.assign(m, 1e-6f);
+          for (size_t i = 0; i < m; ++i) {
+            t->accum[i] += g[i] * g[i];
+            t->param[i] -= t->lr * g[i] / std::sqrt(t->accum[i]);
+          }
+        } else {  // sgd
+          for (size_t i = 0; i < m; ++i) t->param[i] -= t->lr * g[i];
+        }
+        return SendResponse(fd, 0, nullptr, 0);
+      }
+      case kPullSparse: {
+        SparseTable* t = GetSparse(table);
+        if (!t || payload_len != n * sizeof(uint64_t))
+          return SendResponse(fd, 1, nullptr, 0);
+        const uint64_t* ids = reinterpret_cast<const uint64_t*>(payload);
+        std::vector<float> out(n * t->dim);
+        std::lock_guard<std::mutex> g(t->mu);
+        for (uint64_t i = 0; i < n; ++i) {
+          auto& row = t->rows[ids[i]];
+          if (row.empty()) row.assign(t->dim, 0.0f);
+          std::memcpy(out.data() + i * t->dim, row.data(),
+                      t->dim * sizeof(float));
+        }
+        return SendResponse(fd, 0, out.data(), out.size() * sizeof(float));
+      }
+      case kPushSparseGrad: {
+        SparseTable* t = GetSparse(table);
+        if (!t ||
+            payload_len != n * (sizeof(uint64_t) + t->dim * sizeof(float)))
+          return SendResponse(fd, 1, nullptr, 0);
+        const uint64_t* ids = reinterpret_cast<const uint64_t*>(payload);
+        const float* grads =
+            reinterpret_cast<const float*>(payload + n * sizeof(uint64_t));
+        std::lock_guard<std::mutex> g(t->mu);
+        for (uint64_t i = 0; i < n; ++i) {
+          auto& row = t->rows[ids[i]];
+          if (row.empty()) row.assign(t->dim, 0.0f);
+          for (size_t d = 0; d < t->dim; ++d)
+            row[d] -= t->lr * grads[i * t->dim + d];
+        }
+        return SendResponse(fd, 0, nullptr, 0);
+      }
+      case kBarrier: {
+        std::unique_lock<std::mutex> lk(barrier_mu_);
+        uint64_t gen = barrier_generation_;
+        if (++barrier_count_ >= n_trainers_) {
+          barrier_count_ = 0;
+          barrier_generation_++;
+          barrier_cv_.notify_all();
+        } else {
+          barrier_cv_.wait(lk, [&] {
+            return barrier_generation_ != gen || stopped_.load();
+          });
+        }
+        return SendResponse(fd, 0, nullptr, 0);
+      }
+      case kStop: {
+        SendResponse(fd, 0, nullptr, 0);
+        // flag-only stop from a worker thread (no self-join); the owner
+        // observes stopped() and performs the joining Stop()
+        RequestStop();
+        return true;
+      }
+      default:
+        return SendResponse(fd, 2, nullptr, 0);
+    }
+  }
+
+  int listen_fd_ = -1;
+  std::mutex listen_mu_;
+  int n_trainers_ = 1;
+  std::atomic<bool> stopped_{true};
+  std::atomic<bool> join_done_{false};
+  std::thread accept_thread_;
+  std::mutex workers_mu_;
+  std::vector<std::thread> workers_;
+  std::vector<int> conn_fds_;
+  std::mutex tables_mu_;
+  std::unordered_map<uint32_t, std::unique_ptr<DenseTable>> dense_;
+  std::unordered_map<uint32_t, std::unique_ptr<SparseTable>> sparse_;
+  std::mutex barrier_mu_;
+  std::condition_variable barrier_cv_;
+  uint64_t barrier_count_ = 0;
+  uint64_t barrier_generation_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// client
+// ---------------------------------------------------------------------------
+class Client {
+ public:
+  bool Connect(const char* host, int port) {
+    Close();  // retrying on the same client must not leak the old fd
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return false;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+        ::connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+                  sizeof(addr)) != 0) {
+      Close();
+      return false;
+    }
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return true;
+  }
+
+  bool Request(uint8_t op, uint32_t table, uint64_t n, const void* payload,
+               size_t payload_len, std::vector<char>* reply) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint32_t body_len = static_cast<uint32_t>(13 + payload_len);
+    char hdr[17];
+    std::memcpy(hdr, &body_len, 4);
+    hdr[4] = static_cast<char>(op);
+    std::memcpy(hdr + 5, &table, 4);
+    std::memcpy(hdr + 9, &n, 8);
+    if (!WriteFull(fd_, hdr, 17)) return false;
+    if (payload_len && !WriteFull(fd_, payload, payload_len)) return false;
+    uint32_t rlen = 0;
+    if (!ReadFull(fd_, &rlen, 4)) return false;
+    std::vector<char> body(rlen);
+    if (!ReadFull(fd_, body.data(), rlen)) return false;
+    if (body.empty() || body[0] != 0) return false;
+    if (reply) reply->assign(body.begin() + 1, body.end());
+    return true;
+  }
+
+  void Close() {
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  ~Client() { Close(); }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace ps
+}  // namespace ptrt
+
+// ---------------------------------------------------------------------------
+// C API
+// ---------------------------------------------------------------------------
+extern "C" {
+
+void* ptrt_ps_server_create() { return new ptrt::ps::Server(); }
+
+int ptrt_ps_server_start(void* s, int port, int n_trainers) {
+  return static_cast<ptrt::ps::Server*>(s)->Start(port, n_trainers);
+}
+
+void ptrt_ps_server_create_dense_table(void* s, uint32_t id, uint64_t size,
+                                       float lr, int optimizer) {
+  static_cast<ptrt::ps::Server*>(s)->CreateDenseTable(id, size, lr,
+                                                      optimizer);
+}
+
+void ptrt_ps_server_create_sparse_table(void* s, uint32_t id, uint64_t dim,
+                                        float lr) {
+  static_cast<ptrt::ps::Server*>(s)->CreateSparseTable(id, dim, lr);
+}
+
+void ptrt_ps_server_stop(void* s) {
+  static_cast<ptrt::ps::Server*>(s)->Stop();
+}
+
+int ptrt_ps_server_stopped(void* s) {
+  return static_cast<ptrt::ps::Server*>(s)->stopped() ? 1 : 0;
+}
+
+void ptrt_ps_server_destroy(void* s) {
+  delete static_cast<ptrt::ps::Server*>(s);
+}
+
+void* ptrt_ps_client_create() { return new ptrt::ps::Client(); }
+
+int ptrt_ps_client_connect(void* c, const char* host, int port) {
+  return static_cast<ptrt::ps::Client*>(c)->Connect(host, port) ? 0 : -1;
+}
+
+// returns 0 on success; reply copied into out (caller-sized)
+int ptrt_ps_client_request(void* c, uint8_t op, uint32_t table, uint64_t n,
+                           const void* payload, uint64_t payload_len,
+                           void* out, uint64_t out_cap, uint64_t* out_len) {
+  std::vector<char> reply;
+  bool ok = static_cast<ptrt::ps::Client*>(c)->Request(
+      op, table, n, payload, payload_len, &reply);
+  if (!ok) return -1;
+  if (out_len) *out_len = reply.size();
+  if (reply.size() > out_cap) return -2;
+  if (!reply.empty() && out) std::memcpy(out, reply.data(), reply.size());
+  return 0;
+}
+
+void ptrt_ps_client_destroy(void* c) {
+  delete static_cast<ptrt::ps::Client*>(c);
+}
+
+}  // extern "C"

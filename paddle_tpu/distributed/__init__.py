@@ -10,6 +10,7 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        build_mesh, get_hybrid_communicate_group,
                        set_hybrid_communicate_group)
 from . import fleet
+from . import ps
 from .fleet.data_parallel import DataParallel
 from . import spawn as _spawn_mod
 from .spawn import spawn

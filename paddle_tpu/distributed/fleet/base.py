@@ -40,7 +40,9 @@ class RoleMakerBase:
 
 
 class PaddleCloudRoleMaker(RoleMakerBase):
-    """reference `fleet/base/role_maker.py:530` — parses PADDLE_* env."""
+    """reference `fleet/base/role_maker.py:530` — parses PADDLE_* env,
+    including the PS-mode role split (TRAINING_ROLE=PSERVER/TRAINER,
+    PADDLE_PSERVERS_IP_PORT_LIST, PADDLE_PORT)."""
 
     def __init__(self, is_collective=True, **kwargs):
         super().__init__(is_collective)
@@ -50,12 +52,27 @@ class PaddleCloudRoleMaker(RoleMakerBase):
                                               jax.process_index()))
         self._trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM",
                                                 jax.process_count()))
+        self._role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = [e for e in eps.split(",") if e]
+        self._current_endpoint = os.environ.get(
+            "PADDLE_CURRENT_ENDPOINT",
+            "127.0.0.1:" + os.environ.get("PADDLE_PORT", "0"))
 
     def worker_index(self):
         return self._trainer_id
 
     def worker_num(self):
         return self._trainers_num
+
+    def is_worker(self):
+        return self._role == "TRAINER"
+
+    def is_server(self):
+        return self._role == "PSERVER"
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
 
 
 class Fleet:
@@ -70,6 +87,10 @@ class Fleet:
     def init(self, role_maker=None, is_collective=True, strategy=None):
         self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective)
         self._strategy = strategy or DistributedStrategy()
+        if self._role_maker.is_server() or self._strategy.a_sync:
+            # PS mode: no device mesh; tables/clients are built lazily by
+            # init_server/init_worker (reference TheOnePSRuntime split)
+            return self
         h = self._strategy.hybrid_configs
         dp = int(h.get("dp_degree", 1))
         mp = int(h.get("mp_degree", 1))
@@ -150,11 +171,81 @@ class Fleet:
             raise RuntimeError("call fleet.distributed_optimizer first")
         return self._user_optimizer.minimize(loss)
 
-    # -- persistence hooks (reference fleet save/load) ----------------------
-    def save_persistables(self, executor=None, dirname=None, main_program=None):
-        pass
+    # -- parameter-server runtime (reference fleet_base init_server/
+    #    run_server/init_worker/stop_worker + TheOnePSRuntime) --------------
+    def init_server(self, tables=None, port=None, n_trainers=None):
+        """Build the native PS with `tables`:
+        {table_id: ("dense", size, lr, optimizer) | ("sparse", dim, lr)}."""
+        from ..ps import PSServer
+
+        srv = PSServer()
+        for tid, spec in (tables or {}).items():
+            kind, *rest = spec
+            if kind == "dense":
+                size = rest[0]
+                lr = rest[1] if len(rest) > 1 else 0.01
+                opt = rest[2] if len(rest) > 2 else "sgd"
+                srv.create_dense_table(tid, size, lr, opt)
+            elif kind == "sparse":
+                dim = rest[0]
+                lr = rest[1] if len(rest) > 1 else 0.01
+                srv.create_sparse_table(tid, dim, lr)
+            else:
+                raise ValueError(f"unknown table kind {spec[0]}")
+        if port is None:
+            import os
+
+            ep = getattr(self._role_maker, "_current_endpoint", "127.0.0.1:0")
+            port = int(ep.rsplit(":", 1)[1]) if ":" in ep else 0
+        self._ps_server = srv
+        self._ps_port = srv.start(port, n_trainers or self.worker_num())
+        return self._ps_port
+
+    def run_server(self):
+        """Block serving until stop (reference server_proc.join)."""
+        import time
+
+        srv = getattr(self, "_ps_server", None)
+        while srv is not None and not srv.is_stopped():
+            time.sleep(0.2)
+        if srv is not None:
+            srv.stop()  # join native threads after a remote OP_STOP
+
+    def init_worker(self, endpoint=None, mode=None):
+        from ..ps import Communicator, PSClient
+
+        if endpoint is None:
+            eps = self._role_maker.get_pserver_endpoints()
+            endpoint = eps[0] if eps else "127.0.0.1:0"
+        host, port = endpoint.rsplit(":", 1)
+        self._ps_client = PSClient(host, int(port))
+        st = self._strategy or DistributedStrategy()
+        if mode is None:
+            k = int(st.a_sync_configs.get("k_steps", -1))
+            mode = "geo" if k > 0 else ("async" if st.a_sync else "sync")
+        self._ps_communicator = Communicator(
+            self._ps_client, mode=mode,
+            k_steps=max(1, int(st.a_sync_configs.get("k_steps", 1))))
+        if mode == "async":
+            self._ps_communicator.start()
+        return self._ps_client
 
     def stop_worker(self):
+        comm = getattr(self, "_ps_communicator", None)
+        if comm is not None:
+            comm.stop()
+        client = getattr(self, "_ps_client", None)
+        if client is not None:
+            client.barrier()
+            client.close()
+
+    def stop_server(self):
+        srv = getattr(self, "_ps_server", None)
+        if srv is not None:
+            srv.stop()
+
+    # -- persistence hooks (reference fleet save/load) ----------------------
+    def save_persistables(self, executor=None, dirname=None, main_program=None):
         pass
 
 

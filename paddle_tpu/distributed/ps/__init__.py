@@ -1,0 +1,305 @@
+"""Parameter-server runtime (Python surface over the native core).
+
+Reference: `python/paddle/distributed/fleet/runtime/the_one_ps.py:434`
+(TheOnePSRuntime builds brpc servers/clients from the strategy),
+`distributed/service/communicator.h:197` (async Communicator with merge
+queues), GEO tables (`distributed/table/sparse_geo_table.cc`).
+
+TPU-native: the server core is csrc/ps_server.cc (TCP + host-memory
+tables + server-side optimizers); trainers keep dense compute on TPU and
+exchange numpy views at the host boundary.  Three sync modes, matching the
+reference's a_sync strategy matrix:
+
+- sync  — push grads / barrier / pull each step
+- async — background Communicator thread merges grads and pushes on an
+          interval, pulls fresh params (reference Communicator queues)
+- geo   — trainers train locally; every k steps push param *deltas*
+          (server applies +=) and pull the merged params (GEO-SGD)
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...core import native
+
+OP_PULL_DENSE = 1
+OP_PUSH_DENSE_GRAD = 2
+OP_SET_DENSE = 3
+OP_PULL_SPARSE = 4
+OP_PUSH_SPARSE_GRAD = 5
+OP_BARRIER = 6
+OP_STOP = 7
+OP_PUSH_DENSE_DELTA = 8
+
+_PS_SIGS = False
+
+
+def _lib():
+    lib = native._load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable (PS needs csrc build)")
+    global _PS_SIGS
+    if not _PS_SIGS:
+        lib.ptrt_ps_server_create.restype = ctypes.c_void_p
+        lib.ptrt_ps_server_start.restype = ctypes.c_int
+        lib.ptrt_ps_server_start.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                             ctypes.c_int]
+        lib.ptrt_ps_server_create_dense_table.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_float, ctypes.c_int]
+        lib.ptrt_ps_server_create_sparse_table.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_float]
+        lib.ptrt_ps_server_stop.argtypes = [ctypes.c_void_p]
+        lib.ptrt_ps_server_stopped.restype = ctypes.c_int
+        lib.ptrt_ps_server_stopped.argtypes = [ctypes.c_void_p]
+        lib.ptrt_ps_server_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptrt_ps_client_create.restype = ctypes.c_void_p
+        lib.ptrt_ps_client_connect.restype = ctypes.c_int
+        lib.ptrt_ps_client_connect.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p, ctypes.c_int]
+        lib.ptrt_ps_client_request.restype = ctypes.c_int
+        lib.ptrt_ps_client_request.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint8, ctypes.c_uint32,
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.ptrt_ps_client_destroy.argtypes = [ctypes.c_void_p]
+        _PS_SIGS = True
+    return lib
+
+
+class PSServer:
+    """In-process native parameter server (reference BrpcPsServer)."""
+
+    OPT_SGD = 0
+    OPT_ADAGRAD = 1
+    OPT_SUM = 2  # GEO delta apply
+
+    def __init__(self):
+        self._lib = _lib()
+        self._h = self._lib.ptrt_ps_server_create()
+        self.port = None
+        self.stopped = False
+
+    def create_dense_table(self, table_id, size, lr=0.01, optimizer="sgd"):
+        opt = {"sgd": 0, "adagrad": 1, "sum": 2}[optimizer]
+        self._lib.ptrt_ps_server_create_dense_table(
+            self._h, table_id, int(size), float(lr), opt)
+
+    def create_sparse_table(self, table_id, dim, lr=0.01):
+        self._lib.ptrt_ps_server_create_sparse_table(
+            self._h, table_id, int(dim), float(lr))
+
+    def start(self, port=0, n_trainers=1):
+        self.port = self._lib.ptrt_ps_server_start(self._h, int(port),
+                                                   int(n_trainers))
+        if self.port < 0:
+            raise RuntimeError(f"PS server failed to bind port {port}")
+        return self.port
+
+    def stop(self):
+        if self._h:
+            self._lib.ptrt_ps_server_stop(self._h)
+        self.stopped = True
+
+    def is_stopped(self) -> bool:
+        """True once the native server saw a stop — locally via stop() or
+        remotely via a client OP_STOP."""
+        if self.stopped:
+            return True
+        return bool(self._h and self._lib.ptrt_ps_server_stopped(self._h))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ptrt_ps_server_stop(self._h)
+                self._lib.ptrt_ps_server_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class PSClient:
+    """Trainer-side connection (reference BrpcPsClient)."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._lib = _lib()
+        self._h = self._lib.ptrt_ps_client_create()
+        rc = self._lib.ptrt_ps_client_connect(self._h, host.encode(),
+                                              int(port))
+        if rc != 0:
+            raise ConnectionError(f"cannot connect PS at {host}:{port}")
+
+    def _request(self, op, table, n, payload: bytes, out_cap: int) -> bytes:
+        out = ctypes.create_string_buffer(out_cap) if out_cap else None
+        out_len = ctypes.c_uint64(0)
+        rc = self._lib.ptrt_ps_client_request(
+            self._h, op, table, n, payload, len(payload) if payload else 0,
+            out, out_cap, ctypes.byref(out_len))
+        if rc != 0:
+            raise RuntimeError(f"PS request op={op} failed rc={rc}")
+        return out.raw[: out_len.value] if out else b""
+
+    def pull_dense(self, table, size) -> np.ndarray:
+        raw = self._request(OP_PULL_DENSE, table, size, b"",
+                            size * 4 + 16)
+        return np.frombuffer(raw, np.float32, count=size).copy()
+
+    def push_dense_grad(self, table, grad: np.ndarray):
+        g = np.ascontiguousarray(grad, np.float32)
+        self._request(OP_PUSH_DENSE_GRAD, table, g.size, g.tobytes(), 0)
+
+    def push_dense_delta(self, table, delta: np.ndarray):
+        d = np.ascontiguousarray(delta, np.float32)
+        self._request(OP_PUSH_DENSE_DELTA, table, d.size, d.tobytes(), 0)
+
+    def set_dense(self, table, value: np.ndarray):
+        v = np.ascontiguousarray(value, np.float32)
+        self._request(OP_SET_DENSE, table, v.size, v.tobytes(), 0)
+
+    def pull_sparse(self, table, ids: np.ndarray, dim: int) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.uint64)
+        raw = self._request(OP_PULL_SPARSE, table, ids.size, ids.tobytes(),
+                            ids.size * dim * 4 + 16)
+        return np.frombuffer(raw, np.float32,
+                             count=ids.size * dim).reshape(ids.size, dim).copy()
+
+    def push_sparse_grad(self, table, ids: np.ndarray, grads: np.ndarray):
+        ids = np.ascontiguousarray(ids, np.uint64)
+        g = np.ascontiguousarray(grads, np.float32)
+        self._request(OP_PUSH_SPARSE_GRAD, table, ids.size,
+                      ids.tobytes() + g.tobytes(), 0)
+
+    def barrier(self, table=0):
+        self._request(OP_BARRIER, table, 0, b"", 0)
+
+    def stop_server(self):
+        try:
+            self._request(OP_STOP, 0, 0, b"", 0)
+        except RuntimeError:
+            pass
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.ptrt_ps_client_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class Communicator:
+    """Async gradient communicator (reference
+    `distributed/service/communicator.h:197`): trainers enqueue grads;  a
+    background thread merges same-table grads and pushes them, then pulls
+    fresh params into a cache the trainer reads at its own pace.
+
+    GEO mode (`sparse_geo_table.cc`): `geo_step` marks the table for
+    delta-sync every `k_steps` calls instead of per-grad pushes."""
+
+    def __init__(self, client: PSClient, mode="async", send_interval_s=0.01,
+                 merge_size=4, k_steps=4):
+        self.client = client
+        self.mode = mode
+        self.send_interval_s = send_interval_s
+        self.merge_size = merge_size
+        self.k_steps = max(1, int(k_steps))
+        self._q: "queue.Queue" = queue.Queue()
+        self._params: Dict[int, np.ndarray] = {}
+        self._sizes: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._geo_old: Dict[int, np.ndarray] = {}
+        self._geo_tick: Dict[int, int] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def is_running(self):
+        return self._running
+
+    # -- trainer API --------------------------------------------------------
+    def register_dense(self, table_id, size):
+        self._sizes[table_id] = int(size)
+
+    def send(self, table_id, grad: np.ndarray):
+        """Enqueue a dense grad for async merge+push."""
+        self._q.put((table_id, np.asarray(grad, np.float32)))
+
+    def recv(self, table_id) -> Optional[np.ndarray]:
+        """Latest pulled params (may lag; that's the async contract)."""
+        with self._lock:
+            p = self._params.get(table_id)
+        if p is None:  # first touch: synchronous pull
+            p = self.client.pull_dense(table_id, self._sizes[table_id])
+            with self._lock:
+                self._params[table_id] = p
+        return p
+
+    def geo_step(self, table_id, local_param: np.ndarray) -> np.ndarray:
+        """GEO-SGD: every k calls push (local - last_synced) as a delta and
+        pull the merged global params; returns the params the trainer
+        should continue from."""
+        local = np.asarray(local_param, np.float32)
+        tick = self._geo_tick.get(table_id, 0) + 1
+        self._geo_tick[table_id] = tick
+        if table_id not in self._geo_old:
+            # last-synced state is the SERVER's params (the trainer may
+            # already have stepped locally before the first geo_step)
+            self._geo_old[table_id] = self.client.pull_dense(
+                table_id, local.size).reshape(local.shape)
+        if tick % self.k_steps:
+            return local
+        delta = local - self._geo_old[table_id]
+        self.client.push_dense_delta(table_id, delta)
+        fresh = self.client.pull_dense(table_id, local.size).reshape(
+            local.shape)
+        self._geo_old[table_id] = fresh.copy()
+        return fresh
+
+    # -- background loop ----------------------------------------------------
+    def _loop(self):
+        while self._running:
+            merged: Dict[int, np.ndarray] = {}
+            count = 0
+            deadline = time.monotonic() + self.send_interval_s
+            while count < self.merge_size and time.monotonic() < deadline:
+                try:
+                    tid, g = self._q.get(timeout=self.send_interval_s)
+                except queue.Empty:
+                    break
+                merged[tid] = g if tid not in merged else merged[tid] + g
+                count += 1
+            for tid, g in merged.items():
+                try:
+                    self.client.push_dense_grad(tid, g)
+                    # size falls back to the pushed grad's size so an
+                    # unregistered table cannot kill the send thread
+                    size = self._sizes.get(tid, g.size)
+                    fresh = self.client.pull_dense(tid, size)
+                    with self._lock:
+                        self._params[tid] = fresh
+                except Exception:
+                    if self._running:
+                        raise
+                    return
