@@ -28,6 +28,7 @@
 #include <cstdint>
 #include <cstring>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -153,7 +154,7 @@ class Server {
     {
       std::lock_guard<std::mutex> g(barrier_mu_);
       barrier_generation_++;
-      barrier_count_ = 0;
+      barrier_ids_.clear();
     }
     barrier_cv_.notify_all();
     std::lock_guard<std::mutex> g(listen_mu_);
@@ -248,9 +249,15 @@ class Server {
       case kPullDense: {
         DenseTable* t = GetDense(table);
         if (!t) return SendResponse(fd, 1, nullptr, 0);
-        std::lock_guard<std::mutex> g(t->mu);
-        return SendResponse(fd, 0, t->param.data(),
-                            t->param.size() * sizeof(float));
+        std::vector<float> snapshot;
+        {
+          // copy under the lock, send after: a slow reader must not hold
+          // the table mutex while its TCP window drains
+          std::lock_guard<std::mutex> g(t->mu);
+          snapshot = t->param;
+        }
+        return SendResponse(fd, 0, snapshot.data(),
+                            snapshot.size() * sizeof(float));
       }
       case kSetDense: {
         DenseTable* t = GetDense(table);
@@ -283,23 +290,28 @@ class Server {
       }
       case kPullSparse: {
         SparseTable* t = GetSparse(table);
-        if (!t || payload_len != n * sizeof(uint64_t))
+        // bound n BEFORE multiplying: a forged huge n must not overflow the
+        // size check into an OOB read or an uncaught length_error
+        if (!t || n > payload_len / sizeof(uint64_t) ||
+            payload_len != n * sizeof(uint64_t))
           return SendResponse(fd, 1, nullptr, 0);
         const uint64_t* ids = reinterpret_cast<const uint64_t*>(payload);
         std::vector<float> out(n * t->dim);
-        std::lock_guard<std::mutex> g(t->mu);
-        for (uint64_t i = 0; i < n; ++i) {
-          auto& row = t->rows[ids[i]];
-          if (row.empty()) row.assign(t->dim, 0.0f);
-          std::memcpy(out.data() + i * t->dim, row.data(),
-                      t->dim * sizeof(float));
+        {
+          std::lock_guard<std::mutex> g(t->mu);
+          for (uint64_t i = 0; i < n; ++i) {
+            auto& row = t->rows[ids[i]];
+            if (row.empty()) row.assign(t->dim, 0.0f);
+            std::memcpy(out.data() + i * t->dim, row.data(),
+                        t->dim * sizeof(float));
+          }
         }
         return SendResponse(fd, 0, out.data(), out.size() * sizeof(float));
       }
       case kPushSparseGrad: {
         SparseTable* t = GetSparse(table);
-        if (!t ||
-            payload_len != n * (sizeof(uint64_t) + t->dim * sizeof(float)))
+        size_t elem = sizeof(uint64_t) + (t ? t->dim : 0) * sizeof(float);
+        if (!t || n > payload_len / elem || payload_len != n * elem)
           return SendResponse(fd, 1, nullptr, 0);
         const uint64_t* ids = reinterpret_cast<const uint64_t*>(payload);
         const float* grads =
@@ -314,10 +326,14 @@ class Server {
         return SendResponse(fd, 0, nullptr, 0);
       }
       case kBarrier: {
+        // `n` carries the trainer id: arrivals are tracked as a SET so a
+        // restarted trainer re-arriving cannot release the barrier early
+        // (reference barrier_table tracks trainer ids the same way)
         std::unique_lock<std::mutex> lk(barrier_mu_);
         uint64_t gen = barrier_generation_;
-        if (++barrier_count_ >= n_trainers_) {
-          barrier_count_ = 0;
+        barrier_ids_.insert(n);
+        if (barrier_ids_.size() >= static_cast<size_t>(n_trainers_)) {
+          barrier_ids_.clear();
           barrier_generation_++;
           barrier_cv_.notify_all();
         } else {
@@ -325,7 +341,11 @@ class Server {
             return barrier_generation_ != gen || stopped_.load();
           });
         }
-        return SendResponse(fd, 0, nullptr, 0);
+        // a stop-released waiter must not look like a completed barrier
+        uint8_t status = (barrier_generation_ == gen && stopped_.load())
+                             ? 3
+                             : 0;
+        return SendResponse(fd, status, nullptr, 0);
       }
       case kStop: {
         SendResponse(fd, 0, nullptr, 0);
@@ -353,7 +373,7 @@ class Server {
   std::unordered_map<uint32_t, std::unique_ptr<SparseTable>> sparse_;
   std::mutex barrier_mu_;
   std::condition_variable barrier_cv_;
-  uint64_t barrier_count_ = 0;
+  std::set<uint64_t> barrier_ids_;
   uint64_t barrier_generation_ = 0;
 };
 

@@ -36,6 +36,10 @@ import importlib as _importlib
 # `from .ops import *` above leaks `ops.linalg` under the name `linalg`;
 # rebind to the public namespace module (paddle_tpu/linalg.py) explicitly.
 linalg = _importlib.import_module(".linalg", __name__)
+from . import incubate
+from . import inference
+from . import quantization
+from . import sparsity
 from . import profiler
 from . import regularizer
 from .framework.param_attr import ParamAttr

@@ -193,8 +193,6 @@ class Fleet:
             else:
                 raise ValueError(f"unknown table kind {spec[0]}")
         if port is None:
-            import os
-
             ep = getattr(self._role_maker, "_current_endpoint", "127.0.0.1:0")
             port = int(ep.rsplit(":", 1)[1]) if ":" in ep else 0
         self._ps_server = srv
@@ -216,6 +214,13 @@ class Fleet:
 
         if endpoint is None:
             eps = self._role_maker.get_pserver_endpoints()
+            if len(eps) > 1:
+                import warnings
+
+                warnings.warn(
+                    "multiple pserver endpoints configured but table "
+                    "sharding across servers is not implemented; all "
+                    f"traffic goes to {eps[0]}", stacklevel=2)
             endpoint = eps[0] if eps else "127.0.0.1:0"
         host, port = endpoint.rsplit(":", 1)
         self._ps_client = PSClient(host, int(port))
@@ -236,7 +241,10 @@ class Fleet:
             comm.stop()
         client = getattr(self, "_ps_client", None)
         if client is not None:
-            client.barrier()
+            try:
+                client.barrier(trainer_id=self.worker_index())
+            except RuntimeError:
+                pass  # server already stopping
             client.close()
 
     def stop_server(self):

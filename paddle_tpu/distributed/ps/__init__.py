@@ -177,8 +177,10 @@ class PSClient:
         self._request(OP_PUSH_SPARSE_GRAD, table, ids.size,
                       ids.tobytes() + g.tobytes(), 0)
 
-    def barrier(self, table=0):
-        self._request(OP_BARRIER, table, 0, b"", 0)
+    def barrier(self, trainer_id=0, table=0):
+        """Block until all n_trainers distinct trainer ids arrive (restarts
+        of the same id don't double-count)."""
+        self._request(OP_BARRIER, table, int(trainer_id), b"", 0)
 
     def stop_server(self):
         try:
@@ -220,6 +222,7 @@ class Communicator:
         self._lock = threading.Lock()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        self._send_error: Optional[Exception] = None
         self._geo_old: Dict[int, np.ndarray] = {}
         self._geo_tick: Dict[int, int] = {}
 
@@ -243,7 +246,11 @@ class Communicator:
         self._sizes[table_id] = int(size)
 
     def send(self, table_id, grad: np.ndarray):
-        """Enqueue a dense grad for async merge+push."""
+        """Enqueue a dense grad for async merge+push.  Raises the background
+        thread's failure here rather than growing the queue forever."""
+        if self._send_error is not None:
+            err, self._send_error = self._send_error, None
+            raise RuntimeError("PS communicator send thread failed") from err
         self._q.put((table_id, np.asarray(grad, np.float32)))
 
     def recv(self, table_id) -> Optional[np.ndarray]:
@@ -299,7 +306,8 @@ class Communicator:
                     fresh = self.client.pull_dense(tid, size)
                     with self._lock:
                         self._params[tid] = fresh
-                except Exception:
+                except Exception as e:  # noqa: BLE001 — surfaced via send()
                     if self._running:
-                        raise
+                        self._send_error = e
+                        self._running = False
                     return

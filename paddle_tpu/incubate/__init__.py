@@ -1,0 +1,2 @@
+"""`paddle.incubate` equivalents (experimental surface)."""
+from . import checkpoint  # noqa: F401

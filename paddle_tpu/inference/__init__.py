@@ -1,0 +1,218 @@
+"""Inference engine.
+
+Reference: `paddle/fluid/inference/` — `AnalysisConfig`
+(`inference/api/paddle_analysis_config.h`), `AnalysisPredictor::Run/
+ZeroCopyRun` (`inference/api/analysis_predictor.cc:381,889`) over
+`NaiveExecutor` with an IR-pass optimization pipeline and TensorRT/Lite
+subgraph engines.
+
+TPU-native re-design: the deployable artifact is the serialized StableHLO
+program + weights that `paddle_tpu.jit.save` emits (replacing
+ProgramDesc+params files), and the entire "optimization pipeline"
+(fusion passes, memory passes, engine subgraphs) is XLA compilation —
+there is nothing to hand-optimize post hoc.  The predictor:
+
+- loads the artifact once, compiles per input-shape signature, and caches
+  executables (reference's program/executable cache);
+- exposes the zero-copy handle API (`get_input_handle` /
+  `copy_from_cpu` / `copy_to_cpu`) so user code ported from the
+  reference runs unchanged.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    XPU = 3
+
+
+class Config:
+    """reference `AnalysisConfig` (`paddle_analysis_config.h`): model paths
+    + device + optimization switches.  Switches that configure CUDA/TRT/
+    MKLDNN specifics are accepted as no-ops (XLA owns those concerns) so
+    reference deployment scripts keep working."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._model_prefix = prog_file
+        self._device = "tpu"
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+        self._ir_optim = True
+        self._memory_optim = True
+        self._cpu_math_threads = 1
+
+    # -- model path ---------------------------------------------------------
+    def set_model(self, prog_file, params_file=None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._model_prefix = prog_file
+
+    def model_dir(self):
+        return os.path.dirname(self._model_prefix or "")
+
+    def prog_file(self):
+        return (self._model_prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return (self._model_prefix or "") + ".pdiparams"
+
+    # -- device -------------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device, self._device_id = "gpu", device_id
+
+    def enable_tpu(self, device_id=0):
+        self._device, self._device_id = "tpu", device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "gpu"
+
+    # -- switches (accepted; XLA makes them moot) ---------------------------
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = bool(x)
+
+    def enable_memory_optim(self, x=True):
+        self._memory_optim = bool(x)
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = int(n)
+
+    def enable_tensorrt_engine(self, *args, **kwargs):
+        pass  # TRT is a CUDA concern; XLA compiles the whole graph on TPU
+
+    def enable_mkldnn(self):
+        pass
+
+    def switch_use_feed_fetch_ops(self, x=False):
+        pass
+
+    def switch_specify_input_names(self, x=True):
+        pass
+
+    def set_precision(self, p):
+        self._precision = p
+
+    def summary(self):
+        return (f"Config(model={self._model_prefix!r}, device={self._device}"
+                f":{self._device_id}, ir_optim={self._ir_optim})")
+
+
+class Tensor:
+    """Zero-copy input/output handle (reference `ZeroCopyTensor`,
+    `inference/api/details/zero_copy_tensor.cc`)."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self._name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def name(self):
+        return self._name
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        if not self._is_input:
+            raise RuntimeError("copy_from_cpu on an output handle")
+        self._owner._inputs[self._name] = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            raise RuntimeError("copy_to_cpu on an input handle")
+        out = self._owner._outputs.get(self._name)
+        if out is None:
+            raise RuntimeError("run() the predictor before copy_to_cpu")
+        return np.asarray(out)
+
+    def shape(self):
+        src = self._owner._inputs if self._is_input else self._owner._outputs
+        a = src.get(self._name)
+        return list(a.shape) if a is not None else None
+
+    def reshape(self, shape):
+        pass  # shapes are taken from copy_from_cpu data
+
+
+class Predictor:
+    """reference `AnalysisPredictor`: load once, run many.  The artifact is
+    shape-specialized StableHLO: inputs must match the `input_spec` shapes
+    given to `jit.save` (deploy-time static shapes, as with the reference's
+    fixed-shape TensorRT engines); XLA compiles on first run and caches."""
+
+    def __init__(self, config: Config):
+        import jax
+
+        from .. import jit as pjit
+
+        self._config = config
+        self._layer = pjit.load(config._model_prefix)
+        self._exported_in_specs = None
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+        # input names: exported calling convention is positional; synthesize
+        # stable names like the reference's feed targets
+        n_in = self._n_model_inputs()
+        self._input_names = [f"input_{i}" for i in range(n_in)]
+        self._output_names: List[str] = []
+
+    def _n_model_inputs(self) -> int:
+        ex = self._layer._exported
+        total = len(ex.in_avals)
+        return total - len(self._layer._pnames) - len(self._layer._bnames)
+
+    # -- handle API ---------------------------------------------------------
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        if name not in self._input_names:
+            raise KeyError(name)
+        return Tensor(name, self, is_input=True)
+
+    def get_output_names(self):
+        if not self._output_names:
+            raise RuntimeError("run() once to materialize output names")
+        return list(self._output_names)
+
+    def get_output_handle(self, name):
+        return Tensor(name, self, is_input=False)
+
+    # -- execution ----------------------------------------------------------
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Zero-copy run (reference `ZeroCopyRun` analysis_predictor.cc:889).
+        Either pass `inputs` positionally or pre-fill via input handles."""
+        if inputs is None:
+            inputs = [self._inputs[n] for n in self._input_names]
+        outs = self._layer(*inputs)
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        self._output_names = [f"output_{i}" for i in range(len(outs))]
+        self._outputs = {n: np.asarray(o.numpy())
+                         for n, o in zip(self._output_names, outs)}
+        return [self._outputs[n] for n in self._output_names]
+
+    def clone(self):
+        return Predictor(self._config)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

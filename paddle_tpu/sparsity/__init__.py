@@ -1,0 +1,153 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference: `python/paddle/fluid/contrib/sparsity/` — `asp.py`
+(`prune_model`, `decorate`, `set_excluded_layers`), `utils.py`
+(n:m mask creation `create_mask`, `check_sparsity`).
+
+TPU-native: 2:4 masks are computed with one top-k over reshaped groups
+(no Ampere sparse-tensor-core format needed — on TPU the win is model
+compression and the masked matmul staying dense on the MXU), and mask
+preservation after optimizer steps is a wrapper over `step()` instead of
+rewritten update ops.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import framework
+from ..core.tensor import Tensor, unwrap
+from ..nn.layer.layers import Layer
+
+__all__ = [
+    "calculate_density", "create_mask", "check_mask", "prune_model",
+    "decorate", "set_excluded_layers", "reset_excluded_layers", "ASPHelper",
+]
+
+_excluded: Dict[int, set] = {}
+
+
+def calculate_density(x) -> float:
+    """reference `sparsity/utils.py calculate_density`."""
+    a = np.asarray(unwrap(x) if isinstance(x, Tensor) else x)
+    return float((a != 0).sum() / a.size)
+
+
+def create_mask(x, n=2, m=4):
+    """n:m mask along the last axis: keep the n largest-magnitude entries of
+    every group of m (reference `utils.py create_mask`, MaskAlgo_MASK_1D).
+    Tail elements (size % m) are kept dense."""
+    a = np.asarray(unwrap(x) if isinstance(x, Tensor) else x)
+    flat = a.reshape(-1)
+    g = (flat.size // m) * m
+    groups = np.abs(flat[:g]).reshape(-1, m)
+    # indices of the top-n per group
+    order = np.argsort(-groups, axis=1)[:, :n]
+    mask = np.zeros_like(groups, dtype=np.float32)
+    np.put_along_axis(mask, order, 1.0, axis=1)
+    out = np.ones_like(flat, dtype=np.float32)
+    out[:g] = mask.reshape(-1)
+    return out.reshape(a.shape)
+
+
+def check_mask(x, n=2, m=4) -> bool:
+    """reference `utils.py check_sparsity`: every complete group of m has at
+    most n nonzeros."""
+    a = np.asarray(unwrap(x) if isinstance(x, Tensor) else x)
+    flat = a.reshape(-1)
+    g = (flat.size // m) * m
+    if g == 0:
+        return True
+    nz = (flat[:g].reshape(-1, m) != 0).sum(axis=1)
+    return bool((nz <= n).all())
+
+
+def set_excluded_layers(param_names: List[str], model: Layer):
+    _excluded[id(model)] = set(param_names)
+
+
+def reset_excluded_layers(model: Layer = None):
+    if model is None:
+        _excluded.clear()
+    else:
+        _excluded.pop(id(model), None)
+
+
+def _prunable(model: Layer):
+    """Multi-dim weights of Linear/Conv sublayers (reference
+    `asp.py _is_supported_layer`)."""
+    excluded = _excluded.get(id(model), set())
+    for name, p in model.named_parameters():
+        if p is None or p.ndim < 2:
+            continue  # biases / norm scales stay dense
+        if name in excluded:
+            continue
+        yield name, p
+
+
+class ASPHelper:
+    """reference `asp.py ASPHelper`: owns the per-model masks."""
+
+    _masks: Dict[int, Dict[str, np.ndarray]] = {}
+
+    @classmethod
+    def prune_model(cls, model: Layer, n=2, m=4, mask_algo="mask_1d",
+                    with_mask=True):
+        masks = {}
+        with framework.no_grad_guard():
+            for name, p in _prunable(model):
+                mask = create_mask(p, n=n, m=m)
+                p._array = p._array * jnp.asarray(mask)
+                masks[name] = mask
+        cls._masks[id(model)] = masks
+        return masks
+
+    @classmethod
+    def reapply_masks(cls, model: Layer):
+        masks = cls._masks.get(id(model))
+        if not masks:
+            return
+        with framework.no_grad_guard():
+            for name, p in model.named_parameters():
+                mask = masks.get(name)
+                if mask is not None:
+                    p._array = p._array * jnp.asarray(mask)
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune supported weights to n:m sparsity in place (reference
+    `asp.py prune_model`)."""
+    return ASPHelper.prune_model(model, n=n, m=m, mask_algo=mask_algo,
+                                 with_mask=with_mask)
+
+
+class _ASPOptimizer:
+    """Optimizer wrapper that re-applies masks after every step so pruned
+    entries stay zero (reference `asp.py decorate` rewrites the update ops
+    to multiply by the mask variables)."""
+
+    def __init__(self, optimizer, model: Layer):
+        self._inner = optimizer
+        self._model = model
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+        ASPHelper.reapply_masks(self._model)
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+
+def decorate(optimizer, model: Optional[Layer] = None):
+    """reference `asp.py decorate(optimizer)` — wraps the optimizer so
+    masked weights remain masked through training."""
+    if model is None:
+        raise ValueError("paddle_tpu.sparsity.decorate requires model=")
+    return _ASPOptimizer(optimizer, model)

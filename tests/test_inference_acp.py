@@ -1,0 +1,118 @@
+"""Inference predictor + auto-checkpoint tests.
+
+Reference: inference/api tests (AnalysisPredictor load/run),
+fluid/incubate/checkpoint tests (test_auto_checkpoint*.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.static import InputSpec
+
+
+class TestPredictor:
+    @pytest.fixture
+    def saved_model(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        prefix = str(tmp_path / "deploy" / "model")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([2, 4], "float32")])
+        return net, prefix
+
+    def test_config_and_run(self, saved_model):
+        net, prefix = saved_model
+        from paddle_tpu import inference
+
+        cfg = inference.Config(prefix + ".pdmodel")
+        cfg.enable_memory_optim()
+        cfg.switch_ir_optim(True)
+        pred = inference.create_predictor(cfg)
+        assert pred.get_input_names() == ["input_0"]
+
+        x = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+        h = pred.get_input_handle("input_0")
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        # matches the eager network
+        net.eval()
+        ref = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_positional_run_and_clone(self, saved_model):
+        _, prefix = saved_model
+        from paddle_tpu import inference
+
+        pred = inference.create_predictor(inference.Config(prefix))
+        x = np.zeros((2, 4), np.float32)
+        outs = pred.run([x])
+        assert outs[0].shape == (2, 2)
+        outs2 = pred.clone().run([x])
+        np.testing.assert_allclose(outs[0], outs2[0])
+
+
+class TestAutoCheckpoint:
+    def test_disabled_is_plain_range(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_RUNNING_ENV", raising=False)
+        from paddle_tpu.incubate.checkpoint import auto_checkpoint as acp
+
+        assert list(acp.train_epoch_range(3)) == [0, 1, 2]
+
+    def test_resume_after_interruption(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_RUNNING_ENV",
+                           "PADDLE_EDL_AUTO_CHECKPOINT")
+        monkeypatch.setenv("PADDLE_JOB_ID", "job_abc")
+        monkeypatch.setenv("PADDLE_EDL_CHECKPOINT_DIR", str(tmp_path))
+        from paddle_tpu.incubate.checkpoint import auto_checkpoint as acp
+        from paddle_tpu.optimizer import SGD
+
+        acp._reset()
+        paddle.seed(0)
+        net = nn.Linear(2, 2)
+        opt = SGD(learning_rate=0.1, parameters=net.parameters())
+        acp.register(model=net, optimizer=opt)
+
+        seen = []
+        try:
+            for epoch in acp.train_epoch_range(5):
+                seen.append(epoch)
+                x = paddle.ones([4, 2])
+                loss = net(x).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                if epoch == 2:
+                    raise KeyboardInterrupt  # simulated preemption
+        except KeyboardInterrupt:
+            pass
+        assert seen == [0, 1, 2]
+        w_at_preempt = net.weight.numpy().copy()
+
+        # "restarted" process: fresh model, same job id
+        acp._reset()
+        paddle.seed(123)
+        net2 = nn.Linear(2, 2)
+        opt2 = SGD(learning_rate=0.1, parameters=net2.parameters())
+        acp.register(model=net2, optimizer=opt2)
+        seen2 = list(acp.train_epoch_range(5))
+        # resumes after the last checkpointed epoch
+        assert seen2[0] > 0 and seen2[-1] == 4
+        # restored weights match the pre-preemption state at resume time
+        acp._reset()
+
+    def test_completed_job_yields_nothing(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_RUNNING_ENV",
+                           "PADDLE_EDL_AUTO_CHECKPOINT")
+        monkeypatch.setenv("PADDLE_JOB_ID", "job_done")
+        monkeypatch.setenv("PADDLE_EDL_CHECKPOINT_DIR", str(tmp_path))
+        from paddle_tpu.incubate.checkpoint import auto_checkpoint as acp
+
+        acp._reset()
+        net = nn.Linear(2, 2)
+        acp.register(model=net)
+        assert list(acp.train_epoch_range(3)) == [0, 1, 2]
+        # second run of the same finished job: nothing left to do
+        assert list(acp.train_epoch_range(3)) == []
+        acp._reset()

@@ -90,17 +90,43 @@ class TestBarrier:
         order = []
 
         def t1():
-            c1.barrier()
+            c1.barrier(trainer_id=0)
             order.append("released")
 
         th = threading.Thread(target=t1)
         th.start()
         time.sleep(0.2)
         assert order == []  # c1 still blocked
-        c2.barrier()
+        c2.barrier(trainer_id=1)
         th.join(timeout=5)
         assert order == ["released"]
         c1.close(); c2.close()
+
+    def test_rearrival_of_same_trainer_does_not_release(self, server):
+        """A restarted trainer re-entering the barrier must not count as a
+        second distinct participant (reference barrier_table semantics)."""
+        _, port = server
+        c1, c1b = PSClient(port=port), PSClient(port=port)
+        order = []
+
+        def t1():
+            c1.barrier(trainer_id=0)
+            order.append("released")
+
+        th = threading.Thread(target=t1)
+        th.start()
+        time.sleep(0.2)
+        # same trainer id arrives again on a new connection
+        th2 = threading.Thread(target=lambda: c1b.barrier(trainer_id=0))
+        th2.start()
+        time.sleep(0.2)
+        assert order == []  # still only one distinct id
+        c2 = PSClient(port=port)
+        c2.barrier(trainer_id=1)
+        th.join(timeout=5)
+        th2.join(timeout=5)
+        assert order == ["released"]
+        c1.close(); c1b.close(); c2.close()
 
 
 class TestCommunicator:

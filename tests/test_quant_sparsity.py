@@ -1,0 +1,118 @@
+"""Quantization (QAT/PTQ) + ASP sparsity tests.
+
+Reference tests: slim/tests/test_imperative_qat.py,
+test_post_training_quantization_*.py, test_asp_pruning_*.py,
+test_asp_optimize.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, quantization, sparsity
+from paddle_tpu.optimizer import SGD
+
+
+class TestFakeQuant:
+    def test_abs_max_values(self):
+        x = paddle.to_tensor(np.array([-1.0, -0.5, 0.0, 0.49, 1.0],
+                                      np.float32))
+        q = quantization.fake_quantize_abs_max(x, bit_length=8).numpy()
+        # scale 1.0, 127 levels: values snap to k/127 grid
+        np.testing.assert_allclose(q, np.round(
+            np.array([-1.0, -0.5, 0.0, 0.49, 1.0]) * 127) / 127, atol=1e-6)
+
+    def test_channel_wise_scales(self):
+        w = np.array([[1.0, 100.0], [0.5, 50.0]], np.float32)  # cols differ
+        q = quantization.fake_quantize_channel_wise_abs_max(
+            paddle.to_tensor(w), quant_axis=1).numpy()
+        # each column quantized against its own max
+        np.testing.assert_allclose(q[:, 1], [100.0, 50.0], rtol=1e-2)
+        np.testing.assert_allclose(q[:, 0], [1.0, 0.5], rtol=1e-2)
+
+    def test_ste_gradient_identity(self):
+        x = paddle.to_tensor(np.array([0.3, -0.7], np.float32))
+        x.stop_gradient = False
+        quantization.fake_quantize_abs_max(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 1.0)
+
+
+class TestQAT:
+    def test_quantize_swaps_layers_and_trains(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        qat = quantization.ImperativeQuantAware()
+        qat.quantize(net)
+        assert isinstance(net._sub_layers["0"],
+                          quantization.QuantizedLinear)
+        assert isinstance(net._sub_layers["2"],
+                          quantization.QuantizedLinear)
+        opt = SGD(learning_rate=0.05, parameters=net.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+        first = None
+        for _ in range(20):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss.numpy())
+        assert float(loss.numpy()) < first
+        # activation scale buffer was updated by forward passes
+        assert float(net._sub_layers["0"]._act_scale.numpy()) > 0
+
+    def test_ptq_calibration_sets_scales(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 4))
+        ptq = quantization.ImperativePTQ()
+        data = paddle.to_tensor(
+            np.random.RandomState(1).rand(8, 4).astype(np.float32) * 3)
+
+        ptq.quantize(net, calib_fn=lambda m: m(data))
+        scale = float(net._sub_layers["0"]._act_scale.numpy())
+        assert scale == pytest.approx(float(data.numpy().max()), rel=1e-4)
+
+
+class TestASP:
+    def test_create_and_check_mask(self):
+        rng = np.random.RandomState(0)
+        w = rng.randn(8, 16).astype(np.float32)
+        mask = sparsity.create_mask(w, n=2, m=4)
+        assert sparsity.check_mask(mask, 2, 4)
+        assert sparsity.calculate_density(mask) == pytest.approx(0.5)
+        # kept entries are the group-wise largest
+        flat = np.abs(w.reshape(-1, 4))
+        kept = mask.reshape(-1, 4)
+        for g in range(flat.shape[0]):
+            top2 = set(np.argsort(-flat[g])[:2])
+            assert set(np.nonzero(kept[g])[0]) == top2
+
+    def test_prune_model_and_mask_preserved_through_training(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+        sparsity.prune_model(net, n=2, m=4)
+        for _, p in net.named_parameters():
+            if p.ndim >= 2:
+                assert sparsity.check_mask(p.numpy(), 2, 4)
+        opt = sparsity.decorate(
+            SGD(learning_rate=0.05, parameters=net.parameters()), model=net)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+        for _ in range(5):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        for _, p in net.named_parameters():
+            if p.ndim >= 2:
+                assert sparsity.check_mask(p.numpy(), 2, 4)
+
+    def test_excluded_layers(self):
+        net = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+        name0 = next(iter(dict(net.named_parameters())))
+        sparsity.set_excluded_layers([name0], net)
+        sparsity.prune_model(net, n=1, m=4)
+        params = dict(net.named_parameters())
+        assert sparsity.calculate_density(params[name0].numpy()) == 1.0
+        sparsity.reset_excluded_layers(net)
