@@ -341,10 +341,11 @@ class Server {
             return barrier_generation_ != gen || stopped_.load();
           });
         }
-        // a stop-released waiter must not look like a completed barrier
-        uint8_t status = (barrier_generation_ == gen && stopped_.load())
-                             ? 3
-                             : 0;
+        // a stop-released waiter must not look like a completed barrier;
+        // RequestStop() bumps the generation, so the only reliable signal
+        // is the stop flag itself (conservatively flagging a genuine
+        // release that raced the stop is fine — shutdown is in progress)
+        uint8_t status = stopped_.load() ? 3 : 0;
         return SendResponse(fd, status, nullptr, 0);
       }
       case kStop: {

@@ -40,6 +40,7 @@ from . import incubate
 from . import inference
 from . import quantization
 from . import sparsity
+from . import text
 from . import profiler
 from . import regularizer
 from .framework.param_attr import ParamAttr

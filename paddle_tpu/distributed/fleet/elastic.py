@@ -1,0 +1,150 @@
+"""Elastic training manager.
+
+Reference: `python/paddle/distributed/fleet/elastic.py:90-328`
+(`ElasticManager`): registers this node in **etcd**, watches the
+host/np/endpoint keys, and on membership change kills local trainers and
+relaunches them with re-assigned ranks; scale-in/out is matched against
+`PADDLE_ELASTIC_NP`.
+
+TPU-native: etcd is an environment detail — the manager takes a pluggable
+KV store.  `FileKVStore` (shared filesystem, the common TPU-pod case)
+ships in-tree; an etcd adapter can implement the same 4-method interface.
+The watch loop and rank-reassignment semantics follow the reference.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ElasticManager", "FileKVStore", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class FileKVStore:
+    """Membership KV on a shared filesystem (stands in for the reference's
+    etcd prefix `/paddle/<job_id>/nodes/`)."""
+
+    def __init__(self, root: str):
+        self._root = root
+        os.makedirs(root, exist_ok=True)
+
+    def put(self, key: str, value: str):
+        path = os.path.join(self._root, key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(value)
+        os.replace(tmp, path)
+
+    def get(self, key: str) -> Optional[str]:
+        path = os.path.join(self._root, key)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return f.read()
+
+    def delete(self, key: str):
+        path = os.path.join(self._root, key)
+        if os.path.exists(path):
+            os.remove(path)
+
+    def list(self, prefix: str) -> Dict[str, str]:
+        d = os.path.join(self._root, prefix)
+        out = {}
+        if os.path.isdir(d):
+            for fn in sorted(os.listdir(d)):
+                if fn.endswith(".tmp"):
+                    continue
+                with open(os.path.join(d, fn)) as f:
+                    out[f"{prefix}/{fn}"] = f.read()
+        return out
+
+
+class ElasticManager:
+    """Watches membership; on change, re-ranks and triggers restart.
+
+    `on_restart(new_ranks: dict)` is the relaunch hook (the reference kills
+    and respawns local trainer procs; tests inject a recorder)."""
+
+    def __init__(self, kv, job_id: Optional[str] = None,
+                 host: Optional[str] = None,
+                 np_target: Optional[int] = None,
+                 watch_interval_s: float = 0.2,
+                 on_restart: Optional[Callable] = None):
+        self.kv = kv
+        self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
+        self.host = host or os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                                           "127.0.0.1:0")
+        self.np_target = int(np_target if np_target is not None else
+                             os.environ.get("PADDLE_ELASTIC_NP", "1"))
+        self.watch_interval_s = watch_interval_s
+        self.on_restart = on_restart
+        self._prefix = f"{self.job_id}/nodes"
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._last_members: Optional[List[str]] = None
+        self.enabled = self.np_target > 0
+
+    # -- membership ---------------------------------------------------------
+    def register(self):
+        """reference `:154` — publish this node under the job prefix."""
+        self.kv.put(f"{self._prefix}/{self.host.replace(':', '_')}",
+                    json.dumps({"host": self.host, "ts": time.time()}))
+
+    def deregister(self):
+        self.kv.delete(f"{self._prefix}/{self.host.replace(':', '_')}")
+
+    def hosts(self) -> List[str]:
+        vals = self.kv.list(self._prefix)
+        return sorted(json.loads(v)["host"] for v in vals.values())
+
+    def _assign_ranks(self, members: List[str]) -> Dict[str, int]:
+        return {h: i for i, h in enumerate(members)}
+
+    # -- scale decisions (reference `_match` / scale-in/out `:246`) ---------
+    def match(self) -> bool:
+        """True when membership equals the elastic target np."""
+        return len(self.hosts()) == self.np_target
+
+    def status(self) -> str:
+        n = len(self.hosts())
+        if n == self.np_target:
+            return ElasticStatus.COMPLETED
+        return ElasticStatus.HOLD
+
+    # -- watch loop (reference `watch` `:301`) ------------------------------
+    def start(self):
+        self._running = True
+        self._last_members = self.hosts()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _watch(self):
+        while self._running:
+            time.sleep(self.watch_interval_s)
+            try:
+                members = self.hosts()
+            except OSError:
+                continue
+            if members != self._last_members:
+                self._last_members = members
+                ranks = self._assign_ranks(members)
+                # reference `_update_hosts` `:246`: re-rank, then restart
+                if self.on_restart is not None:
+                    self.on_restart(ranks)

