@@ -63,14 +63,28 @@ def _spawn(cmd: List[str], env: dict, log_dir: Optional[str], tag: str,
 def launch(training_script: str, training_script_args: List[str],
            nproc_per_node: int = 1, servers: str = "", workers: str = "",
            ips: str = "127.0.0.1", start_port: int = 6070,
-           log_dir: Optional[str] = None, env_extra: Optional[dict] = None):
+           log_dir: Optional[str] = None, env_extra: Optional[dict] = None,
+           node_rank: Optional[int] = None):
     """Programmatic entry (reference `launch.launch_collective/_ps`).
-    Returns the list of exit codes."""
+    Returns the list of exit codes for the processes spawned ON THIS NODE:
+    with a multi-node `ips` list, each node runs this same command and
+    spawns only its own slice of ranks (`node_rank` defaults from
+    PADDLE_NODE_RANK, mirroring the reference's pod-by-current-ip filter).
+    """
     ns = argparse.Namespace(nproc_per_node=nproc_per_node, servers=servers,
                             workers=workers, ips=ips, start_port=start_port)
     cluster = get_cluster_from_args(ns)
     procs: List[_Proc] = []
     ps_mode = bool(cluster["servers"])
+    if node_rank is None:
+        node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
+    node_ips = (ips or "127.0.0.1").split(",")
+    if not 0 <= node_rank < len(node_ips):
+        raise ValueError(f"node_rank {node_rank} out of range for ips {ips}")
+    node_ip = node_ips[node_rank]
+
+    def _is_local(endpoint: str) -> bool:
+        return endpoint.rsplit(":", 1)[0] == node_ip
 
     def base_env():
         e = dict(os.environ)
@@ -79,8 +93,10 @@ def launch(training_script: str, training_script_args: List[str],
 
     try:
         if ps_mode:
-            # parameter-server mode: spawn servers then workers
+            # parameter-server mode: spawn this node's servers then workers
             for i, ep in enumerate(cluster["servers"]):
+                if not _is_local(ep):
+                    continue
                 env = base_env()
                 env.update({
                     "TRAINING_ROLE": "PSERVER",
@@ -99,6 +115,8 @@ def launch(training_script: str, training_script_args: List[str],
                 for i in range(nproc_per_node)]
             n_workers = len(worker_eps)
             for i, wep in enumerate(worker_eps):
+                if not _is_local(wep):
+                    continue
                 env = base_env()
                 env.update({
                     "TRAINING_ROLE": "TRAINER",
@@ -115,6 +133,8 @@ def launch(training_script: str, training_script_args: List[str],
         else:
             eps = cluster["trainers"]
             for i, ep in enumerate(eps):
+                if not _is_local(ep):
+                    continue
                 env = base_env()
                 env.update({
                     "TRAINING_ROLE": "TRAINER",
@@ -159,6 +179,7 @@ def main(argv=None):
     parser.add_argument("--servers", type=str, default="")
     parser.add_argument("--workers", type=str, default="")
     parser.add_argument("--start_port", type=int, default=6070)
+    parser.add_argument("--node_rank", type=int, default=None)
     parser.add_argument("--log_dir", type=str, default=None)
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -166,7 +187,8 @@ def main(argv=None):
     codes = launch(args.training_script, args.training_script_args,
                    nproc_per_node=args.nproc_per_node, servers=args.servers,
                    workers=args.workers, ips=args.ips,
-                   start_port=args.start_port, log_dir=args.log_dir)
+                   start_port=args.start_port, log_dir=args.log_dir,
+                   node_rank=args.node_rank)
     bad = [c for c in codes if c]
     sys.exit(bad[0] if bad else 0)
 

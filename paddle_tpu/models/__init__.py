@@ -1,4 +1,7 @@
 """Flagship model zoo (reference: ERNIE/GPT-class language models trained
 via fleet, plus the paddle.vision CNNs re-exported here)."""
+from .bert import (BertConfig, BertForPretraining, BertModel, ErnieModel,
+                   bert_pretrain_loss_fn)
 from .gpt import GPT, GPTConfig, gpt_loss_fn
-from ..vision.models import LeNet, ResNet, resnet50
+from ..vision.models import (LeNet, ResNet, VisionTransformer, resnet50,
+                             vit_b_16)

@@ -78,6 +78,18 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
     nd = len(normalized_shape)
     axes = tuple(range(-nd, 0))
 
+    if weight is not None and nd == 1:
+        # common single-axis case: fused Pallas kernel on TPU (XLA composed
+        # form elsewhere) — reference fused layer_norm CUDA kernels
+        from ...ops.pallas.layer_norm import fused_layer_norm
+
+        def f1(a, w, b):
+            shape = a.shape
+            out = fused_layer_norm(a.reshape(-1, shape[-1]), w, b, epsilon)
+            return out.reshape(shape)
+
+        return dispatch(f1, x, weight, bias)
+
     def f(a, *wb):
         a32 = a.astype(jnp.float32)
         mean = jnp.mean(a32, axis=axes, keepdims=True)

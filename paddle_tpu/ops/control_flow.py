@@ -1,0 +1,160 @@
+"""Control-flow operators.
+
+Reference: `python/paddle/fluid/layers/control_flow.py` (3.8 k LoC —
+`cond`, `while_loop`, `case`, `switch_case` built on `conditional_block_op`
+and `while_op` sub-block execution, `operators/controlflow/`).
+
+TPU-native: these lower directly to `lax.cond` / `lax.while_loop` /
+`lax.switch` — XLA's structured control flow, which compiles into the same
+program instead of the reference's interpreter-driven sub-blocks.  Eager
+mode short-circuits on concrete predicates (matching dygraph semantics
+where Python `if` just works).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, unwrap
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _concrete(pred) -> bool | None:
+    """Return a python bool if the predicate is concrete (eager), else
+    None (tracing: must lower to lax)."""
+    arr = unwrap(pred) if isinstance(pred, Tensor) else pred
+    if isinstance(arr, (bool, int)):
+        return bool(arr)
+    if isinstance(arr, jax.core.Tracer):
+        return None
+    return bool(jax.device_get(arr))
+
+
+def _unwrap_tree(x):
+    return jax.tree_util.tree_map(
+        lambda v: unwrap(v) if isinstance(v, Tensor) else v, x,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """reference `layers.cond` (`control_flow.py:2091` area): run one of two
+    branches.  Under jit both branches trace (lax.cond); eagerly only the
+    taken branch runs."""
+    c = _concrete(pred)
+    if c is not None:
+        return true_fn() if c else false_fn()
+    p = unwrap(pred).reshape(())
+
+    def tf(_):
+        return _unwrap_tree(true_fn())
+
+    def ff(_):
+        return _unwrap_tree(false_fn())
+
+    out = lax.cond(p, tf, ff, operand=None)
+    return jax.tree_util.tree_map(Tensor, out)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test=False, name=None):
+    """reference `layers.while_loop` (`control_flow.py:1014`): iterate
+    body while cond holds.  Shapes must be loop-invariant (the reference's
+    while_op has the same constraint for compiled use)."""
+    vars_ = list(loop_vars)
+    c = _concrete(cond_fn(*vars_))
+    if c is not None:
+        # eager loop: concrete python iteration (dygraph semantics)
+        while c:
+            out = body_fn(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+            c = _concrete(cond_fn(*vars_))
+            if c is None:
+                raise RuntimeError("predicate became abstract mid-loop")
+        return vars_
+
+    init = tuple(_unwrap_tree(v) for v in vars_)
+
+    def cf(state):
+        return unwrap(cond_fn(*[Tensor(s) if not isinstance(s, Tensor)
+                                else s for s in state])).reshape(())
+
+    def bf(state):
+        out = body_fn(*[Tensor(s) for s in state])
+        out = out if isinstance(out, (list, tuple)) else (out,)
+        return tuple(_unwrap_tree(o) for o in out)
+
+    final = lax.while_loop(cf, bf, init)
+    return [Tensor(f) for f in final]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference `layers.case` (`control_flow.py:2811`): first true
+    predicate wins."""
+    pairs = list(pred_fn_pairs)
+    concretes = [_concrete(p) for p, _ in pairs]
+    if all(c is not None for c in concretes):
+        for c, (_, fn) in zip(concretes, pairs):
+            if c:
+                return fn()
+        # reference semantics: with no default, the LAST pair's fn is the
+        # fallback (fluid layers.case docstring) — matches the traced path
+        return default() if default is not None else pairs[-1][1]()
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+    preds = jnp.stack([unwrap(p).reshape(()) for p, _ in pairs])
+    # index of first true predicate; len(pairs) = default
+    first = jnp.argmax(preds)
+    idx = jnp.where(jnp.any(preds), first, len(pairs))
+    fns = [fn for _, fn in pairs] + [default]
+
+    out = lax.switch(idx, [lambda _, f=f: _unwrap_tree(f()) for f in fns],
+                     None)
+    return jax.tree_util.tree_map(Tensor, out)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference `layers.switch_case` (`control_flow.py:2990`)."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        index_map = {k: i for i, k in enumerate(keys)}
+    else:
+        fns = list(branch_fns)
+        index_map = None
+    bi = _concrete_index(branch_index)
+    if bi is not None:
+        i = index_map.get(bi) if index_map is not None else (
+            bi if 0 <= bi < len(fns) else None)
+        if i is None:
+            if default is None:
+                raise ValueError(f"branch {bi} out of range, no default")
+            return default()
+        return fns[i]()
+    # traced index
+    if default is None:
+        default = fns[-1]
+    arr = unwrap(branch_index).reshape(())
+    if index_map is not None:
+        keys_arr = jnp.asarray(sorted(index_map))
+        matches = keys_arr == arr
+        idx = jnp.where(jnp.any(matches), jnp.argmax(matches), len(fns))
+    else:
+        idx = jnp.where((arr >= 0) & (arr < len(fns)), arr, len(fns))
+    all_fns = fns + [default]
+    out = lax.switch(idx, [lambda _, f=f: _unwrap_tree(f())
+                           for f in all_fns], None)
+    return jax.tree_util.tree_map(Tensor, out)
+
+
+def _concrete_index(i):
+    arr = unwrap(i) if isinstance(i, Tensor) else i
+    if isinstance(arr, int):
+        return arr
+    if isinstance(arr, jax.core.Tracer):
+        return None
+    return int(jax.device_get(arr))

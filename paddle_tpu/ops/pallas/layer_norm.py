@@ -1,0 +1,116 @@
+"""Fused LayerNorm Pallas kernel (TPU).
+
+Replaces the reference's dedicated fused norm kernels
+(`operators/fused/fused_fc_elementwise_layernorm_op.cu`,
+`operators/fused/skip_layernorm_op.*`, `operators/layer_norm_op.cu`'s
+Welford block kernels): one VMEM-resident pass computes mean/rstd and the
+normalized output per row tile, keeping the feature dim in lanes
+(pallas_guide.md: last dim multiple of 128 maps onto the VPU lanes).
+
+Gradient: custom_vjp whose backward uses the standard composed XLA form
+(itself fully fused by XLA) with the saved mean/rstd — the memory win of
+the kernel is in not materializing normalized intermediates in HBM on the
+forward.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _ln_kernel(x_ref, w_ref, b_ref, o_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)  # [block_rows, d]
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = (x - mean) * rstd
+    y = y * w_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    o_ref[...] = y.astype(o_ref.dtype)
+    mean_ref[...] = mean[:, 0]
+    rstd_ref[...] = rstd[:, 0]
+
+
+def _fwd_pallas(x2d, w, b, eps, block_rows=256):
+    n, d = x2d.shape
+    if n == 0:
+        return _fwd_xla(x2d, w, b, eps)
+    rows = min(block_rows, n)
+    while n % rows:
+        rows //= 2
+    rows = max(rows, 1)
+    grid = (n // rows,)
+    out, mean, rstd = pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((rows,), lambda i: (i,)),
+            pl.BlockSpec((rows,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, d), x2d.dtype),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+        ],
+    )(x2d, w, b)
+    return out, mean, rstd
+
+
+def _fwd_xla(x2d, w, b, eps):
+    x32 = x2d.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1)
+    var = jnp.var(x32, axis=-1)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = (x32 - mean[:, None]) * rstd[:, None]
+    return (y * w + b).astype(x2d.dtype), mean, rstd
+
+
+def _use_pallas(d: int) -> bool:
+    return (_HAS_PALLAS and jax.default_backend() == "tpu" and
+            d % 128 == 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layer_norm(x2d, w, b, eps=1e-5):
+    """x2d: [rows, d]; w/b: [d].  Returns normalized [rows, d]."""
+    out, _, _ = (_fwd_pallas if _use_pallas(x2d.shape[-1])
+                 else _fwd_xla)(x2d, w, b, eps)
+    return out
+
+
+def _vjp_fwd(x2d, w, b, eps):
+    out, mean, rstd = (_fwd_pallas if _use_pallas(x2d.shape[-1])
+                       else _fwd_xla)(x2d, w, b, eps)
+    return out, (x2d, w, mean, rstd)
+
+
+def _vjp_bwd(eps, res, g):
+    x2d, w, mean, rstd = res
+    x32 = x2d.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    xhat = (x32 - mean[:, None]) * rstd[:, None]
+    dw = jnp.sum(g32 * xhat, axis=0).astype(w.dtype)
+    db = jnp.sum(g32, axis=0).astype(w.dtype)
+    gy = g32 * w.astype(jnp.float32)
+    d = x2d.shape[-1]
+    dx = (gy - jnp.mean(gy, axis=-1, keepdims=True) -
+          xhat * jnp.mean(gy * xhat, axis=-1, keepdims=True))
+    dx = (dx * rstd[:, None]).astype(x2d.dtype)
+    return dx, dw, db
+
+
+fused_layer_norm.defvjp(_vjp_fwd, _vjp_bwd)

@@ -19,6 +19,9 @@ from .input_spec import InputSpec
 from ..core.place import CPUPlace, TPUPlace
 
 
+from . import nn  # noqa: E402  (control-flow + layer surface)
+
+
 class Program:
     """Facade for API parity.  Holds nothing until a function is captured."""
 

@@ -1,0 +1,10 @@
+"""`paddle.static.nn` — static-graph layer/control-flow surface.
+
+Reference: `python/paddle/static/nn/__init__.py` (fc, control flow ops).
+The control-flow ops lower to lax primitives (see ops/control_flow.py);
+layer builders delegate to the shared nn layers since this framework has
+one compiled representation rather than a separate static op graph.
+"""
+from ..ops.control_flow import case, cond, switch_case, while_loop
+
+__all__ = ["case", "cond", "switch_case", "while_loop"]

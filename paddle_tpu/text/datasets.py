@@ -7,11 +7,11 @@ Reference: `python/paddle/text/datasets/` — Imdb (`imdb.py`), Imikolov
 yields numpy samples through the `paddle.io.Dataset` protocol.
 
 TPU-image note: this build environment has **zero network egress**, so
-each dataset supports (a) `data_file=` pointing at a pre-downloaded corpus
-in the reference's archive format, and (b) a deterministic synthetic
-corpus (`mode='train'/'test'` with `synthetic=True`, the default when no
-file is given) so pipelines and tests run hermetically.  The synthetic
-generators preserve each dataset's sample *schema* exactly.
+every dataset provides a deterministic synthetic corpus (the default when
+no file is given) preserving the reference's sample *schema* exactly;
+Imdb/Imikolov/UCIHousing additionally accept `data_file=` pointing at a
+pre-downloaded corpus in the reference's archive format, and the others
+raise NotImplementedError on `data_file` rather than silently ignoring it.
 """
 from __future__ import annotations
 
@@ -150,6 +150,10 @@ class Movielens(Dataset):
     def __init__(self, data_file: Optional[str] = None, mode="train",
                  test_ratio=0.1, rand_seed=0, num_samples=2048,
                  num_users=500, num_movies=300):
+        if data_file is not None:
+            raise NotImplementedError(
+                f"{type(self).__name__}: archive loading is not implemented;"
+                " omit data_file for the deterministic synthetic corpus")
         r = _rng("movielens", mode)
         self.num_users = num_users
         self.num_movies = num_movies
@@ -214,6 +218,10 @@ class Conll05st(Dataset):
     def __init__(self, data_file: Optional[str] = None, mode="train",
                  num_samples=256, vocab_size=5000, num_labels=67,
                  seq_len=24):
+        if data_file is not None:
+            raise NotImplementedError(
+                f"{type(self).__name__}: archive loading is not implemented;"
+                " omit data_file for the deterministic synthetic corpus")
         r = _rng("conll05", mode)
         self.samples = []
         for _ in range(num_samples):
@@ -238,7 +246,12 @@ class _WMTBase(Dataset):
 
     BOS, EOS, UNK = 0, 1, 2
 
-    def __init__(self, name, mode, dict_size, num_samples, seq_len):
+    def __init__(self, name, mode, dict_size, num_samples, seq_len,
+                 data_file=None):
+        if data_file is not None:
+            raise NotImplementedError(
+                f"{type(self).__name__}: archive loading is not implemented;"
+                " omit data_file for the deterministic synthetic corpus")
         r = _rng(name, mode)
         dict_size = max(dict_size, 16)
         self.src_dict = {f"s{i}": i for i in range(dict_size)}
@@ -265,7 +278,8 @@ class WMT14(_WMTBase):
 
     def __init__(self, data_file: Optional[str] = None, mode="train",
                  dict_size=1000, num_samples=512, seq_len=20):
-        super().__init__("wmt14", mode, dict_size, num_samples, seq_len)
+        super().__init__("wmt14", mode, dict_size, num_samples, seq_len,
+                         data_file=data_file)
 
 
 class WMT16(_WMTBase):
@@ -276,4 +290,4 @@ class WMT16(_WMTBase):
                  lang="en", num_samples=512, seq_len=20):
         super().__init__("wmt16", mode,
                          max(src_lang_dict_size, trg_lang_dict_size),
-                         num_samples, seq_len)
+                         num_samples, seq_len, data_file=data_file)

@@ -55,12 +55,17 @@ def _compile(name: str, sources: Sequence[str],
     out = os.path.join(get_build_directory(),
                        f"{name}-{h.hexdigest()[:12]}.so")
     if not os.path.exists(out):
+        # compile to a unique temp path and rename: concurrent workers
+        # (fleet launch) racing on the same cache entry must never dlopen
+        # a half-written library
+        tmp = f"{out}.{os.getpid()}.tmp"
         cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-               *extra_cxx_flags, *srcs, "-o", out]
+               *extra_cxx_flags, *srcs, "-o", tmp]
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"custom op build failed:\n{proc.stderr[-4000:]}")
+        os.replace(tmp, out)
     return out
 
 

@@ -4,3 +4,4 @@ from .resnet import (BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34,
                      resnet50, resnet101, resnet152, resnext50_32x4d,
                      wide_resnet50_2)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .vit import VisionTransformer, vit_b_16, vit_l_16, vit_s_16

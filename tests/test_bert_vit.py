@@ -1,0 +1,148 @@
+"""BERT/ERNIE + ViT model tests.
+
+Reference: `dygraph_to_static/test_bert.py` + `bert_dygraph_model.py`
+(pretrain model trains and is to_static-able), vision model tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                    BertModel, ErnieModel,
+                                    bert_pretrain_loss_fn)
+from paddle_tpu.optimizer import AdamW
+
+
+def tiny_cfg():
+    return BertConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                      num_heads=4, intermediate_size=64,
+                      max_position_embeddings=64, hidden_dropout=0.0,
+                      attention_dropout=0.0)
+
+
+def make_batch(rng, b=2, s=16, p=4, vocab=128):
+    return dict(
+        input_ids=paddle.to_tensor(
+            rng.integers(3, vocab, (b, s)).astype(np.int32)),
+        token_type_ids=paddle.to_tensor(
+            (rng.random((b, s)) > 0.5).astype(np.int32)),
+        masked_positions=paddle.to_tensor(
+            rng.integers(0, s, (b, p)).astype(np.int32)),
+        masked_labels=paddle.to_tensor(
+            rng.integers(3, vocab, (b, p)).astype(np.int32)),
+        nsp_labels=paddle.to_tensor(rng.integers(0, 2, (b,)).astype(np.int32)),
+        masked_weights=paddle.to_tensor(
+            np.ones((b, p), np.float32)),
+    )
+
+
+class TestBert:
+    def test_trunk_shapes(self):
+        paddle.seed(0)
+        model = BertModel(tiny_cfg())
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(rng.integers(3, 128, (2, 16)).astype(np.int32))
+        seq, pooled = model(ids)
+        assert seq.shape == [2, 16, 32] and pooled.shape == [2, 32]
+
+    def test_padding_is_masked(self):
+        """pad tokens must not change non-pad token representations."""
+        paddle.seed(0)
+        model = BertModel(tiny_cfg())
+        model.eval()
+        rng = np.random.default_rng(1)
+        ids = rng.integers(3, 128, (1, 8)).astype(np.int32)
+        a = np.concatenate([ids, np.zeros((1, 4), np.int32)], axis=1)
+        b = np.concatenate([ids, np.full((1, 4), 77, np.int32)], axis=1)
+        mask = np.concatenate([np.ones((1, 8)), np.zeros((1, 4))],
+                              axis=1).astype(np.int32)
+        sa, _ = model(paddle.to_tensor(a),
+                      attention_mask=paddle.to_tensor(mask))
+        sb, _ = model(paddle.to_tensor(b),
+                      attention_mask=paddle.to_tensor(mask))
+        np.testing.assert_allclose(sa.numpy()[:, :8], sb.numpy()[:, :8],
+                                   atol=1e-5)
+
+    def test_pretrain_learns(self):
+        paddle.seed(0)
+        model = BertForPretraining(tiny_cfg())
+        opt = AdamW(learning_rate=3e-4, parameters=model.parameters())
+        rng = np.random.default_rng(0)
+        batch = make_batch(rng)
+        first = None
+        for _ in range(15):
+            loss = bert_pretrain_loss_fn(model, **batch)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss.numpy())
+        assert float(loss.numpy()) < first * 0.9
+
+    def test_mlm_head_tied_to_embeddings(self):
+        model = BertForPretraining(tiny_cfg())
+        assert model.heads.decoder_weight is \
+            model.bert.embeddings.word_embeddings.weight
+
+    def test_jit_train_step(self):
+        """Whole pretrain step compiles as ONE executable (the TPU-native
+        path the per-op reference dispatch maps to)."""
+        from paddle_tpu import jit
+
+        paddle.seed(0)
+        model = BertForPretraining(tiny_cfg())
+        opt = AdamW(learning_rate=3e-4, parameters=model.parameters())
+
+        def loss_fn(m, input_ids, token_type_ids, masked_positions,
+                    masked_labels, nsp_labels):
+            return bert_pretrain_loss_fn(m, input_ids, token_type_ids,
+                                         masked_positions, masked_labels,
+                                         nsp_labels)
+
+        step = jit.train_step(model, loss_fn, opt)
+        rng = np.random.default_rng(0)
+        b = make_batch(rng)
+        losses = [float(step(b["input_ids"], b["token_type_ids"],
+                             b["masked_positions"], b["masked_labels"],
+                             b["nsp_labels"]).numpy()) for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+    def test_ernie_variant(self):
+        paddle.seed(0)
+        model = ErnieModel(vocab_size=100, hidden_size=32, num_layers=1,
+                           num_heads=4, intermediate_size=64)
+        ids = paddle.to_tensor(
+            np.random.default_rng(0).integers(3, 100, (2, 8))
+            .astype(np.int32))
+        seq, pooled = model(ids)
+        assert seq.shape == [2, 8, 32] and pooled.shape == [2, 32]
+
+
+class TestViT:
+    def test_forward_and_learn(self):
+        from paddle_tpu.vision.models import VisionTransformer
+
+        paddle.seed(0)
+        model = VisionTransformer(image_size=16, patch_size=4, in_channels=3,
+                                  num_classes=5, embed_dim=32, depth=2,
+                                  num_heads=4, dropout=0.0)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.random((4, 3, 16, 16)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 5, (4,)).astype(np.int32))
+        out = model(x)
+        assert out.shape == [4, 5]
+        opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+        first = None
+        for _ in range(10):
+            loss = nn.functional.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss.numpy())
+        assert float(loss.numpy()) < first
+
+    def test_variants_exist(self):
+        from paddle_tpu.vision.models import vit_b_16, vit_l_16, vit_s_16
+
+        m = vit_s_16(num_classes=10, image_size=32, patch_size=16)
+        assert m.embed_dim == 384

@@ -1,0 +1,91 @@
+"""Control-flow op tests (reference fluid/layers/control_flow.py:
+test_cond.py, test_while_loop_op.py, test_case.py, test_switch_case.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import control_flow as cf
+from paddle_tpu.static import nn as static_nn
+
+
+class TestCond:
+    def test_eager_concrete(self):
+        x = paddle.to_tensor(np.float32(3.0))
+        out = cf.cond(x > 2, lambda: x + 1, lambda: x - 1)
+        assert float(out.numpy()) == 4.0
+        out = cf.cond(x > 5, lambda: x + 1, lambda: x - 1)
+        assert float(out.numpy()) == 2.0
+
+    def test_traced_under_jit(self):
+        def f(a):
+            t = paddle.to_tensor(a)
+            return cf.cond(t.sum() > 0,
+                           lambda: t * 2,
+                           lambda: t * -1)._array
+
+        jf = jax.jit(f)
+        np.testing.assert_allclose(np.asarray(jf(jnp.asarray([1.0, 2.0]))),
+                                   [2.0, 4.0])
+        np.testing.assert_allclose(np.asarray(jf(jnp.asarray([-1.0, -2.0]))),
+                                   [1.0, 2.0])
+
+
+class TestWhileLoop:
+    def test_eager(self):
+        i = paddle.to_tensor(np.int32(0))
+        s = paddle.to_tensor(np.float32(0.0))
+        i, s = cf.while_loop(lambda i, s: i < 5,
+                             lambda i, s: (i + 1, s + 2.0), [i, s])
+        assert int(i.numpy()) == 5 and float(s.numpy()) == 10.0
+
+    def test_traced(self):
+        def f(n):
+            i = paddle.to_tensor(jnp.asarray(0))
+            acc = paddle.to_tensor(jnp.asarray(1.0))
+            nt = paddle.to_tensor(n)
+            i, acc, _ = cf.while_loop(
+                lambda i, a, n_: i < n_,
+                lambda i, a, n_: (i + 1, a * 2.0, n_), [i, acc, nt])
+            return acc._array
+
+        out = jax.jit(f)(jnp.asarray(6))
+        assert float(out) == 64.0
+
+
+class TestCaseSwitch:
+    def test_case_eager(self):
+        x = paddle.to_tensor(np.float32(0.3))
+        out = cf.case([(x > 0.5, lambda: x * 10),
+                       (x > 0.2, lambda: x * 100)],
+                      default=lambda: x)
+        assert float(out.numpy()) == pytest.approx(30.0)
+
+    def test_switch_case_eager(self):
+        fns = {1: lambda: paddle.to_tensor(np.float32(10.0)),
+               3: lambda: paddle.to_tensor(np.float32(30.0))}
+        out = cf.switch_case(3, fns,
+                             default=lambda: paddle.to_tensor(np.float32(-1)))
+        assert float(out.numpy()) == 30.0
+        out = cf.switch_case(2, fns,
+                             default=lambda: paddle.to_tensor(np.float32(-1)))
+        assert float(out.numpy()) == -1.0
+
+    def test_switch_case_traced(self):
+        def f(i):
+            it = paddle.to_tensor(i)
+            return cf.switch_case(
+                it, [lambda: paddle.to_tensor(jnp.asarray(1.0)),
+                     lambda: paddle.to_tensor(jnp.asarray(2.0))],
+                default=lambda: paddle.to_tensor(jnp.asarray(-1.0)))._array
+
+        jf = jax.jit(f)
+        assert float(jf(jnp.asarray(0))) == 1.0
+        assert float(jf(jnp.asarray(1))) == 2.0
+        assert float(jf(jnp.asarray(7))) == -1.0
+
+    def test_static_nn_namespace(self):
+        assert static_nn.cond is cf.cond
+        assert paddle.while_loop is cf.while_loop
