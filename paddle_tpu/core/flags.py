@@ -59,3 +59,7 @@ define_flag("eager_jit_ops", True, "allow per-op jit caching in eager mode")
 define_flag("amp_dtype", "bfloat16", "autocast compute dtype (TPU: bfloat16)")
 define_flag("allocator_strategy", "pjrt", "memory is managed by PJRT")
 define_flag("log_level", 0, "VLOG-style verbosity")
+define_flag("use_pallas_attention", "auto",
+            "attention kernel policy: auto (seq>=2048), 1 force, 0 off")
+define_flag("use_pallas_layernorm", False,
+            "use the Pallas fused layer_norm kernel instead of XLA fusion")

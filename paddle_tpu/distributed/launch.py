@@ -148,6 +148,11 @@ def launch(training_script: str, training_script_args: List[str],
                     [sys.executable, "-u", training_script,
                      *training_script_args], env, log_dir, "workerlog", i))
 
+        if not procs:
+            raise RuntimeError(
+                f"no server/worker/trainer endpoint matched this node's ip "
+                f"{node_ip!r} (node_rank {node_rank} of ips {ips!r}); "
+                "check --ips/--node_rank against your endpoint lists")
         # watchdog: any child failing aborts the job (reference
         # `watch_local_trainers` / TrainerProc handling)
         codes = [None] * len(procs)

@@ -115,8 +115,10 @@ class BertPretrainingHeads(nn.Layer):
         self.seq_relationship = nn.Linear(cfg.hidden_size, 2)
 
     def forward(self, sequence_output, pooled_output, masked_positions):
-        """masked_positions: [B, P] int32 (padded with 0s — static shape;
-        the loss masks the padding)."""
+        """masked_positions: [B, P] int32, static shape.  When P is padded
+        (fewer than P real masks), the caller must pass matching
+        `masked_weights` to `bert_pretrain_loss_fn` — the heads compute
+        logits for every slot and only the loss can tell padding apart."""
         import paddle_tpu as paddle
 
         b, s, h = sequence_output.shape
@@ -148,8 +150,9 @@ class BertForPretraining(nn.Layer):
 def bert_pretrain_loss_fn(model, input_ids, token_type_ids,
                           masked_positions, masked_labels, nsp_labels,
                           masked_weights=None):
-    """MLM + NSP loss (reference PretrainModelLayer.forward loss tail);
-    masked_weights zeroes padded mask slots."""
+    """MLM + NSP loss (reference PretrainModelLayer.forward loss tail).
+    `masked_weights` ([B, P], 1.0 = real mask slot) zeroes padded slots;
+    None means every slot in masked_positions is a real mask."""
     import paddle_tpu as paddle
 
     mlm_logits, nsp_logits = model(input_ids, token_type_ids,

@@ -78,7 +78,7 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
     nd = len(normalized_shape)
     axes = tuple(range(-nd, 0))
 
-    if weight is not None and nd == 1:
+    if weight is not None and bias is not None and nd == 1:
         # common single-axis case: fused Pallas kernel on TPU (XLA composed
         # form elsewhere) — reference fused layer_norm CUDA kernels
         from ...ops.pallas.layer_norm import fused_layer_norm

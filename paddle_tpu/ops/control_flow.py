@@ -106,7 +106,9 @@ def case(pred_fn_pairs, default=None, name=None):
     if default is None:
         default = pairs[-1][1]
         pairs = pairs[:-1]
-    preds = jnp.stack([unwrap(p).reshape(()) for p, _ in pairs])
+    # jnp.asarray: a mix of concrete python bools and traced predicates is
+    # legal (config constant + data-dependent) and must all lift to arrays
+    preds = jnp.stack([jnp.asarray(unwrap(p)).reshape(()) for p, _ in pairs])
     # index of first true predicate; len(pairs) = default
     first = jnp.argmax(preds)
     idx = jnp.where(jnp.any(preds), first, len(pairs))

@@ -56,8 +56,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_k, scale,
 
     def body(j, carry):
         m, l, acc = carry
-        k_blk = pl.load(k_ref, (pl.dslice(j * block_k, block_k), slice(None))).astype(jnp.float32)
-        v_blk = pl.load(v_ref, (pl.dslice(j * block_k, block_k), slice(None))).astype(jnp.float32)
+        k_blk = k_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
         logits = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -85,12 +85,50 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_k, scale,
 def flash_attention_fwd(q, k, v, mask=None, is_causal=False, scale=None,
                         block_q=256, block_k=256):
     """q,k,v: [B,H,S,D].  Uses the Pallas kernel when mask is None and shapes
-    tile; otherwise the XLA composed reference."""
+    tile; otherwise the XLA composed reference.  Differentiable: the
+    backward pass recomputes attention in the composed XLA form (the
+    flash-attention recompute strategy — no S^2 tensor is saved)."""
     if (not _HAS_PALLAS or mask is not None
             or q.shape[-2] % block_q or k.shape[-2] % block_k
             or jax.default_backend() != "tpu"):
         return _xla_reference(q, k, v, mask, is_causal, scale)
+    # Policy (measured on v5e): XLA's fused attention wins at moderate
+    # sequence lengths; the tiled kernel wins once the S^2 logits
+    # intermediate stops fitting comfortably in HBM/VMEM traffic.  Flag
+    # FLAGS_use_pallas_attention: "auto" (default) = kernel at S >= 2048,
+    # "1"/"0" force on/off.
+    from ...core import flags as _flags
 
+    pol = str(_flags.flag("use_pallas_attention"))
+    use = (pol in ("1", "True", "true") or
+           (pol == "auto" and q.shape[-2] >= 2048))
+    if not use:
+        return _xla_reference(q, k, v, mask, is_causal, scale)
+    return _flash_diff(q, k, v, is_causal, scale, block_q, block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_diff(q, k, v, is_causal, scale, block_q, block_k):
+    return _pallas_forward(q, k, v, is_causal, scale, block_q, block_k)
+
+
+def _flash_diff_fwd(q, k, v, is_causal, scale, block_q, block_k):
+    out = _pallas_forward(q, k, v, is_causal, scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_diff_bwd(is_causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_reference(q_, k_, v_, None, is_causal,
+                                          scale), q, k, v)
+    return vjp(g)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+def _pallas_forward(q, k, v, is_causal, scale, block_q, block_k):
     b, h, sq, d = q.shape
     sk = k.shape[-2]
     s = scale if scale is not None else 1.0 / math.sqrt(d)

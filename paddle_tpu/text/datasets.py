@@ -28,13 +28,7 @@ __all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st",
            "WMT14", "WMT16"]
 
 
-def _rng(name: str, mode: str):
-    # zlib.crc32, not hash(): str hashing is randomized per process, and
-    # synthetic corpora must agree across distributed workers and runs
-    import zlib
-
-    return np.random.RandomState(
-        zlib.crc32(f"{name}:{mode}".encode()) % (2 ** 31))
+from ..utils import stable_rng as _rng  # shared crc32-seeded RandomState
 
 
 class Imdb(Dataset):

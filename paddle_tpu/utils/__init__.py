@@ -2,6 +2,18 @@ from . import clip
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 
 
+def stable_rng(name: str, mode: str):
+    """Deterministic numpy RandomState keyed by (name, mode) via crc32 —
+    NOT hash(), whose per-process randomization would give distributed
+    workers different synthetic corpora."""
+    import zlib
+
+    import numpy as np
+
+    return np.random.RandomState(
+        zlib.crc32(f"{name}:{mode}".encode()) % (2 ** 31))
+
+
 def try_import(name):
     import importlib
 

