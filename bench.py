@@ -38,7 +38,9 @@ def main():
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=1024,
                         use_parallel_layers=False)
-        batch, seq, steps, warmup = 8, 1024, 20, 3
+        # batch 16 saturates a v5e-lite chip: batch 20+ OOMs, and batch 8
+        # measured ~1.3-2.4x slower across sweeps (shared-chip variance)
+        batch, seq, steps, warmup = 16, 1024, 20, 3
 
     model = GPT(cfg)
     opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
