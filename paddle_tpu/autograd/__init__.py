@@ -17,8 +17,10 @@ from ..core.dispatch import dispatch
 from ..core.tensor import Tensor
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False):
-    tape_mod.backward(tensors, grad_tensors, retain_graph=retain_graph)
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             create_graph=False):
+    tape_mod.backward(tensors, grad_tensors, retain_graph=retain_graph,
+                      create_graph=create_graph)
 
 
 class no_grad(contextlib.ContextDecorator):
@@ -63,19 +65,29 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     rg = True if retain_graph is None else retain_graph
 
-    # snapshot existing .grad, run backward, read new grads, restore
-    saved = [t.grad for t in inputs]
+    # partial-grad semantics (reference PartialGradEngine): .grad of EVERY
+    # variable is left untouched — the backward records exactly the grads
+    # it writes, and we restore them afterwards (inputs included: their
+    # result is returned, not left on .grad).
+    saved_inputs = [(t, t.grad) for t in inputs]
     for t in inputs:
         t.grad = None
-    tape_mod.backward(list(outputs), grad_tensors=grad_outputs, retain_graph=rg)
+    touched = []
+    tape_mod.backward(list(outputs), grad_tensors=grad_outputs,
+                      retain_graph=rg, create_graph=create_graph,
+                      touched=touched)
     grads = []
-    for t, old in zip(inputs, saved):
+    for t, _ in saved_inputs:
         g = t.grad
         if g is None and not allow_unused:
             from ..ops import zeros_like
 
             g = zeros_like(t)
         grads.append(g)
+    # restore in reverse write order so repeated writes unwind correctly
+    for t, old in reversed(touched):
+        t.grad = old
+    for t, old in saved_inputs:
         t.grad = old
     return grads
 

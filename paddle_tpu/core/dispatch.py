@@ -104,6 +104,7 @@ def dispatch(jfn, *inputs, amp_policy=None, nondiff=(), **static_kwargs):
         [inputs[p] for p in diff_pos],
         list(outs),
         out_is_tuple=isinstance(wrapped, tuple),
+        primal_fn=fn_of_diff,
     )
     default_tape().record(node)
 

@@ -29,6 +29,7 @@ from typing import Any, Callable, List, Optional
 class TapeNode:
     __slots__ = (
         "vjp_fn",
+        "primal_fn",
         "input_refs",
         "output_wrefs",
         "output_uids",
@@ -37,8 +38,13 @@ class TapeNode:
         "released",
     )
 
-    def __init__(self, vjp_fn, inputs, outputs, out_is_tuple=False):
+    def __init__(self, vjp_fn, inputs, outputs, out_is_tuple=False,
+                 primal_fn=None):
         self.vjp_fn = vjp_fn
+        # pure function of the differentiable inputs; kept so backward can
+        # itself be re-derived under dispatch (paddle.grad(create_graph=True)
+        # — reference PartialGradEngine double-grad)
+        self.primal_fn = primal_fn
         self.input_refs = inputs
         self.output_wrefs = [weakref.ref(t) for t in outputs]
         self.output_uids = [t._uid for t in outputs]
@@ -84,8 +90,11 @@ def default_tape() -> Tape:
     return _TAPE
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False):
-    """Run reverse-mode over the recorded tape from `tensors` roots."""
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             create_graph=False, touched=None):
+    """Run reverse-mode over the recorded tape from `tensors` roots.
+    create_graph=True records the backward computation itself on the tape
+    (double-grad; reference `imperative/partial_grad_engine.cc`)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -98,6 +107,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         grad_tensors = [None] * len(tensors)
     if not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
+
+    if create_graph:
+        return _backward_create_graph(list(tensors), list(grad_tensors),
+                                      touched)
 
     # cotangent accumulator keyed by tensor uid
     cot = {}
@@ -141,23 +154,117 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         for t in node.input_refs:
             if t._uid not in seen:
                 seen.add(t._uid)
-                _maybe_set_grad(t, cot)
+                _maybe_set_grad(t, cot, touched)
     for t in tensors:
         if t._uid not in seen:
             seen.add(t._uid)
-            _maybe_set_grad(t, cot)
+            _maybe_set_grad(t, cot, touched)
 
     if not retain_graph:
         tape.clear()
 
 
-def _maybe_set_grad(t, cot):
+def _maybe_set_grad(t, cot, touched=None):
     from .tensor import Tensor
 
     g = cot.get(t._uid)
     if g is None or t.stop_gradient:
         return
+    if touched is not None:
+        # caller (paddle.grad) restores these afterwards — record exactly
+        # the tensors written, at write time (no O(tape) pre-scan)
+        touched.append((t, t.grad))
     if t.grad is None:
         t.grad = Tensor(g, stop_gradient=True)
     else:
         t.grad = Tensor(t.grad._array + g, stop_gradient=True)
+
+
+def _backward_create_graph(tensors, grad_tensors, touched=None):
+    """Differentiable backward: replays each node's vjp THROUGH dispatch so
+    the gradient computation is itself taped.  The graph is retained (the
+    reference's create_graph contract implies retain_graph)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .dispatch import dispatch
+    from .tensor import Tensor
+
+    cot = {}  # uid -> Tensor (taped)
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            gt = Tensor(jnp.ones_like(t._array))
+        else:
+            gt = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))
+        prev = cot.get(t._uid)
+        cot[t._uid] = gt if prev is None else prev + gt
+
+    tape = default_tape()
+    # snapshot: the second-order dispatches below append NEW nodes
+    nodes = list(tape.nodes)
+    for node in reversed(nodes):
+        if node.released:
+            continue
+        out_cots = [cot.get(uid) for uid in node.output_uids]
+        if all(c is None for c in out_cots):
+            continue
+        if node.primal_fn is None:
+            raise RuntimeError(
+                "create_graph=True needs the primal function; this node "
+                "(custom PyLayer?) recorded only an opaque vjp")
+        protos = node._out_protos
+        inexact = tuple(i for i, p in enumerate(protos)
+                        if jnp.issubdtype(p[1], jnp.inexact))
+        cot_args = []
+        for i in inexact:
+            c = out_cots[i]
+            cot_args.append(c if c is not None
+                            else Tensor(jnp.zeros(protos[i][0], protos[i][1])))
+        n_in = len(node.input_refs)
+
+        def second(*args, _pf=node.primal_fn, _n=n_in, _protos=protos,
+                   _inexact=inexact, _tup=node.out_is_tuple):
+            primals = args[:_n]
+            cots = list(args[_n:])
+            full = []
+            k = 0
+            for i, p in enumerate(_protos):
+                if i in _inexact:
+                    c = cots[k]
+                    if c.dtype != p[1]:
+                        c = c.astype(p[1])
+                    full.append(c)
+                    k += 1
+                else:
+                    full.append(np.zeros(p[0], jax.dtypes.float0))
+            _, vjp = jax.vjp(_pf, *primals)
+            return tuple(vjp(tuple(full) if _tup else full[0]))
+
+        in_cots = dispatch(second, *node.input_refs, *cot_args)
+        if not isinstance(in_cots, tuple):
+            in_cots = (in_cots,)
+        for t, g in zip(node.input_refs, in_cots):
+            prev = cot.get(t._uid)
+            cot[t._uid] = g if prev is None else prev + g
+
+    # deposit differentiable grads (further backward can flow through them)
+    seen = set()
+    for node in nodes:
+        for t in node.input_refs:
+            if t._uid not in seen:
+                seen.add(t._uid)
+                _deposit_graph_grad(t, cot, touched)
+    for t in tensors:
+        if t._uid not in seen:
+            seen.add(t._uid)
+            _deposit_graph_grad(t, cot, touched)
+
+
+def _deposit_graph_grad(t, cot, touched=None):
+    g = cot.get(t._uid)
+    if g is None or t.stop_gradient:
+        return
+    if touched is not None:
+        touched.append((t, t.grad))
+    t.grad = g if t.grad is None else t.grad + g

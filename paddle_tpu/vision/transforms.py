@@ -173,3 +173,177 @@ def hflip(img):
     arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
     out = arr[..., ::-1].copy()
     return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def _to_np(img):
+    return (img.numpy() if isinstance(img, Tensor)
+            else np.asarray(img)), isinstance(img, Tensor)
+
+
+def _wrap_like(out, was_tensor):
+    return Tensor(out) if was_tensor else out
+
+
+def _layout(arr):
+    """-> 'chw' | 'hwc' | '2d' (channel-count based, incl. 1-channel)."""
+    if arr.ndim == 2:
+        return "2d"
+    if arr.ndim == 3 and arr.shape[0] in (1, 3, 4):
+        return "chw"
+    return "hwc"
+
+
+def _to_gray(arr, layout):
+    """Luma (ITU-R 601-2); returns 2-D [H, W]."""
+    w = np.asarray([0.299, 0.587, 0.114], np.float32)
+    if layout == "2d":
+        return arr
+    if layout == "chw":
+        return arr[0] if arr.shape[0] == 1 else np.tensordot(
+            w, arr[:3], axes=(0, 0))
+    return arr[..., 0] if arr.shape[-1] == 1 else arr[..., :3] @ w
+
+
+class Pad(BaseTransform):
+    """reference `transforms.Pad`: constant/edge/reflect border padding,
+    numpy-side (host preprocessing)."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding, padding, padding, padding)  # l, t, r, b
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr, was_t = _to_np(img)
+        l, t, r, b = self.padding
+        pad = ((0, 0), (t, b), (l, r)) if _layout(arr) == "chw" else \
+            ((t, b), (l, r)) + ((0, 0),) * (arr.ndim - 2)
+        if self.padding_mode == "constant":
+            out = np.pad(arr, pad, constant_values=self.fill)
+        else:
+            out = np.pad(arr, pad, mode=self.padding_mode)
+        return _wrap_like(out, was_t)
+
+
+class Grayscale(BaseTransform):
+    """reference `transforms.Grayscale` (ITU-R 601-2 luma)."""
+
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        arr, was_t = _to_np(img)
+        arr = arr.astype(np.float32)
+        layout = _layout(arr)
+        gray = _to_gray(arr, layout)
+        reps = self.num_output_channels
+        out = (np.repeat(gray[..., None], reps, -1) if layout == "hwc"
+               else np.repeat(gray[None], reps, 0))
+        return _wrap_like(out, was_t)
+
+
+class ColorJitter(BaseTransform):
+    """reference `transforms.ColorJitter`: random brightness/contrast/
+    saturation scaling (hue omitted: HSV round-trip is a data-pipeline
+    nicety, not a capability)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+
+    @staticmethod
+    def _factor(rng, amount):
+        return 1.0 + rng.uniform(-amount, amount) if amount else 1.0
+
+    def _apply_image(self, img):
+        arr, was_t = _to_np(img)
+        arr = arr.astype(np.float32)
+        # value range decided from the INPUT, not the jittered result
+        hi = 255.0 if arr.max() > 1.5 else 1.0
+        layout = _layout(arr)
+        rng = np.random
+        arr = arr * self._factor(rng, self.brightness)
+        if self.contrast:
+            mean = arr.mean()
+            arr = (arr - mean) * self._factor(rng, self.contrast) + mean
+        if self.saturation and layout != "2d" and \
+                (arr.shape[0] if layout == "chw" else arr.shape[-1]) >= 3:
+            gray = _to_gray(arr, layout)
+            gray = gray[None] if layout == "chw" else gray[..., None]
+            f = self._factor(rng, self.saturation)
+            arr = arr * f + gray * (1.0 - f)
+        return _wrap_like(np.clip(arr, 0.0, hi), was_t)
+
+
+class RandomResizedCrop(BaseTransform):
+    """reference `transforms.RandomResizedCrop`: random area/aspect crop
+    then resize (the ImageNet training crop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        arr, _ = _to_np(img)  # Resize below handles Tensor re-wrap inputs
+        chw = _layout(arr) == "chw"
+        h, w = (arr.shape[1:3]) if chw else arr.shape[:2]
+        area = h * w
+        rng = np.random
+        for _ in range(10):
+            target = area * rng.uniform(*self.scale)
+            ar = np.exp(rng.uniform(np.log(self.ratio[0]),
+                                    np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = rng.randint(0, h - ch + 1)
+                j = rng.randint(0, w - cw + 1)
+                crop = arr[:, i:i + ch, j:j + cw] if chw else \
+                    arr[i:i + ch, j:j + cw]
+                return self._resize(crop)
+        return self._resize(arr)  # fallback: full image
+
+
+class RandomRotation(BaseTransform):
+    """reference `transforms.RandomRotation`: rotate by a random angle
+    (nearest-neighbor resampling about the image center)."""
+
+    def __init__(self, degrees, fill=0):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr, was_t = _to_np(img)
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        chw = _layout(arr) == "chw"
+        h, w = (arr.shape[1:3]) if chw else arr.shape[:2]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        # inverse mapping for a COUNTER-clockwise rotation (PIL/paddle
+        # convention): source = R(+angle) . (dest - center) + center
+        cos, sin = np.cos(angle), np.sin(angle)
+        sy = cy + (yy - cy) * cos + (xx - cx) * sin
+        sx = cx - (yy - cy) * sin + (xx - cx) * cos
+        syi = np.round(sy).astype(np.int64)
+        sxi = np.round(sx).astype(np.int64)
+        valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
+        syi = np.clip(syi, 0, h - 1)
+        sxi = np.clip(sxi, 0, w - 1)
+        if chw:
+            out = arr[:, syi, sxi]
+            out = np.where(valid[None], out, self.fill)
+        else:
+            out = arr[syi, sxi]
+            out = np.where(valid[..., None] if arr.ndim == 3 else valid,
+                           out, self.fill)
+        return _wrap_like(out.astype(arr.dtype), was_t)

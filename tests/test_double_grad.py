@@ -1,0 +1,66 @@
+"""Double-backward (create_graph) tests.
+
+Reference: `imperative/partial_grad_engine.cc` (`paddle.grad` with
+create_graph=True) + test_imperative_double_grad.py — second-order
+gradients and the WGAN-GP gradient-penalty pattern.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestDoubleGrad:
+    def test_second_derivative_power(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        y = x ** 3
+        (g,) = paddle.grad([y], [x], create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [12.0])
+        (g2,) = paddle.grad([g], [x])
+        np.testing.assert_allclose(g2.numpy(), [12.0])  # 6x
+
+    def test_chain_rule_second_order(self):
+        x = paddle.to_tensor(np.array([0.5], np.float32))
+        x.stop_gradient = False
+        y = (x * x).sin()
+        (g,) = paddle.grad([y], [x], create_graph=True)
+        (g2,) = paddle.grad([g], [x])
+        want = 2 * math.cos(0.25) - 4 * 0.25 * math.sin(0.25)
+        np.testing.assert_allclose(g2.numpy(), [want], rtol=1e-5)
+
+    def test_gradient_penalty_backward(self):
+        """WGAN-GP pattern: penalty on the gradient norm, then backward."""
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = (x ** 2).sum()
+        (gx,) = paddle.grad([y], [x], create_graph=True)  # 2x
+        penalty = (gx ** 2).sum()  # 4x^2
+        penalty.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0, 16.0], rtol=1e-6)
+
+    def test_through_linear_layer(self):
+        paddle.seed(0)
+        lin = nn.Linear(3, 1)
+        x = paddle.to_tensor(np.array([[1.0, 2.0, 3.0]], np.float32))
+        x.stop_gradient = False
+        y = lin(x).sum()
+        (gx,) = paddle.grad([y], [x], create_graph=True)
+        # dy/dx = W; d(sum(gx * c))/dW flows through second order
+        loss = (gx * paddle.to_tensor(
+            np.array([[1.0, 1.0, 1.0]], np.float32))).sum()
+        loss.backward()
+        assert lin.weight.grad is not None
+        # d loss / dW == outer contribution = 1 per element
+        np.testing.assert_allclose(lin.weight.grad.numpy(),
+                                   np.ones((3, 1), np.float32), atol=1e-6)
+
+    def test_without_create_graph_unaffected(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        x.stop_gradient = False
+        y = x * x
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])

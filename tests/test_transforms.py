@@ -1,0 +1,51 @@
+"""Vision transform tests (reference tests/test_transforms.py)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import transforms as T
+
+
+@pytest.fixture
+def img():
+    return (np.random.RandomState(0).rand(3, 16, 16) * 255).astype(
+        np.float32)
+
+
+class TestTransforms:
+    def test_pad(self, img):
+        out = T.Pad(2)(img)
+        assert out.shape == (3, 20, 20)
+        assert (out[:, :2, :] == 0).all()
+        out = T.Pad((1, 2))(img)
+        assert out.shape == (3, 20, 18)
+
+    def test_grayscale(self, img):
+        g1 = T.Grayscale(1)(img)
+        assert g1.shape == (1, 16, 16)
+        g3 = T.Grayscale(3)(img)
+        np.testing.assert_allclose(g3[0], g3[1])
+        w = np.array([0.299, 0.587, 0.114], np.float32)
+        np.testing.assert_allclose(
+            g1[0, 0, 0], (img[:, 0, 0] * w).sum(), rtol=1e-5)
+
+    def test_color_jitter_identity_when_zero(self, img):
+        out = T.ColorJitter(0, 0, 0)(img)
+        np.testing.assert_allclose(out, np.clip(img, 0, 255))
+
+    def test_random_resized_crop(self, img):
+        out = T.RandomResizedCrop(8)(img)
+        assert out.shape[-2:] == (8, 8)
+
+    def test_random_rotation_zero_is_identity(self, img):
+        out = T.RandomRotation((0, 0))(img)
+        np.testing.assert_allclose(out, img)
+
+    def test_random_rotation_shape_and_fill(self, img):
+        out = T.RandomRotation((45, 45), fill=-1)(img)
+        assert out.shape == img.shape
+        assert (out == -1).any()  # corners fall outside the source
+
+    def test_compose_pipeline(self, img):
+        pipe = T.Compose([T.Pad(2), T.Grayscale(1), T.ToTensor()])
+        out = pipe(img.transpose(1, 2, 0))  # HWC input
+        assert list(out.shape)[-2:] == [20, 20]
