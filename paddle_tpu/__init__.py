@@ -88,3 +88,9 @@ def summary(net, input_size=None, dtypes=None):
     from .hapi.summary import summary as _summary
 
     return _summary(net, input_size, dtypes)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.summary import flops as _flops
+
+    return _flops(net, input_size, custom_ops, print_detail)

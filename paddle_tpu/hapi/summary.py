@@ -20,3 +20,68 @@ def summary(net, input_size=None, dtypes=None):
               "-" * 64]
     print("\n".join(lines))
     return {"total_params": total_params, "trainable_params": trainable_params}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Per-layer FLOPs estimate (reference `python/paddle/hapi/
+    dynamic_flops.py`): counts multiply-accumulates of Conv/Linear (x2 for
+    FLOPs) plus norm/activation elementwise costs, via forward hooks on a
+    dry run with zeros."""
+    import paddle_tpu as paddle
+    from .. import nn
+
+    counts = {}
+    hooks = []
+
+    def out_shape(out):
+        o = out[0] if isinstance(out, (list, tuple)) else out
+        return tuple(o.shape)
+
+    def hook(layer, inputs, output):
+        name = type(layer).__name__
+        f = 0
+        if isinstance(layer, nn.Linear):
+            inf = int(np.prod(layer.weight.shape))
+            batch = int(np.prod(out_shape(output)[:-1]))
+            f = 2 * batch * inf
+        elif hasattr(layer, "weight") and getattr(layer, "_stride", None) \
+                is not None and layer.weight is not None:
+            # conv-like: out_elems * (Cin/g * prod(k)) MACs.  Cin comes
+            # from the layer, not the weight: transposed convs store
+            # weights as [Cin, Cout/g, *k]
+            w = layer.weight
+            o = out_shape(output)
+            cin_g = int(getattr(layer, "_in_channels", w.shape[1]) //
+                        max(int(getattr(layer, "_groups", 1)), 1))
+            k_elems = int(np.prod(w.shape[2:]))
+            f = 2 * int(np.prod(o)) * cin_g * k_elems
+        elif isinstance(layer, (nn.BatchNorm, nn.BatchNorm1D, nn.BatchNorm2D,
+                                nn.BatchNorm3D, nn.LayerNorm)):
+            f = 2 * int(np.prod(out_shape(output)))
+        if custom_ops and type(layer) in custom_ops:
+            f = custom_ops[type(layer)](layer, inputs, output)
+        if f:
+            # accumulate: weight-shared layers may run several times per
+            # forward (reference dynamic_flops does m.total_ops += ...)
+            prev = counts.get(id(layer), (name, 0))[1]
+            counts[id(layer)] = (name, prev + f)
+
+    for sub in net.sublayers(include_self=True):
+        hooks.append(sub.register_forward_post_hook(hook))
+    was_training = net.training
+    net.eval()
+    try:
+        with paddle.no_grad():
+            x = paddle.zeros(list(input_size), dtype="float32")
+            net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    total = sum(f for _, f in counts.values())
+    if print_detail:
+        for name, f in counts.values():
+            print(f"{name:<24}{f:>16,}")
+    print(f"Total Flops: {total:,}")
+    return total

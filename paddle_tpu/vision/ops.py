@@ -28,7 +28,7 @@ from ..core.tensor import Tensor, unwrap
 
 __all__ = [
     "iou_similarity", "box_clip", "box_coder", "prior_box", "yolo_box",
-    "roi_align", "roi_pool", "nms", "multiclass_nms",
+    "roi_align", "roi_pool", "nms", "multiclass_nms", "deform_conv2d",
 ]
 
 
@@ -489,3 +489,96 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
     if return_index:
         return out, index, counts
     return out, counts
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2.
+    Reference: `operators/deformable_conv_op.*`, `deformable_conv_v1_op.*`;
+    Python `python/paddle/vision/ops.py deform_conv2d`.
+
+    TPU-native: instead of the reference's im2col-with-offsets CUDA kernel,
+    sampling locations are materialized as one bilinear gather per kernel
+    tap and the contraction is a single einsum (MXU) — no scatter, static
+    shapes.  x: [N, Cin, H, W]; offset: [N, 2*dg*kh*kw, Ho, Wo];
+    mask (v2): [N, dg*kh*kw, Ho, Wo]; weight: [Cout, Cin/groups, kh, kw].
+    """
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def f(xv, off, w, *rest):
+        m = rest[0] if mask is not None else None
+        b = (rest[-1] if bias is not None else None)
+        n, cin, h, wd = xv.shape
+        cout, cin_g, kh, kw = w.shape
+        ho = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        wo = (wd + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        dg = deformable_groups
+        off = off.reshape(n, dg, kh * kw, 2, ho, wo)
+
+        base_y = jnp.arange(ho) * s[0] - p[0]  # [Ho]
+        base_x = jnp.arange(wo) * s[1] - p[1]  # [Wo]
+        ky = jnp.arange(kh) * d[0]
+        kx = jnp.arange(kw) * d[1]
+        # sample positions per tap: [kh*kw, Ho, Wo]
+        shape = (kh, kw, ho, wo)
+        taps_y = jnp.broadcast_to(ky[:, None, None, None] +
+                                  base_y[None, None, :, None], shape)
+        taps_x = jnp.broadcast_to(kx[None, :, None, None] +
+                                  base_x[None, None, None, :], shape)
+        taps_y = taps_y.reshape(kh * kw, ho, wo)
+        taps_x = taps_x.reshape(kh * kw, ho, wo)
+
+        # offsets are (dy, dx) per deformable group and tap
+        sy = taps_y[None, None] + off[:, :, :, 0]  # [N, dg, K, Ho, Wo]
+        sx = taps_x[None, None] + off[:, :, :, 1]
+
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        ly = sy - y0
+        lx = sx - x0
+
+        def gather(img_dg, yi, xi):
+            # img_dg: [N, dg, C/dg, H, W]; yi/xi: [N, dg, K, Ho, Wo] float.
+            # Zero-padded sampling (reference deformable im2col): a corner
+            # OUTSIDE the image contributes 0, not a replicated edge pixel.
+            valid = ((yi >= 0) & (yi <= h - 1) &
+                     (xi >= 0) & (xi <= wd - 1))
+            yic = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xic = jnp.clip(xi, 0, wd - 1).astype(jnp.int32)
+            flat = img_dg.reshape(n, dg, cin // dg, h * wd)
+            idx = (yic * wd + xic).reshape(n, dg, 1, -1)
+            idx = jnp.broadcast_to(idx, (n, dg, cin // dg, idx.shape[-1]))
+            out = jnp.take_along_axis(flat, idx, axis=-1)
+            out = out.reshape(n, dg, cin // dg, kh * kw, ho, wo)
+            return out * valid[:, :, None].astype(out.dtype)
+
+        img_dg = xv.reshape(n, dg, cin // dg, h, wd)
+        v00 = gather(img_dg, y0, x0)
+        v01 = gather(img_dg, y0, x0 + 1)
+        v10 = gather(img_dg, y0 + 1, x0)
+        v11 = gather(img_dg, y0 + 1, x0 + 1)
+        wy = ly[:, :, None]
+        wx = lx[:, :, None]
+        val = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+               v10 * wy * (1 - wx) + v11 * wy * wx)
+        if m is not None:
+            val = val * m.reshape(n, dg, 1, kh * kw, ho, wo)
+
+        cols = val.reshape(n, cin, kh * kw, ho, wo)
+        wflat = w.reshape(groups, cout // groups, cin_g, kh * kw)
+        cols_g = cols.reshape(n, groups, cin // groups, kh * kw, ho, wo)
+        out = jnp.einsum("ngckyx,gock->ngoyx", cols_g, wflat)
+        out = out.reshape(n, cout, ho, wo)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return dispatch(f, *args)

@@ -121,6 +121,79 @@ class TestYoloRoi:
         assert float(np.abs(x.grad.numpy()).sum()) > 0
 
 
+class TestDeformConv:
+    def test_zero_offset_matches_conv(self):
+        from paddle_tpu.nn import functional as F
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 2, 5, 5).astype(np.float32)
+        w = rng.rand(3, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 5, 5), np.float32)
+        got = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                 paddle.to_tensor(w), stride=1, padding=1)
+        ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), stride=1,
+                       padding=1)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_mask_modulates(self):
+        from paddle_tpu.nn import functional as F
+
+        rng = np.random.RandomState(1)
+        x = rng.rand(1, 2, 5, 5).astype(np.float32)
+        w = rng.rand(3, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 5, 5), np.float32)
+        mask = np.full((1, 9, 5, 5), 0.5, np.float32)
+        got = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                 paddle.to_tensor(w),
+                                 mask=paddle.to_tensor(mask),
+                                 stride=1, padding=1)
+        ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), stride=1,
+                       padding=1)
+        np.testing.assert_allclose(got.numpy(), ref.numpy() * 0.5, atol=1e-4)
+
+    def test_integer_offset_shifts(self):
+        """Offset (0, +1) on every tap == conv over x shifted left."""
+        from paddle_tpu.nn import functional as F
+
+        rng = np.random.RandomState(2)
+        x = rng.rand(1, 1, 6, 6).astype(np.float32)
+        w = rng.rand(1, 1, 1, 1).astype(np.float32)  # 1x1 kernel, no pad
+        off = np.zeros((1, 2, 6, 6), np.float32)
+        off[:, 1] = 1.0  # dx = +1
+        got = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                 paddle.to_tensor(w), stride=1, padding=0)
+        want = np.zeros_like(x)
+        want[..., :-1] = x[..., 1:] * w[0, 0, 0, 0]
+        np.testing.assert_allclose(got.numpy(), want, atol=1e-4)
+
+    def test_fractional_offset_zero_pads_border(self):
+        """Fractional offsets crossing the border blend with ZERO, not a
+        replicated edge pixel (reference zero-padded bilinear im2col)."""
+        x = np.ones((1, 1, 3, 3), np.float32)
+        w = np.ones((1, 1, 1, 1), np.float32)
+        off = np.zeros((1, 2, 3, 3), np.float32)
+        off[:, 1] = 0.5  # dx = +0.5
+        got = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                 paddle.to_tensor(w), stride=1,
+                                 padding=0).numpy()
+        np.testing.assert_allclose(got[0, 0, :, :2], 1.0, atol=1e-6)
+        np.testing.assert_allclose(got[0, 0, :, 2], 0.5, atol=1e-6)
+
+    def test_gradients_flow(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(3).rand(1, 2, 5, 5).astype(np.float32))
+        x.stop_gradient = False
+        off = paddle.to_tensor(np.zeros((1, 18, 5, 5), np.float32))
+        off.stop_gradient = False
+        w = paddle.to_tensor(
+            np.random.RandomState(4).rand(2, 2, 3, 3).astype(np.float32))
+        w.stop_gradient = False
+        out = vops.deform_conv2d(x, off, w, stride=1, padding=1)
+        out.sum().backward()
+        assert x.grad is not None and w.grad is not None
+        assert off.grad is not None
+
+
 class TestNMS:
     def test_nms_basic(self):
         boxes = np.array([

@@ -1,0 +1,40 @@
+"""paddle.flops + misc API surface tests."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestFlops:
+    def test_linear_stack(self):
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        total = paddle.flops(net, [2, 8])
+        assert total == 2 * 2 * 8 * 16 + 2 * 2 * 16 * 4
+
+    def test_conv_model(self):
+        net = paddle.vision.models.LeNet(num_classes=10)
+        total = paddle.flops(net, [1, 1, 28, 28], print_detail=False)
+        assert total > 100_000  # conv + fc MACs
+
+    def test_custom_op_hook(self):
+        class Twice(nn.Layer):
+            def forward(self, x):
+                return x * 2
+
+        net = nn.Sequential(Twice())
+        total = paddle.flops(
+            net, [4, 4], custom_ops={Twice: lambda l, i, o: 123})
+        assert total == 123
+
+
+class TestMiscSurface:
+    def test_top_level_api_presence(self):
+        for name in ("ParamAttr", "flops", "summary", "linalg",
+                     "regularizer", "profiler", "inference", "quantization",
+                     "sparsity", "incubate", "text", "sequence_mask",
+                     "while_loop"):
+            assert hasattr(paddle, name), name
+
+    def test_device_queries(self):
+        assert paddle.device_count() >= 1
+        assert isinstance(paddle.is_compiled_with_tpu(), bool)
