@@ -41,20 +41,26 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         name = type(layer).__name__
         f = 0
         if isinstance(layer, nn.Linear):
-            inf = int(np.prod(layer.weight.shape))
-            batch = int(np.prod(out_shape(output)[:-1]))
-            f = 2 * batch * inf
+            # reference dynamic_flops count_linear: in_features * out.numel
+            # (MACs, no factor 2)
+            in_features = int(layer.weight.shape[0])
+            f = in_features * int(np.prod(out_shape(output)))
         elif hasattr(layer, "weight") and getattr(layer, "_stride", None) \
                 is not None and layer.weight is not None:
-            # conv-like: out_elems * (Cin/g * prod(k)) MACs.  Cin comes
-            # from the layer, not the weight: transposed convs store
-            # weights as [Cin, Cout/g, *k]
+            # reference count_convNd: out.numel * (Cin/g * prod(k) + bias).
+            # Cin comes from the layer when known (transposed convs store
+            # weights as [Cin, Cout/g, *k]); a duck-typed layer's
+            # w.shape[1] is ALREADY Cin/g — don't divide again.
             w = layer.weight
             o = out_shape(output)
-            cin_g = int(getattr(layer, "_in_channels", w.shape[1]) //
-                        max(int(getattr(layer, "_groups", 1)), 1))
+            if hasattr(layer, "_in_channels"):
+                cin_g = int(layer._in_channels) // max(
+                    int(getattr(layer, "_groups", 1)), 1)
+            else:
+                cin_g = int(w.shape[1])
             k_elems = int(np.prod(w.shape[2:]))
-            f = 2 * int(np.prod(o)) * cin_g * k_elems
+            bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+            f = int(np.prod(o)) * (cin_g * k_elems + bias_ops)
         elif isinstance(layer, (nn.BatchNorm, nn.BatchNorm1D, nn.BatchNorm2D,
                                 nn.BatchNorm3D, nn.LayerNorm)):
             f = 2 * int(np.prod(out_shape(output)))

@@ -20,6 +20,7 @@ from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
+from .misc import *  # noqa: F401,F403
 # control_flow exposed as a namespace only: its `cond` (branching) must not
 # shadow linalg's `cond` (condition number) at the top level
 from . import (control_flow, creation, linalg, logic, manipulation, math,

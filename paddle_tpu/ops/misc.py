@@ -1,0 +1,368 @@
+"""Long-tail operators from the reference inventory (SURVEY Appendix A).
+
+Each op cites its reference kernel.  These are the mechanically-simple
+members of the remaining op families; all are pure jnp (XLA) functions
+through the standard dispatch (eager + jit + autograd).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, unwrap
+
+__all__ = [
+    "add_position_encoding", "affine_channel", "anchor_generator",
+    "bipartite_match", "bpr_loss", "center_loss", "ctc_align", "data_norm",
+    "edit_distance", "gather_tree", "hinge_loss", "l1_norm", "mean_iou",
+    "modified_huber_loss", "rank_loss", "sampling_id", "space_to_depth",
+    "squared_l2_distance", "squared_l2_norm", "teacher_student_sigmoid_loss",
+    "row_conv",
+]
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0, name=None):
+    """Sinusoidal position encoding added to the input
+    (`operators/add_position_encoding_op.*`): out = alpha*x + beta*PE."""
+    def f(a):
+        b, t, d = a.shape
+        pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+        half = d // 2
+        div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+        ang = pos / div[None, :]
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+        if pe.shape[-1] < d:
+            pe = jnp.pad(pe, ((0, 0), (0, d - pe.shape[-1])))
+        return alpha * a + beta * pe[None].astype(a.dtype)
+
+    return dispatch(f, x)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    """Per-channel affine (`operators/affine_channel_op.*`)."""
+    def f(a, s, b):
+        shape = ((1, -1, 1, 1) if data_layout == "NCHW" else (1, 1, 1, -1))
+        return a * s.reshape(shape) + b.reshape(shape)
+
+    return dispatch(f, x, scale, bias)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
+                     stride, offset=0.5, name=None):
+    """RPN anchor generation (`operators/detection/anchor_generator_op.*`):
+    anchors [H, W, A, 4] in xyxy + matching variances."""
+    h, w = int(unwrap(input).shape[2]), int(unwrap(input).shape[3])
+    sw, sh = float(stride[0]), float(stride[1])
+    # reference kernel semantics (anchor_generator_op.h): aspect ratios
+    # OUTER loop, base w/h rounded from the stride cell area, then scaled
+    ws, hs = [], []
+    for ar in aspect_ratios:
+        area = sw * sh
+        area_ratios = area / ar
+        base_w = np.round(np.sqrt(area_ratios))
+        base_h = np.round(base_w * ar)
+        for size in anchor_sizes:
+            scale_w = size / sw
+            scale_h = size / sh
+            ws.append(scale_w * base_w)
+            hs.append(scale_h * base_h)
+    a = len(ws)
+    cx = np.arange(w) * sw + offset * (sw - 1)
+    cy = np.arange(h) * sh + offset * (sh - 1)
+    cxg, cyg = np.meshgrid(cx, cy)
+    half_w = (np.asarray(ws, np.float32) - 1.0) * 0.5
+    half_h = (np.asarray(hs, np.float32) - 1.0) * 0.5
+    anchors = np.stack([
+        cxg[..., None] - half_w, cyg[..., None] - half_h,
+        cxg[..., None] + half_w, cyg[..., None] + half_h,
+    ], axis=-1).astype(np.float32)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          (h, w, a, 4)).copy()
+    return Tensor(jnp.asarray(anchors)), Tensor(jnp.asarray(var))
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    """Greedy bipartite matching (`operators/detection/
+    bipartite_match_op.cc`): rows = ground truth, cols = priors; returns
+    (match_indices [cols] int32 with -1 for unmatched, match_dist [cols]).
+    Eager (host) op — the reference's is CPU-only too."""
+    d = np.asarray(jax.device_get(unwrap(dist_matrix)), np.float32).copy()
+    rows, cols = d.shape
+    match_idx = np.full((cols,), -1, np.int64)
+    match_dist = np.zeros((cols,), np.float32)
+    # phase 1: global greedy bipartite
+    work = d.copy()
+    for _ in range(min(rows, cols)):
+        r, c = np.unravel_index(np.argmax(work), work.shape)
+        if work[r, c] < 0:
+            break
+        match_idx[c] = r
+        match_dist[c] = d[r, c]
+        work[r, :] = -1.0
+        work[:, c] = -1.0
+    if match_type == "per_prediction":
+        # phase 2: every unmatched col above threshold takes its argmax row
+        for c in range(cols):
+            if match_idx[c] == -1:
+                r = int(np.argmax(d[:, c]))
+                if d[r, c] >= dist_threshold:
+                    match_idx[c] = r
+                    match_dist[c] = d[r, c]
+    return (Tensor(jnp.asarray(match_idx)),
+            Tensor(jnp.asarray(match_dist)))
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian Personalized Ranking loss (`operators/bpr_loss_op.*`):
+    -mean log sigmoid(score_pos - score_neg_j) over j != pos."""
+    def f(logits, y):
+        n, c = logits.shape
+        pos = jnp.take_along_axis(logits, y.reshape(-1, 1), axis=1)
+        diff = pos - logits  # [N, C]
+        logsig = jax.nn.log_sigmoid(diff)
+        mask = 1.0 - jax.nn.one_hot(y.reshape(-1), c, dtype=logits.dtype)
+        return -(logsig * mask).sum(axis=1, keepdims=True) / (c - 1)
+
+    return dispatch(f, input, label, nondiff=(1,))
+
+
+def center_loss(input, label, centers, alpha=0.5, update_center=True,
+                name=None):
+    """Center loss (`operators/center_loss_op.*`): pulls features toward
+    their class center.  Returns (loss [N,1], new_centers) — the center
+    update is the op's side output (reference updates in-kernel)."""
+    def f(feat, y, c):
+        sel = c[y]  # [N, D]
+        diff = feat - sel
+        loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+        if update_center:
+            counts = jnp.zeros((c.shape[0],), feat.dtype).at[y].add(1.0)
+            sums = jnp.zeros_like(c).at[y].add(diff)
+            new_c = c + alpha * sums / (counts[:, None] + 1.0)
+        else:
+            new_c = c
+        return loss, jax.lax.stop_gradient(new_c)
+
+    return dispatch(f, input, label, centers, nondiff=(1,))
+
+
+def ctc_align(input, blank=0, merge_repeated=True, padding_value=0,
+              input_length=None, name=None):
+    """CTC alignment decode (`operators/ctc_align_op.*`): squeeze repeats +
+    drop blanks per row; output padded with padding_value (static shape)."""
+    arr = np.asarray(jax.device_get(unwrap(input)))
+    out = np.full_like(arr, padding_value)
+    lens = np.zeros((arr.shape[0],), np.int64)
+    for i, row in enumerate(arr):
+        prev = None
+        k = 0
+        n = (int(input_length.numpy()[i]) if input_length is not None
+             else len(row))
+        for v in row[:n]:
+            if merge_repeated and prev is not None and v == prev:
+                prev = v
+                continue
+            prev = v
+            if v != blank:
+                out[i, k] = v
+                k += 1
+        lens[i] = k
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(lens))
+
+
+def data_norm(x, means, scales, name=None):
+    """Serving-time data normalization (`operators/data_norm_op.*`):
+    (x - mean) * scale with externally-maintained statistics."""
+    def f(a, m, s):
+        return (a - m) * s
+
+    return dispatch(f, x, means, scales)
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None, name=None):
+    """Levenshtein distance per sequence pair
+    (`operators/edit_distance_op.*`).  Host DP (the reference's is a CPU/
+    small-GPU kernel); inputs are [B, T] id arrays + lengths."""
+    a = np.asarray(jax.device_get(unwrap(input)))
+    b = np.asarray(jax.device_get(unwrap(label)))
+    il = (np.asarray(jax.device_get(unwrap(input_length)))
+          if input_length is not None else np.full(a.shape[0], a.shape[1]))
+    ll = (np.asarray(jax.device_get(unwrap(label_length)))
+          if label_length is not None else np.full(b.shape[0], b.shape[1]))
+    out = np.zeros((a.shape[0], 1), np.float32)
+    seq_num = a.shape[0]
+    for i in range(seq_num):
+        x = a[i, : int(il[i])]
+        y = b[i, : int(ll[i])]
+        m, n = len(x), len(y)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for r in range(1, m + 1):
+            prev = dp[0]
+            dp[0] = r
+            for c in range(1, n + 1):
+                cur = dp[c]
+                dp[c] = min(dp[c] + 1, dp[c - 1] + 1,
+                            prev + (x[r - 1] != y[c - 1]))
+                prev = cur
+        d = float(dp[n])
+        out[i, 0] = d / max(n, 1) if normalized else d
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(
+        np.full((1,), seq_num, np.int64)))
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (`operators/gather_tree_op.*`):
+    ids/parents [T, B, W] -> full sequences by walking parent pointers."""
+    def f(idv, par):
+        t, b, w = idv.shape
+
+        def step(beams, i):
+            # beams: [B, W] current beam index at time i+1
+            rows = t - 1 - i
+            out = jnp.take_along_axis(idv[rows], beams, axis=-1)
+            nxt = jnp.take_along_axis(par[rows], beams, axis=-1)
+            return nxt, out
+
+        init = jnp.broadcast_to(jnp.arange(w), (b, w))
+        _, outs = jax.lax.scan(step, init, jnp.arange(t))
+        return outs[::-1]  # [T, B, W]
+
+    return dispatch(f, ids, parents, nondiff=(0, 1))
+
+
+def hinge_loss(input, label, name=None):
+    """(`operators/hinge_loss_op.*`): max(0, 1 - pred * (2y - 1))."""
+    def f(p, y):
+        return jnp.maximum(0.0, 1.0 - p * (2.0 * y - 1.0))
+
+    return dispatch(f, input, label)
+
+
+def l1_norm(x, name=None):
+    """(`operators/l1_norm_op.*`): sum(|x|)."""
+    return dispatch(lambda a: jnp.sum(jnp.abs(a)), x)
+
+
+def mean_iou(input, label, num_classes, name=None):
+    """Segmentation mean-IoU (`operators/mean_iou_op.*`): returns
+    (mean_iou scalar, out_wrong [C], out_correct [C])."""
+    def f(pred, y):
+        p = pred.reshape(-1)
+        t = y.reshape(-1)
+        conf = jnp.zeros((num_classes, num_classes), jnp.float32).at[
+            t, p].add(1.0)
+        inter = jnp.diag(conf)
+        union = conf.sum(0) + conf.sum(1) - inter
+        valid = union > 0
+        iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+        miou = iou.sum() / jnp.maximum(valid.sum(), 1)
+        correct = inter
+        wrong = conf.sum(1) - inter
+        return miou, wrong, correct
+
+    return dispatch(f, input, label, nondiff=(0, 1))
+
+
+def modified_huber_loss(input, label, name=None):
+    """(`operators/modified_huber_loss_op.*`), y in {0,1}:
+    z = pred*(2y-1); z >= -1: max(0, 1-z)^2 else -4z."""
+    def f(p, y):
+        z = p * (2.0 * y - 1.0)
+        return jnp.where(z >= -1.0, jnp.square(jnp.maximum(0.0, 1.0 - z)),
+                         -4.0 * z)
+
+    return dispatch(f, input, label)
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (`operators/rank_loss_op.*`)."""
+    def f(y, l, r):
+        d = l - r
+        return jnp.log1p(jnp.exp(d)) - y * d
+
+    return dispatch(f, label, left, right)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64", name=None):
+    """Sample a category id per row from probability rows
+    (`operators/sampling_id_op.*`).  seed != 0 gives a deterministic
+    per-call stream (reference seeds a local engine); dtype is honored."""
+    from ..core import dtype as dtype_mod
+    from ..core import framework
+
+    key = (jax.random.PRNGKey(seed) if seed
+           else framework.default_generator.next_key())
+    dt = dtype_mod.convert_dtype(dtype)
+
+    def f(p):
+        ids = jax.random.categorical(key, jnp.log(jnp.maximum(p, 1e-20)),
+                                     axis=-1)
+        return ids.astype(dt)
+
+    return dispatch(f, x, nondiff=(0,))
+
+
+def space_to_depth(x, blocksize, name=None):
+    """(`operators/space_to_depth_op.*`): [N,C,H,W] ->
+    [N, C*bs*bs, H/bs, W/bs]."""
+    def f(a):
+        n, c, h, w = a.shape
+        bs = blocksize
+        a = a.reshape(n, c, h // bs, bs, w // bs, bs)
+        a = a.transpose(0, 3, 5, 1, 2, 4)
+        return a.reshape(n, c * bs * bs, h // bs, w // bs)
+
+    return dispatch(f, x)
+
+
+def squared_l2_distance(x, y, name=None):
+    """(`operators/squared_l2_distance_op.*`): per-row sum((x-y)^2).
+    Returns (distance [N,1], sub [N,D])."""
+    def f(a, b):
+        sub = a - b
+        return jnp.sum(sub * sub, axis=-1, keepdims=True), sub
+
+    return dispatch(f, x, y)
+
+
+def squared_l2_norm(x, name=None):
+    """(`operators/squared_l2_norm_op.*`): sum(x^2)."""
+    return dispatch(lambda a: jnp.sum(a * a), x)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0, name=None):
+    """(`operators/teacher_student_sigmoid_loss_op.cc`): distillation loss
+    mixing hard (sign) and soft (teacher score) targets."""
+    def f(z, y):
+        zc = jnp.clip(z, soft_max_lower_bound, soft_max_up_bound)
+        # hard part: log(1 + exp(-|z|)) + max(z, 0) - z * (y > 0)
+        hard = jnp.log1p(jnp.exp(-jnp.abs(zc))) + jnp.maximum(zc, 0.0) \
+            - zc * (y > 0.0)
+        # soft part (teacher score in (0, 1) fractional labels)
+        frac = y - jnp.floor(y)
+        soft = jnp.where(frac > 0.0,
+                         jnp.log1p(jnp.exp(-jnp.abs(zc))) +
+                         jnp.maximum(zc, 0.0) - zc * frac, 0.0)
+        return hard + soft
+
+    return dispatch(f, input, label)
+
+
+def row_conv(input, weight, name=None):
+    """Lookahead row convolution (`operators/row_conv_op.*`, DeepSpeech2):
+    input [B, T, D]; weight [future_context + 1, D]."""
+    def f(a, w):
+        ctx = w.shape[0]
+        b, t, d = a.shape
+        pad = jnp.pad(a, ((0, 0), (0, ctx - 1), (0, 0)))
+        out = jnp.zeros_like(a)
+        for k in range(ctx):  # small static context: unrolled adds fuse
+            out = out + pad[:, k: k + t, :] * w[k][None, None, :]
+        return out
+
+    return dispatch(f, input, weight)

@@ -9,7 +9,8 @@ class TestFlops:
     def test_linear_stack(self):
         net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
         total = paddle.flops(net, [2, 8])
-        assert total == 2 * 2 * 8 * 16 + 2 * 2 * 16 * 4
+        # reference dynamic_flops count_linear: in_features * out.numel
+        assert total == 8 * (2 * 16) + 16 * (2 * 4)
 
     def test_conv_model(self):
         net = paddle.vision.models.LeNet(num_classes=10)

@@ -1,0 +1,132 @@
+"""Long-tail op tests (SURVEY Appendix A stragglers in ops/misc.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(a, dtype))
+
+
+class TestMiscOps:
+    def test_add_position_encoding(self):
+        x = t(np.zeros((1, 4, 8)))
+        out = paddle.add_position_encoding(x, alpha=1.0, beta=1.0).numpy()
+        # position 0: sin(0)=0 for first half, cos(0)=1 for second half
+        np.testing.assert_allclose(out[0, 0, :4], 0.0, atol=1e-6)
+        np.testing.assert_allclose(out[0, 0, 4:], 1.0, atol=1e-6)
+
+    def test_affine_channel(self):
+        x = t(np.ones((1, 2, 2, 2)))
+        out = paddle.affine_channel(x, t([2.0, 3.0]), t([1.0, -1.0])).numpy()
+        np.testing.assert_allclose(out[0, 0], 3.0)
+        np.testing.assert_allclose(out[0, 1], 2.0)
+
+    def test_anchor_generator(self):
+        anchors, var = paddle.anchor_generator(
+            t(np.zeros((1, 3, 4, 4))), anchor_sizes=[64.0],
+            aspect_ratios=[1.0], variances=[0.1, 0.1, 0.2, 0.2],
+            stride=[16.0, 16.0])
+        assert anchors.shape == [4, 4, 1, 4]
+        a = anchors.numpy()[0, 0, 0]
+        # reference anchor_generator_op.h: base 16x16 cell scaled by
+        # 64/16=4 -> 64x64 box, centered at offset*(stride-1)=7.5,
+        # corners +/- 0.5*(w-1)
+        np.testing.assert_allclose(a, [7.5 - 31.5, 7.5 - 31.5,
+                                       7.5 + 31.5, 7.5 + 31.5])
+
+    def test_bipartite_match(self):
+        dist = t([[0.9, 0.1, 0.3], [0.2, 0.8, 0.4]])
+        idx, d = paddle.bipartite_match(dist)
+        np.testing.assert_array_equal(idx.numpy(), [0, 1, -1])
+        np.testing.assert_allclose(d.numpy(), [0.9, 0.8, 0.0])
+        idx2, _ = paddle.bipartite_match(dist, "per_prediction", 0.35)
+        np.testing.assert_array_equal(idx2.numpy(), [0, 1, 1])
+
+    def test_bpr_loss_positive(self):
+        logits = t(np.array([[3.0, 1.0, 0.0]]))
+        loss = paddle.bpr_loss(logits, t([0], np.int32)).numpy()
+        assert loss.shape == (1, 1) and loss[0, 0] > 0
+
+    def test_center_loss(self):
+        feats = t(np.array([[1.0, 1.0], [0.0, 0.0]]))
+        centers = t(np.zeros((3, 2)))
+        loss, new_c = paddle.center_loss(feats, t([1, 1], np.int32), centers)
+        np.testing.assert_allclose(loss.numpy()[0, 0], 1.0)  # 0.5*(1+1)
+        assert float(new_c.numpy()[1].sum()) > 0  # center 1 moved
+
+    def test_ctc_align(self):
+        inp = t([[1, 1, 0, 2, 2, 0, 3]], np.int32)
+        out, lens = paddle.ctc_align(inp, blank=0)
+        np.testing.assert_array_equal(out.numpy()[0][:3], [1, 2, 3])
+        assert int(lens.numpy()[0]) == 3
+
+    def test_edit_distance(self):
+        a = t([[1, 2, 3, 0]], np.int64)
+        b = t([[1, 3, 3, 0]], np.int64)
+        d, _ = paddle.edit_distance(a, b, normalized=False,
+                                    input_length=t([3], np.int64),
+                                    label_length=t([3], np.int64))
+        assert float(d.numpy()[0, 0]) == 1.0
+
+    def test_gather_tree(self):
+        # T=2, B=1, W=2: step1 parents say beam0<-beam1, beam1<-beam0
+        ids = t([[[10, 11]], [[20, 21]]], np.int32)
+        parents = t([[[0, 1]], [[1, 0]]], np.int32)
+        out = paddle.gather_tree(ids, parents).numpy()
+        # final beam 0 traces parent 1 at t=1: sequence [11, 20]
+        np.testing.assert_array_equal(out[:, 0, 0], [11, 20])
+        np.testing.assert_array_equal(out[:, 0, 1], [10, 21])
+
+    def test_simple_losses(self):
+        p = t([0.5, -2.0])
+        y = t([1.0, 0.0])
+        np.testing.assert_allclose(paddle.hinge_loss(p, y).numpy(),
+                                   [0.5, 0.0], atol=1e-6)
+        mh = paddle.modified_huber_loss(p, y).numpy()
+        np.testing.assert_allclose(mh[0], (1 - 0.5) ** 2, atol=1e-6)
+        np.testing.assert_allclose(mh[1], 0.0, atol=1e-6)  # z=2 >= 1
+        mh2 = paddle.modified_huber_loss(t([-3.0]), t([1.0])).numpy()
+        np.testing.assert_allclose(mh2[0], 12.0, atol=1e-6)  # z=-3: -4z
+        rl = paddle.rank_loss(t([1.0]), t([2.0]), t([1.0])).numpy()
+        np.testing.assert_allclose(rl, np.log1p(np.exp(1.0)) - 1.0,
+                                   rtol=1e-6)
+
+    def test_norm_ops(self):
+        x = t([[1.0, -2.0], [3.0, -4.0]])
+        assert float(paddle.l1_norm(x).numpy()) == 10.0
+        assert float(paddle.squared_l2_norm(x).numpy()) == 30.0
+        d, sub = paddle.squared_l2_distance(x, t([[0.0, 0.0], [0.0, 0.0]]))
+        np.testing.assert_allclose(d.numpy()[:, 0], [5.0, 25.0])
+
+    def test_mean_iou(self):
+        pred = t([0, 1, 1, 0], np.int32)
+        label = t([0, 1, 0, 0], np.int32)
+        miou, wrong, correct = paddle.mean_iou(pred, label, 2)
+        # class0: inter 2, union 3 -> 2/3; class1: inter 1, union 2 -> 0.5
+        np.testing.assert_allclose(float(miou.numpy()),
+                                   (2 / 3 + 0.5) / 2, rtol=1e-5)
+
+    def test_space_to_depth(self):
+        x = t(np.arange(16).reshape(1, 1, 4, 4))
+        out = paddle.space_to_depth(x, 2)
+        assert out.shape == [1, 4, 2, 2]
+
+    def test_sampling_id(self):
+        paddle.seed(0)
+        probs = t([[0.0, 1.0, 0.0]] * 8)
+        ids = paddle.sampling_id(probs).numpy()
+        np.testing.assert_array_equal(ids, 1)
+
+    def test_row_conv(self):
+        x = t(np.ones((1, 4, 2)))
+        w = t(np.array([[1.0, 1.0], [0.5, 0.5]]))
+        out = paddle.row_conv(x, w).numpy()
+        np.testing.assert_allclose(out[0, :3], 1.5)  # current + 0.5*future
+        np.testing.assert_allclose(out[0, 3], 1.0)  # last step: no future
+
+    def test_data_norm(self):
+        x = t([[10.0, 20.0]])
+        out = paddle.data_norm(x, t([10.0, 10.0]), t([0.5, 0.1])).numpy()
+        np.testing.assert_allclose(out, [[0.0, 1.0]])
