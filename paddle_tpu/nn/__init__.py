@@ -37,3 +37,5 @@ from .layer.rnn import (BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase,
                         SimpleRNN, SimpleRNNCell)
 
 from ..utils.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+
+from .decode import BeamSearchDecoder, dynamic_decode

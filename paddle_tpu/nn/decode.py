@@ -1,0 +1,133 @@
+"""Sequence decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference: `python/paddle/nn/decode.py` (BeamSearchDecoder over RNN cells,
+dynamic_decode loop) built on the `beam_search` / `beam_search_decode` /
+`gather_tree` ops (`operators/beam_search_op.*`,
+`operators/gather_tree_op.*`).
+
+TPU-native: the decode loop is a fixed `max_step_num` python loop over
+jit-cacheable steps (static shapes — the reference's dynamic while_op
+stopping is replaced by finished-masking, the standard XLA decode idiom);
+the backtrace uses the `gather_tree` op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap
+from ..ops.misc import gather_tree
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    """Beam search over a step cell (reference `nn/decode.py:BeamSearchDecoder`).
+
+    cell(inputs, states) -> (cell_out, new_states); `output_fn` maps
+    cell_out to vocab logits (e.g. the projection layer); `embedding_fn`
+    maps token ids to the next step's inputs.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers ------------------------------------------------------------
+    def _merge(self, x):
+        """[B, W, ...] -> [B*W, ...]"""
+        a = unwrap(x)
+        return Tensor(a.reshape((-1,) + a.shape[2:]))
+
+    def _split(self, x, batch):
+        a = unwrap(x)
+        return Tensor(a.reshape((batch, self.beam_size) + a.shape[1:]))
+
+    def initialize(self, initial_states, batch_size):
+        """Tile encoder-final states across beams; beam 0 active, others
+        start at -inf so the first step picks distinct continuations."""
+        w = self.beam_size
+
+        def tile(s):
+            a = unwrap(s)
+            return Tensor(jnp.repeat(a[:, None], w, axis=1).reshape(
+                (-1,) + a.shape[1:]))
+
+        states = jax.tree_util.tree_map(
+            tile, initial_states,
+            is_leaf=lambda v: isinstance(v, Tensor))
+        tokens = jnp.full((batch_size, w), self.start_token, jnp.int32)
+        log_probs = jnp.tile(
+            jnp.asarray([[0.0] + [-1e9] * (w - 1)], jnp.float32),
+            (batch_size, 1))
+        finished = jnp.zeros((batch_size, w), jnp.bool_)
+        return tokens, log_probs, finished, states
+
+    def step(self, tokens, log_probs, finished, states, batch_size):
+        w = self.beam_size
+        inp = Tensor(unwrap(Tensor(tokens)).reshape(-1))
+        if self.embedding_fn is not None:
+            inp = self.embedding_fn(inp)
+        cell_out, new_states = self.cell(inp, states)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        v = logits.shape[-1]
+        logp = jax.nn.log_softmax(unwrap(logits).astype(jnp.float32), -1)
+        logp = logp.reshape(batch_size, w, v)
+
+        # finished beams only continue with end_token at zero added cost
+        fin_mask = jnp.full((v,), -1e9).at[self.end_token].set(0.0)
+        logp = jnp.where(finished[..., None], fin_mask[None, None], logp)
+
+        total = log_probs[..., None] + logp  # [B, W, V]
+        flat = total.reshape(batch_size, w * v)
+        top_p, top_i = jax.lax.top_k(flat, w)
+        parent = top_i // v  # [B, W]
+        token = (top_i % v).astype(jnp.int32)
+
+        # reorder states by parent beam
+        def reorder(s):
+            a = unwrap(s).reshape((batch_size, w) + unwrap(s).shape[1:])
+            out = jnp.take_along_axis(
+                a, parent.reshape((batch_size, w) +
+                                  (1,) * (a.ndim - 2)).astype(jnp.int32),
+                axis=1)
+            return Tensor(out.reshape((-1,) + a.shape[2:]))
+
+        new_states = jax.tree_util.tree_map(
+            reorder, new_states, is_leaf=lambda x: isinstance(x, Tensor))
+        new_finished = jnp.take_along_axis(finished, parent, axis=1) | (
+            token == self.end_token)
+        return token, top_p, new_finished, new_states, parent
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
+                   batch_size=None, **kwargs):
+    """Run the decoder to max_step_num (reference `nn/decode.py
+    dynamic_decode`; fixed horizon + finished masking instead of a dynamic
+    while).  Returns (sequences [B, T, W] int32, final log-probs [B, W])."""
+    if batch_size is None:
+        leaf = jax.tree_util.tree_leaves(
+            inits, is_leaf=lambda v: isinstance(v, Tensor))[0]
+        batch_size = int(unwrap(leaf).shape[0])
+    tokens, log_probs, finished, states = decoder.initialize(
+        inits, batch_size)
+    step_ids, parents = [], []
+    for _ in range(max_step_num):
+        tokens, log_probs, finished, states, parent = decoder.step(
+            tokens, log_probs, finished, states, batch_size)
+        step_ids.append(tokens)
+        parents.append(parent)
+        if bool(jax.device_get(finished.all())):
+            break
+    ids = Tensor(jnp.stack(step_ids))        # [T, B, W]
+    par = Tensor(jnp.stack(parents))         # [T, B, W]
+    seqs = gather_tree(ids, par)             # [T, B, W]
+    out = Tensor(unwrap(seqs).transpose(1, 0, 2))  # [B, T, W]
+    return out, Tensor(log_probs)

@@ -36,6 +36,20 @@ class TestMiscOps:
         np.testing.assert_allclose(a, [7.5 - 31.5, 7.5 - 31.5,
                                        7.5 + 31.5, 7.5 + 31.5])
 
+    def test_bipartite_match_zero_matrix_unmatched(self):
+        idx, d = paddle.bipartite_match(t(np.zeros((2, 3))))
+        np.testing.assert_array_equal(idx.numpy(), [-1, -1, -1])
+
+    def test_teacher_student_loss_reference_cases(self):
+        x = t([1.0, 1.0, 1.0, 1.0])
+        y = t([-2.0, -1.0, 0.5, 1.5])
+        out = paddle.teacher_student_sigmoid_loss(x, y).numpy()
+        sp = np.log1p(np.exp(-1.0)) + 1.0  # softplus(1)
+        np.testing.assert_allclose(out[0], sp, rtol=1e-6)           # z=0
+        np.testing.assert_allclose(out[1], sp - 1.0, rtol=1e-5)     # z=1
+        np.testing.assert_allclose(out[2], sp + sp - 0.5, rtol=1e-6)
+        np.testing.assert_allclose(out[3], (sp - 1) + sp - 0.5, rtol=1e-5)
+
     def test_bipartite_match(self):
         dist = t([[0.9, 0.1, 0.3], [0.2, 0.8, 0.4]])
         idx, d = paddle.bipartite_match(dist)
@@ -107,11 +121,20 @@ class TestMiscOps:
         # class0: inter 2, union 3 -> 2/3; class1: inter 1, union 2 -> 0.5
         np.testing.assert_allclose(float(miou.numpy()),
                                    (2 / 3 + 0.5) / 2, rtol=1e-5)
+        # reference: a mismatch increments wrong for BOTH classes
+        np.testing.assert_allclose(wrong.numpy(), [1.0, 1.0])
+        np.testing.assert_allclose(correct.numpy(), [2.0, 1.0])
 
     def test_space_to_depth(self):
-        x = t(np.arange(16).reshape(1, 1, 4, 4))
+        # reference darknet-reorg sequence for [1,4,2,2]=arange(16), bs=2
+        x = t(np.arange(16).reshape(1, 4, 2, 2))
         out = paddle.space_to_depth(x, 2)
-        assert out.shape == [1, 4, 2, 2]
+        assert out.shape == [1, 16, 1, 1]
+        np.testing.assert_array_equal(
+            out.numpy().reshape(-1),
+            [0, 4, 1, 5, 8, 12, 9, 13, 2, 6, 3, 7, 10, 14, 11, 15])
+        with pytest.raises(ValueError):
+            paddle.space_to_depth(t(np.zeros((1, 1, 4, 4))), 2)
 
     def test_sampling_id(self):
         paddle.seed(0)
