@@ -38,6 +38,7 @@ __all__ = [
     "MetaOptimizerBase", "GradientMergeOptimizer", "LocalSGDOptimizer",
     "DGCOptimizer", "FP16AllReduceOptimizer", "LookaheadOptimizer",
     "ModelAverage", "ExponentialMovingAverage", "StrategyCompiler",
+    "DygraphShardingOptimizer",
 ]
 
 
@@ -445,3 +446,29 @@ class StrategyCompiler:
                 optimizer = wrappers[name](optimizer)
                 applied.insert(0, name)
         return optimizer, applied
+
+
+class DygraphShardingOptimizer(MetaOptimizerBase):
+    """ZeRO-1 optimizer-state sharding API shim (reference
+    `fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py`).
+
+    TPU-native: the actual state sharding happens in
+    `fleet.build_train_step`'s NamedShardings (`sharded_step.py`,
+    strategy.sharding stage 1); this class keeps the reference's
+    constructor/step surface for ported scripts and simply delegates —
+    wrapping it around an optimizer used with a ShardedTrainStep yields
+    exactly the sharded behavior the reference builds by hand."""
+
+    def __init__(self, hcg=None, user_defined_strategy=None, params=None,
+                 inner_optimizer_class=None, optimizer=None, **inner_kw):
+        # reference positional signature: (hcg, strategy, params,
+        # inner_optimizer_class, **inner_opt_kargs); `optimizer=` accepts a
+        # pre-built optimizer for the TPU-native flow
+        if optimizer is None:
+            if inner_optimizer_class is None:
+                raise TypeError(
+                    "DygraphShardingOptimizer needs inner_optimizer_class "
+                    "(reference signature) or optimizer=")
+            optimizer = inner_optimizer_class(parameters=params, **inner_kw)
+        super().__init__(optimizer)
+        self._hcg = hcg

@@ -40,16 +40,6 @@ class BeamSearchDecoder:
         self.embedding_fn = embedding_fn
         self.output_fn = output_fn
 
-    # -- helpers ------------------------------------------------------------
-    def _merge(self, x):
-        """[B, W, ...] -> [B*W, ...]"""
-        a = unwrap(x)
-        return Tensor(a.reshape((-1,) + a.shape[2:]))
-
-    def _split(self, x, batch):
-        a = unwrap(x)
-        return Tensor(a.reshape((batch, self.beam_size) + a.shape[1:]))
-
     def initialize(self, initial_states, batch_size):
         """Tile encoder-final states across beams; beam 0 active, others
         start at -inf so the first step picks distinct continuations."""
@@ -108,10 +98,14 @@ class BeamSearchDecoder:
 
 
 def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
-                   batch_size=None, **kwargs):
+                   batch_size=None, output_time_major=False, **kwargs):
     """Run the decoder to max_step_num (reference `nn/decode.py
     dynamic_decode`; fixed horizon + finished masking instead of a dynamic
-    while).  Returns (sequences [B, T, W] int32, final log-probs [B, W])."""
+    while).  Returns (sequences [B, T, W] int32 — or [T, B, W] with
+    output_time_major — and final log-probs [B, W])."""
+    unknown = set(kwargs) - {"is_test", "return_length", "impute_finished"}
+    if unknown:
+        raise TypeError(f"dynamic_decode: unsupported options {unknown}")
     if batch_size is None:
         leaf = jax.tree_util.tree_leaves(
             inits, is_leaf=lambda v: isinstance(v, Tensor))[0]
@@ -129,5 +123,6 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
     ids = Tensor(jnp.stack(step_ids))        # [T, B, W]
     par = Tensor(jnp.stack(parents))         # [T, B, W]
     seqs = gather_tree(ids, par)             # [T, B, W]
-    out = Tensor(unwrap(seqs).transpose(1, 0, 2))  # [B, T, W]
-    return out, Tensor(log_probs)
+    if not output_time_major:
+        seqs = Tensor(unwrap(seqs).transpose(1, 0, 2))  # [B, T, W]
+    return seqs, Tensor(log_probs)

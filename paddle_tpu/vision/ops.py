@@ -28,7 +28,8 @@ from ..core.tensor import Tensor, unwrap
 
 __all__ = [
     "iou_similarity", "box_clip", "box_coder", "prior_box", "yolo_box",
-    "roi_align", "roi_pool", "nms", "multiclass_nms", "deform_conv2d",
+    "roi_align", "roi_pool", "nms", "multiclass_nms", "matrix_nms",
+    "deform_conv2d",
 ]
 
 
@@ -582,3 +583,77 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     if bias is not None:
         args.append(bias)
     return dispatch(f, *args)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=-1, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (`operators/detection/matrix_nms_op.cc`, SOLOv2): scores
+    decay by the max IoU with any higher-scored same-class box — fully
+    parallel (one IoU matrix + reductions), which is exactly the
+    suppression formulation that suits the TPU (no sequential greedy loop).
+    bboxes: [N, M, 4]; scores: [N, C, M].
+    Returns out [N, keep_top_k, 6] (label, score, box), valid counts [N]
+    (and indices when return_index)."""
+    def f(bb, sc):
+        n, m, _ = bb.shape
+        c = sc.shape[1]
+
+        def per_image(boxes_i, scores_i):
+            def per_class(cls_scores):
+                k = min(nms_top_k, m) if nms_top_k > 0 else m
+                topv, topi = jax.lax.top_k(
+                    jnp.where(cls_scores > score_threshold, cls_scores,
+                              -jnp.inf), k)
+                b = boxes_i[topi]
+                iou = _pairwise_iou(b, b, normalized)
+                upper = jnp.triu(jnp.ones((k, k), bool), 1)
+                # decay per box j: worst (min) over higher-ranked i of
+                # decay(iou_ij) relative to how compressed i already is
+                ious = jnp.where(upper, iou, 0.0)
+                iou_cmax = ious.max(axis=0)  # [k] max IoU of i with any above
+                if use_gaussian:
+                    # reference decay_score<T,true>: exp((max^2 - iou^2)*sigma)
+                    decay = jnp.exp((jnp.square(iou_cmax)[:, None] -
+                                     jnp.square(ious)) * gaussian_sigma)
+                else:
+                    denom = jnp.maximum(1.0 - iou_cmax[:, None], 1e-10)
+                    decay = (1.0 - ious) / denom
+                decay = jnp.where(upper, decay, 1.0).min(axis=0)  # [k]
+                newv = jnp.where(jnp.isfinite(topv), topv * decay, -jnp.inf)
+                return newv, topi
+
+            vals, idxs = jax.vmap(per_class)(scores_i)  # [C, K]
+            k = vals.shape[1]
+            labels = jnp.broadcast_to(jnp.arange(c)[:, None], (c, k))
+            if background_label >= 0:
+                vals = jnp.where(labels == background_label, -jnp.inf, vals)
+            flat_v = jnp.where(vals > post_threshold, vals,
+                               -jnp.inf).reshape(-1)
+            flat_l = labels.reshape(-1)
+            flat_i = idxs.reshape(-1)
+            take = min(keep_top_k, flat_v.shape[0]) if keep_top_k > 0 \
+                else flat_v.shape[0]
+            sel_v, sel = jax.lax.top_k(flat_v, take)
+            valid = jnp.isfinite(sel_v)
+            sel_b = boxes_i[flat_i[sel]]
+            out = jnp.concatenate([
+                jnp.where(valid, flat_l[sel].astype(bb.dtype), -1.0)[:, None],
+                jnp.where(valid, sel_v, -1.0)[:, None],
+                jnp.where(valid[:, None], sel_b, -1.0),
+            ], axis=1)
+            if keep_top_k > 0 and take < keep_top_k:
+                pad = keep_top_k - take
+                out = jnp.concatenate(
+                    [out, jnp.full((pad, 6), -1.0, out.dtype)], axis=0)
+            return (out, jax.lax.stop_gradient(
+                valid.sum().astype(jnp.int32)),
+                jax.lax.stop_gradient(flat_i[sel]))
+
+        return jax.vmap(per_image)(bb, sc)
+
+    out, counts, index = dispatch(f, bboxes, scores)
+    if return_index:
+        return out, index, counts
+    return out, counts

@@ -306,6 +306,32 @@ class TestSequenceOps:
                                    paddle.to_tensor(ln), "max").numpy()
         assert np.isfinite(got).all() and got[0, 0] == 0.0
 
+    def test_matrix_nms_decay(self):
+        """SOLOv2 matrix NMS: overlapped lower-scored boxes decay, distant
+        boxes keep their scores (reference matrix_nms_op.cc)."""
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                           [50, 50, 60, 60]]], np.float32)
+        scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+        out, counts = vops.matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.0, nms_top_k=3,
+            keep_top_k=3)
+        o = out.numpy()[0]
+        assert int(counts.numpy()[0]) == 3
+        assert o[0, 1] == pytest.approx(0.9)
+        assert o[1, 1] == pytest.approx(0.7)   # far box undedecayed
+        assert o[2, 1] < 0.5                   # heavy-overlap box decayed
+        # gaussian kernel: decay = exp((max_iou^2 - iou^2) * sigma)
+        out_g, _ = vops.matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.0, nms_top_k=3,
+            keep_top_k=3, use_gaussian=True, gaussian_sigma=2.0)
+        iou = 81.0 / (200.0 - 81.0)  # boxes 0 and 1
+        want = 0.8 * np.exp(-(iou ** 2) * 2.0)
+        g = out_g.numpy()[0]
+        decayed = g[np.isclose(g[:, 1], want, rtol=1e-4)]
+        assert len(decayed) == 1
+
     def test_multiclass_nms_backward(self):
         rng = np.random.RandomState(5)
         scores = paddle.to_tensor(rng.rand(1, 2, 6).astype(np.float32))
