@@ -15,6 +15,12 @@
 //   response: u32 body_len | u8 status | payload
 // Ops: 1 PULL_DENSE  2 PUSH_DENSE_GRAD  3 SET_DENSE
 //      4 PULL_SPARSE 5 PUSH_SPARSE_GRAD 6 BARRIER 7 STOP 8 PUSH_DENSE_DELTA
+//      9 SAVE_TABLES (payload = filesystem path on the server host)
+//
+// Security model: the protocol is UNAUTHENTICATED, same trust model as the
+// reference's brpc PS (any peer that can reach the port can read/write
+// tables).  It must only be exposed on a trusted network; the default bind
+// address is therefore 127.0.0.1 — pass "0.0.0.0" explicitly for multi-host.
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -26,6 +32,7 @@
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <set>
@@ -46,6 +53,7 @@ enum Op : uint8_t {
   kBarrier = 6,
   kStop = 7,
   kPushDenseDelta = 8,
+  kSaveTables = 9,
 };
 
 // ---------------------------------------------------------------------------
@@ -54,17 +62,28 @@ enum Op : uint8_t {
 struct DenseTable {
   std::vector<float> param;
   std::vector<float> accum;  // adagrad accumulator (lazy)
+  std::vector<float> m, v;   // adam moments (lazy)
+  uint64_t step = 0;         // adam bias-correction counter
   float lr = 0.01f;
-  int optimizer = 0;  // 0 = sgd, 1 = adagrad, 2 = sum (GEO delta apply)
+  int optimizer = 0;  // 0 = sgd, 1 = adagrad, 2 = sum (GEO), 3 = adam
   std::mutex mu;
 };
 
 struct SparseTable {
   std::unordered_map<uint64_t, std::vector<float>> rows;
+  std::unordered_map<uint64_t, std::vector<float>> accum;  // adagrad / adam m
+  std::unordered_map<uint64_t, std::vector<float>> mom2;   // adam v
+  std::unordered_map<uint64_t, uint64_t> steps;            // adam per-row t
   size_t dim = 0;
   float lr = 0.01f;
+  int optimizer = 0;  // 0 = sgd, 1 = adagrad, 2 = adam
   std::mutex mu;
 };
+
+// adam hyperparameters match the reference server-side accessor defaults
+constexpr float kAdamBeta1 = 0.9f;
+constexpr float kAdamBeta2 = 0.999f;
+constexpr float kAdamEps = 1e-8f;
 
 // ---------------------------------------------------------------------------
 // socket helpers
@@ -106,7 +125,7 @@ class Server {
  public:
   Server() = default;
 
-  int Start(int port, int n_trainers) {
+  int Start(int port, int n_trainers, const char* host) {
     n_trainers_ = n_trainers > 0 ? n_trainers : 1;
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) return -1;
@@ -114,7 +133,14 @@ class Server {
     ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
-    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    // default loopback: the protocol is unauthenticated, so all-interfaces
+    // exposure must be an explicit operator choice ("0.0.0.0" / "*")
+    if (host == nullptr || host[0] == '\0') host = "127.0.0.1";
+    if (std::strcmp(host, "*") == 0 || std::strcmp(host, "0.0.0.0") == 0) {
+      addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    } else if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      return -1;
+    }
     addr.sin_port = htons(static_cast<uint16_t>(port));
     if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
                sizeof(addr)) != 0)
@@ -139,12 +165,226 @@ class Server {
     t->optimizer = opt;
   }
 
-  void CreateSparseTable(uint32_t id, uint64_t dim, float lr) {
+  // returns false for an unknown optimizer code — the sparse/dense code
+  // spaces differ (sparse: 0 sgd, 1 adagrad, 2 adam), so an out-of-range
+  // value must fail loudly rather than silently train with sgd
+  bool CreateSparseTable(uint32_t id, uint64_t dim, float lr, int opt) {
+    if (opt < 0 || opt > 2) return false;
     std::lock_guard<std::mutex> g(tables_mu_);
     auto& t = sparse_[id];
     t = std::make_unique<SparseTable>();
     t->dim = dim;
     t->lr = lr;
+    t->optimizer = opt;
+    return true;
+  }
+
+  // -- persistence ----------------------------------------------------------
+  // Binary snapshot of every table incl. optimizer slots, so a restarted
+  // server resumes mid-training (reference
+  // TheOnePSRuntime._save_distributed_persistables + table save/load).
+  bool Save(const char* path) {
+    // write-to-temp + rename: a failed save must not truncate the previous
+    // good snapshot
+    std::string tmp = std::string(path) + ".tmp";
+    std::FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    bool ok = true;
+    auto wr = [&](const void* p, size_t n) {
+      if (ok && std::fwrite(p, 1, n, f) != n) ok = false;
+    };
+    auto wr_vec = [&](const std::vector<float>& v) {
+      uint64_t n = v.size();
+      wr(&n, 8);
+      if (n) wr(v.data(), n * sizeof(float));
+    };
+    const uint32_t magic = 0x53505450u;  // "PTPS"
+    const uint32_t version = 1;
+    wr(&magic, 4);
+    wr(&version, 4);
+    // collect table pointers under the global lock, then snapshot and
+    // write one table at a time under only that table's mutex — a slow
+    // disk must not stall every pull/push (same discipline as kPullDense's
+    // copy-under-lock-send-after).  Pointers stay valid: tables are only
+    // destroyed by Load(), which is refused once the server is running.
+    std::vector<std::pair<uint32_t, DenseTable*>> dts;
+    std::vector<std::pair<uint32_t, SparseTable*>> sts;
+    {
+      std::lock_guard<std::mutex> g(tables_mu_);
+      for (auto& kv : dense_) dts.emplace_back(kv.first, kv.second.get());
+      for (auto& kv : sparse_) sts.emplace_back(kv.first, kv.second.get());
+    }
+    uint32_t nd = static_cast<uint32_t>(dts.size());
+    wr(&nd, 4);
+    for (auto& kv : dts) {
+      DenseTable* t = kv.second;
+      DenseTable snap;
+      {
+        std::lock_guard<std::mutex> tg(t->mu);
+        snap.lr = t->lr;
+        snap.optimizer = t->optimizer;
+        snap.step = t->step;
+        snap.param = t->param;
+        snap.accum = t->accum;
+        snap.m = t->m;
+        snap.v = t->v;
+      }
+      wr(&kv.first, 4);
+      wr(&snap.lr, 4);
+      int32_t opt = snap.optimizer;
+      wr(&opt, 4);
+      wr(&snap.step, 8);
+      wr_vec(snap.param);
+      wr_vec(snap.accum);
+      wr_vec(snap.m);
+      wr_vec(snap.v);
+    }
+    uint32_t ns = static_cast<uint32_t>(sts.size());
+    wr(&ns, 4);
+    for (auto& kv : sts) {
+      SparseTable* src = kv.second;
+      SparseTable snap;
+      {
+        std::lock_guard<std::mutex> tg(src->mu);
+        snap.dim = src->dim;
+        snap.lr = src->lr;
+        snap.optimizer = src->optimizer;
+        snap.rows = src->rows;
+        snap.accum = src->accum;
+        snap.mom2 = src->mom2;
+        snap.steps = src->steps;
+      }
+      wr(&kv.first, 4);
+      uint64_t dim = snap.dim;
+      wr(&dim, 8);
+      wr(&snap.lr, 4);
+      int32_t opt = snap.optimizer;
+      wr(&opt, 4);
+      uint64_t nrows = snap.rows.size();
+      wr(&nrows, 8);
+      for (auto& row : snap.rows) {
+        wr(&row.first, 8);
+        uint64_t st = 0;
+        auto sit = snap.steps.find(row.first);
+        if (sit != snap.steps.end()) st = sit->second;
+        wr(&st, 8);
+        wr(row.second.data(), snap.dim * sizeof(float));
+        auto write_slot =
+            [&](std::unordered_map<uint64_t, std::vector<float>>& slot) {
+              auto it = slot.find(row.first);
+              uint8_t has = it != slot.end() ? 1 : 0;
+              wr(&has, 1);
+              if (has) wr(it->second.data(), snap.dim * sizeof(float));
+            };
+        write_slot(snap.accum);
+        write_slot(snap.mom2);
+      }
+    }
+    if (std::fclose(f) != 0) ok = false;
+    if (ok) ok = std::rename(tmp.c_str(), path) == 0;
+    if (!ok) std::remove(tmp.c_str());
+    return ok;
+  }
+
+  bool Load(const char* path) {
+    // only before Start(): replacing live tables would free memory that
+    // request handlers hold raw pointers to (GetDense/GetSparse release
+    // tables_mu_ before use)
+    if (!stopped_.load()) return false;
+    std::FILE* f = std::fopen(path, "rb");
+    if (!f) return false;
+    bool ok = true;
+    auto rd = [&](void* p, size_t n) {
+      if (ok && std::fread(p, 1, n, f) != n) ok = false;
+    };
+    auto rd_vec = [&](std::vector<float>& v) {
+      uint64_t n = 0;
+      rd(&n, 8);
+      if (!ok || n > (1ull << 32)) {
+        ok = false;
+        return;
+      }
+      v.resize(n);
+      if (n) rd(v.data(), n * sizeof(float));
+    };
+    uint32_t magic = 0, version = 0;
+    rd(&magic, 4);
+    rd(&version, 4);
+    if (!ok || magic != 0x53505450u || version != 1) {
+      std::fclose(f);
+      return false;
+    }
+    // stage into local maps; commit only on a fully-valid file so a
+    // truncated snapshot can't leave the server half-loaded
+    std::unordered_map<uint32_t, std::unique_ptr<DenseTable>> staged_dense;
+    std::unordered_map<uint32_t, std::unique_ptr<SparseTable>> staged_sparse;
+    uint32_t nd = 0;
+    rd(&nd, 4);
+    for (uint32_t i = 0; ok && i < nd; ++i) {
+      uint32_t id = 0;
+      rd(&id, 4);
+      auto t = std::make_unique<DenseTable>();
+      rd(&t->lr, 4);
+      int32_t opt = 0;
+      rd(&opt, 4);
+      t->optimizer = opt;
+      rd(&t->step, 8);
+      rd_vec(t->param);
+      rd_vec(t->accum);
+      rd_vec(t->m);
+      rd_vec(t->v);
+      if (ok) staged_dense[id] = std::move(t);
+    }
+    uint32_t ns = 0;
+    rd(&ns, 4);
+    for (uint32_t i = 0; ok && i < ns; ++i) {
+      uint32_t id = 0;
+      rd(&id, 4);
+      auto t = std::make_unique<SparseTable>();
+      uint64_t dim = 0;
+      rd(&dim, 8);
+      rd(&t->lr, 4);
+      int32_t opt = 0;
+      rd(&opt, 4);
+      uint64_t nrows = 0;
+      rd(&nrows, 8);
+      if (!ok || dim > (1u << 20) || nrows > (1ull << 32)) {
+        ok = false;
+        break;
+      }
+      t->dim = dim;
+      t->optimizer = opt;
+      for (uint64_t r = 0; ok && r < nrows; ++r) {
+        uint64_t key = 0, st = 0;
+        rd(&key, 8);
+        rd(&st, 8);
+        std::vector<float> row(dim);
+        rd(row.data(), dim * sizeof(float));
+        if (st) t->steps[key] = st;
+        auto read_slot =
+            [&](std::unordered_map<uint64_t, std::vector<float>>& slot) {
+              uint8_t has = 0;
+              rd(&has, 1);
+              if (ok && has) {
+                std::vector<float> s(dim);
+                rd(s.data(), dim * sizeof(float));
+                if (ok) slot[key] = std::move(s);
+              }
+            };
+        read_slot(t->accum);
+        read_slot(t->mom2);
+        if (ok) t->rows[key] = std::move(row);
+      }
+      if (ok) staged_sparse[id] = std::move(t);
+    }
+    std::fclose(f);
+    if (ok) {
+      std::lock_guard<std::mutex> g(tables_mu_);
+      for (auto& kv : staged_dense) dense_[kv.first] = std::move(kv.second);
+      for (auto& kv : staged_sparse)
+        sparse_[kv.first] = std::move(kv.second);
+    }
+    return ok;
   }
 
   // Safe from any thread (incl. a worker handling kStop): flags shutdown
@@ -283,6 +523,18 @@ class Server {
             t->accum[i] += g[i] * g[i];
             t->param[i] -= t->lr * g[i] / std::sqrt(t->accum[i]);
           }
+        } else if (t->optimizer == 3) {  // adam w/ bias correction
+          if (t->m.size() != m) t->m.assign(m, 0.0f);
+          if (t->v.size() != m) t->v.assign(m, 0.0f);
+          t->step++;
+          float bc1 = 1.0f - std::pow(kAdamBeta1, float(t->step));
+          float bc2 = 1.0f - std::pow(kAdamBeta2, float(t->step));
+          for (size_t i = 0; i < m; ++i) {
+            t->m[i] = kAdamBeta1 * t->m[i] + (1.0f - kAdamBeta1) * g[i];
+            t->v[i] = kAdamBeta2 * t->v[i] + (1.0f - kAdamBeta2) * g[i] * g[i];
+            t->param[i] -= t->lr * (t->m[i] / bc1) /
+                           (std::sqrt(t->v[i] / bc2) + kAdamEps);
+          }
         } else {  // sgd
           for (size_t i = 0; i < m; ++i) t->param[i] -= t->lr * g[i];
         }
@@ -320,10 +572,39 @@ class Server {
         for (uint64_t i = 0; i < n; ++i) {
           auto& row = t->rows[ids[i]];
           if (row.empty()) row.assign(t->dim, 0.0f);
-          for (size_t d = 0; d < t->dim; ++d)
-            row[d] -= t->lr * grads[i * t->dim + d];
+          const float* gr = grads + i * t->dim;
+          if (t->optimizer == 1) {  // adagrad
+            auto& acc = t->accum[ids[i]];
+            if (acc.empty()) acc.assign(t->dim, 1e-6f);
+            for (size_t d = 0; d < t->dim; ++d) {
+              acc[d] += gr[d] * gr[d];
+              row[d] -= t->lr * gr[d] / std::sqrt(acc[d]);
+            }
+          } else if (t->optimizer == 2) {  // adam
+            auto& mm = t->accum[ids[i]];
+            auto& vv = t->mom2[ids[i]];
+            if (mm.empty()) mm.assign(t->dim, 0.0f);
+            if (vv.empty()) vv.assign(t->dim, 0.0f);
+            uint64_t step = ++t->steps[ids[i]];
+            float bc1 = 1.0f - std::pow(kAdamBeta1, float(step));
+            float bc2 = 1.0f - std::pow(kAdamBeta2, float(step));
+            for (size_t d = 0; d < t->dim; ++d) {
+              mm[d] = kAdamBeta1 * mm[d] + (1.0f - kAdamBeta1) * gr[d];
+              vv[d] = kAdamBeta2 * vv[d] + (1.0f - kAdamBeta2) * gr[d] * gr[d];
+              row[d] -= t->lr * (mm[d] / bc1) /
+                        (std::sqrt(vv[d] / bc2) + kAdamEps);
+            }
+          } else {  // sgd
+            for (size_t d = 0; d < t->dim; ++d) row[d] -= t->lr * gr[d];
+          }
         }
         return SendResponse(fd, 0, nullptr, 0);
+      }
+      case kSaveTables: {
+        if (payload_len == 0 || payload_len > 4096)
+          return SendResponse(fd, 1, nullptr, 0);
+        std::string path(payload, payload_len);
+        return SendResponse(fd, Save(path.c_str()) ? 0 : 1, nullptr, 0);
       }
       case kBarrier: {
         // `n` carries the trainer id: arrivals are tracked as a SET so a
@@ -414,6 +695,9 @@ class Client {
     if (payload_len && !WriteFull(fd_, payload, payload_len)) return false;
     uint32_t rlen = 0;
     if (!ReadFull(fd_, &rlen, 4)) return false;
+    // cap server-supplied reply length: a malicious/corrupt peer must not
+    // be able to force an arbitrary-size allocation
+    if (rlen > (1u << 30)) return false;
     std::vector<char> body(rlen);
     if (!ReadFull(fd_, body.data(), rlen)) return false;
     if (body.empty() || body[0] != 0) return false;
@@ -445,8 +729,9 @@ extern "C" {
 
 void* ptrt_ps_server_create() { return new ptrt::ps::Server(); }
 
-int ptrt_ps_server_start(void* s, int port, int n_trainers) {
-  return static_cast<ptrt::ps::Server*>(s)->Start(port, n_trainers);
+int ptrt_ps_server_start(void* s, int port, int n_trainers,
+                         const char* host) {
+  return static_cast<ptrt::ps::Server*>(s)->Start(port, n_trainers, host);
 }
 
 void ptrt_ps_server_create_dense_table(void* s, uint32_t id, uint64_t size,
@@ -455,9 +740,20 @@ void ptrt_ps_server_create_dense_table(void* s, uint32_t id, uint64_t size,
                                                       optimizer);
 }
 
-void ptrt_ps_server_create_sparse_table(void* s, uint32_t id, uint64_t dim,
-                                        float lr) {
-  static_cast<ptrt::ps::Server*>(s)->CreateSparseTable(id, dim, lr);
+int ptrt_ps_server_create_sparse_table(void* s, uint32_t id, uint64_t dim,
+                                       float lr, int optimizer) {
+  return static_cast<ptrt::ps::Server*>(s)->CreateSparseTable(id, dim, lr,
+                                                              optimizer)
+             ? 0
+             : -1;
+}
+
+int ptrt_ps_server_save(void* s, const char* path) {
+  return static_cast<ptrt::ps::Server*>(s)->Save(path) ? 0 : -1;
+}
+
+int ptrt_ps_server_load(void* s, const char* path) {
+  return static_cast<ptrt::ps::Server*>(s)->Load(path) ? 0 : -1;
 }
 
 void ptrt_ps_server_stop(void* s) {

@@ -74,6 +74,14 @@ class PaddleCloudRoleMaker(RoleMakerBase):
     def get_pserver_endpoints(self):
         return self._server_endpoints
 
+    def server_index(self):
+        """This server's position in PADDLE_PSERVERS_IP_PORT_LIST (its
+        dense-table shard index)."""
+        try:
+            return self._server_endpoints.index(self._current_endpoint)
+        except ValueError:
+            return 0
+
 
 class Fleet:
     def __init__(self):
@@ -175,28 +183,47 @@ class Fleet:
     #    run_server/init_worker/stop_worker + TheOnePSRuntime) --------------
     def init_server(self, tables=None, port=None, n_trainers=None):
         """Build the native PS with `tables`:
-        {table_id: ("dense", size, lr, optimizer) | ("sparse", dim, lr)}."""
-        from ..ps import PSServer
+        {table_id: ("dense", size, lr, optimizer) | ("sparse", dim, lr)}.
 
+        With multiple configured pservers, `size` is the GLOBAL dense size:
+        each server creates only its contiguous block
+        (`shard_dense_sizes`), matching the trainer-side ShardedPSClient
+        routing."""
+        from ..ps import PSServer, shard_dense_sizes
+
+        eps = getattr(self._role_maker, "get_pserver_endpoints",
+                      lambda: [])() or []
+        n_servers = max(1, len(eps))
+        my_idx = getattr(self._role_maker, "server_index", lambda: 0)() \
+            if n_servers > 1 else 0
         srv = PSServer()
         for tid, spec in (tables or {}).items():
             kind, *rest = spec
             if kind == "dense":
                 size = rest[0]
+                if n_servers > 1:
+                    size = shard_dense_sizes(size, n_servers)[my_idx]
                 lr = rest[1] if len(rest) > 1 else 0.01
                 opt = rest[2] if len(rest) > 2 else "sgd"
                 srv.create_dense_table(tid, size, lr, opt)
             elif kind == "sparse":
                 dim = rest[0]
                 lr = rest[1] if len(rest) > 1 else 0.01
-                srv.create_sparse_table(tid, dim, lr)
+                opt = rest[2] if len(rest) > 2 else "sgd"
+                srv.create_sparse_table(tid, dim, lr, opt)
             else:
                 raise ValueError(f"unknown table kind {spec[0]}")
+        ep = getattr(self._role_maker, "_current_endpoint", "127.0.0.1:0")
         if port is None:
-            ep = getattr(self._role_maker, "_current_endpoint", "127.0.0.1:0")
             port = int(ep.rsplit(":", 1)[1]) if ":" in ep else 0
+        # bind the interface the endpoint advertises: loopback endpoints
+        # stay loopback (safe default); a routable endpoint must accept
+        # remote trainers, so bind all interfaces there
+        host = ep.rsplit(":", 1)[0] if ":" in ep else "127.0.0.1"
+        bind = "127.0.0.1" if host in ("127.0.0.1", "localhost") else "0.0.0.0"
         self._ps_server = srv
-        self._ps_port = srv.start(port, n_trainers or self.worker_num())
+        self._ps_port = srv.start(port, n_trainers or self.worker_num(),
+                                  host=bind)
         return self._ps_port
 
     def run_server(self):
@@ -210,20 +237,19 @@ class Fleet:
             srv.stop()  # join native threads after a remote OP_STOP
 
     def init_worker(self, endpoint=None, mode=None):
-        from ..ps import Communicator, PSClient
+        from ..ps import Communicator, PSClient, ShardedPSClient
 
         if endpoint is None:
             eps = self._role_maker.get_pserver_endpoints()
             if len(eps) > 1:
-                import warnings
-
-                warnings.warn(
-                    "multiple pserver endpoints configured but table "
-                    "sharding across servers is not implemented; all "
-                    f"traffic goes to {eps[0]}", stacklevel=2)
-            endpoint = eps[0] if eps else "127.0.0.1:0"
-        host, port = endpoint.rsplit(":", 1)
-        self._ps_client = PSClient(host, int(port))
+                # client-side table sharding across all configured servers
+                # (reference brpc_ps_client fan-out)
+                self._ps_client = ShardedPSClient(eps)
+            else:
+                endpoint = eps[0] if eps else "127.0.0.1:0"
+        if endpoint is not None:
+            host, port = endpoint.rsplit(":", 1)
+            self._ps_client = PSClient(host, int(port))
         st = self._strategy or DistributedStrategy()
         if mode is None:
             k = int(st.a_sync_configs.get("k_steps", -1))

@@ -126,6 +126,8 @@ class ShardedTrainStep:
         pnames, bnames = self._pnames, self._bnames
         buf_order = self._buf_order
         K = self.grad_accum
+        from ...optimizer.optimizer import collect_lr_mults
+        lr_mults = collect_lr_mults(params)
 
         def forward_loss(pa, barr, rng, micro_batch):
             writes: Dict[int, Any] = {}
@@ -179,7 +181,7 @@ class ShardedTrainStep:
                 wmap = jax.tree_util.tree_map(lambda w: w[-1], wmaps)
 
             new_params, new_opt = optimizer.apply_gradients(
-                parr, grads, opt_state, lr, step
+                parr, grads, opt_state, lr, step, lr_mults=lr_mults
             )
             new_bufs = dict(barr)
             new_bufs.update(wmap)

@@ -37,6 +37,7 @@ OP_PUSH_SPARSE_GRAD = 5
 OP_BARRIER = 6
 OP_STOP = 7
 OP_PUSH_DENSE_DELTA = 8
+OP_SAVE_TABLES = 9
 
 _PS_SIGS = False
 
@@ -50,12 +51,18 @@ def _lib():
         lib.ptrt_ps_server_create.restype = ctypes.c_void_p
         lib.ptrt_ps_server_start.restype = ctypes.c_int
         lib.ptrt_ps_server_start.argtypes = [ctypes.c_void_p, ctypes.c_int,
-                                             ctypes.c_int]
+                                             ctypes.c_int, ctypes.c_char_p]
         lib.ptrt_ps_server_create_dense_table.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
             ctypes.c_float, ctypes.c_int]
+        lib.ptrt_ps_server_create_sparse_table.restype = ctypes.c_int
         lib.ptrt_ps_server_create_sparse_table.argtypes = [
-            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_float]
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_float, ctypes.c_int]
+        lib.ptrt_ps_server_save.restype = ctypes.c_int
+        lib.ptrt_ps_server_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ptrt_ps_server_load.restype = ctypes.c_int
+        lib.ptrt_ps_server_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.ptrt_ps_server_stop.argtypes = [ctypes.c_void_p]
         lib.ptrt_ps_server_stopped.restype = ctypes.c_int
         lib.ptrt_ps_server_stopped.argtypes = [ctypes.c_void_p]
@@ -78,9 +85,9 @@ def _lib():
 class PSServer:
     """In-process native parameter server (reference BrpcPsServer)."""
 
-    OPT_SGD = 0
-    OPT_ADAGRAD = 1
-    OPT_SUM = 2  # GEO delta apply
+    # wire codes differ per table kind — use the string names in Python
+    DENSE_OPTS = {"sgd": 0, "adagrad": 1, "sum": 2, "adam": 3}
+    SPARSE_OPTS = {"sgd": 0, "adagrad": 1, "adam": 2}
 
     def __init__(self):
         self._lib = _lib()
@@ -89,20 +96,38 @@ class PSServer:
         self.stopped = False
 
     def create_dense_table(self, table_id, size, lr=0.01, optimizer="sgd"):
-        opt = {"sgd": 0, "adagrad": 1, "sum": 2}[optimizer]
         self._lib.ptrt_ps_server_create_dense_table(
-            self._h, table_id, int(size), float(lr), opt)
+            self._h, table_id, int(size), float(lr),
+            self.DENSE_OPTS[optimizer])
 
-    def create_sparse_table(self, table_id, dim, lr=0.01):
-        self._lib.ptrt_ps_server_create_sparse_table(
-            self._h, table_id, int(dim), float(lr))
+    def create_sparse_table(self, table_id, dim, lr=0.01, optimizer="sgd"):
+        rc = self._lib.ptrt_ps_server_create_sparse_table(
+            self._h, table_id, int(dim), float(lr),
+            self.SPARSE_OPTS[optimizer])
+        if rc != 0:
+            raise ValueError(f"invalid sparse optimizer {optimizer!r}")
 
-    def start(self, port=0, n_trainers=1):
+    def start(self, port=0, n_trainers=1, host="127.0.0.1"):
+        """Bind defaults to loopback — the wire protocol is unauthenticated
+        (same trust model as the reference's brpc PS); pass host="0.0.0.0"
+        explicitly for a trusted multi-host network."""
         self.port = self._lib.ptrt_ps_server_start(self._h, int(port),
-                                                   int(n_trainers))
+                                                   int(n_trainers),
+                                                   host.encode())
         if self.port < 0:
-            raise RuntimeError(f"PS server failed to bind port {port}")
+            raise RuntimeError(f"PS server failed to bind {host}:{port}")
         return self.port
+
+    def save(self, path: str) -> None:
+        """Persist all tables + optimizer slots (reference
+        _save_distributed_persistables)."""
+        if self._lib.ptrt_ps_server_save(self._h, path.encode()) != 0:
+            raise RuntimeError(f"PS server save to {path} failed")
+
+    def load(self, path: str) -> None:
+        """Restore tables saved by save(); call before start()."""
+        if self._lib.ptrt_ps_server_load(self._h, path.encode()) != 0:
+            raise RuntimeError(f"PS server load from {path} failed")
 
     def stop(self):
         if self._h:
@@ -185,6 +210,11 @@ class PSClient:
             trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         self._request(OP_BARRIER, table, int(trainer_id), b"", 0)
 
+    def save_tables(self, path: str):
+        """Ask the server to persist its tables to `path` (server-host
+        filesystem)."""
+        self._request(OP_SAVE_TABLES, 0, 0, path.encode(), 0)
+
     def stop_server(self):
         try:
             self._request(OP_STOP, 0, 0, b"", 0)
@@ -201,6 +231,135 @@ class PSClient:
             self.close()
         except Exception:
             pass
+
+
+def shard_dense_sizes(total: int, n_shards: int) -> List[int]:
+    """Contiguous block partition of a dense param across servers
+    (reference common table block scheme: even blocks, remainder spread
+    over the leading shards)."""
+    base, rem = divmod(int(total), n_shards)
+    return [base + (1 if i < rem else 0) for i in range(n_shards)]
+
+
+class ShardedPSClient:
+    """Client-side routing over multiple PS servers (reference
+    `brpc_ps_client.cc` request fan-out + `common_sparse_table.cc` block
+    partitioning).
+
+    - dense tables are split into contiguous blocks, one block per server
+      (`shard_dense_sizes`); pull concatenates, push scatters.
+    - sparse rows route by ``id % n_servers`` (the reference's
+      shard-by-modulo), so each server owns a disjoint id set.
+    Servers must be created with the per-shard sizes, e.g. via
+    ``create_dense_table(tid, shard_dense_sizes(total, n)[i])`` on server i.
+    """
+
+    def __init__(self, endpoints: List):
+        """endpoints: list of (host, port) or "host:port" strings."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.clients: List[PSClient] = []
+        for ep in endpoints:
+            if isinstance(ep, str):
+                host, port = ep.rsplit(":", 1)
+            else:
+                host, port = ep
+            self.clients.append(PSClient(host, int(port)))
+        self.n = len(self.clients)
+        self._dense_sizes: Dict[int, List[int]] = {}
+        # per-shard requests go out concurrently (reference brpc fan-out);
+        # each PSClient serializes its own socket internally
+        self._pool = ThreadPoolExecutor(max_workers=self.n,
+                                        thread_name_prefix="ps-shard")
+
+    def _fanout(self, calls):
+        """Run [(fn, args...)] concurrently, return results in order."""
+        futs = [self._pool.submit(fn, *args) for fn, *args in calls]
+        return [f.result() for f in futs]
+
+    def register_dense(self, table_id, total_size):
+        self._dense_sizes[table_id] = shard_dense_sizes(total_size, self.n)
+
+    def _splits(self, table_id, total=None):
+        sizes = self._dense_sizes.get(table_id)
+        if sizes is None:
+            if total is None:
+                raise KeyError(f"dense table {table_id} not registered")
+            sizes = shard_dense_sizes(total, self.n)
+            self._dense_sizes[table_id] = sizes
+        return sizes
+
+    # -- dense ---------------------------------------------------------------
+    def pull_dense(self, table, size) -> np.ndarray:
+        sizes = self._splits(table, size)
+        parts = self._fanout([(c.pull_dense, table, s)
+                              for c, s in zip(self.clients, sizes) if s])
+        return np.concatenate(parts) if parts else np.zeros(0, np.float32)
+
+    def _scatter_dense(self, table, arr, fn_name):
+        arr = np.ascontiguousarray(arr, np.float32).reshape(-1)
+        sizes = self._splits(table, arr.size)
+        calls, off = [], 0
+        for c, s in zip(self.clients, sizes):
+            if s:
+                calls.append((getattr(c, fn_name), table, arr[off:off + s]))
+            off += s
+        self._fanout(calls)
+
+    def push_dense_grad(self, table, grad):
+        self._scatter_dense(table, grad, "push_dense_grad")
+
+    def push_dense_delta(self, table, delta):
+        self._scatter_dense(table, delta, "push_dense_delta")
+
+    def set_dense(self, table, value):
+        self._scatter_dense(table, value, "set_dense")
+
+    # -- sparse --------------------------------------------------------------
+    def pull_sparse(self, table, ids: np.ndarray, dim: int) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.uint64)
+        shard = (ids % np.uint64(self.n)).astype(np.int64)
+        out = np.zeros((ids.size, dim), np.float32)
+        masks = [shard == s for s in range(self.n)]
+        calls = [(self.clients[s].pull_sparse, table, ids[m], dim)
+                 for s, m in enumerate(masks) if m.any()]
+        results = self._fanout(calls)
+        ri = 0
+        for m in masks:
+            if m.any():
+                out[m] = results[ri]
+                ri += 1
+        return out
+
+    def push_sparse_grad(self, table, ids: np.ndarray, grads: np.ndarray):
+        ids = np.ascontiguousarray(ids, np.uint64)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(ids.size, -1)
+        shard = (ids % np.uint64(self.n)).astype(np.int64)
+        self._fanout([
+            (self.clients[s].push_sparse_grad, table, ids[shard == s],
+             grads[shard == s])
+            for s in range(self.n) if (shard == s).any()
+        ])
+
+    # -- control -------------------------------------------------------------
+    def barrier(self, trainer_id=None, table=0):
+        # one designated server arbitrates the barrier; all trainers use the
+        # same fan-out order so server 0 is consistent across the job
+        self.clients[0].barrier(trainer_id, table)
+
+    def save_tables(self, path_prefix: str):
+        """Each server persists its shard to `{prefix}.shard{i}`."""
+        self._fanout([(c.save_tables, f"{path_prefix}.shard{i}")
+                      for i, c in enumerate(self.clients)])
+
+    def stop_servers(self):
+        for c in self.clients:
+            c.stop_server()
+
+    def close(self):
+        self._pool.shutdown(wait=False)
+        for c in self.clients:
+            c.close()
 
 
 class Communicator:

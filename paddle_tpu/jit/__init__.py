@@ -225,6 +225,8 @@ class TrainStep:
         params, buffers = self._params, self._buffers
         pnames, bnames = self._pnames, self._bnames
         buf_order_holder = self._buf_order
+        from ..optimizer.optimizer import collect_lr_mults
+        lr_mults = collect_lr_mults(params)
 
         def pure(parr: Dict[str, Any], opt_state, barr: Dict[str, Any], lr,
                  step, rng, batch):
@@ -250,7 +252,7 @@ class TrainStep:
 
             (loss, wmap), grads = jax.value_and_grad(loss_of, has_aux=True)(parr)
             new_params, new_opt = optimizer.apply_gradients(
-                parr, grads, opt_state, lr, step
+                parr, grads, opt_state, lr, step, lr_mults=lr_mults
             )
             new_bufs = dict(barr)
             new_bufs.update(wmap)

@@ -118,7 +118,11 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
             tokens, log_probs, finished, states, batch_size)
         step_ids.append(tokens)
         parents.append(parent)
-        if bool(jax.device_get(finished.all())):
+        # early exit is a host-side convenience only: under jit tracing
+        # `finished` is a Tracer (no concrete bool), so fall back to the
+        # fixed max_step_num horizon — finished lanes are masked anyway
+        if not isinstance(finished, jax.core.Tracer) and \
+                bool(jax.device_get(finished.all())):
             break
     ids = Tensor(jnp.stack(step_ids))        # [T, B, W]
     par = Tensor(jnp.stack(parents))         # [T, B, W]

@@ -24,6 +24,18 @@ from ..core.tensor import Tensor
 from .lr import LRScheduler
 
 
+def collect_lr_mults(params: Dict[str, object]) -> Optional[Dict[str, float]]:
+    """ParamAttr.learning_rate multipliers for a named param dict (reference
+    ``_create_param_lr``); None when every multiplier is 1.0 so callers can
+    skip the per-param scaling entirely."""
+    mults = {
+        k: float((getattr(t, "optimize_attr", None) or {})
+                 .get("learning_rate", 1.0))
+        for k, t in params.items()
+    }
+    return None if all(m == 1.0 for m in mults.values()) else mults
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, multi_precision=False):
@@ -100,8 +112,13 @@ class Optimizer:
                     self._state[key] = self._init_slot(p._array)
                 garr = self._apply_decay(p._array,
                                          g._array.astype(p._array.dtype), p)
+                # per-parameter LR multiplier from ParamAttr.learning_rate
+                # (reference optimizer.py _create_param_lr)
+                oattr = getattr(p, "optimize_attr", None) or {}
+                mult = float(oattr.get("learning_rate", 1.0))
                 new_p, new_slot = self._update_param(
-                    p._array, garr, self._state[key], lr, self._step_count
+                    p._array, garr, self._state[key],
+                    lr * mult if mult != 1.0 else lr, self._step_count
                 )
                 p._array = new_p
                 self._state[key] = new_slot
@@ -125,8 +142,12 @@ class Optimizer:
         }
 
     def apply_gradients(self, params: dict, grads: dict, state: dict, lr,
-                        step=1):
-        """Pure pytree update: params/grads dict[str]->array."""
+                        step=1, lr_mults: Optional[dict] = None):
+        """Pure pytree update: params/grads dict[str]->array.
+
+        ``lr_mults`` carries per-parameter ParamAttr.learning_rate
+        multipliers (reference ``_create_param_lr``) keyed like params.
+        """
         new_params, new_state = {}, {}
         if self._grad_clip is not None:
             keys = [k for k in params if grads.get(k) is not None]
@@ -141,7 +162,10 @@ class Optimizer:
                 new_state[k] = state.get(k, {})
                 continue
             g = self._apply_decay(p, g.astype(p.dtype))
-            np_, ns_ = self._update_param(p, g, state.get(k) or self._init_slot(p), lr, step)
+            mult = lr_mults.get(k, 1.0) if lr_mults else 1.0
+            np_, ns_ = self._update_param(
+                p, g, state.get(k) or self._init_slot(p),
+                lr * mult if mult != 1.0 else lr, step)
             new_params[k] = np_
             new_state[k] = ns_
         return new_params, new_state

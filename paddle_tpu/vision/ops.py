@@ -319,8 +319,12 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
-    """Max-pool ROI pooling (`operators/roi_pool_op.*`) — approximated on
-    TPU by dense bilinear sampling + max (static-shape friendly)."""
+    """Max-pool ROI pooling, exact reference semantics
+    (`operators/roi_pool_op.h`): integer bin ranges
+    ``hstart = floor(ph*bin_h)+y1 .. hend = ceil((ph+1)*bin_h)+y1`` and a
+    true max over every pixel in the bin (empty bins output 0).  Realized
+    as static-shape row/col masks + masked max so XLA sees fixed shapes —
+    no sampling approximation."""
     if isinstance(output_size, int):
         out_h = out_w = output_size
     else:
@@ -332,27 +336,51 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
         roi_batch = jnp.sum(
             (jnp.arange(rois.shape[0])[:, None] >= ends[None, :]).astype(
                 jnp.int32), axis=1)
-        x1 = jnp.round(rois[:, 0] * spatial_scale)
-        y1 = jnp.round(rois[:, 1] * spatial_scale)
-        x2 = jnp.round(rois[:, 2] * spatial_scale)
-        y2 = jnp.round(rois[:, 3] * spatial_scale)
+
+        def cround(v):
+            # C round(): half away from zero (jnp.round is half-to-even,
+            # which shifts ROI edges at .5 boundaries, e.g. scale 1/16)
+            return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
+        x1 = cround(rois[:, 0] * spatial_scale)
+        y1 = cround(rois[:, 1] * spatial_scale)
+        x2 = cround(rois[:, 2] * spatial_scale)
+        y2 = cround(rois[:, 3] * spatial_scale)
         rw = jnp.maximum(x2 - x1 + 1, 1.0)
         rh = jnp.maximum(y2 - y1 + 1, 1.0)
-        ratio = 4  # dense samples per output bin edge
-        gy = (jnp.arange(out_h * ratio) + 0.5) / (out_h * ratio)
-        gx = (jnp.arange(out_w * ratio) + 0.5) / (out_w * ratio)
-        sy = y1[:, None] + rh[:, None] * gy[None, :]
-        sx = x1[:, None] + rw[:, None] * gx[None, :]
+        bin_h = rh / out_h  # [R]
+        bin_w = rw / out_w
+        ph = jnp.arange(out_h, dtype=jnp.float32)
+        pw = jnp.arange(out_w, dtype=jnp.float32)
+        # integer bin edges, clipped into the feature map ([R, out])
+        hs = jnp.clip(jnp.floor(ph[None, :] * bin_h[:, None]) + y1[:, None],
+                      0, h)
+        he = jnp.clip(jnp.ceil((ph[None, :] + 1) * bin_h[:, None])
+                      + y1[:, None], 0, h)
+        ws = jnp.clip(jnp.floor(pw[None, :] * bin_w[:, None]) + x1[:, None],
+                      0, w)
+        we = jnp.clip(jnp.ceil((pw[None, :] + 1) * bin_w[:, None])
+                      + x1[:, None], 0, w)
+        hidx = jnp.arange(h, dtype=jnp.float32)
+        widx = jnp.arange(w, dtype=jnp.float32)
+        # [R, out_h, H] / [R, out_w, W] membership masks
+        rowmask = (hidx[None, None, :] >= hs[:, :, None]) & \
+                  (hidx[None, None, :] < he[:, :, None])
+        colmask = (widx[None, None, :] >= ws[:, :, None]) & \
+                  (widx[None, None, :] < we[:, :, None])
+        neg = jnp.asarray(-jnp.inf, xv.dtype)
 
-        def per_roi(b, ys, xs):
-            img = xv[b]
-            yi = jnp.clip(ys, 0, h - 1).astype(jnp.int32)
-            xi = jnp.clip(xs, 0, w - 1).astype(jnp.int32)
-            vals = img[:, yi][:, :, xi]  # [C, Sy, Sx]
-            vals = vals.reshape(c, out_h, ratio, out_w, ratio)
-            return vals.max(axis=(2, 4))
+        def per_roi(b, rm, cm):
+            img = xv[b]  # [C, H, W]
+            # max over rows in each bin-row: [C, out_h, W]
+            rowmax = jnp.where(rm[None, :, :, None], img[:, None, :, :],
+                               neg).max(axis=2)
+            # then max over cols in each bin-col: [C, out_h, out_w]
+            full = jnp.where(cm[None, None, :, :], rowmax[:, :, None, :],
+                             neg).max(axis=3)
+            return jnp.where(jnp.isfinite(full), full, 0.0).astype(xv.dtype)
 
-        return jax.vmap(per_roi)(roi_batch, sy, sx)
+        return jax.vmap(per_roi)(roi_batch, rowmask, colmask)
 
     return dispatch(f, x, boxes, boxes_num, nondiff=(2,))
 

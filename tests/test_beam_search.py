@@ -57,3 +57,23 @@ class TestBeamSearch:
         # 2 -> 3(end) then padding with end tokens only
         assert best[0] == 3
         assert (best[1:] == 3).all() or len(best) == 1
+
+    def test_dynamic_decode_under_jit_trace(self):
+        """finished is a Tracer inside jit — the early-exit check must be
+        skipped (fixed horizon), not raise TracerBoolConversionError."""
+        import jax
+
+        vocab, end = 6, 5
+        cell = DeterministicCell(vocab)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=end,
+                                   beam_size=2)
+
+        def run(init_arr):
+            seqs, scores = nn.dynamic_decode(dec, Tensor(init_arr),
+                                             max_step_num=8)
+            return seqs._array, scores._array
+
+        eager_seqs, _ = run(jnp.zeros((3, 4)))
+        jit_seqs, _ = jax.jit(run)(jnp.zeros((3, 4)))
+        np.testing.assert_array_equal(np.asarray(eager_seqs),
+                                      np.asarray(jit_seqs))

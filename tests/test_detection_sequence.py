@@ -355,3 +355,67 @@ class TestSequenceOps:
         g = x.grad.numpy()
         # padding positions get zero grad
         assert g[0, 2].sum() == 0 and g[0, 0].sum() > 0
+
+
+class TestRoiPoolExact:
+    """roi_pool must match the reference's exact integer-bin max semantics
+    (operators/roi_pool_op.h), including large ROIs whose bins span many
+    pixels (the old sampled approximation missed interior maxima)."""
+
+    @staticmethod
+    def _np_roi_pool(x, rois, out_h, out_w, scale):
+        def cround(v):  # C round(): half away from zero, like the reference
+            return int(np.floor(abs(v) + 0.5) * np.sign(v))
+
+        n_roi = rois.shape[0]
+        c, h, w = x.shape[1:]
+        out = np.zeros((n_roi, c, out_h, out_w), np.float32)
+        for r in range(n_roi):
+            x1 = cround(rois[r, 0] * scale)
+            y1 = cround(rois[r, 1] * scale)
+            x2 = cround(rois[r, 2] * scale)
+            y2 = cround(rois[r, 3] * scale)
+            rh = max(y2 - y1 + 1, 1)
+            rw = max(x2 - x1 + 1, 1)
+            bh, bw = rh / out_h, rw / out_w
+            for ph in range(out_h):
+                for pw in range(out_w):
+                    hs = min(max(int(np.floor(ph * bh)) + y1, 0), h)
+                    he = min(max(int(np.ceil((ph + 1) * bh)) + y1, 0), h)
+                    ws = min(max(int(np.floor(pw * bw)) + x1, 0), w)
+                    we = min(max(int(np.ceil((pw + 1) * bw)) + x1, 0), w)
+                    if he <= hs or we <= ws:
+                        continue
+                    out[r, :, ph, pw] = x[0, :, hs:he, ws:we].max(axis=(1, 2))
+        return out
+
+    def test_matches_numpy_large_rois(self):
+        import paddle_tpu as paddle
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 2, 32, 32).astype(np.float32)
+        # large ROI: bins span 8+ pixels per edge — the old 4-sample grid
+        # would miss the true max here
+        rois = np.array([[0.0, 0.0, 31.0, 31.0],
+                         [4.0, 2.0, 30.0, 28.0],
+                         [10.0, 10.0, 12.0, 12.0]], np.float32)
+        out = paddle.vision.ops.roi_pool(
+            paddle.to_tensor(x), paddle.to_tensor(rois),
+            paddle.to_tensor(np.array([3], np.int32)), output_size=4,
+            spatial_scale=1.0)
+        ref = self._np_roi_pool(x, rois, 4, 4, 1.0)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref, atol=1e-5)
+
+    def test_half_boundary_rounding(self):
+        """scale 1/16 puts ROI edges exactly on .5 — C round() (half away
+        from zero) must win over round-half-to-even."""
+        import paddle_tpu as paddle
+        rng = np.random.RandomState(7)
+        x = rng.randn(1, 1, 8, 8).astype(np.float32)
+        # 8 * 1/16 = 0.5 -> must round to 1, not 0
+        rois = np.array([[8.0, 8.0, 104.0, 104.0]], np.float32)
+        out = paddle.vision.ops.roi_pool(
+            paddle.to_tensor(x), paddle.to_tensor(rois),
+            paddle.to_tensor(np.array([1], np.int32)), output_size=2,
+            spatial_scale=1.0 / 16.0)
+        ref = self._np_roi_pool(x, rois, 2, 2, 1.0 / 16.0)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref, atol=1e-5)

@@ -154,3 +154,45 @@ class TestLRSchedulers:
         s3.step(metrics=1.0)
         s3.step(metrics=2.0)  # worse -> reduce
         assert abs(s3() - 0.05) < 1e-9
+
+
+class TestPerParamLR:
+    """ParamAttr.learning_rate multiplier (reference optimizer.py
+    _create_param_lr): a 0.5x param must move at half the base LR."""
+
+    def test_step_applies_multiplier(self):
+        import paddle_tpu as paddle
+        p_full = nn.Parameter(np.array([1.0], dtype=np.float32))
+        p_half = nn.Parameter(np.array([1.0], dtype=np.float32))
+        p_half.optimize_attr["learning_rate"] = 0.5
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p_full, p_half])
+        loss = (p_full * 2.0 + p_half * 2.0).sum()
+        loss.backward()
+        opt.step()
+        assert np.allclose(_np(p_full), 1.0 - 0.1 * 2.0, atol=1e-6)
+        assert np.allclose(_np(p_half), 1.0 - 0.05 * 2.0, atol=1e-6)
+
+    def test_layer_param_attr_through_trainstep(self):
+        """The compiled TrainStep path honors the multiplier too."""
+        import paddle_tpu as paddle
+        from paddle_tpu import jit
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(
+                    2, 2,
+                    weight_attr=paddle.ParamAttr(learning_rate=0.0))
+
+            def forward(self, x):
+                return self.fc(x)
+
+        m = M()
+        w0 = _np(m.fc.weight).copy()
+        opt = optimizer.SGD(learning_rate=0.5,
+                            parameters=list(m.parameters()))
+        step = jit.TrainStep(m, lambda mm, x: mm(x).sum(), opt)
+        step(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        # weight LR multiplier 0 -> frozen; bias (mult 1) moves
+        assert np.allclose(_np(m.fc.weight), w0, atol=1e-7)
+        assert not np.allclose(_np(m.fc.bias), 0.0, atol=1e-7)
