@@ -3,7 +3,7 @@ from . import collective
 from .collective import (Group, ReduceOp, all_gather, all_gather_object,
                          all_reduce, alltoall, barrier, broadcast, irecv,
                          isend, new_group, recv, reduce, reduce_scatter,
-                         scatter, send, wait)
+                         scatter, send, split, wait)
 from .parallel import (ParallelEnv, get_rank, get_world_size,
                        init_parallel_env)
 from .topology import (CommunicateTopology, HybridCommunicateGroup,

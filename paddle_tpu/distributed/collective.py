@@ -243,16 +243,68 @@ def barrier(group=None):
 
 
 def wait(tensor, group=None, use_calc_stream=True):
-    unwrap(tensor).block_until_ready() if hasattr(unwrap(tensor), "block_until_ready") else None
+    arr = unwrap(tensor)
+    if hasattr(arr, "block_until_ready"):
+        arr.block_until_ready()
+    return tensor
 
 
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
           weight_attr=None, bias_attr=None, name=None):
     """reference `paddle.distributed.split` (collective.py:1282) — megatron
-    style sharded fc/embedding; here delegated to fleet mp_layers."""
-    from .fleet import meta_parallel as mp
+    style sharded fc/embedding, delegated to fleet mp_layers under the
+    current mesh.  Like the reference, each call BUILDS the parallel layer
+    (fresh parameters) and applies it — it is a network-construction API,
+    not a stateless op.  The constructed layer is exposed as
+    ``split.last_layer`` so callers can reach its parameters."""
+    from .fleet.meta_parallel import (ColumnParallelLinear,
+                                      RowParallelLinear,
+                                      VocabParallelEmbedding)
+    from .topology import get_hybrid_communicate_group
 
-    raise NotImplementedError(
-        "use paddle_tpu.distributed.fleet.meta_parallel.ColumnParallelLinear /"
-        " RowParallelLinear / VocabParallelEmbedding"
-    )
+    # the layers shard over the mesh's 'mp' axis, so num_partitions must
+    # agree with it (the reference asserts num_partitions == mp world size,
+    # collective.py:1282); validate divisibility against the REAL degree
+    hcg = get_hybrid_communicate_group()
+    mesh = getattr(hcg, "mesh", None) if hcg is not None else None
+    mp_deg = int(mesh.shape.get("mp", 1)) if mesh is not None else None
+    if mp_deg is not None and mp_deg > 1 and num_partitions != mp_deg:
+        raise ValueError(
+            f"num_partitions {num_partitions} must equal the mesh "
+            f"mp degree {mp_deg}")
+    shards = mp_deg if mp_deg and mp_deg > 1 else max(num_partitions, 1)
+
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            # weight split along rows (in_features): reference row-parallel
+            if in_f % shards:
+                raise ValueError(
+                    f"in_features {in_f} not divisible by {shards} "
+                    "partitions")
+            layer = RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        elif axis == 1:
+            if out_f % shards:
+                raise ValueError(
+                    f"out_features {out_f} not divisible by {shards} "
+                    "partitions")
+            layer = ColumnParallelLinear(in_f, out_f,
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        else:
+            raise ValueError("linear split axis must be 0 or 1")
+    elif operation == "embedding":
+        if axis != 0:
+            raise ValueError("embedding split supports axis=0 (vocab)")
+        vocab, hidden = size
+        layer = VocabParallelEmbedding(vocab, hidden,
+                                       weight_attr=weight_attr)
+    else:
+        raise ValueError(
+            f"unknown split operation {operation!r}; expected 'linear' or "
+            "'embedding'")
+    out = layer(x)
+    split.last_layer = layer
+    return out

@@ -161,17 +161,41 @@ class Fleet:
         return DataParallel(model, hcg=self._hcg, strategy=self._strategy)
 
     def build_train_step(self, model, loss_fn, optimizer=None):
-        """TPU-native entry: compile the strategy into one sharded step."""
+        """TPU-native entry: compile the strategy into one sharded step.
+
+        With ``hybrid_configs["pp_degree"] > 1`` and a PipelineLayer model,
+        this returns the compiled 1F1B pipeline step (params sharded per
+        stage over 'pp'); loss_fn then takes ``(output, label)`` like the
+        reference PipelineLayer loss.  Otherwise the GSPMD ShardedTrainStep
+        (dp/mp/zero/grad-merge) with ``loss_fn(model, *batch)``."""
         from .sharded_step import ShardedTrainStep
 
         opt = optimizer or self._user_optimizer
         st = self._strategy or DistributedStrategy()
-        zero = int(st.sharding_configs.get("stage", 1)) if st.sharding else 0
-        k = int(st.gradient_merge_configs.get("k_steps", 1)) if st.gradient_merge else 1
         inner = model.network if hasattr(model, "network") else model
         inner = getattr(inner, "_layers", inner)
-        return ShardedTrainStep(inner, loss_fn, opt, self._hcg.mesh,
-                                zero_stage=zero, grad_accum=k)
+        mesh = self._hcg.mesh
+        pp = int(mesh.shape.get("pp", 1))
+        from .meta_parallel.pipeline_parallel import PipelineLayer
+
+        if pp > 1 and isinstance(inner, PipelineLayer):
+            from .pipeline_step import PipelineTrainStep
+
+            n_micro = int(st.pipeline_configs.get("accumulate_steps", pp)) \
+                if st.pipeline else pp
+            return PipelineTrainStep(inner, loss_fn, opt, mesh,
+                                     n_micro=n_micro)
+        zero = int(st.sharding_configs.get("stage", 1)) if st.sharding else 0
+        k = int(st.gradient_merge_configs.get("k_steps", 1)) if st.gradient_merge else 1
+        offload = bool(st.sharding and
+                       st.sharding_configs.get("offload", False))
+        return ShardedTrainStep(inner, loss_fn, opt, mesh,
+                                zero_stage=zero, grad_accum=k,
+                                recompute=bool(st.recompute),
+                                offload=offload,
+                                recompute_checkpoints=st.recompute_configs
+                                .get("checkpoints") if st.recompute
+                                else None)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):

@@ -137,14 +137,43 @@ class PipelineParallel(Layer):
         if strategy is not None:
             acc = int(strategy.pipeline_configs.get("accumulate_steps", 1))
         self.accumulate_steps = acc
+        self._compiled_step = None
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
+
+    def _pp_mesh(self):
+        mesh = getattr(self._hcg, "mesh", None) if self._hcg else None
+        if mesh is not None and int(mesh.shape.get("pp", 1)) > 1 and \
+                int(mesh.shape.get("pp", 1)) == self._layers._num_stages:
+            return mesh
+        return None
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         from ....ops import split as tsplit
 
         inputs, labels = data
+        # compiled 1F1B path: whole schedule + optimizer in one SPMD
+        # program, per-stage params sharded over 'pp' (section_worker 1F1B).
+        # The user's accumulate_steps IS the micro-batch count (schedule
+        # bubbles simply grow when it is < pp); batches the micro split
+        # cannot divide fall back to the eager loop instead of erroring.
+        mesh = self._pp_mesh()
+        if mesh is not None and scaler is None:
+            n_micro = max(1, self.accumulate_steps)
+            dp = int(mesh.shape.get("dp", 1))
+            bsz = inputs.shape[0] if hasattr(inputs, "shape") else None
+            if bsz is not None and bsz % (n_micro * dp) == 0:
+                if self._compiled_step is None:
+                    from ..pipeline_step import PipelineTrainStep
+
+                    self._compiled_step = PipelineTrainStep(
+                        self._layers, self._layers._loss_fn, optimizer,
+                        mesh, n_micro=n_micro)
+                loss = self._compiled_step(inputs, labels)
+                if lr_scheduler is not None:
+                    lr_scheduler.step()
+                return loss
         k = self.accumulate_steps
         total = None
         micro_in = tsplit(inputs, k, axis=0) if k > 1 else [inputs]
@@ -166,7 +195,15 @@ class PipelineParallel(Layer):
             lr_scheduler.step()
         return total / k
 
+    def state_dict(self, *args, **kwargs):
+        # master params live in the compiled step's packed copy
+        if self._compiled_step is not None:
+            self._compiled_step.sync_params()
+        return self._layers.state_dict(*args, **kwargs)
+
     def eval_batch(self, data, compute_loss=True):
+        if self._compiled_step is not None:
+            self._compiled_step.sync_params()
         inputs, labels = data
         out = self._layers(inputs)
         if compute_loss and self._layers._loss_fn is not None:

@@ -62,11 +62,56 @@ def _opt_state_spec(pspec: PartitionSpec, p, zero_stage: int, mesh: Mesh):
     return PartitionSpec(*spec)
 
 
+def _wrap_recompute_blocks(model, checkpoint_names):
+    """Wrap selected sublayers' forwards in jax.checkpoint (reference
+    RecomputeOptimizer checkpoints / recompute_configs["checkpoints"]).
+
+    ``checkpoint_names`` selects sublayers by their `named_sublayers` name
+    prefix; empty means every direct child with parameters.  Wrapping is
+    idempotent and only active under a jit trace — eager calls fall
+    through untouched."""
+    targets = []
+    if checkpoint_names:
+        wanted = set(checkpoint_names)
+        for name, ly in model.named_sublayers():
+            if name in wanted:
+                targets.append(ly)
+    else:
+        for _, ly in model.named_children():
+            if ly.parameters():
+                targets.append(ly)
+
+    for ly in targets:
+        if getattr(ly, "_recompute_wrapped", False):
+            continue
+        orig = ly.forward
+
+        def ckpt_forward(*args, __orig=orig, **kwargs):
+            if not framework.in_trace():
+                return __orig(*args, **kwargs)
+            t_pos = [i for i, a in enumerate(args)
+                     if isinstance(a, Tensor)]
+            arrs = [args[i]._array for i in t_pos]
+
+            def pure(*xs):
+                new_args = list(args)
+                for i, x in zip(t_pos, xs):
+                    new_args[i] = Tensor(x)
+                out = __orig(*new_args, **kwargs)
+                return out._array if isinstance(out, Tensor) else out
+
+            return Tensor(jax.checkpoint(pure)(*arrs))
+
+        ly.forward = ckpt_forward
+        ly._recompute_wrapped = True
+
+
 class ShardedTrainStep:
     def __init__(self, model, loss_fn: Callable, optimizer, mesh: Mesh,
                  zero_stage: int = 0, grad_accum: int = 1,
                  batch_axis: str = "dp", donate: bool = True,
-                 loss_dtype=jnp.float32):
+                 loss_dtype=jnp.float32, recompute: bool = False,
+                 offload: bool = False, recompute_checkpoints=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -74,6 +119,20 @@ class ShardedTrainStep:
         self.zero_stage = zero_stage
         self.grad_accum = max(1, grad_accum)
         self.batch_axis = batch_axis
+        # strategy.recompute: rematerialize forward activations during the
+        # backward pass (reference RecomputeOptimizer / fleet recompute).
+        # Each recompute block's forward is wrapped in jax.checkpoint, so
+        # only block-boundary activations are saved between fwd and bwd —
+        # whole-forward remat would NOT reduce peak (the rematerialized
+        # backward still holds every activation at once).
+        self.recompute = recompute
+        if recompute:
+            names = list(recompute_checkpoints or [])
+            _wrap_recompute_blocks(model, names)
+        # sharding_configs["offload"]: keep optimizer moments in host
+        # memory (reference sharding/offload_helper.py); falls back to
+        # device memory where the backend has no pinned_host space
+        self.offload = offload
         self._donate = donate
         params, buffers = model.functional_state()
         self._params = params
@@ -105,6 +164,34 @@ class ShardedTrainStep:
             b = self._buffers[k]
             b._array = jax.device_put(b._array, self.buffer_shardings[k])
 
+    def _maybe_host(self, sh: NamedSharding) -> NamedSharding:
+        """Offload variant of a sharding: pinned host memory when the
+        backend supports it (TPU), unchanged otherwise."""
+        if not self.offload:
+            return sh
+        if not hasattr(self, "_host_ok"):
+            # probe once: not just device_put — the whole in-jit
+            # host->device->host round trip must compile (the CPU SPMD
+            # partitioner rejects pinned_host placement annotations)
+            try:
+                host = self._repl.with_memory_kind("pinned_host")
+                dev = self._repl.with_memory_kind("device")
+                probe = jax.jit(
+                    lambda a: jax.device_put(
+                        jax.device_put(a, dev) + 1.0, host),
+                    in_shardings=host, out_shardings=host)
+                jax.block_until_ready(probe(
+                    jax.device_put(jnp.zeros((), jnp.float32), host)))
+                self._host_ok = True
+            except Exception:
+                self._host_ok = False
+        if not self._host_ok:
+            return sh
+        try:
+            return sh.with_memory_kind("pinned_host")
+        except Exception:
+            return sh
+
     def _opt_shardings(self, opt_state):
         out = {}
         for k in self._pnames:
@@ -114,9 +201,10 @@ class ShardedTrainStep:
             slots = {}
             for sk, sv in opt_state[k].items():
                 if getattr(sv, "ndim", 0) == p.ndim and p.ndim > 0:
-                    slots[sk] = NamedSharding(self.mesh, sspec)
+                    slots[sk] = self._maybe_host(
+                        NamedSharding(self.mesh, sspec))
                 else:
-                    slots[sk] = self._repl
+                    slots[sk] = self._maybe_host(self._repl)
             out[k] = slots
         return out
 
@@ -180,16 +268,32 @@ class ShardedTrainStep:
                 loss = lsum / K
                 wmap = jax.tree_util.tree_map(lambda w: w[-1], wmaps)
 
+            if host_opt_shardings is not None:
+                # offload: moments live in pinned host memory between
+                # steps; bring them on-device for the update, push back
+                # after (XLA overlaps the transfers with compute)
+                opt_state = jax.device_put(opt_state, dev_opt_shardings)
             new_params, new_opt = optimizer.apply_gradients(
                 parr, grads, opt_state, lr, step, lr_mults=lr_mults
             )
+            if host_opt_shardings is not None:
+                new_opt = jax.device_put(new_opt, host_opt_shardings)
             new_bufs = dict(barr)
             new_bufs.update(wmap)
             return loss, new_params, new_opt, new_bufs
 
+        opt_sh = self._opt_shardings(self._opt_state)
+        if self.offload and getattr(self, "_host_ok", False):
+            host_opt_shardings = opt_sh
+            dev_opt_shardings = jax.tree_util.tree_map(
+                lambda s: s.with_memory_kind("device"), opt_sh,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
+        else:
+            host_opt_shardings = dev_opt_shardings = None
+
         in_shardings = (
             {k: self.param_shardings[k] for k in pnames},
-            self._opt_shardings(self._opt_state),
+            opt_sh,
             {k: self.buffer_shardings[k] for k in bnames},
             self._repl, self._repl, self._repl,
             tuple(self._batch_sharding for _ in range(n_batch_args)),
@@ -197,7 +301,7 @@ class ShardedTrainStep:
         out_shardings = (
             self._repl,
             {k: self.param_shardings[k] for k in pnames},
-            self._opt_shardings(self._opt_state),
+            opt_sh,
             {k: self.buffer_shardings[k] for k in bnames},
         )
         donate = (1, 2) if self._donate else ()

@@ -252,12 +252,21 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, multiprocess_mode="process"):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        # "process": spawned workers + shm transport (reference
+        # dataloader_iter.py:248 fork workers); "thread": GIL-bound pool
+        # (fine for numpy-heavy transforms, zero startup cost)
+        self.multiprocess_mode = multiprocess_mode
+        self._pool = None  # persistent worker pool
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -295,7 +304,100 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._to_tensors(self._fetch(indices))
             return
+        if self.multiprocess_mode == "process":
+            try:
+                pool = self._ensure_pool()
+            except Exception as e:
+                # unpicklable dataset / restricted platform: degrade to the
+                # thread pool rather than failing the input pipeline
+                import warnings
+
+                warnings.warn(
+                    f"multiprocess DataLoader unavailable ({e!r}); "
+                    "falling back to threads", stacklevel=2)
+                pool = None
+            if pool is not None:
+                # a worker that dies before producing ANYTHING is a spawn
+                # bootstrap failure (e.g. guard-less __main__ script), not
+                # a data error — data errors are reported through the
+                # result queue by a live worker.  Only that case degrades
+                # to threads; after the first batch, errors propagate.
+                mp_iter = self._iter_multiprocess(pool)
+                try:
+                    first = next(mp_iter)
+                except StopIteration:
+                    return
+                except RuntimeError as e:
+                    if "exited unexpectedly" not in str(e):
+                        raise
+                    import warnings
+
+                    warnings.warn(
+                        "DataLoader worker processes failed to start "
+                        "(is the training script missing an `if __name__"
+                        " == '__main__'` guard?); falling back to "
+                        "threads", stacklevel=2)
+                    self._pool = None
+                else:
+                    yield first
+                    yield from mp_iter
+                    return
         yield from self._iter_threaded()
+
+    # -- multiprocess path (reference dataloader_iter.py:248) ---------------
+    def _ensure_pool(self):
+        if self._pool is not None and self._pool.alive():
+            return self._pool
+        self._pool = _WorkerPool(self.dataset, self.collate_fn,
+                                 self.num_workers, self.use_shared_memory,
+                                 self.worker_init_fn)
+        return self._pool
+
+    def _iter_multiprocess(self, pool):
+        from .worker import _discard_payload, _unpack
+
+        indices_list = list(self.batch_sampler)
+        depth = self.num_workers * self.prefetch_factor
+        gen = pool.start_epoch()  # stale results from a truncated prior
+        sent = 0                  # epoch carry this tag and are discarded
+        for i in range(min(depth, len(indices_list))):
+            pool.send(gen, i, indices_list[i])
+            sent += 1
+        pending = {}
+        # timeout=0 = wait indefinitely (reference semantics); worker
+        # death still raises via the watchdog inside recv()
+        timeout = self.timeout if self.timeout and self.timeout > 0 \
+            else None
+        try:
+            for want in range(len(indices_list)):
+                while want not in pending:
+                    rgen, bid, payload, err = pool.recv(timeout)
+                    if rgen != gen:
+                        _discard_payload(payload)  # unlink stale epoch shm
+                        continue
+                    if err is not None:
+                        raise RuntimeError(
+                            f"DataLoader worker failed:\n{err}")
+                    pending[bid] = payload
+                payload = pending.pop(want)
+                if sent < len(indices_list):
+                    pool.send(gen, sent, indices_list[sent])
+                    sent += 1
+                yield self._to_tensors(_unpack(payload))
+        finally:
+            # unlink shm of anything buffered but never consumed
+            for payload in pending.values():
+                _discard_payload(payload)
+            if not self.persistent_workers:
+                pool.shutdown()
+                self._pool = None
+
+    def __del__(self):
+        try:
+            if self._pool is not None:
+                self._pool.shutdown()
+        except Exception:
+            pass
 
     def _iter_iterable(self):
         batch = []
@@ -327,5 +429,108 @@ class DataLoader:
                 yield self._to_tensors(batch)
 
 
+class _WorkerPool:
+    """Spawned worker processes + queues (reference `dataloader_iter.py`
+    `_DataLoaderIterMultiProcess`: per-worker index queues, one shared
+    result queue, liveness watchdog)."""
+
+    def __init__(self, dataset, collate_fn, num_workers, use_shared_memory,
+                 worker_init_fn):
+        import multiprocessing as mp
+        import os
+
+        from .worker import _worker_loop
+
+        ctx = mp.get_context("spawn")  # fork is unsafe once PJRT is live
+        self.num_workers = num_workers
+        self.index_queues = [ctx.Queue() for _ in range(num_workers)]
+        self.result_queue = ctx.Queue()
+        self.workers = []
+        seed = np.random.randint(0, 2 ** 31 - 1)
+        # spawned children must never touch the trainer's TPU: pin their
+        # jax (imported by sitecustomize at interpreter start) to CPU
+        saved = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for wid in range(num_workers):
+                p = ctx.Process(
+                    target=_worker_loop,
+                    args=(dataset, collate_fn, self.index_queues[wid],
+                          self.result_queue, wid, num_workers,
+                          use_shared_memory, worker_init_fn, seed),
+                    daemon=True)
+                p.start()  # pickles args here: unpicklables raise now
+                self.workers.append(p)
+        finally:
+            if saved is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = saved
+
+    def alive(self):
+        return bool(self.workers) and all(p.is_alive()
+                                          for p in self.workers)
+
+    def start_epoch(self) -> int:
+        """Bump the result generation: anything a truncated previous epoch
+        left in flight is identifiable (and unlinked) instead of being
+        mistaken for this epoch's batches."""
+        self.generation = getattr(self, "generation", 0) + 1
+        return self.generation
+
+    def send(self, gen, batch_id, indices):
+        self.index_queues[batch_id % self.num_workers].put(
+            (gen, batch_id, list(indices)))
+
+    def recv(self, timeout):
+        """Result-queue get with a liveness watchdog (reference
+        worker-watchdog): a dead worker must raise, not hang forever.
+        timeout=None waits indefinitely (but still watches liveness)."""
+        import queue as pyqueue
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self.result_queue.get(timeout=1.0)
+            except pyqueue.Empty:
+                dead = [p.pid for p in self.workers if not p.is_alive()]
+                if dead:
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} exited "
+                        "unexpectedly") from None
+                if deadline is not None and time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"DataLoader timed out after {timeout}s waiting "
+                        "for a worker batch") from None
+
+    def shutdown(self):
+        import queue as pyqueue
+
+        from .worker import _discard_payload
+
+        for q in self.index_queues:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for p in self.workers:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self.workers = []
+        # workers are gone: anything still queued can never be consumed —
+        # unlink its shared memory before dropping the queue
+        while True:
+            try:
+                item = self.result_queue.get_nowait()
+            except (pyqueue.Empty, OSError, ValueError):
+                break
+            if item and len(item) == 4:
+                _discard_payload(item[2])
+
+
 def get_worker_info():
-    return None
+    from .worker import get_worker_info as _gwi
+
+    return _gwi()

@@ -70,6 +70,83 @@ def pipeline_local(stage_fn: Callable, stage_params, microbatches,
     return lax.psum(outputs, axis_name)
 
 
+def pipeline_1f1b_local(fwd_apply: Callable, bwd_apply: Callable, vec,
+                        n_micro: int, act_shape, act_dtype,
+                        axis_name: str = "pp", rng=None, unroll: int = 1):
+    """Per-device 1F1B micro-batch schedule (call inside shard_map).
+
+    The lockstep-SPMD realization of the reference 1F1B schedule
+    (`framework/section_worker.cc:144`): every tick runs one forward slot
+    AND one backward slot per stage ("one forward, one backward"), so
+    in-flight activations are bounded by the stage count (2L-1 boundary
+    activations here, vs M for fill-drain/GPipe) — the defining property of
+    1F1B.  Startup: stage r's backward slot idles until its first
+    micro-batch's grad returns (the lockstep analog of the reference's
+    ``num_stages - stage - 1`` warmup); drain mirrors it at the tail.
+    Backward recomputes the stage forward from the saved *input* activation
+    (recompute-in-backward), so only stage-boundary tensors are stored.
+
+    fwd_apply(vec, act_in, mb_idx, rng) -> act_out          [all ranks]
+    bwd_apply(vec, act_saved, g_in, mb_idx, rng)
+        -> (grad_vec, g_out, loss)                           [all ranks]
+    Both dispatch on ``lax.axis_index(axis_name)`` internally (lax.switch).
+    Returns (grad_vec_accum, loss_sum) — loss only nonzero on the last
+    stage; psum/scale at the caller.
+    """
+    L = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    M = n_micro
+    D = 2 * L  # residual ring depth; max in-flight = 2(L - r) - 1 <= 2L - 1
+    T = M + 2 * L - 1
+    fwd_perm = [(i, (i + 1) % L) for i in range(L)]
+    bwd_perm = [((i + 1) % L, i) for i in range(L)]
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    resid = jnp.zeros((D,) + tuple(act_shape), act_dtype)
+    rot = jnp.zeros(act_shape, act_dtype)     # incoming activation
+    brot = jnp.zeros(act_shape, jnp.float32)  # incoming activation grad
+    gacc = jnp.zeros(vec.shape, jnp.float32)
+    loss_acc = jnp.zeros((), jnp.float32)
+
+    def tick(t, carry):
+        rot, brot, resid, gacc, loss_acc = carry
+        f = t - r                      # forward micro-batch at this stage
+        b = t - (2 * L - 1) + r        # backward micro-batch at this stage
+        f_valid = (f >= 0) & (f < M)
+        b_valid = (b >= 0) & (b < M)
+        fc = jnp.clip(f, 0, M - 1)
+        bc = jnp.clip(b, 0, M - 1)
+        # forward slot: per-micro-batch rng must be reproducible at the
+        # backward slot's recompute, so key = fold(mb, rank) only
+        fkey = jax.random.fold_in(jax.random.fold_in(rng, fc), r)
+        act_out = fwd_apply(vec, rot, fc, fkey)
+        resid = jnp.where(f_valid, resid.at[jnp.mod(fc, D)].set(rot), resid)
+        act_out = jnp.where(f_valid, act_out,
+                            jnp.zeros(act_shape, act_dtype))
+        # backward slot
+        bkey = jax.random.fold_in(jax.random.fold_in(rng, bc), r)
+        saved = resid[jnp.mod(bc, D)]
+        gvec, gout, lss = bwd_apply(vec, saved, brot, bc, bkey)
+        gacc = gacc + jnp.where(b_valid, gvec.astype(jnp.float32), 0.0)
+        loss_acc = loss_acc + jnp.where(b_valid, lss.astype(jnp.float32),
+                                        0.0)
+        gout = jnp.where(b_valid, gout.astype(jnp.float32),
+                         jnp.zeros(act_shape, jnp.float32))
+        rot = lax.ppermute(act_out, axis_name, fwd_perm)
+        brot = lax.ppermute(gout, axis_name, bwd_perm)
+        return rot, brot, resid, gacc, loss_acc
+
+    carry = (rot, brot, resid, gacc, loss_acc)
+    if unroll >= T:
+        for t in range(T):
+            carry = tick(t, carry)
+    else:
+        carry = lax.fori_loop(0, T, tick, carry, unroll=unroll)
+    _, _, _, gacc, loss_acc = carry
+    return gacc, loss_acc
+
+
 def pipeline_spmd_step(stage_fn: Callable, stacked_params, microbatches, mesh,
                        axis_name: str = "pp", params_pspec=None):
     """Global entry: stacked_params pytree with leading dim = pp size."""

@@ -100,3 +100,97 @@ class TestDataLoader:
                         collate_fn=lambda items: np.stack([i[0] for i in items]).sum())
         out = list(dl)
         assert len(out) == 2
+
+
+class BigDs(Dataset):
+    """Samples big enough to force shared-memory transport (>=4KB)."""
+
+    def __getitem__(self, i):
+        return (np.full((64, 64), i, np.float32),  # 16 KB -> shm
+                np.int64(i))
+
+    def __len__(self):
+        return 12
+
+
+class CrashDs(Dataset):
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("poison sample")
+        return np.float32([i]), np.int64(i)
+
+    def __len__(self):
+        return 8
+
+
+def _winit(worker_id):
+    from paddle_tpu.io import get_worker_info
+
+    info = get_worker_info()
+    assert info is not None and info.id == worker_id
+
+
+class TestMultiprocessWorkers:
+    """Spawned worker processes + shm transport (reference
+    dataloader_iter.py:248 / mmap_allocator.cc; VERDICT round-1 item 9)."""
+
+    def test_process_workers_order_and_values(self):
+        ds = BigDs()
+        dl0 = DataLoader(ds, batch_size=4, num_workers=0)
+        dlp = DataLoader(ds, batch_size=4, num_workers=2,
+                         multiprocess_mode="process")
+        ref = [b[0].numpy() for b in dl0]
+        got = [b[0].numpy() for b in dlp]
+        assert len(ref) == len(got)
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(r, g)
+
+    def test_worker_exception_propagates(self):
+        dl = DataLoader(CrashDs(), batch_size=4, num_workers=2,
+                        multiprocess_mode="process")
+        with pytest.raises(RuntimeError, match="poison sample"):
+            list(dl)
+
+    def test_worker_init_fn_and_info(self):
+        dl = DataLoader(BigDs(), batch_size=4, num_workers=2,
+                        multiprocess_mode="process",
+                        worker_init_fn=_winit)
+        assert len(list(dl)) == 3
+
+    def test_persistent_workers_reused(self):
+        dl = DataLoader(BigDs(), batch_size=4, num_workers=2,
+                        multiprocess_mode="process",
+                        persistent_workers=True)
+        list(dl)
+        pool1 = dl._pool
+        assert pool1 is not None and pool1.alive()
+        list(dl)
+        assert dl._pool is pool1  # same processes served both epochs
+        pool1.shutdown()
+
+    def test_unpicklable_falls_back_to_threads(self):
+        ds = RangeDs(8)
+        dl = DataLoader(ds, batch_size=2, num_workers=2,
+                        multiprocess_mode="process",
+                        collate_fn=lambda items: np.stack(
+                            [i[0] for i in items]))
+        with pytest.warns(UserWarning, match="falling back to threads"):
+            out = list(dl)
+        assert len(out) == 4
+
+    def test_truncated_epoch_does_not_poison_next(self):
+        """Breaking out of an epoch leaves prefetched batches in flight;
+        the next epoch must not consume them as its own (generation tags)."""
+        dl = DataLoader(BigDs(), batch_size=2, num_workers=2,
+                        multiprocess_mode="process",
+                        persistent_workers=True)
+        it = iter(dl)
+        first = next(it)[0].numpy()
+        it.close()  # truncate: up to depth batches still in flight
+        ref = [b[0].numpy() for b in DataLoader(BigDs(), batch_size=2,
+                                                num_workers=0)]
+        got = [b[0].numpy() for b in dl]
+        assert len(got) == len(ref)
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(r, g)
+        dl._pool.shutdown()

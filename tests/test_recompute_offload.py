@@ -1,0 +1,136 @@
+"""strategy.recompute / sharding offload wiring into the compiled step.
+
+Reference: `fleet/meta_optimizers/recompute_optimizer.py` (checkpoint-based
+program rewrite) and `sharding/offload_helper.py` (optimizer-state host
+placement).  The TPU realization: per-block jax.checkpoint + pinned-host
+NamedShardings for moments.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.sharded_step import ShardedTrainStep
+from paddle_tpu.distributed.fleet.strategy import DistributedStrategy
+from paddle_tpu.distributed.topology import build_mesh
+
+HID, DEPTH = 64, 4
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.a = nn.Linear(HID, HID)
+        self.b = nn.Linear(HID, HID)
+        self.c = nn.Linear(HID, HID)
+
+    def forward(self, x):
+        return nn.functional.relu(self.c(
+            nn.functional.relu(self.b(nn.functional.relu(self.a(x))))))
+
+
+class Deep(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        for i in range(DEPTH):
+            setattr(self, f"blk{i}", Block())
+
+    def forward(self, x):
+        for i in range(DEPTH):
+            x = getattr(self, f"blk{i}")(x)
+        return x
+
+
+def _loss(model, x, y):
+    return ((model(x) - y) ** 2).mean()
+
+
+def _saved_residual_bytes(step, batch):
+    """Bytes saved between forward and backward of the captured loss
+    (backend-independent live-buffer measure of recompute)."""
+    from jax._src.ad_checkpoint import saved_residuals
+
+    params, buffers = step.model.functional_state()
+    pa = {k: v._array for k, v in params.items()}
+    ba = {k: v._array for k, v in buffers.items()}
+
+    # rebuild the same traced forward ShardedTrainStep uses
+    from paddle_tpu.core import framework
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit import _SwappedState
+
+    def forward_loss(parr, b):
+        swap = dict(params)
+        with _SwappedState(swap) as sw:
+            sw.bind(parr)
+            with framework.trace_guard(rng_key=jax.random.PRNGKey(0)):
+                loss = _loss(step.model, Tensor(b[0]), Tensor(b[1]))
+        return loss._array
+
+    res = saved_residuals(forward_loss, pa, batch)
+    return sum(int(np.prod(v.shape)) * v.dtype.itemsize for v, _ in res)
+
+
+class TestRecompute:
+    def test_block_recompute_reduces_saved_residuals(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, HID).astype(np.float32)
+        y = rng.randn(128, HID).astype(np.float32)
+        mesh = build_mesh(dp=1)
+
+        paddle.seed(0)
+        plain = ShardedTrainStep(Deep(), _loss, optimizer.SGD(0.1, []),
+                                 mesh, recompute=False)
+        paddle.seed(0)
+        ck = ShardedTrainStep(Deep(), _loss, optimizer.SGD(0.1, []),
+                              mesh, recompute=True)
+        b_plain = _saved_residual_bytes(plain, (x, y))
+        b_ck = _saved_residual_bytes(ck, (x, y))
+        # per-block remat keeps only block boundaries: expect a big drop
+        assert b_ck < b_plain * 0.6, (b_plain, b_ck)
+
+    def test_recompute_numerics_unchanged(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(16, HID).astype(np.float32)
+        y = rng.randn(16, HID).astype(np.float32)
+        mesh = build_mesh(dp=1)
+
+        paddle.seed(2)
+        m1 = Deep()
+        s1 = ShardedTrainStep(m1, _loss, optimizer.SGD(
+            0.1, list(m1.parameters())), mesh, recompute=False)
+        paddle.seed(2)
+        m2 = Deep()
+        s2 = ShardedTrainStep(m2, _loss, optimizer.SGD(
+            0.1, list(m2.parameters())), mesh, recompute=True)
+        for _ in range(3):
+            l1 = float(s1(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+            l2 = float(s2(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+            np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+    def test_strategy_wires_recompute_and_offload(self):
+        strategy = DistributedStrategy()
+        strategy.recompute = True
+        strategy.recompute_configs = {"checkpoints": []}
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 1, "offload": True}
+        fleet.init(is_collective=True, strategy=strategy)
+        m = Deep()
+        step = fleet.fleet.build_train_step(
+            m, _loss, optimizer.Adam(0.001,
+                                     parameters=list(m.parameters())))
+        assert step.recompute and step.offload
+        rng = np.random.RandomState(3)
+        x = rng.randn(16, HID).astype(np.float32)
+        y = rng.randn(16, HID).astype(np.float32)
+        loss = step(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert np.isfinite(float(loss.numpy()))
+        # on CPU pinned_host is unsupported -> graceful device fallback;
+        # either way every adam moment got a concrete placement
+        st = step._opt_state
+        assert all(sv.sharding is not None
+                   for slots in st.values() for sv in slots.values())
