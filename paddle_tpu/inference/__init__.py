@@ -154,26 +154,55 @@ class Tensor:
 
 
 class Predictor:
-    """reference `AnalysisPredictor`: load once, run many.  The artifact is
-    shape-specialized StableHLO: inputs must match the `input_spec` shapes
-    given to `jit.save` (deploy-time static shapes, as with the reference's
-    fixed-shape TensorRT engines); XLA compiles on first run and caches."""
+    """reference `AnalysisPredictor`: load once, run many.  Two artifact
+    formats:
+
+    * reference interchange (``.pdmodel``+``.pdiparams`` pair or a dir
+      with ``__model__``/``__params__``) — parsed by the framework.proto
+      codec and interpreted to one XLA computation;
+    * the TPU-native StableHLO export from `paddle_tpu.jit.save`."""
 
     def __init__(self, config: Config):
         import jax
 
-        from .. import jit as pjit
-
         self._config = config
-        self._layer = pjit.load(config._model_prefix)
-        self._exported_in_specs = None
         self._inputs: Dict[str, np.ndarray] = {}
         self._outputs: Dict[str, np.ndarray] = {}
-        # input names: exported calling convention is positional; synthesize
-        # stable names like the reference's feed targets
-        n_in = self._n_model_inputs()
-        self._input_names = [f"input_{i}" for i in range(n_in)]
         self._output_names: List[str] = []
+        prefix = config._model_prefix or ""
+        # sniff the artifact: a reference-era .pdmodel parses as a
+        # framework.proto ProgramDesc with blocks; the TPU-native export
+        # (jit.save) stores StableHLO under the same extension
+        is_ref_format = os.path.isdir(prefix) and os.path.exists(
+            os.path.join(prefix, "__model__"))
+        if not is_ref_format and os.path.exists(prefix + ".pdmodel"):
+            from ..static import proto as _proto
+
+            try:
+                with open(prefix + ".pdmodel", "rb") as f:
+                    parsed = _proto.parse_program(f.read())
+                is_ref_format = bool(parsed.get("blocks"))
+            except Exception:
+                is_ref_format = False
+        if is_ref_format:
+            from ..static import load_inference_model
+            from ..static.interp import ProgramRunner
+
+            program, feeds, fetches = load_inference_model(prefix)
+            self._runner = ProgramRunner(
+                program, getattr(program, "_param_scope", {}) or {})
+            self._layer = None
+            self._input_names = list(self._runner.feed_names)
+            self._output_names = [f"output_{i}"
+                                  for i in range(len(
+                                      self._runner.fetch_names))]
+        else:
+            from .. import jit as pjit
+
+            self._runner = None
+            self._layer = pjit.load(prefix)
+            n_in = self._n_model_inputs()
+            self._input_names = [f"input_{i}" for i in range(n_in)]
 
     def _n_model_inputs(self) -> int:
         ex = self._layer._exported
@@ -203,11 +232,16 @@ class Predictor:
         Either pass `inputs` positionally or pre-fill via input handles."""
         if inputs is None:
             inputs = [self._inputs[n] for n in self._input_names]
-        outs = self._layer(*inputs)
-        outs = outs if isinstance(outs, tuple) else (outs,)
+        if self._runner is not None:
+            outs = self._runner(*[np.asarray(i) for i in inputs])
+        else:
+            outs = self._layer(*inputs)
+            outs = outs if isinstance(outs, tuple) else (outs,)
         self._output_names = [f"output_{i}" for i in range(len(outs))]
-        self._outputs = {n: np.asarray(o.numpy())
-                         for n, o in zip(self._output_names, outs)}
+        self._outputs = {
+            n: np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+            for n, o in zip(self._output_names, outs)
+        }
         return [self._outputs[n] for n in self._output_names]
 
     def clone(self):

@@ -14,28 +14,16 @@ source compatibility, and save/load_inference_model mapping onto
 from __future__ import annotations
 
 import contextlib
+import os
 
 from .input_spec import InputSpec
 from ..core.place import CPUPlace, TPUPlace
 
 
 from . import nn  # noqa: E402  (control-flow + layer surface)
-
-
-class Program:
-    """Facade for API parity.  Holds nothing until a function is captured."""
-
-    def __init__(self):
-        self.random_seed = None
-        self._captured = None
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        import copy
-
-        return copy.copy(self)
+from . import proto  # noqa: E402
+from .program import Block, Operator, Program, Variable, \
+    program_from_layer  # noqa: E402
 
 
 _default_main = Program()
@@ -64,34 +52,156 @@ def program_guard(main_program, startup_program=None):
 
 
 class Executor:
-    """API-parity executor (reference `fluid/executor.py:916`): in this
-    framework `run` simply invokes a python callable captured via paddle_tpu
-    jit; feed/fetch become the callable's inputs/outputs."""
+    """Executor over real ProgramDescs (reference `fluid/executor.py:916` /
+    `framework/executor.cc:292`): interprets the block's ops through the
+    jnp translator — the whole program traces to one XLA computation.
+    Also still accepts a bare python callable for source compatibility."""
 
     def __init__(self, place=None):
         self.place = place
+        self.scope = {}
+        self._runners = {}  # id(program) -> compiled ProgramRunner
 
-    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            **kwargs):
+        feed = feed or {}
+        if isinstance(program, Program):
+            from .interp import ProgramRunner
+
+            base = dict(scope if scope is not None else self.scope)
+            base.update(getattr(program, "_param_scope", None) or {})
+            runner = self._runners.get(id(program))
+            if runner is None:
+                runner = ProgramRunner(program, base)
+                self._runners[id(program)] = runner
+            import jax.numpy as jnp
+
+            feeds = {k: jnp.asarray(v) for k, v in feed.items()}
+            fetch_vals, final_scope = runner.run_with_scope(feeds)
+            if fetch_list:
+                out = []
+                for f in fetch_list:
+                    name = getattr(f, "name", f)
+                    if name in final_scope:
+                        out.append(final_scope[name])
+                    else:
+                        raise KeyError(
+                            f"fetch target {name!r} was not produced by "
+                            "the program (known vars: "
+                            f"{sorted(final_scope)[:20]}...)")
+                return out
+            return list(fetch_vals)
         if callable(program):
-            feed = feed or {}
             outs = program(**feed)
             return outs if isinstance(outs, (list, tuple)) else [outs]
         return []
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         **kwargs):
-    raise NotImplementedError(
-        "use paddle_tpu.jit.save(layer, path, input_spec=...) — the deployable "
-        "format is serialized StableHLO + weights"
-    )
+def _combined_params_bytes(program: Program, scope: dict) -> bytes:
+    """Reference `.pdiparams` / `__params__`: concatenated LoDTensor
+    streams in LEXICOGRAPHIC var-name order (`inference/io.cc:112` sorts
+    before appending load_combine).  Every persistable var must be in
+    `scope` — a silent skip would shift every later record onto the
+    wrong name at load time (records carry no names)."""
+    names = sorted(v.name for v in program.persistable_vars())
+    missing = [n for n in names if n not in scope]
+    if missing:
+        raise ValueError(
+            f"save_inference_model: persistable vars missing from scope: "
+            f"{missing}")
+    return b"".join(proto.write_lod_tensor(scope[n]) for n in names)
+
+
+def _load_combined_params(program: Program, data: bytes) -> dict:
+    names = sorted(v.name for v in program.persistable_vars())
+    scope = {}
+    pos = 0
+    for n in names:
+        if pos >= len(data):
+            raise ValueError(
+                f"params file truncated: no record for var {n!r} "
+                f"(expected {len(names)} records)")
+        arr, _lod, pos = proto.read_lod_tensor(data, pos)
+        # validate against the declared VarDesc shape (-1 = dynamic)
+        want = program.global_block().var(n).shape if \
+            program.global_block().has_var(n) else ()
+        if want and len(want) == arr.ndim and any(
+                w != -1 and w != s for w, s in zip(want, arr.shape)):
+            raise ValueError(
+                f"param {n!r} shape {arr.shape} does not match its "
+                f"VarDesc {tuple(want)} — records/vars out of sync")
+        scope[n] = arr
+    if pos != len(data):
+        raise ValueError(
+            f"params file has {len(data) - pos} trailing bytes after "
+            f"{len(names)} records — program/params mismatch")
+    return scope
+
+
+def save_inference_model(path_prefix, feed_vars=None, fetch_vars=None,
+                         executor=None, program=None, layer=None,
+                         input_spec=None, scope=None, **kwargs):
+    """Write `{prefix}.pdmodel` + `{prefix}.pdiparams` in the REFERENCE
+    interchange format (framework.proto ProgramDesc + combined LoDTensor
+    records), loadable by reference-era tooling and by our Predictor.
+
+    Accepts either a desc-backed `program` (+ `scope` of param arrays) or
+    a sequential `layer` (+ `input_spec`) converted via
+    `program_from_layer`."""
+    if program is None:
+        if layer is None:
+            raise ValueError(
+                "save_inference_model needs program= (desc Program) or "
+                "layer= (+input_spec) to convert")
+        scope = {}
+        program = program_from_layer(layer, input_spec, scope)
+    if scope is None:
+        scope = {}
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(program.serialize_to_string())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        f.write(_combined_params_bytes(program, scope))
+    return program
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
-    from .. import jit
+    """Load a reference-format inference model.  Returns
+    (program, feed_target_names, fetch_target_names); run it with
+    `Executor.run(program, feed={...}, fetch_list=[...])` — params are
+    pre-populated into the executor scope.
 
-    layer = jit.load(path_prefix)
-    return layer
+    Accepts `{prefix}.pdmodel`/`.pdiparams` pairs and legacy
+    `dir/__model__` + `dir/__params__` layouts (`inference/io.cc`)."""
+    if os.path.isdir(path_prefix):
+        model_path = os.path.join(path_prefix, "__model__")
+        params_path = os.path.join(path_prefix, "__params__")
+    else:
+        model_path = path_prefix + ".pdmodel"
+        params_path = path_prefix + ".pdiparams"
+    with open(model_path, "rb") as f:
+        raw = f.read()
+    try:
+        program = Program.parse_from_string(raw)
+        if not program.desc.get("blocks"):
+            raise ValueError("no blocks")
+    except Exception:
+        # same extension, different artifact: paddle_tpu.jit.save stores
+        # StableHLO under .pdmodel too — keep the old behavior for it
+        from .. import jit
+
+        return jit.load(path_prefix)
+    scope = {}
+    if os.path.exists(params_path):
+        with open(params_path, "rb") as f:
+            scope = _load_combined_params(program, f.read())
+    if isinstance(executor, Executor):
+        executor.scope.update(scope)
+    else:
+        # stash on the program so Predictor-style callers can reach params
+        program._param_scope = scope
+    return program, program.feed_target_names(), \
+        program.fetch_target_names()
 
 
 def data(name, shape, dtype="float32", lod_level=0):
