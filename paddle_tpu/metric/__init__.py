@@ -161,3 +161,183 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
         lab = lab.squeeze(-1)
     corr = (idx == lab[..., None]).any(axis=-1).mean()
     return Tensor(np.asarray(corr, dtype=np.float32))
+
+
+class ChunkEvaluator(Metric):
+    """Chunking (NER) precision/recall/F1 over IOB-style tag sequences
+    (reference `operators/metrics/chunk_eval_op.*` + `metric` wrapper).
+    update() takes (num_infer_chunks, num_label_chunks, num_correct_chunks)
+    like the reference's compute() outputs."""
+
+    def __init__(self, name=None):
+        self._name = name or "chunk"
+        self.reset()
+
+    def reset(self):
+        self.num_infer = 0
+        self.num_label = 0
+        self.num_correct = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        def _i(v):
+            return int(np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+                       .sum())
+
+        self.num_infer += _i(num_infer_chunks)
+        self.num_label += _i(num_label_chunks)
+        self.num_correct += _i(num_correct_chunks)
+
+    def accumulate(self):
+        p = self.num_correct / self.num_infer if self.num_infer else 0.0
+        r = self.num_correct / self.num_label if self.num_label else 0.0
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        return p, r, f1
+
+    def name(self):
+        return self._name
+
+    @staticmethod
+    def extract_chunks(tags, scheme="IOB", n_types=None):
+        """Decode (start, end, type) chunks from an IOB tag sequence where
+        tag = type*2 (+0=B, +1=I) and any tag >= 2*n_types (conventionally
+        2*n_types itself) is Outside, matching chunk_eval_op's plain
+        scheme."""
+        tags = [int(t) for t in tags]
+        o_floor = 2 * n_types if n_types is not None else None
+        chunks = []
+        start, ctype = None, None
+        for i, tg in enumerate(tags):
+            if o_floor is not None and tg >= o_floor:  # Outside
+                if start is not None:
+                    chunks.append((start, i - 1, ctype))
+                start, ctype = None, None
+                continue
+            ty, io = tg // 2, tg % 2
+            if io == 0:  # B
+                if start is not None:
+                    chunks.append((start, i - 1, ctype))
+                start, ctype = i, ty
+            elif start is None or ty != ctype:  # stray I
+                start, ctype = None, None
+        if start is not None:
+            chunks.append((start, len(tags) - 1, ctype))
+        return chunks
+
+    def compute(self, infer_tags, label_tags, lengths=None, n_types=None):
+        """Host-side chunk extraction; returns the three counts update()
+        wants."""
+        inf = np.asarray(infer_tags.numpy() if hasattr(infer_tags, "numpy")
+                         else infer_tags)
+        lab = np.asarray(label_tags.numpy() if hasattr(label_tags, "numpy")
+                         else label_tags)
+        if inf.ndim == 1:
+            inf, lab = inf[None], lab[None]
+        lens = np.asarray(lengths.numpy() if hasattr(lengths, "numpy")
+                          else lengths) if lengths is not None else \
+            np.full(inf.shape[0], inf.shape[1])
+        ni = nl = nc = 0
+        for row_i, row_l, L in zip(inf, lab, lens):
+            ci = set(self.extract_chunks(row_i[:int(L)], n_types=n_types))
+            cl = set(self.extract_chunks(row_l[:int(L)], n_types=n_types))
+            ni += len(ci)
+            nl += len(cl)
+            nc += len(ci & cl)
+        return ni, nl, nc
+
+
+class DetectionMAP(Metric):
+    """VOC-style detection mAP (reference `operators/metrics/` detection
+    map machinery + `fluid/metrics.py DetectionMAP`): 11-point or
+    'integral' interpolated average precision over IoU-matched
+    detections."""
+
+    def __init__(self, overlap_threshold=0.5, ap_version="11point",
+                 class_num=None, name=None):
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self._name = name or "mAP"
+        self.reset()
+
+    def reset(self):
+        self._dets = []   # (class, score, matched_gt)
+        self._gt_count = {}
+
+    def update(self, pred_boxes, pred_scores, pred_labels, gt_boxes,
+               gt_labels):
+        """Single-image update; all inputs numpy-able. pred_boxes [P,4],
+        gt_boxes [G,4] xyxy."""
+        def _np_(v):
+            return np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+
+        pb, ps, pl = _np_(pred_boxes), _np_(pred_scores), _np_(pred_labels)
+        gb, gl = _np_(gt_boxes), _np_(gt_labels)
+        for c in np.unique(gl):
+            self._gt_count[int(c)] = self._gt_count.get(int(c), 0) + \
+                int((gl == c).sum())
+        order = np.argsort(-ps)
+        taken = np.zeros(len(gb), bool)
+        for i in order:
+            c = int(pl[i])
+            best, best_j = 0.0, -1
+            for j in range(len(gb)):
+                if taken[j] or int(gl[j]) != c:
+                    continue
+                ixmin = max(pb[i, 0], gb[j, 0])
+                iymin = max(pb[i, 1], gb[j, 1])
+                ixmax = min(pb[i, 2], gb[j, 2])
+                iymax = min(pb[i, 3], gb[j, 3])
+                iw = max(ixmax - ixmin, 0)
+                ih = max(iymax - iymin, 0)
+                inter = iw * ih
+                a1 = (pb[i, 2] - pb[i, 0]) * (pb[i, 3] - pb[i, 1])
+                a2 = (gb[j, 2] - gb[j, 0]) * (gb[j, 3] - gb[j, 1])
+                iou = inter / max(a1 + a2 - inter, 1e-10)
+                if iou > best:
+                    best, best_j = iou, j
+            hit = best >= self.overlap_threshold and best_j >= 0
+            if hit:
+                taken[best_j] = True
+            self._dets.append((c, float(ps[i]), hit))
+
+    def accumulate(self):
+        aps = []
+        for c, total in self._gt_count.items():
+            rows = sorted((d for d in self._dets if d[0] == c),
+                          key=lambda d: -d[1])
+            if not rows or total == 0:
+                continue
+            tp = np.cumsum([1.0 if r[2] else 0.0 for r in rows])
+            fp = np.cumsum([0.0 if r[2] else 1.0 for r in rows])
+            rec = tp / total
+            prec = tp / np.maximum(tp + fp, 1e-10)
+            if self.ap_version == "11point":
+                ap = np.mean([prec[rec >= t].max() if (rec >= t).any()
+                              else 0.0
+                              for t in np.linspace(0, 1, 11)])
+            else:  # integral
+                mrec = np.concatenate([[0.0], rec, [1.0]])
+                mpre = np.concatenate([[0.0], prec, [0.0]])
+                for i in range(len(mpre) - 2, -1, -1):
+                    mpre[i] = max(mpre[i], mpre[i + 1])
+                idx = np.where(mrec[1:] != mrec[:-1])[0]
+                ap = float(((mrec[idx + 1] - mrec[idx])
+                            * mpre[idx + 1]).sum())
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0
+
+    def name(self):
+        return self._name
+
+
+def mean_iou(pred, label, num_classes, name=None):
+    """Semantic-segmentation mean IoU — delegates to the JAX-native
+    confusion-matrix implementation (`ops/misc.py` mean_iou, reference
+    `operators/metrics/mean_iou_op.*` return contract)."""
+    from ..ops.misc import mean_iou as _mi
+
+    if not hasattr(pred, "numpy"):
+        pred = Tensor(np.asarray(pred))
+    if not hasattr(label, "numpy"):
+        label = Tensor(np.asarray(label))
+    return _mi(pred, label, num_classes)

@@ -9,3 +9,4 @@ from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
+from .sampling import *  # noqa: F401,F403

@@ -46,10 +46,25 @@ def _pool_nd(x, kernel, stride, padding, nd, mode, ceil_mode=False,
         if isinstance(pad_spatial, str):
             pads = pad_spatial
         else:
+            spatial = list(pad_spatial)
+            if ceil_mode:
+                # ceil output size: extend the right padding so
+                # reduce_window emits ceil((in+2p-k)/s)+1 windows
+                # (reference pool ceil_mode semantics)
+                sp_start = 2 if channel_first else 1
+                for d in range(nd):
+                    i = a.shape[sp_start + d]
+                    lo, hi = spatial[d]
+                    num = i + lo + hi - kernel[d]
+                    ceil_out = -(-num // stride[d]) + 1
+                    need = (ceil_out - 1) * stride[d] + kernel[d] \
+                        - (i + lo + hi)
+                    if need > 0:
+                        spatial[d] = (lo, hi + need)
             if channel_first:
-                pads = [(0, 0), (0, 0)] + list(pad_spatial)
+                pads = [(0, 0), (0, 0)] + spatial
             else:
-                pads = [(0, 0)] + list(pad_spatial) + [(0, 0)]
+                pads = [(0, 0)] + spatial + [(0, 0)]
         if mode == "max":
             init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
             return lax.reduce_window(a, init, lax.max, window, strides, pads)
@@ -72,8 +87,58 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool2d_with_index(x, kernel_size, stride, padding,
+                                      ceil_mode, data_format)
     return _pool_nd(x, kernel_size, stride, padding, 2, "max", ceil_mode,
                     data_format=data_format)
+
+
+def _max_pool2d_with_index(x, kernel_size, stride, padding, ceil_mode,
+                           data_format):
+    """reference `max_pool2d_with_index` (`operators/pool_with_index_op.*`):
+    also returns the argmax position of each window, flattened into the
+    input's H*W plane (what max_unpool2d consumes)."""
+    ks = _tup(kernel_size, 2)
+    st = _tup(stride or kernel_size, 2)
+    pd = _tup(padding, 2)
+    nhwc = data_format == "NHWC"
+
+    def f(a):
+        if nhwc:
+            a = a.transpose(0, 3, 1, 2)
+        n, c, h, w = a.shape
+
+        def osize(i, k, p, s):
+            num = i + 2 * p - k
+            return (num + s - 1) // s + 1 if ceil_mode else num // s + 1
+
+        oh = osize(h, ks[0], pd[0], st[0])
+        ow = osize(w, ks[1], pd[1], st[1])
+        # ceil_mode may read past the padded edge: extend with -inf
+        extra_h = max((oh - 1) * st[0] + ks[0] - (h + 2 * pd[0]), 0)
+        extra_w = max((ow - 1) * st[1] + ks[1] - (w + 2 * pd[1]), 0)
+        neg = jnp.finfo(a.dtype).min
+        ap = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0] + extra_h),
+                         (pd[1], pd[1] + extra_w)), constant_values=neg)
+        hh = jnp.arange(oh)[:, None] * st[0] + jnp.arange(ks[0])[None, :]
+        ww = jnp.arange(ow)[:, None] * st[1] + jnp.arange(ks[1])[None, :]
+        # windows [N, C, OH, OW, KH, KW]
+        win = ap[:, :, hh[:, None, :, None], ww[None, :, None, :]]
+        flat = win.reshape(n, c, oh, ow, -1)
+        arg = jnp.argmax(flat, axis=-1)
+        out = jnp.take_along_axis(flat, arg[..., None], axis=-1)[..., 0]
+        kh = arg // ks[1]
+        kw = arg % ks[1]
+        gh = jnp.arange(oh)[None, None, :, None] * st[0] + kh - pd[0]
+        gw = jnp.arange(ow)[None, None, None, :] * st[1] + kw - pd[1]
+        idx = (gh * w + gw).astype(jnp.int64)
+        if nhwc:
+            out = out.transpose(0, 2, 3, 1)
+            idx = idx.transpose(0, 2, 3, 1)
+        return out, idx
+
+    return dispatch(f, x)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -160,3 +225,51 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool_nd(x, output_size, 3, "max", "NCDHW")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d with indices (`operators/unpool_op.*`):
+    scatters each pooled value back to its argmax position."""
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+
+    def f(xv, idx):
+        n, c, h, w = xv.shape
+        if output_size is not None:
+            oh, ow = output_size[-2:]
+        else:
+            oh = (h - 1) * stride[0] + kernel_size[0] - 2 * (
+                padding if isinstance(padding, int) else padding[0])
+            ow = (w - 1) * stride[1] + kernel_size[1] - 2 * (
+                padding if isinstance(padding, int) else padding[1])
+        flat = jnp.zeros((n, c, oh * ow), xv.dtype)
+        nidx = jnp.arange(n)[:, None, None]
+        cidx = jnp.arange(c)[None, :, None]
+        flat = flat.at[nidx, cidx, idx.reshape(n, c, -1)].set(
+            xv.reshape(n, c, -1))
+        return flat.reshape(n, c, oh, ow)
+
+    return dispatch(f, x, indices, nondiff=(1,))
+
+
+def spatial_pyramid_pool(x, pyramid_height, pool_type="max", name=None):
+    """SPP (`operators/spp_op.*`): concat adaptive {max,avg} pools at
+    1x1, 2x2, ... 2^(H-1) x 2^(H-1) bins, flattened per level."""
+    outs = []
+    for level in range(int(pyramid_height)):
+        bins = 2 ** level
+        if pool_type == "max":
+            p = adaptive_max_pool2d(x, bins)
+        else:
+            p = adaptive_avg_pool2d(x, bins)
+        outs.append(p.reshape([p.shape[0], -1]))
+    from ...ops import concat as _concat
+
+    return _concat(outs, axis=1)
+
+
+spp = spatial_pyramid_pool

@@ -1,0 +1,144 @@
+"""Sampled / tree-structured classification losses.
+
+Reference: `operators/nce_op.h` (noise-contrastive estimation with
+uniform / log-uniform / custom samplers) and
+`operators/hierarchical_sigmoid_op.h` + `math/matrix_bit_code.h`
+(complete-binary-tree sigmoid softmax, SimpleCode bit paths).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core import framework
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor, unwrap
+
+__all__ = ["nce", "hsigmoid_loss", "hierarchical_sigmoid"]
+
+
+def _log_uniform_probs(n):
+    # math::LogUniformSampler: P(k) = (log(k+2) - log(k+1)) / log(range+1)
+    k = np.arange(n, dtype=np.float64)
+    return ((np.log(k + 2) - np.log(k + 1)) / np.log(n + 1)).astype(
+        np.float32)
+
+
+def nce(input, label, weight, bias=None, num_total_classes=None,
+        num_neg_samples=10, sampler="uniform", custom_dist=None,
+        sample_weight=None, seed=0, name=None):
+    """Noise-contrastive estimation loss, exact `nce_op.h` math: per
+    sampled class, o = sigmoid(x.w_c + b_c), q = P(c) * num_neg_samples;
+    cost = -log(o/(o+q)) for true classes, -log(q/(o+q)) for noise.
+    Negatives are drawn on host per call (like the reference's CPU
+    sampler) so shapes stay static."""
+    n_classes = int(num_total_classes if num_total_classes is not None
+                    else unwrap(weight).shape[0])
+    lab = np.asarray(jax.device_get(unwrap(label))).reshape(
+        unwrap(input).shape[0], -1)
+    num_true = lab.shape[1]
+    k = int(num_neg_samples)
+    rng = np.random.RandomState(seed or None)
+    if sampler == "uniform":
+        probs = np.full(n_classes, 1.0 / n_classes, np.float32)
+        negs = rng.randint(0, n_classes, size=(lab.shape[0], k))
+    elif sampler == "log_uniform":
+        probs = _log_uniform_probs(n_classes)
+        negs = rng.choice(n_classes, size=(lab.shape[0], k),
+                          p=probs / probs.sum())
+    elif sampler == "custom_dist":
+        probs = np.asarray(custom_dist, np.float32)
+        negs = rng.choice(n_classes, size=(lab.shape[0], k),
+                          p=probs / probs.sum())
+    else:
+        raise ValueError(f"unknown sampler {sampler!r}")
+    samples = np.concatenate([lab, negs], axis=1)  # [B, T+k]
+    q = jnp.asarray(probs[samples] * k)            # [B, T+k]
+    samples_j = jnp.asarray(samples)
+
+    def f(x, w, *rest):
+        logits = jnp.einsum("bd,btd->bt", x, w[samples_j])
+        if rest:
+            logits = logits + rest[0][samples_j]
+        o = jax.nn.sigmoid(logits)
+        cost_true = -jnp.log(o / (o + q))
+        cost_noise = -jnp.log(q / (o + q))
+        is_true = jnp.arange(samples.shape[1])[None, :] < num_true
+        cost = jnp.where(is_true, cost_true, cost_noise).sum(axis=1)
+        return cost[:, None]
+
+    args = (input, weight) + ((bias,) if bias is not None else ())
+    return dispatch(f, *args)
+
+
+def _simple_code_table(num_classes):
+    """SimpleCode paths for every class (matrix_bit_code.h): c = label +
+    num_classes; step j uses weight row (c >> (j+1)) - 1 and bit
+    (c >> j) & 1; path length = floor(log2(c)).  Returns int32
+    [num_classes, L] node indices, [.., L] bits, [..] lengths."""
+    max_len = int(np.floor(np.log2(2 * num_classes - 1)))
+    idx = np.zeros((num_classes, max_len), np.int32)
+    bits = np.zeros((num_classes, max_len), np.float32)
+    lens = np.zeros(num_classes, np.int32)
+    for c in range(num_classes):
+        code = c + num_classes
+        L = int(np.floor(np.log2(code)))
+        lens[c] = L
+        for j in range(L):
+            idx[c, j] = (code >> (j + 1)) - 1
+            bits[c, j] = (code >> j) & 1
+    return idx, bits, lens
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss, exact `hierarchical_sigmoid_op.h`
+    semantics: z_j = clip(x.w[idx_j] + b[idx_j], ±40); loss = Σ_j
+    softplus(z_j over the FULL code_length, out-of-path z=0) - Σ_{j<L}
+    bit_j z_j.  Default tree = complete binary over num_classes
+    (SimpleCode); custom trees via path_table/path_code."""
+    if path_table is not None:
+        pt = np.asarray(jax.device_get(unwrap(path_table)), np.int64)
+        pc = np.asarray(jax.device_get(unwrap(path_code)), np.float32)
+        lab = np.asarray(jax.device_get(unwrap(label))).reshape(-1)
+        idx_all = pt[lab].astype(np.int32)
+        bits_all = pc[lab].astype(np.float32)
+        lens_all = (idx_all >= 0).sum(axis=1).astype(np.int32)
+        idx_all = np.maximum(idx_all, 0)
+        code_length = idx_all.shape[1]
+    else:
+        idx, bits, lens = _simple_code_table(int(num_classes))
+        lab = np.asarray(jax.device_get(unwrap(label))).reshape(-1)
+        idx_all = idx[lab]
+        bits_all = bits[lab]
+        lens_all = lens[lab]
+        code_length = int(np.floor(np.log2(num_classes - 1))) + 1 \
+            if num_classes > 1 else 1
+        idx_all = idx_all[:, :code_length]
+        bits_all = bits_all[:, :code_length]
+    idx_j = jnp.asarray(idx_all)
+    bits_j = jnp.asarray(bits_all)
+    valid = jnp.asarray(
+        (np.arange(idx_all.shape[1])[None, :] < lens_all[:, None])
+        .astype(np.float32))
+
+    def f(x, w, *rest):
+        z = jnp.einsum("bd,bjd->bj", x, w[idx_j])
+        if rest:
+            b = rest[0].reshape(-1)
+            z = z + b[idx_j]
+        z = jnp.clip(z * valid, -40.0, 40.0)  # out-of-path -> 0
+        # softplus over the full code_length (incl. z=0 padding, matching
+        # the reference's zero-initialized pre_out)
+        loss = jnp.log1p(jnp.exp(z)).sum(axis=1) \
+            - (bits_j * z * valid).sum(axis=1)
+        return loss[:, None]
+
+    args = (input, weight) + ((bias,) if bias is not None else ())
+    return dispatch(f, *args)
+
+
+hierarchical_sigmoid = hsigmoid_loss

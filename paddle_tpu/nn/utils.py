@@ -1,0 +1,115 @@
+"""paddle.nn.utils — weight reparameterizations.
+
+Reference: `python/paddle/nn/utils/weight_norm_hook.py` and
+`operators/spectral_norm_op.*` (power-iteration spectral normalization).
+TPU-native: the reparameterized weight is recomputed in forward via a
+pre-forward hook (pure function of the stored params), so it traces
+cleanly into compiled steps.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap
+from .layer.layers import Layer
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_except(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.square(w).sum(axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name="weight", dim=0):
+    """w = g * v / ||v|| (reference weight_norm_hook.py).  Adds
+    `{name}_g` / `{name}_v` params and recomputes `{name}` before every
+    forward."""
+    w = getattr(layer, name)
+    dim = 0 if dim is None else dim
+    g = Tensor(_norm_except(unwrap(w), dim))
+    v = Tensor(unwrap(w))
+    g.stop_gradient = False
+    v.stop_gradient = False
+    setattr(layer, name + "_g", g)
+    setattr(layer, name + "_v", v)
+    layer._parameters[name + "_g"] = g
+    layer._parameters[name + "_v"] = v
+    layer._parameters.pop(name, None)
+
+    def hook(ly, inputs):
+        vv = unwrap(getattr(ly, name + "_v"))
+        gg = unwrap(getattr(ly, name + "_g"))
+        wt = Tensor(gg * vv / jnp.maximum(_norm_except(vv, dim), 1e-12))
+        object.__setattr__(ly, name, wt)
+        return inputs
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = handle
+    hook(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name="weight"):
+    handle = getattr(layer, "_weight_norm_handle", None)
+    if handle is not None:
+        handle.remove()
+    v = layer._parameters.pop(name + "_v", None)
+    g = layer._parameters.pop(name + "_g", None)
+    if v is not None and g is not None:
+        w = Tensor(unwrap(g) * unwrap(v)
+                   / jnp.maximum(_norm_except(unwrap(v), 0), 1e-12))
+        w.stop_gradient = False
+        layer._parameters[name] = w
+        setattr(layer, name, w)
+    for attr in (name + "_g", name + "_v"):
+        if hasattr(layer, attr):
+            delattr(layer, attr)
+    return layer
+
+
+def spectral_norm(layer: Layer, name="weight", n_power_iterations=1,
+                  eps=1e-12, dim=None):
+    """w_sn = w / sigma_max(w) with power-iteration u/v buffers
+    (reference `operators/spectral_norm_op.h`)."""
+    w = getattr(layer, name)
+    warr = unwrap(w)
+    if dim is None:
+        dim = 0
+    mat = jnp.moveaxis(warr, dim, 0).reshape(warr.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u = Tensor(jnp.asarray(rng.randn(mat.shape[0]).astype(np.float32)))
+    v = Tensor(jnp.asarray(rng.randn(mat.shape[1]).astype(np.float32)))
+    orig = Tensor(warr)
+    orig.stop_gradient = False
+    layer._parameters[name + "_orig"] = orig
+    layer._parameters.pop(name, None)
+    setattr(layer, name + "_orig", orig)
+    setattr(layer, name + "_u", u)
+    setattr(layer, name + "_v", v)
+
+    def hook(ly, inputs):
+        wv = unwrap(getattr(ly, name + "_orig"))
+        m = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+        uu = unwrap(getattr(ly, name + "_u"))
+        vv = unwrap(getattr(ly, name + "_v"))
+        for _ in range(max(1, n_power_iterations)):
+            vv = m.T @ uu
+            vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+            uu = m @ vv
+            uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+        sigma = uu @ (m @ vv)
+        wt = Tensor(wv / jnp.maximum(sigma, eps))
+        object.__setattr__(ly, name, wt)
+        # persist the iteration state (buffers, not differentiated)
+        getattr(ly, name + "_u")._array = jax.lax.stop_gradient(uu)
+        getattr(ly, name + "_v")._array = jax.lax.stop_gradient(vv)
+        return inputs
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_handle = handle
+    hook(layer, ())
+    return layer

@@ -19,7 +19,8 @@ __all__ = [
     "edit_distance", "gather_tree", "hinge_loss", "l1_norm", "mean_iou",
     "modified_huber_loss", "rank_loss", "sampling_id", "space_to_depth",
     "squared_l2_distance", "squared_l2_norm", "teacher_student_sigmoid_loss",
-    "row_conv",
+    "row_conv", "set_value", "segment_sum", "segment_mean", "segment_max",
+    "segment_min", "segment_pool", "fsp_matrix", "Print", "Assert",
 ]
 
 
@@ -391,3 +392,132 @@ def row_conv(input, weight, name=None):
         return out
 
     return dispatch(f, input, weight)
+
+
+def set_value(x, value, slices=None, name=None):
+    """Static slice assignment (`operators/set_value_op.*`): functional
+    form of the reference's in-place `x[slices] = value`; returns the
+    updated tensor (Tensor.__setitem__ wraps this for the eager API)."""
+    from ..core.tensor import Tensor, unwrap
+
+    val = value if isinstance(value, Tensor) else \
+        Tensor(jnp.asarray(unwrap(value)))
+    return dispatch(lambda a, v: (a.at[slices].set(v.astype(a.dtype))
+                                  if slices is not None else
+                                  jnp.broadcast_to(v, a.shape)
+                                  .astype(a.dtype)), x, val)
+
+
+def segment_sum(data, segment_ids, name=None):
+    """`operators/segment_pool_op.*` SUM (jax.ops.segment_sum over the
+    leading axis; segment count = max(id)+1, host-known)."""
+    import numpy as np
+
+    from ..core.tensor import unwrap
+
+    n_seg = int(np.asarray(jax.device_get(unwrap(segment_ids))).max()) + 1
+    return dispatch(
+        lambda d, s: jax.ops.segment_sum(d, s.astype(jnp.int32),
+                                         num_segments=n_seg),
+        data, segment_ids, nondiff=(1,))
+
+
+def _segment_reduce(data, segment_ids, mode):
+    import numpy as np
+
+    from ..core.tensor import unwrap
+
+    n_seg = int(np.asarray(jax.device_get(unwrap(segment_ids))).max()) + 1
+
+    def f(d, s):
+        s = s.astype(jnp.int32)
+        if mode == "mean":
+            tot = jax.ops.segment_sum(d, s, num_segments=n_seg)
+            cnt = jax.ops.segment_sum(jnp.ones(s.shape[0], d.dtype), s,
+                                      num_segments=n_seg)
+            shape = (-1,) + (1,) * (d.ndim - 1)
+            return tot / jnp.maximum(cnt, 1).reshape(shape)
+        if mode == "max":
+            return jax.ops.segment_max(d, s, num_segments=n_seg)
+        if mode == "min":
+            return jax.ops.segment_min(d, s, num_segments=n_seg)
+        raise ValueError(mode)
+
+    return dispatch(f, data, segment_ids, nondiff=(1,))
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "min")
+
+
+def segment_pool(data, segment_ids, pool_type="sum", name=None):
+    """Dispatching wrapper matching the reference op's pooltype attr."""
+    pt = pool_type.lower()
+    if pt == "sum":
+        return segment_sum(data, segment_ids)
+    return _segment_reduce(data, segment_ids, pt)
+
+
+def fsp_matrix(x, y, name=None):
+    """Flow-of-solution-procedure matrix (`operators/fsp_op.*`, knowledge
+    distillation): [N, Cx, H, W] x [N, Cy, H, W] -> [N, Cx, Cy] =
+    x_flat @ y_flat^T / (H*W)."""
+    def f(a, b):
+        n, cx, h, w = a.shape
+        af = a.reshape(n, cx, h * w)
+        bf = b.reshape(n, b.shape[1], h * w)
+        return jnp.einsum("ncs,nds->ncd", af, bf) / (h * w)
+
+    return dispatch(f, x, y)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both", name=None):
+    """`operators/controlflow/print_op`-style debug print; inside jit it
+    lowers to jax.debug.print (host callback), eagerly it prints now."""
+    from ..core import framework
+    from ..core.tensor import unwrap
+
+    msg = message or ""
+    arr = unwrap(input)
+    if framework.in_trace():
+        jax.debug.print(msg + " {x}", x=arr)
+    else:
+        import numpy as np
+
+        vals = np.asarray(jax.device_get(arr)).ravel()[:summarize]
+        print(f"{msg} shape={tuple(arr.shape)} dtype={arr.dtype} "
+              f"values={vals}")
+    return input
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    """`operators/assert_op`: raise if cond is False (eager) /
+    checkify-style debug check under jit."""
+    from ..core import framework
+    from ..core.tensor import unwrap
+
+    arr = unwrap(cond)
+    if framework.in_trace():
+        def _cb(ok):
+            if not bool(ok):
+                raise AssertionError("paddle.static.nn.Assert failed")
+        jax.debug.callback(_cb, jnp.all(arr))
+        return cond
+    if not bool(jax.device_get(jnp.all(arr))):
+        import numpy as np
+
+        detail = [np.asarray(jax.device_get(unwrap(d))).ravel()[:summarize]
+                  for d in (data or [])]
+        raise AssertionError(f"Assert failed; data={detail}")
+    return cond

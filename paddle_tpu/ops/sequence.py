@@ -21,7 +21,10 @@ from ..core.tensor import Tensor, unwrap
 __all__ = [
     "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
     "sequence_reverse", "sequence_softmax", "sequence_expand",
-    "sequence_first_step", "sequence_last_step",
+    "sequence_first_step", "sequence_last_step", "sequence_concat",
+    "sequence_expand_as", "sequence_enumerate", "sequence_erase",
+    "sequence_reshape", "sequence_scatter", "sequence_slice",
+    "sequence_topk_avg_pooling", "sequence_conv",
 ]
 
 
@@ -180,3 +183,209 @@ def sequence_expand(x, repeat_times, name=None):
                           total_repeat_length=int(reps.sum()))
 
     return dispatch(f, x)
+
+
+def sequence_concat(xs, lengths_list, name=None):
+    """Concatenate the i-th sequences of every input along time
+    (`sequence_ops/sequence_concat_op.*`).  Padded form: inputs
+    [B, Ti, ...] with lengths [B]; output [B, sum(Ti), ...] where row b
+    holds the valid parts back to back, zero-padded after."""
+    k = len(xs)
+    T_out = sum(unwrap(x).shape[1] for x in xs)
+
+    def f(*ops):
+        xs_, ls_ = ops[:k], ops[k:]
+        b = xs_[0].shape[0]
+        feat = xs_[0].shape[2:]
+        out = jnp.zeros((b, T_out) + feat, xs_[0].dtype)
+        tpos = jnp.arange(T_out)
+        offset = jnp.zeros(b, jnp.int32)
+        for xv, lv in zip(xs_, ls_):
+            lv = lv.astype(jnp.int32)
+            ti = xv.shape[1]
+            # scatter xv[b, :lv[b]] at out[b, offset[b]:offset[b]+lv[b]]
+            src_idx = tpos[None, :] - offset[:, None]  # [B, T_out]
+            valid = (src_idx >= 0) & (src_idx < lv[:, None])
+            gathered = jnp.take_along_axis(
+                xv, jnp.clip(src_idx, 0, ti - 1).reshape(
+                    (b, T_out) + (1,) * len(feat)), axis=1)
+            out = jnp.where(valid.reshape((b, T_out) + (1,) * len(feat)),
+                            gathered, out)
+            offset = offset + lv
+        return out, offset
+
+    # through dispatch so gradients flow back into every input sequence
+    return dispatch(f, *xs, *lengths_list,
+                    nondiff=tuple(range(k, 2 * k)))
+
+
+def sequence_expand_as(x, y_lengths, name=None):
+    """Expand each row of x to the length of the matching y sequence
+    (`sequence_ops/sequence_expand_as_op.*`): row b (one timestep) is
+    broadcast y_lengths[b] times -> padded [B, maxlen, ...] + lengths."""
+    import numpy as np
+
+    ln = unwrap(y_lengths)
+    maxlen = int(np.asarray(jax.device_get(ln)).max())
+
+    def g(xv, lv):
+        rep = jnp.repeat(xv[:, None, ...], maxlen, axis=1)
+        mask = jnp.arange(maxlen)[None, :] < lv[:, None].astype(jnp.int32)
+        return jnp.where(mask.reshape(mask.shape + (1,) *
+                                      (rep.ndim - 2)), rep, 0.0)
+
+    return dispatch(g, x, y_lengths, nondiff=(1,))
+
+
+def sequence_enumerate(x, lengths, win_size, pad_value=0, name=None):
+    """Sliding windows of ids (`sequence_ops/sequence_enumerate_op.*`):
+    [B, T] int ids -> [B, T, win_size]; positions past a row's length (or
+    past T) fill with pad_value."""
+    def f(ids, lv):
+        b, t = ids.shape
+        base = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]
+        win = jnp.where(base < t, ids[:, jnp.clip(base, 0, t - 1)],
+                        pad_value)
+        valid_row = base[None, :, :] < lv[:, None, None].astype(jnp.int32)
+        return jnp.where(valid_row, win, pad_value)
+
+    return dispatch(f, x, lengths, nondiff=(0, 1))
+
+
+def sequence_erase(x, lengths, tokens, name=None):
+    """Remove listed tokens from each sequence, compacting left
+    (`sequence_ops/sequence_erase_op.*`).  Returns (ids, new_lengths)."""
+    toks = jnp.asarray(list(tokens))
+
+    def f(ids, lv):
+        b, t = ids.shape
+        keep = ~jnp.isin(ids, toks) & (
+            jnp.arange(t)[None, :] < lv[:, None].astype(jnp.int32))
+        # stable left-compaction: order keeps first, then padding
+        key = jnp.where(keep, jnp.arange(t)[None, :], t + jnp.arange(t))
+        perm = jnp.argsort(key, axis=1)
+        packed = jnp.take_along_axis(ids, perm, axis=1)
+        new_len = keep.sum(axis=1)
+        mask = jnp.arange(t)[None, :] < new_len[:, None]
+        return jnp.where(mask, packed, 0), new_len.astype(jnp.int64)
+
+    return dispatch(f, x, lengths, nondiff=(0, 1))
+
+
+def sequence_reshape(x, lengths, new_dim, name=None):
+    """Re-chunk each row's valid payload to `new_dim` columns
+    (`sequence_ops/sequence_reshape_op.*`): row of L steps x D dims
+    becomes L*D/new_dim steps x new_dim.  Requires L*D % new_dim == 0 per
+    valid row (checked by the reference); padding stays zero."""
+    def f(xv, lv):
+        b, t, d = xv.shape
+        t2 = t * d // new_dim
+        out = xv.reshape(b, t2, new_dim)
+        new_len = (lv.astype(jnp.int32) * d) // new_dim
+        mask = jnp.arange(t2)[None, :] < new_len[:, None]
+        return jnp.where(mask[:, :, None], out, 0.0), \
+            new_len.astype(jnp.int64)
+
+    return dispatch(f, x, lengths, nondiff=(1,))
+
+
+def sequence_scatter(x, index, updates, index_lengths, name=None):
+    """Scatter-add per-sequence updates into x
+    (`sequence_ops/sequence_scatter_op.*`): for each batch row b,
+    x[b, index[b, j]] += updates[b, j] for j < index_lengths[b]."""
+    def f(xv, idx, upd, lv):
+        b, k = idx.shape
+        valid = jnp.arange(k)[None, :] < lv[:, None].astype(jnp.int32)
+        contrib = jnp.where(valid[..., None] if upd.ndim == 3 else valid,
+                            upd, 0.0)
+        bidx = jnp.arange(b)[:, None].repeat(k, 1)
+        return xv.at[bidx, idx].add(contrib)
+
+    return dispatch(f, x, index, updates, index_lengths, nondiff=(1, 3))
+
+
+def sequence_slice(x, lengths, offset, length, name=None):
+    """Per-sequence slice (`sequence_ops/sequence_slice_op.*`):
+    row b keeps [offset[b], offset[b]+length[b]); output padded to
+    max(length) with new lengths."""
+    def f(xv, lv, off, ln):
+        b, t = xv.shape[:2]
+        tout = xv.shape[1]
+        pos = jnp.arange(tout)[None, :]
+        src = pos + off[:, None].astype(jnp.int32)
+        valid = pos < ln[:, None].astype(jnp.int32)
+        g = jnp.take_along_axis(
+            xv, jnp.clip(src, 0, t - 1).reshape(
+                (b, tout) + (1,) * (xv.ndim - 2)), axis=1)
+        return jnp.where(valid.reshape((b, tout) + (1,) * (xv.ndim - 2)),
+                         g, 0.0), ln.astype(jnp.int64)
+
+    return dispatch(f, x, lengths, offset, length, nondiff=(1, 2, 3))
+
+
+def sequence_topk_avg_pooling(x, row_lengths, col_lengths, topks,
+                              channel_num=1, name=None):
+    """`sequence_ops/sequence_topk_avg_pooling_op.*`: for each (batch,
+    channel, row), average the top-k values among the valid columns, for
+    every k in `topks`.  x: [B, C, R, Cl] padded; returns
+    [B, R, C*len(topks)]."""
+    ks = list(topks)
+    kmax = max(ks)
+
+    def f(xv, rl, cl):
+        b, c, r, w = xv.shape
+        colmask = jnp.arange(w)[None, :] < cl[:, None].astype(jnp.int32)
+        masked = jnp.where(colmask[:, None, None, :], xv, -jnp.inf)
+        top = jax.lax.top_k(masked, min(kmax, w))[0]  # [B,C,R,kmax]
+        outs = []
+        for k in ks:
+            kk = jnp.minimum(k, cl.astype(jnp.int32))  # effective k
+            vals = jnp.where(jnp.arange(top.shape[-1])[None, None, None, :]
+                             < kk[:, None, None, None],
+                             jnp.where(jnp.isfinite(top), top, 0.0), 0.0)
+            outs.append(vals.sum(-1) / jnp.maximum(kk, 1)[:, None, None])
+        out = jnp.stack(outs, axis=-1)  # [B,C,R,K]
+        rowmask = jnp.arange(r)[None, :] < rl[:, None].astype(jnp.int32)
+        out = jnp.where(rowmask[:, None, :, None], out, 0.0)
+        return out.transpose(0, 2, 1, 3).reshape(b, r, -1)
+
+    return dispatch(f, x, row_lengths, col_lengths, nondiff=(1, 2))
+
+
+def sequence_conv(x, lengths, weight, bias=None, context_length=3,
+                  context_start=None, padding_data=None, name=None):
+    """Context-window sequence convolution
+    (`sequence_ops/sequence_conv_op.*`): each timestep's context window
+    [t+start, t+start+context_length) is flattened and projected by
+    `weight` [context_length*D, M].  Out-of-sequence context rows are
+    zero (or `padding_data`)."""
+    start = -((context_length - 1) // 2) if context_start is None \
+        else context_start
+    has_pad = padding_data is not None
+    has_bias = bias is not None
+
+    def f(xv, lv, w, *rest):
+        b, t, d = xv.shape
+        offs = jnp.arange(context_length) + start
+        pos = jnp.arange(t)[:, None] + offs[None, :]  # [T, ctx]
+        valid = (pos >= 0) & (pos < lv[:, None, None].astype(jnp.int32))
+        g = xv[:, jnp.clip(pos, 0, t - 1), :]  # [B, T, ctx, D]
+        if has_pad:
+            # trainable boundary rows (reference PaddingData): row j of
+            # padding_data covers context offset j's out-of-range slots
+            pad = rest[0]
+            g = jnp.where(valid[..., None], g,
+                          pad[None, None, :context_length, :])
+        else:
+            g = jnp.where(valid[..., None], g, 0.0)
+        flat = g.reshape(b, t, context_length * d)
+        out = flat @ w
+        if has_bias:
+            out = out + rest[-1]
+        mask = jnp.arange(t)[None, :] < lv[:, None].astype(jnp.int32)
+        return jnp.where(mask[..., None], out, 0.0)
+
+    args = (x, lengths, weight) + \
+        ((padding_data,) if has_pad else ()) + \
+        ((bias,) if has_bias else ())
+    return dispatch(f, *args, nondiff=(1,))

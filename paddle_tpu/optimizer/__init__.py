@@ -10,6 +10,7 @@ to bf16 inside the jit'd forward (the XLA-native version of the reference's
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from . import lr
@@ -243,5 +244,73 @@ class Lars(Momentum):
         return p - v, {"velocity": v}
 
 
+class Ftrl(Optimizer):
+    """reference `operators/optimizers/ftrl_op.h` (FTRL-proximal):
+    squared-accum + linear-accum update with L1/L2 shrinkage."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._l1 = float(l1)
+        self._l2 = float(l2)
+        self._lr_power = float(lr_power)
+
+    def _init_slot(self, p):
+        return {"squared": jnp.zeros_like(p), "linear": jnp.zeros_like(p)}
+
+    def _update_param(self, p, g, slot, lr, step):
+        sq, lin = slot["squared"], slot["linear"]
+        new_sq = sq + g * g
+        lp = -self._lr_power
+        sigma = (new_sq ** lp - sq ** lp) / lr
+        new_lin = lin + g - sigma * p
+        pre = jnp.where(jnp.abs(new_lin) > self._l1,
+                        (self._l1 * jnp.sign(new_lin) - new_lin)
+                        / (new_sq ** lp / lr + 2 * self._l2),
+                        0.0)
+        return pre.astype(p.dtype), {"squared": new_sq, "linear": new_lin}
+
+
+class Dpsgd(Optimizer):
+    """reference `operators/optimizers/dpsgd_op.h` (differentially
+    private SGD): per-step gradient clipping to `clip` + gaussian noise
+    scaled by `sigma`, then a plain SGD step."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, seed=0, name=None, **kw):
+        super().__init__(learning_rate, parameters)
+        self._dp_clip = float(clip)
+        self._batch = float(batch_size)
+        self._sigma = float(sigma)
+        self._seed = int(seed)
+        self._param_ctr = 0
+        self._last_step = None
+
+    def _init_slot(self, p):
+        return {}
+
+    def _update_param(self, p, g, slot, lr, step):
+        norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        scale = jnp.minimum(1.0, self._dp_clip / jnp.maximum(norm, 1e-12))
+        # per-parameter independent noise: fold in a per-step param index
+        # (stable under tracing — the counter advances at trace time, once
+        # per parameter position) in addition to (seed, step)
+        if self._last_step is not step:
+            self._last_step = step
+            self._param_ctr = 0
+        self._param_ctr += 1
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(self._seed),
+                               jnp.asarray(step, jnp.int32)),
+            self._param_ctr)
+        noise = jax.random.normal(key, g.shape, jnp.float32) * (
+            self._dp_clip * self._sigma / self._batch)
+        gg = g * scale + noise.astype(g.dtype)
+        return p - (lr * gg).astype(p.dtype), {}
+
+
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
-           "Adadelta", "Adamax", "RMSProp", "Lamb", "Lars", "lr"]
+           "Adadelta", "Adamax", "RMSProp", "Lamb", "Lars", "Ftrl",
+           "Dpsgd", "lr"]

@@ -52,6 +52,48 @@ def fake_quantize_abs_max(x, bit_length=8):
     return dispatch(f, x)
 
 
+def dequantize_abs_max(x, scale, max_range, name=None):
+    """reference `dequantize_abs_max` (`operators/dequantize_abs_max_op.cc`):
+    out = x * scale / max_range (int8 -> float recovery)."""
+    def f(a, s):
+        return a.astype(jnp.float32) * s / max_range
+
+    return dispatch(f, x, scale)
+
+
+def dequantize_log(x, dict_table, name=None):
+    """reference `dequantize_log` (`operators/dequantize_log_op.cc`):
+    log-quantized uint8 codes -> float via a 128-entry lookup table;
+    codes >= 128 map to the negative of entry code-128."""
+    def f(a, table):
+        code = a.astype(jnp.int32)
+        neg = code >= 128
+        idx = jnp.where(neg, code - 128, code)
+        val = table[jnp.clip(idx, 0, table.shape[0] - 1)]
+        return jnp.where(neg, -val, val)
+
+    return dispatch(f, x, dict_table, nondiff=(0,))
+
+
+def moving_average_abs_max_scale(x, state=None, accum=None,
+                                 moving_rate=0.9, name=None):
+    """reference `moving_average_abs_max_scale`
+    (`operators/fake_quantize_op.cc`): running |x|_max scale tracker —
+    state = rate*state + 1; accum = rate*accum + max|x|;
+    scale = accum/state.  Returns (x, scale, new_state, new_accum)."""
+    from ..core.tensor import unwrap
+
+    st = unwrap(state) if state is not None else jnp.ones((), jnp.float32)
+    ac = unwrap(accum) if accum is not None else jnp.zeros((), jnp.float32)
+
+    def f(a, s, c):
+        new_s = moving_rate * s + 1.0
+        new_c = moving_rate * c + jnp.max(jnp.abs(a))
+        return a, new_c / new_s, new_s, new_c
+
+    return dispatch(f, x, Tensor(st), Tensor(ac), nondiff=(1, 2))
+
+
 def fake_quantize_channel_wise_abs_max(x, bit_length=8, quant_axis=0):
     """reference `fake_channel_wise_quantize_abs_max`: per-output-channel
     scales (weights)."""

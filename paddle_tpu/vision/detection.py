@@ -1,0 +1,778 @@
+"""Detection long-tail operators (RPN/FPN/RCNN/RetinaNet/YOLOv3 families).
+
+Reference: `paddle/fluid/operators/detection/` —
+`generate_proposals_op.cc` (+_v2), `distribute_fpn_proposals_op.cc`,
+`collect_fpn_proposals_op.cc`, `box_decoder_and_assign_op.cc`,
+`retinanet_detection_output_op.cc`, `locality_aware_nms_op.cc`,
+`density_prior_box_op.cc`, `yolov3_loss_op.h`, and the top-level
+`psroi_pool_op.h` / `prroi_pool_op.h` / `deformable_psroi_pooling_op.h`.
+
+TPU-first design (same stance as vision/ops.py): every op returns
+STATIC-shape padded outputs plus valid counts in place of the reference's
+variable-length LoD outputs; selection loops become sort + mask
+formulations; psroi/prroi pooling are separable mask/integral einsums
+that XLA maps onto the VPU/MXU.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch
+from ..core.tensor import Tensor, unwrap
+from .ops import _nms_keep_mask, _pairwise_iou
+
+__all__ = [
+    "psroi_pool", "prroi_pool", "deformable_psroi_pooling",
+    "generate_proposals", "generate_proposals_v2",
+    "distribute_fpn_proposals", "collect_fpn_proposals",
+    "box_decoder_and_assign", "retinanet_detection_output",
+    "locality_aware_nms", "density_prior_box", "yolov3_loss",
+    "multiclass_nms2", "multiclass_nms3",
+]
+
+
+# ---------------------------------------------------------------------------
+# position-sensitive / precise ROI pooling
+# ---------------------------------------------------------------------------
+def _roi_batch_ids(rois_num, n_rois):
+    ends = jnp.cumsum(rois_num)
+    return jnp.sum((jnp.arange(n_rois)[:, None] >= ends[None, :])
+                   .astype(jnp.int32), axis=1)
+
+
+def psroi_pool(x, boxes, boxes_num, output_channels, spatial_scale,
+               pooled_height, pooled_width, name=None):
+    """Position-sensitive ROI average pooling, exact reference semantics
+    (`operators/psroi_pool_op.h`): input channel (c*PH + ph)*PW + pw feeds
+    output [c, ph, pw]; integer bin windows floor/ceil'ed from
+    round(coord)*scale edges; empty bins -> 0."""
+    PH, PW, C = int(pooled_height), int(pooled_width), int(output_channels)
+
+    def f(xv, rois, rois_num):
+        n, in_c, h, w = xv.shape
+        if in_c != C * PH * PW:
+            raise ValueError(
+                f"psroi_pool: input channels {in_c} != output_channels*"
+                f"pooled_height*pooled_width = {C * PH * PW}")
+        batch = _roi_batch_ids(rois_num, rois.shape[0])
+
+        def cround(v):
+            return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
+        x1 = cround(rois[:, 0]) * spatial_scale
+        y1 = cround(rois[:, 1]) * spatial_scale
+        x2 = (cround(rois[:, 2]) + 1.0) * spatial_scale
+        y2 = (cround(rois[:, 3]) + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh = rh / PH
+        bw = rw / PW
+        ph = jnp.arange(PH, dtype=jnp.float32)
+        pw = jnp.arange(PW, dtype=jnp.float32)
+        hs = jnp.clip(jnp.floor(ph[None, :] * bh[:, None] + y1[:, None]),
+                      0, h)
+        he = jnp.clip(jnp.ceil((ph[None, :] + 1) * bh[:, None]
+                               + y1[:, None]), 0, h)
+        ws = jnp.clip(jnp.floor(pw[None, :] * bw[:, None] + x1[:, None]),
+                      0, w)
+        we = jnp.clip(jnp.ceil((pw[None, :] + 1) * bw[:, None]
+                               + x1[:, None]), 0, w)
+        hidx = jnp.arange(h, dtype=jnp.float32)
+        widx = jnp.arange(w, dtype=jnp.float32)
+        rm = ((hidx[None, None, :] >= hs[:, :, None]) &
+              (hidx[None, None, :] < he[:, :, None])).astype(xv.dtype)
+        cm = ((widx[None, None, :] >= ws[:, :, None]) &
+              (widx[None, None, :] < we[:, :, None])).astype(xv.dtype)
+
+        def per_roi(b, rmr, cmr):
+            xg = xv[b].reshape(C, PH, PW, h, w)
+            sums = jnp.einsum("ih,cijhw,jw->cij", rmr, xg, cmr)
+            area = rmr.sum(-1)[:, None] * cmr.sum(-1)[None, :]
+            return jnp.where(area > 0, sums / jnp.maximum(area, 1.0), 0.0)
+
+        return jax.vmap(per_roi)(batch, rm, cm)
+
+    return dispatch(f, x, boxes, boxes_num, nondiff=(2,))
+
+
+def _tri_integral(lo, hi, n):
+    """∫_lo^hi max(0, 1-|t-i|) dt for every integer i in [0, n) — the
+    exact per-pixel weight of PrRoI pooling's bilinear integral, computed
+    from the triangle kernel's antiderivative (separable in x/y)."""
+    idx = jnp.arange(n, dtype=jnp.float32)
+
+    def T(t):  # antiderivative of the triangle kernel, T(-1)=0, T(1)=1
+        t = jnp.clip(t, -1.0, 1.0)
+        return jnp.where(t < 0, (t + 1.0) ** 2 / 2.0,
+                         0.5 + t - t * t / 2.0)
+
+    return T(hi[..., None] - idx) - T(lo[..., None] - idx)
+
+
+def prroi_pool(x, boxes, boxes_num, pooled_height, pooled_width,
+               spatial_scale=1.0, name=None):
+    """Precise ROI pooling (`operators/prroi_pool_op.h`, PrRoIPooling):
+    the EXACT integral of the bilinearly-interpolated feature over each
+    continuous bin, divided by the bin area.  Realized in closed form as
+    a separable triangle-kernel integral (outer product of 1-D weights),
+    so it is fully differentiable w.r.t. both features and coords."""
+    PH, PW = int(pooled_height), int(pooled_width)
+
+    def f(xv, rois, rois_num):
+        n, c, h, w = xv.shape
+        batch = _roi_batch_ids(rois_num, rois.shape[0])
+        x1 = rois[:, 0] * spatial_scale
+        y1 = rois[:, 1] * spatial_scale
+        x2 = rois[:, 2] * spatial_scale
+        y2 = rois[:, 3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.0)
+        rh = jnp.maximum(y2 - y1, 0.0)
+        bw = rw / PW
+        bh = rh / PH
+        ph = jnp.arange(PH, dtype=jnp.float32)
+        pw = jnp.arange(PW, dtype=jnp.float32)
+        # continuous bin edges [R, P]
+        hlo = y1[:, None] + ph[None, :] * bh[:, None]
+        hhi = y1[:, None] + (ph[None, :] + 1) * bh[:, None]
+        wlo = x1[:, None] + pw[None, :] * bw[:, None]
+        whi = x1[:, None] + (pw[None, :] + 1) * bw[:, None]
+        gh = _tri_integral(hlo, hhi, h)  # [R, PH, H]
+        gw = _tri_integral(wlo, whi, w)  # [R, PW, W]
+        area = jnp.maximum(bh[:, None, None] * bw[:, None, None], 1e-9)
+
+        def per_roi(b, ghr, gwr, ar):
+            sums = jnp.einsum("ih,chw,jw->cij", ghr, xv[b], gwr)
+            return sums / ar
+
+        return jax.vmap(per_roi)(batch, gh, gw, area)
+
+    return dispatch(f, x, boxes, boxes_num, nondiff=(2,))
+
+
+def deformable_psroi_pooling(x, rois, trans, rois_num=None, no_trans=False,
+                             spatial_scale=1.0, output_channels=None,
+                             group_size=1, pooled_height=1, pooled_width=1,
+                             part_size=None, sample_per_part=4,
+                             trans_std=0.1, name=None):
+    """Deformable position-sensitive ROI pooling
+    (`operators/deformable_psroi_pooling_op.h`): psroi bins shifted by
+    learned normalized offsets `trans` [R, 2, part_h, part_w], averaged
+    over `sample_per_part`^2 bilinear samples per bin."""
+    PH, PW = int(pooled_height), int(pooled_width)
+    G = int(group_size)
+    S = int(sample_per_part)
+    part = int(part_size or PH)
+
+    def f(xv, roi_arr, trans_arr, rois_num_arr):
+        n, in_c, h, w = xv.shape
+        C = int(output_channels or in_c // (PH * PW))
+        R = roi_arr.shape[0]
+        batch = _roi_batch_ids(rois_num_arr, R)
+        # reference: roi corners offset by 0.5 at scale
+        x1 = roi_arr[:, 0] * spatial_scale - 0.5
+        y1 = roi_arr[:, 1] * spatial_scale - 0.5
+        x2 = (roi_arr[:, 2] + 1.0) * spatial_scale - 0.5
+        y2 = (roi_arr[:, 3] + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh = rh / PH
+        bw = rw / PW
+        sub_h = bh / S
+        sub_w = bw / S
+
+        ph = jnp.arange(PH)
+        pw = jnp.arange(PW)
+        # offset part bin for each output bin
+        part_h_idx = jnp.floor(ph.astype(jnp.float32) / PH * part
+                               ).astype(jnp.int32)
+        part_w_idx = jnp.floor(pw.astype(jnp.float32) / PW * part
+                               ).astype(jnp.int32)
+
+        def per_roi(r):
+            if no_trans:
+                off_h = jnp.zeros((PH, PW))
+                off_w = jnp.zeros((PH, PW))
+            else:
+                t = trans_arr[r]  # [2, part, part]
+                off_h = t[0][part_h_idx[:, None], part_w_idx[None, :]] \
+                    * trans_std * rh[r]
+                off_w = t[1][part_h_idx[:, None], part_w_idx[None, :]] \
+                    * trans_std * rw[r]
+            # sample grid per bin: [PH, PW, S, S]
+            sy = (y1[r] + ph[:, None, None, None] * bh[r] + off_h[:, :, None, None]
+                  + (jnp.arange(S)[None, None, :, None] + 0.5) * sub_h[r])
+            sx = (x1[r] + pw[None, :, None, None] * bw[r] + off_w[:, :, None, None]
+                  + (jnp.arange(S)[None, None, None, :] + 0.5) * sub_w[r])
+            valid = (sy > -1.0) & (sy < h) & (sx > -1.0) & (sx < w)
+            syc = jnp.clip(sy, 0.0, h - 1.0)
+            sxc = jnp.clip(sx, 0.0, w - 1.0)
+            y0 = jnp.floor(syc).astype(jnp.int32)
+            x0 = jnp.floor(sxc).astype(jnp.int32)
+            y1i = jnp.minimum(y0 + 1, h - 1)
+            x1i = jnp.minimum(x0 + 1, w - 1)
+            ly = syc - y0
+            lx = sxc - x0
+            img = xv[batch[r]].reshape(C, PH, PW, h, w)
+            cidx = jnp.arange(C)
+
+            def gather(yy, xx):
+                # img[c, ph, pw, yy[ph,pw,s,s], xx[ph,pw,s,s]]
+                return img[
+                    cidx[:, None, None, None, None],
+                    ph[None, :, None, None, None],
+                    pw[None, None, :, None, None],
+                    yy[None], xx[None]]
+
+            v = (gather(y0, x0) * ((1 - ly) * (1 - lx))[None]
+                 + gather(y0, x1i) * ((1 - ly) * lx)[None]
+                 + gather(y1i, x0) * (ly * (1 - lx))[None]
+                 + gather(y1i, x1i) * (ly * lx)[None])
+            v = jnp.where(valid[None], v, 0.0)
+            cnt = jnp.maximum(valid.sum(axis=(-1, -2)), 1)
+            return v.sum(axis=(-1, -2)) / cnt[None]
+
+        return jax.vmap(per_roi)(jnp.arange(R))
+
+    rn = rois_num if rois_num is not None else \
+        Tensor(jnp.asarray([unwrap(rois).shape[0]], jnp.int32))
+    return dispatch(f, x, rois, trans, rn, nondiff=(3,))
+
+
+# ---------------------------------------------------------------------------
+# RPN proposals
+# ---------------------------------------------------------------------------
+def _decode_proposals(anchors, deltas, variances, offset):
+    """generate_proposals' internal BoxCoder (center-size decode with
+    per-anchor variances and dw/dh clipped at log(1000/16))."""
+    clip = np.log(1000.0 / 16.0)
+    aw = anchors[:, 2] - anchors[:, 0] + offset
+    ah = anchors[:, 3] - anchors[:, 1] + offset
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    dx, dy, dw, dh = (deltas[:, i] for i in range(4))
+    vx, vy, vw, vh = (variances[:, i] for i in range(4))
+    cx = acx + dx * vx * aw
+    cy = acy + dy * vy * ah
+    w = jnp.exp(jnp.minimum(dw * vw, clip)) * aw
+    h = jnp.exp(jnp.minimum(dh * vh, clip)) * ah
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                      cx + 0.5 * w - offset, cy + 0.5 * h - offset],
+                     axis=1)
+
+
+def _proposals_one_image(scores, deltas, anchors, variances, im_h, im_w,
+                         scale, pre_nms_top_n, post_nms_top_n, nms_thresh,
+                         min_size, offset):
+    """[A] scores / [A,4] deltas -> (rois [post,4], probs [post], count)."""
+    A = scores.shape[0]
+    k = min(pre_nms_top_n if pre_nms_top_n > 0 else A, A)
+    top_scores, idx = jax.lax.top_k(scores, k)
+    props = _decode_proposals(anchors[idx], deltas[idx], variances[idx],
+                              offset)
+    # clip to image
+    x1 = jnp.clip(props[:, 0], 0, im_w - offset)
+    y1 = jnp.clip(props[:, 1], 0, im_h - offset)
+    x2 = jnp.clip(props[:, 2], 0, im_w - offset)
+    y2 = jnp.clip(props[:, 3], 0, im_h - offset)
+    props = jnp.stack([x1, y1, x2, y2], axis=1)
+    ms = jnp.maximum(min_size, 1.0) * scale
+    ww = props[:, 2] - props[:, 0] + offset
+    hh = props[:, 3] - props[:, 1] + offset
+    cx = props[:, 0] + ww / 2.0
+    cy = props[:, 1] + hh / 2.0
+    valid = (ww >= ms) & (hh >= ms) & (cx <= im_w) & (cy <= im_h)
+    sc = jnp.where(valid, top_scores, -jnp.inf)
+    keep = _nms_keep_mask(props, sc, nms_thresh, box_normalized=False)
+    keep = keep & valid
+    final = jnp.where(keep, sc, -jnp.inf)
+    pk = min(post_nms_top_n, k)
+    out_sc, out_idx = jax.lax.top_k(final, pk)
+    rois = props[out_idx]
+    good = jnp.isfinite(out_sc)
+    rois = jnp.where(good[:, None], rois, 0.0)
+    probs = jnp.where(good, out_sc, 0.0)
+    return rois, probs, good.sum().astype(jnp.int32)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=True, return_rois_num=True, name=None):
+    """RPN proposal generation (`detection/generate_proposals_op.cc`
+    ProposalForOneImage): per image top-pre_nms scores -> decode against
+    anchors/variances -> clip -> min-size filter -> NMS -> top-post_nms.
+    Static shapes: returns ([N, post, 4], [N, post], counts [N]).
+    `img_size` rows are [H, W] (v2) or [H, W, scale] (v1 im_info)."""
+    off = 1.0 if pixel_offset else 0.0
+
+    def f(sc, deltas, imgs, anc, var):
+        n = sc.shape[0]
+        a_per = sc.shape[1]
+        hw = sc.shape[2] * sc.shape[3]
+        anc2 = anc.reshape(-1, 4)
+        var2 = var.reshape(-1, 4) if var.ndim > 2 else var
+        if var2.shape[0] != anc2.shape[0]:
+            var2 = jnp.broadcast_to(var2, anc2.shape)
+
+        def one(i):
+            # scores [A,H,W] -> [H*W*A] matching anchors [H,W,A,4] layout
+            s = sc[i].transpose(1, 2, 0).reshape(-1)
+            d = deltas[i].reshape(a_per, 4, sc.shape[2], sc.shape[3]) \
+                .transpose(2, 3, 0, 1).reshape(-1, 4)
+            im_h, im_w = imgs[i][0], imgs[i][1]
+            scale = imgs[i][2] if imgs.shape[1] > 2 else 1.0
+            return _proposals_one_image(
+                s, d, anc2, var2, im_h, im_w, scale, pre_nms_top_n,
+                post_nms_top_n, nms_thresh, min_size, off)
+
+        rois, probs, counts = jax.vmap(one)(jnp.arange(n))
+        return rois, probs, counts
+
+    rois, probs, counts = dispatch(
+        f, scores, bbox_deltas, img_size, anchors, variances,
+        nondiff=(2,))
+    if return_rois_num:
+        return rois, probs, counts
+    return rois, probs
+
+
+def generate_proposals_v2(scores, bbox_deltas, img_size, anchors, variances,
+                          **kwargs):
+    """`generate_proposals_v2` — identical math with im_shape=[H,W] and a
+    pixel_offset attr (`detection/generate_proposals_v2_op.cc`)."""
+    return generate_proposals(scores, bbox_deltas, img_size, anchors,
+                              variances, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# FPN proposal routing
+# ---------------------------------------------------------------------------
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=True, rois_num=None,
+                             name=None):
+    """Route each ROI to its FPN level
+    (`detection/distribute_fpn_proposals_op.cc`):
+    level = floor(log2(sqrt(area)/refer_scale + 1e-6)) + refer_level,
+    clipped to [min_level, max_level].  Static shapes: every level output
+    is [R, 4] padded (invalid rows zeroed) + per-level counts +
+    restore_index [R]."""
+    n_levels = max_level - min_level + 1
+    off = 1.0 if pixel_offset else 0.0
+
+    def f(rois):
+        R = rois.shape[0]
+        w = jnp.maximum(rois[:, 2] - rois[:, 0] + off, 0.0)
+        h = jnp.maximum(rois[:, 3] - rois[:, 1] + off, 0.0)
+        scale = jnp.sqrt(w * h)
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        outs = []
+        counts = []
+        order_slots = []
+        for L in range(min_level, max_level + 1):
+            mask = lvl == L
+            # stable pack: indices of this level first, padding after
+            key = jnp.where(mask, jnp.arange(R), R + jnp.arange(R))
+            perm = jnp.argsort(key)
+            packed = jnp.where(mask[perm][:, None], rois[perm], 0.0)
+            outs.append(packed)
+            counts.append(mask.sum().astype(jnp.int32))
+            order_slots.append(perm)
+        counts_arr = jnp.stack(counts)
+        # restore index: position of each original roi in the
+        # concatenated per-level outputs
+        starts = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                  jnp.cumsum(counts_arr)[:-1]])
+        restore = jnp.zeros(R, jnp.int32)
+        for li, L in enumerate(range(min_level, max_level + 1)):
+            perm = order_slots[li]
+            pos_in_level = jnp.argsort(perm)  # original idx -> packed slot
+            restore = jnp.where(lvl == L, starts[li] + pos_in_level,
+                                restore)
+        return (*outs, counts_arr, restore)
+
+    results = dispatch(f, fpn_rois)
+    level_rois = list(results[:n_levels])
+    counts = results[n_levels]
+    restore = results[n_levels + 1]
+    return level_rois, restore, counts
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Merge per-level ROIs and keep the global top-k by score
+    (`detection/collect_fpn_proposals_op.cc`).  Returns
+    (rois [post,4], counts scalar)."""
+    def f(*arrs):
+        k = len(arrs) // 2
+        rois = jnp.concatenate(arrs[:k], axis=0)
+        scores = jnp.concatenate([a.reshape(-1) for a in arrs[k:]], axis=0)
+        top = min(post_nms_top_n, scores.shape[0])
+        sc, idx = jax.lax.top_k(scores, top)
+        return rois[idx], sc, (sc > -jnp.inf).sum().astype(jnp.int32)
+
+    return dispatch(f, *multi_rois, *multi_scores)
+
+
+# ---------------------------------------------------------------------------
+# decode+assign / retinanet output / locality-aware NMS
+# ---------------------------------------------------------------------------
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=4.135, name=None):
+    """`detection/box_decoder_and_assign_op.cc`: decode per-class deltas
+    against priors (variance-weighted, dw/dh clipped), then assign each
+    ROI the box of its best non-background class."""
+    def f(prior, var, target, score):
+        R = prior.shape[0]
+        n_cls4 = target.shape[1]
+        C = n_cls4 // 4
+        pw = prior[:, 2] - prior[:, 0] + 1.0
+        ph = prior[:, 3] - prior[:, 1] + 1.0
+        pcx = prior[:, 0] + 0.5 * pw
+        pcy = prior[:, 1] + 0.5 * ph
+        t = target.reshape(R, C, 4)
+        v = var.reshape(R if var.shape[0] == R else 1, -1)
+        v = jnp.broadcast_to(v[:, :4], (R, 4))
+        dx = t[..., 0] * v[:, None, 0]
+        dy = t[..., 1] * v[:, None, 1]
+        dw = jnp.clip(t[..., 2] * v[:, None, 2], -box_clip, box_clip)
+        dh = jnp.clip(t[..., 3] * v[:, None, 3], -box_clip, box_clip)
+        cx = dx * pw[:, None] + pcx[:, None]
+        cy = dy * ph[:, None] + pcy[:, None]
+        w = jnp.exp(dw) * pw[:, None]
+        h = jnp.exp(dh) * ph[:, None]
+        decoded = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                             cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0],
+                            axis=-1)  # [R, C, 4]
+        # assign: best class EXCLUDING background (last column, reference
+        # uses argmax over scores[:-1])
+        best = jnp.argmax(score[:, :-1], axis=1)
+        assigned = jnp.take_along_axis(
+            decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+        return decoded.reshape(R, C * 4), assigned
+
+    return dispatch(f, prior_box, prior_box_var, target_box, box_score)
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """`detection/locality_aware_nms_op.cc` (EAST text detection): first
+    merge consecutive overlapping boxes by score-weighted averaging, then
+    standard multiclass NMS.  Returns ([K, 6] padded, count)."""
+    def f(boxes, score):
+        C = score.shape[0]
+        n = boxes.shape[0]
+
+        def one_class(c):
+            sc = score[c]
+            # locality merge: weighted-average each box with its
+            # IoU>thresh neighbours (single pass, score weights)
+            iou = _pairwise_iou(boxes, boxes, normalized)
+            wmat = jnp.where(iou > nms_threshold, sc[None, :], 0.0)
+            wsum = wmat.sum(1, keepdims=True)
+            merged = jnp.where(
+                wsum > 0, (wmat @ boxes) / jnp.maximum(wsum, 1e-9), boxes)
+            msc = jnp.where(sc >= score_threshold, sc, -jnp.inf)
+            k = min(nms_top_k if nms_top_k > 0 else n, n)
+            top_sc, idx = jax.lax.top_k(msc, k)
+            mb = merged[idx]
+            keep = _nms_keep_mask(mb, top_sc, nms_threshold, normalized)
+            valid = keep & jnp.isfinite(top_sc)
+            return mb, jnp.where(valid, top_sc, -jnp.inf)
+
+        cls_ids = [c for c in range(C) if c != background_label]
+        all_boxes = []
+        all_scores = []
+        all_cls = []
+        for c in cls_ids:
+            mb, s = one_class(c)
+            all_boxes.append(mb)
+            all_scores.append(s)
+            all_cls.append(jnp.full(s.shape, c, jnp.float32))
+        ab = jnp.concatenate(all_boxes)
+        asc = jnp.concatenate(all_scores)
+        ac = jnp.concatenate(all_cls)
+        k = min(keep_top_k if keep_top_k > 0 else asc.shape[0],
+                asc.shape[0])
+        sc, idx = jax.lax.top_k(asc, k)
+        good = jnp.isfinite(sc)
+        out = jnp.concatenate([
+            ac[idx][:, None], jnp.where(good, sc, 0.0)[:, None],
+            jnp.where(good[:, None], ab[idx], 0.0)], axis=1)
+        return out, good.sum().astype(jnp.int32)
+
+    return dispatch(f, bboxes, scores)
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0, name=None):
+    """`detection/retinanet_detection_output_op.cc`: per-FPN-level top-k
+    + sigmoid-score threshold, decode against anchors (variance-free
+    center-size), merge levels, class-wise NMS, global keep_top_k.
+    Single-image; returns ([K, 6], count)."""
+    def f(*arrs):
+        L = (len(arrs) - 1) // 3
+        bb = arrs[:L]
+        sc = arrs[L:2 * L]
+        an = arrs[2 * L:3 * L]
+        im = arrs[-1]
+        all_boxes = []
+        all_scores = []
+        for lb, ls, la in zip(bb, sc, an):
+            s = ls.reshape(-1, ls.shape[-1])  # [A, C]
+            d = lb.reshape(-1, 4)
+            a = la.reshape(-1, 4)
+            smax = s.max(axis=1)
+            k = min(nms_top_k, smax.shape[0])
+            _, idx = jax.lax.top_k(smax, k)
+            dec = _decode_proposals(
+                a[idx], d[idx], jnp.ones((k, 4), jnp.float32), 1.0)
+            h_im, w_im, scale = im[0], im[1], im[2]
+            dec = jnp.stack([
+                jnp.clip(dec[:, 0] / scale, 0, w_im / scale - 1),
+                jnp.clip(dec[:, 1] / scale, 0, h_im / scale - 1),
+                jnp.clip(dec[:, 2] / scale, 0, w_im / scale - 1),
+                jnp.clip(dec[:, 3] / scale, 0, h_im / scale - 1)],
+                axis=1)
+            all_boxes.append(dec)
+            all_scores.append(s[idx])
+        boxes = jnp.concatenate(all_boxes)       # [M, 4]
+        scores_m = jnp.concatenate(all_scores)   # [M, C]
+        C = scores_m.shape[1]
+        outs = []
+        for c in range(C):
+            s = jnp.where(scores_m[:, c] >= score_threshold,
+                          scores_m[:, c], -jnp.inf)
+            keep = _nms_keep_mask(boxes, s, nms_threshold, False)
+            s = jnp.where(keep, s, -jnp.inf)
+            outs.append((jnp.full(s.shape, c, jnp.float32), s))
+        ac = jnp.concatenate([o[0] for o in outs])
+        asc = jnp.concatenate([o[1] for o in outs])
+        ab = jnp.tile(boxes, (C, 1))
+        k = min(keep_top_k, asc.shape[0])
+        top_sc, idx = jax.lax.top_k(asc, k)
+        good = jnp.isfinite(top_sc)
+        out = jnp.concatenate([
+            ac[idx][:, None], jnp.where(good, top_sc, 0.0)[:, None],
+            jnp.where(good[:, None], ab[idx], 0.0)], axis=1)
+        return out, good.sum().astype(jnp.int32)
+
+    return dispatch(f, *bboxes, *scores, *anchors, im_info)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      step_w=0.0, step_h=0.0, offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """`detection/density_prior_box_op.cc` (SSD densified anchors): for
+    each (density, fixed_size) pair and each fixed_ratio, lay a density x
+    density sub-grid of anchors inside every feature-map cell."""
+    def f(feat, img):
+        fh, fw = feat.shape[2], feat.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+        sw = step_w or iw / fw
+        sh = step_h or ih / fh
+        boxes = []
+        ys, xs = jnp.meshgrid(jnp.arange(fh, dtype=jnp.float32),
+                              jnp.arange(fw, dtype=jnp.float32),
+                              indexing="ij")
+        cx0 = (xs + offset) * sw
+        cy0 = (ys + offset) * sh
+        for size, dens in zip(fixed_sizes, densities):
+            dens = int(dens)
+            shift = size / dens
+            for ratio in fixed_ratios:
+                bw = size * np.sqrt(ratio)
+                bh = size / np.sqrt(ratio)
+                for di in range(dens):
+                    for dj in range(dens):
+                        ccx = cx0 - size / 2.0 + shift / 2.0 + dj * shift
+                        ccy = cy0 - size / 2.0 + shift / 2.0 + di * shift
+                        b = jnp.stack([(ccx - bw / 2.0) / iw,
+                                       (ccy - bh / 2.0) / ih,
+                                       (ccx + bw / 2.0) / iw,
+                                       (ccy + bh / 2.0) / ih], axis=-1)
+                        boxes.append(b)
+        out = jnp.stack(boxes, axis=2)  # [fh, fw, P, 4]
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               out.shape)
+        if flatten_to_2d:
+            return out.reshape(-1, 4), var.reshape(-1, 4)
+        return out, var
+
+    return dispatch(f, input, image)
+
+
+# ---------------------------------------------------------------------------
+# YOLOv3 training loss
+# ---------------------------------------------------------------------------
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, scale_x_y=1.0, name=None):
+    """`detection/yolov3_loss_op.h` exact semantics: per-cell best-IoU
+    ignore mask, per-gt best-anchor positive assignment, sigmoid-CE for
+    x/y/objectness/class, L1 for w/h, (2 - w*h) box-size weighting,
+    optional label smoothing and mixup scores.  Returns per-image loss
+    [N] (plus objectness/match masks like the reference)."""
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    C = int(class_num)
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    def bce(logit, label):
+        return jnp.maximum(logit, 0) - logit * label + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def f(xv, gtb, gtl, *rest):
+        gts = rest[0] if rest else None
+        n, _, h, w = xv.shape
+        m = len(mask)
+        b = gtb.shape[1]
+        input_size = downsample_ratio * h
+        pred = xv.reshape(n, m, 5 + C, h, w)
+        tx, ty = pred[:, :, 0], pred[:, :, 1]
+        tw, th = pred[:, :, 2], pred[:, :, 3]
+        tobj = pred[:, :, 4]
+        tcls = pred[:, :, 5:]
+        if gts is None:
+            gts = jnp.ones((n, b), jnp.float32)
+        gt_valid = (gtb[:, :, 2] > 0) & (gtb[:, :, 3] > 0)
+
+        # pred boxes (normalized to input size) for the ignore mask
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        aw = jnp.asarray(an[mask, 0])[None, :, None, None]
+        ah = jnp.asarray(an[mask, 1])[None, :, None, None]
+        px = (gx + jax.nn.sigmoid(tx) * scale + bias) / w
+        py = (gy + jax.nn.sigmoid(ty) * scale + bias) / h
+        pw = jnp.exp(tw) * aw / input_size
+        ph = jnp.exp(th) * ah / input_size
+
+        def iou_centered(x1, y1, w1, h1, x2, y2, w2, h2):
+            ox = jnp.clip(jnp.minimum(x1 + w1 / 2, x2 + w2 / 2)
+                          - jnp.maximum(x1 - w1 / 2, x2 - w2 / 2), 0)
+            oy = jnp.clip(jnp.minimum(y1 + h1 / 2, y2 + h2 / 2)
+                          - jnp.maximum(y1 - h1 / 2, y2 - h2 / 2), 0)
+            inter = ox * oy
+            return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+        # best IoU of each predicted box vs any valid gt: [n,m,h,w]
+        iou_all = iou_centered(
+            px[:, :, :, :, None], py[:, :, :, :, None],
+            pw[:, :, :, :, None], ph[:, :, :, :, None],
+            gtb[:, None, None, None, :, 0], gtb[:, None, None, None, :, 1],
+            gtb[:, None, None, None, :, 2], gtb[:, None, None, None, :, 3])
+        iou_all = jnp.where(gt_valid[:, None, None, None, :], iou_all, 0.0)
+        best_iou = iou_all.max(axis=-1)
+        ignore = best_iou > ignore_thresh
+
+        # per-gt best anchor over ALL anchors (shifted-to-origin IoU)
+        a_w = jnp.asarray(an[:, 0]) / input_size
+        a_h = jnp.asarray(an[:, 1]) / input_size
+        inter = jnp.minimum(gtb[:, :, 2:3], a_w[None, None, :]) * \
+            jnp.minimum(gtb[:, :, 3:4], a_h[None, None, :])
+        iou_an = inter / (gtb[:, :, 2:3] * gtb[:, :, 3:4]
+                          + (a_w * a_h)[None, None, :] - inter + 1e-10)
+        best_n = jnp.argmax(iou_an, axis=-1)  # [n, b]
+        mask_arr = np.full(an.shape[0], -1, np.int64)
+        for mi, a_idx in enumerate(mask):
+            mask_arr[a_idx] = mi
+        mask_idx = jnp.asarray(mask_arr)[best_n]  # [n,b]; -1 = unmatched
+        gi = jnp.clip((gtb[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gtb[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+        matched = (mask_idx >= 0) & gt_valid
+        mi_c = jnp.clip(mask_idx, 0, m - 1)
+
+        smooth = min(1.0 / C, 1.0 / 40.0) if use_label_smooth else 0.0
+        pos, neg = 1.0 - smooth, smooth
+
+        nb_idx = (jnp.arange(n)[:, None].repeat(b, 1), mi_c, gj, gi)
+        t_x = gtb[:, :, 0] * w - gi
+        t_y = gtb[:, :, 1] * h - gj
+        anw = jnp.asarray(an[:, 0])[best_n]
+        anh = jnp.asarray(an[:, 1])[best_n]
+        t_w = jnp.log(jnp.maximum(gtb[:, :, 2] * input_size / anw, 1e-9))
+        t_h = jnp.log(jnp.maximum(gtb[:, :, 3] * input_size / anh, 1e-9))
+        box_scale = (2.0 - gtb[:, :, 2] * gtb[:, :, 3]) * gts
+
+        loc = (bce(tx[nb_idx], t_x) + bce(ty[nb_idx], t_y)
+               + jnp.abs(tw[nb_idx] - t_w) + jnp.abs(th[nb_idx] - t_h))
+        loc_loss = jnp.where(matched, loc * box_scale, 0.0).sum(axis=1)
+
+        cls_label = jax.nn.one_hot(gtl.astype(jnp.int32), C) * (pos - neg) \
+            + neg
+        cls_logits = tcls[nb_idx[0], nb_idx[1], :, nb_idx[2], nb_idx[3]]
+        cls = bce(cls_logits, cls_label).sum(-1)
+        cls_loss = jnp.where(matched, cls * gts, 0.0).sum(axis=1)
+
+        # objectness: positive cells get score, ignored cells skipped
+        obj_mask = jnp.zeros((n, m, h, w), jnp.float32)
+        obj_mask = jnp.where(ignore, -1.0, obj_mask)
+        obj_mask = obj_mask.at[nb_idx].set(
+            jnp.where(matched, gts, obj_mask[nb_idx]))
+        obj_pos = jnp.where(obj_mask > 1e-5,
+                            bce(tobj, 1.0) * obj_mask, 0.0)
+        obj_neg = jnp.where((obj_mask <= 1e-5) & (obj_mask > -0.5),
+                            bce(tobj, 0.0), 0.0)
+        obj_loss = (obj_pos + obj_neg).sum(axis=(1, 2, 3))
+
+        total = loc_loss + cls_loss + obj_loss
+        gt_match = jnp.where(matched, mi_c, -1).astype(jnp.int32)
+        return total, obj_mask, gt_match
+
+    args = (x, gt_box, gt_label) + ((gt_score,) if gt_score is not None
+                                    else ())
+    return dispatch(f, *args, nondiff=(1, 2))
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms versions
+# ---------------------------------------------------------------------------
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    """`multiclass_nms2` — multiclass_nms that additionally returns the
+    selected indices (`detection/multiclass_nms_op.cc` REGISTER v2)."""
+    from .ops import multiclass_nms
+
+    out, counts = multiclass_nms(
+        bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+        nms_threshold=nms_threshold, normalized=normalized,
+        nms_eta=nms_eta, background_label=background_label)
+    if not return_index:
+        return out, counts
+
+    # recover indices by matching selected boxes back to the inputs
+    def f(sel, boxes):
+        # sel [N,K,6]; boxes [N,M,4] -> index of first exact box match
+        eq = (jnp.abs(sel[:, :, None, 2:6] - boxes[:, None, :, :])
+              < 1e-5).all(-1)
+        return jnp.argmax(eq, axis=-1).astype(jnp.int64)
+
+    idx = dispatch(f, out, bboxes)
+    return out, counts, idx
+
+
+def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                    nms_top_k=1000, keep_top_k=100, nms_threshold=0.3,
+                    normalized=True, nms_eta=1.0, background_label=0,
+                    return_index=True, name=None):
+    """`multiclass_nms3` — v2 plus per-image RoisNum in/out
+    (`detection/multiclass_nms_op.cc` REGISTER v3)."""
+    res = multiclass_nms2(bboxes, scores, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold=nms_threshold,
+                          normalized=normalized, nms_eta=nms_eta,
+                          background_label=background_label,
+                          return_index=return_index)
+    return res

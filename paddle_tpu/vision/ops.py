@@ -33,6 +33,16 @@ __all__ = [
 ]
 
 
+def __getattr__(name):
+    # detection long-tail ops live in vision.detection but are reachable
+    # through vision.ops for reference API parity (fluid/layers/detection)
+    from . import detection as _det
+
+    if name in _det.__all__:
+        return getattr(_det, name)
+    raise AttributeError(name)
+
+
 # ---------------------------------------------------------------------------
 # IoU / box utilities
 # ---------------------------------------------------------------------------

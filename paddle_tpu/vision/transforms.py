@@ -347,3 +347,46 @@ class RandomRotation(BaseTransform):
             out = np.where(valid[..., None] if arr.ndim == 3 else valid,
                            out, self.fill)
         return _wrap_like(out.astype(arr.dtype), was_t)
+
+
+def read_file(path, name=None):
+    """Raw file bytes as a uint8 tensor (`operators/read_file_op.cc`)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    with open(path, "rb") as f:
+        data = f.read()
+    return Tensor(np.frombuffer(data, np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG bytes -> CHW uint8 image (`operators/decode_jpeg_op.cc`;
+    host-side PIL decode — IO stays on CPU like the reference's
+    nvjpeg-less path)."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from ..core.tensor import Tensor, unwrap
+
+    raw = bytes(np.asarray(unwrap(x)).astype(np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(np.ascontiguousarray(arr))
+
+
+def image_load(path, backend=None):
+    """paddle.vision.image_load equivalent (PIL backend)."""
+    from PIL import Image
+
+    return Image.open(path)

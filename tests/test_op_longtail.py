@@ -1,0 +1,267 @@
+"""Long-tail op coverage (VERDICT #3): detection family, sampled losses,
+sequence ops, norm/vision stragglers — with numeric-gradient checks in the
+reference OpTest style (`tests/unittests/op_test.py:110` finite
+differences).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, ops
+from paddle_tpu.nn import functional as F
+from paddle_tpu.vision import detection as D
+
+t = paddle.to_tensor
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Central finite differences of scalar fn at numpy x."""
+    g = np.zeros_like(x, np.float64)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + eps
+        fp = fn(x)
+        flat[i] = old - eps
+        fm = fn(x)
+        flat[i] = old
+        gf[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+class TestInventory:
+    def test_inventory_floor(self):
+        """Regression gate: implemented count must not drop below the
+        recorded floor (PARITY.md)."""
+        import subprocess
+        import sys
+
+        out = subprocess.run(
+            [sys.executable, "tools/op_inventory.py", "--floor", "379"],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "0 missing" in out.stdout, out.stdout
+
+
+class TestPsroiPrroi:
+    def test_psroi_numeric_grad(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2 * 2 * 2, 6, 6).astype(np.float64)
+        rois = np.array([[0., 0., 4., 4.]], np.float32)
+
+        def run(xv):
+            out = D.psroi_pool(t(xv.astype(np.float32)), t(rois),
+                               t(np.array([1], np.int32)), 2, 1.0, 2, 2)
+            return float(out.sum().numpy())
+
+        xt = t(x.astype(np.float32))
+        xt.stop_gradient = False
+        out = D.psroi_pool(xt, t(rois), t(np.array([1], np.int32)),
+                           2, 1.0, 2, 2)
+        out.sum().backward()
+        analytic = np.asarray(xt.grad.numpy())
+        numeric = numeric_grad(run, x.copy())
+        np.testing.assert_allclose(analytic, numeric, atol=2e-2)
+
+    def test_prroi_exact_on_bilinear_surface(self):
+        """On a plane f(x,y)=ax+by+c the bilinear surface IS the plane,
+        so the precise integral average equals the plane at the bin
+        center — an exactness check no sampling approximation passes."""
+        h = w = 8
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+        plane = (2.0 * xx + 3.0 * yy + 1.0)[None, None]
+        rois = np.array([[1.25, 2.5, 5.25, 6.5]], np.float32)
+        out = D.prroi_pool(t(plane), t(rois), t(np.array([1], np.int32)),
+                           2, 2, 1.0)
+        x1, y1, x2, y2 = rois[0]
+        bw, bh = (x2 - x1) / 2, (y2 - y1) / 2
+        expect = np.zeros((2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                cx = x1 + (j + 0.5) * bw
+                cy = y1 + (i + 0.5) * bh
+                expect[i, j] = 2.0 * cx + 3.0 * cy + 1.0
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, 0], expect,
+                                   rtol=1e-5)
+
+    def test_prroi_grad_flows_to_coords(self):
+        x = t(np.random.RandomState(1).randn(1, 1, 8, 8)
+              .astype(np.float32))
+        rois = t(np.array([[1.0, 1.0, 6.0, 6.0]], np.float32))
+        rois.stop_gradient = False
+        out = D.prroi_pool(x, rois, t(np.array([1], np.int32)), 2, 2)
+        out.sum().backward()
+        assert rois.grad is not None
+        assert np.isfinite(np.asarray(rois.grad.numpy())).all()
+
+
+class TestProposals:
+    def test_generate_proposals_respects_nms(self):
+        """Two identical high-score anchors at the same place -> NMS keeps
+        one; a distant third survives."""
+        H = W = 1
+        A = 3
+        scores = np.array([[[[0.9]], [[0.8]], [[0.7]]]], np.float32)
+        deltas = np.zeros((1, A * 4, H, W), np.float32)
+        anchors = np.array([[[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                              [50, 50, 60, 60]]]], np.float32)
+        var = np.ones((1, 1, A, 4), np.float32)
+        img = np.array([[100., 100.]], np.float32)
+        rois, probs, counts = D.generate_proposals(
+            t(scores), t(deltas), t(img), t(anchors), t(var),
+            pre_nms_top_n=3, post_nms_top_n=3, nms_thresh=0.5,
+            min_size=1.0)
+        assert int(counts.numpy()[0]) == 2
+        p = np.asarray(probs.numpy())[0]
+        np.testing.assert_allclose(p[:2], [0.9, 0.7], rtol=1e-6)
+
+    def test_fpn_distribute_collect_roundtrip(self):
+        rois = np.array([[0, 0, 12, 12], [0, 0, 220, 220],
+                         [0, 0, 500, 500], [3, 3, 30, 30]], np.float32)
+        levels, restore, counts = D.distribute_fpn_proposals(
+            t(rois), 2, 5, 4, 224)
+        assert int(np.asarray(counts.numpy()).sum()) == 4
+        # restore index maps concatenated level rois back to input order
+        concat = np.concatenate([np.asarray(l.numpy()) for l in levels])
+        valid = np.concatenate([
+            np.asarray(l.numpy())[:int(c)]
+            for l, c in zip(levels, np.asarray(counts.numpy()))])
+        rest = np.asarray(restore.numpy())
+        np.testing.assert_allclose(valid[rest], rois, rtol=1e-6)
+
+
+class TestSampledLosses:
+    def test_nce_matches_manual(self):
+        """Fixed sampler seed: recompute the exact nce_op cost formula in
+        numpy and compare."""
+        rng = np.random.RandomState(3)
+        x = rng.randn(4, 5).astype(np.float32)
+        w = rng.randn(8, 5).astype(np.float32)
+        b = rng.randn(8).astype(np.float32)
+        lab = np.array([1, 2, 3, 0])
+        k = 3
+        loss = F.nce(t(x), t(lab), t(w), t(b), num_total_classes=8,
+                     num_neg_samples=k, sampler="uniform", seed=7)
+        # reproduce the host sampling
+        r2 = np.random.RandomState(7)
+        negs = r2.randint(0, 8, size=(4, k))
+        samples = np.concatenate([lab[:, None], negs], axis=1)
+        o = 1 / (1 + np.exp(-(np.einsum("bd,btd->bt", x, w[samples])
+                              + b[samples])))
+        q = (1.0 / 8) * k
+        cost = np.where(np.arange(k + 1)[None, :] < 1,
+                        -np.log(o / (o + q)), -np.log(q / (o + q)))
+        np.testing.assert_allclose(np.asarray(loss.numpy()).ravel(),
+                                   cost.sum(1), rtol=1e-4)
+
+    def test_hsigmoid_grad_and_descent(self):
+        rng = np.random.RandomState(4)
+        x = nn.Parameter(rng.randn(6, 4).astype(np.float32))
+        w = nn.Parameter(rng.randn(5, 4).astype(np.float32))
+        lab = t(np.array([0, 1, 2, 3, 4, 0]))
+        losses = []
+        opt = optimizer.SGD(0.1, parameters=[x, w])
+        for _ in range(20):
+            loss = F.hsigmoid_loss(x, lab, 5, w).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.7
+
+
+class TestSequenceLongtail:
+    def test_sequence_concat_values(self):
+        x1 = t(np.arange(6, dtype=np.float32).reshape(2, 3, 1))
+        x2 = t(np.arange(10, 14, dtype=np.float32).reshape(2, 2, 1))
+        out, lens = ops.sequence.sequence_concat(
+            [x1, x2], [t(np.array([2, 3])), t(np.array([1, 2]))])
+        o = np.asarray(out.numpy())[..., 0]
+        np.testing.assert_allclose(o[0], [0, 1, 10, 0, 0])
+        np.testing.assert_allclose(o[1], [3, 4, 5, 12, 13])
+        np.testing.assert_allclose(np.asarray(lens.numpy()), [3, 5])
+
+    def test_sequence_conv_matches_manual(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(1, 4, 2).astype(np.float32)
+        w = rng.randn(6, 3).astype(np.float32)  # ctx=3 * D=2
+        out = ops.sequence.sequence_conv(
+            t(x), t(np.array([4])), t(w), context_length=3)
+        # manual: context [-1, 0, 1]
+        ctx = np.zeros((4, 6), np.float32)
+        padded = np.concatenate([np.zeros((1, 2)), x[0],
+                                 np.zeros((1, 2))]).astype(np.float32)
+        for i in range(4):
+            ctx[i] = padded[i:i + 3].reshape(-1)
+        np.testing.assert_allclose(np.asarray(out.numpy())[0], ctx @ w,
+                                   rtol=1e-5)
+
+    def test_sequence_slice_and_reshape(self):
+        x = t(np.arange(12, dtype=np.float32).reshape(2, 3, 2))
+        out, lens = ops.sequence.sequence_slice(
+            x, t(np.array([3, 3])), t(np.array([1, 0])),
+            t(np.array([2, 1])))
+        o = np.asarray(out.numpy())
+        np.testing.assert_allclose(o[0, 0], [2, 3])
+        r, rl = ops.sequence.sequence_reshape(x, t(np.array([3, 2])), 3)
+        assert np.asarray(r.numpy()).shape == (2, 2, 3)
+        np.testing.assert_allclose(np.asarray(rl.numpy()), [2, 1])
+
+
+class TestNormVisionTail:
+    def test_max_unpool_roundtrip_positions(self):
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 1, 2] = 5.0
+        x[0, 0, 3, 0] = 7.0
+        out, idx = F.max_pool2d(t(x), 2, 2, return_mask=True)
+        rec = np.asarray(F.max_unpool2d(out, idx, 2, 2).numpy())
+        assert rec[0, 0, 1, 2] == 5.0
+        assert rec[0, 0, 3, 0] == 7.0
+
+    def test_spp_shape(self):
+        x = t(np.random.randn(2, 3, 9, 9).astype(np.float32))
+        out = F.spatial_pyramid_pool(x, 2)
+        assert list(out.shape) == [2, 3 * (1 + 4)]
+
+    def test_weight_norm_preserves_function(self):
+        paddle.seed(0)
+        ly = nn.Linear(4, 3)
+        x = t(np.random.RandomState(6).randn(2, 4).astype(np.float32))
+        before = np.asarray(ly(x).numpy())
+        from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+
+        weight_norm(ly)
+        after = np.asarray(ly(x).numpy())
+        np.testing.assert_allclose(before, after, rtol=1e-5)
+        remove_weight_norm(ly)
+        np.testing.assert_allclose(np.asarray(ly(x).numpy()), before,
+                                   rtol=1e-5)
+
+    def test_spectral_norm_unit_sigma(self):
+        ly = nn.Linear(6, 6)
+        from paddle_tpu.nn.utils import spectral_norm
+
+        spectral_norm(ly, n_power_iterations=30)
+        x = t(np.eye(6, dtype=np.float32))
+        ly(x)
+        w = np.asarray(ly.weight.numpy())
+        s = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(s, 1.0, rtol=1e-2)
+
+    def test_yolov3_loss_grad(self):
+        rng = np.random.RandomState(8)
+        x = t(rng.randn(1, 3 * 7, 4, 4).astype(np.float32) * 0.1)
+        x.stop_gradient = False
+        gtb = t(np.array([[[0.5, 0.5, 0.4, 0.4]]], np.float32))
+        gtl = t(np.array([[1]], np.int32))
+        loss, _, _ = D.yolov3_loss(x, gtb, gtl,
+                                   anchors=[10, 13, 16, 30, 33, 23],
+                                   anchor_mask=[0, 1, 2], class_num=2,
+                                   ignore_thresh=0.7, downsample_ratio=32)
+        loss.sum().backward()
+        g = np.asarray(x.grad.numpy())
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
