@@ -60,6 +60,9 @@ define_flag("amp_dtype", "bfloat16", "autocast compute dtype (TPU: bfloat16)")
 define_flag("allocator_strategy", "pjrt", "memory is managed by PJRT")
 define_flag("log_level", 0, "VLOG-style verbosity")
 define_flag("use_pallas_attention", "auto",
-            "attention kernel policy: auto (seq>=2048), 1 force, 0 off")
+            "attention kernel policy: auto (seq threshold), 1 force, 0 off")
+define_flag("pallas_attention_min_seq", 1024,
+            "sequence length at/above which 'auto' picks the Pallas kernel "
+            "(measured crossover vs XLA on v5e: see BENCH_kernels.json)")
 define_flag("use_pallas_layernorm", False,
             "use the Pallas fused layer_norm kernel instead of XLA fusion")

@@ -203,6 +203,10 @@ class ChunkEvaluator(Metric):
         tag = type*2 (+0=B, +1=I) and any tag >= 2*n_types (conventionally
         2*n_types itself) is Outside, matching chunk_eval_op's plain
         scheme."""
+        # Mirrors the reference's ChunkBegin/ChunkEnd for the IOB scheme
+        # (`chunk_eval_op.h:88-112`): a chunk ends on Outside, on a type
+        # switch, or on a B tag; it begins on B, on a type switch, or on
+        # any non-Outside tag following Outside (stray I starts a chunk).
         tags = [int(t) for t in tags]
         o_floor = 2 * n_types if n_types is not None else None
         chunks = []
@@ -214,12 +218,13 @@ class ChunkEvaluator(Metric):
                 start, ctype = None, None
                 continue
             ty, io = tg // 2, tg % 2
-            if io == 0:  # B
-                if start is not None:
-                    chunks.append((start, i - 1, ctype))
-                start, ctype = i, ty
-            elif start is None or ty != ctype:  # stray I
+            ends = start is not None and (ty != ctype or io == 0)
+            if ends:
+                chunks.append((start, i - 1, ctype))
                 start, ctype = None, None
+            begins = (start is None) or io == 0 or ty != ctype
+            if begins:
+                start, ctype = i, ty
         if start is not None:
             chunks.append((start, len(tags) - 1, ctype))
         return chunks

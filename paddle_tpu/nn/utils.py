@@ -29,7 +29,8 @@ def weight_norm(layer: Layer, name="weight", dim=0):
     `{name}_g` / `{name}_v` params and recomputes `{name}` before every
     forward."""
     w = getattr(layer, name)
-    dim = 0 if dim is None else dim
+    # dim=None means a single whole-tensor norm (reference
+    # weight_norm_hook.py); _norm_except reduces over all axes for None.
     g = Tensor(_norm_except(unwrap(w), dim))
     v = Tensor(unwrap(w))
     g.stop_gradient = False
@@ -49,6 +50,7 @@ def weight_norm(layer: Layer, name="weight", dim=0):
 
     handle = layer.register_forward_pre_hook(hook)
     layer._weight_norm_handle = handle
+    layer._weight_norm_dim = dim
     hook(layer, ())
     return layer
 
@@ -57,11 +59,12 @@ def remove_weight_norm(layer: Layer, name="weight"):
     handle = getattr(layer, "_weight_norm_handle", None)
     if handle is not None:
         handle.remove()
+    dim = getattr(layer, "_weight_norm_dim", 0)
     v = layer._parameters.pop(name + "_v", None)
     g = layer._parameters.pop(name + "_g", None)
     if v is not None and g is not None:
         w = Tensor(unwrap(g) * unwrap(v)
-                   / jnp.maximum(_norm_except(unwrap(v), 0), 1e-12))
+                   / jnp.maximum(_norm_except(unwrap(v), dim), 1e-12))
         w.stop_gradient = False
         layer._parameters[name] = w
         setattr(layer, name, w)

@@ -1,10 +1,15 @@
-"""Flash attention Pallas kernel (TPU).
+"""Flash attention Pallas kernels (TPU), forward and backward.
 
 Replaces the reference's fused inference attention
 (`operators/fused/multihead_matmul_op.cu`) and the composed
-matmul+softmax+matmul training path with a tiled online-softmax kernel that
-keeps the running statistics in VMEM (per /opt/skills/guides/pallas_guide.md).
-Falls back to the XLA composed form when shapes don't fit the tile grid.
+matmul+softmax+matmul training path with tiled online-softmax kernels that
+keep the running statistics in VMEM (per /opt/skills/guides/pallas_guide.md).
+
+The backward pass is a real pair of Pallas kernels (dq and dk/dv tiles,
+recomputing P per tile from the saved logsumexp — no S^2 tensor ever hits
+HBM), matching the memory behaviour the flash-attention algorithm promises.
+Falls back to the XLA composed form when shapes don't tile or a dense mask
+is supplied.
 """
 from __future__ import annotations
 
@@ -16,11 +21,15 @@ import jax.numpy as jnp
 
 try:
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
     _HAS_PALLAS = True
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
+
+# TPU float32 tiling wants the lane (last) dimension to be 128; the per-row
+# softmax statistics are stored broadcast across one lane tile.
+_LANES = 128
 
 
 def _xla_reference(q, k, v, mask, is_causal, scale):
@@ -41,18 +50,28 @@ def _xla_reference(q, k, v, mask, is_causal, scale):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_k, scale,
-                 causal, block_q, q_offset_grid):
-    # grid: (batch*heads, num_q_blocks); process all K blocks in a loop
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
+                scale, causal, block_q):
+    # grid: (batch*heads, num_q_blocks); loop over K blocks in VMEM.
     q = q_ref[...].astype(jnp.float32) * scale  # [block_q, d]
     m = jnp.full((block_q,), -1e30, jnp.float32)
     l = jnp.zeros((block_q,), jnp.float32)
     acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
 
     qi = pl.program_id(1)
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)[:, 0]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)[:, 0]
 
     num_k = seq_k // block_k
+    if causal:
+        # Only K blocks intersecting the lower triangle contribute.
+        num_k = jnp.minimum(num_k,
+                            ((qi + 1) * block_q + block_k - 1) // block_k)
 
     def body(j, carry):
         m, l, acc = carry
@@ -80,55 +99,12 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_k, scale,
 
     m, l, acc = jax.lax.fori_loop(0, num_k, body, (m, l, acc))
     o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-
-
-def flash_attention_fwd(q, k, v, mask=None, is_causal=False, scale=None,
-                        block_q=256, block_k=256):
-    """q,k,v: [B,H,S,D].  Uses the Pallas kernel when mask is None and shapes
-    tile; otherwise the XLA composed reference.  Differentiable: the
-    backward pass recomputes attention in the composed XLA form (the
-    flash-attention recompute strategy — no S^2 tensor is saved)."""
-    if (not _HAS_PALLAS or mask is not None
-            or q.shape[-2] % block_q or k.shape[-2] % block_k
-            or jax.default_backend() != "tpu"):
-        return _xla_reference(q, k, v, mask, is_causal, scale)
-    # Policy (measured on v5e): XLA's fused attention wins at moderate
-    # sequence lengths; the tiled kernel wins once the S^2 logits
-    # intermediate stops fitting comfortably in HBM/VMEM traffic.  Flag
-    # FLAGS_use_pallas_attention: "auto" (default) = kernel at S >= 2048,
-    # "1"/"0" force on/off.
-    from ...core import flags as _flags
-
-    pol = str(_flags.flag("use_pallas_attention"))
-    use = (pol in ("1", "True", "true") or
-           (pol == "auto" and q.shape[-2] >= 2048))
-    if not use:
-        return _xla_reference(q, k, v, mask, is_causal, scale)
-    return _flash_diff(q, k, v, is_causal, scale, block_q, block_k)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_diff(q, k, v, is_causal, scale, block_q, block_k):
-    return _pallas_forward(q, k, v, is_causal, scale, block_q, block_k)
-
-
-def _flash_diff_fwd(q, k, v, is_causal, scale, block_q, block_k):
-    out = _pallas_forward(q, k, v, is_causal, scale, block_q, block_k)
-    return out, (q, k, v)
-
-
-def _flash_diff_bwd(is_causal, scale, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _xla_reference(q_, k_, v_, None, is_causal,
-                                          scale), q, k, v)
-    return vjp(g)
-
-
-_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    lse_ref[...] = jnp.broadcast_to(lse[:, None], (block_q, _LANES))
 
 
 def _pallas_forward(q, k, v, is_causal, scale, block_q, block_k):
+    """Returns (out [B,H,Sq,D], lse [B*H, Sq] fp32)."""
     b, h, sq, d = q.shape
     sk = k.shape[-2]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -138,10 +114,10 @@ def _pallas_forward(q, k, v, is_causal, scale, block_q, block_k):
     vr = v.reshape(b * h, sk, d)
 
     kernel = functools.partial(
-        _attn_kernel, block_k=block_k, seq_k=sk, scale=s, causal=is_causal,
-        block_q=block_q, q_offset_grid=None,
+        _fwd_kernel, block_k=block_k, seq_k=sk, scale=s, causal=is_causal,
+        block_q=block_q,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
         in_specs=[
@@ -149,7 +125,247 @@ def _pallas_forward(q, k, v, is_causal, scale, block_q, block_k):
             pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, _LANES), jnp.float32),
+        ],
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d), lse[:, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+#
+# Standard flash-attention backward split into two kernels so each output
+# tile has a single writer:
+#   dkv kernel: grid over K blocks, loops over Q blocks, accumulates
+#               dV = P^T dO and dK = dS^T (Q*scale)
+#   dq  kernel: grid over Q blocks, loops over K blocks, accumulates
+#               dQ = scale * dS K
+# with P recomputed per tile from the saved logsumexp and
+# dS = P * (dP - delta).  delta = rowsum(dO * O) is computed in-kernel
+# from the saved O (cheap VPU reduce) rather than precomputed — passing O
+# (input dtype, D lanes) costs 1/8 the HBM traffic of a broadcast f32
+# 128-lane delta array.  lse stays in the 128-lane broadcast layout
+# (upstream jax's flash kernel convention); the compact
+# (sq//128, 128)-packed alternative needs a cross-lane reshape in-kernel,
+# which Mosaic fails to lower.
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                    dk_ref, dv_ref, *, block_q, block_k, seq_q, scale,
+                    causal):
+    ki = pl.program_id(1)
+    k_blk = k_ref[...].astype(jnp.float32)          # [block_k, d]
+    v_blk = v_ref[...].astype(jnp.float32)
+    d_model = k_blk.shape[-1]
+    acc_dk = jnp.zeros((block_k, d_model), jnp.float32)
+    acc_dv = jnp.zeros((block_k, d_model), jnp.float32)
+
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_k), 1)[0]
+
+    num_q = seq_q // block_q
+    j0 = (ki * block_k) // block_q if causal else 0
+
+    def body(j, carry):
+        acc_dk, acc_dv = carry
+        q_blk = q_ref[pl.dslice(j * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        do_blk = do_ref[pl.dslice(j * block_q, block_q), :].astype(
+            jnp.float32)
+        o_blk = o_ref[pl.dslice(j * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = lse_ref[pl.dslice(j * block_q, block_q), :][:, 0]
+        delta = jnp.sum(do_blk * o_blk, axis=-1)
+        logits = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            q_pos = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)[:, 0]
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask, logits, -1e30)
+        p = jnp.exp(logits - lse[:, None])           # [block_q, block_k]
+        acc_dv = acc_dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        acc_dk = acc_dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_dk, acc_dv
+
+    acc_dk, acc_dv = jax.lax.fori_loop(j0, num_q, body, (acc_dk, acc_dv))
+    dk_ref[...] = acc_dk.astype(dk_ref.dtype)
+    dv_ref[...] = acc_dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                   dq_ref, *, block_q, block_k, seq_k, scale, causal):
+    qi = pl.program_id(1)
+    q_blk = q_ref[...].astype(jnp.float32) * scale   # [block_q, d]
+    do_blk = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...][:, 0]
+    delta = jnp.sum(do_blk * o_ref[...].astype(jnp.float32), axis=-1)
+    d_model = q_blk.shape[-1]
+    acc_dq = jnp.zeros((block_q, d_model), jnp.float32)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)[:, 0]
+
+    num_k = seq_k // block_k
+    if causal:
+        num_k = jnp.minimum(num_k,
+                            ((qi + 1) * block_q + block_k - 1) // block_k)
+
+    def body(j, acc_dq):
+        k_blk = k_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)[0]
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask, logits, -1e30)
+        p = jnp.exp(logits - lse[:, None])
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        return acc_dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    acc_dq = jax.lax.fori_loop(0, num_k, body, acc_dq)
+    dq_ref[...] = (acc_dq * scale).astype(dq_ref.dtype)
+
+
+def _pallas_backward(q, k, v, out, lse, g, is_causal, scale, block_q,
+                     block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[-2]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    dor = g.reshape(b * h, sq, d)
+    outr = out.reshape(b * h, sq, d)
+    lse_b = jnp.broadcast_to(lse[:, :, None], (b * h, sq, _LANES))
+
+    row_specs = [
+        pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),      # q
+        pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),  # k
+        pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),  # v
+        pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),      # do
+        pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),      # o
+        pl.BlockSpec((None, sq, _LANES), lambda i, j: (i, 0, 0)),  # lse
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                          seq_q=sq, scale=s, causal=is_causal),
+        grid=(b * h, sk // block_k),
+        in_specs=row_specs,
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+    )(qr, kr, vr, dor, outr, lse_b)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                          seq_k=sk, scale=s, causal=is_causal),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda i, j: (i, j, 0)),
+        ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-    )(qr, kr, vr)
-    return out.reshape(b, h, sq, d)
+    )(qr, kr, vr, dor, outr, lse_b)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+# ---------------------------------------------------------------------------
+# Differentiable wrapper + public entry point
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_diff(q, k, v, is_causal, scale, block_q, block_k):
+    out, _ = _pallas_forward(q, k, v, is_causal, scale, block_q, block_k)
+    return out
+
+
+def _flash_diff_fwd(q, k, v, is_causal, scale, block_q, block_k):
+    out, lse = _pallas_forward(q, k, v, is_causal, scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_diff_bwd(is_causal, scale, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    return _pallas_backward(q, k, v, out, lse, g, is_causal, scale,
+                            block_q, block_k)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+def flash_attention_fwd(q, k, v, mask=None, is_causal=False, scale=None,
+                        block_q=256, block_k=256):
+    """q,k,v: [B,H,S,D].  Uses the Pallas kernels when mask is None and shapes
+    tile; otherwise the XLA composed reference.  Fully differentiable with a
+    Pallas backward (dq/dk/dv kernels recomputing P from the saved
+    logsumexp)."""
+    if (not _HAS_PALLAS or mask is not None
+            or q.shape[-2] % block_q or k.shape[-2] % block_k
+            or jax.default_backend() != "tpu"):
+        return _xla_reference(q, k, v, mask, is_causal, scale)
+    # Policy: flag FLAGS_use_pallas_attention: "auto" (default; threshold
+    # from the measured crossover vs XLA's fused attention, see
+    # BENCH_kernels.json), "1"/"0" force on/off.
+    from ...core import flags as _flags
+
+    pol = str(_flags.flag("use_pallas_attention"))
+    use = (pol in ("1", "True", "true") or
+           (pol == "auto" and q.shape[-2] >= _auto_threshold()))
+    if not use:
+        return _xla_reference(q, k, v, mask, is_causal, scale)
+    return _flash_diff(q, k, v, is_causal, scale, block_q, block_k)
+
+
+def _auto_threshold():
+    from ...core import flags as _flags
+
+    try:
+        return int(_flags.flag("pallas_attention_min_seq"))
+    except Exception:
+        return 1024

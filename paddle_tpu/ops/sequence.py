@@ -363,6 +363,8 @@ def sequence_conv(x, lengths, weight, bias=None, context_length=3,
         else context_start
     has_pad = padding_data is not None
     has_bias = bias is not None
+    up_pad = max(0, -start)
+    down_pad = max(0, start + context_length - 1)
 
     def f(xv, lv, w, *rest):
         b, t, d = xv.shape
@@ -370,12 +372,18 @@ def sequence_conv(x, lengths, weight, bias=None, context_length=3,
         pos = jnp.arange(t)[:, None] + offs[None, :]  # [T, ctx]
         valid = (pos >= 0) & (pos < lv[:, None, None].astype(jnp.int32))
         g = xv[:, jnp.clip(pos, 0, t - 1), :]  # [B, T, ctx, D]
-        if has_pad:
-            # trainable boundary rows (reference PaddingData): row j of
-            # padding_data covers context offset j's out-of-range slots
+        if has_pad and up_pad + down_pad > 0:
+            # trainable boundary rows (reference PaddingData,
+            # math/context_project.h:156-199): padding_data is
+            # [up_pad+down_pad, D]; an out-of-range context position p<0
+            # reads up-pad row up_pad+p, p>=seq_len reads down-pad row
+            # up_pad+(p-seq_len)
             pad = rest[0]
-            g = jnp.where(valid[..., None], g,
-                          pad[None, None, :context_length, :])
+            lens = lv[:, None, None].astype(jnp.int32)
+            pad_row = jnp.where(pos[None] < 0, up_pad + pos[None],
+                                up_pad + pos[None] - lens)
+            pad_row = jnp.clip(pad_row, 0, up_pad + down_pad - 1)
+            g = jnp.where(valid[..., None], g, pad[pad_row])
         else:
             g = jnp.where(valid[..., None], g, 0.0)
         flat = g.reshape(b, t, context_length * d)

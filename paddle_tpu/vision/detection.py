@@ -407,16 +407,33 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
                           name=None):
     """Merge per-level ROIs and keep the global top-k by score
     (`detection/collect_fpn_proposals_op.cc`).  Returns
-    (rois [post,4], counts scalar)."""
+    (rois [post,4], counts scalar).  `rois_num_per_level` (list of scalar
+    valid counts, as produced by generate_proposals) distinguishes real
+    proposals from the zero-padded rows of the static-shape layout; without
+    it, rows with score <= 0 are treated as padding."""
+    n_levels = len(multi_rois)
+
     def f(*arrs):
-        k = len(arrs) // 2
-        rois = jnp.concatenate(arrs[:k], axis=0)
-        scores = jnp.concatenate([a.reshape(-1) for a in arrs[k:]], axis=0)
+        rois = jnp.concatenate(arrs[:n_levels], axis=0)
+        scores = jnp.concatenate(
+            [a.reshape(-1) for a in arrs[n_levels:2 * n_levels]], axis=0)
+        if len(arrs) > 2 * n_levels:  # per-level valid counts
+            counts = arrs[2 * n_levels:]
+            valid = jnp.concatenate([
+                jnp.arange(arrs[i].shape[0]) < counts[i]
+                for i in range(n_levels)])
+        else:
+            valid = scores > 0.0
+        scores = jnp.where(valid, scores, -jnp.inf)
         top = min(post_nms_top_n, scores.shape[0])
         sc, idx = jax.lax.top_k(scores, top)
-        return rois[idx], sc, (sc > -jnp.inf).sum().astype(jnp.int32)
+        keep = sc > -jnp.inf
+        return (jnp.where(keep[:, None], rois[idx], 0.0), sc,
+                keep.sum().astype(jnp.int32))
 
-    return dispatch(f, *multi_rois, *multi_scores)
+    extra = tuple(rois_num_per_level) if rois_num_per_level is not None \
+        else ()
+    return dispatch(f, *multi_rois, *multi_scores, *extra)
 
 
 # ---------------------------------------------------------------------------
@@ -753,14 +770,21 @@ def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
     if not return_index:
         return out, counts
 
-    # recover indices by matching selected boxes back to the inputs
-    def f(sel, boxes):
+    # Recover indices by matching selected boxes back to the inputs.
+    # Padded output rows (beyond the valid count) and unmatched rows get
+    # index -1; identical input boxes resolve to the first occurrence
+    # (documented divergence — the reference tracks provenance through its
+    # LoD pipeline).
+    def f(sel, boxes, cnt):
         # sel [N,K,6]; boxes [N,M,4] -> index of first exact box match
         eq = (jnp.abs(sel[:, :, None, 2:6] - boxes[:, None, :, :])
               < 1e-5).all(-1)
-        return jnp.argmax(eq, axis=-1).astype(jnp.int64)
+        idx = jnp.where(eq.any(-1), jnp.argmax(eq, axis=-1), -1)
+        row_valid = (jnp.arange(sel.shape[1])[None, :]
+                     < jnp.atleast_1d(cnt)[:, None])
+        return jnp.where(row_valid, idx, -1).astype(jnp.int64)
 
-    idx = dispatch(f, out, bboxes)
+    idx = dispatch(f, out, bboxes, counts, nondiff=(2,))
     return out, counts, idx
 
 

@@ -200,6 +200,24 @@ class TestSequenceLongtail:
         np.testing.assert_allclose(np.asarray(out.numpy())[0], ctx @ w,
                                    rtol=1e-5)
 
+    def test_sequence_conv_trainable_padding(self):
+        # reference math/context_project.h Case2: ctx_start=-1, ctx_len=3,
+        # padding_data=[[w1,w2],[w3,w4]] (up_pad=1, down_pad=1)
+        x = np.array([[[1., 2], [3, 4], [5, 6], [0, 0]],
+                      [[7., 8], [0, 0], [0, 0], [0, 0]]], np.float32)
+        pad = np.array([[91., 92], [93, 94]], np.float32)
+        w = np.eye(6, dtype=np.float32)  # identity: out == gathered context
+        out = ops.sequence.sequence_conv(
+            t(x), t(np.array([3, 1])), t(w), context_length=3,
+            context_start=-1, padding_data=t(pad))
+        o = np.asarray(out.numpy())
+        np.testing.assert_allclose(
+            o[0, 0], [91, 92, 1, 2, 3, 4])      # w1 w2 a1 a2 b1 b2
+        np.testing.assert_allclose(
+            o[0, 2], [3, 4, 5, 6, 93, 94])      # b1 b2 c1 c2 w3 w4
+        np.testing.assert_allclose(
+            o[1, 0], [91, 92, 7, 8, 93, 94])    # w1 w2 d1 d2 w3 w4
+
     def test_sequence_slice_and_reshape(self):
         x = t(np.arange(12, dtype=np.float32).reshape(2, 3, 2))
         out, lens = ops.sequence.sequence_slice(
