@@ -49,6 +49,9 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, data_format
     )
 
     def f(a, w, *b):
+        # No preferred_element_type here: the TPU MXU accumulates in f32
+        # natively for bf16 operands, and the annotation breaks jax's conv
+        # transpose rule under AMP (bf16 operand x f32 cotangent mismatch).
         out = lax.conv_general_dilated(
             a,
             w,
@@ -57,10 +60,7 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, data_format
             rhs_dilation=dilation,
             dimension_numbers=dn,
             feature_group_count=groups,
-            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None,
         )
-        if out.dtype != a.dtype:
-            out = out.astype(a.dtype)
         if b:
             bias_shape = [1] * out.ndim
             c_axis = 1 if dn_in.startswith("NC") else out.ndim - 1
