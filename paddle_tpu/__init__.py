@@ -9,6 +9,33 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# Multi-controller bootstrap must run before anything touches the XLA
+# backend (jax.distributed.initialize's own requirement), so it happens at
+# package import when the launcher's FULL env is present — mirroring the
+# reference's env-driven trainer identity (PADDLE_TRAINER_ID/...,
+# `fleet/launch_utils.py`).  All three variables are required so a
+# lingering PADDLE_MASTER alone can't stall an unrelated import waiting on
+# peers that will never connect.
+if (_os.environ.get("PADDLE_MASTER") or
+        _os.environ.get("COORDINATOR_ADDRESS")) and \
+        _os.environ.get("PADDLE_TRAINER_ID") is not None and \
+        int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
+    import warnings as _warnings
+
+    import jax as _jax
+
+    try:
+        _jax.distributed.initialize(
+            coordinator_address=(_os.environ.get("PADDLE_MASTER")
+                                 or _os.environ.get("COORDINATOR_ADDRESS")),
+            num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
+            process_id=int(_os.environ["PADDLE_TRAINER_ID"]),
+        )
+    except RuntimeError as _e:  # backend already up / double init
+        _warnings.warn(f"paddle_tpu multi-controller bootstrap skipped: {_e}")
+
 from .core import (CPUPlace, CUDAPlace, Place, Tensor, TPUPlace, XPUPlace,
                    bfloat16, bool_, complex64, complex128, float16, float32,
                    float64, get_default_dtype, get_device, get_flags, int8,

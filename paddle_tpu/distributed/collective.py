@@ -79,6 +79,72 @@ def _in_spmd_trace(arr) -> bool:
     return isinstance(arr, jax.core.Tracer)
 
 
+def _multi_controller() -> bool:
+    return jax.process_count() > 1
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _cross_process_plumbing(devs, ndim):
+    """Cached (input sharding, jitted replicate fn) per (device set, rank)
+    so eager collectives don't recompile every call."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(devs), ("procs",))
+    sharding = NamedSharding(mesh, P("procs", *([None] * ndim)))
+    rep = NamedSharding(mesh, P(*([None] * (ndim + 1))))
+    return sharding, jax.jit(lambda x: x, out_shardings=rep)
+
+
+def _cross_process(arr, kind, op=ReduceOp.SUM, src=0):
+    """Eager collective in multi-controller mode (one process per host as
+    set up by `init_parallel_env`/`jax.distributed.initialize`).
+
+    The per-process value is placed as this process's shard of a global
+    array over a mesh of all devices, and the collective runs as one XLA
+    computation — the TPU-native replacement for the reference's eager
+    NCCL calls (`imperative/all_reduce.cc`).  Ranks are processes; with
+    multiple local devices per process each device carries the process
+    value and the reduction is renormalized.  Returns a host ndarray
+    (replicated result) or, for all_gather, the stacked [nranks, ...]
+    array ordered by rank.
+    """
+    import numpy as np
+
+    arr = np.asarray(arr)
+    nloc = jax.local_device_count()
+    sharding, replicate = _cross_process_plumbing(tuple(jax.devices()),
+                                                  arr.ndim)
+    d = len(jax.devices())
+    local = np.repeat(arr[None], nloc, axis=0)
+    ga = jax.make_array_from_process_local_data(
+        sharding, local, (d,) + arr.shape)
+    gathered = replicate(ga)
+    stacked = np.asarray(gathered.addressable_data(0))  # [d, ...]
+    # one row per process (devices within a process hold copies)
+    per_proc = stacked[::nloc]
+    if kind == "all_gather":
+        return per_proc
+    if kind == "broadcast":
+        return per_proc[src]
+    if kind == "all_reduce":
+        if op == ReduceOp.SUM:
+            return per_proc.sum(0)
+        if op == ReduceOp.MAX:
+            return per_proc.max(0)
+        if op == ReduceOp.MIN:
+            return per_proc.min(0)
+        if op == ReduceOp.AVG:
+            return per_proc.mean(0)
+        if op == ReduceOp.PROD:
+            return per_proc.prod(0)
+        raise ValueError(op)
+    raise ValueError(kind)
+
+
 def _axis_in_scope(name) -> bool:
     try:
         lax.axis_size(name)
@@ -109,6 +175,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         if isinstance(tensor, Tensor):
             tensor.set_value(out._array) if not _in_spmd_trace(out._array) else None
         return out
+    if not _in_spmd_trace(arr) and _multi_controller():
+        res = _cross_process(arr, "all_reduce", op=op)
+        out = Tensor(jnp.asarray(res))
+        if isinstance(tensor, Tensor):
+            tensor.set_value(out._array)
+        return out
     # eager single-controller: replicated value — allreduce is identity
     return tensor
 
@@ -124,6 +196,12 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
             parts = unbind(out, 0)
             tensor_list.extend(parts)
+        return out
+    if not _in_spmd_trace(arr) and _multi_controller():
+        stacked = _cross_process(arr, "all_gather")
+        out = Tensor(jnp.asarray(stacked))
+        if tensor_list is not None:
+            tensor_list.extend(Tensor(jnp.asarray(s)) for s in stacked)
         return out
     if tensor_list is not None:
         tensor_list.append(tensor)
@@ -166,6 +244,12 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
             return g[src]
 
         out = dispatch(f, tensor)
+        return out
+    if not _in_spmd_trace(arr) and _multi_controller():
+        res = _cross_process(arr, "broadcast", src=src)
+        out = Tensor(jnp.asarray(res))
+        if isinstance(tensor, Tensor):
+            tensor.set_value(out._array)
         return out
     return tensor
 
@@ -239,6 +323,9 @@ def irecv(tensor, src=0, group=None):
 
 
 def barrier(group=None):
+    if _multi_controller():
+        _cross_process(jnp.zeros(()), "all_reduce", op=ReduceOp.SUM)
+        return
     (jax.device_put(jnp.zeros(())) + 0).block_until_ready()
 
 

@@ -54,12 +54,28 @@ class ParallelEnv:
 _INITIALIZED = [False]
 
 
+def _jax_distributed_active() -> bool:
+    """Whether jax.distributed.initialize already ran, WITHOUT touching the
+    XLA backend (jax.process_count() would initialize it and make a later
+    explicit initialize() call fail)."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
 def init_parallel_env(coordinator_address=None, num_processes=None,
                       process_id=None):
     """Initialize the distributed runtime.  Single-host: no-op (the
     controller already owns all chips).  Multi-host: wires up the PJRT
     coordination service."""
-    if _INITIALIZED[0]:
+    if _INITIALIZED[0] or _jax_distributed_active():
+        # already wired up (env-driven bootstrap at package import, or an
+        # earlier call) — jax.distributed.initialize may only run once and
+        # only before backend init
+        _INITIALIZED[0] = True
         return ParallelEnv()
     addr = coordinator_address or os.environ.get("PADDLE_MASTER") \
         or os.environ.get("COORDINATOR_ADDRESS")

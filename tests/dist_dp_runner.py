@@ -1,0 +1,78 @@
+"""Data-parallel trainer script run as a real subprocess by
+test_multiprocess_launch.py — the TPU analog of the reference's
+`dist_*.py` runners executed by TestDistBase (`test_dist_base.py:743`).
+
+Each rank: init_parallel_env (jax distributed coordination), train a tiny
+MLP on its shard of a deterministic batch with eager backward + cross-
+process grad allreduce, and write its loss sequence to a pickle.
+"""
+import os
+import pickle
+import sys
+
+# must be set before jax initializes (the launch test passes them via env)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import distributed as dist  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+
+STEPS = 5
+GLOBAL_BATCH = 8
+FEAT = 16
+
+
+def build_model():
+    paddle.seed(42)
+    return nn.Sequential(
+        nn.Linear(FEAT, 32), nn.ReLU(), nn.Linear(32, 1))
+
+
+def batches():
+    rng = np.random.default_rng(7)
+    for _ in range(STEPS):
+        x = rng.standard_normal((GLOBAL_BATCH, FEAT)).astype(np.float32)
+        y = (x.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+        yield x, y
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    out_path = sys.argv[1] + f".rank{rank}"
+
+    model = build_model()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    loss_fn = nn.MSELoss()
+
+    losses = []
+    shard = GLOBAL_BATCH // world
+    for x, y in batches():
+        xs = paddle.to_tensor(x[rank * shard:(rank + 1) * shard])
+        ys = paddle.to_tensor(y[rank * shard:(rank + 1) * shard])
+        loss = loss_fn(model(xs), ys)
+        opt.clear_grad()
+        loss.backward()
+        # DP grad sync: average gradients across ranks (reference Reducer)
+        for p in model.parameters():
+            if p.grad is not None:
+                dist.all_reduce(p.grad, op=dist.ReduceOp.AVG)
+        opt.step()
+        # the *global* loss is the mean over ranks of the local loss
+        gl = dist.all_reduce(loss.detach(), op=dist.ReduceOp.AVG)
+        losses.append(float(np.asarray(gl.numpy())))
+
+    with open(out_path, "wb") as f:
+        pickle.dump({"rank": rank, "world": world, "losses": losses}, f)
+
+
+if __name__ == "__main__":
+    main()
