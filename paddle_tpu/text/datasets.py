@@ -141,18 +141,27 @@ class Movielens(Dataset):
     """Rating prediction: (user feats..., movie feats..., score).
     Reference `text/datasets/movielens.py` (ml-1m archive)."""
 
+    AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
     def __init__(self, data_file: Optional[str] = None, mode="train",
                  test_ratio=0.1, rand_seed=0, num_samples=2048,
                  num_users=500, num_movies=300):
         if data_file is not None:
-            raise NotImplementedError(
-                f"{type(self).__name__}: archive loading is not implemented;"
-                " omit data_file for the deterministic synthetic corpus")
+            self._load_archive(data_file, mode.lower(), test_ratio,
+                               rand_seed)
+            return
         r = _rng("movielens", mode)
         self.num_users = num_users
         self.num_movies = num_movies
         n = num_samples
         self.samples = []
+        # same schema as the archive path (and the reference): shape-(1,)
+        # scalar features and score = rating*2-5.  Ratings come from a
+        # latent-factor model so the corpus is learnable (real preference
+        # data has structure; pure noise would make the book recommender
+        # example meaningless).
+        u_lat = r.randn(num_users, 4)
+        m_lat = r.randn(num_movies, 4)
         for _ in range(n):
             user_id = r.randint(0, num_users)
             gender = r.randint(0, 2)
@@ -161,12 +170,75 @@ class Movielens(Dataset):
             movie_id = r.randint(0, num_movies)
             categories = r.randint(0, 2, (18,)).astype(np.int64)
             title = r.randint(0, 5000, (8,)).astype(np.int64)
-            score = r.randint(1, 6)
+            affinity = float(u_lat[user_id] @ m_lat[movie_id])
+            rating = int(np.clip(round(3 + affinity), 1, 5))
+            score = float(rating) * 2 - 5.0
             self.samples.append((
-                np.asarray(user_id, np.int64), np.asarray(gender, np.int64),
-                np.asarray(age, np.int64), np.asarray(job, np.int64),
-                np.asarray(movie_id, np.int64), categories, title,
-                np.asarray(score, np.float32)))
+                np.array([user_id], np.int64), np.array([gender], np.int64),
+                np.array([age], np.int64), np.array([job], np.int64),
+                np.array([movie_id], np.int64), categories, title,
+                np.array([score], np.float32)))
+
+    def _load_archive(self, data_file, mode, test_ratio, rand_seed):
+        """Parse the real ml-1m zip (reference
+        `text/datasets/movielens.py:157-213`): movies.dat / users.dat
+        metadata then ratings.dat rows split train/test by rand_seed, each
+        sample = ([uid],[gender],[age idx],[job]) + ([mov id],[category
+        ids],[title word ids]) + [[rating*2-5]]."""
+        import re
+        import zipfile
+
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        movie_info, user_info = {}, {}
+        # ids assigned in file-encounter order: deterministic across
+        # processes/runs (hash-seeded set iteration would give each DP rank
+        # a different vocabulary)
+        self.movie_title_dict = {}
+        self.categories_dict = {}
+        with zipfile.ZipFile(data_file) as pkg:
+            with pkg.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode("latin1").strip() \
+                        .split("::")
+                    cats = cats.split("|")
+                    for c in cats:
+                        self.categories_dict.setdefault(
+                            c, len(self.categories_dict))
+                    m = pattern.match(title)
+                    title = m.group(1) if m else title
+                    movie_info[int(mid)] = (int(mid), cats, title)
+                    for w in title.split():
+                        self.movie_title_dict.setdefault(
+                            w.lower(), len(self.movie_title_dict))
+            with pkg.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = line.decode("latin1") \
+                        .strip().split("::")
+                    user_info[int(uid)] = (
+                        int(uid), 0 if gender == "M" else 1,
+                        self.AGE_TABLE.index(int(age)), int(job))
+            rnd = np.random.RandomState(rand_seed)
+            is_test = mode == "test"
+            self.samples = []
+            with pkg.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (rnd.random_sample() < test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = line.decode("latin1").strip() \
+                        .split("::")
+                    u = user_info[int(uid)]
+                    mid_i, cats, title = movie_info[int(mid)]
+                    self.samples.append((
+                        np.array([u[0]], np.int64),
+                        np.array([u[1]], np.int64),
+                        np.array([u[2]], np.int64),
+                        np.array([u[3]], np.int64),
+                        np.array([mid_i], np.int64),
+                        np.array([self.categories_dict[c] for c in cats],
+                                 np.int64),
+                        np.array([self.movie_title_dict[w.lower()]
+                                  for w in title.split()], np.int64),
+                        np.array([float(rating) * 2 - 5.0], np.float32)))
 
     def __len__(self):
         return len(self.samples)
@@ -239,13 +311,9 @@ class _WMTBase(Dataset):
     """(source ids, target ids, target-next ids) translation triples."""
 
     BOS, EOS, UNK = 0, 1, 2
+    START_MARK, END_MARK, UNK_MARK = "<s>", "<e>", "<unk>"
 
-    def __init__(self, name, mode, dict_size, num_samples, seq_len,
-                 data_file=None):
-        if data_file is not None:
-            raise NotImplementedError(
-                f"{type(self).__name__}: archive loading is not implemented;"
-                " omit data_file for the deterministic synthetic corpus")
+    def __init__(self, name, mode, dict_size, num_samples, seq_len):
         r = _rng(name, mode)
         dict_size = max(dict_size, 16)
         self.src_dict = {f"s{i}": i for i in range(dict_size)}
@@ -272,8 +340,55 @@ class WMT14(_WMTBase):
 
     def __init__(self, data_file: Optional[str] = None, mode="train",
                  dict_size=1000, num_samples=512, seq_len=20):
-        super().__init__("wmt14", mode, dict_size, num_samples, seq_len,
-                         data_file=data_file)
+        if data_file is not None:
+            self._load_archive(data_file, mode.lower(), dict_size)
+            return
+        super().__init__("wmt14", mode, dict_size, num_samples, seq_len)
+
+    def _load_archive(self, data_file, mode, dict_size):
+        """Parse the real wmt14 tgz (reference `wmt14.py:108-161`):
+        *src.dict / *trg.dict members give the vocabularies (first
+        `dict_size` lines), the `{mode}/{mode}` member holds tab-separated
+        src/trg sentence pairs; pairs longer than 80 tokens are dropped."""
+        import tarfile
+
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if i >= size:
+                    break
+                out[line.decode("utf-8").strip()] = i
+            return out
+
+        with tarfile.open(data_file, mode="r") as f:
+            members = f.getmembers()
+            src_name = [m for m in members if m.name.endswith("src.dict")]
+            trg_name = [m for m in members if m.name.endswith("trg.dict")]
+            assert len(src_name) == 1 and len(trg_name) == 1
+            self.src_dict = to_dict(f.extractfile(src_name[0]), dict_size)
+            self.trg_dict = to_dict(f.extractfile(trg_name[0]), dict_size)
+            suffix = "{}/{}".format(mode, mode)
+            self.samples = []
+            for m in members:
+                if not m.name.endswith(suffix):
+                    continue
+                for line in f.extractfile(m):
+                    parts = line.decode("utf-8").strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, self.UNK) for w in
+                           [self.START_MARK] + parts[0].split()
+                           + [self.END_MARK]]
+                    trg = [self.trg_dict.get(w, self.UNK)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    trg_next = trg + [self.trg_dict[self.END_MARK]]
+                    trg = [self.trg_dict[self.START_MARK]] + trg
+                    self.samples.append(
+                        (np.asarray(src, np.int64),
+                         np.asarray(trg, np.int64),
+                         np.asarray(trg_next, np.int64)))
 
 
 class WMT16(_WMTBase):
@@ -282,6 +397,57 @@ class WMT16(_WMTBase):
     def __init__(self, data_file: Optional[str] = None, mode="train",
                  src_lang_dict_size=1000, trg_lang_dict_size=1000,
                  lang="en", num_samples=512, seq_len=20):
+        if data_file is not None:
+            self._load_archive(data_file, mode.lower(), lang,
+                               src_lang_dict_size, trg_lang_dict_size)
+            return
         super().__init__("wmt16", mode,
                          max(src_lang_dict_size, trg_lang_dict_size),
-                         num_samples, seq_len, data_file=data_file)
+                         num_samples, seq_len)
+
+    def _load_archive(self, data_file, mode, lang, src_size, trg_size):
+        """Parse the real wmt16 tgz (reference `wmt16.py:159-215`): vocab
+        built from the `wmt16/train` member per language (marks first, then
+        words by frequency), data from `wmt16/{mode}` tab-separated en/de
+        pairs with `lang` choosing the source column."""
+        import tarfile
+        from collections import defaultdict
+
+        src_col = 0 if lang == "en" else 1
+        with tarfile.open(data_file, mode="r") as f:
+            # one pass over wmt16/train fills BOTH frequency tables (a
+            # gzip tar re-decompresses the member on every extractfile)
+            freq = (defaultdict(int), defaultdict(int))
+            for line in f.extractfile("wmt16/train"):
+                parts = line.decode("utf-8").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for col in (0, 1):
+                    for w in parts[col].split():
+                        freq[col][w] += 1
+
+            def to_dict(col, size):
+                words = [self.START_MARK, self.END_MARK, self.UNK_MARK]
+                words += [w for w, _ in sorted(freq[col].items(),
+                                               key=lambda x: x[1],
+                                               reverse=True)
+                          ][:max(0, size - 3)]
+                return {w: i for i, w in enumerate(words)}
+
+            self.src_dict = to_dict(src_col, src_size)
+            self.trg_dict = to_dict(1 - src_col, trg_size)
+            self.samples = []
+            for line in f.extractfile("wmt16/{}".format(mode)):
+                parts = line.decode("utf-8").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [self.BOS] + [self.src_dict.get(w, self.UNK)
+                                    for w in parts[src_col].split()] \
+                    + [self.EOS]
+                trg = [self.trg_dict.get(w, self.UNK)
+                       for w in parts[1 - src_col].split()]
+                trg_next = trg + [self.EOS]
+                trg = [self.BOS] + trg
+                self.samples.append((np.asarray(src, np.int64),
+                                     np.asarray(trg, np.int64),
+                                     np.asarray(trg_next, np.int64)))

@@ -160,30 +160,106 @@ class Flowers(Dataset):
     archive directory of .npy images, else a deterministic synthetic corpus
     with the reference's (image, label) schema."""
 
+    # the reference trains on the LARGE split (tstid) and tests on trnid
+    # (`vision/datasets/flowers.py` MODE_FLAG_MAP)
+    MODE_FLAG_MAP = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, num_samples=128,
                  image_size=(3, 32, 32)):
         from ..utils import stable_rng
 
-        if data_file is not None or label_file is not None or \
-                setid_file is not None:
-            raise NotImplementedError(
-                "Flowers: archive loading is not implemented in the "
-                "zero-egress build; omit data/label/setid files for "
-                "synthetic data")
         self.transform = transform
+        self._archive = None
+        if data_file is not None:
+            if label_file is None or setid_file is None:
+                raise ValueError(
+                    "Flowers: data_file requires label_file "
+                    "(imagelabels.mat) and setid_file (setid.mat) too")
+            import scipy.io as scio
+
+            self._archive = _TarImages(data_file)
+            self.labels = scio.loadmat(label_file)["labels"][0]
+            self.indexes = scio.loadmat(setid_file)[
+                self.MODE_FLAG_MAP[mode.lower()]][0]
+            return
+        if label_file is not None or setid_file is not None:
+            raise ValueError(
+                "Flowers: label_file/setid_file given without data_file")
         r = stable_rng("flowers", mode)
         self.images = r.rand(num_samples, *image_size).astype(np.float32)
-        self.labels = r.randint(0, 102, (num_samples,)).astype(np.int64)
+        # same schema as the archive path: 1-based labels, shape (1,)
+        # (the reference's imagelabels.mat is 1-based)
+        self.labels = r.randint(1, 103, (num_samples,)).astype(np.int64)
 
     def __getitem__(self, idx):
+        if self._archive is not None:
+            index = int(self.indexes[idx])
+            img = self._archive.read_image("jpg/image_%05d.jpg" % index)
+            label = np.array([self.labels[index - 1]]).astype(np.int64)
+            if self.transform is not None:
+                img = self.transform(img)
+            return img, label
         img = self.images[idx]
         if self.transform is not None:
             img = self.transform(img)
-        return img, self.labels[idx]
+        return img, np.array([self.labels[idx]]).astype(np.int64)
 
     def __len__(self):
+        if self._archive is not None:
+            return len(self.indexes)
         return len(self.images)
+
+
+class _TarImages:
+    """Member-indexed tar archive with PIL image decode (reference
+    pattern: `vision/datasets/voc2012.py:120` name2mem + extractfile).
+    The handle is opened lazily and dropped on pickling so archive-backed
+    datasets survive the spawn-based multiprocess DataLoader (each worker
+    re-opens its own handle)."""
+
+    def __init__(self, path):
+        self.path = path
+        self._tar = None
+        self._name2mem = None
+        self._members()  # validate the archive eagerly
+
+    def _members(self):
+        import tarfile
+
+        if self._tar is None:
+            self._tar = tarfile.open(self.path)
+            self._name2mem = {m.name: m for m in self._tar.getmembers()}
+        return self._tar, self._name2mem
+
+    def __getstate__(self):
+        return {"path": self.path}
+
+    def __setstate__(self, state):
+        self.path = state["path"]
+        self._tar = None
+        self._name2mem = None
+
+    def close(self):
+        if self._tar is not None:
+            self._tar.close()
+            self._tar = None
+            self._name2mem = None
+
+    def read_text_lines(self, name):
+        tar, n2m = self._members()
+        data = tar.extractfile(n2m[name]).read()
+        return [ln.strip() for ln in data.decode("utf-8").splitlines()
+                if ln.strip()]
+
+    def read_image(self, name):
+        import io as _io
+
+        from PIL import Image
+
+        tar, n2m = self._members()
+        raw = tar.extractfile(n2m[name]).read()
+        return np.array(Image.open(_io.BytesIO(raw)))
 
 
 class VOC2012(Dataset):
@@ -191,25 +267,42 @@ class VOC2012(Dataset):
     `python/paddle/vision/datasets/voc2012.py`): (image, label-mask) pairs.
     Synthetic fallback preserves the schema (HxW class-index mask)."""
 
+    SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+    MODE_FLAG_MAP = {"train": "train", "test": "train", "valid": "val"}
+
     def __init__(self, data_file=None, mode="train", transform=None,
                  num_samples=32, image_size=(3, 32, 32), num_classes=21):
         from ..utils import stable_rng
 
-        if data_file is not None:
-            raise NotImplementedError(
-                "VOC2012: archive loading is not implemented in the "
-                "zero-egress build; omit data_file for synthetic data")
         self.transform = transform
+        self._archive = None
+        if data_file is not None:
+            self._archive = _TarImages(data_file)
+            flag = self.MODE_FLAG_MAP[mode.lower()]
+            names = self._archive.read_text_lines(self.SET_FILE.format(flag))
+            self.data = [self.DATA_FILE.format(n) for n in names]
+            self.labels = [self.LABEL_FILE.format(n) for n in names]
+            return
         r = stable_rng("voc2012", mode)
         self.images = r.rand(num_samples, *image_size).astype(np.float32)
         self.masks = r.randint(0, num_classes,
                                (num_samples,) + image_size[1:]).astype(np.int64)
 
     def __getitem__(self, idx):
+        if self._archive is not None:
+            img = self._archive.read_image(self.data[idx])
+            mask = self._archive.read_image(self.labels[idx]).astype(np.int64)
+            if self.transform is not None:
+                img = self.transform(img)
+            return img, mask
         img = self.images[idx]
         if self.transform is not None:
             img = self.transform(img)
         return img, self.masks[idx]
 
     def __len__(self):
+        if self._archive is not None:
+            return len(self.data)
         return len(self.images)

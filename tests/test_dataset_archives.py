@@ -1,0 +1,213 @@
+"""Real archive-format loaders: build miniature archives in the exact
+reference layouts (102flowers.tgz + .mat labels, VOC tar, ml-1m zip,
+wmt14/wmt16 tgz) and check field semantics against the reference parsers
+(`python/paddle/vision/datasets/flowers.py`, `voc2012.py`,
+`text/datasets/movielens.py`, `wmt14.py`, `wmt16.py`)."""
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text.datasets import WMT14, WMT16, Movielens
+from paddle_tpu.vision.datasets import VOC2012, Flowers
+
+
+def _jpg_bytes(arr):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _png_bytes(arr):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _add_bytes(tar, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tar.addfile(info, io.BytesIO(data))
+
+
+class TestFlowers:
+    def test_archive_roundtrip(self, tmp_path):
+        import scipy.io as scio
+
+        rng = np.random.RandomState(0)
+        n = 6
+        data_file = str(tmp_path / "102flowers.tgz")
+        with tarfile.open(data_file, "w:gz") as tar:
+            for i in range(1, n + 1):
+                img = rng.randint(0, 255, (8, 8, 3), np.uint8)
+                _add_bytes(tar, "jpg/image_%05d.jpg" % i, _jpg_bytes(img))
+        label_file = str(tmp_path / "imagelabels.mat")
+        labels = rng.randint(1, 103, (1, n))
+        scio.savemat(label_file, {"labels": labels})
+        setid_file = str(tmp_path / "setid.mat")
+        scio.savemat(setid_file, {"tstid": [[1, 3, 5]], "trnid": [[2, 4]],
+                                  "valid": [[6]]})
+
+        train = Flowers(data_file=data_file, label_file=label_file,
+                        setid_file=setid_file, mode="train")
+        assert len(train) == 3  # reference: train reads tstid
+        img, label = train[0]
+        assert img.shape == (8, 8, 3) and img.dtype == np.uint8
+        assert label.shape == (1,) and label[0] == labels[0, 0]  # 1-based
+
+        test = Flowers(data_file=data_file, label_file=label_file,
+                       setid_file=setid_file, mode="test")
+        assert len(test) == 2
+        _, tl = test[1]
+        assert tl[0] == labels[0, 3]  # trnid index 4 -> labels[3]
+
+    def test_requires_mat_files(self, tmp_path):
+        with pytest.raises(ValueError):
+            Flowers(data_file=str(tmp_path / "x.tgz"))
+
+
+class TestVOC2012:
+    def test_archive_roundtrip(self, tmp_path):
+        rng = np.random.RandomState(1)
+        data_file = str(tmp_path / "voc.tar")
+        names = ["2007_000032", "2007_000033", "2007_000042"]
+        masks = {}
+        with tarfile.open(data_file, "w") as tar:
+            _add_bytes(tar,
+                       "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+                       ("\n".join(names[:2]) + "\n").encode())
+            _add_bytes(tar,
+                       "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+                       (names[2] + "\n").encode())
+            for nm in names:
+                img = rng.randint(0, 255, (6, 6, 3), np.uint8)
+                mask = rng.randint(0, 21, (6, 6)).astype(np.uint8)
+                masks[nm] = mask
+                _add_bytes(tar, f"VOCdevkit/VOC2012/JPEGImages/{nm}.jpg",
+                           _jpg_bytes(img))
+                _add_bytes(tar,
+                           f"VOCdevkit/VOC2012/SegmentationClass/{nm}.png",
+                           _png_bytes(mask))
+
+        train = VOC2012(data_file=data_file, mode="train")
+        assert len(train) == 2
+        img, mask = train[1]
+        assert img.shape == (6, 6, 3)
+        np.testing.assert_array_equal(mask, masks[names[1]])  # png lossless
+
+        val = VOC2012(data_file=data_file, mode="valid")
+        assert len(val) == 1
+        np.testing.assert_array_equal(val[0][1], masks[names[2]])
+
+    def test_picklable_for_worker_spawn(self, tmp_path):
+        # multiprocess DataLoader pickles the dataset into spawn workers;
+        # the tar handle must drop and lazily re-open
+        import pickle
+
+        rng = np.random.RandomState(2)
+        data_file = str(tmp_path / "voc.tar")
+        with tarfile.open(data_file, "w") as tar:
+            _add_bytes(tar,
+                       "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+                       b"a\n")
+            _add_bytes(tar, "VOCdevkit/VOC2012/JPEGImages/a.jpg",
+                       _jpg_bytes(rng.randint(0, 255, (4, 4, 3), np.uint8)))
+            mask = rng.randint(0, 21, (4, 4)).astype(np.uint8)
+            _add_bytes(tar, "VOCdevkit/VOC2012/SegmentationClass/a.png",
+                       _png_bytes(mask))
+        ds = VOC2012(data_file=data_file, mode="train")
+        _ = ds[0]  # open the handle
+        clone = pickle.loads(pickle.dumps(ds))
+        np.testing.assert_array_equal(clone[0][1], mask)
+
+
+class TestMovielens:
+    def _make_zip(self, tmp_path):
+        path = str(tmp_path / "ml-1m.zip")
+        movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+                  "2::Heat (1995)::Action|Crime\n")
+        users = ("1::M::25::15::55117\n"
+                 "2::F::35::7::02460\n")
+        ratings = ("1::1::5::978300760\n"
+                   "2::2::3::978302109\n"
+                   "1::2::4::978301968\n")
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("ml-1m/movies.dat", movies)
+            z.writestr("ml-1m/users.dat", users)
+            z.writestr("ml-1m/ratings.dat", ratings)
+        return path
+
+    def test_fields(self, tmp_path):
+        ds = Movielens(data_file=self._make_zip(tmp_path), mode="train",
+                       test_ratio=0.0)  # all rows -> train
+        assert len(ds) == 3
+        uid, gender, age, job, mid, cats, title, rating = ds[0]
+        assert uid[0] == 1 and gender[0] == 0      # M -> 0
+        assert age[0] == 2                          # AGE_TABLE.index(25)
+        assert job[0] == 15 and mid[0] == 1
+        assert cats.shape == (2,) and title.shape == (2,)  # "Toy Story"
+        assert rating[0] == 5 * 2 - 5.0             # rating*2-5
+        # row 2: F -> 1, age 35 -> idx 3
+        assert ds[1][1][0] == 1 and ds[1][2][0] == 3
+
+    def test_split(self, tmp_path):
+        path = self._make_zip(tmp_path)
+        tr = Movielens(data_file=path, mode="train", test_ratio=0.5,
+                       rand_seed=3)
+        te = Movielens(data_file=path, mode="test", test_ratio=0.5,
+                       rand_seed=3)
+        assert len(tr) + len(te) == 3
+
+
+class TestWMT:
+    def test_wmt14_archive(self, tmp_path):
+        path = str(tmp_path / "wmt14.tgz")
+        src_dict = "<s>\n<e>\n<unk>\nhello\nworld\n"
+        trg_dict = "<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+        pairs = "hello world\tbonjour monde\nhello\tbonjour\n"
+        with tarfile.open(path, "w:gz") as tar:
+            _add_bytes(tar, "wmt14/src.dict", src_dict.encode())
+            _add_bytes(tar, "wmt14/trg.dict", trg_dict.encode())
+            _add_bytes(tar, "wmt14/train/train", pairs.encode())
+            _add_bytes(tar, "wmt14/test/test", "hello\tmonde\n".encode())
+
+        ds = WMT14(data_file=path, mode="train", dict_size=5)
+        assert len(ds) == 2
+        src, trg, trg_next = ds[0]
+        # <s> hello world <e> / <s> bonjour monde / bonjour monde <e>
+        np.testing.assert_array_equal(src, [0, 3, 4, 1])
+        np.testing.assert_array_equal(trg, [0, 3, 4])
+        np.testing.assert_array_equal(trg_next, [3, 4, 1])
+
+        te = WMT14(data_file=path, mode="test", dict_size=5)
+        assert len(te) == 1
+        np.testing.assert_array_equal(te[0][1], [0, 4])  # monde
+
+    def test_wmt16_archive(self, tmp_path):
+        path = str(tmp_path / "wmt16.tgz")
+        train = "a b b\tx y\nb\ty\n"
+        with tarfile.open(path, "w:gz") as tar:
+            _add_bytes(tar, "wmt16/train", train.encode())
+            _add_bytes(tar, "wmt16/val", "a\tx\n".encode())
+
+        ds = WMT16(data_file=path, mode="train", src_lang_dict_size=5,
+                   trg_lang_dict_size=5, lang="en")
+        # dicts: marks + freq-sorted words; en: b(3) a(1); de: y(2) x(1)
+        assert ds.src_dict == {"<s>": 0, "<e>": 1, "<unk>": 2, "b": 3,
+                               "a": 4}
+        assert ds.trg_dict["y"] == 3 and ds.trg_dict["x"] == 4
+        src, trg, trg_next = ds[0]
+        np.testing.assert_array_equal(src, [0, 4, 3, 3, 1])  # <s> a b b <e>
+        np.testing.assert_array_equal(trg, [0, 4, 3])        # <s> x y
+        np.testing.assert_array_equal(trg_next, [4, 3, 1])
+
+        val = WMT16(data_file=path, mode="val", lang="en",
+                    src_lang_dict_size=5, trg_lang_dict_size=5)
+        assert len(val) == 1
