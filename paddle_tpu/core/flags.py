@@ -52,7 +52,9 @@ def flag(name: str):
 
 # Core flags (subset of reference's platform/flags.cc that is meaningful on
 # TPU; CUDA/cudnn-specific knobs are intentionally absent).
-define_flag("check_nan_inf", False, "check every op output for NaN/Inf")
+define_flag("check_nan_inf", False,
+            "check every op output for NaN/Inf (debug only: forces a host "
+            "sync per op, serializing the device)")
 define_flag("benchmark", False, "sync + log after every eager op")
 define_flag("deterministic", False, "force deterministic reductions")
 define_flag("eager_jit_ops", True, "allow per-op jit caching in eager mode")

@@ -58,7 +58,7 @@ class Config:
         self._device_id = 0
         self._precision = PrecisionType.Float32
         self._ir_optim = True
-        self._memory_optim = True
+        self._memory_optim = False  # opt-in, as in the reference AnalysisConfig
         self._cpu_math_threads = 1
 
     # -- model path ---------------------------------------------------------
@@ -89,11 +89,15 @@ class Config:
     def use_gpu(self):
         return self._device == "gpu"
 
-    # -- switches (accepted; XLA makes them moot) ---------------------------
+    # -- switches -----------------------------------------------------------
     def switch_ir_optim(self, x=True):
+        # off = interpret the program op-by-op without the whole-graph XLA
+        # compile (reference: skip OptimizeInferenceProgram)
         self._ir_optim = bool(x)
 
     def enable_memory_optim(self, x=True):
+        # donate feed buffers to the executable so outputs can alias them
+        # (reference: memory_optimize_pass buffer reuse)
         self._memory_optim = bool(x)
 
     def set_cpu_math_library_num_threads(self, n):
@@ -190,7 +194,8 @@ class Predictor:
 
             program, feeds, fetches = load_inference_model(prefix)
             self._runner = ProgramRunner(
-                program, getattr(program, "_param_scope", {}) or {})
+                program, getattr(program, "_param_scope", {}) or {},
+                jit=config._ir_optim, donate_feeds=config._memory_optim)
             self._layer = None
             self._input_names = list(self._runner.feed_names)
             self._output_names = [f"output_{i}"

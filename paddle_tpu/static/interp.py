@@ -121,13 +121,18 @@ class ProgramRunner:
     at trace time).  Shared by `static.Executor` and the inference
     Predictor."""
 
-    def __init__(self, program, scope: Dict[str, Any]):
+    def __init__(self, program, scope: Dict[str, Any], jit: bool = True,
+                 donate_feeds: bool = False):
+        """``jit=False`` interprets the block op-by-op without the
+        whole-graph XLA compile (Config.switch_ir_optim(False) semantics —
+        the reference's un-optimized NaiveExecutor loop);
+        ``donate_feeds=True`` donates the feed buffers to the executable so
+        outputs may alias them (Config.enable_memory_optim)."""
         self.program = program
         self.params = {k: jnp.asarray(v) for k, v in scope.items()}
         self.feed_names = program.feed_target_names()
         self.fetch_names = program.fetch_target_names()
         ops = program.desc["blocks"][0]["ops"]
-        extra_holder: Dict[str, Any] = {}
 
         def pure(params, feeds):
             s = Scope(params)
@@ -138,8 +143,16 @@ class ProgramRunner:
             # fetch-op targets
             return tuple(fetches[k] for k in sorted(fetches)), dict(s)
 
-        self._jit = jax.jit(pure)
-        self._extra = extra_holder
+        if jit:
+            self._jit = jax.jit(
+                pure, donate_argnums=(1,) if donate_feeds else ())
+        else:
+            if donate_feeds:
+                import warnings
+
+                warnings.warn("donate_feeds requires the jit-compiled "
+                              "runner; ignored with jit=False")
+            self._jit = pure
 
     def __call__(self, *inputs):
         feeds = dict(zip(self.feed_names, (jnp.asarray(i) for i in inputs)))

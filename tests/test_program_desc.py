@@ -252,6 +252,35 @@ class TestSaveLoadInferenceModel:
         eager = np.asarray(model(paddle.to_tensor(x)).numpy())
         np.testing.assert_allclose(outs[0], eager, rtol=1e-4, atol=1e-6)
 
+    def test_predictor_config_toggles(self, tmp_path):
+        # switch_ir_optim(False) -> op-by-op interpretation;
+        # enable_memory_optim  -> donated feed buffers; outputs identical
+        model = self._model()
+        model.eval()
+        spec = static.InputSpec([None, 1, 8, 8], "float32", "image")
+        prefix = str(tmp_path / "m4")
+        static.save_inference_model(prefix, layer=model, input_spec=[spec])
+        from paddle_tpu import inference
+
+        x = np.random.RandomState(5).randn(2, 1, 8, 8).astype(np.float32)
+        base = inference.create_predictor(
+            inference.Config(prefix + ".pdmodel")).run([x])[0]
+
+        cfg = inference.Config(prefix + ".pdmodel")
+        cfg.switch_ir_optim(False)
+        no_ir = inference.create_predictor(cfg).run([x])[0]
+        np.testing.assert_allclose(no_ir, base, rtol=1e-5, atol=1e-6)
+
+        cfg2 = inference.Config(prefix + ".pdmodel")
+        cfg2.enable_memory_optim(True)
+        pred2 = inference.create_predictor(cfg2)
+        np.testing.assert_allclose(pred2.run([x])[0], base, rtol=1e-5,
+                                   atol=1e-6)
+        # donated feeds: running twice must still work (fresh device
+        # buffers are created from the numpy inputs each run)
+        np.testing.assert_allclose(pred2.run([x])[0], base, rtol=1e-5,
+                                   atol=1e-6)
+
 
 class TestProgramBuilder:
     def test_builder_and_executor(self):
