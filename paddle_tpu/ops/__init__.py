@@ -21,10 +21,11 @@ from .stat import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .misc import *  # noqa: F401,F403
+from .crf import *  # noqa: F401,F403
 # control_flow exposed as a namespace only: its `cond` (branching) must not
 # shadow linalg's `cond` (condition number) at the top level
-from . import (control_flow, creation, linalg, logic, manipulation, math,
-               random, search, sequence, stat)
+from . import (control_flow, creation, crf, linalg, logic, manipulation,
+               math, random, search, sequence, stat)
 from .control_flow import case, switch_case, while_loop
 
 

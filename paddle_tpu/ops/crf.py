@@ -1,0 +1,129 @@
+"""Linear-chain CRF ops.
+
+Reference: `operators/linear_chain_crf_op.h` (forward algorithm over a
+[tag_num+2, tag_num] transition matrix whose row 0 holds start weights and
+row 1 end weights) and `operators/crf_decoding_op.h` (Viterbi decode; with
+a Label input the output becomes a per-position correctness indicator).
+
+TPU-native: padded [B, T, N] emissions + lengths instead of LoD, the time
+recursion as `lax.scan` (static shapes, masked past each sequence end), so
+both ops trace into compiled steps.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch
+
+__all__ = ["linear_chain_crf", "crf_decoding"]
+
+
+def linear_chain_crf(emission, transition, label, length, name=None):
+    """Negative log-likelihood cost per sequence (`linear_chain_crf` op).
+
+    emission: [B, T, N] unnormalized tag scores; transition: [N+2, N]
+    (row 0 start, row 1 end, rows 2+ the NxN transition matrix);
+    label: [B, T] int tags; length: [B].  Returns [B, 1] cost
+    = logZ - path_score (minimize to train, as in the reference book's
+    label_semantic_roles example).
+    """
+
+    def f(em, trans, lab, ln):
+        b, t, n = em.shape
+        start, end, w = trans[0], trans[1], trans[2:]
+        em = em.astype(jnp.float32)
+        lab = lab.astype(jnp.int32)
+        ln = ln.astype(jnp.int32)
+
+        # ---- partition function via forward algorithm -------------------
+        alpha0 = start[None, :] + em[:, 0, :]  # [B, N]
+
+        def step(alpha, xs):
+            em_t, active = xs  # [B,N], [B]
+            nxt = jax.scipy.special.logsumexp(
+                alpha[:, :, None] + w[None, :, :], axis=1) + em_t
+            alpha = jnp.where(active[:, None], nxt, alpha)
+            return alpha, None
+
+        ts = jnp.arange(1, t)
+        active = ts[None, :] < ln[:, None]  # [B, T-1]
+        alpha, _ = jax.lax.scan(
+            step, alpha0,
+            (jnp.moveaxis(em[:, 1:, :], 1, 0), jnp.moveaxis(active, 1, 0)))
+        logz = jax.scipy.special.logsumexp(alpha + end[None, :], axis=-1)
+
+        # ---- gold path score -------------------------------------------
+        pos = jnp.arange(t)[None, :]
+        valid = pos < ln[:, None]  # [B, T]
+        em_score = jnp.where(
+            valid, jnp.take_along_axis(em, lab[:, :, None],
+                                       axis=2)[:, :, 0], 0.0).sum(-1)
+        prev, cur = lab[:, :-1], lab[:, 1:]
+        trans_valid = pos[:, 1:] < ln[:, None]
+        trans_score = jnp.where(trans_valid, w[prev, cur], 0.0).sum(-1)
+        last = jnp.take_along_axis(
+            lab, jnp.maximum(ln - 1, 0)[:, None], axis=1)[:, 0]
+        score = (em_score + trans_score + start[lab[:, 0]] + end[last])
+        return (logz - score)[:, None]
+
+    return dispatch(f, emission, transition, label, length, nondiff=(2, 3))
+
+
+def crf_decoding(emission, transition, label=None, length=None, name=None):
+    """Viterbi decode (`crf_decoding` op).  Returns the best path [B, T]
+    int64 (zeros past each length); when `label` is given, returns the
+    reference's correctness indicator instead: 1 where the decoded tag
+    equals the label (within length), else 0."""
+    has_label = label is not None
+
+    def f(em, trans, *rest):
+        b, t, n = em.shape
+        start, end, w = trans[0], trans[1], trans[2:]
+        em = em.astype(jnp.float32)
+        if length is not None:
+            ln = rest[-1].astype(jnp.int32)
+        else:
+            ln = jnp.full((b,), t, jnp.int32)
+
+        alpha0 = start[None, :] + em[:, 0, :]
+
+        def step(alpha, xs):
+            em_t, active = xs
+            scores = alpha[:, :, None] + w[None, :, :]  # [B, N, N]
+            best_prev = jnp.argmax(scores, axis=1)      # [B, N]
+            nxt = jnp.max(scores, axis=1) + em_t
+            alpha_new = jnp.where(active[:, None], nxt, alpha)
+            # inactive steps back-point to themselves (identity)
+            bp = jnp.where(active[:, None], best_prev,
+                           jnp.arange(n)[None, :])
+            return alpha_new, bp
+
+        ts = jnp.arange(1, t)
+        active = ts[None, :] < ln[:, None]
+        alpha, bps = jax.lax.scan(
+            step, alpha0,
+            (jnp.moveaxis(em[:, 1:, :], 1, 0), jnp.moveaxis(active, 1, 0)))
+        # bps: [T-1, B, N]
+        last_tag = jnp.argmax(alpha + end[None, :], axis=-1)  # [B]
+
+        def back(tag, bp):
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        # reverse scan emits the tag at time k+1 for k = 0..t-2 and leaves
+        # the time-0 tag in the carry
+        first_tag, path_rev = jax.lax.scan(back, last_tag, bps,
+                                           reverse=True)
+        path = jnp.concatenate([first_tag[None], path_rev], axis=0)
+        path = jnp.moveaxis(path, 0, 1)  # [B, T]
+        valid = jnp.arange(t)[None, :] < ln[:, None]
+        path = jnp.where(valid, path, 0).astype(jnp.int64)
+        if has_label:
+            lab = rest[0].astype(jnp.int64)
+            return jnp.where(valid, (lab == path).astype(jnp.int64), 0)
+        return path
+
+    args = (emission, transition) + ((label,) if has_label else ()) + \
+        ((length,) if length is not None else ())
+    return dispatch(f, *args, nondiff=tuple(range(2, 2 + len(args) - 2)))

@@ -21,6 +21,7 @@ __all__ = [
     "squared_l2_distance", "squared_l2_norm", "teacher_student_sigmoid_loss",
     "row_conv", "set_value", "segment_sum", "segment_mean", "segment_max",
     "segment_min", "segment_pool", "fsp_matrix", "Print", "Assert",
+    "conv_shift", "cvm", "shuffle_batch", "hash_op",
 ]
 
 
@@ -521,3 +522,72 @@ def Assert(cond, data=None, summarize=20, name=None):
                   for d in (data or [])]
         raise AssertionError(f"Assert failed; data={detail}")
     return cond
+
+
+def conv_shift(x, y, name=None):
+    """Circular convolution (`operators/conv_shift_op.cc`):
+    out[i, j] = sum_k x[i, (j + k - y_half) mod W] * y[i, k] with
+    W = x.shape[1], y_half = y.shape[1] // 2."""
+
+    def f(xv, yv):
+        w = xv.shape[1]
+        m = yv.shape[1]
+        half = m // 2
+        j = jnp.arange(w)[:, None]
+        k = jnp.arange(m)[None, :]
+        idx = (j + k - half) % w  # [W, M]
+        return jnp.einsum("bwm,bm->bw", xv[:, idx], yv)
+
+    return dispatch(f, x, y)
+
+
+def cvm(x, cvm_input, use_cvm=True, name=None):
+    """Continuous-value model op (`operators/cvm_op.h`): the first two
+    columns are show/click counters; use_cvm=True log-transforms them in
+    place (log(show+1), log(click+1)-log(show+1)), use_cvm=False drops
+    them."""
+
+    def f(xv, _cvm):
+        if use_cvm:
+            c0 = jnp.log(xv[:, :1] + 1.0)
+            c1 = jnp.log(xv[:, 1:2] + 1.0) - c0
+            return jnp.concatenate([c0, c1, xv[:, 2:]], axis=1)
+        return xv[:, 2:]
+
+    return dispatch(f, x, cvm_input, nondiff=(1,))
+
+
+def shuffle_batch(x, seed=0, name=None):
+    """Random row permutation (`operators/shuffle_batch_op.cc`); returns
+    (shuffled, shuffle_idx, seed_out) like the reference (the index output
+    lets callers un-shuffle)."""
+
+    def f(xv):
+        key = jax.random.PRNGKey(int(seed))
+        perm = jax.random.permutation(key, xv.shape[0])
+        return xv[perm], perm.astype(jnp.int64)
+
+    out, idx = dispatch(f, x)
+    return out, idx, Tensor(jnp.asarray([seed], jnp.int32))
+
+
+def hash_op(x, num_hash=1, mod_by=100000000, name=None):
+    """Feature hashing (`operators/hash_op.cc`, xxhash-based in the
+    reference).  Each int row [D] hashes to `num_hash` buckets via
+    distinct FNV-1a style mixes mod `mod_by`; output [N, num_hash, 1].
+    Divergence: the mix function is FNV-1a rather than xxhash (bucket
+    distribution is equivalent for embedding lookup purposes)."""
+
+    def f(xv):
+        v = xv.astype(jnp.uint32).reshape(xv.shape[0], -1)
+
+        def one_hash(i):
+            h = jnp.full((v.shape[0],), jnp.uint32(2166136261 + i * 16777619))
+            for d in range(v.shape[1]):
+                h = (h ^ v[:, d]) * jnp.uint32(16777619)
+            return (h % jnp.uint32(mod_by)).astype(jnp.int64)
+
+        return jnp.stack([one_hash(i) for i in range(num_hash)],
+                         axis=1)[:, :, None]
+
+    return dispatch(f, x, nondiff=(0,))

@@ -32,6 +32,8 @@ __all__ = [
     "box_decoder_and_assign", "retinanet_detection_output",
     "locality_aware_nms", "density_prior_box", "yolov3_loss",
     "multiclass_nms2", "multiclass_nms3",
+    "target_assign", "mine_hard_examples", "rpn_target_assign",
+    "retinanet_target_assign",
 ]
 
 
@@ -800,3 +802,284 @@ def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
                           background_label=background_label,
                           return_index=return_index)
     return res
+
+
+# ---------------------------------------------------------------------------
+# train-time target assigners
+# ---------------------------------------------------------------------------
+def _select_k(order, count, k):
+    """First `count` entries of the ranking `order` laid into k slots
+    (pad -1).  Works for any order length vs k (gather-clipped), the
+    static-shape building block replacing the reference's index-list
+    (LoD) outputs."""
+    n = order.shape[0]
+    slots = jnp.clip(jnp.arange(k), 0, n - 1)
+    return jnp.where(jnp.arange(k) < count, jnp.take(order, slots), -1)
+
+
+def target_assign(x, match_indices, negative_indices=None, mismatch_value=0,
+                  name=None):
+    """`target_assign` op (`detection/target_assign_op.h`): out[n, p] =
+    x[n, match[n, p], p] where matched (match > -1) with weight 1, else
+    `mismatch_value` with weight 0.  `negative_indices` (padded [N, Q],
+    -1 pad) forces weight 1 / mismatch fill at those prior slots (the
+    reference's NegTargetAssign).  x: [N, G, P, K] per-image gt-major
+    encoded targets (LoD over N in the reference)."""
+    has_neg = negative_indices is not None
+
+    def f(xv, match, *rest):
+        n, g, p, k = xv.shape
+        m = match.astype(jnp.int32)
+        # out[n,p] = xv[n, m[n,p], p] — direct advanced indexing (an
+        # [N,P,P,K] take_along_axis intermediate would be O(P^2) memory)
+        gathered = xv[jnp.arange(n)[:, None], jnp.clip(m, 0, g - 1),
+                      jnp.arange(p)[None, :]]  # [N, P, K]
+        matched = (m > -1)[:, :, None]
+        out = jnp.where(matched, gathered, float(mismatch_value))
+        wt = matched.astype(jnp.float32)
+        if has_neg:
+            neg = rest[0].astype(jnp.int32)  # [N, Q], -1 padded
+            neg_mask = jnp.zeros((n, p), bool)
+            valid = neg >= 0
+            neg_mask = jnp.zeros((n, p), bool).at[
+                jnp.arange(n)[:, None].repeat(neg.shape[1], 1),
+                jnp.clip(neg, 0, p - 1)].max(valid)
+            out = jnp.where(neg_mask[:, :, None], float(mismatch_value),
+                            out)
+            wt = jnp.where(neg_mask[:, :, None], 1.0, wt)
+        return out, wt
+
+    args = (x, match_indices) + ((negative_indices,) if has_neg else ())
+    return dispatch(f, *args, nondiff=tuple(range(1, len(args))))
+
+
+def mine_hard_examples(cls_loss, match_indices, match_dist, loc_loss=None,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5,
+                       sample_size=0, mining_type="max_negative", name=None):
+    """`mine_hard_examples` op (`detection/mine_hard_examples_op.cc`).
+    Returns (neg_indices [N, P] padded with -1, neg_count [N],
+    updated_match_indices [N, P]).  max_negative: candidates are priors
+    with match == -1 and dist < neg_dist_threshold, ranked by cls_loss
+    (plus loc_loss for hard_example), capped at neg_pos_ratio * num_pos
+    (or sample_size for hard_example)."""
+    has_loc = loc_loss is not None
+    hard = mining_type == "hard_example"
+
+    def f(cl, match, dist, *rest):
+        n, p = cl.shape
+        m = match.astype(jnp.int32)
+        loss = cl + (rest[0] if has_loc and hard else 0.0)
+        if hard:
+            # hard_example mining ranks EVERY prior (positives compete for
+            # the sample budget too — reference IsEligibleMining)
+            eligible = jnp.ones((n, p), bool)
+        else:
+            eligible = (m == -1) & (dist < neg_dist_threshold)
+        # rank eligible priors by loss descending
+        key = jnp.where(eligible, loss, -jnp.inf)
+        order = jnp.argsort(-key, axis=1)  # [N, P]
+        n_cand = eligible.sum(1)
+        if hard:
+            cap = jnp.minimum(n_cand, sample_size)
+        else:
+            num_pos = (m != -1).sum(1)
+            cap = jnp.minimum(n_cand,
+                              (num_pos * neg_pos_ratio).astype(jnp.int32))
+        take = jnp.arange(p)[None, :] < cap[:, None]
+        sel_mask = jnp.zeros((n, p), bool).at[
+            jnp.arange(n)[:, None].repeat(p, 1),
+            jnp.clip(order, 0, p - 1)].max(take)
+        if hard:
+            # selected positives stay matched, unselected get disabled;
+            # only selected NEGATIVES go to the neg index list
+            upd = jnp.where((m > -1) & ~sel_mask, -1, m)
+            neg_mask = sel_mask & (m == -1)
+        else:
+            upd = m
+            neg_mask = sel_mask  # eligibility already implies match == -1
+        # reference emits ascending neg index lists
+        neg_sorted = jnp.sort(
+            jnp.where(neg_mask, jnp.arange(p)[None, :], p), axis=1)
+        neg_sorted = jnp.where(neg_sorted == p, -1, neg_sorted)
+        return neg_sorted, neg_mask.sum(1).astype(jnp.int32), upd
+
+    args = (cls_loss, match_indices, match_dist) + \
+        ((loc_loss,) if has_loc else ())
+    return dispatch(f, *args, nondiff=(1, 2))
+
+
+def _box_to_delta(boxes, anchors):
+    """Encode gt boxes relative to anchors (reference BoxToDelta,
+    `rpn_target_assign_op.cc` without variance weights)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + aw * 0.5
+    ay = anchors[:, 1] + ah * 0.5
+    gw = boxes[:, 2] - boxes[:, 0] + 1.0
+    gh = boxes[:, 3] - boxes[:, 1] + 1.0
+    gx = boxes[:, 0] + gw * 0.5
+    gy = boxes[:, 1] + gh * 0.5
+    return jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                      jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info, gt_num=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=False, name=None):
+    """`rpn_target_assign` (`detection/rpn_target_assign_op.cc`).
+
+    Batched static-shape form: anchor_box [A, 4]; gt_boxes [N, G, 4] with
+    `gt_num` [N] valid counts; is_crowd [N, G]; im_info [N, 3]
+    (h, w, scale).  Returns (loc_index [N, B], score_index [N, B],
+    tgt_label [N, B], tgt_bbox [N, B, 4], bbox_inside_weight [N, B, 4],
+    fg_num [N]) with B = rpn_batch_size_per_im; index slots beyond the
+    selected count hold -1.  use_random=False follows the reference's
+    deterministic take-first-k path; True subsamples with a fixed-seed
+    PRNG (stream differs from the reference's minstd_rand)."""
+    B = int(rpn_batch_size_per_im)
+
+    def f(anchors, gt, crowd, iminfo, gtn):
+        import jax
+
+        a = anchors.shape[0]
+        n, g = gt.shape[:2]
+
+        def one(gt_i, crowd_i, info_i, gn_i, key):
+            gt_valid = (jnp.arange(g) < gn_i) & (crowd_i == 0)
+            if rpn_straddle_thresh >= 0:
+                st = rpn_straddle_thresh
+                inside = ((anchors[:, 0] >= -st) & (anchors[:, 1] >= -st)
+                          & (anchors[:, 2] < info_i[1] + st)
+                          & (anchors[:, 3] < info_i[0] + st))
+            else:
+                inside = jnp.ones((a,), bool)
+            iou = _iou_matrix(anchors, gt_i)  # [A, G]
+            iou = jnp.where(gt_valid[None, :], iou, 0.0)
+            a2g_max = iou.max(1)
+            a2g_arg = iou.argmax(1)
+            g2a_max = jnp.where(gt_valid, iou.max(0), jnp.inf)
+            is_max = ((jnp.abs(iou - g2a_max[None, :]) < 1e-5)
+                      & gt_valid[None, :]).any(1)
+            has_gt = gt_valid.any()
+            fg_cand = inside & has_gt & \
+                (is_max | (a2g_max >= rpn_positive_overlap))
+            if use_random:
+                # random subsample: rank candidates by random key
+                rk = jax.random.uniform(key, (a,))
+                fg_order = jnp.argsort(jnp.where(fg_cand, rk, 2.0))
+            else:
+                fg_order = jnp.argsort(
+                    jnp.where(fg_cand, jnp.arange(a), a + jnp.arange(a)))
+            fg_target = int(rpn_fg_fraction * B)
+            fg_count = jnp.minimum(fg_cand.sum(), fg_target)
+            fg_sel = _select_k(fg_order, fg_count, B)
+
+            # each anchor gets exactly one label (reference assigns bg
+            # first, fg overwrites) — exclude fg candidates from bg
+            bg_cand = inside & (a2g_max < rpn_negative_overlap) & ~fg_cand
+            bg_allowed = B - fg_count
+            if use_random:
+                rk2 = jax.random.uniform(jax.random.fold_in(key, 1), (a,))
+                bg_order = jnp.argsort(jnp.where(bg_cand, rk2, 2.0))
+            else:
+                bg_order = jnp.argsort(
+                    jnp.where(bg_cand, jnp.arange(a), a + jnp.arange(a)))
+            bg_count = jnp.minimum(bg_cand.sum(), bg_allowed)
+            bg_sel = _select_k(bg_order, bg_count, B)
+
+            # score slots: fg first then bg (reference concatenates)
+            slot = jnp.arange(B)
+            shifted_bg = jnp.take(
+                bg_sel, jnp.clip(slot - fg_count, 0, B - 1))
+            score_index = jnp.where(slot < fg_count, fg_sel, shifted_bg)
+            score_index = jnp.where(slot < fg_count + bg_count,
+                                    score_index, -1)
+            tgt_label = jnp.where(slot < fg_count, 1,
+                                  jnp.where(slot < fg_count + bg_count,
+                                            0, -1))
+            matched_gt = gt_i[a2g_arg[jnp.clip(fg_sel, 0, a - 1)]]
+            tgt_bbox = _box_to_delta(
+                matched_gt, anchors[jnp.clip(fg_sel, 0, a - 1)])
+            w = (fg_sel >= 0)[:, None].astype(jnp.float32)
+            return (fg_sel, score_index, tgt_label,
+                    jnp.where(w > 0, tgt_bbox, 0.0),
+                    jnp.broadcast_to(w, (B, 4)), fg_count.astype(jnp.int32))
+
+        keys = jax.random.split(jax.random.PRNGKey(0), n)
+        return jax.vmap(one)(gt, crowd, iminfo, gtn, keys)
+
+    if gt_num is None:
+        import numpy as _np
+
+        gt_num = _np.full((int(unwrap(gt_boxes).shape[0]),),
+                          int(unwrap(gt_boxes).shape[1]), _np.int32)
+    return dispatch(f, anchor_box, gt_boxes, is_crowd, im_info, gt_num,
+                    nondiff=(1, 2, 3, 4))
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            gt_num=None, positive_overlap=0.5,
+                            negative_overlap=0.4, name=None):
+    """`retinanet_target_assign` (`detection/rpn_target_assign_op.cc`
+    RetinanetTargetAssign): like rpn_target_assign but without
+    subsampling (focal loss uses every anchor), with per-anchor class
+    labels from `gt_labels` and a foreground-count output used to
+    normalize the focal loss.  Returns (labels [N, A] (-1 ignore,
+    0 background, else gt label), tgt_bbox [N, A, 4],
+    bbox_inside_weight [N, A, 4], fg_num [N, 1])."""
+
+    def f(anchors, gt, gtl, crowd, iminfo, gtn):
+        import jax
+
+        a = anchors.shape[0]
+        n, g = gt.shape[:2]
+
+        def one(gt_i, gtl_i, crowd_i, info_i, gn_i):
+            gt_valid = (jnp.arange(g) < gn_i) & (crowd_i == 0)
+            iou = _iou_matrix(anchors, gt_i)
+            iou = jnp.where(gt_valid[None, :], iou, 0.0)
+            a2g_max = iou.max(1)
+            a2g_arg = iou.argmax(1)
+            g2a_max = jnp.where(gt_valid, iou.max(0), jnp.inf)
+            is_max = ((jnp.abs(iou - g2a_max[None, :]) < 1e-5)
+                      & gt_valid[None, :]).any(1)
+            has_gt = gt_valid.any()
+            fg = has_gt & (is_max | (a2g_max >= positive_overlap))
+            bg = (~fg) & (a2g_max < negative_overlap)
+            labels = jnp.where(
+                fg, gtl_i[a2g_arg].astype(jnp.int32),
+                jnp.where(bg, 0, -1))
+            tgt = _box_to_delta(gt_i[a2g_arg], anchors)
+            w = fg[:, None].astype(jnp.float32)
+            return (labels, jnp.where(w > 0, tgt, 0.0),
+                    jnp.broadcast_to(w, (a, 4)),
+                    fg.sum().astype(jnp.int32)[None])
+
+        return jax.vmap(one)(gt, gtl, crowd, iminfo, gtn)
+
+    if gt_num is None:
+        import numpy as _np
+
+        gt_num = _np.full((int(unwrap(gt_boxes).shape[0]),),
+                          int(unwrap(gt_boxes).shape[1]), _np.int32)
+    return dispatch(f, anchor_box, gt_boxes, gt_labels, is_crowd, im_info,
+                    gt_num, nondiff=(1, 2, 3, 4, 5))
+
+
+def _iou_matrix(a, b):
+    """Pixel-coordinate IoU with the +1 convention the RPN assigners use
+    (`detection/bbox_util.h BboxOverlaps`)."""
+    aw = (a[:, 2] - a[:, 0] + 1).clip(0)
+    ah = (a[:, 3] - a[:, 1] + 1).clip(0)
+    bw = (b[:, 2] - b[:, 0] + 1).clip(0)
+    bh = (b[:, 3] - b[:, 1] + 1).clip(0)
+    ix = (jnp.minimum(a[:, None, 2], b[None, :, 2])
+          - jnp.maximum(a[:, None, 0], b[None, :, 0]) + 1).clip(0)
+    iy = (jnp.minimum(a[:, None, 3], b[None, :, 3])
+          - jnp.maximum(a[:, None, 1], b[None, :, 1]) + 1).clip(0)
+    inter = ix * iy
+    union = aw[:, None] * ah[:, None] + bw[None, :] * bh[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)

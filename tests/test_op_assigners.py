@@ -1,0 +1,254 @@
+"""CRF ops, detection train-time assigners, and small long-tail ops —
+numpy/brute-force references in the OpTest style (SURVEY §4.1)."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.crf import crf_decoding, linear_chain_crf
+from paddle_tpu.ops.misc import conv_shift, cvm, hash_op, shuffle_batch
+from paddle_tpu.vision.detection import (mine_hard_examples,
+                                         retinanet_target_assign,
+                                         rpn_target_assign, target_assign)
+
+t = paddle.to_tensor
+
+
+def _path_score(e, tr, tags):
+    s = tr[0][tags[0]] + e[0][tags[0]]
+    for k in range(1, len(tags)):
+        s += tr[2 + tags[k - 1]][tags[k]] + e[k][tags[k]]
+    return s + tr[1][tags[-1]]
+
+
+class TestCRF:
+    def setup_method(self, _):
+        rng = np.random.RandomState(0)
+        self.B, self.T, self.N = 2, 4, 3
+        self.em = rng.randn(self.B, self.T, self.N).astype(np.float32)
+        self.trans = rng.randn(self.N + 2, self.N).astype(np.float32)
+        self.lab = rng.randint(0, self.N,
+                               (self.B, self.T)).astype(np.int64)
+        self.ln = np.array([4, 2], np.int64)
+
+    def test_cost_matches_brute_force(self):
+        got = np.asarray(linear_chain_crf(
+            t(self.em), t(self.trans), t(self.lab), t(self.ln)).numpy())
+        for b in range(self.B):
+            L = self.ln[b]
+            scores = {p: _path_score(self.em[b], self.trans, p)
+                      for p in itertools.product(range(self.N), repeat=L)}
+            logz = np.logaddexp.reduce(np.array(list(scores.values())))
+            want = logz - scores[tuple(self.lab[b, :L])]
+            np.testing.assert_allclose(got[b, 0], want, atol=1e-4)
+
+    def test_gradient_flows(self):
+        em = t(self.em)
+        em.stop_gradient = False
+        cost = linear_chain_crf(em, t(self.trans), t(self.lab), t(self.ln))
+        cost.sum().backward()
+        g = np.asarray(em.grad.numpy())
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+        # positions past each length must get zero gradient
+        assert np.abs(g[1, 2:]).sum() == 0
+
+    def test_viterbi_matches_brute_force(self):
+        dec = np.asarray(crf_decoding(
+            t(self.em), t(self.trans), length=t(self.ln)).numpy())
+        for b in range(self.B):
+            L = self.ln[b]
+            scores = {p: _path_score(self.em[b], self.trans, p)
+                      for p in itertools.product(range(self.N), repeat=L)}
+            best = max(scores, key=scores.get)
+            assert tuple(dec[b, :L]) == best
+            assert (dec[b, L:] == 0).all()
+
+    def test_label_mode_is_indicator(self):
+        dec = np.asarray(crf_decoding(
+            t(self.em), t(self.trans), length=t(self.ln)).numpy())
+        ind = np.asarray(crf_decoding(
+            t(self.em), t(self.trans), label=t(dec),
+            length=t(self.ln)).numpy())
+        # decoded labels compared against themselves -> all ones in length
+        assert (ind[0, :4] == 1).all() and (ind[1, :2] == 1).all()
+        assert (ind[1, 2:] == 0).all()
+
+
+class TestTargetAssign:
+    def test_matched_and_negative(self):
+        # x: [N=1, G=2, P=3, K=2]
+        x = np.arange(12, dtype=np.float32).reshape(1, 2, 3, 2)
+        match = np.array([[1, -1, 0]], np.int32)
+        neg = np.array([[1, -1]], np.int32)
+        out, wt = target_assign(t(x), t(match), t(neg), mismatch_value=9)
+        o = np.asarray(out.numpy())
+        w = np.asarray(wt.numpy())
+        np.testing.assert_allclose(o[0, 0], x[0, 1, 0])  # gt 1, prior 0
+        np.testing.assert_allclose(o[0, 2], x[0, 0, 2])  # gt 0, prior 2
+        np.testing.assert_allclose(o[0, 1], [9, 9])      # neg slot
+        np.testing.assert_allclose(w[0, :, 0], [1, 1, 1])  # neg weight 1
+
+    def test_unmatched_without_negatives(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        match = np.array([[-1, 0]], np.int32)
+        out, wt = target_assign(t(x), t(match), mismatch_value=0)
+        np.testing.assert_allclose(np.asarray(wt.numpy())[0, :, 0], [0, 1])
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, 0], [0, 0])
+
+
+class TestMineHardExamples:
+    def test_max_negative(self):
+        cls_loss = np.array([[0.1, 0.9, 0.5, 0.3, 0.7]], np.float32)
+        match = np.array([[0, -1, -1, -1, -1]], np.int32)
+        dist = np.array([[0.8, 0.1, 0.2, 0.9, 0.3]], np.float32)
+        neg, cnt, upd = mine_hard_examples(
+            t(cls_loss), t(match), t(dist), neg_pos_ratio=2.0,
+            neg_dist_threshold=0.5)
+        # candidates: priors 1,2,4 (match==-1 & dist<0.5); 1 pos -> cap 2;
+        # by loss desc: 1 (0.9), 4 (0.7) -> ascending [1, 4]
+        assert int(np.asarray(cnt.numpy())[0]) == 2
+        np.testing.assert_array_equal(np.asarray(neg.numpy())[0, :2],
+                                      [1, 4])
+        assert (np.asarray(neg.numpy())[0, 2:] == -1).all()
+        np.testing.assert_array_equal(np.asarray(upd.numpy()), match)
+
+    def test_hard_example(self):
+        # positives compete for the sample budget; unselected positives
+        # are disabled and only selected negatives go to the neg list
+        cls_loss = np.array([[5.0, 0.9, 0.1, 4.0]], np.float32)
+        match = np.array([[0, -1, -1, 1]], np.int32)
+        dist = np.array([[0.8, 0.1, 0.2, 0.9]], np.float32)
+        neg, cnt, upd = mine_hard_examples(
+            t(cls_loss), t(match), t(dist), mining_type="hard_example",
+            sample_size=2)
+        # top-2 by loss: priors 0 (pos) and 3 (pos) -> no negatives
+        # selected; both positives selected so match unchanged
+        assert int(np.asarray(cnt.numpy())[0]) == 0
+        np.testing.assert_array_equal(np.asarray(upd.numpy()), match)
+
+        neg2, cnt2, upd2 = mine_hard_examples(
+            t(cls_loss), t(match), t(dist), mining_type="hard_example",
+            sample_size=3)
+        # top-3 adds prior 1 (neg); positives 0,3 still selected
+        assert int(np.asarray(cnt2.numpy())[0]) == 1
+        assert np.asarray(neg2.numpy())[0, 0] == 1
+        np.testing.assert_array_equal(np.asarray(upd2.numpy()), match)
+
+
+class TestRpnTargetAssign:
+    def _setup(self):
+        anchors = np.array([[0, 0, 9, 9], [20, 20, 29, 29],
+                            [100, 100, 109, 109], [0, 0, 4, 4]],
+                           np.float32)
+        gt = np.array([[[0, 0, 9, 9], [21, 21, 30, 30]]], np.float32)
+        crowd = np.zeros((1, 2), np.int32)
+        im_info = np.array([[200.0, 200.0, 1.0]], np.float32)
+        return anchors, gt, crowd, im_info
+
+    def test_assignment(self):
+        anchors, gt, crowd, im_info = self._setup()
+        loc_i, score_i, lbl, tgt, w, fg_num = rpn_target_assign(
+            None, None, t(anchors), None, t(gt), t(crowd), t(im_info),
+            gt_num=t(np.array([2], np.int32)), rpn_batch_size_per_im=4,
+            rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+            rpn_negative_overlap=0.3)
+        loc_i = np.asarray(loc_i.numpy())[0]
+        lbl = np.asarray(lbl.numpy())[0]
+        # anchor 0 is exact match of gt 0 (fg); anchor 1 overlaps gt 1
+        # (max-per-gt -> fg); anchors 2,3 are bg candidates
+        assert set(loc_i[loc_i >= 0]) == {0, 1}
+        assert int(np.asarray(fg_num.numpy())[0]) == 2
+        assert (lbl[:2] == 1).all()
+        # anchor 0 matches gt exactly -> zero deltas
+        np.testing.assert_allclose(np.asarray(tgt.numpy())[0, 0],
+                                   [0, 0, 0, 0], atol=1e-5)
+
+    def test_fewer_anchors_than_batch_size(self):
+        # A=4 anchors with the default rpn_batch_size_per_im=256
+        anchors, gt, crowd, im_info = self._setup()
+        loc_i, score_i, lbl, tgt, w, fg_num = rpn_target_assign(
+            None, None, t(anchors), None, t(gt), t(crowd), t(im_info),
+            gt_num=t(np.array([2], np.int32)))
+        li = np.asarray(loc_i.numpy())[0]
+        assert li.shape == (256,)
+        assert set(li[li >= 0]) == {0, 1}
+
+    def test_anchor_never_labeled_both_fg_and_bg(self):
+        # gt whose best anchor has IoU below the negative threshold: the
+        # is_max rule makes it fg; it must not also be drawn as bg
+        anchors = np.array([[0, 0, 9, 9], [50, 50, 59, 59]], np.float32)
+        gt = np.array([[[8, 8, 40, 40]]], np.float32)  # iou(anchor0)~0.003
+        crowd = np.zeros((1, 1), np.int32)
+        im_info = np.array([[200.0, 200.0, 1.0]], np.float32)
+        loc_i, score_i, lbl, *_ = rpn_target_assign(
+            None, None, t(anchors), None, t(gt), t(crowd), t(im_info),
+            gt_num=t(np.array([1], np.int32)), rpn_batch_size_per_im=4)
+        si = np.asarray(score_i.numpy())[0]
+        li = np.asarray(lbl.numpy())[0]
+        picked = si[si >= 0]
+        assert len(set(picked.tolist())) == len(picked)  # no duplicates
+        # anchor 0 is fg (max for the gt); its label is 1 exactly once
+        assert li[0] == 1 and (picked == 0).sum() == 1
+
+    def test_no_gt_gives_no_fg(self):
+        anchors, gt, crowd, im_info = self._setup()
+        *_, fg_num = rpn_target_assign(
+            None, None, t(anchors), None, t(gt), t(crowd), t(im_info),
+            gt_num=t(np.array([0], np.int32)), rpn_batch_size_per_im=4)
+        assert int(np.asarray(fg_num.numpy())[0]) == 0
+
+
+class TestRetinanetTargetAssign:
+    def test_labels_and_fg_num(self):
+        anchors = np.array([[0, 0, 9, 9], [100, 100, 109, 109]], np.float32)
+        gt = np.array([[[0, 0, 9, 9]]], np.float32)
+        gtl = np.array([[3]], np.int32)
+        crowd = np.zeros((1, 1), np.int32)
+        im_info = np.array([[200.0, 200.0, 1.0]], np.float32)
+        labels, tgt, w, fg_num = retinanet_target_assign(
+            None, None, t(anchors), None, t(gt), t(gtl), t(crowd),
+            t(im_info), gt_num=t(np.array([1], np.int32)))
+        lab = np.asarray(labels.numpy())[0]
+        assert lab[0] == 3 and lab[1] == 0  # fg keeps gt label, bg is 0
+        assert int(np.asarray(fg_num.numpy())[0, 0]) == 1
+        np.testing.assert_allclose(np.asarray(w.numpy())[0, 0], [1] * 4)
+
+
+class TestSmallOps:
+    def test_conv_shift(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 5).astype(np.float32)
+        y = rng.randn(2, 3).astype(np.float32)
+        out = np.asarray(conv_shift(t(x), t(y)).numpy())
+        want = np.zeros_like(x)
+        half = 3 // 2
+        for b in range(2):
+            for j in range(5):
+                for k in range(3):
+                    want[b, j] += x[b, (j + k - half) % 5] * y[b, k]
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_cvm(self):
+        x = np.array([[2.0, 1.0, 5.0, 6.0]], np.float32)
+        on = np.asarray(cvm(t(x), t(x[:, :2]), use_cvm=True).numpy())
+        np.testing.assert_allclose(
+            on[0], [np.log(3.0), np.log(2.0) - np.log(3.0), 5, 6],
+            rtol=1e-6)
+        off = np.asarray(cvm(t(x), t(x[:, :2]), use_cvm=False).numpy())
+        np.testing.assert_allclose(off[0], [5, 6])
+
+    def test_shuffle_batch(self):
+        x = np.arange(12, dtype=np.float32).reshape(6, 2)
+        out, idx, seed = shuffle_batch(t(x), seed=7)
+        o = np.asarray(out.numpy())
+        i = np.asarray(idx.numpy())
+        np.testing.assert_allclose(o, x[i])
+        assert sorted(i.tolist()) == list(range(6))
+
+    def test_hash_op(self):
+        x = np.array([[1, 2], [1, 2], [3, 4]], np.int64)
+        out = np.asarray(hash_op(t(x), num_hash=2, mod_by=1000).numpy())
+        assert out.shape == (3, 2, 1)
+        np.testing.assert_array_equal(out[0], out[1])  # deterministic
+        assert (out >= 0).all() and (out < 1000).all()
+        assert (out[0] != out[2]).any()

@@ -104,6 +104,14 @@ where where_index while write_to_array yolo_box yolov3_loss
 # explicit op-name -> "module:attr" (or category marker) for renames and
 # semantic equivalents
 ALIASES = {
+    "linear_chain_crf": "paddle:linear_chain_crf",
+    "crf_decoding": "paddle:crf_decoding",
+    "conv_shift": "ops:conv_shift", "cvm": "ops:cvm",
+    "shuffle_batch": "ops:shuffle_batch", "hash": "ops:hash_op",
+    "target_assign": "vdet:target_assign",
+    "mine_hard_examples": "vdet:mine_hard_examples",
+    "rpn_target_assign": "vdet:rpn_target_assign",
+    "retinanet_target_assign": "vdet:retinanet_target_assign",
     "matmul_v2": "paddle:matmul", "mul": "paddle:matmul",
     "lookup_table": "F:embedding", "lookup_table_v2": "F:embedding",
     "reshape2": "paddle:reshape", "transpose2": "paddle:transpose",
@@ -386,21 +394,11 @@ DESCOPED = {
     "var_conv_2d": "variable-size conv over LoD (niche)",
     "similarity_focus": "niche attention variant",
     "filter_by_instag": "industrial instance-tag filter",
-    "shuffle_batch": "PS-side negative sampling",
-    "cvm": "CTR continuous-value model op",
     "roi_perspective_transform": "OCR-specific geometric op",
     "polygon_box_transform": "OCR-specific",
-    "rpn_target_assign": "anchor assigner (train-time detection)",
-    "retinanet_target_assign": "anchor assigner (train-time detection)",
     "generate_mask_labels": "Mask-RCNN train-time assigner",
     "generate_proposal_labels": "RCNN train-time assigner",
-    "mine_hard_examples": "SSD train-time miner",
-    "target_assign": "SSD train-time assigner",
-    "hash": "sparse feature hashing (PS)",
     "lookup_table_dequant": "PS quantized embedding",
-    "linear_chain_crf": "CRF train (niche NLP)",
-    "crf_decoding": "CRF decode (niche NLP)",
-    "conv_shift": "circular conv (NTM-specific)",
 }
 
 
@@ -445,6 +443,7 @@ def resolve(name: str):
                 "meta": "paddle_tpu.distributed.fleet.meta_optimizers",
                 "nn_utils": "paddle_tpu.nn.utils",
                 "seq": "paddle_tpu.ops.sequence",
+                "vdet": "paddle_tpu.vision.detection",
                 "quant": "paddle_tpu.quantization",
             }
             import importlib
