@@ -24,6 +24,7 @@ from . import nn  # noqa: E402  (control-flow + layer surface)
 from . import proto  # noqa: E402
 from .program import Block, Operator, Program, Variable, \
     program_from_layer  # noqa: E402
+from .backward import append_backward, gradients  # noqa: E402
 
 
 _default_main = Program()
@@ -70,14 +71,20 @@ class Executor:
 
             base = dict(scope if scope is not None else self.scope)
             base.update(getattr(program, "_param_scope", None) or {})
-            runner = self._runners.get(id(program))
+            # key includes the op count so appending ops (e.g.
+            # append_backward) invalidates the compiled runner
+            key = (id(program), len(program.desc["blocks"][0]["ops"]))
+            runner = self._runners.get(key)
             if runner is None:
                 runner = ProgramRunner(program, base)
-                self._runners[id(program)] = runner
+                self._runners[key] = runner
             import jax.numpy as jnp
 
             feeds = {k: jnp.asarray(v) for k, v in feed.items()}
-            fetch_vals, final_scope = runner.run_with_scope(feeds)
+            # current scope values override construction-time params so
+            # weight updates between runs take effect
+            fetch_vals, final_scope = runner.run_with_scope(feeds,
+                                                            params=base)
             if fetch_list:
                 out = []
                 for f in fetch_list:

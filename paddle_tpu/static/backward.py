@@ -1,0 +1,176 @@
+"""Static-program autodiff: append_backward / gradients over a ProgramDesc.
+
+Reference: `python/paddle/fluid/backward.py:1369` (`append_backward` walks
+the forward block in reverse, applies each op's GradOpMaker, and
+aggregates duplicate gradients) and `:1964` (`gradients`).
+
+TPU-native twist: instead of ~700 hand-written grad kernels, one generic
+grad executor differentiates any translated forward op by re-tracing its
+interpreter translation under `jax.vjp` (static/interp.py `run_grad_op`).
+The emitted grad ops still follow the reference's program form — op type
+`{fwd}_grad`, gradient vars named `X@GRAD`, reverse program order, a
+`fill_constant` seeding loss@GRAD = 1 — so the augmented program remains
+serializable through the framework.proto codec (the forward op is carried
+in a string attr `__forward_op__`).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from .program import Program, Variable
+
+__all__ = ["append_backward", "gradients"]
+
+GRAD_SUFFIX = "@GRAD"
+
+# op types that never propagate gradients
+_NON_DIFF = {
+    "feed", "fetch", "fill_constant", "assign_value", "shape",
+    "uniform_random", "gaussian_random", "range", "arg_max", "arg_min",
+    "accuracy", "top_k", "top_k_v2",
+}
+
+
+def _op_io_args(op_desc: Dict, key: str) -> List[str]:
+    return [a for slot in op_desc.get(key, [])
+            for a in slot.get("arguments", [])]
+
+
+def _append_grad_ops(block, target_names: List[str], stop_names: set,
+                     target_grad_names: Optional[List[str]] = None
+                     ) -> Dict[str, str]:
+    """Emit `{type}_grad` ops in reverse program order for every op on the
+    path to any of `target_names` (single pass — per-target passes would
+    double-count shared subgraphs).  Returns forward-var -> grad-var
+    names.  `target_grad_names` supplies user cotangent vars; targets
+    without one are seeded with ones."""
+    fwd_ops = list(block.desc["ops"])  # snapshot before appending
+
+    needed = set(target_names)
+    emit = []
+    for op_desc in reversed(fwd_ops):
+        if op_desc["type"] in _NON_DIFF or op_desc["type"].endswith("_grad"):
+            continue
+        outs = _op_io_args(op_desc, "outputs")
+        if not any(o in needed for o in outs):
+            continue
+        ins = _op_io_args(op_desc, "inputs")
+        overwritten = set(ins) & set(outs)
+        if overwritten:
+            # the grad executor recomputes each op from final scope
+            # values; an op overwriting its own input would differentiate
+            # at the wrong point (the reference renames such vars —
+            # backward.py _rename_grad_); require single-assignment form
+            raise ValueError(
+                f"append_backward: op {op_desc['type']!r} writes its own "
+                f"input var(s) {sorted(overwritten)}; use distinct output "
+                "names on the path to the loss")
+        emit.append(op_desc)
+        for i in ins:
+            if i not in stop_names:
+                needed.add(i)
+
+    grad_map: Dict[str, str] = {}
+    for k, target_name in enumerate(target_names):
+        tvar = block.var(target_name)
+        seed = target_grad_names[k] if target_grad_names else None
+        if seed is not None:
+            # honor the user cotangent (reference target_gradients)
+            block.append_op("assign", {"X": seed},
+                            {"Out": target_name + GRAD_SUFFIX}, {})
+        else:
+            # seed d(target)/d(target) = 1 (reference fill_constant)
+            block.append_op(
+                "fill_constant", inputs={},
+                outputs={"Out": target_name + GRAD_SUFFIX},
+                attrs={"shape": [int(d) for d in (tvar.shape or [1])],
+                       "dtype": 5, "value": 1.0})
+        block.create_var(target_name + GRAD_SUFFIX, shape=tvar.shape,
+                         dtype=tvar.dtype)
+        grad_map[target_name] = target_name + GRAD_SUFFIX
+    for op_desc in emit:
+        ins = {s["parameter"]: list(s.get("arguments", []))
+               for s in op_desc.get("inputs", [])}
+        outs = {s["parameter"]: list(s.get("arguments", []))
+                for s in op_desc.get("outputs", [])}
+        g_inputs = dict(ins)
+        for p, args in outs.items():
+            g_inputs[p] = args
+            g_inputs[p + GRAD_SUFFIX] = [a + GRAD_SUFFIX for a in args]
+        g_outputs = {}
+        for p, args in ins.items():
+            grads = []
+            for a in args:
+                if a in stop_names:
+                    continue
+                grads.append(a + GRAD_SUFFIX)
+                grad_map[a] = a + GRAD_SUFFIX
+                if not block.has_var(a + GRAD_SUFFIX):
+                    src = block.var(a) if block.has_var(a) else None
+                    block.create_var(
+                        a + GRAD_SUFFIX,
+                        shape=src.shape if src is not None else None,
+                        dtype=src.dtype if src is not None else "float32")
+            if grads:
+                g_outputs[p + GRAD_SUFFIX] = grads
+        attrs = {a["name"]: a for a in op_desc.get("attrs", [])}
+        block.append_op(op_desc["type"] + "_grad", inputs=g_inputs,
+                       outputs=g_outputs,
+                       attrs={"__forward_op__": json.dumps(op_desc)})
+        # carry the forward attrs verbatim (already proto-shaped dicts)
+        block.desc["ops"][-1]["attrs"].extend(attrs.values())
+    return grad_map
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    program: Optional[Program] = None):
+    """reference `fluid/backward.py:1369`: append grad ops for `loss` and
+    return [(parameter, gradient)] Variable pairs."""
+    if isinstance(loss, Variable):
+        block = loss.block
+        loss_name = loss.name
+    else:
+        from . import default_main_program
+
+        program = program or default_main_program()
+        block = program.global_block()
+        loss_name = str(loss)
+    stop = set(no_grad_set or ())
+    grad_map = _append_grad_ops(block, [loss_name], stop)
+
+    if parameter_list is not None:
+        params = [p if isinstance(p, str) else p.name
+                  for p in parameter_list]
+    else:
+        params = [v.name for v in block.list_vars()
+                  if v.persistable and v.name in grad_map]
+    out = []
+    for p in params:
+        if p in grad_map and block.has_var(grad_map[p]):
+            out.append((block.var(p), block.var(grad_map[p])))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference `fluid/backward.py:1964`: grad vars of `targets` w.r.t.
+    `inputs` (list of Variables)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    block = targets[0].block
+    stop = set(no_grad_set or ())
+    tg_names = None
+    if target_gradients is not None:
+        tgs = target_gradients if isinstance(target_gradients,
+                                             (list, tuple)) \
+            else [target_gradients]
+        tg_names = [None if g is None else
+                    (g if isinstance(g, str) else g.name) for g in tgs]
+    grad_map = _append_grad_ops(block, [tg.name for tg in targets], stop,
+                                tg_names)
+    outs = []
+    for x in inputs:
+        name = x if isinstance(x, str) else x.name
+        g = grad_map.get(name)
+        outs.append(block.var(g) if g and block.has_var(g) else None)
+    return outs

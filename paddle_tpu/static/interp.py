@@ -110,9 +110,81 @@ def run_block(block_ops: List[Dict[str, Any]], scope: Scope,
         op = OpView(raw)
         fn = OP_TRANSLATORS.get(op.type)
         if fn is None:
+            if op.type.endswith("_grad") and \
+                    op.attr("__forward_op__") is not None:
+                run_grad_op(op, scope, feeds, fetch_holder)
+                continue
             raise NotImplementedError(
                 f"ProgramDesc op {op.type!r} has no TPU translation yet")
         fn(op, scope, feeds, fetch_holder)
+
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def run_grad_op(op: OpView, scope: Scope, feeds, fetch_holder):
+    """Generic grad-op executor: differentiate the embedded forward op by
+    re-tracing its translator under jax.vjp (the TPU-native replacement
+    for per-op GradOpMaker kernels — `fluid/backward.py:1015`).  Input
+    gradients accumulate (the reference inserts sum ops for duplicated
+    grads; here duplicate producers add in place)."""
+    import json
+
+    fwd = OpView(json.loads(op.attr("__forward_op__")))
+    fwd_fn = OP_TRANSLATORS.get(fwd.type)
+    if fwd_fn is None:
+        raise NotImplementedError(
+            f"grad of untranslated op {fwd.type!r}")
+
+    in_args, seen = [], set()
+    for p, args in fwd._in.items():
+        for a in args:
+            if a not in seen:
+                seen.add(a)
+                in_args.append(a)
+    # differentiable = float arrays present in scope
+    diff = [a for a in in_args if a in scope
+            and jnp.issubdtype(jnp.asarray(scope[a]).dtype, jnp.inexact)]
+    out_args = [a for p, args in fwd._out.items() for a in args]
+
+    # discover which declared outputs the translator actually writes
+    # (optional outputs may be skipped, e.g. batch_norm stats in eval)
+    probe = Scope(scope)
+    fwd_fn(fwd, probe, feeds, {})
+    produced = [a for a in out_args if a in probe]
+
+    def fwd_vals(vals):
+        local = Scope(scope)
+        for a, v in zip(diff, vals):
+            local[a] = v
+        fwd_fn(fwd, local, feeds, {})
+        return tuple(local[a] for a in produced)
+
+    primals = tuple(scope[a] for a in diff)
+    outs, vjp = jax.vjp(fwd_vals, primals)
+    # cotangents: @GRAD vars where produced, zeros otherwise (e.g. an
+    # auxiliary output nobody differentiated through)
+    def _conform(c, o):
+        c = jnp.asarray(c).astype(o.dtype)
+        if c.shape == o.shape:
+            return c
+        if c.size == o.size:  # e.g. the [1]-shaped loss seed vs scalar mean
+            return c.reshape(o.shape)
+        return jnp.broadcast_to(c, o.shape)
+
+    cots = tuple(
+        _conform(scope[a + GRAD_SUFFIX], o)
+        if (a + GRAD_SUFFIX) in scope else jnp.zeros_like(o)
+        for a, o in zip(produced, outs))
+    (gin,) = vjp(cots)
+    # only materialize gradients the grad op DECLARES (no_grad_set pruning
+    # removes slots from the op's outputs)
+    declared = {a for p, args in op._out.items() for a in args}
+    for a, g in zip(diff, gin):
+        key = a + GRAD_SUFFIX
+        if key not in declared:
+            continue
+        scope[key] = scope[key] + g if key in scope else g
 
 
 class ProgramRunner:
@@ -159,8 +231,14 @@ class ProgramRunner:
         outs, _ = self._jit(self.params, feeds)
         return outs
 
-    def run_with_scope(self, feeds):
-        outs, scope = self._jit(self.params, feeds)
+    def run_with_scope(self, feeds, params=None):
+        """`params` overrides the construction-time parameter values
+        (same pytree structure → no recompile), so callers can update
+        weights between runs — the static training loop."""
+        if params is not None:
+            params = {k: jnp.asarray(params.get(k, v))
+                      for k, v in self.params.items()}
+        outs, scope = self._jit(params or self.params, feeds)
         return outs, scope
 
 

@@ -1,0 +1,223 @@
+"""Static-program autodiff (static.append_backward / static.gradients)
+— reference `fluid/backward.py:1369,1964`.  Grad ops execute through the
+generic vjp-retrace executor and must match jax.grad of the same math."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static import Program, proto
+
+
+def _linear_softmax_program():
+    """feed x -> matmul W -> add b (via scale trick: use elementwise sum)
+    -> softmax_with_cross_entropy-style loss via mean."""
+    prog = Program()
+    b = prog.global_block()
+    b.create_var("feed", type=proto.VarType.FEED_MINIBATCH, persistable=True)
+    b.create_var("fetch", type=proto.VarType.FETCH_LIST, persistable=True)
+    b.create_var("x", [-1, 4], "float32", need_check_feed=True)
+    b.create_var("w", [4, 3], "float32", persistable=True)
+    b.create_var("h", [-1, 3], "float32")
+    b.create_var("p", [-1, 3], "float32")
+    b.create_var("loss", [1], "float32")
+    b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+    b.append_op("matmul_v2", {"X": "x", "Y": "w"}, {"Out": "h"}, {})
+    b.append_op("softmax", {"X": "h"}, {"Out": "p"}, {"axis": -1})
+    b.append_op("mean", {"X": "p"}, {"Out": "loss"}, {})
+    return prog
+
+
+class TestAppendBackward:
+    def test_matches_jax_grad(self):
+        import jax
+        import jax.numpy as jnp
+
+        prog = _linear_softmax_program()
+        rng = np.random.RandomState(0)
+        w = rng.randn(4, 3).astype(np.float32)
+        x = rng.randn(2, 4).astype(np.float32)
+
+        loss_var = prog.global_block().var("loss")
+        pairs = static.append_backward(loss_var, parameter_list=["w"])
+        assert len(pairs) == 1
+        pvar, gvar = pairs[0]
+        assert pvar.name == "w" and gvar.name == "w@GRAD"
+
+        exe = static.Executor()
+        exe.scope["w"] = w
+        loss, wg = exe.run(prog, feed={"x": x},
+                           fetch_list=["loss", "w@GRAD"])
+
+        def ref(wv):
+            p = jax.nn.softmax(jnp.asarray(x) @ wv, axis=-1)
+            return p.mean()
+
+        want_loss = ref(jnp.asarray(w))
+        want_grad = jax.grad(ref)(jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(loss), want_loss, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(wg), np.asarray(want_grad),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_grad_accumulation_over_reused_var(self):
+        # x used by two branches summed -> dx must accumulate both paths
+        import jax
+        import jax.numpy as jnp
+
+        prog = Program()
+        b = prog.global_block()
+        b.create_var("feed", type=proto.VarType.FEED_MINIBATCH,
+                     persistable=True)
+        b.create_var("x", [2, 2], "float32", need_check_feed=True)
+        b.create_var("a", [2, 2], "float32")
+        b.create_var("c", [2, 2], "float32")
+        b.create_var("s", [2, 2], "float32")
+        b.create_var("loss", [1], "float32")
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.append_op("scale", {"X": "x"}, {"Out": "a"},
+                    {"scale": 2.0, "bias": 0.0, "bias_after_scale": True})
+        b.append_op("softmax", {"X": "x"}, {"Out": "c"}, {"axis": -1})
+        b.append_op("sum", {"X": ["a", "c"]}, {"Out": "s"}, {})
+        b.append_op("mean", {"X": "s"}, {"Out": "loss"}, {})
+
+        x = np.random.RandomState(1).randn(2, 2).astype(np.float32)
+        gx = static.gradients(b.var("loss"), [b.var("x")])[0]
+        assert gx is not None and gx.name == "x@GRAD"
+        exe = static.Executor()
+        (got,) = exe.run(prog, feed={"x": x}, fetch_list=["x@GRAD"])
+
+        def ref(xv):
+            return (2.0 * xv + jax.nn.softmax(xv, -1)).mean()
+
+        want = jax.grad(ref)(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_multi_target_gradients_no_double_count(self):
+        import jax
+        import jax.numpy as jnp
+
+        prog = Program()
+        b = prog.global_block()
+        b.create_var("feed", type=proto.VarType.FEED_MINIBATCH,
+                     persistable=True)
+        b.create_var("x", [2, 2], "float32", need_check_feed=True)
+        b.create_var("h", [2, 2], "float32")
+        b.create_var("t1", [1], "float32")
+        b.create_var("t2", [1], "float32")
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.append_op("scale", {"X": "x"}, {"Out": "h"},
+                    {"scale": 3.0, "bias": 0.0, "bias_after_scale": True})
+        b.append_op("mean", {"X": "h"}, {"Out": "t1"}, {})
+        b.append_op("mean", {"X": "h"}, {"Out": "t2"}, {})
+        gx = static.gradients([b.var("t1"), b.var("t2")], [b.var("x")])[0]
+        x = np.random.RandomState(3).randn(2, 2).astype(np.float32)
+        exe = static.Executor()
+        (got,) = exe.run(prog, feed={"x": x}, fetch_list=[gx.name])
+        want = jax.grad(
+            lambda xv: (3.0 * xv).mean() + (3.0 * xv).mean())(
+                jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5)
+
+    def test_target_gradients_cotangent_honored(self):
+        import jax
+        import jax.numpy as jnp
+
+        prog = Program()
+        b = prog.global_block()
+        b.create_var("feed", type=proto.VarType.FEED_MINIBATCH,
+                     persistable=True)
+        b.create_var("x", [2, 2], "float32", need_check_feed=True)
+        b.create_var("yg", [2, 2], "float32", need_check_feed=True)
+        b.create_var("y", [2, 2], "float32")
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.append_op("feed", {"X": "feed"}, {"Out": "yg"}, {"col": 1})
+        b.append_op("softmax", {"X": "x"}, {"Out": "y"}, {"axis": -1})
+        gx = static.gradients(b.var("y"), [b.var("x")],
+                              target_gradients=[b.var("yg")])[0]
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 2).astype(np.float32)
+        cot = rng.randn(2, 2).astype(np.float32)
+        exe = static.Executor()
+        (got,) = exe.run(prog, feed={"x": x, "yg": cot},
+                         fetch_list=[gx.name])
+        _, vjp = jax.vjp(lambda v: jax.nn.softmax(v, -1), jnp.asarray(x))
+        (want,) = vjp(jnp.asarray(cot))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_no_grad_set_prunes(self):
+        prog = _linear_softmax_program()
+        static.append_backward(prog.global_block().var("loss"),
+                               parameter_list=["w"], no_grad_set={"x"})
+        exe = static.Executor()
+        exe.scope["w"] = np.ones((4, 3), np.float32)
+        x = np.ones((2, 4), np.float32)
+        import pytest
+
+        with pytest.raises(KeyError):
+            exe.run(prog, feed={"x": x}, fetch_list=["x@GRAD"])
+
+    def test_param_update_takes_effect(self):
+        # the static training loop: scope updates between runs must be
+        # seen by the cached compiled runner (mean(x @ w) depends on w;
+        # note mean(softmax(.)) would not)
+        prog = Program()
+        b = prog.global_block()
+        b.create_var("feed", type=proto.VarType.FEED_MINIBATCH,
+                     persistable=True)
+        b.create_var("x", [-1, 4], "float32", need_check_feed=True)
+        b.create_var("w", [4, 3], "float32", persistable=True)
+        b.create_var("h", [-1, 3], "float32")
+        b.create_var("loss", [1], "float32")
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.append_op("matmul_v2", {"X": "x", "Y": "w"}, {"Out": "h"}, {})
+        b.append_op("mean", {"X": "h"}, {"Out": "loss"}, {})
+        static.append_backward(b.var("loss"), parameter_list=["w"])
+        exe = static.Executor()
+        exe.scope["w"] = np.zeros((4, 3), np.float32)
+        x = np.random.RandomState(5).randn(2, 4).astype(np.float32)
+        (l0,) = exe.run(prog, feed={"x": x}, fetch_list=["loss"])
+        (g,) = exe.run(prog, feed={"x": x}, fetch_list=["w@GRAD"])
+        exe.scope["w"] = exe.scope["w"] - 100.0 * np.asarray(g)
+        (l1,) = exe.run(prog, feed={"x": x}, fetch_list=["loss"])
+        assert abs(float(np.asarray(l0))) < 1e-6
+        assert not np.allclose(np.asarray(l0), np.asarray(l1))
+
+    def test_inplace_forward_var_rejected(self):
+        import pytest
+
+        prog = Program()
+        b = prog.global_block()
+        b.create_var("feed", type=proto.VarType.FEED_MINIBATCH,
+                     persistable=True)
+        b.create_var("x", [2, 2], "float32", need_check_feed=True)
+        b.create_var("loss", [1], "float32")
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.append_op("scale", {"X": "x"}, {"Out": "x"},  # overwrites input
+                    {"scale": 2.0, "bias": 0.0, "bias_after_scale": True})
+        b.append_op("mean", {"X": "x"}, {"Out": "loss"}, {})
+        with pytest.raises(ValueError, match="writes its own input"):
+            static.append_backward(b.var("loss"))
+
+    def test_serialized_backward_program_roundtrips(self):
+        # the augmented program (with *_grad ops) survives the
+        # framework.proto codec and still runs
+        prog = _linear_softmax_program()
+        static.append_backward(prog.global_block().var("loss"),
+                               parameter_list=["w"])
+        data = prog.serialize_to_string()
+        clone = Program.parse_from_string(data)
+        types = [op.type for op in clone.global_block().ops]
+        assert "softmax_grad" in types and "matmul_v2_grad" in types
+
+        rng = np.random.RandomState(2)
+        w = rng.randn(4, 3).astype(np.float32)
+        x = rng.randn(2, 4).astype(np.float32)
+        e1, e2 = static.Executor(), static.Executor()
+        e1.scope["w"] = w
+        e2.scope["w"] = w
+        g1 = e1.run(prog, feed={"x": x}, fetch_list=["w@GRAD"])[0]
+        g2 = e2.run(clone, feed={"x": x}, fetch_list=["w@GRAD"])[0]
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-6)
