@@ -1,0 +1,276 @@
+// C inference API — reference counterpart: paddle/fluid/inference/capi_exp/
+// (PD_ConfigCreate / PD_PredictorCreate / PD_PredictorRun handle surface,
+// `pd_config.cc`, `pd_predictor.cc`).
+//
+// TPU-native design: the predictor runtime IS the XLA/PJRT stack driven
+// from Python, so the C surface embeds the CPython interpreter and calls
+// paddle_tpu.inference — one process, zero-copy into numpy, the same
+// compiled-program path a Python caller gets.  Deployment callers link
+// libpaddle_tpu_capi and never touch Python themselves.
+//
+// Thread model: calls are serialized through the GIL (PyGILState); one
+// predictor per thread is the supported pattern, as with the reference's
+// predictor clone-per-thread guidance.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_last_error;
+std::once_flag g_init_once;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+void fetch_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* u = PyUnicode_AsUTF8(s);
+      if (u) msg = u;
+      else PyErr_Clear();  // non-UTF8 str(): keep the generic message
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+void ensure_python() {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL the initializing thread holds, so every entry
+      // point (on any thread) acquires it through PyGILState_Ensure
+      PyEval_SaveThread();
+    }
+  });
+}
+
+struct GIL {
+  PyGILState_STATE st;
+  GIL() { st = PyGILState_Ensure(); }
+  ~GIL() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+extern "C" {
+
+typedef struct PD_Config {
+  std::string prog_file;
+  std::string params_file;
+  bool ir_optim = true;
+  bool memory_optim = false;
+} PD_Config;
+
+typedef struct PD_Predictor {
+  PyObject* predictor = nullptr;       // paddle_tpu.inference.Predictor
+  PyObject* outputs = nullptr;         // list of contiguous f32 ndarrays
+} PD_Predictor;
+
+const char* PD_GetLastError() { return g_last_error.c_str(); }
+
+PD_Config* PD_ConfigCreate() { return new PD_Config(); }
+
+void PD_ConfigDestroy(PD_Config* c) { delete c; }
+
+void PD_ConfigSetModel(PD_Config* c, const char* prog_file,
+                       const char* params_file) {
+  c->prog_file = prog_file ? prog_file : "";
+  c->params_file = params_file ? params_file : "";
+}
+
+void PD_ConfigSwitchIrOptim(PD_Config* c, int on) { c->ir_optim = on != 0; }
+
+void PD_ConfigEnableMemoryOptim(PD_Config* c, int on) {
+  c->memory_optim = on != 0;
+}
+
+PD_Predictor* PD_PredictorCreate(PD_Config* c) {
+  ensure_python();
+  GIL gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) {
+    fetch_py_error();
+    return nullptr;
+  }
+  PyObject* cfg_cls = PyObject_GetAttrString(mod, "Config");
+  PyObject* cfg =
+      cfg_cls ? PyObject_CallFunction(cfg_cls, "ss", c->prog_file.c_str(),
+                                      c->params_file.c_str())
+              : nullptr;
+  if (cfg) {
+    PyObject* r1 = PyObject_CallMethod(cfg, "switch_ir_optim", "i",
+                                       c->ir_optim ? 1 : 0);
+    Py_XDECREF(r1);
+    PyObject* r2 = PyObject_CallMethod(cfg, "enable_memory_optim", "i",
+                                       c->memory_optim ? 1 : 0);
+    Py_XDECREF(r2);
+  }
+  PyObject* pred =
+      cfg ? PyObject_CallMethod(mod, "create_predictor", "O", cfg) : nullptr;
+  Py_XDECREF(cfg);
+  Py_XDECREF(cfg_cls);
+  Py_DECREF(mod);
+  if (!pred) {
+    fetch_py_error();
+    return nullptr;
+  }
+  auto* h = new PD_Predictor();
+  h->predictor = pred;
+  return h;
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (!p) return;
+  GIL gil;
+  Py_XDECREF(p->predictor);
+  Py_XDECREF(p->outputs);
+  delete p;
+}
+
+int PD_PredictorGetInputNum(PD_Predictor* p) {
+  GIL gil;
+  PyObject* names = PyObject_CallMethod(p->predictor, "get_input_names", "");
+  if (!names) {
+    fetch_py_error();
+    return -1;
+  }
+  int n = static_cast<int>(PyList_Size(names));
+  Py_DECREF(names);
+  return n;
+}
+
+// Run with float32 inputs.  input_data[i] points at a contiguous buffer of
+// the product of input_shapes[i][0..input_ndims[i]).  Returns 0 on success.
+int PD_PredictorRunFloat(PD_Predictor* p, const float* const* input_data,
+                         const int* const* input_shapes,
+                         const int* input_ndims, int num_inputs) {
+  GIL gil;
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) {
+    fetch_py_error();
+    return -1;
+  }
+  PyObject* inputs = PyList_New(num_inputs);
+  bool ok = true;
+  for (int i = 0; i < num_inputs && ok; ++i) {
+    int64_t numel = 1;
+    for (int d = 0; d < input_ndims[i]; ++d) numel *= input_shapes[i][d];
+    PyObject* mem = PyMemoryView_FromMemory(
+        reinterpret_cast<char*>(const_cast<float*>(input_data[i])),
+        numel * sizeof(float), PyBUF_READ);
+    PyObject* flat =
+        mem ? PyObject_CallMethod(np, "frombuffer", "Os", mem, "float32")
+            : nullptr;
+    PyObject* shape = PyTuple_New(input_ndims[i]);
+    for (int d = 0; d < input_ndims[i]; ++d) {
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLong(input_shapes[i][d]));
+    }
+    PyObject* arr =
+        flat ? PyObject_CallMethod(flat, "reshape", "O", shape) : nullptr;
+    PyObject* copy = arr ? PyObject_CallMethod(arr, "copy", "") : nullptr;
+    if (copy) {
+      PyList_SET_ITEM(inputs, i, copy);  // steals ref
+    } else {
+      ok = false;
+    }
+    Py_XDECREF(arr);
+    Py_XDECREF(shape);
+    Py_XDECREF(flat);
+    Py_XDECREF(mem);
+  }
+  PyObject* outs =
+      ok ? PyObject_CallMethod(p->predictor, "run", "O", inputs) : nullptr;
+  Py_DECREF(inputs);
+  if (!outs) {
+    fetch_py_error();
+    Py_DECREF(np);
+    return -1;
+  }
+  // normalize each output to a contiguous float32 ndarray
+  PyObject* norm = PyList_New(PyList_Size(outs));
+  for (Py_ssize_t i = 0; i < PyList_Size(outs); ++i) {
+    PyObject* o = PyList_GetItem(outs, i);  // borrowed
+    PyObject* a = PyObject_CallMethod(np, "ascontiguousarray", "Os", o,
+                                      "float32");
+    if (!a) {
+      fetch_py_error();
+      Py_DECREF(norm);
+      Py_DECREF(outs);
+      Py_DECREF(np);
+      return -1;
+    }
+    PyList_SET_ITEM(norm, i, a);
+  }
+  Py_DECREF(outs);
+  Py_DECREF(np);
+  Py_XDECREF(p->outputs);
+  p->outputs = norm;
+  return 0;
+}
+
+int PD_PredictorGetOutputNum(PD_Predictor* p) {
+  GIL gil;
+  return p->outputs ? static_cast<int>(PyList_Size(p->outputs)) : 0;
+}
+
+namespace {
+PyObject* output_at(PD_Predictor* p, int idx) {  // borrowed ref or NULL
+  if (!p || !p->outputs || idx < 0 || idx >= PyList_Size(p->outputs)) {
+    set_error("output index out of range (run the predictor first)");
+    return nullptr;
+  }
+  return PyList_GetItem(p->outputs, idx);
+}
+}  // namespace
+
+int PD_PredictorGetOutputNDim(PD_Predictor* p, int idx) {
+  GIL gil;
+  PyObject* o = output_at(p, idx);
+  if (!o) return -1;
+  PyObject* shape = PyObject_GetAttrString(o, "shape");
+  int n = static_cast<int>(PyTuple_Size(shape));
+  Py_DECREF(shape);
+  return n;
+}
+
+int PD_PredictorGetOutputShape(PD_Predictor* p, int idx, int* shape_out) {
+  GIL gil;
+  PyObject* o = output_at(p, idx);
+  if (!o) return -1;
+  PyObject* shape = PyObject_GetAttrString(o, "shape");
+  for (Py_ssize_t d = 0; d < PyTuple_Size(shape); ++d) {
+    shape_out[d] =
+        static_cast<int>(PyLong_AsLong(PyTuple_GetItem(shape, d)));
+  }
+  Py_DECREF(shape);
+  return 0;
+}
+
+int PD_PredictorGetOutputData(PD_Predictor* p, int idx, float* dst) {
+  GIL gil;
+  PyObject* o = output_at(p, idx);
+  if (!o) return -1;
+  Py_buffer view;
+  if (PyObject_GetBuffer(o, &view, PyBUF_CONTIG_RO) != 0) {
+    fetch_py_error();
+    return -1;
+  }
+  std::memcpy(dst, view.buf, view.len);
+  PyBuffer_Release(&view);
+  return 0;
+}
+
+}  // extern "C"

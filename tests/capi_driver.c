@@ -1,0 +1,90 @@
+/* C driver for the inference C API (tests/test_capi.py compiles and runs
+ * this against a saved model; reference analog: capi_exp tests).
+ * Usage: capi_driver <model_prefix.pdmodel> <N> <D>
+ * Feeds an N x D ramp input, prints output shape and values. */
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+extern PD_Config* PD_ConfigCreate(void);
+extern void PD_ConfigDestroy(PD_Config*);
+extern void PD_ConfigSetModel(PD_Config*, const char*, const char*);
+extern PD_Predictor* PD_PredictorCreate(PD_Config*);
+extern void PD_PredictorDestroy(PD_Predictor*);
+extern int PD_PredictorGetInputNum(PD_Predictor*);
+extern int PD_PredictorRunFloat(PD_Predictor*, const float* const*,
+                                const int* const*, const int*, int);
+extern int PD_PredictorGetOutputNum(PD_Predictor*);
+extern int PD_PredictorGetOutputNDim(PD_Predictor*, int);
+extern int PD_PredictorGetOutputShape(PD_Predictor*, int, int*);
+extern int PD_PredictorGetOutputData(PD_Predictor*, int, float*);
+extern const char* PD_GetLastError(void);
+#ifdef __cplusplus
+}
+#endif
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s model.pdmodel N D\n", argv[0]);
+    return 2;
+  }
+  int n = atoi(argv[2]), d = atoi(argv[3]);
+
+  PD_Config* cfg = PD_ConfigCreate();
+  PD_ConfigSetModel(cfg, argv[1], "");
+  PD_Predictor* pred = PD_PredictorCreate(cfg);
+  if (!pred) {
+    fprintf(stderr, "create failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  printf("inputs=%d\n", PD_PredictorGetInputNum(pred));
+
+  float* x = (float*)malloc(sizeof(float) * n * d);
+  for (int i = 0; i < n * d; ++i) x[i] = (float)i / (n * d);
+  int shape[2];
+  shape[0] = n;
+  shape[1] = d;
+  const float* inputs[1];
+  const int* shapes[1];
+  int ndims[1];
+  inputs[0] = x;
+  shapes[0] = shape;
+  ndims[0] = 2;
+  if (PD_PredictorRunFloat(pred, inputs, shapes, ndims, 1) != 0) {
+    fprintf(stderr, "run failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  int n_out = PD_PredictorGetOutputNum(pred);
+  printf("outputs=%d\n", n_out);
+  for (int i = 0; i < n_out; ++i) {
+    int nd = PD_PredictorGetOutputNDim(pred, i);
+    int oshape[8];
+    if (nd < 0 || nd > 8) {
+      fprintf(stderr, "unexpected ndim %d\n", nd);
+      return 1;
+    }
+    PD_PredictorGetOutputShape(pred, i, oshape);
+    long numel = 1;
+    printf("out%d shape=", i);
+    for (int k = 0; k < nd; ++k) {
+      printf("%d%s", oshape[k], k + 1 < nd ? "x" : "");
+      numel *= oshape[k];
+    }
+    printf("\n");
+    float* buf = (float*)malloc(sizeof(float) * numel);
+    PD_PredictorGetOutputData(pred, i, buf);
+    printf("out%d data=", i);
+    for (long k = 0; k < numel; ++k) printf("%.6f ", buf[k]);
+    printf("\n");
+    free(buf);
+  }
+  free(x);
+  PD_PredictorDestroy(pred);
+  PD_ConfigDestroy(cfg);
+  return 0;
+}

@@ -1,0 +1,80 @@
+"""Inference C API (csrc/capi.cc — reference inference/capi_exp):
+build libpaddle_tpu_capi, compile the C driver, run it as a real external
+process against a saved model, and compare its printed outputs with the
+Python predictor."""
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_capi(tmp_path):
+    build = tmp_path / "build"
+    build.mkdir()
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    subprocess.run(["cmake", *gen, os.path.join(REPO, "csrc")],
+                   cwd=build, check=True, capture_output=True)
+    r = subprocess.run(["cmake", "--build", ".", "--target",
+                        "paddle_tpu_capi"], cwd=build,
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        # CMake omits the target when no Python embed dev env exists
+        pytest.skip("paddle_tpu_capi target unavailable: "
+                    + r.stderr[-300:])
+    lib = build / "libpaddle_tpu_capi.so"
+    assert lib.exists()
+    drv = build / "capi_driver"
+    subprocess.run(
+        ["g++", os.path.join(REPO, "tests", "capi_driver.c"),
+         "-o", str(drv), "-L", str(build), "-lpaddle_tpu_capi",
+         f"-Wl,-rpath,{build}"],
+        check=True, capture_output=True)
+    return drv
+
+
+@pytest.mark.skipif(shutil.which("cmake") is None or
+                    shutil.which("g++") is None,
+                    reason="native toolchain unavailable")
+def test_c_driver_matches_python_predictor(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    prefix = str(tmp_path / "model")
+    static.save_inference_model(
+        prefix, layer=net, input_spec=[static.InputSpec([None, 4],
+                                                        "float32")])
+
+    drv = _build_capi(tmp_path)
+
+    n, d = 3, 4
+    env = dict(os.environ)
+    # the embedded interpreter must see the venv packages + repo and run
+    # jax on CPU with a single device
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, sysconfig.get_path("purelib")] +
+        [p for p in sys.path if p.endswith("site-packages")])
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([str(drv), prefix + ".pdmodel", str(n), str(d)],
+                       capture_output=True, text=True, env=env,
+                       timeout=300)
+    assert r.returncode == 0, r.stderr + r.stdout
+    lines = r.stdout.strip().splitlines()
+    assert "inputs=1" in lines[0]
+    assert "outputs=1" in lines[1]
+    assert lines[2].startswith("out0 shape=3x2")
+    got = np.array([float(v) for v in lines[3].split("=")[1].split()],
+                   np.float32).reshape(n, 2)
+
+    x = (np.arange(n * d, dtype=np.float32) / (n * d)).reshape(n, d)
+    want = np.asarray(net(paddle.to_tensor(x)).numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
