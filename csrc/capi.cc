@@ -22,7 +22,8 @@
 
 namespace {
 
-std::string g_last_error;
+// per-thread so a reader never races another thread's reassignment
+thread_local std::string g_last_error;
 std::once_flag g_init_once;
 
 void set_error(const std::string& msg) { g_last_error = msg; }
@@ -36,9 +37,11 @@ void fetch_py_error() {
     if (s) {
       const char* u = PyUnicode_AsUTF8(s);
       if (u) msg = u;
-      else PyErr_Clear();  // non-UTF8 str(): keep the generic message
       Py_DECREF(s);
     }
+    // str() or AsUTF8 may themselves have raised; never leave an
+    // exception pending for the next CPython call
+    PyErr_Clear();
   }
   Py_XDECREF(type);
   Py_XDECREF(value);
@@ -113,10 +116,19 @@ PD_Predictor* PD_PredictorCreate(PD_Config* c) {
   if (cfg) {
     PyObject* r1 = PyObject_CallMethod(cfg, "switch_ir_optim", "i",
                                        c->ir_optim ? 1 : 0);
+    PyObject* r2 = r1 ? PyObject_CallMethod(cfg, "enable_memory_optim", "i",
+                                            c->memory_optim ? 1 : 0)
+                      : nullptr;
+    bool switch_ok = r1 && r2;
     Py_XDECREF(r1);
-    PyObject* r2 = PyObject_CallMethod(cfg, "enable_memory_optim", "i",
-                                       c->memory_optim ? 1 : 0);
     Py_XDECREF(r2);
+    if (!switch_ok) {
+      fetch_py_error();
+      Py_DECREF(cfg);
+      Py_XDECREF(cfg_cls);
+      Py_DECREF(mod);
+      return nullptr;
+    }
   }
   PyObject* pred =
       cfg ? PyObject_CallMethod(mod, "create_predictor", "O", cfg) : nullptr;

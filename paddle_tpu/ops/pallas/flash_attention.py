@@ -340,7 +340,15 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
 def flash_attention_fwd(q, k, v, mask=None, is_causal=False, scale=None,
-                        block_q=256, block_k=256):
+                        block_q=512, block_k=512):
+    # 512x512 blocks won every Pallas-preferred shape in the measured
+    # sweep (BENCH_kernels.json); for sequences they don't divide, shrink
+    # to the largest power-of-two block that tiles rather than losing the
+    # kernel entirely
+    while block_q > 128 and q.shape[-2] % block_q:
+        block_q //= 2
+    while block_k > 128 and k.shape[-2] % block_k:
+        block_k //= 2
     """q,k,v: [B,H,S,D].  Uses the Pallas kernels when mask is None and shapes
     tile; otherwise the XLA composed reference.  Fully differentiable with a
     Pallas backward (dq/dk/dv kernels recomputing P from the saved
