@@ -2,6 +2,8 @@
 `python/paddle/distributed/fleet/`)."""
 from . import meta_optimizers, meta_parallel, utils
 from .base import Fleet, PaddleCloudRoleMaker, RoleMakerBase, fleet
+from .dataset import (DatasetBase, DatasetFactory, InMemoryDataset,
+                      QueueDataset)
 from .data_parallel import DataParallel
 from .sharded_step import ShardedTrainStep
 from .strategy import DistributedStrategy

@@ -28,6 +28,12 @@ class SGD(Optimizer):
     def _update_param(self, p, g, slot, lr, step):
         return p - lr * g, slot
 
+    def _append_static_update(self, block, param, grad, lr_name):
+        block.append_op("sgd",
+                        {"Param": param.name, "Grad": grad.name,
+                         "LearningRate": lr_name},
+                        {"ParamOut": param.name}, {})
+
 
 class Momentum(Optimizer):
     """reference `operators/optimizers/momentum_op.h` (use_nesterov attr)."""
@@ -50,6 +56,17 @@ class Momentum(Optimizer):
         else:
             new_p = p - lr * v
         return new_p, {"velocity": v}
+
+    def _append_static_update(self, block, param, grad, lr_name):
+        vname = param.name + "_velocity_0"
+        block.create_var(vname, param.shape, "float32", persistable=True)
+        block.append_op(
+            "momentum",
+            {"Param": param.name, "Grad": grad.name, "Velocity": vname,
+             "LearningRate": lr_name},
+            {"ParamOut": param.name, "VelocityOut": vname},
+            {"mu": float(self._momentum),
+             "use_nesterov": bool(self._use_nesterov)})
 
 
 class Adam(Optimizer):

@@ -200,10 +200,68 @@ class Optimizer:
 
 
 def _minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+    from ..static.program import Variable as _StaticVar
+
+    if isinstance(loss, _StaticVar):
+        return _minimize_static(self, loss, parameters, no_grad_set)
     loss.backward()
     self.step()
     self.clear_grad()
     return None, [(p, p.grad) for p in (self._parameters or [])]
 
 
+_STATIC_LR_COUNTER = [0]
+
+
+def _minimize_static(self, loss, parameters=None, no_grad_set=None):
+    """Static-graph minimize (reference `fluid/optimizer.py` minimize):
+    append_backward + optimizer ops into the loss's program, so
+    Executor.run becomes a real training step (the Executor writes
+    updated persistable vars back into its scope)."""
+    from ..static import append_backward
+
+    block = loss.block
+    pairs = append_backward(loss, parameter_list=parameters,
+                            no_grad_set=no_grad_set)
+    _STATIC_LR_COUNTER[0] += 1
+    lr_name = f"learning_rate_{_STATIC_LR_COUNTER[0]}"
+    block.create_var(lr_name, [1], "float32", persistable=True)
+    block.append_op("fill_constant", {}, {"Out": lr_name},
+                    {"shape": [1], "dtype": 5,
+                     "value": float(self.get_lr())})
+    # remember the fill op so set_lr can rewrite it (and bump the program
+    # version, which is part of the Executor's compile-cache key)
+    if not hasattr(self, "_static_lr_sites"):
+        self._static_lr_sites = []
+    self._static_lr_sites.append(
+        (block.program, block.desc["ops"][-1]))
+    for p, g in pairs:
+        self._append_static_update(block, p, g, lr_name)
+    return None, pairs
+
+
+def _append_static_update(self, block, param, grad, lr_name):
+    """Emit this optimizer's update op (override per subclass; reference
+    `optimizer.py _append_optimize_op`)."""
+    raise NotImplementedError(
+        f"{type(self).__name__} has no static-graph update op lowering "
+        "yet; use SGD or Momentum for static minimize()")
+
+
+_orig_set_lr = Optimizer.set_lr
+
+
+def _set_lr(self, value):
+    _orig_set_lr(self, value)
+    # propagate into any static programs this optimizer minimized
+    for program, fill_desc in getattr(self, "_static_lr_sites", ()):
+        for a in fill_desc.get("attrs", []):
+            if a.get("name") == "value":
+                a["f"] = float(value)
+        program.desc["version"]["version"] = \
+            program.desc["version"].get("version", 0) + 1
+
+
 Optimizer.minimize = _minimize
+Optimizer._append_static_update = _append_static_update
+Optimizer.set_lr = _set_lr

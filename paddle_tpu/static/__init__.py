@@ -69,11 +69,16 @@ class Executor:
         if isinstance(program, Program):
             from .interp import ProgramRunner
 
-            base = dict(scope if scope is not None else self.scope)
-            base.update(getattr(program, "_param_scope", None) or {})
-            # key includes the op count so appending ops (e.g.
-            # append_backward) invalidates the compiled runner
-            key = (id(program), len(program.desc["blocks"][0]["ops"]))
+            # layer-capture params are the DEFAULTS; the live scope (which
+            # receives persistable write-back after each run) overrides
+            # them, so training on a program_from_layer program advances
+            base = dict(getattr(program, "_param_scope", None) or {})
+            base.update(scope if scope is not None else self.scope)
+            # key includes the op count (append_backward/minimize add ops)
+            # and the desc version (set_lr rewrites attrs + bumps it) so
+            # program mutations invalidate the compiled runner
+            key = (id(program), len(program.desc["blocks"][0]["ops"]),
+                   program.desc.get("version", {}).get("version", 0))
             runner = self._runners.get(key)
             if runner is None:
                 runner = ProgramRunner(program, base)
@@ -85,6 +90,14 @@ class Executor:
             # weight updates between runs take effect
             fetch_vals, final_scope = runner.run_with_scope(feeds,
                                                             params=base)
+            # persistable state (params, optimizer slots, lr) written by
+            # the program flows back into the scope — Executor.run on a
+            # minimize()d program is a full training step (reference
+            # executor semantics: the Scope owns persistables)
+            for v in program.persistable_vars():
+                if v.name in final_scope:
+                    target = scope if scope is not None else self.scope
+                    target[v.name] = final_scope[v.name]
             if fetch_list:
                 out = []
                 for f in fetch_list:
@@ -102,6 +115,45 @@ class Executor:
             outs = program(**feed)
             return outs if isinstance(outs, (list, tuple)) else [outs]
         return []
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Dataset-driven training loop (reference
+        `fluid/executor.py:1663` -> MultiTrainer/HogwildWorker;
+        `framework/data_set.h:157`).  Iterates the dataset's batches
+        through the compiled program; optimizer ops inside the program
+        update persistable state between batches."""
+        if dataset is None:
+            raise ValueError("dataset is required")
+        if thread:
+            dataset._set_thread(thread)
+        names = [getattr(v, "name", str(v)) for v in dataset.use_vars]
+        step = 0
+        for batch in dataset.iter_batches():
+            feed = {n: batch[n] for n in names}
+            outs = self.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+            step += 1
+            if fetch_list and (debug or step % print_period == 0):
+                import numpy as _np
+
+                labels = fetch_info or [getattr(f, "name", f)
+                                        for f in fetch_list]
+                msg = ", ".join(
+                    f"{k}={_np.asarray(v).ravel()[:4]}"
+                    for k, v in zip(labels, outs))
+                print(f"[train_from_dataset] step {step}: {msg}")
+        return None
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """reference `fluid/executor.py:1540`; same loop, caller supplies
+        an inference program (no optimizer ops)."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
 
 
 def _combined_params_bytes(program: Program, scope: dict) -> bytes:

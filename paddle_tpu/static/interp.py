@@ -232,12 +232,16 @@ class ProgramRunner:
         return outs
 
     def run_with_scope(self, feeds, params=None):
-        """`params` overrides the construction-time parameter values
-        (same pytree structure → no recompile), so callers can update
-        weights between runs — the static training loop."""
+        """`params` overrides the construction-time parameter values, so
+        callers can update weights between runs — the static training
+        loop.  Keys beyond the construction set (e.g. optimizer slot vars
+        the program created on its first run) are merged in too; a new
+        key changes the pytree structure and costs one retrace, after
+        which the structure is stable."""
         if params is not None:
-            params = {k: jnp.asarray(params.get(k, v))
-                      for k, v in self.params.items()}
+            merged = dict(self.params)
+            merged.update({k: jnp.asarray(v) for k, v in params.items()})
+            params = merged
         outs, scope = self._jit(params or self.params, feeds)
         return outs, scope
 
@@ -947,3 +951,35 @@ def _range(op, scope, feeds, fetches):
 def _cumsum(op, scope, feeds, fetches):
     x = scope.fetch(op.input("X"))
     scope[op.output("Out")] = jnp.cumsum(x, axis=op.attr("axis", -1))
+
+
+# ---------------------------------------------------------------------------
+# optimizer ops (reference operators/optimizers/) — executed in-program so
+# Executor.run on a minimize()d program IS a training step; the Executor
+# writes updated persistable vars back into its scope between runs.
+# ---------------------------------------------------------------------------
+@register("sgd")
+def _sgd(op, scope, feeds, fetches):
+    p = scope.fetch(op.input("Param"))
+    g = scope.fetch(op.input("Grad"))
+    lr = jnp.reshape(scope.fetch(op.input("LearningRate")), ())
+    scope[op.output("ParamOut")] = p - lr * g
+
+
+@register("momentum")
+def _momentum_op(op, scope, feeds, fetches):
+    p = scope.fetch(op.input("Param"))
+    g = scope.fetch(op.input("Grad"))
+    lr = jnp.reshape(scope.fetch(op.input("LearningRate")), ())
+    vname = op.input("Velocity")
+    v = scope.get(vname)
+    if v is None:
+        v = jnp.zeros_like(p)
+    mu = op.attr("mu", 0.9)
+    v_new = mu * v + g
+    if op.attr("use_nesterov", False):
+        p_new = p - lr * (g + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    scope[op.output("ParamOut")] = p_new
+    scope[op.output("VelocityOut")] = v_new

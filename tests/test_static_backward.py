@@ -184,6 +184,65 @@ class TestAppendBackward:
         assert abs(float(np.asarray(l0))) < 1e-6
         assert not np.allclose(np.asarray(l0), np.asarray(l1))
 
+    def _train_program(self):
+        prog = Program()
+        b = prog.global_block()
+        b.create_var("feed", type=proto.VarType.FEED_MINIBATCH,
+                     persistable=True)
+        b.create_var("x", [-1, 4], "float32", need_check_feed=True)
+        b.create_var("w", [4, 1], "float32", persistable=True)
+        b.create_var("h", [-1, 1], "float32")
+        b.create_var("loss", [1], "float32")
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.append_op("matmul_v2", {"X": "x", "Y": "w"}, {"Out": "h"}, {})
+        b.append_op("mean", {"X": "h"}, {"Out": "loss"}, {})
+        return prog, b
+
+    def test_static_momentum_velocity_persists(self):
+        # velocity accumulates across Executor.run calls (d loss/d w is
+        # constant = mean(x)/1, so with momentum the per-step delta GROWS;
+        # if velocity were re-zeroed each run it would stay constant)
+        from paddle_tpu import optimizer
+
+        prog, b = self._train_program()
+        optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
+            b.var("loss"))
+        exe = static.Executor()
+        exe.scope["w"] = np.zeros((4, 1), np.float32)
+        x = np.ones((2, 4), np.float32)
+        ws = [exe.scope["w"].copy()]
+        for _ in range(3):
+            exe.run(prog, feed={"x": x}, fetch_list=["loss"])
+            ws.append(np.asarray(exe.scope["w"]).copy())
+        d1 = np.abs(ws[1] - ws[0]).max()
+        d2 = np.abs(ws[2] - ws[1]).max()
+        d3 = np.abs(ws[3] - ws[2]).max()
+        assert d2 > d1 * 1.5 and d3 > d2 * 1.2  # momentum build-up
+
+    def test_static_set_lr_takes_effect(self):
+        from paddle_tpu import optimizer
+
+        prog, b = self._train_program()
+        opt = optimizer.SGD(learning_rate=0.1)
+        opt.minimize(b.var("loss"))
+        exe = static.Executor()
+        exe.scope["w"] = np.zeros((4, 1), np.float32)
+        x = np.ones((2, 4), np.float32)
+        exe.run(prog, feed={"x": x})
+        w1 = np.asarray(exe.scope["w"]).copy()
+        opt.set_lr(0.0)  # freeze: further runs must not move w
+        exe.run(prog, feed={"x": x})
+        np.testing.assert_allclose(np.asarray(exe.scope["w"]), w1)
+
+    def test_unsupported_static_optimizer_raises(self):
+        import pytest
+
+        from paddle_tpu import optimizer
+
+        prog, b = self._train_program()
+        with pytest.raises(NotImplementedError, match="static-graph"):
+            optimizer.Adam(learning_rate=1e-3).minimize(b.var("loss"))
+
     def test_inplace_forward_var_rejected(self):
         import pytest
 
