@@ -28,6 +28,9 @@ import threading
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _LIB_CANDIDATES = (
+    # packaged location (setup.py copies the built lib here for wheels)
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "lib", "libpaddle_tpu_rt.so"),
     os.path.join(_REPO_ROOT, "build", "libpaddle_tpu_rt.so"),
     os.path.join(_REPO_ROOT, "csrc", "libpaddle_tpu_rt.so"),
 )

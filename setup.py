@@ -1,0 +1,60 @@
+"""Packaging for paddle_tpu (reference counterpart: the cmake +
+`paddle/scripts/paddle_build.sh` build system, reduced to what a
+Python-first TPU runtime needs: a pip-installable package plus the
+native runtime library built via CMake at install time when a toolchain
+is present — `csrc/` is otherwise auto-built on first import by
+`paddle_tpu.core.native`)."""
+import os
+import shutil
+import subprocess
+
+from setuptools import find_packages, setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        self._build_native()
+        super().run()
+
+    def _build_native(self):
+        root = os.path.dirname(os.path.abspath(__file__))
+        csrc = os.path.join(root, "csrc")
+        if not (shutil.which("cmake") and os.path.isdir(csrc)):
+            return  # runtime falls back to first-import auto-build
+        # build into <root>/build — the first path core/native.py searches
+        build = os.path.join(root, "build")
+        os.makedirs(build, exist_ok=True)
+        gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+        try:
+            subprocess.run(["cmake", *gen, csrc], cwd=build, check=True)
+            subprocess.run(["cmake", "--build", "."], cwd=build, check=True)
+        except subprocess.CalledProcessError:
+            return  # optional at package-build time
+        # ship the runtime lib inside the package so installed copies
+        # (wheel/site-packages) find it without a toolchain
+        libdir = os.path.join(root, "paddle_tpu", "lib")
+        os.makedirs(libdir, exist_ok=True)
+        for so in ("libpaddle_tpu_rt.so", "libpaddle_tpu_capi.so"):
+            src = os.path.join(build, so)
+            if os.path.exists(src):
+                shutil.copy2(src, os.path.join(libdir, so))
+
+
+setup(
+    name="paddle-tpu",
+    version="0.1.0",
+    description=("TPU-native deep-learning framework with "
+                 "PaddlePaddle-v2.1-class capabilities (JAX/XLA/Pallas "
+                 "compute, C++ runtime)"),
+    packages=find_packages(include=["paddle_tpu", "paddle_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["jax", "numpy"],
+    extras_require={
+        "full": ["flax", "optax", "orbax-checkpoint", "einops", "pillow",
+                 "scipy"],
+    },
+    cmdclass={"build_py": BuildWithNative},
+    include_package_data=True,
+    package_data={"paddle_tpu": ["lib/*.so"]},
+)

@@ -1,0 +1,61 @@
+"""Build-system / CI tooling (reference: paddle_build.sh + tools/):
+packaging metadata, op micro-bench harness, and the perf regression gate."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+def test_setup_metadata_parses():
+    r = subprocess.run([sys.executable, "setup.py", "--name"], cwd=REPO,
+                       capture_output=True, text=True, env=ENV, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip().splitlines()[-1] == "paddle-tpu"
+
+
+def test_op_bench_and_gate(tmp_path):
+    base = str(tmp_path / "base.json")
+    r = subprocess.run(
+        [sys.executable, "tools/op_bench.py", "--iters", "2",
+         "--ops", "matmul,elementwise_add", "--out", base],
+        cwd=REPO, capture_output=True, text=True, env=ENV, timeout=300)
+    assert r.returncode == 0, r.stderr
+    with open(base) as f:
+        data = json.load(f)
+    assert {x["op"] for x in data["results"]} == {"matmul",
+                                                  "elementwise_add"}
+
+    # gate passes against itself...
+    ok = subprocess.run(
+        [sys.executable, "tools/check_op_benchmark_result.py", base, base],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stdout
+    # ...and fails on a fabricated 10x regression
+    data["results"][0]["mean_us"] *= 10
+    worse = str(tmp_path / "worse.json")
+    with open(worse, "w") as f:
+        json.dump(data, f)
+    bad = subprocess.run(
+        [sys.executable, "tools/check_op_benchmark_result.py", base, worse],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1 and "FAIL" in bad.stdout
+
+    # dropped coverage fails; empty results refuse to pass
+    data["results"] = data["results"][1:]
+    dropped = str(tmp_path / "dropped.json")
+    with open(dropped, "w") as f:
+        json.dump(data, f)
+    miss = subprocess.run(
+        [sys.executable, "tools/check_op_benchmark_result.py", base,
+         dropped], cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert miss.returncode == 1 and "[missing]" in miss.stdout
+    empty = str(tmp_path / "empty.json")
+    with open(empty, "w") as f:
+        json.dump({"results": []}, f)
+    e = subprocess.run(
+        [sys.executable, "tools/check_op_benchmark_result.py", base,
+         empty], cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert e.returncode == 2

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Build + test driver (reference counterpart: paddle/scripts/paddle_build.sh,
+# reduced to the TPU build's real steps).
+#
+#   tools/build_and_test.sh [native|test|bench|all]
+#
+# native : cmake-build csrc/ (runtime lib + C API)
+# test   : full pytest suite on the 8-device virtual CPU mesh
+# bench  : flagship benchmark on the attached accelerator
+# all    : native + test
+set -euo pipefail
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+MODE="${1:-all}"
+
+build_native() {
+  # <root>/build is the first path core/native.py searches for the lib
+  mkdir -p "$ROOT/build"
+  cd "$ROOT/build"
+  if command -v ninja >/dev/null; then cmake -G Ninja "$ROOT/csrc"
+  else cmake "$ROOT/csrc"; fi
+  cmake --build .
+}
+
+run_tests() {
+  cd "$ROOT"
+  python -m pytest tests/ -x -q
+}
+
+run_bench() {
+  cd "$ROOT"
+  python bench.py
+}
+
+case "$MODE" in
+  native) build_native ;;
+  test)   run_tests ;;
+  bench)  run_bench ;;
+  all)    build_native; run_tests ;;
+  *) echo "usage: $0 [native|test|bench|all]" >&2; exit 2 ;;
+esac

@@ -1,0 +1,57 @@
+"""CI op-perf regression gate.
+
+Reference counterpart: `tools/check_op_benchmark_result.py` (compares op
+benchmark output across a PR; used by paddle_build.sh CI).  Compares two
+`tools/op_bench.py --out` files and fails (exit 1) when any op regressed
+beyond the threshold.
+
+Usage:
+    python tools/check_op_benchmark_result.py base.json new.json \
+        [--threshold 1.25]
+"""
+import argparse
+import json
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("base")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float, default=1.25,
+                    help="fail when new/base mean exceeds this ratio")
+    args = ap.parse_args()
+
+    def load(path):
+        with open(path) as f:
+            data = json.load(f)
+        return {r["op"]: r["mean_us"] for r in data["results"]}
+
+    base, new = load(args.base), load(args.new)
+    if not new:
+        print("no results in the new benchmark output — refusing to pass")
+        sys.exit(2)
+    failed = []
+    for op, t_new in sorted(new.items()):
+        t_base = base.get(op)
+        if t_base is None:
+            print(f"[new-op] {op}: {t_new:.2f}us (no baseline)")
+            continue
+        ratio = t_new / t_base if t_base else float("inf")
+        status = "FAIL" if ratio > args.threshold else "ok"
+        print(f"[{status}] {op}: {t_base:.2f} -> {t_new:.2f}us "
+              f"({ratio:.2f}x)")
+        if ratio > args.threshold:
+            failed.append(op)
+    for op in sorted(set(base) - set(new)):
+        # coverage must not silently shrink
+        print(f"[missing] {op}: present in baseline, absent from new run")
+        failed.append(op)
+    if failed:
+        print(f"op perf gate failed for: {', '.join(failed)}")
+        sys.exit(1)
+    print("all ops within threshold")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Per-op micro-benchmark harness.
+
+Reference counterpart: `operators/benchmark/op_tester.cc` (config-driven
+per-op latency) and `tests/unittests/benchmark.py`.  Emits one JSON
+object per op to stdout (and optionally a file) so
+`tools/check_op_benchmark_result.py` can gate regressions in CI.
+
+Usage:
+    python tools/op_bench.py [--out ops.json] [--iters 50] [--ops a,b,c]
+
+Each benchmarked op runs as its own jitted executable on the default
+device with a host readback fence (the tunneled TPU defers execution
+past block_until_ready).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fence(x):
+    return float(np.asarray(jax.device_get(jnp.sum(x.astype(jnp.float32)))))
+
+
+def bench_one(name, fn, args, iters):
+    jfn = jax.jit(fn)
+    _fence(jfn(*args))  # compile
+    t0 = time.perf_counter()
+    acc = None
+    for _ in range(iters):
+        acc = jfn(*args)
+    _fence(acc)
+    dt = (time.perf_counter() - t0) / iters
+    return {"op": name, "mean_us": round(dt * 1e6, 2), "iters": iters}
+
+
+def default_suite():
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(1024, 1024).astype(np.float32))
+    b = jnp.asarray(rng.randn(1024, 1024).astype(np.float32))
+    img = jnp.asarray(rng.randn(8, 64, 56, 56).astype(np.float32))
+    ker = jnp.asarray(rng.randn(64, 64, 3, 3).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, 1000, (64, 128)))
+    emb = jnp.asarray(rng.randn(1000, 256).astype(np.float32))
+    logits = jnp.asarray(rng.randn(256, 1000).astype(np.float32))
+
+    from jax import lax
+
+    dn = lax.conv_dimension_numbers(img.shape, ker.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    return {
+        "matmul": (lambda x, y: x @ y, (a, b)),
+        "elementwise_add": (lambda x, y: x + y, (a, b)),
+        "softmax": (lambda x: jax.nn.softmax(x, -1), (logits,)),
+        "layer_norm": (
+            lambda x: (x - x.mean(-1, keepdims=True))
+            * jax.lax.rsqrt(x.var(-1, keepdims=True) + 1e-5), (a,)),
+        "conv2d": (
+            lambda x, k: lax.conv_general_dilated(
+                x, k, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn),
+            (img, ker)),
+        "embedding": (lambda t, w: w[t], (ids, emb)),
+        "reduce_sum": (lambda x: x.sum(), (a,)),
+        "transpose": (lambda x: x.T.copy(), (a,)),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset of the suite")
+    args = ap.parse_args()
+
+    suite = default_suite()
+    if args.ops:
+        pick = set(args.ops.split(","))
+        suite = {k: v for k, v in suite.items() if k in pick}
+    results = []
+    for name, (fn, fargs) in suite.items():
+        r = bench_one(name, fn, fargs, args.iters)
+        results.append(r)
+        print(json.dumps(r))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"device": str(jax.devices()[0]),
+                       "results": results}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
