@@ -28,11 +28,13 @@ import threading
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _LIB_CANDIDATES = (
-    # packaged location (setup.py copies the built lib here for wheels)
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 "lib", "libpaddle_tpu_rt.so"),
+    # source-tree builds first so a rebuild is never shadowed by a stale
+    # packaged copy; the packaged location (setup.py puts the lib there
+    # for wheels) is the fallback when no source build exists
     os.path.join(_REPO_ROOT, "build", "libpaddle_tpu_rt.so"),
     os.path.join(_REPO_ROOT, "csrc", "libpaddle_tpu_rt.so"),
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "lib", "libpaddle_tpu_rt.so"),
 )
 
 _lib = None

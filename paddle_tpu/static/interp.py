@@ -983,3 +983,237 @@ def _momentum_op(op, scope, feeds, fetches):
         p_new = p - lr * v_new
     scope[op.output("ParamOut")] = p_new
     scope[op.output("VelocityOut")] = v_new
+
+
+# ---------------------------------------------------------------------------
+# reductions / comparisons / logicals (reference reduce_ops/, controlflow/
+# compare_op.cc + logical_op.cc macro families)
+# ---------------------------------------------------------------------------
+def _reduce_axes(op, x):
+    if op.attr("reduce_all", False):
+        return None
+    dims = op.attr("dim", [0]) or [0]
+    return tuple(int(d) % x.ndim for d in dims)
+
+
+for _name, _red in [
+    ("reduce_sum", jnp.sum), ("reduce_mean", jnp.mean),
+    ("reduce_max", jnp.max), ("reduce_min", jnp.min),
+    ("reduce_prod", jnp.prod), ("reduce_all", jnp.all),
+    ("reduce_any", jnp.any),
+]:
+    def _mkr(red):
+        def _op(op, scope, feeds, fetches):
+            x = scope.fetch(op.input("X"))
+            scope[op.output("Out")] = red(
+                x, axis=_reduce_axes(op, x),
+                keepdims=op.attr("keep_dim", False))
+        return _op
+    OP_TRANSLATORS[_name] = _mkr(_red)
+
+for _name, _cmp in [
+    ("equal", jnp.equal), ("not_equal", jnp.not_equal),
+    ("less_than", jnp.less), ("less_equal", jnp.less_equal),
+    ("greater_than", jnp.greater), ("greater_equal", jnp.greater_equal),
+    ("logical_and", jnp.logical_and), ("logical_or", jnp.logical_or),
+    ("logical_xor", jnp.logical_xor),
+]:
+    def _mkc(fn):
+        def _op(op, scope, feeds, fetches):
+            scope[op.output("Out")] = fn(scope.fetch(op.input("X")),
+                                         scope.fetch(op.input("Y")))
+        return _op
+    OP_TRANSLATORS[_name] = _mkc(_cmp)
+
+
+@register("logical_not")
+def _logical_not(op, scope, feeds, fetches):
+    scope[op.output("Out")] = jnp.logical_not(scope.fetch(op.input("X")))
+
+
+@register("where")
+def _where(op, scope, feeds, fetches):
+    scope[op.output("Out")] = jnp.where(
+        scope.fetch(op.input("Condition")), scope.fetch(op.input("X")),
+        scope.fetch(op.input("Y")))
+
+
+@register("fill_zeros_like", "fill_zeros_like2")
+def _fill_zeros_like(op, scope, feeds, fetches):
+    scope[op.output("Out")] = jnp.zeros_like(scope.fetch(op.input("X")))
+
+
+@register("clip_by_norm")
+def _clip_by_norm(op, scope, feeds, fetches):
+    x = scope.fetch(op.input("X"))
+    mn = op.attr("max_norm", 1.0)
+    n = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scope[op.output("Out")] = jnp.where(n > mn, x * (mn / n), x)
+
+
+@register("p_norm")
+def _p_norm(op, scope, feeds, fetches):
+    x = scope.fetch(op.input("X"))
+    p = op.attr("porder", 2.0)
+    axis = op.attr("axis", -1)
+    keep = op.attr("keepdim", False)
+    eps = op.attr("epsilon", 1e-12)
+    if op.attr("asvector", False):
+        x = x.reshape(-1)
+        axis = 0
+    ax = jnp.abs(x)
+    if p == float("inf"):
+        out = ax.max(axis=axis, keepdims=keep)
+    elif p == float("-inf"):
+        out = ax.min(axis=axis, keepdims=keep)
+    elif p == 0:
+        out = (ax > 0).sum(axis=axis, keepdims=keep).astype(x.dtype)
+    else:
+        out = (jnp.sum(ax ** p, axis=axis, keepdims=keep)
+               + eps) ** (1.0 / p)
+    scope[op.output("Out")] = out
+
+
+@register("norm")
+def _norm_op(op, scope, feeds, fetches):
+    # reference norm_op: l2-normalize along `axis`, Norm aux output
+    x = scope.fetch(op.input("X"))
+    axis = op.attr("axis", -1)
+    eps = op.attr("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    scope[op.output("Out")] = x / n
+    if op.output("Norm"):
+        scope[op.output("Norm")] = n
+
+
+@register("sigmoid_cross_entropy_with_logits")
+def _sce_logits(op, scope, feeds, fetches):
+    x = scope.fetch(op.input("X"))
+    label = scope.fetch(op.input("Label")).astype(x.dtype)
+    # max(x,0) - x*z + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = op.attr("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if op.attr("normalize", False):
+        denom = jnp.maximum((label != ignore).sum(), 1)
+        loss = loss / denom
+    scope[op.output("Out")] = loss
+
+
+@register("cross_entropy", "cross_entropy2")
+def _cross_entropy_op(op, scope, feeds, fetches):
+    # input X holds PROBABILITIES (softmax output) in the reference op
+    x = scope.fetch(op.input("X"))
+    label = scope.fetch(op.input("Label"))
+    if op.attr("soft_label", False):
+        loss = -(label * jnp.log(jnp.clip(x, 1e-12, None))).sum(
+            -1, keepdims=True)
+    else:
+        ignore = op.attr("ignore_index", -100)
+        lab = label.reshape(label.shape[0]).astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            x, jnp.clip(lab, 0, x.shape[-1] - 1)[:, None], axis=-1)
+        loss = -jnp.log(jnp.clip(picked, 1e-12, None))
+        loss = jnp.where(lab[:, None] == ignore, 0.0, loss)
+    scope[op.output("Y") or op.output("Out")] = loss
+
+
+@register("group_norm")
+def _group_norm(op, scope, feeds, fetches):
+    x = scope.fetch(op.input("X"))
+    groups = op.attr("groups", 1)
+    eps = op.attr("epsilon", 1e-5)
+    n, c = x.shape[:2]
+    xg = x.reshape(n, groups, -1)
+    mu = xg.mean(-1, keepdims=True)
+    var = xg.var(-1, keepdims=True)
+    out = ((xg - mu) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    if op.input("Scale"):
+        s = scope.fetch(op.input("Scale")).reshape(
+            (1, c) + (1,) * (x.ndim - 2))
+        out = out * s
+    if op.input("Bias"):
+        b = scope.fetch(op.input("Bias")).reshape(
+            (1, c) + (1,) * (x.ndim - 2))
+        out = out + b
+    scope[op.output("Y")] = out
+
+
+@register("instance_norm")
+def _instance_norm(op, scope, feeds, fetches):
+    x = scope.fetch(op.input("X"))
+    eps = op.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mu = x.mean(axes, keepdims=True)
+    var = x.var(axes, keepdims=True)
+    out = (x - mu) * jax.lax.rsqrt(var + eps)
+    c = x.shape[1]
+    if op.input("Scale"):
+        out = out * scope.fetch(op.input("Scale")).reshape(
+            (1, c) + (1,) * (x.ndim - 2))
+    if op.input("Bias"):
+        out = out + scope.fetch(op.input("Bias")).reshape(
+            (1, c) + (1,) * (x.ndim - 2))
+    scope[op.output("Y")] = out
+
+
+def _via_functional(fn, *tensors, **kw):
+    """Run a paddle_tpu functional op inside the interp trace and return
+    the raw array(s) (dispatch handles tracers transparently)."""
+    from ..core.tensor import unwrap
+
+    out = fn(*tensors, **kw)
+    if isinstance(out, tuple):
+        return tuple(unwrap(o) for o in out)
+    return unwrap(out)
+
+
+@register("grid_sampler")
+def _grid_sampler(op, scope, feeds, fetches):
+    from ..nn.functional.common import grid_sample
+
+    scope[op.output("Output")] = _via_functional(
+        grid_sample, scope.fetch(op.input("X")),
+        scope.fetch(op.input("Grid")),
+        mode=op.attr("mode", "bilinear"),
+        padding_mode=op.attr("padding_mode", "zeros"),
+        align_corners=op.attr("align_corners", True))
+
+
+@register("roi_align")
+def _roi_align_op(op, scope, feeds, fetches):
+    from ..vision.ops import roi_align
+
+    rois = scope.fetch(op.input("ROIs"))
+    if op.input("RoisNum"):
+        num = scope.fetch(op.input("RoisNum"))
+    else:
+        # the fluid-era form carries per-image ROI counts via LoD, which
+        # this padded representation doesn't retain — only the
+        # single-image case is unambiguous without RoisNum
+        if scope.fetch(op.input("X")).shape[0] != 1:
+            raise NotImplementedError(
+                "roi_align without RoisNum needs batch size 1 "
+                "(LoD-carried ROI counts are not representable here)")
+        num = jnp.asarray([rois.shape[0]], jnp.int32)
+    scope[op.output("Out")] = _via_functional(
+        roi_align, scope.fetch(op.input("X")), rois, num,
+        (op.attr("pooled_height", 1), op.attr("pooled_width", 1)),
+        spatial_scale=op.attr("spatial_scale", 1.0),
+        sampling_ratio=op.attr("sampling_ratio", -1),
+        aligned=op.attr("aligned", True))
+
+
+@register("box_coder")
+def _box_coder_op(op, scope, feeds, fetches):
+    from ..vision.ops import box_coder
+
+    out = _via_functional(
+        box_coder, scope.fetch(op.input("PriorBox")),
+        scope.fetch(op.input("PriorBoxVar"))
+        if op.input("PriorBoxVar") else None,
+        scope.fetch(op.input("TargetBox")),
+        code_type=op.attr("code_type", "encode_center_size"),
+        box_normalized=op.attr("box_normalized", True),
+        axis=op.attr("axis", 0))
+    scope[op.output("OutputBox")] = out

@@ -300,3 +300,77 @@ class TestProgramBuilder:
         x = np.ones((2, 2), np.float32)
         (out,) = exe.run(prog, feed={"x": x}, fetch_list=["y"])
         np.testing.assert_allclose(np.asarray(out), 3 * x + 1)
+
+
+class TestInterpTranslatorFamilies:
+    """Reductions/compares/logicals/norm translators added for broader
+    reference-program coverage (reduce_ops/, compare_op.cc macro
+    families, group_norm_op, p_norm_op, cross_entropy_op)."""
+
+    def _run(self, build, feeds, fetches):
+        prog = Program()
+        b = prog.global_block()
+        b.create_var("feed", type=proto.VarType.FEED_MINIBATCH,
+                     persistable=True)
+        build(b)
+        exe = static.Executor()
+        return [np.asarray(v) for v in
+                exe.run(prog, feed=feeds, fetch_list=fetches)]
+
+    def test_reduce_compare_where_pnorm(self):
+        x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+
+        def build(b):
+            b.create_var("x", [2, 6], "float32", need_check_feed=True)
+            for nm in ("r", "cmp", "sel", "pn"):
+                b.create_var(nm, None, "float32")
+            b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+            b.append_op("reduce_sum", {"X": "x"}, {"Out": "r"},
+                        {"dim": [1], "keep_dim": True})
+            b.append_op("greater_than", {"X": "x", "Y": "r"},
+                        {"Out": "cmp"}, {})
+            b.append_op("where", {"Condition": "cmp", "X": "x", "Y": "r"},
+                        {"Out": "sel"}, {})
+            b.append_op("p_norm", {"X": "x"}, {"Out": "pn"},
+                        {"porder": 2.0, "axis": 1, "keepdim": False})
+
+        r, cmp_, sel, pn = self._run(build, {"x": x},
+                                     ["r", "cmp", "sel", "pn"])
+        s = x.sum(1, keepdims=True)
+        np.testing.assert_allclose(r, s, rtol=1e-5)
+        np.testing.assert_allclose(pn, np.sqrt((x ** 2).sum(1)), rtol=1e-5)
+        np.testing.assert_allclose(sel, np.where(x > s, x, s), rtol=1e-5)
+
+    def test_group_norm_and_cross_entropy(self):
+        xi = np.random.RandomState(1).randn(2, 4, 3, 3).astype(np.float32)
+
+        def build(b):
+            b.create_var("x", [2, 4, 3, 3], "float32",
+                         need_check_feed=True)
+            b.create_var("y", None, "float32")
+            b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+            b.append_op("group_norm", {"X": "x"}, {"Y": "y"},
+                        {"groups": 2, "epsilon": 1e-5})
+
+        (y,) = self._run(build, {"x": xi}, ["y"])
+        xg = xi.reshape(2, 2, -1)
+        want = ((xg - xg.mean(-1, keepdims=True))
+                / np.sqrt(xg.var(-1, keepdims=True) + 1e-5)).reshape(
+                    xi.shape)
+        np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-5)
+
+        probs = np.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]], np.float32)
+        lab = np.asarray([[0], [1]], np.int64)
+
+        def build2(b):
+            b.create_var("p", [2, 3], "float32", need_check_feed=True)
+            b.create_var("l", [2, 1], "int64", need_check_feed=True)
+            b.create_var("ce", None, "float32")
+            b.append_op("feed", {"X": "feed"}, {"Out": "p"}, {"col": 0})
+            b.append_op("feed", {"X": "feed"}, {"Out": "l"}, {"col": 1})
+            b.append_op("cross_entropy", {"X": "p", "Label": "l"},
+                        {"Y": "ce"}, {})
+
+        (ce,) = self._run(build2, {"p": probs, "l": lab}, ["ce"])
+        np.testing.assert_allclose(
+            ce.ravel(), -np.log([0.7, 0.8]), rtol=1e-5)
