@@ -158,42 +158,47 @@ def _pallas_forward(q, k, v, is_causal, scale, block_q, block_k):
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                    dk_ref, dv_ref, *, block_q, block_k, seq_q, scale,
-                    causal):
+                    dk_ref, dv_ref, acc_dk, acc_dv, *, block_q, block_k,
+                    seq_q, scale, causal):
+    # grid (bh, num_k, num_q): the q axis is the FASTEST grid dim, so the
+    # (bh, k)-pinned output blocks and f32 scratch accumulators stay
+    # resident while q/do/o/lse blocks stream through VMEM — constant VMEM
+    # at any sequence length (the all-rows-in-VMEM form topped out ~4k)
     ki = pl.program_id(1)
-    k_blk = k_ref[...].astype(jnp.float32)          # [block_k, d]
-    v_blk = v_ref[...].astype(jnp.float32)
-    d_model = k_blk.shape[-1]
-    acc_dk = jnp.zeros((block_k, d_model), jnp.float32)
-    acc_dv = jnp.zeros((block_k, d_model), jnp.float32)
-
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (1, block_k), 1)[0]
-
+    qj = pl.program_id(2)
     num_q = seq_q // block_q
-    j0 = (ki * block_k) // block_q if causal else 0
 
-    def body(j, carry):
-        acc_dk, acc_dv = carry
-        q_blk = q_ref[pl.dslice(j * block_q, block_q), :].astype(
-            jnp.float32) * scale
-        do_blk = do_ref[pl.dslice(j * block_q, block_q), :].astype(
-            jnp.float32)
-        o_blk = o_ref[pl.dslice(j * block_q, block_q), :].astype(
-            jnp.float32)
-        lse = lse_ref[pl.dslice(j * block_q, block_q), :][:, 0]
+    @pl.when(qj == 0)
+    def _init():
+        acc_dk[...] = jnp.zeros_like(acc_dk)
+        acc_dv[...] = jnp.zeros_like(acc_dv)
+
+    # causal: a tile entirely above the diagonal contributes nothing —
+    # skip its matmuls (max q_pos < min k_pos)
+    live = ((qj + 1) * block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        k_blk = k_ref[...].astype(jnp.float32)          # [block_k, d]
+        v_blk = v_ref[...].astype(jnp.float32)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)[0]
+        q_blk = q_ref[...].astype(jnp.float32) * scale  # [block_q, d]
+        do_blk = do_ref[...].astype(jnp.float32)
+        o_blk = o_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, 0]
         delta = jnp.sum(do_blk * o_blk, axis=-1)
         logits = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
         if causal:
-            q_pos = j * block_q + jax.lax.broadcasted_iota(
+            q_pos = qj * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, 1), 0)[:, 0]
             mask = q_pos[:, None] >= k_pos[None, :]
             logits = jnp.where(mask, logits, -1e30)
         p = jnp.exp(logits - lse[:, None])           # [block_q, block_k]
-        acc_dv = acc_dv + jax.lax.dot_general(
+        acc_dv[...] += jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -202,44 +207,48 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta[:, None])
-        acc_dk = acc_dk + jax.lax.dot_general(
+        acc_dk[...] += jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc_dk, acc_dv
 
-    acc_dk, acc_dv = jax.lax.fori_loop(j0, num_q, body, (acc_dk, acc_dv))
-    dk_ref[...] = acc_dk.astype(dk_ref.dtype)
-    dv_ref[...] = acc_dv.astype(dv_ref.dtype)
+    @pl.when(qj == num_q - 1)
+    def _flush():
+        dk_ref[...] = acc_dk[...].astype(dk_ref.dtype)
+        dv_ref[...] = acc_dv[...].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                   dq_ref, *, block_q, block_k, seq_k, scale, causal):
+                   dq_ref, acc_dq, *, block_q, block_k, seq_k, scale,
+                   causal):
+    # grid (bh, num_q, num_k): k blocks stream while the dq accumulator
+    # stays pinned (same streaming scheme as the dkv kernel)
     qi = pl.program_id(1)
-    q_blk = q_ref[...].astype(jnp.float32) * scale   # [block_q, d]
-    do_blk = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...][:, 0]
-    delta = jnp.sum(do_blk * o_ref[...].astype(jnp.float32), axis=-1)
-    d_model = q_blk.shape[-1]
-    acc_dq = jnp.zeros((block_q, d_model), jnp.float32)
-
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, 1), 0)[:, 0]
-
+    kj = pl.program_id(2)
     num_k = seq_k // block_k
-    if causal:
-        num_k = jnp.minimum(num_k,
-                            ((qi + 1) * block_q + block_k - 1) // block_k)
 
-    def body(j, acc_dq):
-        k_blk = k_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        acc_dq[...] = jnp.zeros_like(acc_dq)
+
+    live = ((qi + 1) * block_q - 1 >= kj * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q_blk = q_ref[...].astype(jnp.float32) * scale   # [block_q, d]
+        do_blk = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, 0]
+        delta = jnp.sum(do_blk * o_ref[...].astype(jnp.float32), axis=-1)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)[:, 0]
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
         logits = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)[0]
             mask = q_pos[:, None] >= k_pos[None, :]
             logits = jnp.where(mask, logits, -1e30)
@@ -249,13 +258,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta[:, None])
-        return acc_dq + jax.lax.dot_general(
+        acc_dq[...] += jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    acc_dq = jax.lax.fori_loop(0, num_k, body, acc_dq)
-    dq_ref[...] = (acc_dq * scale).astype(dq_ref.dtype)
+    @pl.when(kj == num_k - 1)
+    def _flush():
+        dq_ref[...] = (acc_dq[...] * scale).astype(dq_ref.dtype)
 
 
 def _pallas_backward(q, k, v, out, lse, g, is_causal, scale, block_q,
@@ -271,43 +281,48 @@ def _pallas_backward(q, k, v, out, lse, g, is_causal, scale, block_q,
     outr = out.reshape(b * h, sq, d)
     lse_b = jnp.broadcast_to(lse[:, :, None], (b * h, sq, _LANES))
 
-    row_specs = [
-        pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),      # q
-        pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),  # k
-        pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),  # v
-        pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),      # do
-        pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),      # o
-        pl.BlockSpec((None, sq, _LANES), lambda i, j: (i, 0, 0)),  # lse
-    ]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
                           seq_q=sq, scale=s, causal=is_causal),
-        grid=(b * h, sk // block_k),
-        in_specs=row_specs,
+        grid=(b * h, sk // block_k, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j, r: (i, r, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, r: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, r: (i, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j, r: (i, r, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j, r: (i, r, 0)),
+            pl.BlockSpec((None, block_q, _LANES),
+                         lambda i, j, r: (i, r, 0)),
+        ],
         out_specs=[
-            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, r: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, r: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
         ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
     )(qr, kr, vr, dor, outr, lse_b)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
                           seq_k=sk, scale=s, causal=is_causal),
-        grid=(b * h, sq // block_q),
+        grid=(b * h, sq // block_q, sk // block_k),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q, _LANES), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j, r: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, r: (i, r, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, r: (i, r, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j, r: (i, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j, r: (i, j, 0)),
+            pl.BlockSpec((None, block_q, _LANES),
+                         lambda i, j, r: (i, j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda i, j, r: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
     )(qr, kr, vr, dor, outr, lse_b)
 
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
@@ -360,12 +375,7 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False, scale=None,
     # Policy: flag FLAGS_use_pallas_attention: "auto" (default; threshold
     # from the measured crossover vs XLA's fused attention, see
     # BENCH_kernels.json), "1"/"0" force on/off.
-    from ...core import flags as _flags
-
-    pol = str(_flags.flag("use_pallas_attention"))
-    use = (pol in ("1", "True", "true") or
-           (pol == "auto" and q.shape[-2] >= _auto_threshold()))
-    if not use:
+    if not pallas_attention_wanted(q.shape[-2]):
         return _xla_reference(q, k, v, mask, is_causal, scale)
     return _flash_diff(q, k, v, is_causal, scale, block_q, block_k)
 
@@ -377,3 +387,20 @@ def _auto_threshold():
         return int(_flags.flag("pallas_attention_min_seq"))
     except Exception:
         return 1024
+
+
+def pallas_attention_wanted(seq_len: int) -> bool:
+    """Shared FLAGS_use_pallas_attention policy ('1'/'0' force, 'auto'
+    applies the measured seq threshold) — the single gate used by both the
+    single-device kernel and the ring-attention blocks."""
+    from ...core import flags as _flags
+
+    if not _HAS_PALLAS or jax.default_backend() != "tpu":
+        return False
+    try:
+        pol = str(_flags.flag("use_pallas_attention"))
+    except Exception:
+        return False
+    if pol in ("1", "True", "true"):
+        return True
+    return pol == "auto" and seq_len >= _auto_threshold()

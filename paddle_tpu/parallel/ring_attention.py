@@ -31,15 +31,102 @@ def _block_attn(q, k, v, scale, mask):
     return o, m, s
 
 
+def _use_flash_blocks(q, scale):
+    """Whether the Pallas flash kernel should compute each ring block —
+    the single shared gate (`pallas_attention_wanted`) plus the ring's
+    tiling constraints and a static-scale requirement (the kernel bakes
+    scale as a compile-time constant)."""
+    from ..ops.pallas.flash_attention import pallas_attention_wanted
+
+    if q.shape[-2] % 512 or q.shape[-1] % 64:
+        return False
+    if not isinstance(scale, (int, float)):
+        return False  # traced scale can't be baked into the kernel
+    return pallas_attention_wanted(q.shape[-2])
+
+
+def _flash_or_skip(q, k, v, scale, causal, rank, src):
+    """Causal ring block via flash: a block strictly below the diagonal
+    (src < rank) is fully visible, the diagonal block (src == rank) is
+    causal, and a block strictly above (src > rank) contributes nothing
+    — selected with lax.cond since rank/src are traced."""
+    if not causal:
+        return _flash_block(q, k, v, scale, False)
+    b, h, sq = q.shape[0], q.shape[1], q.shape[2]
+
+    def masked():
+        return (jnp.zeros(q.shape, jnp.float32),
+                jnp.full((b, h, sq), -jnp.inf, jnp.float32),
+                jnp.zeros((b, h, sq), jnp.float32))
+
+    return lax.cond(
+        src > rank, masked,
+        lambda: lax.cond(src == rank,
+                         lambda: _flash_block(q, k, v, scale, True),
+                         lambda: _flash_block(q, k, v, scale, False)))
+
+
+def _flash_block(q, k, v, scale, causal):
+    """One ring block via the Pallas flash kernel.  The kernel returns the
+    NORMALIZED block output plus the row logsumexp; that maps onto the
+    online-softmax carry as (o_unnorm=out, m=lse, l=1), since
+    exp(logits - lse) sums to exactly 1.  Differentiable: the custom VJP
+    recomputes the block in composed form (the same O(S_local^2) the
+    pre-flash ring used, but only during backward)."""
+    out, lse = _flash_block_diff(q, k, v, causal, float(scale))
+    b, h, sq, _ = q.shape
+    return out, lse, jnp.ones((b, h, sq), jnp.float32)
+
+
+def _composed_block(q, k, v, causal, scale):
+    """(normalized out f32, lse) of one block in composed XLA form — the
+    math _flash_block_diff's backward differentiates through."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, -1e30)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    p = jnp.exp(logits - lse[..., None])
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return out, lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_block_diff(q, k, v, causal, scale):
+    from ..ops.pallas.flash_attention import _pallas_forward
+
+    out, lse = _pallas_forward(q, k, v, causal, scale, 512, 512)
+    b, h, sq, _ = q.shape
+    return out.astype(jnp.float32), lse.reshape(b, h, sq)
+
+
+def _flash_block_diff_fwd(q, k, v, causal, scale):
+    return _flash_block_diff(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_block_diff_bwd(causal, scale, res, cots):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda a, b, c: _composed_block(a, b, c, causal, scale), q, k, v)
+    return vjp(cots)
+
+
+_flash_block_diff.defvjp(_flash_block_diff_fwd, _flash_block_diff_bwd)
+
+
 def ring_attention_local(q, k, v, axis_name: str = "sp", causal: bool = False,
                          scale=None):
     """Per-device body; call inside shard_map with q/k/v sharded on the seq
     dim over `axis_name`.  q,k,v: [B, H, S_local, D]."""
+    import math
+
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     d = q.shape[-1]
-    s = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
     s_local = q.shape[2]
+    use_flash = _use_flash_blocks(q, s)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -47,14 +134,18 @@ def ring_attention_local(q, k, v, axis_name: str = "sp", causal: bool = False,
         o_acc, m_acc, l_acc, k_cur, v_cur = carry
         # K/V block currently held came from rank (rank - i) mod n
         src = (rank - i) % n
-        if causal:
-            q_pos = rank * s_local + jnp.arange(s_local)
-            k_pos = src * s_local + jnp.arange(s_local)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            mask = mask[None, None]
+        if use_flash:
+            o_blk, m_blk, l_blk = _flash_or_skip(q, k_cur, v_cur, s,
+                                                 causal, rank, src)
         else:
-            mask = None
-        o_blk, m_blk, l_blk = _block_attn(q, k_cur, v_cur, s, mask)
+            if causal:
+                q_pos = rank * s_local + jnp.arange(s_local)
+                k_pos = src * s_local + jnp.arange(s_local)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                mask = mask[None, None]
+            else:
+                mask = None
+            o_blk, m_blk, l_blk = _block_attn(q, k_cur, v_cur, s, mask)
         m_new = jnp.maximum(m_acc, m_blk)
         alpha = jnp.exp(m_acc - m_new)
         beta = jnp.exp(m_blk - m_new)

@@ -52,7 +52,7 @@ def main():
     B, H, D = 8, 12, 64
     causal = True
     best_blocks = {}
-    for S in (512, 1024, 2048, 4096):
+    for S in (512, 1024, 2048, 4096, 8192):
         key = jax.random.PRNGKey(S)
         q, k, v, g = (jax.random.normal(jax.random.fold_in(key, i),
                                         (B, H, S, D), dtype)
@@ -60,7 +60,12 @@ def main():
 
         xla_attn = lambda q, k, v: fa._xla_reference(q, k, v, None, causal,
                                                      None)
-        t_xla = timeit(xla_attn, q, k, v, g)
+        try:
+            t_xla = timeit(xla_attn, q, k, v, g)
+        except Exception as e:  # composed S^2 logits OOM at long seq
+            print(f"S={S} xla composed failed ({type(e).__name__}) — "
+                  "flash-only at this length")
+            t_xla = None
 
         best = None
         for bq, bk in ((256, 256), (512, 256), (256, 512), (512, 512),
@@ -78,17 +83,23 @@ def main():
                 best = (t, bq, bk)
         t_pl, bq, bk = best
         best_blocks[S] = (bq, bk)
-        win = t_xla / t_pl
-        results.append({
+        row = {
             "shape": f"B{B}xH{H}xS{S}xD{D}", "seq": S, "dtype": "bf16",
             "causal": causal,
-            "xla_ms": round(t_xla * 1e3, 3),
             "pallas_ms": round(t_pl * 1e3, 3),
             "pallas_block_q": bq, "pallas_block_k": bk,
-            "pallas_speedup_vs_xla": round(win, 3),
-            "winner": "pallas" if win > 1.0 else "xla",
-        })
-        print(results[-1])
+        }
+        if t_xla is None:
+            row.update({"xla_ms": None, "winner": "pallas",
+                        "note": "composed XLA attention OOMs (S^2 logits);"
+                                " flash is the only option"})
+        else:
+            win = t_xla / t_pl
+            row.update({"xla_ms": round(t_xla * 1e3, 3),
+                        "pallas_speedup_vs_xla": round(win, 3),
+                        "winner": "pallas" if win > 1.0 else "xla"})
+        results.append(row)
+        print(row)
 
     out = {
         "bench": "flash_attention fwd+bwd (train step), causal",
