@@ -32,7 +32,10 @@ except Exception:  # pragma: no cover
 _LANES = 128
 
 
-def _xla_reference(q, k, v, mask, is_causal, scale):
+def _composed_attention(q, k, v, mask, is_causal, scale, want_lse=False):
+    """The single composed (O(S^2)) attention definition — the numerics
+    ground truth for the Pallas kernels AND the recompute backward of the
+    ring flash blocks.  Returns out (q.dtype) or (out, lse f32)."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
@@ -47,7 +50,14 @@ def _xla_reference(q, k, v, mask, is_causal, scale):
         else:
             logits = logits + mask.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    if want_lse:
+        return out, jax.scipy.special.logsumexp(logits, axis=-1)
+    return out
+
+
+def _xla_reference(q, k, v, mask, is_causal, scale):
+    return _composed_attention(q, k, v, mask, is_causal, scale)
 
 
 # ---------------------------------------------------------------------------
@@ -356,22 +366,17 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 def flash_attention_fwd(q, k, v, mask=None, is_causal=False, scale=None,
                         block_q=512, block_k=512):
-    # 512x512 blocks won every Pallas-preferred shape in the measured
-    # sweep (BENCH_kernels.json); for sequences they don't divide, shrink
-    # to the largest power-of-two block that tiles rather than losing the
-    # kernel entirely
-    while block_q > 128 and q.shape[-2] % block_q:
-        block_q //= 2
-    while block_k > 128 and k.shape[-2] % block_k:
-        block_k //= 2
     """q,k,v: [B,H,S,D].  Uses the Pallas kernels when mask is None and shapes
     tile; otherwise the XLA composed reference.  Fully differentiable with a
     Pallas backward (dq/dk/dv kernels recomputing P from the saved
-    logsumexp)."""
-    if (not _HAS_PALLAS or mask is not None
-            or q.shape[-2] % block_q or k.shape[-2] % block_k
+    logsumexp).  512x512 blocks won every Pallas-preferred shape in the
+    measured sweep (BENCH_kernels.json); `pick_blocks` shrinks them for
+    sequences they don't divide."""
+    picked = pick_blocks(q.shape[-2], k.shape[-2], block_q, block_k)
+    if (not _HAS_PALLAS or mask is not None or picked is None
             or jax.default_backend() != "tpu"):
         return _xla_reference(q, k, v, mask, is_causal, scale)
+    block_q, block_k = picked
     # Policy: flag FLAGS_use_pallas_attention: "auto" (default; threshold
     # from the measured crossover vs XLA's fused attention, see
     # BENCH_kernels.json), "1"/"0" force on/off.
@@ -387,6 +392,20 @@ def _auto_threshold():
         return int(_flags.flag("pallas_attention_min_seq"))
     except Exception:
         return 1024
+
+
+def pick_blocks(seq_q: int, seq_k: int, block_q: int = 512,
+                block_k: int = 512):
+    """Largest power-of-two blocks (floor 128) that tile the sequences;
+    None when no tiling exists — the one block-selection policy shared by
+    the single-device entry point and the ring blocks."""
+    while block_q > 128 and seq_q % block_q:
+        block_q //= 2
+    while block_k > 128 and seq_k % block_k:
+        block_k //= 2
+    if seq_q % block_q or seq_k % block_k:
+        return None
+    return block_q, block_k
 
 
 def pallas_attention_wanted(seq_len: int) -> bool:

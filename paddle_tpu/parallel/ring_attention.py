@@ -33,12 +33,13 @@ def _block_attn(q, k, v, scale, mask):
 
 def _use_flash_blocks(q, scale):
     """Whether the Pallas flash kernel should compute each ring block —
-    the single shared gate (`pallas_attention_wanted`) plus the ring's
-    tiling constraints and a static-scale requirement (the kernel bakes
+    the single shared gate (`pallas_attention_wanted`) + block policy
+    (`pick_blocks`) and a static-scale requirement (the kernel bakes
     scale as a compile-time constant)."""
-    from ..ops.pallas.flash_attention import pallas_attention_wanted
+    from ..ops.pallas.flash_attention import (pallas_attention_wanted,
+                                              pick_blocks)
 
-    if q.shape[-2] % 512 or q.shape[-1] % 64:
+    if pick_blocks(q.shape[-2], q.shape[-2]) is None or q.shape[-1] % 64:
         return False
     if not isinstance(scale, (int, float)):
         return False  # traced scale can't be baked into the kernel
@@ -79,24 +80,22 @@ def _flash_block(q, k, v, scale, causal):
 
 
 def _composed_block(q, k, v, causal, scale):
-    """(normalized out f32, lse) of one block in composed XLA form — the
-    math _flash_block_diff's backward differentiates through."""
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        sq, sk = logits.shape[-2], logits.shape[-1]
-        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        logits = jnp.where(cm, logits, -1e30)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    p = jnp.exp(logits - lse[..., None])
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
-    return out, lse
+    """(normalized out f32, lse) of one block — delegates to the ONE
+    composed attention definition (`_composed_attention`), so the VJP
+    ground truth can never drift from the single-device reference."""
+    from ..ops.pallas.flash_attention import _composed_attention
+
+    out, lse = _composed_attention(q, k, v, None, causal, scale,
+                                   want_lse=True)
+    return out.astype(jnp.float32), lse
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_block_diff(q, k, v, causal, scale):
-    from ..ops.pallas.flash_attention import _pallas_forward
+    from ..ops.pallas.flash_attention import _pallas_forward, pick_blocks
 
-    out, lse = _pallas_forward(q, k, v, causal, scale, 512, 512)
+    bq, bk = pick_blocks(q.shape[-2], k.shape[-2])
+    out, lse = _pallas_forward(q, k, v, causal, scale, bq, bk)
     b, h, sq, _ = q.shape
     return out.astype(jnp.float32), lse.reshape(b, h, sq)
 

@@ -5,3 +5,4 @@ from .resnet import (BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34,
                      wide_resnet50_2)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .vit import VisionTransformer, vit_b_16, vit_l_16, vit_s_16
+from .yolov3 import DarkNet53, YOLOv3, yolov3_darknet53

@@ -1,0 +1,172 @@
+"""YOLOv3 with a DarkNet-53 backbone — the reference's detection model
+family (PaddleDetection-era YOLOv3; ops `yolov3_loss_op.h`,
+`yolo_box_op.h`, `multiclass_nms_op.cc` are the kernels it trains and
+serves with; cf. the dygraph_to_static darknet test models).
+
+TPU-first: plain Layer composition (convs stay NCHW, XLA lays out for the
+MXU), training loss is the exact `yolov3_loss` op over all three scales,
+inference decodes with `yolo_box` per scale + one `multiclass_nms` over
+the concatenated candidates — all static shapes.
+"""
+from __future__ import annotations
+
+from ... import nn, ops
+from ...nn import functional as F
+from ..detection import yolov3_loss
+from ..ops import multiclass_nms, yolo_box
+
+__all__ = ["DarkNet53", "YOLOv3", "yolov3_darknet53"]
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, cin, cout, ksize=3, stride=1):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, ksize, stride=stride,
+                              padding=(ksize - 1) // 2, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = nn.LeakyReLU(0.1)
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class BasicBlock(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv1 = ConvBNLayer(ch, ch // 2, 1)
+        self.conv2 = ConvBNLayer(ch // 2, ch, 3)
+
+    def forward(self, x):
+        return x + self.conv2(self.conv1(x))
+
+
+class DarkNet53(nn.Layer):
+    """Backbone; returns feature maps at strides 8/16/32 (C3, C4, C5)."""
+
+    DEPTHS = (1, 2, 8, 8, 4)
+
+    def __init__(self):
+        super().__init__()
+        self.stem = ConvBNLayer(3, 32, 3)
+        chans = (64, 128, 256, 512, 1024)
+        stages = []
+        cin = 32
+        for depth, cout in zip(self.DEPTHS, chans):
+            blocks = [ConvBNLayer(cin, cout, 3, stride=2)]
+            blocks += [BasicBlock(cout) for _ in range(depth)]
+            stages.append(nn.Sequential(*blocks))
+            cin = cout
+        self.stages = nn.LayerList(stages)
+
+    def forward(self, x):
+        feats = []
+        h = self.stem(x)
+        for i, stage in enumerate(self.stages):
+            h = stage(h)
+            if i >= 2:  # strides 8, 16, 32
+                feats.append(h)
+        return feats  # [C3, C4, C5]
+
+
+class YoloDetBlock(nn.Layer):
+    def __init__(self, cin, ch):
+        super().__init__()
+        self.body = nn.Sequential(
+            ConvBNLayer(cin, ch, 1), ConvBNLayer(ch, ch * 2, 3),
+            ConvBNLayer(ch * 2, ch, 1), ConvBNLayer(ch, ch * 2, 3),
+            ConvBNLayer(ch * 2, ch, 1))
+        self.tip = ConvBNLayer(ch, ch * 2, 3)
+
+    def forward(self, x):
+        route = self.body(x)
+        return route, self.tip(route)
+
+
+class YOLOv3(nn.Layer):
+    """Three-scale YOLOv3 head over DarkNet53.
+
+    train:    model(img, gt_box, gt_label) -> scalar loss (sum of the
+              three per-scale `yolov3_loss` terms)
+    eval:     model(img, im_shape) -> (boxes [N,K,6], counts [N]) after
+              per-scale `yolo_box` decode + `multiclass_nms`
+    """
+
+    ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119, 116, 90,
+               156, 198, 373, 326]
+    MASKS = [[6, 7, 8], [3, 4, 5], [0, 1, 2]]  # C5, C4, C3 order
+
+    def __init__(self, num_classes=80, ignore_thresh=0.7,
+                 downsamples=(32, 16, 8)):
+        super().__init__()
+        self.num_classes = num_classes
+        self.ignore_thresh = ignore_thresh
+        self.downsamples = downsamples
+        self.backbone = DarkNet53()
+        out_ch = len(self.MASKS[0]) * (5 + num_classes)
+        self.blocks = nn.LayerList()
+        self.outs = nn.LayerList()
+        self.routes = nn.LayerList()
+        in_chs = (1024, 768, 384)  # C5, C4+route/2, C3+route/2
+        chs = (512, 256, 128)
+        for i, (cin, ch) in enumerate(zip(in_chs, chs)):
+            self.blocks.append(YoloDetBlock(cin, ch))
+            self.outs.append(nn.Conv2D(ch * 2, out_ch, 1))
+            if i < 2:
+                self.routes.append(ConvBNLayer(ch, ch // 2, 1))
+
+    def _heads(self, img):
+        c3, c4, c5 = self.backbone(img)
+        outs = []
+        feats = [c5, c4, c3]
+        route = None
+        for i, feat in enumerate(feats):
+            if route is not None:
+                up = F.interpolate(route, scale_factor=2, mode="nearest")
+                feat = ops.concat([up, feat], axis=1)
+            route_t, tip = self.blocks[i](feat)
+            outs.append(self.outs[i](tip))
+            if i < 2:
+                route = self.routes[i](route_t)
+        return outs  # stride 32, 16, 8
+
+    def forward(self, img, gt_box=None, gt_label=None, im_shape=None,
+                score_threshold=0.01, nms_top_k=400, keep_top_k=100,
+                nms_threshold=0.45):
+        outs = self._heads(img)
+        if self.training:
+            assert gt_box is not None and gt_label is not None
+            total = None
+            for i, out in enumerate(outs):
+                loss, _, _ = yolov3_loss(
+                    out, gt_box, gt_label,
+                    anchors=self.ANCHORS,
+                    anchor_mask=self.MASKS[i],
+                    class_num=self.num_classes,
+                    ignore_thresh=self.ignore_thresh,
+                    downsample_ratio=self.downsamples[i])
+                s = loss.sum()
+                total = s if total is None else total + s
+            return total
+        assert im_shape is not None
+        boxes_all, scores_all = [], []
+        for i, out in enumerate(outs):
+            mask = self.MASKS[i]
+            anchors = [self.ANCHORS[2 * m + d] for m in mask
+                       for d in (0, 1)]
+            boxes, scores = yolo_box(
+                out, im_shape, anchors=anchors,
+                class_num=self.num_classes, conf_thresh=score_threshold,
+                downsample_ratio=self.downsamples[i])
+            boxes_all.append(boxes)
+            scores_all.append(scores)
+        boxes = ops.concat(boxes_all, axis=1)        # [N, M, 4]
+        scores = ops.concat(scores_all, axis=1)      # [N, M, C]
+        return multiclass_nms(
+            boxes, ops.transpose(scores, [0, 2, 1]),
+            score_threshold=score_threshold, nms_top_k=nms_top_k,
+            keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+            background_label=-1)
+
+
+def yolov3_darknet53(num_classes=80, **kwargs):
+    return YOLOv3(num_classes=num_classes, **kwargs)

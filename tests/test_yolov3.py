@@ -1,0 +1,57 @@
+"""YOLOv3/DarkNet53 model family (reference PaddleDetection-era YOLOv3
+over `yolov3_loss`/`yolo_box`/`multiclass_nms`)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.vision.models import DarkNet53, yolov3_darknet53
+
+
+class TestDarkNet53:
+    def test_feature_strides(self):
+        paddle.seed(0)
+        bb = DarkNet53()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 3, 64, 64).astype(
+                np.float32))
+        c3, c4, c5 = bb(x)
+        assert tuple(c3.shape) == (1, 256, 8, 8)    # stride 8
+        assert tuple(c4.shape) == (1, 512, 4, 4)    # stride 16
+        assert tuple(c5.shape) == (1, 1024, 2, 2)   # stride 32
+
+
+class TestYOLOv3:
+    def _data(self):
+        rng = np.random.RandomState(0)
+        img = paddle.to_tensor(rng.randn(1, 3, 64, 64).astype(np.float32))
+        gt_box = paddle.to_tensor(np.array(
+            [[[0.4, 0.4, 0.3, 0.3], [0.7, 0.6, 0.2, 0.2]]], np.float32))
+        gt_label = paddle.to_tensor(np.array([[1, 3]], np.int64))
+        return img, gt_box, gt_label
+
+    def test_train_loss_decreases(self):
+        paddle.seed(0)
+        m = yolov3_darknet53(num_classes=6)
+        m.train()
+        img, gt_box, gt_label = self._data()
+        opt = optimizer.Adam(learning_rate=1e-3,
+                             parameters=m.parameters())
+        losses = []
+        for _ in range(4):
+            loss = m(img, gt_box=gt_box, gt_label=gt_label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_eval_decode_shapes(self):
+        paddle.seed(0)
+        m = yolov3_darknet53(num_classes=6)
+        m.eval()
+        img, _, _ = self._data()
+        im_shape = paddle.to_tensor(np.array([[64, 64]], np.float32))
+        out, cnt = m(img, im_shape=im_shape, keep_top_k=50)
+        assert tuple(out.shape) == (1, 50, 6)  # label/score/x1y1x2y2
+        assert 0 <= int(np.asarray(cnt.numpy())[0]) <= 50
