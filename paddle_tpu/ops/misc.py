@@ -21,7 +21,7 @@ __all__ = [
     "squared_l2_distance", "squared_l2_norm", "teacher_student_sigmoid_loss",
     "row_conv", "set_value", "segment_sum", "segment_mean", "segment_max",
     "segment_min", "segment_pool", "fsp_matrix", "Print", "Assert",
-    "conv_shift", "cvm", "shuffle_batch", "hash_op",
+    "conv_shift", "cvm", "shuffle_batch", "hash_op", "batch_fc",
 ]
 
 
@@ -591,3 +591,18 @@ def hash_op(x, num_hash=1, mod_by=100000000, name=None):
                          axis=1)[:, :, None]
 
     return dispatch(f, x, nondiff=(0,))
+
+
+def batch_fc(x, w, bias=None, name=None):
+    """Per-slot batched FC (`operators/batch_fc_op.cu`): x [S, N, I],
+    w [S, I, O], bias [S, O] -> out [S, N, O] (one independent fc per
+    slot pair — CTR models)."""
+
+    def f(xv, wv, *b):
+        out = jnp.einsum("sni,sio->sno", xv, wv)
+        if b:
+            out = out + b[0][:, None, :]
+        return out
+
+    args = (x, w) + ((bias,) if bias is not None else ())
+    return dispatch(f, *args)

@@ -33,7 +33,8 @@ __all__ = [
     "locality_aware_nms", "density_prior_box", "yolov3_loss",
     "multiclass_nms2", "multiclass_nms3",
     "target_assign", "mine_hard_examples", "rpn_target_assign",
-    "retinanet_target_assign",
+    "retinanet_target_assign", "polygon_box_transform",
+    "generate_proposal_labels",
 ]
 
 
@@ -1083,3 +1084,125 @@ def _iou_matrix(a, b):
     inter = ix * iy
     union = aw[:, None] * ah[:, None] + bw[None, :] * bh[None, :] - inter
     return jnp.where(union > 0, inter / union, 0.0)
+
+
+def polygon_box_transform(x, name=None):
+    """EAST-style geometry decode (`detection/polygon_box_transform_op.cc`):
+    input [N, 2n, H, W] holds corner offsets; even channels become
+    4*w_idx - offset, odd channels 4*h_idx - offset."""
+
+    def f(xv):
+        n, c, h, w = xv.shape
+        wi = jnp.arange(w, dtype=xv.dtype)[None, None, None, :] * 4.0
+        hi = jnp.arange(h, dtype=xv.dtype)[None, None, :, None] * 4.0
+        even = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+        return jnp.where(even, wi - xv, hi - xv)
+
+    return dispatch(f, x)
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, rois_num=None, gt_num=None,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=False,
+                             is_cls_agnostic=False, name=None):
+    """RCNN second-stage sampler
+    (`detection/generate_proposal_labels_op.cc` SampleRoisForOneImage):
+    gt boxes are prepended to the proposals, rois with IoU >= fg_thresh
+    become foreground mapped to their best gt, [bg_lo, bg_hi) become
+    background (label 0), and the first floor(bs*fg_fraction) fg / rest bg
+    are kept (the reference's use_random=False contract).
+
+    Batched static form: rpn_rois [N, R, 4] (+`rois_num` [N]), gt_boxes
+    [N, G, 4] (+`gt_num` [N]), gt_classes/is_crowd [N, G].  Returns
+    (rois [N, B, 4], labels [N, B] int32 (-1 pad), bbox_targets
+    [N, B, 4*class_nums], bbox_inside_weights, bbox_outside_weights,
+    counts [N]) with B = batch_size_per_im."""
+    B = int(batch_size_per_im)
+    W = jnp.asarray(bbox_reg_weights, jnp.float32)
+
+    def f(rois, gtc, crowd, gt, info, rn, gn):
+        import jax
+
+        n, r = rois.shape[:2]
+        g = gt.shape[1]
+
+        def one(rois_i, gtc_i, crowd_i, gt_i, info_i, gn_i, rn_i):
+            # proposals arrive in the scaled frame; gt boxes are in
+            # original-image coordinates (reference SampleRoisForOneImage
+            # divides rois by im_scale = im_info[2] before mixing them)
+            rois_i = rois_i / jnp.maximum(info_i[2], 1e-6)
+            all_boxes = jnp.concatenate([gt_i, rois_i], axis=0)  # [G+R,4]
+            nb = g + r
+            valid = jnp.concatenate([jnp.arange(g) < gn_i,
+                                     jnp.arange(r) < rn_i])
+            gt_valid = jnp.arange(g) < gn_i
+            iou = _iou_matrix(all_boxes, gt_i)
+            iou = jnp.where(gt_valid[None, :] & valid[:, None], iou, 0.0)
+            max_ov = iou.max(1)
+            arg = iou.argmax(1)
+            # crowd gt rows are excluded (reference sets overlap -1)
+            is_crowd_row = jnp.concatenate(
+                [crowd_i != 0, jnp.zeros((r,), bool)])
+            max_ov = jnp.where(is_crowd_row, -1.0, max_ov)
+
+            fg_cand = valid & (max_ov >= fg_thresh)
+            bg_cand = valid & (max_ov >= bg_thresh_lo) & \
+                (max_ov < bg_thresh_hi)
+            fg_cap = int(B * fg_fraction)
+            fg_order = jnp.argsort(
+                jnp.where(fg_cand, jnp.arange(nb), nb + jnp.arange(nb)))
+            fg_count = jnp.minimum(fg_cand.sum(), fg_cap)
+            fg_sel = _select_k(fg_order, fg_count, B)
+            bg_order = jnp.argsort(
+                jnp.where(bg_cand, jnp.arange(nb), nb + jnp.arange(nb)))
+            bg_count = jnp.minimum(bg_cand.sum(), B - fg_count)
+            bg_sel = _select_k(bg_order, bg_count, B)
+
+            slot = jnp.arange(B)
+            shifted_bg = jnp.take(bg_sel,
+                                  jnp.clip(slot - fg_count, 0, B - 1))
+            sel = jnp.where(slot < fg_count, fg_sel, shifted_bg)
+            count = fg_count + bg_count
+            sel = jnp.where(slot < count, sel, -1)
+            sel_c = jnp.clip(sel, 0, nb - 1)
+
+            out_rois = jnp.where((sel >= 0)[:, None],
+                                 all_boxes[sel_c], 0.0)
+            mapped_gt = arg[sel_c]
+            labels = jnp.where(
+                slot < fg_count, gtc_i[mapped_gt].astype(jnp.int32),
+                jnp.where(slot < count, 0, -1))
+
+            deltas = _box_to_delta(gt_i[mapped_gt],
+                                   all_boxes[sel_c]) / W[None, :]
+            is_fg = slot < fg_count
+            cls = jnp.where(is_cls_agnostic, 1,
+                            labels.astype(jnp.int32))
+            tgt = jnp.zeros((B, 4 * class_nums), jnp.float32)
+            col = jnp.clip(cls, 0, class_nums - 1) * 4
+            rows = jnp.arange(B)[:, None]
+            cols = col[:, None] + jnp.arange(4)[None, :]
+            tgt = tgt.at[rows, cols].set(
+                jnp.where(is_fg[:, None], deltas, 0.0))
+            w_in = jnp.zeros_like(tgt).at[rows, cols].set(
+                jnp.where(is_fg[:, None], 1.0, 0.0))
+            return (out_rois, labels, tgt, w_in, w_in,
+                    count.astype(jnp.int32))
+
+        return jax.vmap(one)(rois, gtc, crowd, gt, info, gn, rn)
+
+    import numpy as _np
+
+    R = int(unwrap(rpn_rois).shape[1])
+    G = int(unwrap(gt_boxes).shape[1])
+    N = int(unwrap(rpn_rois).shape[0])
+    if rois_num is None:
+        rois_num = _np.full((N,), R, _np.int32)
+    if gt_num is None:
+        gt_num = _np.full((N,), G, _np.int32)
+    return dispatch(f, rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
+                    rois_num, gt_num, nondiff=(0, 1, 2, 3, 4, 5, 6))

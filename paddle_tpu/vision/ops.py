@@ -29,7 +29,7 @@ from ..core.tensor import Tensor, unwrap
 __all__ = [
     "iou_similarity", "box_clip", "box_coder", "prior_box", "yolo_box",
     "roi_align", "roi_pool", "nms", "multiclass_nms", "matrix_nms",
-    "deform_conv2d",
+    "deform_conv2d", "correlation",
 ]
 
 
@@ -695,3 +695,51 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
     if return_index:
         return out, index, counts
     return out, counts
+
+
+def correlation(x1, x2, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1, name=None):
+    """FlowNet correlation volume (`operators/correlation_op.cu`): for each
+    displacement (ti, tj) within max_displacement (step stride2), the
+    channel-and-kernel-window mean of x1 * shift(x2), sampled on a
+    stride1 grid offset by max_displacement into the padded frame.
+    Output [N, (2*max_displacement//stride2 + 1)^2, OH, OW] with
+    OH = ceil((H + 2*pad - 2*(max_displacement + kernel_radius)) / stride1)
+    (reference CorrelationOutputSize, border_radius = kernel_radius +
+    max_displacement)."""
+    K = int(kernel_size)
+    krad = (K - 1) // 2
+    rad = int(max_displacement) // int(stride2)
+    if int(max_displacement) < krad:
+        raise ValueError("correlation: max_displacement must be >= "
+                         "kernel radius")
+
+    def f(a, b):
+        from jax import lax
+
+        n, c, h, w = a.shape
+        p = int(pad_size)
+        border = int(max_displacement) + krad
+        pa = jnp.pad(a, ((0, 0), (0, 0), (p, p), (p, p)))
+        pb = jnp.pad(b, ((0, 0), (0, 0), (p, p), (p, p)))
+        oh = int(np.ceil((h + 2 * p - 2 * border) / stride1))
+        ow = int(np.ceil((w + 2 * p - 2 * border) / stride1))
+        nelems = K * K * c
+        start = int(max_displacement) - krad
+        planes = []
+        for tj in range(-rad, rad + 1):
+            for ti in range(-rad, rad + 1):
+                shifted = jnp.roll(pb, (-tj * stride2, -ti * stride2),
+                                   axis=(2, 3))
+                prod = (pa * shifted).sum(axis=1)  # [N, PH, PW]
+                win = lax.reduce_window(
+                    prod, 0.0, lax.add, (1, K, K), (1, 1, 1), "VALID")
+                sub = lax.slice(
+                    win, (0, start, start),
+                    (n, start + (oh - 1) * stride1 + 1,
+                     start + (ow - 1) * stride1 + 1),
+                    (1, int(stride1), int(stride1)))
+                planes.append(sub / nelems)
+        return jnp.stack(planes, axis=1)
+
+    return dispatch(f, x1, x2)

@@ -252,3 +252,123 @@ class TestSmallOps:
         np.testing.assert_array_equal(out[0], out[1])  # deterministic
         assert (out >= 0).all() and (out < 1000).all()
         assert (out[0] != out[2]).any()
+
+
+class TestSecondBatchOps:
+    def test_batch_fc(self):
+        from paddle_tpu.ops.misc import batch_fc
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 4, 5).astype(np.float32)
+        w = rng.randn(3, 5, 2).astype(np.float32)
+        b = rng.randn(3, 2).astype(np.float32)
+        out = np.asarray(batch_fc(t(x), t(w), t(b)).numpy())
+        want = np.einsum("sni,sio->sno", x, w) + b[:, None, :]
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_polygon_box_transform(self):
+        from paddle_tpu.vision.detection import polygon_box_transform
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 4, 2, 3).astype(np.float32)
+        out = np.asarray(polygon_box_transform(t(x)).numpy())
+        for cc in range(4):
+            for hh in range(2):
+                for ww in range(3):
+                    want = (ww * 4 - x[0, cc, hh, ww] if cc % 2 == 0
+                            else hh * 4 - x[0, cc, hh, ww])
+                    np.testing.assert_allclose(out[0, cc, hh, ww], want,
+                                               rtol=1e-6)
+
+    def test_correlation_matches_naive(self):
+        from paddle_tpu.vision.ops import correlation
+        rng = np.random.RandomState(2)
+        N, C, H, W = 1, 3, 6, 6
+        pad, K, md, s1, s2 = 2, 1, 2, 1, 1
+        a = rng.randn(N, C, H, W).astype(np.float32)
+        b = rng.randn(N, C, H, W).astype(np.float32)
+        out = np.asarray(correlation(t(a), t(b), pad, K, md, s1, s2)
+                         .numpy())
+        pa = np.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        pb = np.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        rad = md // s2
+        oh = int(np.ceil((H + 2 * pad - 2 * md) / s1))
+        idx = 0
+        for tj in range(-rad, rad + 1):
+            for ti in range(-rad, rad + 1):
+                for i in range(oh):
+                    for j in range(oh):
+                        h1 = md + i * s1
+                        w1 = md + j * s1
+                        h2, w2 = h1 + tj * s2, w1 + ti * s2
+                        want = (pa[0, :, h1, w1]
+                                * pb[0, :, h2, w2]).sum() / (K * K * C)
+                        np.testing.assert_allclose(
+                            out[0, idx, i, j], want, rtol=1e-4,
+                            atol=1e-5)
+                idx += 1
+
+    def test_correlation_kernel3_shape(self):
+        from paddle_tpu.vision.ops import correlation
+        rng = np.random.RandomState(4)
+        N, C, H, W = 1, 2, 8, 8
+        pad, K, md, s1, s2 = 4, 3, 4, 1, 1
+        a = rng.randn(N, C, H, W).astype(np.float32)
+        b = rng.randn(N, C, H, W).astype(np.float32)
+        out = np.asarray(correlation(t(a), t(b), pad, K, md, s1, s2)
+                         .numpy())
+        # reference CorrelationOutputSize: border = md + (K-1)//2 = 5
+        # -> ceil((8 + 8 - 10)/1) = 6
+        assert out.shape == (1, 81, 6, 6)
+        # center (md + i) with kernel window, naive check of one entry
+        pa = np.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        pb = np.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        tj = ti = 0
+        cidx = (2 * (md // s2) + 1) * (md // s2) + (md // s2)
+        h1 = w1 = md + 2 * s1
+        want = 0.0
+        for j in (-1, 0, 1):
+            for i in (-1, 0, 1):
+                want += (pa[0, :, h1 + j, w1 + i]
+                         * pb[0, :, h1 + tj + j, w1 + ti + i]).sum()
+        want /= K * K * C
+        np.testing.assert_allclose(out[0, cidx, 2, 2], want, rtol=1e-4)
+
+    def test_generate_proposal_labels_im_scale(self):
+        from paddle_tpu.vision.detection import generate_proposal_labels
+        # rois given at 2x scale; gt in original coords; scale division
+        # must realign them (roi0/2 == gt0 exactly)
+        rois = np.array([[[0, 0, 20, 20], [200, 200, 220, 220]]],
+                        np.float32)
+        gt = np.array([[[0, 0, 10, 10]]], np.float32)
+        gtc = np.array([[3]], np.int64)
+        crowd = np.zeros((1, 1), np.int32)
+        info = np.array([[200, 200, 2.0]], np.float32)
+        out_rois, labels, *_, cnt = generate_proposal_labels(
+            t(rois), t(gtc), t(crowd), t(gt), t(info),
+            batch_size_per_im=4, fg_fraction=0.5, fg_thresh=0.5,
+            bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=5)
+        lab = np.asarray(labels.numpy())[0]
+        # fg: prepended gt + rescaled roi0 -> both labeled class 3
+        assert (lab[:2] == 3).all()
+
+    def test_generate_proposal_labels(self):
+        from paddle_tpu.vision.detection import generate_proposal_labels
+        rois = np.array([[[0, 0, 10, 10], [20, 20, 28, 28],
+                          [100, 100, 110, 110]]], np.float32)
+        gt = np.array([[[0, 0, 10, 10], [21, 21, 29, 29]]], np.float32)
+        gtc = np.array([[2, 5]], np.int64)
+        crowd = np.zeros((1, 2), np.int32)
+        info = np.array([[200, 200, 1.0]], np.float32)
+        out_rois, labels, tgt, w_in, w_out, cnt = generate_proposal_labels(
+            t(rois), t(gtc), t(crowd), t(gt), t(info),
+            batch_size_per_im=6, fg_fraction=0.5, fg_thresh=0.5,
+            bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=8)
+        lab = np.asarray(labels.numpy())[0]
+        n = int(np.asarray(cnt.numpy())[0])
+        # fg: the two gt rows (prepended, IoU 1) + roi0 (IoU 1 with gt0)
+        # capped at 3 = floor(6*0.5); bg: remaining candidates
+        assert (lab[:3] > 0).all()
+        assert set(lab[:3]) <= {2, 5}
+        assert (lab[3:n] == 0).all() and (lab[n:] == -1).all()
+        wi = np.asarray(w_in.numpy())[0]
+        # fg rows carry 4 inside-weights at their class column
+        assert wi[0].sum() == 4 and wi[n - 1].sum() == 0

@@ -109,6 +109,9 @@ ALIASES = {
     "conv_shift": "ops:conv_shift", "cvm": "ops:cvm",
     "shuffle_batch": "ops:shuffle_batch", "hash": "ops:hash_op",
     "target_assign": "vdet:target_assign",
+    "polygon_box_transform": "vdet:polygon_box_transform",
+    "generate_proposal_labels": "vdet:generate_proposal_labels",
+    "batch_fc": "ops:batch_fc", "correlation": "vops:correlation",
     "mine_hard_examples": "vdet:mine_hard_examples",
     "rpn_target_assign": "vdet:rpn_target_assign",
     "retinanet_target_assign": "vdet:retinanet_target_assign",
@@ -383,21 +386,17 @@ QUANT_FAMILY = {n for n in OPS if n.startswith("fake_")}
 # remaining deliberate descopes (niche, with reasons) — kept visibly small
 DESCOPED = {
     "bilateral_slice": "HDRNet-specific CUDA op",
-    "correlation": "FlowNet-specific CUDA op",
     "tree_conv": "tree-structured NN (niche)",
     "tdm_child": "tree-based deep match (industrial PS)",
     "tdm_sampler": "tree-based deep match (industrial PS)",
     "pyramid_hash": "industrial sparse hash embedding",
     "rank_attention": "industrial CTR op",
-    "batch_fc": "industrial CTR op",
     "match_matrix_tensor": "text matching (niche)",
     "var_conv_2d": "variable-size conv over LoD (niche)",
     "similarity_focus": "niche attention variant",
     "filter_by_instag": "industrial instance-tag filter",
     "roi_perspective_transform": "OCR-specific geometric op",
-    "polygon_box_transform": "OCR-specific",
     "generate_mask_labels": "Mask-RCNN train-time assigner",
-    "generate_proposal_labels": "RCNN train-time assigner",
     "lookup_table_dequant": "PS quantized embedding",
 }
 
