@@ -1217,3 +1217,72 @@ def _box_coder_op(op, scope, feeds, fetches):
         box_normalized=op.attr("box_normalized", True),
         axis=op.attr("axis", 0))
     scope[op.output("OutputBox")] = out
+
+
+@register("prior_box")
+def _prior_box_op(op, scope, feeds, fetches):
+    from ..vision.ops import prior_box
+
+    boxes, var = _via_functional(
+        prior_box, scope.fetch(op.input("Input")),
+        scope.fetch(op.input("Image")),
+        min_sizes=op.attr("min_sizes", []),
+        max_sizes=op.attr("max_sizes", []) or None,
+        aspect_ratios=op.attr("aspect_ratios", [1.0]),
+        variance=op.attr("variances", [0.1, 0.1, 0.2, 0.2]),
+        flip=op.attr("flip", False), clip=op.attr("clip", False),
+        steps=(op.attr("step_w", 0.0), op.attr("step_h", 0.0)),
+        offset=op.attr("offset", 0.5),
+        min_max_aspect_ratios_order=op.attr("min_max_aspect_ratios_order",
+                                            False))
+    scope[op.output("Boxes")] = boxes
+    scope[op.output("Variances")] = var
+
+
+@register("yolo_box")
+def _yolo_box_op(op, scope, feeds, fetches):
+    from ..vision.ops import yolo_box
+
+    if op.attr("iou_aware", False):
+        raise NotImplementedError(
+            "yolo_box iou_aware=True (PP-YOLO layout) is not translated")
+    boxes, scores = _via_functional(
+        yolo_box, scope.fetch(op.input("X")),
+        scope.fetch(op.input("ImgSize")),
+        anchors=op.attr("anchors", []),
+        class_num=op.attr("class_num", 1),
+        conf_thresh=op.attr("conf_thresh", 0.01),
+        downsample_ratio=op.attr("downsample_ratio", 32),
+        clip_bbox=op.attr("clip_bbox", True),
+        scale_x_y=op.attr("scale_x_y", 1.0))
+    scope[op.output("Boxes")] = boxes
+    scope[op.output("Scores")] = scores
+
+
+@register("multiclass_nms", "multiclass_nms2", "multiclass_nms3")
+def _multiclass_nms_op(op, scope, feeds, fetches):
+    from ..vision.detection import multiclass_nms2
+
+    if op.input("RoisNum"):
+        raise NotImplementedError(
+            "multiclass_nms with LoD-batched RoisNum input is not "
+            "supported; export with dense [N, M, 4] boxes")
+    want_index = bool(op.output("Index"))
+    res = _via_functional(
+        multiclass_nms2, scope.fetch(op.input("BBoxes")),
+        scope.fetch(op.input("Scores")),
+        op.attr("score_threshold", 0.05), op.attr("nms_top_k", 1000),
+        op.attr("keep_top_k", 100),
+        nms_threshold=op.attr("nms_threshold", 0.3),
+        normalized=op.attr("normalized", True),
+        nms_eta=op.attr("nms_eta", 1.0),
+        background_label=op.attr("background_label", 0),
+        return_index=want_index)
+    if want_index:
+        out, counts, index = res
+        scope[op.output("Index")] = index
+    else:
+        out, counts = res
+    scope[op.output("Out")] = out
+    if op.output("NmsRoisNum"):
+        scope[op.output("NmsRoisNum")] = counts

@@ -374,3 +374,80 @@ class TestInterpTranslatorFamilies:
         (ce,) = self._run(build2, {"p": probs, "l": lab}, ["ce"])
         np.testing.assert_allclose(
             ce.ravel(), -np.log([0.7, 0.8]), rtol=1e-5)
+
+
+class TestDetectionInferencePrograms:
+    """SSD-style ProgramDesc graphs (prior_box + box_coder +
+    multiclass_nms, yolo_box) interpret end to end."""
+
+    def test_ssd_pipeline(self):
+        prog = Program()
+        b = prog.global_block()
+        b.create_var("feed", type=proto.VarType.FEED_MINIBATCH,
+                     persistable=True)
+        b.create_var("feat", [1, 8, 4, 4], "float32", need_check_feed=True)
+        b.create_var("img", [1, 3, 32, 32], "float32",
+                     need_check_feed=True)
+        b.create_var("scores", [1, 2, 32], "float32", need_check_feed=True)
+        b.create_var("deltas", [1, 32, 4], "float32",
+                     need_check_feed=True)
+        for nm in ("pb", "pbv", "pbf", "pbvf", "dec", "out", "cnt"):
+            b.create_var(nm, None, "float32")
+        b.append_op("feed", {"X": "feed"}, {"Out": "feat"}, {"col": 0})
+        b.append_op("feed", {"X": "feed"}, {"Out": "img"}, {"col": 1})
+        b.append_op("feed", {"X": "feed"}, {"Out": "scores"}, {"col": 2})
+        b.append_op("feed", {"X": "feed"}, {"Out": "deltas"}, {"col": 3})
+        b.append_op("prior_box", {"Input": "feat", "Image": "img"},
+                    {"Boxes": "pb", "Variances": "pbv"},
+                    {"min_sizes": [4.0], "aspect_ratios": [1.0, 2.0],
+                     "variances": [0.1, 0.1, 0.2, 0.2], "flip": False,
+                     "clip": True})
+        b.append_op("reshape", {"X": "pb"}, {"Out": "pbf"},
+                    {"shape": [32, 4]})
+        b.append_op("reshape", {"X": "pbv"}, {"Out": "pbvf"},
+                    {"shape": [32, 4]})
+        b.append_op("box_coder",
+                    {"PriorBox": "pbf", "PriorBoxVar": "pbvf",
+                     "TargetBox": "deltas"}, {"OutputBox": "dec"},
+                    {"code_type": "decode_center_size",
+                     "box_normalized": False})
+        b.append_op("multiclass_nms3",
+                    {"BBoxes": "dec", "Scores": "scores"},
+                    {"Out": "out", "NmsRoisNum": "cnt"},
+                    {"score_threshold": 0.1, "nms_top_k": 16,
+                     "keep_top_k": 8, "nms_threshold": 0.5,
+                     "background_label": 0})
+        rng = np.random.RandomState(0)
+        exe = static.Executor()
+        out, cnt = exe.run(prog, feed={
+            "feat": rng.randn(1, 8, 4, 4).astype(np.float32),
+            "img": rng.randn(1, 3, 32, 32).astype(np.float32),
+            "scores": np.abs(rng.rand(1, 2, 32)).astype(np.float32),
+            "deltas": (rng.randn(1, 32, 4) * 0.1).astype(np.float32),
+        }, fetch_list=["out", "cnt"])
+        assert np.asarray(out).shape == (1, 8, 6)
+        assert 0 <= int(np.asarray(cnt)[0]) <= 8
+
+    def test_yolo_box_program(self):
+        prog = Program()
+        b = prog.global_block()
+        b.create_var("feed", type=proto.VarType.FEED_MINIBATCH,
+                     persistable=True)
+        b.create_var("x", [1, 18, 2, 2], "float32", need_check_feed=True)
+        b.create_var("imgsz", [1, 2], "int32", need_check_feed=True)
+        b.create_var("boxes", None, "float32")
+        b.create_var("sc", None, "float32")
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.append_op("feed", {"X": "feed"}, {"Out": "imgsz"}, {"col": 1})
+        b.append_op("yolo_box", {"X": "x", "ImgSize": "imgsz"},
+                    {"Boxes": "boxes", "Scores": "sc"},
+                    {"anchors": [10, 13, 16, 30, 33, 23], "class_num": 1,
+                     "conf_thresh": 0.005, "downsample_ratio": 32})
+        rng = np.random.RandomState(1)
+        exe = static.Executor()
+        boxes, sc = exe.run(prog, feed={
+            "x": rng.randn(1, 18, 2, 2).astype(np.float32),
+            "imgsz": np.array([[64, 64]], np.int32),
+        }, fetch_list=["boxes", "sc"])
+        assert np.asarray(boxes).shape == (1, 12, 4)  # 2*2*3 anchors
+        assert np.asarray(sc).shape == (1, 12, 1)
