@@ -22,6 +22,7 @@ __all__ = [
     "row_conv", "set_value", "segment_sum", "segment_mean", "segment_max",
     "segment_min", "segment_pool", "fsp_matrix", "Print", "Assert",
     "conv_shift", "cvm", "shuffle_batch", "hash_op", "batch_fc",
+    "similarity_focus", "lookup_table_dequant",
 ]
 
 
@@ -606,3 +607,63 @@ def batch_fc(x, w, bias=None, name=None):
 
     args = (x, w) + ((bias,) if bias is not None else ())
     return dispatch(f, *args)
+
+
+def similarity_focus(x, axis, indexes, name=None):
+    """Similarity-focus mask (`operators/similarity_focus_op.cc`): for
+    each index along `axis`, greedily pick maxima of the [B, C] slice so
+    each row/column is used at most once, mark those positions 1, OR over
+    indexes, broadcast back to x's shape.  Host-side eager op (the
+    reference is CPU-only), matching bipartite_match's pattern."""
+    arr = np.asarray(jax.device_get(unwrap(x)), np.float32)
+    n, c, h, w = arr.shape
+    if axis not in (1, 2, 3):
+        raise ValueError("similarity_focus: axis must be 1, 2 or 3")
+    if not indexes:
+        raise ValueError("similarity_focus: indexes must be non-empty")
+    out = np.zeros_like(arr)
+    for b in range(n):
+        mask = None
+        for idx in indexes:
+            t = np.take(arr[b], int(idx), axis=axis - 1)  # 2-D slice
+            r, cc = t.shape
+            used_r = np.zeros(r, bool)
+            used_c = np.zeros(cc, bool)
+            m = np.zeros((r, cc), bool)
+            order = np.argsort(-t, axis=None)
+            picked = 0
+            for flat in order:
+                i, j = divmod(int(flat), cc)
+                if used_r[i] or used_c[j]:
+                    continue
+                m[i, j] = True
+                used_r[i] = used_c[j] = True
+                picked += 1
+                if picked == min(r, cc):
+                    break
+            mask = m if mask is None else (mask | m)
+        # broadcast over the reduced axis
+        full = np.expand_dims(mask, axis - 1)
+        out[b] = np.broadcast_to(full, arr[b].shape)
+    return Tensor(jnp.asarray(out))
+
+
+def lookup_table_dequant(w, ids, pow_2_bits=8, name=None):
+    """Quantized embedding lookup (`operators/lookup_table_dequant_op.h`):
+    each table row packs [min, max] as two float32 then uint8 codes in
+    the remaining float32 payload; out = (max-min)/2^bits * code + min.
+    w: [V, 2 + ceil(D/4)] float32; ids: int; returns [..., D] with
+    D = (row_width - 2) * 4."""
+
+    def f(wv, iv):
+        rows = wv[iv.reshape(-1)]                    # [N, 2 + P]
+        mn = rows[:, 0:1]
+        mx = rows[:, 1:2]
+        payload = rows[:, 2:]
+        codes = jax.lax.bitcast_convert_type(payload, jnp.uint8)
+        codes = codes.reshape(rows.shape[0], -1).astype(jnp.float32)
+        scale = (mx - mn) / float(2 ** pow_2_bits)
+        out = scale * codes + mn
+        return out.reshape(iv.shape + (out.shape[-1],))
+
+    return dispatch(f, w, ids, nondiff=(0, 1))

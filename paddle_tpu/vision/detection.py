@@ -779,10 +779,14 @@ def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
     # (documented divergence — the reference tracks provenance through its
     # LoD pipeline).
     def f(sel, boxes, cnt):
-        # sel [N,K,6]; boxes [N,M,4] -> index of first exact box match
+        # sel [N,K,6]; boxes [N,M,4] -> index of first exact box match,
+        # offset into the flat [N*M] frame like the reference kernel
+        # (multiclass_nms_op.cc adds offset = i * num_boxes per image)
+        m = boxes.shape[1]
         eq = (jnp.abs(sel[:, :, None, 2:6] - boxes[:, None, :, :])
               < 1e-5).all(-1)
-        idx = jnp.where(eq.any(-1), jnp.argmax(eq, axis=-1), -1)
+        base = (jnp.arange(sel.shape[0]) * m)[:, None]
+        idx = jnp.where(eq.any(-1), jnp.argmax(eq, axis=-1) + base, -1)
         row_valid = (jnp.arange(sel.shape[1])[None, :]
                      < jnp.atleast_1d(cnt)[:, None])
         return jnp.where(row_valid, idx, -1).astype(jnp.int64)

@@ -372,3 +372,36 @@ class TestSecondBatchOps:
         wi = np.asarray(w_in.numpy())[0]
         # fg rows carry 4 inside-weights at their class column
         assert wi[0].sum() == 4 and wi[n - 1].sum() == 0
+
+
+class TestFinalBatchOps:
+    def test_similarity_focus(self):
+        from paddle_tpu.ops.misc import similarity_focus
+        x = np.zeros((1, 2, 2, 3), np.float32)
+        # slice at channel 0: maxima at (0,2)=9 then row0/col2 used ->
+        # next eligible best is (1,0)=5
+        x[0, 0] = [[1, 2, 9], [5, 4, 3]]
+        out = np.asarray(similarity_focus(t(x), 1, [0]).numpy())
+        want = np.zeros((2, 3), np.float32)
+        want[0, 2] = 1
+        want[1, 0] = 1
+        np.testing.assert_array_equal(out[0, 0], want)
+        np.testing.assert_array_equal(out[0, 1], want)  # broadcast
+
+    def test_lookup_table_dequant(self):
+        from paddle_tpu.ops.misc import lookup_table_dequant
+        rng = np.random.RandomState(0)
+        V, D = 4, 8
+        codes = rng.randint(0, 256, (V, D)).astype(np.uint8)
+        mins = rng.randn(V).astype(np.float32)
+        maxs = mins + np.abs(rng.randn(V)).astype(np.float32) + 0.5
+        table = np.zeros((V, 2 + D // 4), np.float32)
+        table[:, 0] = mins
+        table[:, 1] = maxs
+        table[:, 2:] = codes.reshape(V, D // 4, 4).view(
+            np.float32).reshape(V, D // 4)
+        ids = np.array([[1, 3], [0, 2]], np.int64)
+        out = np.asarray(lookup_table_dequant(t(table), t(ids)).numpy())
+        scale = (maxs - mins) / 256.0
+        want = scale[:, None] * codes + mins[:, None]
+        np.testing.assert_allclose(out, want[ids], rtol=1e-5)

@@ -112,6 +112,8 @@ ALIASES = {
     "polygon_box_transform": "vdet:polygon_box_transform",
     "generate_proposal_labels": "vdet:generate_proposal_labels",
     "batch_fc": "ops:batch_fc", "correlation": "vops:correlation",
+    "similarity_focus": "ops:similarity_focus",
+    "lookup_table_dequant": "ops:lookup_table_dequant",
     "mine_hard_examples": "vdet:mine_hard_examples",
     "rpn_target_assign": "vdet:rpn_target_assign",
     "retinanet_target_assign": "vdet:retinanet_target_assign",
@@ -393,11 +395,9 @@ DESCOPED = {
     "rank_attention": "industrial CTR op",
     "match_matrix_tensor": "text matching (niche)",
     "var_conv_2d": "variable-size conv over LoD (niche)",
-    "similarity_focus": "niche attention variant",
     "filter_by_instag": "industrial instance-tag filter",
     "roi_perspective_transform": "OCR-specific geometric op",
     "generate_mask_labels": "Mask-RCNN train-time assigner",
-    "lookup_table_dequant": "PS quantized embedding",
 }
 
 
