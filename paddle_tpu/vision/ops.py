@@ -29,7 +29,7 @@ from ..core.tensor import Tensor, unwrap
 __all__ = [
     "iou_similarity", "box_clip", "box_coder", "prior_box", "yolo_box",
     "roi_align", "roi_pool", "nms", "multiclass_nms", "matrix_nms",
-    "deform_conv2d", "correlation",
+    "deform_conv2d", "correlation", "bilateral_slice",
 ]
 
 
@@ -743,3 +743,64 @@ def correlation(x1, x2, pad_size, kernel_size, max_displacement, stride1,
         return jnp.stack(planes, axis=1)
 
     return dispatch(f, x1, x2)
+
+
+def bilateral_slice(x, grid, guide, has_offset=False, name=None):
+    """HDRNet bilateral-grid slice-and-apply
+    (`operators/bilateral_slice_op.cu`): per output pixel, trilinearly
+    sample per-channel affine coefficients from the bilateral grid at
+    (x/w*gw, y/h*gh, guide*gd) with tent weights, then apply them to the
+    input channels (+1 offset row when has_offset).
+    x [N, Ci, H, W]; grid [N, Cg, gd, gh, gw] with
+    Cg = (Ci (+1 if has_offset)) * Co; guide [N, H, W].
+    Returns [N, Co, H, W]."""
+
+    def f(xv, gv, guide_v):
+        n, ci, h, w = xv.shape
+        _, cg, gd, gh, gw = gv.shape
+        stride = ci + (1 if has_offset else 0)
+        if cg % stride:
+            raise ValueError(
+                f"bilateral_slice: grid channels {cg} not divisible by "
+                f"input channels{' + offset' if has_offset else ''} "
+                f"({stride})")
+        co = cg // stride
+        gx = (jnp.arange(w) + 0.5) * gw / w
+        gy = (jnp.arange(h) + 0.5) * gh / h
+        gz = guide_v * gd
+
+        def tent(d):
+            return jnp.maximum(1.0 - jnp.abs(d), 0.0)
+
+        fx = jnp.floor(gx - 0.5).astype(jnp.int32)
+        fy = jnp.floor(gy - 0.5).astype(jnp.int32)
+        fz = jnp.floor(gz - 0.5).astype(jnp.int32)
+
+        def per_image(g_img, gz_i, fz_i):
+            # g_img [Cg, gd, gh, gw]; gz_i/fz_i [H, W]
+            acc = jnp.zeros((cg, gz_i.shape[0], gz_i.shape[1]),
+                            g_img.dtype)
+            for dx in (0, 1):
+                x_ = jnp.clip(fx + dx, 0, gw - 1)          # [W]
+                wx = tent(fx + dx + 0.5 - gx)              # [W]
+                for dy in (0, 1):
+                    y_ = jnp.clip(fy + dy, 0, gh - 1)      # [H]
+                    wy = tent(fy + dy + 0.5 - gy)          # [H]
+                    plane = g_img[:, :, y_, :][:, :, :, x_]  # [Cg,gd,H,W]
+                    for dz in (0, 1):
+                        z_ = jnp.clip(fz_i + dz, 0, gd - 1)  # [H, W]
+                        wz = tent(fz_i + dz + 0.5 - gz_i)    # [H, W]
+                        samp = jnp.take_along_axis(
+                            plane, z_[None, None, :, :], axis=1)[:, 0]
+                        acc = acc + samp * (wx[None, None, :]
+                                            * wy[None, :, None] * wz)
+            return acc  # [Cg, H, W]
+
+        coeff = jax.vmap(per_image)(gv, gz, fz)  # [N, Cg, H, W]
+        coeff = coeff.reshape(n, co, stride, h, w)
+        out = jnp.einsum("nosij,nsij->noij", coeff[:, :, :ci], xv)
+        if has_offset:
+            out = out + coeff[:, :, ci]
+        return out
+
+    return dispatch(f, x, grid, guide)

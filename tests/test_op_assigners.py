@@ -405,3 +405,68 @@ class TestFinalBatchOps:
         scale = (maxs - mins) / 256.0
         want = scale[:, None] * codes + mins[:, None]
         np.testing.assert_allclose(out, want[ids], rtol=1e-5)
+
+    def test_bilateral_slice(self):
+        from paddle_tpu.vision.ops import bilateral_slice
+        rng = np.random.RandomState(0)
+        N, Ci, Co, H, W = 1, 2, 2, 4, 5
+        gd, gh, gw = 3, 4, 4
+        stride = Ci + 1
+        x = rng.randn(N, Ci, H, W).astype(np.float32)
+        grid = rng.randn(N, stride * Co, gd, gh, gw).astype(np.float32)
+        guide = rng.rand(N, H, W).astype(np.float32)
+        out = np.asarray(bilateral_slice(
+            t(x), t(grid), t(guide), has_offset=True).numpy())
+        assert out.shape == (N, Co, H, W)
+        # has_offset=False path: pure multiplicative slice, all points
+        grid2 = grid[:, :Ci * Co]
+        out2 = np.asarray(bilateral_slice(
+            t(x), t(grid2), t(guide), has_offset=False).numpy())
+        assert out2.shape == (N, Co, H, W)
+        for oc in range(Co):
+            yy, xx = 1, 2
+            gx2 = (xx + 0.5) * gw / W
+            gy2 = (yy + 0.5) * gh / H
+            gz2 = guide[0, yy, xx] * gd
+            f2 = (int(np.floor(gx2 - 0.5)), int(np.floor(gy2 - 0.5)),
+                  int(np.floor(gz2 - 0.5)))
+            val2 = 0.0
+            for ic in range(Ci):
+                cs = 0.0
+                for dx2 in (f2[0], f2[0] + 1):
+                    x2_ = min(max(dx2, 0), gw - 1)
+                    wx2 = max(1.0 - abs(dx2 + 0.5 - gx2), 0.0)
+                    for dy2 in (f2[1], f2[1] + 1):
+                        y2_ = min(max(dy2, 0), gh - 1)
+                        wy2 = max(1.0 - abs(dy2 + 0.5 - gy2), 0.0)
+                        for dz2 in (f2[2], f2[2] + 1):
+                            z2_ = min(max(dz2, 0), gd - 1)
+                            wz2 = max(1.0 - abs(dz2 + 0.5 - gz2), 0.0)
+                            cs += grid2[0, Ci * oc + ic, z2_, y2_, x2_] \
+                                * wx2 * wy2 * wz2
+                val2 += cs * x[0, ic, yy, xx]
+            np.testing.assert_allclose(out2[0, oc, yy, xx], val2,
+                                       rtol=1e-4)
+        # one-point naive check (kernel port)
+        b, oc, y, xw = 0, 1, 2, 3
+        gx = (xw + 0.5) * gw / W
+        gy = (y + 0.5) * gh / H
+        gz = guide[b, y, xw] * gd
+        fx, fy, fz = (int(np.floor(gx - 0.5)), int(np.floor(gy - 0.5)),
+                      int(np.floor(gz - 0.5)))
+        val = 0.0
+        for ic in range(stride):
+            cs = 0.0
+            for xx in (fx, fx + 1):
+                x_ = min(max(xx, 0), gw - 1)
+                wx = max(1.0 - abs(xx + 0.5 - gx), 0.0)
+                for yy in (fy, fy + 1):
+                    y_ = min(max(yy, 0), gh - 1)
+                    wy = max(1.0 - abs(yy + 0.5 - gy), 0.0)
+                    for zz in (fz, fz + 1):
+                        z_ = min(max(zz, 0), gd - 1)
+                        wz = max(1.0 - abs(zz + 0.5 - gz), 0.0)
+                        cs += grid[b, stride * oc + ic, z_, y_, x_] \
+                            * wx * wy * wz
+            val += cs * x[b, ic, y, xw] if ic < Ci else cs
+        np.testing.assert_allclose(out[b, oc, y, xw], val, rtol=1e-4)

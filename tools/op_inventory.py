@@ -113,6 +113,7 @@ ALIASES = {
     "generate_proposal_labels": "vdet:generate_proposal_labels",
     "batch_fc": "ops:batch_fc", "correlation": "vops:correlation",
     "similarity_focus": "ops:similarity_focus",
+    "bilateral_slice": "vops:bilateral_slice",
     "lookup_table_dequant": "ops:lookup_table_dequant",
     "mine_hard_examples": "vdet:mine_hard_examples",
     "rpn_target_assign": "vdet:rpn_target_assign",
@@ -387,7 +388,6 @@ QUANT_FAMILY = {n for n in OPS if n.startswith("fake_")}
 
 # remaining deliberate descopes (niche, with reasons) — kept visibly small
 DESCOPED = {
-    "bilateral_slice": "HDRNet-specific CUDA op",
     "tree_conv": "tree-structured NN (niche)",
     "tdm_child": "tree-based deep match (industrial PS)",
     "tdm_sampler": "tree-based deep match (industrial PS)",
