@@ -116,16 +116,22 @@ def _cross_process(arr, kind, op=ReduceOp.SUM, src=0):
 
     arr = np.asarray(arr)
     nloc = jax.local_device_count()
-    sharding, replicate = _cross_process_plumbing(tuple(jax.devices()),
-                                                  arr.ndim)
-    d = len(jax.devices())
+    devs = tuple(jax.devices())  # also the mesh order of the sharding
+    sharding, replicate = _cross_process_plumbing(devs, arr.ndim)
+    d = len(devs)
     local = np.repeat(arr[None], nloc, axis=0)
     ga = jax.make_array_from_process_local_data(
         sharding, local, (d,) + arr.shape)
     gathered = replicate(ga)
     stacked = np.asarray(gathered.addressable_data(0))  # [d, ...]
-    # one row per process (devices within a process hold copies)
-    per_proc = stacked[::nloc]
+    # One row per process, ordered by rank.  Rows are selected by each
+    # device's process_index rather than assuming jax.devices() is
+    # contiguous/ordered by process (JAX doesn't guarantee that on all
+    # topologies); first local device of each process carries its value.
+    first_row = {}
+    for row, dev in enumerate(devs):
+        first_row.setdefault(dev.process_index, row)
+    per_proc = stacked[[first_row[p] for p in sorted(first_row)]]
     if kind == "all_gather":
         return per_proc
     if kind == "broadcast":

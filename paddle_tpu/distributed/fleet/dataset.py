@@ -88,9 +88,14 @@ class DatasetBase:
             # (`data_feed.cc` fp_ = popen) — e.g. "zcat" for gzip parts
             import subprocess
 
-            r = subprocess.run(f"{self.pipe_command} < {path}",
-                               shell=True, capture_output=True, text=True,
-                               check=True)
+            # pipe_command is a user-supplied shell pipeline (reference
+            # semantics), but the *filename* must not be interpolated
+            # into the shell — feed it via stdin instead so paths with
+            # spaces/metacharacters can't break parsing or run commands
+            with open(path, "rb") as fin:
+                r = subprocess.run(self.pipe_command, shell=True,
+                                   stdin=fin, capture_output=True,
+                                   text=True, check=True)
             return r.stdout.splitlines()
         with open(path, "r") as f:
             return f.read().splitlines()

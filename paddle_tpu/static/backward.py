@@ -71,6 +71,28 @@ def _append_grad_ops(block, target_names: List[str], stop_names: set,
             if i not in stop_names:
                 needed.add(i)
 
+    # Re-entry guard (advisor round-2 finding): a second
+    # append_backward/gradients pass whose grad vars overlap ones already
+    # written on this block would silently double-accumulate (the grad
+    # executor sums into existing @GRAD scope entries).  Detected
+    # statelessly off the persistent desc (Block wrappers are ephemeral —
+    # Program.global_block() builds a fresh one per call) by intersecting
+    # the @GRAD vars this pass will write with existing op outputs;
+    # passes over disjoint subgraphs remain allowed.
+    existing_outs = {a for op in fwd_ops for a in _op_io_args(op, "outputs")}
+    planned = {t + GRAD_SUFFIX for t in target_names}
+    planned |= {a + GRAD_SUFFIX for op_desc in emit
+                for a in _op_io_args(op_desc, "inputs")
+                if a not in stop_names}
+    clash = planned & existing_outs
+    if clash:
+        raise RuntimeError(
+            f"append_backward/gradients: grad var(s) {sorted(clash)} are "
+            "already written by earlier ops on this block; a second "
+            "backward pass over the same vars would double-accumulate "
+            "into them. Build a fresh Program (or clone) to re-derive "
+            "gradients.")
+
     grad_map: Dict[str, str] = {}
     for k, target_name in enumerate(target_names):
         tvar = block.var(target_name)

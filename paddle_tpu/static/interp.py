@@ -1110,11 +1110,17 @@ def _cross_entropy_op(op, scope, feeds, fetches):
             -1, keepdims=True)
     else:
         ignore = op.attr("ignore_index", -100)
-        lab = label.reshape(label.shape[0]).astype(jnp.int32)
+        # arbitrary leading dims (e.g. [N,T,C] sequence labeling, which
+        # the reference op supports): flatten to (-1, C), restore after
+        c = x.shape[-1]
+        lead = x.shape[:-1]
+        xf = x.reshape(-1, c)
+        lab = label.reshape(-1).astype(jnp.int32)
         picked = jnp.take_along_axis(
-            x, jnp.clip(lab, 0, x.shape[-1] - 1)[:, None], axis=-1)
+            xf, jnp.clip(lab, 0, c - 1)[:, None], axis=-1)
         loss = -jnp.log(jnp.clip(picked, 1e-12, None))
         loss = jnp.where(lab[:, None] == ignore, 0.0, loss)
+        loss = loss.reshape(lead + (1,))
     scope[op.output("Y") or op.output("Out")] = loss
 
 

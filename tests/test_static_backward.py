@@ -259,6 +259,31 @@ class TestAppendBackward:
         with pytest.raises(ValueError, match="writes its own input"):
             static.append_backward(b.var("loss"))
 
+    def test_double_append_backward_rejected(self):
+        # a second append_backward would re-emit grad ops and silently
+        # double-accumulate into the same @GRAD vars; must raise even
+        # through a freshly-fetched Block/Variable wrapper
+        import pytest
+
+        prog = _linear_softmax_program()
+        static.append_backward(prog.global_block().var("loss"))
+        with pytest.raises(RuntimeError, match="double-accumulate"):
+            static.append_backward(prog.global_block().var("loss"))
+
+    def test_second_target_sharing_vars_rejected(self):
+        # two losses sharing a subgraph: the second backward pass would
+        # sum its grads into the first pass's @GRAD vars
+        import pytest
+
+        prog = _linear_softmax_program()
+        b = prog.global_block()
+        b.create_var("loss2", [1], "float32")
+        b.append_op("reduce_sum", {"X": "p"}, {"Out": "loss2"},
+                    {"reduce_all": True})
+        static.append_backward(b.var("loss"))
+        with pytest.raises(RuntimeError, match="double-accumulate"):
+            static.append_backward(prog.global_block().var("loss2"))
+
     def test_serialized_backward_program_roundtrips(self):
         # the augmented program (with *_grad ops) survives the
         # framework.proto codec and still runs
