@@ -19,6 +19,8 @@ import threading
 import jax
 import numpy as np
 
+from . import flags as _flags
+
 
 class _State(threading.local):
     def __init__(self):
@@ -96,12 +98,44 @@ def get_trace_write(tensor):
 # Trace mode: keys are split off the threaded trace key so that the captured
 # program stays pure (a fresh key is fed per invocation by the jit wrapper).
 # ---------------------------------------------------------------------------
+_RNG_IMPL = None
+
+
+def _rng_impl() -> str:
+    """Framework PRNG impl, decided once at first key creation (NOT at
+    import — probing the backend at import would force JAX backend init as
+    a side effect of `import paddle_tpu`): the hardware RBG generator on
+    TPU (threefry mask generation measurably slows dropout-bearing train
+    steps — ViT-B/16 630 -> 719 imgs/s switching to rbg, round-3 probe),
+    threefry elsewhere.  Only paddle_tpu's own keys are affected; the
+    process-global jax default impl is never touched."""
+    global _RNG_IMPL
+    if _RNG_IMPL is None:
+        impl = "threefry2x32"
+        try:
+            if _flags.flag("use_rbg_rng") and jax.default_backend() == "tpu":
+                impl = "rbg"
+        except Exception:
+            pass
+        _RNG_IMPL = impl
+    return _RNG_IMPL
+
+
+def make_rng_key(seed: int = 0):
+    """Typed PRNG key with the framework's impl (see `_rng_impl`).  All
+    key-creation sites that feed the jit trace machinery must use this so
+    trace-time and run-time keys agree in impl and shape."""
+    return jax.random.key(int(seed), impl=_rng_impl())
+
+
 class Generator:
     def __init__(self, seed: int = 0):
-        self._key = jax.random.PRNGKey(seed)
+        self._seed = int(seed)
+        self._key = None  # created lazily via make_rng_key
 
     def seed(self, seed: int):
-        self._key = jax.random.PRNGKey(seed)
+        self._seed = int(seed)
+        self._key = None
 
     def next_key(self):
         if _state.trace_mode:
@@ -112,6 +146,8 @@ class Generator:
                 )
             _state.trace_rng_key, sub = jax.random.split(_state.trace_rng_key)
             return sub
+        if self._key is None:
+            self._key = make_rng_key(self._seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 

@@ -152,7 +152,7 @@ class PipelineTrainStep:
         """Trace stage boundaries to find the (uniform) activation spec."""
         def s0(vec, x):
             return self._apply_stage(0, vec, Tensor(x),
-                                     jax.random.PRNGKey(0))
+                                     framework.make_rng_key(0))
 
         out = jax.eval_shape(s0, jax.ShapeDtypeStruct((self.S,),
                                                       jnp.float32),
@@ -164,7 +164,7 @@ class PipelineTrainStep:
         for r in range(1, self.L - 1):
             def sr(vec, a, _r=r):
                 return self._apply_stage(_r, vec, Tensor(a),
-                                         jax.random.PRNGKey(0))
+                                         framework.make_rng_key(0))
             o = jax.eval_shape(sr,
                                jax.ShapeDtypeStruct((self.S,), jnp.float32),
                                jax.ShapeDtypeStruct(spec[0], spec[1]))

@@ -107,7 +107,7 @@ class StaticFunction:
 
         parrs = [params[k]._array for k in pnames]
         barrs = [buffers[k]._array for k in bnames]
-        rng = jax.random.PRNGKey(0) if framework.in_trace() else framework.default_generator.next_key()
+        rng = framework.make_rng_key(0) if framework.in_trace() else framework.default_generator.next_key()
 
         n_out = [None]
 
@@ -168,7 +168,7 @@ class StaticFunction:
         parrs = [params[k]._array for k in pnames]
         barrs = [buffers[k]._array for k in bnames]
         in_arrays = [args[i]._array for i in tensor_positions]
-        _ = jitted.lower(parrs, in_arrays, barrs, jax.random.PRNGKey(0))
+        _ = jitted.lower(parrs, in_arrays, barrs, framework.make_rng_key(0))
         return jitted, list(buf_targets_holder)
 
 
@@ -326,7 +326,7 @@ def save(layer, path, input_spec=None, **config):
         with _SwappedState(swap) as sw:
             sw.bind({k: a for k, a in zip(pnames, parrs)})
             sw.bind({f"__buf__{k}": a for k, a in zip(bnames, barrs)})
-            with framework.trace_guard(rng_key=jax.random.PRNGKey(0), writes={}):
+            with framework.trace_guard(rng_key=framework.make_rng_key(0), writes={}):
                 out = layer(*[Tensor(i) for i in inputs])
         outs = out if isinstance(out, (list, tuple)) else (out,)
         return tuple(o._array for o in outs)

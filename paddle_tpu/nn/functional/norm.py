@@ -33,14 +33,23 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
     if use_batch_stats:
         def f(a, *wb):
+            # stats in f32 (AMP-black), but the *activation* stays in the
+            # input dtype: folding scale/rsqrt into one per-channel a,b
+            # keeps the application a single fused x*s+t in a.dtype —
+            # emitting f32 out of BN doubled the HBM traffic of every
+            # downstream relu/residual/conv-recast under bf16 AMP
+            # (measured 2190->1708 imgs/s on ResNet50, round-3 probe)
             mean = jnp.mean(a.astype(jnp.float32), axis=reduce_axes)
             var = jnp.var(a.astype(jnp.float32), axis=reduce_axes)
-            out = (a - mean.reshape(bshape).astype(a.dtype)) * jax.lax.rsqrt(
-                var.reshape(bshape) + epsilon
-            ).astype(a.dtype)
+            rstd = jax.lax.rsqrt(var + epsilon)
             if wb:
-                out = out * wb[0].reshape(bshape) + wb[1].reshape(bshape)
-            return out
+                s = wb[0] * rstd
+                t = wb[1] - mean * s
+            else:
+                s = rstd
+                t = -mean * rstd
+            return a * s.reshape(bshape).astype(a.dtype) + \
+                t.reshape(bshape).astype(a.dtype)
 
         # update running stats eagerly (buffers; reference batch_norm_op
         # updates MeanOut/VarianceOut in the same kernel)
@@ -60,12 +69,15 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         rm, rv = unwrap(running_mean), unwrap(running_var)
 
         def f(a, *wb):
-            out = (a - rm.reshape(bshape).astype(a.dtype)) * jax.lax.rsqrt(
-                rv.reshape(bshape) + epsilon
-            ).astype(a.dtype)
+            rstd = jax.lax.rsqrt(rv.astype(jnp.float32) + epsilon)
             if wb:
-                out = out * wb[0].reshape(bshape) + wb[1].reshape(bshape)
-            return out
+                s = wb[0] * rstd
+                t = wb[1] - rm * s
+            else:
+                s = rstd
+                t = -rm * rstd
+            return a * s.reshape(bshape).astype(a.dtype) + \
+                t.reshape(bshape).astype(a.dtype)
 
     if weight is not None:
         return dispatch(f, x, weight, bias)
@@ -96,7 +108,7 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
         var = jnp.var(a32, axis=axes, keepdims=True)
         out = ((a32 - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
         if wb:
-            out = out * wb[0] + wb[1]
+            out = out * wb[0].astype(a.dtype) + wb[1].astype(a.dtype)
         return out
 
     if weight is not None:
@@ -121,7 +133,8 @@ def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
         out = out.reshape(a.shape)
         if wb:
             bshape = [1, c] + [1] * (a.ndim - 2)
-            out = out * wb[0].reshape(bshape) + wb[1].reshape(bshape)
+            out = out * wb[0].reshape(bshape).astype(a.dtype) + \
+                wb[1].reshape(bshape).astype(a.dtype)
         if c_axis != 1:
             out = jnp.moveaxis(out, 1, c_axis)
         return out
@@ -148,7 +161,8 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None
                 bshape = [1, -1] + [1] * (nd - 2)
             else:
                 bshape = [1] * (nd - 1) + [-1]
-            out = out * wb[0].reshape(bshape) + wb[1].reshape(bshape)
+            out = out * wb[0].reshape(bshape).astype(a.dtype) + \
+                wb[1].reshape(bshape).astype(a.dtype)
         return out
 
     if weight is not None:

@@ -101,7 +101,9 @@ def pipeline_1f1b_local(fwd_apply: Callable, bwd_apply: Callable, vec,
     fwd_perm = [(i, (i + 1) % L) for i in range(L)]
     bwd_perm = [((i + 1) % L, i) for i in range(L)]
     if rng is None:
-        rng = jax.random.PRNGKey(0)
+        from ..core.framework import make_rng_key
+
+        rng = make_rng_key(0)
 
     resid = jnp.zeros((D,) + tuple(act_shape), act_dtype)
     rot = jnp.zeros(act_shape, act_dtype)     # incoming activation
