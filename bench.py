@@ -96,6 +96,13 @@ def main():
             vision = _vision_benches(paddle, amp, jit, nn, optimizer, np)
         except Exception as e:  # don't lose the flagship metric
             vision = {"vision_bench_error": str(e)[:200]}
+        try:
+            # session context for every MFU row (the shared tunnel chip's
+            # delivered peak swings ~49-128 Tflop/s across sessions)
+            vision["chip_effective_peak_tflops"] = round(
+                _calibrate_effective_peak(np) / 1e12, 1)
+        except Exception as e:
+            vision["calibration_error"] = str(e)[:200]
     print(json.dumps({
         "metric": "gpt_base_pretrain_tokens_per_sec_per_chip",
         "value": round(tok_per_s, 1),
@@ -109,16 +116,51 @@ def main():
     }))
 
 
+def _calibrate_effective_peak(np):
+    """Best-of-3 8192^3 bf16 matmul chain — what the (shared) chip actually
+    delivers right now.  The tunnel chip's effective peak swings 49-128
+    Tflop/s across sessions; recording it makes the MFU rows interpretable
+    (docs/VISION_PERF.md)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = 8192
+    a = jnp.ones((n, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a, b):
+        def body(i, c):
+            return (c @ b) * 0.5 + a * 0.001
+        return lax.fori_loop(0, 20, body, a)
+
+    r = mm(a, a)
+    float(np.asarray(r[0, 0]))
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = mm(a, r)
+        float(np.asarray(r[0, 0]))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return 20 * 2 * n ** 3 / best
+
+
 def _vision_benches(paddle, amp, jit, nn, optimizer, np):
     """BASELINE configs 1 and 5: ResNet50 and ViT-B/16 train-step imgs/s on
-    one chip, ImageNet shapes, bf16 AMP."""
+    one chip, ImageNet shapes, bf16 AMP.  Train-step model FLOPs ~= 3x
+    forward (fwd + 2x bwd weight/input passes).  Per-image forward counts
+    use TRUE FLOPs (2 per multiply-add) to match the GPT row's 6N/token
+    convention: the papers' "4.1 / 17.6 GFLOPs" are multiply-add counts,
+    so ResNet50 fwd = 8.2e9, ViT-B/16 fwd = 35.2e9 (docs/VISION_PERF.md)."""
     from paddle_tpu.vision.models import resnet50, vit_b_16
 
     out = {}
-    for key, build, batch in (("resnet50_imgs_per_sec_per_chip",
-                               lambda: resnet50(num_classes=1000), 256),
-                              ("vit_b16_imgs_per_sec_per_chip",
-                               lambda: vit_b_16(num_classes=1000), 128)):
+    for key, build, batch, flops_per_img in (
+            ("resnet50_imgs_per_sec_per_chip",
+             lambda: resnet50(num_classes=1000), 256, 3 * 8.2e9),
+            ("vit_b16_imgs_per_sec_per_chip",
+             lambda: vit_b_16(num_classes=1000), 128, 3 * 35.2e9)):
         paddle.seed(0)
         model = build()
         opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
@@ -148,7 +190,10 @@ def _vision_benches(paddle, amp, jit, nn, optimizer, np):
             float(np.asarray(loss._array))
             dt = time.perf_counter() - t0
             best = dt if best is None else min(best, dt)
-        out[key] = round(batch * steps / best, 1)
+        imgs = batch * steps / best
+        out[key] = round(imgs, 1)
+        out[key.replace("imgs_per_sec_per_chip", "mfu_vs_peak")] = round(
+            imgs * flops_per_img / 197e12, 4)
     return out
 
 

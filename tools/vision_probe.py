@@ -1,0 +1,193 @@
+"""Vision-ceiling probe: pure-JAX ResNet50 train step variants on TPU.
+
+Measures the achievable ceiling on this chip independent of the framework
+(docs/VISION_PERF.md), with the same fencing discipline as bench.py (host
+readback ends each window; donated param chain makes the readback depend
+on all steps).
+
+Usage: python tools/vision_probe.py [nhwc|nchw|nobn|bnf32|both] [batch...]
+  nhwc/nchw  layout comparison (measured: a wash — XLA normalizes both)
+  nobn       no batch-norm ceiling (BN costs ~1/3 of the step)
+  bnf32      BN emitting f32 activations (reproduces the round-2 regression)
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+LAYOUT = "NHWC"  # flipped by __main__
+BF16 = jnp.bfloat16
+
+
+def conv(x, w, stride=1, padding="SAME"):
+    if LAYOUT == "NHWC":
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+    else:
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    return lax.conv_general_dilated(x, w, (stride, stride), padding,
+                                    dimension_numbers=dn)
+
+
+def bn(x, scale, bias):
+    # train-mode batch stats in f32, like framework BN under AMP
+    axes = (0, 1, 2) if LAYOUT == "NHWC" else (0, 2, 3)
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axes, keepdims=True)
+    var = xf.var(axes, keepdims=True)
+    shp = [1, 1, 1, 1]
+    c_ax = 3 if LAYOUT == "NHWC" else 1
+    shp[c_ax] = x.shape[c_ax]
+    out = (xf - mu) * lax.rsqrt(var + 1e-5)
+    out = out * scale.reshape(shp) + bias.reshape(shp)
+    return out.astype(x.dtype)
+
+
+def make_conv_w(key, cin, cout, k):
+    w = jax.random.normal(key, (k, k, cin, cout), jnp.float32) * 0.05
+    if LAYOUT == "NCHW":
+        w = w.transpose(3, 2, 0, 1)
+    return w
+
+
+def init_params(key):
+    params = {}
+    ks = iter(jax.random.split(key, 200))
+    params["stem"] = make_conv_w(next(ks), 3, 64, 7)
+    params["stem_s"] = jnp.ones(64); params["stem_b"] = jnp.zeros(64)
+    blocks = [(3, 64, 256), (4, 128, 512), (6, 256, 1024), (3, 512, 2048)]
+    cin = 64
+    for si, (n, mid, cout) in enumerate(blocks):
+        for bi in range(n):
+            p = {}
+            p["c1"] = make_conv_w(next(ks), cin, mid, 1)
+            p["s1"] = jnp.ones(mid); p["b1"] = jnp.zeros(mid)
+            p["c2"] = make_conv_w(next(ks), mid, mid, 3)
+            p["s2"] = jnp.ones(mid); p["b2"] = jnp.zeros(mid)
+            p["c3"] = make_conv_w(next(ks), mid, cout, 1)
+            p["s3"] = jnp.ones(cout); p["b3"] = jnp.zeros(cout)
+            if bi == 0:
+                p["down"] = make_conv_w(next(ks), cin, cout, 1)
+                p["ds"] = jnp.ones(cout); p["db"] = jnp.zeros(cout)
+            params[f"blk{si}_{bi}"] = p
+            cin = cout
+    params["fc_w"] = jax.random.normal(next(ks), (2048, 1000),
+                                       jnp.float32) * 0.01
+    params["fc_b"] = jnp.zeros(1000)
+    return params
+
+
+def forward(params, x):
+    x = x.astype(BF16)
+    stem_stride = 2
+    x = conv(x, params["stem"].astype(BF16), stem_stride)
+    x = bn(x, params["stem_s"], params["stem_b"])
+    x = jax.nn.relu(x)
+    # maxpool 3x3 s2
+    if LAYOUT == "NHWC":
+        x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    else:
+        x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 1, 3, 3),
+                              (1, 1, 2, 2), "SAME")
+    blocks = [(3, 64, 256), (4, 128, 512), (6, 256, 1024), (3, 512, 2048)]
+    for si, (n, mid, cout) in enumerate(blocks):
+        for bi in range(n):
+            p = params[f"blk{si}_{bi}"]
+            stride = 2 if (bi == 0 and si > 0) else 1
+            idn = x
+            h = jax.nn.relu(bn(conv(x, p["c1"].astype(BF16), 1),
+                               p["s1"], p["b1"]))
+            h = jax.nn.relu(bn(conv(h, p["c2"].astype(BF16), stride),
+                               p["s2"], p["b2"]))
+            h = bn(conv(h, p["c3"].astype(BF16), 1), p["s3"], p["b3"])
+            if "down" in p:
+                idn = bn(conv(x, p["down"].astype(BF16), stride),
+                         p["ds"], p["db"])
+            x = jax.nn.relu(h + idn)
+    axes = (1, 2) if LAYOUT == "NHWC" else (2, 3)
+    x = x.mean(axes).astype(jnp.float32)
+    return x @ params["fc_w"] + params["fc_b"]
+
+
+def loss_fn(params, x, y):
+    logits = forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def train_step(params, mom, x, y):
+    loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+    new_p = jax.tree.map(lambda p, g, m: p - 0.1 * (0.9 * m + g), params,
+                         grads, mom)
+    new_m = jax.tree.map(lambda g, m: 0.9 * m + g, grads, mom)
+    return loss, new_p, new_m
+
+
+def run(layout, batch):
+    global LAYOUT
+    LAYOUT = layout
+    params = init_params(jax.random.PRNGKey(0))
+    mom = jax.tree.map(jnp.zeros_like, params)
+    rng = np.random.default_rng(0)
+    shape = (batch, 224, 224, 3) if layout == "NHWC" else (batch, 3, 224, 224)
+    x = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 1000, (batch,)).astype(np.int32))
+    steps = 10
+    for _ in range(2):
+        loss, params, mom = train_step(params, mom, x, y)
+    float(np.asarray(loss))
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, params, mom = train_step(params, mom, x, y)
+        float(np.asarray(loss))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    imgs = batch * steps / best
+    # fwd 8.2e9 true FLOPs/img (2 per multiply-add; the paper's "4.1
+    # GFLOPs" counts MACs), train ~3x fwd
+    flops = 3 * 8.2e9 * imgs
+    print(f"{layout} batch={batch}: {imgs:.1f} imgs/s  "
+          f"~{flops/1e12:.1f} Tflop/s  MFU~{flops/197e12*100:.1f}%",
+          flush=True)
+    train_step.clear_cache()
+
+
+def bn_none(x, scale, bias):
+    return x
+
+
+def bn_f32_out(x, scale, bias):
+    axes = (0, 1, 2) if LAYOUT == "NHWC" else (0, 2, 3)
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axes, keepdims=True)
+    var = xf.var(axes, keepdims=True)
+    shp = [1, 1, 1, 1]
+    c_ax = 3 if LAYOUT == "NHWC" else 1
+    shp[c_ax] = x.shape[c_ax]
+    out = (xf - mu) * lax.rsqrt(var + 1e-5)
+    return out * scale.reshape(shp) + bias.reshape(shp)  # stays f32
+
+
+if __name__ == "__main__":
+    import sys
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    batches = [int(b) for b in (sys.argv[2:] or [256])]
+    if which == "nobn":
+        globals()["bn"] = bn_none
+    elif which == "bnf32":
+        globals()["conv"] = (
+            lambda x, w, s=1, p="SAME", _c=conv: _c(x.astype(BF16), w, s, p))
+        globals()["bn"] = bn_f32_out
+    for b in batches:
+        if which in ("both", "nhwc", "nobn", "bnf32"):
+            run("NHWC", b)
+        if which in ("both", "nchw"):
+            run("NCHW", b)
