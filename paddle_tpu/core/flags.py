@@ -63,9 +63,10 @@ define_flag("allocator_strategy", "pjrt", "memory is managed by PJRT")
 define_flag("log_level", 0, "VLOG-style verbosity")
 define_flag("use_pallas_attention", "auto",
             "attention kernel policy: auto (seq threshold), 1 force, 0 off")
-define_flag("pallas_attention_min_seq", 1024,
+define_flag("pallas_attention_min_seq", 512,
             "sequence length at/above which 'auto' picks the Pallas kernel "
-            "(measured crossover vs XLA on v5e: see BENCH_kernels.json)")
+            "(measured crossover vs XLA on v5e: see BENCH_kernels.json; "
+            "round 3's causal dead-block DMA clamps moved it 1024 -> 512)")
 define_flag("use_pallas_layernorm", False,
             "use the Pallas fused layer_norm kernel instead of XLA fusion")
 define_flag("use_rbg_rng", True,

@@ -117,6 +117,32 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
     lse_ref[...] = jnp.broadcast_to(lse[:, None], (block_q, _LANES))
 
 
+# Causal dead-block fetch clamps, shared by the streaming forward and both
+# backward kernels.  A tile wholly above the causal diagonal contributes
+# nothing: compute there is pl.when-gated off in the kernels, and these
+# index maps additionally skip the DMA by clamping the streamed block
+# index to the live range (Pallas skips re-fetch when the index repeats).
+# Keep the formulas in sync with the kernels' `live` predicates.
+def _stream_idx(i, j, r):
+    return (i, r, 0)
+
+
+def _causal_kv_clamp(block_q, block_k):
+    """Fetch index for K/V streamed under a pinned q block j: clamp to the
+    last live K block, ((j+1)*block_q - 1) // block_k."""
+    def idx(i, j, r):
+        return (i, jnp.minimum(r, ((j + 1) * block_q - 1) // block_k), 0)
+    return idx
+
+
+def _causal_q_clamp(block_q, block_k):
+    """Fetch index for Q rows streamed under a pinned K block j: clamp to
+    the first live q block, (j*block_k) // block_q."""
+    def idx(i, j, r):
+        return (i, jnp.maximum(r, (j * block_k) // block_q), 0)
+    return idx
+
+
 # VMEM budget for holding a head's full K+V resident in the forward
 # kernel (the scoped limit on this toolchain is 16MB; leave room for the
 # q/o blocks and pipelining buffers).  Measured: resident beats streaming
@@ -222,17 +248,8 @@ def _pallas_forward(q, k, v, is_causal, scale, block_q, block_k):
         _fwd_kernel, block_k=block_k, seq_k=sk, scale=s, causal=is_causal,
         block_q=block_q,
     )
-    if is_causal:
-        # Don't DMA K/V blocks fully above the diagonal (compute there is
-        # pl.when-gated off anyway): clamp the fetched block index to the
-        # last live one for this q block — Pallas skips the re-fetch when
-        # the index repeats, halving dead K/V traffic at long S.
-        def kv_idx(i, j, r):
-            return (i, jnp.minimum(r, ((j + 1) * block_q - 1) // block_k),
-                    0)
-    else:
-        def kv_idx(i, j, r):
-            return (i, r, 0)
+    kv_idx = (_causal_kv_clamp(block_q, block_k) if is_causal
+              else _stream_idx)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q, sk // block_k),
@@ -401,18 +418,19 @@ def _pallas_backward(q, k, v, out, lse, g, is_causal, scale, block_q,
     outr = out.reshape(b * h, sq, d)
     lse_b = jnp.broadcast_to(lse[:, :, None], (b * h, sq, _LANES))
 
+    q_idx = (_causal_q_clamp(block_q, block_k) if is_causal
+             else _stream_idx)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
                           seq_q=sq, scale=s, causal=is_causal),
         grid=(b * h, sk // block_k, sq // block_q),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j, r: (i, r, 0)),
+            pl.BlockSpec((None, block_q, d), q_idx),
             pl.BlockSpec((None, block_k, d), lambda i, j, r: (i, j, 0)),
             pl.BlockSpec((None, block_k, d), lambda i, j, r: (i, j, 0)),
-            pl.BlockSpec((None, block_q, d), lambda i, j, r: (i, r, 0)),
-            pl.BlockSpec((None, block_q, d), lambda i, j, r: (i, r, 0)),
-            pl.BlockSpec((None, block_q, _LANES),
-                         lambda i, j, r: (i, r, 0)),
+            pl.BlockSpec((None, block_q, d), q_idx),
+            pl.BlockSpec((None, block_q, d), q_idx),
+            pl.BlockSpec((None, block_q, _LANES), q_idx),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda i, j, r: (i, j, 0)),
@@ -426,14 +444,16 @@ def _pallas_backward(q, k, v, out, lse, g, is_causal, scale, block_q,
                         pltpu.VMEM((block_k, d), jnp.float32)],
     )(qr, kr, vr, dor, outr, lse_b)
 
+    kv_idx = (_causal_kv_clamp(block_q, block_k) if is_causal
+              else _stream_idx)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
                           seq_k=sk, scale=s, causal=is_causal),
         grid=(b * h, sq // block_q, sk // block_k),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j, r: (i, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda i, j, r: (i, r, 0)),
-            pl.BlockSpec((None, block_k, d), lambda i, j, r: (i, r, 0)),
+            pl.BlockSpec((None, block_k, d), kv_idx),
+            pl.BlockSpec((None, block_k, d), kv_idx),
             pl.BlockSpec((None, block_q, d), lambda i, j, r: (i, j, 0)),
             pl.BlockSpec((None, block_q, d), lambda i, j, r: (i, j, 0)),
             pl.BlockSpec((None, block_q, _LANES),
@@ -479,29 +499,40 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False, scale=None,
     """q,k,v: [B,H,S,D].  Uses the Pallas kernels when mask is None and shapes
     tile; otherwise the XLA composed reference.  Fully differentiable with a
     Pallas backward (dq/dk/dv kernels recomputing P from the saved
-    logsumexp).  512x512 blocks won every Pallas-preferred shape in the
-    measured sweep (BENCH_kernels.json); `pick_blocks` shrinks them for
-    sequences they don't divide."""
+    logsumexp).  Default 512x512 blocks per the measured sweep
+    (BENCH_kernels.json; individual shapes occasionally prefer 256 on the
+    shared bench chip but within run noise); `pick_blocks` shrinks them for
+    sequences they don't divide.
+
+    Causal cross-length attention (seq_q != seq_k) always takes the XLA
+    reference: its causal mask is bottom-right aligned (tril offset
+    kl-ql), while the kernels mask top-left (q_pos >= k_pos) — the two
+    only agree at seq_q == seq_k."""
     picked = pick_blocks(q.shape[-2], k.shape[-2], block_q, block_k)
     if (not _HAS_PALLAS or mask is not None or picked is None
+            or (is_causal and q.shape[-2] != k.shape[-2])
             or jax.default_backend() != "tpu"):
         return _xla_reference(q, k, v, mask, is_causal, scale)
     block_q, block_k = picked
     # Policy: flag FLAGS_use_pallas_attention: "auto" (default; threshold
     # from the measured crossover vs XLA's fused attention, see
     # BENCH_kernels.json), "1"/"0" force on/off.
-    if not pallas_attention_wanted(q.shape[-2]):
+    if not pallas_attention_wanted(q.shape[-2], is_causal):
         return _xla_reference(q, k, v, mask, is_causal, scale)
     return _flash_diff(q, k, v, is_causal, scale, block_q, block_k)
 
 
-def _auto_threshold():
+def _auto_threshold(is_causal: bool):
     from ...core import flags as _flags
 
     try:
-        return int(_flags.flag("pallas_attention_min_seq"))
+        base = int(_flags.flag("pallas_attention_min_seq"))
     except Exception:
-        return 1024
+        base = 512
+    # the S=512 crossover was measured causal-only (the dead-block DMA
+    # clamps do nothing for full attention); non-causal keeps the round-2
+    # crossover of 1024
+    return base if is_causal else max(base, 1024)
 
 
 def pick_blocks(seq_q: int, seq_k: int, block_q: int = 512,
@@ -518,7 +549,7 @@ def pick_blocks(seq_q: int, seq_k: int, block_q: int = 512,
     return block_q, block_k
 
 
-def pallas_attention_wanted(seq_len: int) -> bool:
+def pallas_attention_wanted(seq_len: int, is_causal: bool = True) -> bool:
     """Shared FLAGS_use_pallas_attention policy ('1'/'0' force, 'auto'
     applies the measured seq threshold) — the single gate used by both the
     single-device kernel and the ring-attention blocks."""
@@ -532,4 +563,4 @@ def pallas_attention_wanted(seq_len: int) -> bool:
         return False
     if pol in ("1", "True", "true"):
         return True
-    return pol == "auto" and seq_len >= _auto_threshold()
+    return pol == "auto" and seq_len >= _auto_threshold(is_causal)

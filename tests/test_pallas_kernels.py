@@ -73,6 +73,27 @@ class TestFlashAttention:
                                    atol=2e-3)
         assert lse.shape == (2, 256) and bool(jnp.all(jnp.isfinite(lse)))
 
+    def test_causal_cross_length_routes_to_xla(self, monkeypatch):
+        # kernels mask top-left (q_pos >= k_pos); the reference masks
+        # bottom-right (tril offset kl-ql) — they only agree at sq == sk,
+        # so cross-length causal must never reach the Pallas path
+        def boom(*a, **k):
+            raise AssertionError("Pallas path taken for cross-length causal")
+
+        monkeypatch.setattr(FA, "_flash_diff", boom)
+        monkeypatch.setattr(FA, "_HAS_PALLAS", True)
+        monkeypatch.setattr(FA.jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(FA, "pallas_attention_wanted",
+                            lambda s, c=True: True)
+        q = jnp.zeros((1, 2, 128, 64))
+        k = jnp.zeros((1, 2, 256, 64))
+        out = FA.flash_attention_fwd(q, k, k, is_causal=True)
+        assert out.shape == (1, 2, 128, 64)
+
+    def test_noncausal_threshold_stays_1024(self):
+        assert FA._auto_threshold(is_causal=True) == 512
+        assert FA._auto_threshold(is_causal=False) == 1024
+
     def test_uneven_blocks_backward(self, interpret_pallas):
         # block_q != block_k exercises the causal loop-bound arithmetic
         q, k, v, g = self._inputs(2, S=256)
