@@ -69,6 +69,10 @@ define_flag("pallas_attention_min_seq", 512,
             "round 3's causal dead-block DMA clamps moved it 1024 -> 512)")
 define_flag("use_pallas_layernorm", False,
             "use the Pallas fused layer_norm kernel instead of XLA fusion")
+define_flag("interp_tensor_array_capacity", 0,
+            "fallback capacity for TensorArrays written inside an "
+            "interpreted `while` when the loop bound cannot be inferred "
+            "from the Condition (0 = raise instead)")
 define_flag("use_rbg_rng", True,
             "on TPU, use the hardware RBG PRNG for the framework's random "
             "ops instead of threefry (measured: recovers ~60% of dropout's "

@@ -74,10 +74,12 @@ class Executor:
             # them, so training on a program_from_layer program advances
             base = dict(getattr(program, "_param_scope", None) or {})
             base.update(scope if scope is not None else self.scope)
-            # key includes the op count (append_backward/minimize add ops)
-            # and the desc version (set_lr rewrites attrs + bumps it) so
-            # program mutations invalidate the compiled runner
-            key = (id(program), len(program.desc["blocks"][0]["ops"]),
+            # key includes the op count across ALL blocks (append_backward
+            # /minimize add ops; control-flow sub-block bodies can grow
+            # too) and the desc version (set_lr rewrites attrs + bumps
+            # it) so program mutations invalidate the compiled runner
+            key = (id(program),
+                   sum(len(blk["ops"]) for blk in program.desc["blocks"]),
                    program.desc.get("version", {}).get("version", 0))
             runner = self._runners.get(key)
             if runner is None:

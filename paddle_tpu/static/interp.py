@@ -117,6 +117,67 @@ def run_block(block_ops: List[Dict[str, Any]], scope: Scope,
             raise NotImplementedError(
                 f"ProgramDesc op {op.type!r} has no TPU translation yet")
         fn(op, scope, feeds, fetch_holder)
+        _fold_consts(op)
+
+
+def _consts() -> Dict[str, Any]:
+    """Desc-level constant map for the active program run.  Under jit
+    EVERYTHING in scope is a tracer (constants included), but ops whose
+    value is defined purely by attrs (fill_constant chains) are
+    statically known; translators that need static values (TensorArray
+    write indices, while trip bounds) consult this instead of the
+    scope."""
+    c = getattr(_BLOCKS_TLS, "consts", None)
+    if c is None:
+        c = _BLOCKS_TLS.consts = {}
+    return c
+
+
+def _fold_consts(op: OpView):
+    """Track outputs of statically-evaluable op chains as numpy values;
+    any op outside the folding set invalidates its outputs."""
+    from .proto import vartype_to_np_dtype
+
+    c = _consts()
+    t = op.type
+    try:
+        if t == "fill_constant":
+            shape = [int(s) for s in op.attr("shape", [])]
+            dt = vartype_to_np_dtype(op.attr("dtype", 5))
+            c[op.output("Out")] = np.full(shape, op.attr("value", 0.0),
+                                          dt)
+            return
+        if t == "assign_value":
+            for key in ("fp32_values", "int32_values", "int64_values",
+                        "bool_values"):
+                vals = op.attr(key)
+                if vals:
+                    shape = [int(s) for s in op.attr("shape", [])]
+                    c[op.output("Out")] = np.asarray(vals).reshape(shape)
+                    return
+        if t == "cast" and op.input("X") in c:
+            c[op.output("Out")] = c[op.input("X")].astype(
+                vartype_to_np_dtype(op.attr("out_dtype", 5)))
+            return
+        if t == "scale" and op.input("X") in c:
+            x = c[op.input("X")]
+            s, b = op.attr("scale", 1.0), op.attr("bias", 0.0)
+            c[op.output("Out")] = (x * s + b) \
+                if op.attr("bias_after_scale", True) else (x + b) * s
+            return
+        if t == "increment" and op.input("X") in c:
+            x = c[op.input("X")]
+            c[op.output("Out")] = x + np.asarray(
+                op.attr("step", 1.0)).astype(x.dtype)
+            return
+        if t == "assign" and op.input("X") in c:
+            c[op.output("Out")] = c[op.input("X")]
+            return
+    except Exception:
+        pass
+    for args in op._out.values():
+        for a in args:
+            c.pop(a, None)
 
 
 GRAD_SUFFIX = "@GRAD"
@@ -206,10 +267,13 @@ class ProgramRunner:
         self.fetch_names = program.fetch_target_names()
         ops = program.desc["blocks"][0]["ops"]
 
+        blocks = program.desc["blocks"]
+
         def pure(params, feeds):
             s = Scope(params)
             fetches: Dict[int, Any] = {}
-            run_block(ops, s, feeds, fetches)
+            with blocks_context(blocks):
+                run_block(ops, s, feeds, fetches)
             # also return the full scope (as a plain dict pytree) so the
             # Executor can satisfy fetch_list entries that aren't
             # fetch-op targets
@@ -1292,3 +1356,588 @@ def _multiclass_nms_op(op, scope, feeds, fetches):
     scope[op.output("Out")] = out
     if op.output("NmsRoisNum"):
         scope[op.output("NmsRoisNum")] = counts
+
+
+# ---------------------------------------------------------------------------
+# Control flow: while / conditional_block / TensorArray family / recurrent /
+# lstm / gru / beam search.
+#
+# Reference: `operators/controlflow/while_op.cc:59` (step-scope loop),
+# `conditional_block_op.cc:29`, `tensor_array_read_write_op.cc`,
+# `tensor_array_to_tensor_op.cc`, `recurrent_op.cc`, `lstm_op.cc`,
+# `gru_op.cc`, `beam_search_op.cc`, `beam_search_decode_op.cc:123`.
+#
+# TPU-native redesign: the reference executes these with dynamic scopes and
+# growing LoDTensorArrays; under XLA everything must be static-shaped, so
+#  * `while`   -> `lax.while_loop` whose carry is the set of outer vars the
+#    body writes (the step-scope/parent-scope write-back collapsed);
+#  * TensorArray -> a fixed-capacity [cap, ...] buffer + dynamic length
+#    (the LoD padded+lengths stance applied to arrays).  Outside a while,
+#    writes at trace-time-constant indices grow the buffer; inside, the
+#    while translator pre-creates buffers with capacity inferred from the
+#    loop bound (the `less_than(i, max_len)` feeding Condition), or
+#    FLAGS_interp_tensor_array_capacity as a fallback;
+#  * `recurrent`/`lstm`/`gru` -> `lax.scan` over the time axis;
+#  * beam search -> fixed beam width K, finished-beam masking, with parent
+#    pointers carried in an explicit "ParentIdx" TensorArray instead of
+#    LoD levels (`beam_search_decode` backtraces it with a reverse scan).
+# ---------------------------------------------------------------------------
+import contextlib as _contextlib
+import threading as _threading
+
+_BLOCKS_TLS = _threading.local()
+
+
+@_contextlib.contextmanager
+def blocks_context(blocks):
+    prev = getattr(_BLOCKS_TLS, "blocks", None)
+    prev_c = getattr(_BLOCKS_TLS, "consts", None)
+    _BLOCKS_TLS.blocks = blocks
+    _BLOCKS_TLS.consts = {}
+    try:
+        yield
+    finally:
+        _BLOCKS_TLS.blocks = prev
+        _BLOCKS_TLS.consts = prev_c
+
+
+def _current_blocks():
+    blocks = getattr(_BLOCKS_TLS, "blocks", None)
+    if blocks is None:
+        raise RuntimeError(
+            "control-flow op interpreted outside a program context; run "
+            "through ProgramRunner / static.Executor / the Predictor")
+    return blocks
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorArrayVal:
+    """Static-capacity stand-in for the reference LoDTensorArray: a
+    [capacity, *elem] buffer plus a dynamic int32 length."""
+
+    def __init__(self, buffer, length):
+        self.buffer = buffer
+        self.length = length
+
+    def tree_flatten(self):
+        return (self.buffer, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"TensorArrayVal({self.buffer.shape}, len={self.length})"
+
+
+def _is_concrete(x):
+    return not isinstance(x, jax.core.Tracer)
+
+
+_TA_CREATE_CAP_TLS = _threading.local()
+
+
+@register("write_to_array")
+def _write_to_array(op, scope, feeds, fetches):
+    x = jnp.asarray(scope.fetch(op.input("X")))
+    i = jnp.asarray(scope.fetch(op.input("I"))).reshape(()).astype(jnp.int32)
+    # desc-level constant index (fill_constant chains): lets top-level
+    # writes size/grow the buffer statically even though every scope
+    # value is a tracer under jit
+    i_const = _consts().get(op.input("I"))
+    if i_const is not None:
+        i_const = int(np.asarray(i_const).reshape(-1)[0])
+    name = op.output("Out")
+    arr = scope.get(name)
+    if not isinstance(arr, TensorArrayVal):
+        if i_const is not None:
+            cap = i_const + 1
+        else:
+            cap = getattr(_TA_CREATE_CAP_TLS, "cap", None)
+            if cap is None:
+                raise NotImplementedError(
+                    f"write_to_array into {name!r} at a dynamic index but "
+                    "the array was not pre-created; writes inside `while` "
+                    "require the loop bound to be inferable (a "
+                    "less_than/less_equal feeding Condition with a "
+                    "statically-known bound) or "
+                    "FLAGS_interp_tensor_array_capacity set")
+        arr = TensorArrayVal(jnp.zeros((cap,) + x.shape, x.dtype),
+                             jnp.zeros((), jnp.int32))
+    cap = arr.buffer.shape[0]
+    if x.shape != arr.buffer.shape[1:]:
+        raise ValueError(
+            f"write_to_array {name!r}: element shape {x.shape} != array "
+            f"element shape {arr.buffer.shape[1:]} (static-shape arrays "
+            "require uniform elements)")
+    if i_const is not None and i_const >= cap:
+        grow = i_const + 1 - cap
+        arr = TensorArrayVal(
+            jnp.concatenate(
+                [arr.buffer, jnp.zeros((grow,) + x.shape, x.dtype)]),
+            arr.length)
+    buf = jax.lax.dynamic_update_index_in_dim(
+        arr.buffer, x.astype(arr.buffer.dtype), i, 0)
+    scope[name] = TensorArrayVal(buf, jnp.maximum(arr.length, i + 1))
+
+
+@register("read_from_array")
+def _read_from_array(op, scope, feeds, fetches):
+    arr = scope.fetch(op.input("X"))
+    if not isinstance(arr, TensorArrayVal):
+        raise TypeError(f"read_from_array: {op.input('X')!r} is not a "
+                        "TensorArray")
+    i = jnp.asarray(scope.fetch(op.input("I"))).reshape(()).astype(jnp.int32)
+    scope[op.output("Out")] = jax.lax.dynamic_index_in_dim(
+        arr.buffer, i, 0, keepdims=False)
+
+
+@register("lod_array_length")
+def _lod_array_length(op, scope, feeds, fetches):
+    arr = scope.fetch(op.input("X"))
+    scope[op.output("Out")] = arr.length.reshape(1).astype(jnp.int64)
+
+
+@register("tensor_array_to_tensor")
+def _tensor_array_to_tensor(op, scope, feeds, fetches):
+    """Stack/concat the array.  With a trace-time-constant length the
+    exact [length, ...] prefix is emitted; a dynamic length (array built
+    in a `while`) emits the full capacity-padded buffer (padded+lengths
+    stance) with OutIndex carrying the true length."""
+    arr = scope.fetch(op.input("X"))
+    axis = op.attr("axis", 0)
+    use_stack = op.attr("use_stack", False)
+    buf, n = arr.buffer, arr.length
+    if _is_concrete(n):
+        buf = buf[: int(n)]
+    elems = buf.shape[0]
+    if use_stack:
+        out = jnp.moveaxis(buf, 0, axis) if axis else buf
+    elif elems:
+        out = jnp.concatenate([buf[i] for i in range(elems)], axis=axis)
+    else:
+        shape = list(buf.shape[1:])
+        shape[axis if axis >= 0 else axis + len(shape)] = 0
+        out = jnp.zeros(shape, buf.dtype)
+    scope[op.output("Out")] = out
+    if op.output("OutIndex"):
+        scope[op.output("OutIndex")] = n.reshape(1).astype(jnp.int32)
+
+
+@register("increment")
+def _increment(op, scope, feeds, fetches):
+    x = scope.fetch(op.input("X"))
+    scope[op.output("Out")] = x + jnp.asarray(
+        op.attr("step", 1.0)).astype(x.dtype)
+
+
+@register("is_empty")
+def _is_empty(op, scope, feeds, fetches):
+    x = scope.fetch(op.input("X"))
+    scope[op.output("Out")] = jnp.asarray([x.size == 0])
+
+
+@register("select_input")
+def _select_input(op, scope, feeds, fetches):
+    """reference `operators/select_input_op.cc`: Out = X[Mask]."""
+    mask = jnp.asarray(scope.fetch(op.input("Mask"))).reshape(
+        ()).astype(jnp.int32)
+    xs = [scope.fetch(n) for n in op.inputs("X")]
+    scope[op.output("Out")] = jax.lax.switch(
+        jnp.clip(mask, 0, len(xs) - 1),
+        [lambda x=x: x for x in xs])
+
+
+@register("select_output")
+def _select_output(op, scope, feeds, fetches):
+    """reference `operators/select_output_op.cc` routes X to Out[Mask];
+    in the traced world every branch executes, so X is written to every
+    listed output — only the branch later chosen by `select_input`
+    reaches the program outputs."""
+    x = scope.fetch(op.input("X"))
+    for n in op._out.get("Out", []):
+        scope[n] = x
+
+
+def _sub_block_ops(op):
+    return _current_blocks()[op.attr("sub_block", 0)]["ops"]
+
+
+def _block_written_names(ops):
+    out: List[str] = []
+    seen = set()
+    for raw in ops:
+        for slot in raw.get("outputs", []):
+            for a in slot.get("arguments", []):
+                if a not in seen:
+                    seen.add(a)
+                    out.append(a)
+    return out
+
+
+@register("conditional_block", "conditional_block_infer")
+def _conditional_block(op, scope, feeds, fetches):
+    """reference `operators/controlflow/conditional_block_op.cc:29`.
+    Out vars that don't pre-exist get zeros on the false path (the fluid
+    `cond` layer pairs two conditional_blocks and reconciles with
+    select_input, so only the taken branch's values survive)."""
+    sub = _sub_block_ops(op)
+    out_names = [n for n in op._out.get("Out", [])]
+
+    def _run_sub():
+        local = Scope(scope)
+        run_block(sub, local, feeds, {})
+        return tuple(jnp.asarray(local.fetch(n)) for n in out_names)
+
+    if not op.attr("is_scalar_condition", False):
+        # reference: the non-scalar mode gates on ALL Input tensors being
+        # non-empty (`conditional_block_op.cc` need_run = numel != 0) —
+        # numel is static under XLA, so this resolves at trace time
+        need_run = all(
+            jnp.asarray(scope.fetch(n)).size != 0
+            for n in op.inputs("Input"))
+        if need_run:
+            for n, v in zip(out_names, _run_sub()):
+                scope[n] = v
+        return
+
+    pred = jnp.asarray(scope.fetch(op.input("Cond"))).reshape(())
+    missing = [n for n in out_names if n not in scope]
+    shapes = jax.eval_shape(_run_sub) if missing else None
+
+    def _true():
+        return _run_sub()
+
+    def _false():
+        return tuple(
+            jnp.asarray(scope[n]) if n in scope
+            else jnp.zeros(s.shape, s.dtype)
+            for n, s in zip(out_names,
+                            shapes or [None] * len(out_names)))
+
+    outs = jax.lax.cond(pred.astype(bool), _true, _false)
+    for n, v in zip(out_names, outs):
+        scope[n] = v
+
+
+def _infer_trip_bound(op, scope, sub_ops):
+    """Upper bound on while trip count, for TensorArray capacity: find a
+    less_than/less_equal writing the Condition var and read its RHS from
+    the desc-level constant map; else
+    FLAGS_interp_tensor_array_capacity."""
+    cond_name = op.input("Condition")
+    for raw in sub_ops:
+        v = OpView(raw)
+        if v.type in ("less_than", "less_equal") and \
+                v.output("Out") == cond_name:
+            y = _consts().get(v.input("Y"))
+            if y is not None:
+                bound = int(np.asarray(y).reshape(-1)[0])
+                return bound + (1 if v.type == "less_equal" else 0)
+    from ..core import flags as _flags
+
+    try:
+        cap = int(_flags.flag("interp_tensor_array_capacity"))
+    except Exception:
+        cap = 0
+    return cap if cap > 0 else None
+
+
+@register("while")
+def _while(op, scope, feeds, fetches):
+    """reference `operators/controlflow/while_op.cc:59`.  The carry is
+    every outer-scope var the body writes (the reference's step-scope
+    write-back); iteration-local temporaries are recomputed per step.
+    Loop-variant shapes are unsupported (XLA static shapes)."""
+    sub = _sub_block_ops(op)
+    cond_name = op.input("Condition")
+    written = _block_written_names(sub)
+    # anything the body writes is loop-dependent: drop stale desc-level
+    # constants (e.g. the counter's fill_constant value)
+    consts = _consts()
+    for n in written:
+        consts.pop(n, None)
+
+    # pre-create TensorArrays the body writes (they must be loop carries
+    # with static capacity before the loop starts)
+    ta_targets = [OpView(r).output("Out") for r in sub
+                  if r["type"] == "write_to_array"]
+    missing_tas = [n for n in ta_targets
+                   if not isinstance(scope.get(n), TensorArrayVal)]
+    if missing_tas:
+        bound = _infer_trip_bound(op, scope, sub)
+        if bound is None:
+            raise NotImplementedError(
+                f"while: cannot infer a trip bound for TensorArray(s) "
+                f"{missing_tas}; make the loop condition a "
+                "less_than(i, bound) with a constant bound, or set "
+                "FLAGS_interp_tensor_array_capacity")
+
+        def _abstract_body():
+            local = Scope(scope)
+            prev_cap = getattr(_TA_CREATE_CAP_TLS, "cap", None)
+            _TA_CREATE_CAP_TLS.cap = bound
+            try:
+                run_block(sub, local, feeds, {})
+            finally:
+                # restore (not clear): a nested while's abstract pass must
+                # not clobber the enclosing pass's capacity
+                _TA_CREATE_CAP_TLS.cap = prev_cap
+            return {n: local[n] for n in missing_tas}
+
+        shapes = jax.eval_shape(_abstract_body)
+        for n, s in shapes.items():
+            scope[n] = TensorArrayVal(
+                jnp.zeros(s.buffer.shape, s.buffer.dtype),
+                jnp.zeros((), jnp.int32))
+
+    carry_names = [n for n in written if n in scope]
+    if cond_name not in carry_names:
+        raise ValueError(
+            f"while: body never updates Condition var {cond_name!r} "
+            "(infinite loop in the source program?)")
+    # while's declared Out vars must be loop carries — a body-written Out
+    # with no pre-loop value can't be given a static carry shape, and
+    # silently dropping it would surface as a confusing missing-var error
+    # at some later fetch
+    dropped = [n for n in op._out.get("Out", [])
+               if n not in carry_names and n != op.output("StepScopes")]
+    if dropped:
+        raise ValueError(
+            f"while: Out var(s) {dropped} are written by the body but "
+            "have no value before the loop; initialize them (e.g. "
+            "fill_constant) so they can join the loop carry")
+    cond_idx = carry_names.index(cond_name)
+
+    def _cond(carry):
+        return jnp.asarray(carry[cond_idx]).reshape(()).astype(bool)
+
+    def _body(carry):
+        local = Scope(scope)
+        local.update(zip(carry_names, carry))
+        run_block(sub, local, feeds, {})
+        return tuple(local[n] for n in carry_names)
+
+    init = tuple(scope[n] for n in carry_names)
+    final = jax.lax.while_loop(_cond, _body, init)
+    for n, v in zip(carry_names, final):
+        scope[n] = v
+
+
+@register("recurrent")
+def _recurrent(op, scope, feeds, fetches):
+    """reference `operators/recurrent_op.cc` (StaticRNN): time-major
+    inputs sliced per step, ex_states <- previous states, outputs stacked
+    by name — `lax.scan` over dim 0."""
+    sub = _sub_block_ops(op)
+    in_names = op.inputs("inputs")
+    init_names = op.inputs("initial_states")
+    out_names = op._out.get("outputs", [])
+    ex_names = op.attr("ex_states", []) or []
+    st_names = op.attr("states", []) or []
+    reverse = bool(op.attr("reverse", False))
+    has_states = bool(op.attr("has_states", bool(st_names)))
+
+    xs = tuple(jnp.asarray(scope.fetch(n)) for n in in_names)
+    init = tuple(jnp.asarray(scope.fetch(n)) for n in init_names)
+
+    def step(carry, xt):
+        local = Scope(scope)
+        local.update(zip(in_names, xt))
+        if has_states:
+            local.update(zip(ex_names, carry))
+        run_block(sub, local, feeds, {})
+        new_carry = tuple(local.fetch(n) for n in st_names) \
+            if has_states else carry
+        return new_carry, tuple(local.fetch(n) for n in out_names)
+
+    _, ys = jax.lax.scan(step, init, xs, reverse=reverse)
+    for n, y in zip(out_names, ys):
+        scope[n] = y
+
+
+def _rnn_act(name):
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": lambda x: jnp.maximum(x, 0),
+            "identity": lambda x: x}[name or "sigmoid"]
+
+
+@register("lstm")
+def _lstm_op(op, scope, feeds, fetches):
+    """reference `operators/lstm_op.cc`: Input is the pre-projected
+    x·W_x [*, 4D] sequence; Weight = {W_ch, W_ih, W_fh, W_oh} [D, 4D]
+    (gate order c, i, f, o), Bias [1, 4D] (+{W_ic, W_fc, W_oc} when
+    use_peepholes).  LoD redesign: Input is padded [B, T, 4D] (or a
+    single [T, 4D] sequence); BatchGate/BatchCellPreAct (batch-reordered
+    internals) are not materialized."""
+    x = jnp.asarray(scope.fetch(op.input("Input")))
+    w = jnp.asarray(scope.fetch(op.input("Weight")))
+    d = w.shape[0]
+    single = x.ndim == 2
+    if single:
+        x = x[None]
+    b, t = x.shape[0], x.shape[1]
+    gates_b = jnp.zeros((4 * d,), x.dtype)
+    peep = op.attr("use_peepholes", True) and op.input("Bias")
+    w_ic = w_fc = w_oc = None
+    if op.input("Bias"):
+        bias = jnp.asarray(scope.fetch(op.input("Bias"))).reshape(-1)
+        gates_b = bias[: 4 * d]
+        if peep and bias.size >= 7 * d:
+            w_ic = bias[4 * d:5 * d]
+            w_fc = bias[5 * d:6 * d]
+            w_oc = bias[6 * d:7 * d]
+    h0 = jnp.asarray(scope.fetch(op.input("H0"))) if op.input("H0") \
+        else jnp.zeros((b, d), x.dtype)
+    c0 = jnp.asarray(scope.fetch(op.input("C0"))) if op.input("C0") \
+        else jnp.zeros((b, d), x.dtype)
+    actg = _rnn_act(op.attr("gate_activation", "sigmoid"))
+    actc = _rnn_act(op.attr("cell_activation", "tanh"))
+    actn = _rnn_act(op.attr("candidate_activation", "tanh"))
+
+    def step(carry, xt):
+        h, c = carry
+        g = xt + h @ w + gates_b
+        gc, gi, gf, go = jnp.split(g, 4, axis=-1)
+        if w_ic is not None:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = actg(gi)
+        f = actg(gf)
+        cand = actc(gc)
+        c_new = f * c + i * cand
+        if w_oc is not None:
+            go = go + c_new * w_oc
+        o = actg(go)
+        h_new = o * actn(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    reverse = bool(op.attr("is_reverse", False))
+    _, (hs, cs) = jax.lax.scan(step, (h0, c0),
+                               jnp.moveaxis(x, 1, 0), reverse=reverse)
+    hidden = jnp.moveaxis(hs, 0, 1)
+    cell = jnp.moveaxis(cs, 0, 1)
+    if single:
+        hidden, cell = hidden[0], cell[0]
+    scope[op.output("Hidden")] = hidden
+    if op.output("Cell"):
+        scope[op.output("Cell")] = cell
+
+
+@register("gru")
+def _gru_op(op, scope, feeds, fetches):
+    """reference `operators/gru_op.cc`: Input = pre-projected [*, 3D]
+    (xu, xr, xc), Weight [D, 3D] = {W_u|W_r [D,2D], W_c [D,D]}.
+    h_t = (1-u)h_{t-1} + u*h~ (origin_mode flips the blend)."""
+    x = jnp.asarray(scope.fetch(op.input("Input")))
+    w = jnp.asarray(scope.fetch(op.input("Weight")))
+    d = w.shape[0]
+    single = x.ndim == 2
+    if single:
+        x = x[None]
+    b = x.shape[0]
+    w_ur = w[:, : 2 * d]
+    w_c = w[:, 2 * d:]
+    bias = jnp.zeros((3 * d,), x.dtype)
+    if op.input("Bias"):
+        bias = jnp.asarray(scope.fetch(op.input("Bias"))).reshape(-1)
+    h0 = jnp.asarray(scope.fetch(op.input("H0"))) if op.input("H0") \
+        else jnp.zeros((b, d), x.dtype)
+    actg = _rnn_act(op.attr("gate_activation", "sigmoid"))
+    actn = _rnn_act(op.attr("activation", "tanh"))
+    origin = bool(op.attr("origin_mode", False))
+
+    def step(h, xt):
+        xur = xt[:, : 2 * d] + h @ w_ur + bias[: 2 * d]
+        u = actg(xur[:, :d])
+        r = actg(xur[:, d:])
+        cand = actn(xt[:, 2 * d:] + (r * h) @ w_c + bias[2 * d:])
+        h_new = u * h + (1 - u) * cand if origin \
+            else (1 - u) * h + u * cand
+        return h_new, h_new
+
+    reverse = bool(op.attr("is_reverse", False))
+    _, hs = jax.lax.scan(step, h0, jnp.moveaxis(x, 1, 0), reverse=reverse)
+    hidden = jnp.moveaxis(hs, 0, 1)
+    if single:
+        hidden = hidden[0]
+    scope[op.output("Hidden")] = hidden
+
+
+@register("beam_search")
+def _beam_search(op, scope, feeds, fetches):
+    """reference `operators/beam_search_op.cc`, static-shape redesign:
+    fixed beam width K per source (no LoD shrinking); finished beams
+    (pre_id == end_id) compete with their frozen score on the end_id
+    column only.  parent_idx is the global [B*K] source-beam index."""
+    k = int(op.attr("beam_size", 4))
+    end_id = int(op.attr("end_id", 1))
+    is_acc = bool(op.attr("is_accumulated", True))
+    pre_ids = jnp.asarray(scope.fetch(op.input("pre_ids"))).reshape(-1)
+    pre_scores = jnp.asarray(
+        scope.fetch(op.input("pre_scores"))).reshape(-1)
+    scores = jnp.asarray(scope.fetch(op.input("scores")))
+    bk, v = scores.shape
+    bsz = bk // k
+    acc = scores.astype(jnp.float32) if is_acc else \
+        pre_scores[:, None] + jnp.log(
+            jnp.clip(scores.astype(jnp.float32), 1e-20, None))
+    finished = pre_ids == end_id
+    neg = jnp.full_like(acc, -1e30)
+    acc = jnp.where(finished[:, None], neg, acc)
+    acc = acc.at[:, end_id].set(
+        jnp.where(finished, pre_scores, acc[:, end_id]))
+    top_s, top_i = jax.lax.top_k(acc.reshape(bsz, k * v), k)
+    parent_local = top_i // v
+    token = (top_i % v).astype(pre_ids.dtype)
+    parent = (jnp.arange(bsz, dtype=jnp.int32)[:, None] * k +
+              parent_local.astype(jnp.int32)).reshape(bk)
+    scope[op.output("selected_ids")] = token.reshape(bk, 1)
+    scope[op.output("selected_scores")] = top_s.reshape(bk, 1)
+    if op.output("parent_idx"):
+        scope[op.output("parent_idx")] = parent
+
+
+@register("beam_search_decode")
+def _beam_search_decode(op, scope, feeds, fetches):
+    """reference `operators/beam_search_decode_op.cc:123`.  The reference
+    backtracks parent pointers encoded in the Ids array's LoD levels; the
+    static redesign carries them in an explicit ParentIdx TensorArray
+    (written per step by the search loop).  SentenceIds is [B, K, T_cap]
+    end_id-padded; SentenceScores [B, K] is each surviving beam's final
+    accumulated score."""
+    end_id = int(op.attr("end_id", 1))
+    k = int(op.attr("beam_size", 4))
+    ids_ta = scope.fetch(op.input("Ids"))
+    scores_ta = scope.fetch(op.input("Scores"))
+    if not op.input("ParentIdx"):
+        raise NotImplementedError(
+            "beam_search_decode requires the ParentIdx TensorArray input "
+            "in the static-shape redesign (LoD parent chains are not "
+            "representable); wire the beam_search op's parent_idx output "
+            "through a write_to_array")
+    par_ta = scope.fetch(op.input("ParentIdx"))
+    t_cap = ids_ta.buffer.shape[0]
+    bk = int(np.prod(ids_ta.buffer.shape[1:]))
+    bsz = bk // k
+    ids = ids_ta.buffer.reshape(t_cap, bk)
+    par = par_ta.buffer.reshape(t_cap, bk).astype(jnp.int32)
+    length = ids_ta.length
+
+    def back(beam, xs):
+        t_ids, t_par, t = xs
+        valid = t < length
+        tok = jnp.where(valid, t_ids[beam], end_id)
+        nxt = jnp.where(valid, t_par[beam], beam)
+        return nxt, tok
+
+    init = jnp.arange(bk, dtype=jnp.int32)
+    _, toks = jax.lax.scan(
+        back, init, (ids, par, jnp.arange(t_cap)), reverse=True)
+    sent = jnp.moveaxis(toks, 0, 1).reshape(bsz, k, t_cap)
+    last = jnp.clip(length - 1, 0, t_cap - 1)
+    final_scores = jax.lax.dynamic_index_in_dim(
+        scores_ta.buffer.reshape(t_cap, bk), last, 0,
+        keepdims=False).reshape(bsz, k)
+    scope[op.output("SentenceIds")] = sent
+    scope[op.output("SentenceScores")] = final_scores

@@ -126,9 +126,21 @@ def _attr_desc(name: str, value) -> Dict[str, Any]:
         else:
             d["type"] = AttrType.INTS
             d["ints"] = [int(v) for v in value]
+    elif isinstance(value, (BlockRef, Block)):
+        d["type"] = AttrType.BLOCK
+        d["block_idx"] = int(value.idx)
     else:
         raise TypeError(f"unsupported attr value {value!r}")
     return d
+
+
+class BlockRef:
+    """Marker for a BLOCK-typed op attribute (sub_block of
+    while/conditional_block/recurrent): `attrs={"sub_block":
+    BlockRef(idx)}`."""
+
+    def __init__(self, idx: int):
+        self.idx = int(idx)
 
 
 class Block:
@@ -220,6 +232,14 @@ class Program:
 
     def block(self, idx) -> Block:
         return Block(self, self.desc["blocks"][idx])
+
+    def create_block(self, parent_idx: int = 0) -> Block:
+        """Append a sub-block (while/conditional_block/recurrent bodies;
+        reference `BlockDesc` with parent_idx)."""
+        d = {"idx": len(self.desc["blocks"]), "parent_idx": int(parent_idx),
+             "vars": [], "ops": []}
+        self.desc["blocks"].append(d)
+        return Block(self, d)
 
     def num_blocks(self):
         return len(self.desc["blocks"])

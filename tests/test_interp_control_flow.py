@@ -1,0 +1,601 @@
+"""Control-flow translators in the ProgramDesc interpreter
+(static/interp.py): while / conditional_block / TensorArray family /
+recurrent / lstm / gru / beam search — reference
+`operators/controlflow/while_op.cc:59`, `conditional_block_op.cc:29`,
+`beam_search_decode_op.cc:123`.
+
+Programs are built through static/program.py (reference op schemas),
+run via ProgramRunner, and checked against numpy re-implementations.
+The final test serializes a seq2seq-with-beam-search program through
+the framework.proto codec, reloads it through the inference Predictor,
+and matches a pure-numpy beam search."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401 - framework init
+from paddle_tpu.static import Program, proto
+from paddle_tpu.static.program import BlockRef
+from paddle_tpu.static.interp import ProgramRunner
+
+
+def _feed_fetch_vars(b):
+    b.create_var("feed", type=proto.VarType.FEED_MINIBATCH, persistable=True)
+    b.create_var("fetch", type=proto.VarType.FETCH_LIST, persistable=True)
+
+
+def _run(prog, feeds_list, params=None, n_fetch=1):
+    runner = ProgramRunner(prog, params or {})
+    outs = runner(*feeds_list)
+    return [np.asarray(o) for o in outs]
+
+
+class TestTensorArrayOps:
+    def test_write_read_length_stack(self):
+        prog = Program()
+        b = prog.global_block()
+        _feed_fetch_vars(b)
+        b.create_var("x", [2, 3], "float32", need_check_feed=True)
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        for i in range(3):
+            b.create_var(f"i{i}", [1], "int64")
+            b.append_op("fill_constant", {}, {"Out": f"i{i}"},
+                        {"shape": [1], "dtype": 3, "value": float(i)})
+            b.create_var(f"xi{i}", [2, 3], "float32")
+            b.append_op("scale", {"X": "x"}, {"Out": f"xi{i}"},
+                        {"scale": float(i + 1), "bias": 0.0,
+                         "bias_after_scale": True})
+            b.append_op("write_to_array", {"X": f"xi{i}", "I": f"i{i}"},
+                        {"Out": "arr"}, {})
+        b.create_var("arr", type=proto.VarType.LOD_TENSOR_ARRAY)
+        b.create_var("n", [1], "int64")
+        b.append_op("lod_array_length", {"X": "arr"}, {"Out": "n"}, {})
+        b.create_var("back", [2, 3], "float32")
+        b.append_op("read_from_array", {"X": "arr", "I": "i1"},
+                    {"Out": "back"}, {})
+        b.create_var("stacked", [3, 2, 3], "float32")
+        b.append_op("tensor_array_to_tensor", {"X": "arr"},
+                    {"Out": "stacked", "OutIndex": "oidx"},
+                    {"axis": 0, "use_stack": True})
+        b.create_var("oidx", [1], "int32")
+        for col, name in enumerate(["n", "back", "stacked"]):
+            b.append_op("fetch", {"X": name}, {"Out": "fetch"}, {"col": col})
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        n, back, stacked = _run(prog, [x])
+        assert int(n[0]) == 3
+        np.testing.assert_allclose(back, 2.0 * x)
+        np.testing.assert_allclose(
+            stacked, np.stack([x, 2 * x, 3 * x]))
+
+
+class TestConditionalBlock:
+    def _cond_program(self):
+        """fluid `cond` pattern: two conditional_blocks + select_input."""
+        prog = Program()
+        b = prog.global_block()
+        _feed_fetch_vars(b)
+        b.create_var("x", [2, 2], "float32", need_check_feed=True)
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.create_var("thr", [1], "float32")
+        b.append_op("fill_constant", {}, {"Out": "thr"},
+                    {"shape": [1], "dtype": 5, "value": 1.0})
+        b.create_var("s", [1], "float32")
+        b.append_op("reduce_sum", {"X": "x"}, {"Out": "s"},
+                    {"reduce_all": True, "keep_dim": False})
+        b.create_var("cond", [1], "bool")
+        b.append_op("greater_than", {"X": "s", "Y": "thr"},
+                    {"Out": "cond"}, {})
+        # true branch: x * 2 ; false branch: x - 1
+        tb = prog.create_block()
+        tb.append_op("scale", {"X": "x"}, {"Out": "t_out"},
+                     {"scale": 2.0, "bias": 0.0, "bias_after_scale": True})
+        fb = prog.create_block()
+        fb.append_op("scale", {"X": "x"}, {"Out": "f_out"},
+                     {"scale": 1.0, "bias": -1.0, "bias_after_scale": True})
+        b.create_var("t_out", [2, 2], "float32")
+        b.create_var("f_out", [2, 2], "float32")
+        b.create_var("not_cond", [1], "bool")
+        b.append_op("logical_not", {"X": "cond"}, {"Out": "not_cond"}, {})
+        b.append_op("conditional_block", {"Cond": "cond", "Input": ["x"]},
+                    {"Out": ["t_out"], "Scope": "cb0_scope"},
+                    {"sub_block": BlockRef(tb.idx),
+                     "is_scalar_condition": True})
+        b.append_op("conditional_block",
+                    {"Cond": "not_cond", "Input": ["x"]},
+                    {"Out": ["f_out"], "Scope": "cb1_scope"},
+                    {"sub_block": BlockRef(fb.idx),
+                     "is_scalar_condition": True})
+        b.create_var("mask", [1], "int32")
+        b.append_op("cast", {"X": "not_cond"}, {"Out": "mask"},
+                    {"in_dtype": 0, "out_dtype": 2})
+        b.create_var("out", [2, 2], "float32")
+        b.append_op("select_input", {"X": ["t_out", "f_out"],
+                                     "Mask": "mask"}, {"Out": "out"}, {})
+        b.append_op("fetch", {"X": "out"}, {"Out": "fetch"}, {"col": 0})
+        return prog
+
+    def test_true_and_false_paths(self):
+        prog = self._cond_program()
+        x_hot = np.ones((2, 2), np.float32)        # sum 4 > 1 -> x * 2
+        (out,) = _run(prog, [x_hot])
+        np.testing.assert_allclose(out, x_hot * 2)
+        x_cold = np.full((2, 2), -1.0, np.float32)  # sum -4 <= 1 -> x - 1
+        (out,) = _run(prog, [x_cold])
+        np.testing.assert_allclose(out, x_cold - 1)
+
+    def test_roundtrips_through_serialization(self):
+        prog = self._cond_program()
+        data = prog.serialize_to_string()
+        prog2 = Program.parse_from_string(data)
+        x = np.ones((2, 2), np.float32)
+        (out,) = _run(prog2, [x])
+        np.testing.assert_allclose(out, x * 2)
+
+
+class TestWhile:
+    def test_counter_accumulator(self):
+        """while i < 5: acc += x; i += 1 — the fluid While layer shape."""
+        prog = Program()
+        b = prog.global_block()
+        _feed_fetch_vars(b)
+        b.create_var("x", [3], "float32", need_check_feed=True)
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.create_var("i", [1], "int64")
+        b.append_op("fill_constant", {}, {"Out": "i"},
+                    {"shape": [1], "dtype": 3, "value": 0.0})
+        b.create_var("limit", [1], "int64")
+        b.append_op("fill_constant", {}, {"Out": "limit"},
+                    {"shape": [1], "dtype": 3, "value": 5.0})
+        b.create_var("acc", [3], "float32")
+        b.append_op("fill_constant", {}, {"Out": "acc"},
+                    {"shape": [3], "dtype": 5, "value": 0.0})
+        b.create_var("cond", [1], "bool")
+        b.append_op("less_than", {"X": "i", "Y": "limit"},
+                    {"Out": "cond"}, {})
+        body = prog.create_block()
+        body.append_op("elementwise_add", {"X": "acc", "Y": "x"},
+                       {"Out": "acc"}, {})
+        body.append_op("increment", {"X": "i"}, {"Out": "i"},
+                       {"step": 1.0})
+        body.append_op("less_than", {"X": "i", "Y": "limit"},
+                       {"Out": "cond"}, {})
+        b.append_op("while", {"X": ["acc", "i"], "Condition": "cond"},
+                    {"Out": ["acc", "i"], "StepScopes": "ws"},
+                    {"sub_block": BlockRef(body.idx)})
+        b.append_op("fetch", {"X": "acc"}, {"Out": "fetch"}, {"col": 0})
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        (acc,) = _run(prog, [x])
+        np.testing.assert_allclose(acc, 5 * x)
+
+    def test_tensor_array_inside_while(self):
+        """while i < 4: write_to_array(x * (i+1), i) — capacity inferred
+        from the less_than bound."""
+        prog = Program()
+        b = prog.global_block()
+        _feed_fetch_vars(b)
+        b.create_var("x", [2], "float32", need_check_feed=True)
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.create_var("i", [1], "int64")
+        b.append_op("fill_constant", {}, {"Out": "i"},
+                    {"shape": [1], "dtype": 3, "value": 0.0})
+        b.create_var("limit", [1], "int64")
+        b.append_op("fill_constant", {}, {"Out": "limit"},
+                    {"shape": [1], "dtype": 3, "value": 4.0})
+        b.create_var("cond", [1], "bool")
+        b.append_op("less_than", {"X": "i", "Y": "limit"},
+                    {"Out": "cond"}, {})
+        b.create_var("arr", type=proto.VarType.LOD_TENSOR_ARRAY)
+        body = prog.create_block()
+        body.append_op("cast", {"X": "i"}, {"Out": "i_f"},
+                       {"in_dtype": 3, "out_dtype": 5})
+        body.append_op("scale", {"X": "i_f"}, {"Out": "i1"},
+                       {"scale": 1.0, "bias": 1.0,
+                        "bias_after_scale": True})
+        body.append_op("elementwise_mul", {"X": "x", "Y": "i1"},
+                       {"Out": "xi"}, {"axis": -1})
+        body.append_op("write_to_array", {"X": "xi", "I": "i"},
+                       {"Out": "arr"}, {})
+        body.append_op("increment", {"X": "i"}, {"Out": "i"},
+                       {"step": 1.0})
+        body.append_op("less_than", {"X": "i", "Y": "limit"},
+                       {"Out": "cond"}, {})
+        b.append_op("while", {"X": ["i"], "Condition": "cond"},
+                    {"Out": ["arr", "i"], "StepScopes": "ws"},
+                    {"sub_block": BlockRef(body.idx)})
+        b.create_var("stacked", [4, 2], "float32")
+        b.append_op("tensor_array_to_tensor", {"X": "arr"},
+                    {"Out": "stacked", "OutIndex": "oi"},
+                    {"axis": 0, "use_stack": True})
+        b.append_op("fetch", {"X": "stacked"}, {"Out": "fetch"}, {"col": 0})
+        x = np.array([1.0, -2.0], np.float32)
+        (stacked,) = _run(prog, [x])
+        want = np.stack([x * (i + 1) for i in range(4)])
+        np.testing.assert_allclose(stacked, want, rtol=1e-6)
+
+
+class TestRecurrent:
+    def test_static_rnn_accumulator(self):
+        """recurrent: h_t = tanh(x_t + h_{t-1}); outputs stacked
+        (reference recurrent_op.cc StaticRNN semantics)."""
+        prog = Program()
+        b = prog.global_block()
+        _feed_fetch_vars(b)
+        b.create_var("x", [5, 2, 3], "float32", need_check_feed=True)
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.create_var("h0", [2, 3], "float32")
+        b.append_op("fill_constant", {}, {"Out": "h0"},
+                    {"shape": [2, 3], "dtype": 5, "value": 0.0})
+        body = prog.create_block()
+        body.append_op("elementwise_add", {"X": "x", "Y": "h_pre"},
+                       {"Out": "pre"}, {"axis": -1})
+        body.append_op("tanh", {"X": "pre"}, {"Out": "h"}, {})
+        b.create_var("hs", [5, 2, 3], "float32")
+        b.append_op("recurrent",
+                    {"inputs": ["x"], "initial_states": ["h0"],
+                     "parameters": []},
+                    {"outputs": ["h"], "step_scopes": "rss"},
+                    {"sub_block": BlockRef(body.idx),
+                     "ex_states": ["h_pre"], "states": ["h"],
+                     "reverse": False, "has_states": True})
+        b.append_op("fetch", {"X": "h"}, {"Out": "fetch"}, {"col": 0})
+        rng = np.random.RandomState(0)
+        x = rng.randn(5, 2, 3).astype(np.float32)
+        (hs,) = _run(prog, [x])
+        h = np.zeros((2, 3), np.float32)
+        want = []
+        for t in range(5):
+            h = np.tanh(x[t] + h)
+            want.append(h)
+        np.testing.assert_allclose(hs, np.stack(want), rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestLstmGruOps:
+    def _np_lstm(self, x, w, bias, d):
+        """Documented math of operators/lstm_op.cc: gates order c,i,f,o."""
+        b_, t = x.shape[0], x.shape[1]
+        gb = bias[:4 * d]
+        h = np.zeros((b_, d), np.float32)
+        c = np.zeros((b_, d), np.float32)
+        hs, cs = [], []
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        for step in range(t):
+            g = x[:, step] + h @ w + gb
+            gc, gi, gf, go = np.split(g, 4, axis=-1)
+            i = sig(gi)
+            f = sig(gf)
+            cand = np.tanh(gc)
+            c = f * c + i * cand
+            o = sig(go)
+            h = o * np.tanh(c)
+            hs.append(h)
+            cs.append(c)
+        return np.stack(hs, 1), np.stack(cs, 1)
+
+    def test_lstm_matches_numpy(self):
+        d = 4
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 6, 4 * d).astype(np.float32) * 0.5
+        w = rng.randn(d, 4 * d).astype(np.float32) * 0.3
+        bias = rng.randn(4 * d).astype(np.float32) * 0.1
+        prog = Program()
+        b = prog.global_block()
+        _feed_fetch_vars(b)
+        b.create_var("x", [2, 6, 4 * d], "float32", need_check_feed=True)
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.create_var("w", [d, 4 * d], "float32", persistable=True)
+        b.create_var("bias", [1, 4 * d], "float32", persistable=True)
+        b.create_var("hidden", [2, 6, d], "float32")
+        b.create_var("cell", [2, 6, d], "float32")
+        b.append_op("lstm", {"Input": "x", "Weight": "w", "Bias": "bias"},
+                    {"Hidden": "hidden", "Cell": "cell"},
+                    {"use_peepholes": False, "is_reverse": False,
+                     "gate_activation": "sigmoid",
+                     "cell_activation": "tanh",
+                     "candidate_activation": "tanh"})
+        b.append_op("fetch", {"X": "hidden"}, {"Out": "fetch"}, {"col": 0})
+        b.append_op("fetch", {"X": "cell"}, {"Out": "fetch"}, {"col": 1})
+        runner = ProgramRunner(prog, {"w": w, "bias": bias.reshape(1, -1)})
+        hidden, cell = [np.asarray(o) for o in runner(x)]
+        want_h, want_c = self._np_lstm(x, w, bias, d)
+        np.testing.assert_allclose(hidden, want_h, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(cell, want_c, rtol=1e-5, atol=1e-5)
+
+    def test_gru_matches_numpy(self):
+        d = 3
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 5, 3 * d).astype(np.float32) * 0.5
+        w = rng.randn(d, 3 * d).astype(np.float32) * 0.3
+        prog = Program()
+        b = prog.global_block()
+        _feed_fetch_vars(b)
+        b.create_var("x", [2, 5, 3 * d], "float32", need_check_feed=True)
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.create_var("w", [d, 3 * d], "float32", persistable=True)
+        b.create_var("hidden", [2, 5, d], "float32")
+        b.append_op("gru", {"Input": "x", "Weight": "w"},
+                    {"Hidden": "hidden"},
+                    {"activation": "tanh", "gate_activation": "sigmoid",
+                     "is_reverse": False, "origin_mode": False})
+        b.append_op("fetch", {"X": "hidden"}, {"Out": "fetch"}, {"col": 0})
+        runner = ProgramRunner(prog, {"w": w})
+        (hidden,) = [np.asarray(o) for o in runner(x)]
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        h = np.zeros((2, d), np.float32)
+        want = []
+        for t in range(5):
+            xur = x[:, t, :2 * d] + h @ w[:, :2 * d]
+            u = sig(xur[:, :d])
+            r = sig(xur[:, d:])
+            cand = np.tanh(x[:, t, 2 * d:] + (r * h) @ w[:, 2 * d:])
+            h = (1 - u) * h + u * cand
+            want.append(h)
+        np.testing.assert_allclose(hidden, np.stack(want, 1), rtol=1e-5,
+                                   atol=1e-5)
+
+
+class TestBeamSearchOp:
+    def test_single_step(self):
+        """K=2, V=4, one batch: finished beam frozen on end_id."""
+        prog = Program()
+        b = prog.global_block()
+        _feed_fetch_vars(b)
+        for name, shape, col in (("pre_ids", [2, 1], 0),
+                                 ("pre_scores", [2, 1], 1),
+                                 ("scores", [2, 4], 2)):
+            b.create_var(name, shape, "float32", need_check_feed=True)
+            b.append_op("feed", {"X": "feed"}, {"Out": name}, {"col": col})
+        b.create_var("sel_ids", [2, 1], "int64")
+        b.create_var("sel_scores", [2, 1], "float32")
+        b.create_var("parent", [2], "int32")
+        b.append_op("beam_search",
+                    {"pre_ids": "pre_ids", "pre_scores": "pre_scores",
+                     "scores": "scores"},
+                    {"selected_ids": "sel_ids",
+                     "selected_scores": "sel_scores",
+                     "parent_idx": "parent"},
+                    {"beam_size": 2, "end_id": 0, "level": 0,
+                     "is_accumulated": True})
+        for col, name in enumerate(["sel_ids", "sel_scores", "parent"]):
+            b.append_op("fetch", {"X": name}, {"Out": "fetch"}, {"col": col})
+        pre_ids = np.array([[3], [2]], np.int64)
+        pre_scores = np.array([[-0.5], [-1.0]], np.float32)
+        scores = np.array([[-1.0, -0.1, -9.0, -9.0],
+                           [-0.2, -5.0, -9.0, -9.0]], np.float32)
+        runner = ProgramRunner(prog, {})
+        ids, sc, par = [np.asarray(o) for o in
+                        runner(pre_ids, pre_scores, scores)]
+        # flat candidates: beam0 -> tokens 1 (-0.1), 0 (-1.0); beam1 ->
+        # token 0 (-0.2): top2 = (-0.1 tok1 parent0), (-0.2 tok0 parent1)
+        np.testing.assert_array_equal(ids.reshape(-1), [1, 0])
+        np.testing.assert_allclose(sc.reshape(-1), [-0.1, -0.2])
+        np.testing.assert_array_equal(par, [0, 1])
+
+    def test_finished_beam_frozen(self):
+        import jax.numpy as jnp
+        from paddle_tpu.static import interp
+
+        # direct translator check: pre_id == end_id keeps its score
+        class FakeOp:
+            pass
+
+        prog = Program()
+        b = prog.global_block()
+        _feed_fetch_vars(b)
+        for name, shape, col in (("pre_ids", [2, 1], 0),
+                                 ("pre_scores", [2, 1], 1),
+                                 ("scores", [2, 3], 2)):
+            b.create_var(name, shape, "float32", need_check_feed=True)
+            b.append_op("feed", {"X": "feed"}, {"Out": name}, {"col": col})
+        b.create_var("sel_ids", [2, 1], "int64")
+        b.create_var("sel_scores", [2, 1], "float32")
+        b.create_var("parent", [2], "int32")
+        b.append_op("beam_search",
+                    {"pre_ids": "pre_ids", "pre_scores": "pre_scores",
+                     "scores": "scores"},
+                    {"selected_ids": "sel_ids",
+                     "selected_scores": "sel_scores",
+                     "parent_idx": "parent"},
+                    {"beam_size": 2, "end_id": 0, "level": 0,
+                     "is_accumulated": True})
+        for col, name in enumerate(["sel_ids", "sel_scores", "parent"]):
+            b.append_op("fetch", {"X": name}, {"Out": "fetch"}, {"col": col})
+        pre_ids = np.array([[0], [2]], np.int64)    # beam0 finished
+        pre_scores = np.array([[-0.3], [-1.0]], np.float32)
+        scores = np.array([[-0.01, -0.01, -0.01],   # ignored: finished
+                           [-2.0, -1.5, -9.0]], np.float32)
+        runner = ProgramRunner(prog, {})
+        ids, sc, par = [np.asarray(o) for o in
+                        runner(pre_ids, pre_scores, scores)]
+        # candidates: (end,-0.3,p0), (tok1,-1.5,p1), (tok0,-2.0,p1)
+        np.testing.assert_array_equal(ids.reshape(-1), [0, 1])
+        np.testing.assert_allclose(sc.reshape(-1), [-0.3, -1.5])
+        np.testing.assert_array_equal(par, [0, 1])
+
+
+class TestSeq2SeqBeamSearchEndToEnd:
+    """The round-2 verdict's acceptance test: a seq2seq-with-beam-search
+    program built via static/program.py, serialized through the
+    framework.proto codec, reloaded and executed through the inference
+    Predictor, matching a pure-numpy beam search."""
+
+    V, D, K, B, T_SRC, MAX_LEN = 11, 8, 3, 2, 4, 5
+    START, END = 2, 1
+
+    def _params(self):
+        rng = np.random.RandomState(7)
+        return {
+            "emb": rng.randn(self.V, self.D).astype(np.float32) * 0.5,
+            "w_enc": rng.randn(self.D, self.D).astype(np.float32) * 0.5,
+            "w_x": rng.randn(self.D, self.D).astype(np.float32) * 0.5,
+            "w_h": rng.randn(self.D, self.D).astype(np.float32) * 0.5,
+            "w_out": rng.randn(self.D, self.V).astype(np.float32) * 0.5,
+        }
+
+    def _build_program(self):
+        V, D, K, B, MAX_LEN = self.V, self.D, self.K, self.B, self.MAX_LEN
+        BK = B * K
+        prog = Program()
+        b = prog.global_block()
+        _feed_fetch_vars(b)
+        b.create_var("src", [B, self.T_SRC], "int64",
+                     need_check_feed=True)
+        b.append_op("feed", {"X": "feed"}, {"Out": "src"}, {"col": 0})
+        for name, shape in (("emb", [V, D]), ("w_enc", [D, D]),
+                            ("w_x", [D, D]), ("w_h", [D, D]),
+                            ("w_out", [D, V])):
+            b.create_var(name, shape, "float32", persistable=True)
+        # encoder: mean source embedding -> tanh(enc @ w_enc) -> [BK, D]
+        b.append_op("lookup_table_v2", {"Ids": "src", "W": "emb"},
+                    {"Out": "src_emb"}, {})
+        b.append_op("reduce_mean", {"X": "src_emb"}, {"Out": "enc"},
+                    {"dim": [1], "keep_dim": False})
+        b.append_op("matmul_v2", {"X": "enc", "Y": "w_enc"},
+                    {"Out": "enc_p"}, {})
+        b.append_op("tanh", {"X": "enc_p"}, {"Out": "h_enc"}, {})
+        b.append_op("unsqueeze2", {"X": "h_enc"}, {"Out": "h_enc3"},
+                    {"axes": [1]})
+        b.append_op("expand_v2", {"X": "h_enc3"}, {"Out": "h_exp"},
+                    {"shape": [B, K, D]})
+        b.append_op("reshape2", {"X": "h_exp"}, {"Out": "h"},
+                    {"shape": [BK, D]})
+        # beam state init: pre_ids = START, pre_scores = [0, -1e9, ...]
+        b.append_op("fill_constant", {}, {"Out": "pre_ids_f"},
+                    {"shape": [BK, 1], "dtype": 5, "value": float(self.START)})
+        b.append_op("cast", {"X": "pre_ids_f"}, {"Out": "pre_ids"},
+                    {"in_dtype": 5, "out_dtype": 3})
+        b.append_op("assign_value", {}, {"Out": "beam_mask"},
+                    {"shape": [1, K, 1], "dtype": 5,
+                     "fp32_values": [0.0] + [-1e9] * (K - 1)})
+        b.append_op("expand_v2", {"X": "beam_mask"}, {"Out": "mask_exp"},
+                    {"shape": [B, K, 1]})
+        b.append_op("reshape2", {"X": "mask_exp"}, {"Out": "pre_scores"},
+                    {"shape": [BK, 1]})
+        # loop counter
+        b.append_op("fill_constant", {}, {"Out": "step"},
+                    {"shape": [1], "dtype": 3, "value": 0.0})
+        b.append_op("fill_constant", {}, {"Out": "max_len"},
+                    {"shape": [1], "dtype": 3, "value": float(MAX_LEN)})
+        b.append_op("less_than", {"X": "step", "Y": "max_len"},
+                    {"Out": "cond"}, {})
+
+        body = prog.create_block()
+        body.append_op("lookup_table_v2", {"Ids": "pre_ids", "W": "emb"},
+                       {"Out": "prev_emb3"}, {})
+        body.append_op("reshape2", {"X": "prev_emb3"}, {"Out": "prev_emb"},
+                       {"shape": [BK, D]})
+        body.append_op("matmul_v2", {"X": "prev_emb", "Y": "w_x"},
+                       {"Out": "xh"}, {})
+        body.append_op("matmul_v2", {"X": "h", "Y": "w_h"},
+                       {"Out": "hh"}, {})
+        body.append_op("elementwise_add", {"X": "xh", "Y": "hh"},
+                       {"Out": "pre_h"}, {"axis": -1})
+        body.append_op("tanh", {"X": "pre_h"}, {"Out": "h_new"}, {})
+        body.append_op("matmul_v2", {"X": "h_new", "Y": "w_out"},
+                       {"Out": "logits"}, {})
+        body.append_op("log_softmax", {"X": "logits"}, {"Out": "logp"},
+                       {"axis": -1})
+        body.append_op("elementwise_add", {"X": "logp", "Y": "pre_scores"},
+                       {"Out": "acc"}, {"axis": 0})
+        body.append_op("beam_search",
+                       {"pre_ids": "pre_ids", "pre_scores": "pre_scores",
+                        "scores": "acc"},
+                       {"selected_ids": "sel_ids",
+                        "selected_scores": "sel_scores",
+                        "parent_idx": "parent"},
+                       {"beam_size": K, "end_id": self.END, "level": 0,
+                        "is_accumulated": True})
+        body.append_op("gather", {"X": "h_new", "Index": "parent"},
+                       {"Out": "h"}, {})
+        body.append_op("write_to_array", {"X": "sel_ids", "I": "step"},
+                       {"Out": "ids_arr"}, {})
+        body.append_op("write_to_array", {"X": "sel_scores", "I": "step"},
+                       {"Out": "scores_arr"}, {})
+        body.append_op("write_to_array", {"X": "parent", "I": "step"},
+                       {"Out": "parent_arr"}, {})
+        body.append_op("assign", {"X": "sel_ids"}, {"Out": "pre_ids"}, {})
+        body.append_op("assign", {"X": "sel_scores"},
+                       {"Out": "pre_scores"}, {})
+        body.append_op("increment", {"X": "step"}, {"Out": "step"},
+                       {"step": 1.0})
+        body.append_op("less_than", {"X": "step", "Y": "max_len"},
+                       {"Out": "cond"}, {})
+        b.append_op("while",
+                    {"X": ["h", "pre_ids", "pre_scores", "step"],
+                     "Condition": "cond"},
+                    {"Out": ["ids_arr", "scores_arr", "parent_arr"],
+                     "StepScopes": "ws"},
+                    {"sub_block": BlockRef(body.idx)})
+        b.append_op("beam_search_decode",
+                    {"Ids": "ids_arr", "Scores": "scores_arr",
+                     "ParentIdx": "parent_arr"},
+                    {"SentenceIds": "sent_ids",
+                     "SentenceScores": "sent_scores"},
+                    {"beam_size": K, "end_id": self.END})
+        b.append_op("fetch", {"X": "sent_ids"}, {"Out": "fetch"},
+                    {"col": 0})
+        b.append_op("fetch", {"X": "sent_scores"}, {"Out": "fetch"},
+                    {"col": 1})
+        return prog
+
+    def _numpy_beam_search(self, params, src):
+        V, D, K, B, MAX_LEN = self.V, self.D, self.K, self.B, self.MAX_LEN
+        BK = B * K
+        emb, w_enc = params["emb"], params["w_enc"]
+        w_x, w_h, w_out = params["w_x"], params["w_h"], params["w_out"]
+        h = np.tanh(emb[src].mean(1) @ w_enc)            # [B, D]
+        h = np.repeat(h, K, axis=0)                      # [BK, D]
+        pre_ids = np.full((BK,), self.START, np.int64)
+        pre_scores = np.tile(
+            np.array([0.0] + [-1e9] * (K - 1), np.float32), B)
+        ids_hist, par_hist = [], []
+        score_hist = []
+        for _ in range(MAX_LEN):
+            x = emb[pre_ids]
+            h_new = np.tanh(x @ w_x + h @ w_h)
+            logits = h_new @ w_out
+            logp = logits - logits.max(-1, keepdims=True)
+            logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+            acc = logp + pre_scores[:, None]
+            finished = pre_ids == self.END
+            acc = np.where(finished[:, None], -1e30, acc)
+            acc[:, self.END] = np.where(finished, pre_scores,
+                                        acc[:, self.END])
+            flat = acc.reshape(B, K * V)
+            top = np.argsort(-flat, axis=1, kind="stable")[:, :K]
+            top_scores = np.take_along_axis(flat, top, 1)
+            parent = (np.arange(B)[:, None] * K + top // V).reshape(BK)
+            token = (top % V).reshape(BK).astype(np.int64)
+            h = h_new[parent]
+            ids_hist.append(token)
+            par_hist.append(parent.astype(np.int32))
+            score_hist.append(top_scores.reshape(BK))
+            pre_ids = token
+            pre_scores = top_scores.reshape(BK).astype(np.float32)
+        # backtrace
+        T = MAX_LEN
+        sent = np.zeros((BK, T), np.int64)
+        beam = np.arange(BK)
+        for t in range(T - 1, -1, -1):
+            sent[:, t] = ids_hist[t][beam]
+            beam = par_hist[t][beam]
+        return (sent.reshape(B, K, T),
+                score_hist[-1].reshape(B, K))
+
+    def test_predictor_matches_numpy(self, tmp_path):
+        from paddle_tpu import inference
+        from paddle_tpu.static import save_inference_model
+
+        prog = self._build_program()
+        params = self._params()
+        prefix = str(tmp_path / "s2s" / "model")
+        save_inference_model(prefix, program=prog, scope=params)
+
+        pred = inference.create_predictor(inference.Config(prefix))
+        rng = np.random.RandomState(3)
+        src = rng.randint(3, self.V, (self.B, self.T_SRC)).astype(np.int64)
+        sent_ids, sent_scores = pred.run([src])
+
+        want_ids, want_scores = self._numpy_beam_search(params, src)
+        np.testing.assert_array_equal(np.asarray(sent_ids), want_ids)
+        np.testing.assert_allclose(np.asarray(sent_scores), want_scores,
+                                   rtol=1e-4, atol=1e-4)
