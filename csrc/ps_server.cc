@@ -34,6 +34,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <list>
 #include <mutex>
 #include <set>
 #include <string>
@@ -54,6 +55,12 @@ enum Op : uint8_t {
   kStop = 7,
   kPushDenseDelta = 8,
   kSaveTables = 9,
+  // graph tables (reference distributed/table/common_graph_table.cc +
+  // service/graph_brpc_server.cc — the GNN sampling service)
+  kGraphAddEdges = 10,
+  kGraphSampleNeighbors = 11,
+  kGraphSetNodeFeat = 12,
+  kGraphGetNodeFeat = 13,
 };
 
 // ---------------------------------------------------------------------------
@@ -77,8 +84,154 @@ struct SparseTable {
   size_t dim = 0;
   float lr = 0.01f;
   int optimizer = 0;  // 0 = sgd, 1 = adagrad, 2 = adam
+
+  // -- SSD spill (reference distributed/table/ssd_sparse_table.cc) ---------
+  // When mem_budget > 0, at most that many rows stay resident; the
+  // least-recently-used overflow lives in a fixed-record spill file
+  // (param + optimizer slots per record).  rocksdb in the reference; a
+  // dependency-free slotted file here — same capability: tables larger
+  // than host memory, working set cached.
+  uint64_t mem_budget = 0;  // 0 = pure in-memory table
+  std::string spill_path;
+  std::FILE* spill = nullptr;
+  std::unordered_map<uint64_t, uint64_t> disk_slot;  // id -> record slot
+  uint64_t next_slot = 0;
+  std::vector<uint64_t> free_slots;
+  // LRU bookkeeping for resident rows
+  std::list<uint64_t> lru;  // front = most recent
+  std::unordered_map<uint64_t, std::list<uint64_t>::iterator> lru_it;
+  std::mutex mu;
+
+  ~SparseTable() {
+    if (spill) std::fclose(spill);
+  }
+
+  size_t RecordBytes() const { return 2 + 8 + 3 * dim * sizeof(float); }
+
+  bool EnsureSpillOpen() {
+    if (spill) return true;
+    if (spill_path.empty()) return false;
+    spill = std::fopen(spill_path.c_str(), "r+b");
+    if (!spill) spill = std::fopen(spill_path.c_str(), "w+b");
+    return spill != nullptr;
+  }
+
+  void Touch(uint64_t id) {
+    if (!mem_budget) return;
+    auto it = lru_it.find(id);
+    if (it != lru_it.end()) lru.erase(it->second);
+    lru.push_front(id);
+    lru_it[id] = lru.begin();
+  }
+
+  // Load a spilled row (and its optimizer slots) back into memory.
+  bool FaultIn(uint64_t id) {
+    auto it = disk_slot.find(id);
+    if (it == disk_slot.end() || !EnsureSpillOpen()) return false;
+    std::vector<char> rec(RecordBytes());
+    if (std::fseek(spill, long(it->second * RecordBytes()), SEEK_SET) != 0 ||
+        std::fread(rec.data(), 1, rec.size(), spill) != rec.size())
+      return false;
+    uint8_t has_accum = rec[0], has_mom2 = rec[1];
+    uint64_t st = 0;
+    std::memcpy(&st, rec.data() + 2, 8);
+    const float* fp = reinterpret_cast<const float*>(rec.data() + 10);
+    rows[id].assign(fp, fp + dim);
+    if (has_accum) accum[id].assign(fp + dim, fp + 2 * dim);
+    if (has_mom2) mom2[id].assign(fp + 2 * dim, fp + 3 * dim);
+    if (st) steps[id] = st;
+    free_slots.push_back(it->second);
+    disk_slot.erase(it);
+    return true;
+  }
+
+  bool SpillOut(uint64_t id) {
+    auto rit = rows.find(id);
+    if (rit == rows.end() || !EnsureSpillOpen()) return false;
+    uint64_t slot;
+    if (!free_slots.empty()) {
+      slot = free_slots.back();
+      free_slots.pop_back();
+    } else {
+      slot = next_slot++;
+    }
+    std::vector<char> rec(RecordBytes(), 0);
+    auto ait = accum.find(id);
+    auto vit = mom2.find(id);
+    auto sit = steps.find(id);
+    rec[0] = ait != accum.end() ? 1 : 0;
+    rec[1] = vit != mom2.end() ? 1 : 0;
+    uint64_t st = sit != steps.end() ? sit->second : 0;
+    std::memcpy(rec.data() + 2, &st, 8);
+    float* fp = reinterpret_cast<float*>(rec.data() + 10);
+    std::memcpy(fp, rit->second.data(), dim * sizeof(float));
+    if (rec[0]) std::memcpy(fp + dim, ait->second.data(),
+                            dim * sizeof(float));
+    if (rec[1]) std::memcpy(fp + 2 * dim, vit->second.data(),
+                            dim * sizeof(float));
+    if (std::fseek(spill, long(slot * RecordBytes()), SEEK_SET) != 0 ||
+        std::fwrite(rec.data(), 1, rec.size(), spill) != rec.size()) {
+      free_slots.push_back(slot);
+      return false;
+    }
+    disk_slot[id] = slot;
+    rows.erase(rit);
+    if (rec[0]) accum.erase(ait);
+    if (rec[1]) mom2.erase(vit);
+    if (st) steps.erase(sit);
+    auto lit = lru_it.find(id);
+    if (lit != lru_it.end()) {
+      lru.erase(lit->second);
+      lru_it.erase(lit);
+    }
+    return true;
+  }
+
+  // Evict least-recently-used rows until within budget.
+  void EnforceBudget() {
+    if (!mem_budget) return;
+    while (rows.size() > mem_budget && !lru.empty()) {
+      uint64_t victim = lru.back();
+      if (!SpillOut(victim)) {
+        // unwritable spill file: stop evicting rather than spin
+        break;
+      }
+    }
+  }
+
+  // Resident row reference, faulting in from the spill file when needed.
+  std::vector<float>& Row(uint64_t id) {
+    auto it = rows.find(id);
+    if (it == rows.end()) {
+      if (disk_slot.count(id)) FaultIn(id);
+    }
+    auto& row = rows[id];
+    if (row.empty()) row.assign(dim, 0.0f);
+    Touch(id);
+    return row;
+  }
+};
+
+// reference distributed/table/common_graph_table.cc: adjacency with edge
+// weights + per-node features, served over the PS transport.
+struct GraphTable {
+  std::unordered_map<uint64_t, std::vector<std::pair<uint64_t, float>>> adj;
+  std::unordered_map<uint64_t, std::vector<float>> feat;
+  uint64_t feat_dim = 0;
   std::mutex mu;
 };
+
+// Deterministic 64->32 bit mix used by the neighbor sampler so a numpy
+// reference can replay the exact draw (splitmix64 finalizer).
+static inline uint32_t SampleHash(uint64_t seed, uint64_t node, uint64_t j) {
+  uint64_t h = seed * 0x9E3779B97F4A7C15ull;
+  h ^= node + 0xD1B54A32D192ED03ull + (h << 6) + (h >> 2);
+  h ^= j * 0x94D049BB133111EBull + (h << 6) + (h >> 2);
+  h ^= h >> 33;
+  h *= 0xFF51AFD7ED558CCDull;
+  h ^= h >> 33;
+  return static_cast<uint32_t>(h & 0xFFFFFFFFu);
+}
 
 // adam hyperparameters match the reference server-side accessor defaults
 constexpr float kAdamBeta1 = 0.9f;
@@ -179,6 +332,25 @@ class Server {
     return true;
   }
 
+  // SSD-spillable sparse table (reference ssd_sparse_table.cc): at most
+  // mem_budget rows resident, LRU overflow in the slotted spill file.
+  bool CreateSparseTableSSD(uint32_t id, uint64_t dim, float lr, int opt,
+                            uint64_t mem_budget, const char* spill_path) {
+    if (spill_path == nullptr || spill_path[0] == '\0') return false;
+    if (!CreateSparseTable(id, dim, lr, opt)) return false;
+    std::lock_guard<std::mutex> g(tables_mu_);
+    sparse_[id]->mem_budget = mem_budget;
+    sparse_[id]->spill_path = spill_path;
+    return true;
+  }
+
+  void CreateGraphTable(uint32_t id, uint64_t feat_dim) {
+    std::lock_guard<std::mutex> g(tables_mu_);
+    auto& t = graph_[id];
+    t = std::make_unique<GraphTable>();
+    t->feat_dim = feat_dim;
+  }
+
   // -- persistence ----------------------------------------------------------
   // Binary snapshot of every table incl. optimizer slots, so a restarted
   // server resumes mid-training (reference
@@ -199,7 +371,7 @@ class Server {
       if (n) wr(v.data(), n * sizeof(float));
     };
     const uint32_t magic = 0x53505450u;  // "PTPS"
-    const uint32_t version = 1;
+    const uint32_t version = 2;  // v2 appends graph tables
     wr(&magic, 4);
     wr(&version, 4);
     // collect table pointers under the global lock, then snapshot and
@@ -209,10 +381,12 @@ class Server {
     // destroyed by Load(), which is refused once the server is running.
     std::vector<std::pair<uint32_t, DenseTable*>> dts;
     std::vector<std::pair<uint32_t, SparseTable*>> sts;
+    std::vector<std::pair<uint32_t, GraphTable*>> gts;
     {
       std::lock_guard<std::mutex> g(tables_mu_);
       for (auto& kv : dense_) dts.emplace_back(kv.first, kv.second.get());
       for (auto& kv : sparse_) sts.emplace_back(kv.first, kv.second.get());
+      for (auto& kv : graph_) gts.emplace_back(kv.first, kv.second.get());
     }
     uint32_t nd = static_cast<uint32_t>(dts.size());
     wr(&nd, 4);
@@ -253,6 +427,31 @@ class Server {
         snap.accum = src->accum;
         snap.mom2 = src->mom2;
         snap.steps = src->steps;
+        // fold SPILLED rows into the snapshot (read records directly —
+        // faulting them in would defeat the memory budget)
+        if (!src->disk_slot.empty() && src->EnsureSpillOpen()) {
+          std::vector<char> rec(src->RecordBytes());
+          for (auto& ds : src->disk_slot) {
+            if (std::fseek(src->spill,
+                           long(ds.second * src->RecordBytes()),
+                           SEEK_SET) != 0 ||
+                std::fread(rec.data(), 1, rec.size(), src->spill) !=
+                    rec.size())
+              continue;
+            const float* fp =
+                reinterpret_cast<const float*>(rec.data() + 10);
+            snap.rows[ds.first].assign(fp, fp + src->dim);
+            if (rec[0])
+              snap.accum[ds.first].assign(fp + src->dim,
+                                          fp + 2 * src->dim);
+            if (rec[1])
+              snap.mom2[ds.first].assign(fp + 2 * src->dim,
+                                         fp + 3 * src->dim);
+            uint64_t st = 0;
+            std::memcpy(&st, rec.data() + 2, 8);
+            if (st) snap.steps[ds.first] = st;
+          }
+        }
       }
       wr(&kv.first, 4);
       uint64_t dim = snap.dim;
@@ -278,6 +477,37 @@ class Server {
             };
         write_slot(snap.accum);
         write_slot(snap.mom2);
+      }
+    }
+    uint32_t ng = static_cast<uint32_t>(gts.size());
+    wr(&ng, 4);
+    for (auto& kv : gts) {
+      GraphTable* src = kv.second;
+      GraphTable snap;
+      {
+        std::lock_guard<std::mutex> tg(src->mu);
+        snap.feat_dim = src->feat_dim;
+        snap.adj = src->adj;
+        snap.feat = src->feat;
+      }
+      wr(&kv.first, 4);
+      wr(&snap.feat_dim, 8);
+      uint64_t nsrc = snap.adj.size();
+      wr(&nsrc, 8);
+      for (auto& a : snap.adj) {
+        wr(&a.first, 8);
+        uint64_t deg = a.second.size();
+        wr(&deg, 8);
+        for (auto& e : a.second) {
+          wr(&e.first, 8);
+          wr(&e.second, 4);
+        }
+      }
+      uint64_t nfeat = snap.feat.size();
+      wr(&nfeat, 8);
+      for (auto& fv : snap.feat) {
+        wr(&fv.first, 8);
+        wr(fv.second.data(), snap.feat_dim * sizeof(float));
       }
     }
     if (std::fclose(f) != 0) ok = false;
@@ -310,7 +540,7 @@ class Server {
     uint32_t magic = 0, version = 0;
     rd(&magic, 4);
     rd(&version, 4);
-    if (!ok || magic != 0x53505450u || version != 1) {
+    if (!ok || magic != 0x53505450u || (version != 1 && version != 2)) {
       std::fclose(f);
       return false;
     }
@@ -377,12 +607,70 @@ class Server {
       }
       if (ok) staged_sparse[id] = std::move(t);
     }
+    std::unordered_map<uint32_t, std::unique_ptr<GraphTable>> staged_graph;
+    if (ok && version >= 2) {
+      uint32_t ng = 0;
+      rd(&ng, 4);
+      for (uint32_t i = 0; ok && i < ng; ++i) {
+        uint32_t id = 0;
+        rd(&id, 4);
+        auto t = std::make_unique<GraphTable>();
+        rd(&t->feat_dim, 8);
+        uint64_t nsrc = 0;
+        rd(&nsrc, 8);
+        if (!ok || t->feat_dim > (1u << 20) || nsrc > (1ull << 32)) {
+          ok = false;
+          break;
+        }
+        for (uint64_t s = 0; ok && s < nsrc; ++s) {
+          uint64_t srcid = 0, deg = 0;
+          rd(&srcid, 8);
+          rd(&deg, 8);
+          if (!ok || deg > (1ull << 28)) {
+            ok = false;
+            break;
+          }
+          auto& lst = t->adj[srcid];
+          lst.resize(deg);
+          for (uint64_t e = 0; ok && e < deg; ++e) {
+            rd(&lst[e].first, 8);
+            rd(&lst[e].second, 4);
+          }
+        }
+        uint64_t nfeat = 0;
+        rd(&nfeat, 8);
+        if (!ok || nfeat > (1ull << 32)) ok = false;
+        for (uint64_t s = 0; ok && s < nfeat; ++s) {
+          uint64_t nid = 0;
+          rd(&nid, 8);
+          std::vector<float> fv(t->feat_dim);
+          rd(fv.data(), t->feat_dim * sizeof(float));
+          if (ok) t->feat[nid] = std::move(fv);
+        }
+        if (ok) staged_graph[id] = std::move(t);
+      }
+    }
     std::fclose(f);
     if (ok) {
       std::lock_guard<std::mutex> g(tables_mu_);
       for (auto& kv : staged_dense) dense_[kv.first] = std::move(kv.second);
-      for (auto& kv : staged_sparse)
+      for (auto& kv : staged_sparse) {
+        // carry the SSD config from a pre-created table of the same id
+        // (create_sparse_table_ssd then load is the recovery flow), and
+        // spill back down to the budget
+        auto prev = sparse_.find(kv.first);
+        if (prev != sparse_.end() && prev->second->mem_budget) {
+          kv.second->mem_budget = prev->second->mem_budget;
+          kv.second->spill_path = prev->second->spill_path;
+          // a fresh load owns the spill file: reset the slot map (the
+          // snapshot holds every row in memory at this point)
+          std::remove(kv.second->spill_path.c_str());
+          for (auto& row : kv.second->rows) kv.second->Touch(row.first);
+          kv.second->EnforceBudget();
+        }
         sparse_[kv.first] = std::move(kv.second);
+      }
+      for (auto& kv : staged_graph) graph_[kv.first] = std::move(kv.second);
     }
     return ok;
   }
@@ -454,6 +742,12 @@ class Server {
     std::lock_guard<std::mutex> g(tables_mu_);
     auto it = sparse_.find(id);
     return it == sparse_.end() ? nullptr : it->second.get();
+  }
+
+  GraphTable* GetGraph(uint32_t id) {
+    std::lock_guard<std::mutex> g(tables_mu_);
+    auto it = graph_.find(id);
+    return it == graph_.end() ? nullptr : it->second.get();
   }
 
   void Serve(int fd) {
@@ -552,11 +846,11 @@ class Server {
         {
           std::lock_guard<std::mutex> g(t->mu);
           for (uint64_t i = 0; i < n; ++i) {
-            auto& row = t->rows[ids[i]];
-            if (row.empty()) row.assign(t->dim, 0.0f);
+            auto& row = t->Row(ids[i]);
             std::memcpy(out.data() + i * t->dim, row.data(),
                         t->dim * sizeof(float));
           }
+          t->EnforceBudget();
         }
         return SendResponse(fd, 0, out.data(), out.size() * sizeof(float));
       }
@@ -570,8 +864,7 @@ class Server {
             reinterpret_cast<const float*>(payload + n * sizeof(uint64_t));
         std::lock_guard<std::mutex> g(t->mu);
         for (uint64_t i = 0; i < n; ++i) {
-          auto& row = t->rows[ids[i]];
-          if (row.empty()) row.assign(t->dim, 0.0f);
+          auto& row = t->Row(ids[i]);
           const float* gr = grads + i * t->dim;
           if (t->optimizer == 1) {  // adagrad
             auto& acc = t->accum[ids[i]];
@@ -598,7 +891,97 @@ class Server {
             for (size_t d = 0; d < t->dim; ++d) row[d] -= t->lr * gr[d];
           }
         }
+        t->EnforceBudget();
         return SendResponse(fd, 0, nullptr, 0);
+      }
+      case kGraphAddEdges: {
+        GraphTable* t = GetGraph(table);
+        const size_t elem = 8 + 8 + 4;  // src, dst, weight
+        if (!t || n > payload_len / elem || payload_len != n * elem)
+          return SendResponse(fd, 1, nullptr, 0);
+        std::lock_guard<std::mutex> g(t->mu);
+        for (uint64_t i = 0; i < n; ++i) {
+          const char* rec = payload + i * elem;
+          uint64_t src, dst;
+          float w;
+          std::memcpy(&src, rec, 8);
+          std::memcpy(&dst, rec + 8, 8);
+          std::memcpy(&w, rec + 16, 4);
+          t->adj[src].emplace_back(dst, w);
+        }
+        return SendResponse(fd, 0, nullptr, 0);
+      }
+      case kGraphSampleNeighbors: {
+        GraphTable* t = GetGraph(table);
+        // payload: u32 sample_size | u32 seed | n * u64 ids
+        if (!t || payload_len < 8 ||
+            n > (payload_len - 8) / sizeof(uint64_t) ||
+            payload_len != 8 + n * sizeof(uint64_t))
+          return SendResponse(fd, 1, nullptr, 0);
+        uint32_t k = 0, seed = 0;
+        std::memcpy(&k, payload, 4);
+        std::memcpy(&seed, payload + 4, 4);
+        if (k == 0 || k > (1u << 16)) return SendResponse(fd, 1, nullptr, 0);
+        const uint64_t* ids =
+            reinterpret_cast<const uint64_t*>(payload + 8);
+        // response per id: u32 count | k * u64 neighbor ids (0-padded)
+        std::vector<char> out(n * (4 + size_t(k) * 8), 0);
+        std::lock_guard<std::mutex> g(t->mu);
+        for (uint64_t i = 0; i < n; ++i) {
+          char* rec = out.data() + i * (4 + size_t(k) * 8);
+          auto it = t->adj.find(ids[i]);
+          if (it == t->adj.end()) continue;
+          const auto& nbrs = it->second;
+          uint32_t cnt = std::min<uint64_t>(k, nbrs.size());
+          std::memcpy(rec, &cnt, 4);
+          // Efraimidis–Spirakis weighted reservoir: key_j = u_j^(1/w_j)
+          // with u_j from the deterministic SampleHash — replayable from
+          // numpy for parity tests.
+          std::vector<std::pair<double, uint64_t>> keys(nbrs.size());
+          for (size_t j = 0; j < nbrs.size(); ++j) {
+            double u = (double(SampleHash(seed, ids[i], j)) + 1.0) /
+                       4294967296.0;
+            double w = nbrs[j].second > 0 ? double(nbrs[j].second) : 1.0;
+            keys[j] = {-std::pow(u, 1.0 / w), j};
+          }
+          std::sort(keys.begin(), keys.end());
+          uint64_t* outs = reinterpret_cast<uint64_t*>(rec + 4);
+          for (uint32_t j = 0; j < cnt; ++j)
+            outs[j] = nbrs[keys[j].second].first;
+        }
+        return SendResponse(fd, 0, out.data(), out.size());
+      }
+      case kGraphSetNodeFeat: {
+        GraphTable* t = GetGraph(table);
+        if (!t) return SendResponse(fd, 1, nullptr, 0);
+        const size_t elem = 8 + t->feat_dim * sizeof(float);
+        if (n > payload_len / elem || payload_len != n * elem)
+          return SendResponse(fd, 1, nullptr, 0);
+        std::lock_guard<std::mutex> g(t->mu);
+        for (uint64_t i = 0; i < n; ++i) {
+          const char* rec = payload + i * elem;
+          uint64_t id;
+          std::memcpy(&id, rec, 8);
+          const float* fv = reinterpret_cast<const float*>(rec + 8);
+          t->feat[id].assign(fv, fv + t->feat_dim);
+        }
+        return SendResponse(fd, 0, nullptr, 0);
+      }
+      case kGraphGetNodeFeat: {
+        GraphTable* t = GetGraph(table);
+        if (!t || n > payload_len / sizeof(uint64_t) ||
+            payload_len != n * sizeof(uint64_t))
+          return SendResponse(fd, 1, nullptr, 0);
+        const uint64_t* ids = reinterpret_cast<const uint64_t*>(payload);
+        std::vector<float> out(n * t->feat_dim, 0.0f);
+        std::lock_guard<std::mutex> g(t->mu);
+        for (uint64_t i = 0; i < n; ++i) {
+          auto it = t->feat.find(ids[i]);
+          if (it != t->feat.end())
+            std::memcpy(out.data() + i * t->feat_dim, it->second.data(),
+                        t->feat_dim * sizeof(float));
+        }
+        return SendResponse(fd, 0, out.data(), out.size() * sizeof(float));
       }
       case kSaveTables: {
         if (payload_len == 0 || payload_len > 4096)
@@ -653,6 +1036,7 @@ class Server {
   std::mutex tables_mu_;
   std::unordered_map<uint32_t, std::unique_ptr<DenseTable>> dense_;
   std::unordered_map<uint32_t, std::unique_ptr<SparseTable>> sparse_;
+  std::unordered_map<uint32_t, std::unique_ptr<GraphTable>> graph_;
   std::mutex barrier_mu_;
   std::condition_variable barrier_cv_;
   std::set<uint64_t> barrier_ids_;
@@ -746,6 +1130,22 @@ int ptrt_ps_server_create_sparse_table(void* s, uint32_t id, uint64_t dim,
                                                               optimizer)
              ? 0
              : -1;
+}
+
+int ptrt_ps_server_create_sparse_table_ssd(void* s, uint32_t id,
+                                           uint64_t dim, float lr,
+                                           int optimizer,
+                                           uint64_t mem_budget,
+                                           const char* spill_path) {
+  return static_cast<ptrt::ps::Server*>(s)->CreateSparseTableSSD(
+             id, dim, lr, optimizer, mem_budget, spill_path)
+             ? 0
+             : -1;
+}
+
+void ptrt_ps_server_create_graph_table(void* s, uint32_t id,
+                                       uint64_t feat_dim) {
+  static_cast<ptrt::ps::Server*>(s)->CreateGraphTable(id, feat_dim);
 }
 
 int ptrt_ps_server_save(void* s, const char* path) {

@@ -38,6 +38,10 @@ OP_BARRIER = 6
 OP_STOP = 7
 OP_PUSH_DENSE_DELTA = 8
 OP_SAVE_TABLES = 9
+OP_GRAPH_ADD_EDGES = 10
+OP_GRAPH_SAMPLE_NEIGHBORS = 11
+OP_GRAPH_SET_NODE_FEAT = 12
+OP_GRAPH_GET_NODE_FEAT = 13
 
 _PS_SIGS = False
 
@@ -59,6 +63,12 @@ def _lib():
         lib.ptrt_ps_server_create_sparse_table.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
             ctypes.c_float, ctypes.c_int]
+        lib.ptrt_ps_server_create_sparse_table_ssd.restype = ctypes.c_int
+        lib.ptrt_ps_server_create_sparse_table_ssd.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_float, ctypes.c_int, ctypes.c_uint64, ctypes.c_char_p]
+        lib.ptrt_ps_server_create_graph_table.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64]
         lib.ptrt_ps_server_save.restype = ctypes.c_int
         lib.ptrt_ps_server_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.ptrt_ps_server_load.restype = ctypes.c_int
@@ -106,6 +116,31 @@ class PSServer:
             self.SPARSE_OPTS[optimizer])
         if rc != 0:
             raise ValueError(f"invalid sparse optimizer {optimizer!r}")
+
+    def create_sparse_table_ssd(self, table_id, dim, mem_budget_rows,
+                                spill_path, lr=0.01, optimizer="sgd"):
+        """SSD-spillable sparse table (reference
+        `distributed/table/ssd_sparse_table.cc`): at most
+        ``mem_budget_rows`` rows stay in host memory; the LRU overflow
+        (param + optimizer slots) lives in the slotted ``spill_path``
+        file.  save()/load() snapshots fold spilled rows in, so tables
+        larger than the budget survive a restart."""
+        rc = self._lib.ptrt_ps_server_create_sparse_table_ssd(
+            self._h, table_id, int(dim), float(lr),
+            self.SPARSE_OPTS[optimizer], int(mem_budget_rows),
+            str(spill_path).encode())
+        if rc != 0:
+            raise ValueError(
+                f"create_sparse_table_ssd failed (optimizer {optimizer!r}, "
+                f"path {spill_path!r})")
+
+    def create_graph_table(self, table_id, feat_dim=0):
+        """Graph table (reference
+        `distributed/table/common_graph_table.cc`): weighted adjacency +
+        per-node features, served over the PS transport for GNN
+        neighbor sampling (`graph_brpc_server.cc`)."""
+        self._lib.ptrt_ps_server_create_graph_table(self._h, table_id,
+                                                    int(feat_dim))
 
     def start(self, port=0, n_trainers=1, host="127.0.0.1"):
         """Bind defaults to loopback — the wire protocol is unauthenticated
@@ -201,6 +236,55 @@ class PSClient:
         g = np.ascontiguousarray(grads, np.float32)
         self._request(OP_PUSH_SPARSE_GRAD, table, ids.size,
                       ids.tobytes() + g.tobytes(), 0)
+
+    # -- graph service (reference graph_brpc_client.cc) ---------------------
+    def add_graph_edges(self, table, src: np.ndarray, dst: np.ndarray,
+                        weight: Optional[np.ndarray] = None):
+        src = np.ascontiguousarray(src, np.uint64)
+        dst = np.ascontiguousarray(dst, np.uint64)
+        w = (np.ascontiguousarray(weight, np.float32) if weight is not None
+             else np.ones(src.size, np.float32))
+        rec = np.zeros(src.size, dtype=[("s", "<u8"), ("d", "<u8"),
+                                        ("w", "<f4")])
+        rec["s"], rec["d"], rec["w"] = src, dst, w
+        self._request(OP_GRAPH_ADD_EDGES, table, src.size, rec.tobytes(), 0)
+
+    def sample_neighbors(self, table, ids: np.ndarray, sample_size: int,
+                         seed: int = 0):
+        """Weighted neighbor sampling without replacement
+        (Efraimidis-Spirakis keys from a deterministic splitmix hash —
+        replayable in numpy, see tests).  Returns (neighbors
+        [n, sample_size] uint64 0-padded, counts [n] int32)."""
+        ids = np.ascontiguousarray(ids, np.uint64)
+        k = int(sample_size)
+        payload = (np.uint32(k).tobytes() + np.uint32(seed).tobytes() +
+                   ids.tobytes())
+        rec_bytes = 4 + k * 8
+        raw = self._request(OP_GRAPH_SAMPLE_NEIGHBORS, table, ids.size,
+                            payload, ids.size * rec_bytes + 16)
+        rec = np.frombuffer(raw, np.uint8,
+                            count=ids.size * rec_bytes).reshape(
+                                ids.size, rec_bytes)
+        counts = rec[:, :4].copy().view(np.int32).reshape(-1)
+        nbrs = rec[:, 4:].copy().view(np.uint64).reshape(ids.size, k)
+        return nbrs, counts
+
+    def set_node_feat(self, table, ids: np.ndarray, feats: np.ndarray):
+        ids = np.ascontiguousarray(ids, np.uint64)
+        f = np.ascontiguousarray(feats, np.float32).reshape(ids.size, -1)
+        rec = ids.reshape(-1, 1).view(np.uint8).reshape(ids.size, 8)
+        payload = np.concatenate(
+            [rec, f.view(np.uint8).reshape(ids.size, -1)],
+            axis=1).tobytes()
+        self._request(OP_GRAPH_SET_NODE_FEAT, table, ids.size, payload, 0)
+
+    def get_node_feat(self, table, ids: np.ndarray, dim: int) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.uint64)
+        raw = self._request(OP_GRAPH_GET_NODE_FEAT, table, ids.size,
+                            ids.tobytes(), ids.size * dim * 4 + 16)
+        return np.frombuffer(raw, np.float32,
+                             count=ids.size * dim).reshape(
+                                 ids.size, dim).copy()
 
     def barrier(self, trainer_id=None, table=0):
         """Block until all n_trainers distinct trainer ids arrive (restarts
@@ -475,3 +559,183 @@ class Communicator:
                         self._send_error = e
                         self._running = False
                     return
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous trainer service (reference heter_client.cc/heter_server.cc +
+# operators/pscore/heter_listen_and_serv_op.cc): a worker offloads part of
+# its step — by name — to a peer process holding different hardware (the
+# reference's CPU-param / accelerator-dense split).  Transport mirrors the
+# PS framing; payloads are named numpy arrays.
+# ---------------------------------------------------------------------------
+import socket
+import struct
+
+
+# Wire codec for the heter service: a restricted binary encoding of
+# (name/status, [numpy arrays]).  Deliberately NOT pickle — the transport
+# is unauthenticated (PS trust model: data tampering is in-scope), and
+# pickle would escalate that to arbitrary code execution.
+def _enc_arrays(tag: str, arrays) -> bytes:
+    tb = tag.encode()
+    out = [struct.pack("<H", len(tb)), tb,
+           struct.pack("<H", len(arrays))]
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        ds = a.dtype.str.encode()
+        out.append(struct.pack("<H", len(ds)))
+        out.append(ds)
+        out.append(struct.pack("<B", a.ndim))
+        out.append(struct.pack(f"<{a.ndim}q", *a.shape) if a.ndim else b"")
+        out.append(struct.pack("<Q", a.nbytes))
+        out.append(a.tobytes())
+    return b"".join(out)
+
+
+def _dec_arrays(buf: bytes):
+    off = 0
+
+    def take(n):
+        nonlocal off
+        if off + n > len(buf):
+            raise ValueError("truncated heter message")
+        b = buf[off:off + n]
+        off += n
+        return b
+
+    (tl,) = struct.unpack("<H", take(2))
+    tag = take(tl).decode()
+    (cnt,) = struct.unpack("<H", take(2))
+    arrays = []
+    for _ in range(cnt):
+        (dl,) = struct.unpack("<H", take(2))
+        dt = np.dtype(take(dl).decode())
+        if dt.hasobject:
+            raise ValueError("object dtypes are not allowed on the wire")
+        (ndim,) = struct.unpack("<B", take(1))
+        shape = struct.unpack(f"<{ndim}q", take(8 * ndim)) if ndim else ()
+        (nbytes,) = struct.unpack("<Q", take(8))
+        arrays.append(np.frombuffer(take(nbytes), dt).reshape(shape).copy())
+    return tag, arrays
+
+
+class HeterServer:
+    """Serves registered callables to HeterClients.
+
+    ``register(name, fn)`` exposes ``fn(*arrays) -> array | tuple`` —
+    typically a jitted sub-program (the reference's heter "section").
+    Loopback bind by default; the protocol is unauthenticated (same trust
+    model as the PS transport) but carries only a restricted
+    dtype/shape/bytes array encoding — never pickled objects."""
+
+    def __init__(self):
+        self._fns: Dict[str, object] = {}
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self.port = None
+
+    def register(self, name: str, fn):
+        self._fns[name] = fn
+
+    def start(self, port=0, host="127.0.0.1"):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._running = True
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._running = False
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- internals ----------------------------------------------------------
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while self._running:
+                hdr = self._read_full(conn, 4)
+                if hdr is None:
+                    return
+                (ln,) = struct.unpack("<I", hdr)
+                if ln > (1 << 30):
+                    return
+                body = self._read_full(conn, ln)
+                if body is None:
+                    return
+                try:
+                    name, arrays = _dec_arrays(body)
+                    fn = self._fns[name]
+                    out = fn(*arrays)
+                    if not isinstance(out, (list, tuple)):
+                        out = (out,)
+                    resp = _enc_arrays(
+                        "ok", [np.asarray(o) for o in out])
+                except Exception as e:  # noqa: BLE001 — shipped to caller
+                    resp = _enc_arrays(
+                        "err:" + str(e)[:500], [])
+                conn.sendall(struct.pack("<I", len(resp)) + resp)
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _read_full(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+
+class HeterClient:
+    """Worker-side handle: ``run(name, *arrays)`` executes the named
+    section on the heter server and returns its output arrays
+    (reference HeterClient::SendAndRecvAsync)."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._sock = socket.create_connection((host, port))
+
+    def run(self, name: str, *arrays):
+        payload = _enc_arrays(name, [np.asarray(a) for a in arrays])
+        self._sock.sendall(struct.pack("<I", len(payload)) + payload)
+        hdr = HeterServer._read_full(self._sock, 4)
+        if hdr is None:
+            raise ConnectionError("heter server closed the connection")
+        (ln,) = struct.unpack("<I", hdr)
+        body = HeterServer._read_full(self._sock, ln)
+        if body is None:
+            raise ConnectionError(
+                "heter server closed mid-response")
+        tag, out = _dec_arrays(body)
+        if tag != "ok":
+            raise RuntimeError(
+                f"heter section {name!r} failed: {tag[4:]}")
+        return tuple(out) if len(out) != 1 else out[0]
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

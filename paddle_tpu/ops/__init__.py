@@ -22,6 +22,7 @@ from .linalg import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .misc import *  # noqa: F401,F403
 from .crf import *  # noqa: F401,F403
+from .industrial import *  # noqa: F401,F403
 # control_flow exposed as a namespace only: its `cond` (branching) must not
 # shadow linalg's `cond` (condition number) at the top level
 from . import (control_flow, creation, crf, linalg, logic, manipulation,

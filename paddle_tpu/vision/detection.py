@@ -34,7 +34,8 @@ __all__ = [
     "multiclass_nms2", "multiclass_nms3",
     "target_assign", "mine_hard_examples", "rpn_target_assign",
     "retinanet_target_assign", "polygon_box_transform",
-    "generate_proposal_labels",
+    "generate_proposal_labels", "roi_perspective_transform",
+    "generate_mask_labels",
 ]
 
 
@@ -1210,3 +1211,231 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
         gt_num = _np.full((N,), G, _np.int32)
     return dispatch(f, rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
                     rois_num, gt_num, nondiff=(0, 1, 2, 3, 4, 5, 6))
+
+
+def roi_perspective_transform(x, rois, transformed_height, transformed_width,
+                              spatial_scale=1.0, roi_batch_idx=None,
+                              name=None):
+    """Perspective-warp quadrilateral ROIs to a fixed size
+    (`detection/roi_perspective_transform_op.cc`): per ROI [8] quad
+    (x0,y0..x3,y3), build the reference's closed-form quad->rect
+    transform (get_transform_matrix, incl. its estimated-width
+    normalization), inverse-map each output pixel and bilinearly sample;
+    pixels outside the quad or the image get 0 with mask 0.
+
+    Static form: rois [R, 8] + `roi_batch_idx` [R] (image index per ROI;
+    the reference's LoD).  Returns (out [R, C, th, tw],
+    mask [R, 1, th, tw] int32, transform_matrix [R, 9])."""
+    th, tw = int(transformed_height), int(transformed_width)
+
+    def f(xv, rv, bidx):
+        n_im, c, h, w = xv.shape
+        rx = rv[:, 0::2] * spatial_scale                    # [R, 4]
+        ry = rv[:, 1::2] * spatial_scale
+
+        x0, x1, x2, x3 = rx[:, 0], rx[:, 1], rx[:, 2], rx[:, 3]
+        y0, y1, y2, y3 = ry[:, 0], ry[:, 1], ry[:, 2], ry[:, 3]
+        len1 = jnp.hypot(x0 - x1, y0 - y1)
+        len2 = jnp.hypot(x1 - x2, y1 - y2)
+        len3 = jnp.hypot(x2 - x3, y2 - y3)
+        len4 = jnp.hypot(x3 - x0, y3 - y0)
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        norm_h = jnp.maximum(2, th)
+        norm_w = jnp.round(est_w * (norm_h - 1) /
+                           jnp.maximum(est_h, 1e-5)) + 1
+        norm_w = jnp.clip(norm_w, 2, tw)
+
+        dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+        dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+        den = dx1 * dy2 - dx2 * dy1 + 1e-5
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / (norm_w - 1)
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / (norm_h - 1)
+        m8 = jnp.ones_like(m6)
+        m3 = (y1 - y0 + m6 * (norm_w - 1) * y1) / (norm_w - 1)
+        m4 = (y3 - y0 + m7 * (norm_h - 1) * y3) / (norm_h - 1)
+        m5 = y0
+        m0 = (x1 - x0 + m6 * (norm_w - 1) * x1) / (norm_w - 1)
+        m1 = (x3 - x0 + m7 * (norm_h - 1) * x3) / (norm_h - 1)
+        m2 = x0
+        mat = jnp.stack([m0, m1, m2, m3, m4, m5, m6, m7, m8], -1)  # [R,9]
+
+        ow = jnp.arange(tw, dtype=jnp.float32)
+        oh = jnp.arange(th, dtype=jnp.float32)
+        gw, gh = jnp.meshgrid(ow, oh)                       # [th, tw]
+        u = (m0[:, None, None] * gw + m1[:, None, None] * gh +
+             m2[:, None, None])
+        v = (m3[:, None, None] * gw + m4[:, None, None] * gh +
+             m5[:, None, None])
+        ww = (m6[:, None, None] * gw + m7[:, None, None] * gh +
+              m8[:, None, None])
+        in_w = u / jnp.where(jnp.abs(ww) < 1e-10, 1e-10, ww)
+        in_h = v / jnp.where(jnp.abs(ww) < 1e-10, 1e-10, ww)
+
+        # crossing-number in-quad test (reference in_quad)
+        def crossings(px, py):
+            cnt = jnp.zeros_like(px, jnp.int32)
+            for i in range(4):
+                xs, ys = rx[:, i], ry[:, i]
+                xe, ye = rx[:, (i + 1) % 4], ry[:, (i + 1) % 4]
+                xs_, ys_ = xs[:, None, None], ys[:, None, None]
+                xe_, ye_ = xe[:, None, None], ye[:, None, None]
+                non_horiz = jnp.abs(ys_ - ye_) >= 1e-4
+                t = (py - ys_) / jnp.where(non_horiz, ye_ - ys_, 1.0)
+                ix = xs_ + t * (xe_ - xs_)
+                hit = non_horiz & (t >= 0) & (t < 1) & (ix > px)
+                cnt = cnt + hit.astype(jnp.int32)
+            return cnt
+        inside_quad = (crossings(in_w, in_h) % 2) == 1
+
+        in_bounds = ((in_w > -0.5) & (in_w < w - 0.5) &
+                     (in_h > -0.5) & (in_h < h - 0.5))
+        valid = inside_quad & in_bounds
+        cw = jnp.clip(in_w, 0.0, w - 1.0)
+        ch = jnp.clip(in_h, 0.0, h - 1.0)
+        wf = jnp.floor(cw)
+        hf = jnp.floor(ch)
+        wc = jnp.minimum(wf + 1, w - 1)
+        hc = jnp.minimum(hf + 1, h - 1)
+        lw_ = cw - wf
+        lh_ = ch - hf
+        img = xv[bidx.astype(jnp.int32)]                    # [R,C,H,W]
+
+        def gat(hh, www):
+            return jax.vmap(
+                lambda im, hh_, ww_: im[:, hh_, ww_])(
+                    img, hh.astype(jnp.int32), www.astype(jnp.int32))
+        v00 = gat(hf, wf)
+        v01 = gat(hf, wc)
+        v10 = gat(hc, wf)
+        v11 = gat(hc, wc)
+        lw_b = lw_[:, None]
+        lh_b = lh_[:, None]
+        out = (v00 * (1 - lw_b) * (1 - lh_b) + v01 * lw_b * (1 - lh_b) +
+               v10 * (1 - lw_b) * lh_b + v11 * lw_b * lh_b)
+        out = jnp.where(valid[:, None], out, 0.0)
+        return out, valid[:, None].astype(jnp.int32), mat
+
+    import numpy as _np
+
+    if roi_batch_idx is None:
+        roi_batch_idx = _np.zeros((unwrap(rois).shape[0],), _np.int32)
+    return dispatch(f, x, rois, roi_batch_idx, nondiff=(1, 2))
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         gt_num=None, name=None):
+    """Mask-RCNN mask-target assigner
+    (`detection/generate_mask_labels_op.cc` SampleMaskForOneImage): each
+    foreground roi (label > 0) is matched to the non-crowd gt polygon
+    whose bounding box overlaps it most, and that polygon is rasterized
+    inside the roi at resolution x resolution into the roi's class slot
+    (-1 elsewhere).
+
+    Static batched form: gt_segms [N, G, P, V, 2] padded polygon points
+    (NaN/repeat-padding tolerated via per-polygon closing), rois
+    [N, R, 4], labels_int32 [N, R] (-1 pad).  Fg rois are compacted to
+    the front.  Rasterization is pixel-center even-odd point-in-polygon
+    (the reference uses COCO's RLE rasterizer; border pixels may differ
+    — documented divergence).  Returns (mask_rois [N, R, 4],
+    roi_has_mask [N, R] int32 original roi index (-1 pad),
+    mask_int32 [N, R, num_classes*resolution^2], fg_counts [N])."""
+    res = int(resolution)
+    ncls = int(num_classes)
+
+    def one(info, gtc, crowd, segms, rois_i, labels, gn):
+        g, p, vmax, _ = segms.shape
+        r = rois_i.shape[0]
+        im_scale = info[2]
+        gt_valid = ((jnp.arange(g) < gn) & (gtc > 0) & (crowd == 0))
+        # polygon bboxes -> gt boxes (Poly2Boxes)
+        pts = segms.reshape(g, p * vmax, 2)
+        finite = jnp.isfinite(pts).all(-1)
+        big = jnp.where(finite[..., None], pts, jnp.inf)
+        small = jnp.where(finite[..., None], pts, -jnp.inf)
+        gt_boxes = jnp.stack([big[:, :, 0].min(1), big[:, :, 1].min(1),
+                              small[:, :, 0].max(1),
+                              small[:, :, 1].max(1)], -1)   # [G,4]
+        fg = labels > 0
+        order = jnp.argsort(~fg, stable=True)
+        fg_sorted = fg[order]
+        rois_s = rois_i[order] / im_scale
+        labels_s = labels[order]
+        # IoU of fg rois vs gt poly boxes
+        x1 = jnp.maximum(rois_s[:, None, 0], gt_boxes[None, :, 0])
+        y1 = jnp.maximum(rois_s[:, None, 1], gt_boxes[None, :, 1])
+        x2 = jnp.minimum(rois_s[:, None, 2], gt_boxes[None, :, 2])
+        y2 = jnp.minimum(rois_s[:, None, 3], gt_boxes[None, :, 3])
+        inter = jnp.clip(x2 - x1 + 1, 0) * jnp.clip(y2 - y1 + 1, 0)
+        area_r = ((rois_s[:, 2] - rois_s[:, 0] + 1) *
+                  (rois_s[:, 3] - rois_s[:, 1] + 1))
+        area_g = ((gt_boxes[:, 2] - gt_boxes[:, 0] + 1) *
+                  (gt_boxes[:, 3] - gt_boxes[:, 1] + 1))
+        iou = inter / jnp.maximum(area_r[:, None] + area_g[None, :] -
+                                  inter, 1e-10)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)                   # [R]
+        # rasterize best_gt's polygons inside each roi
+        ys = (jnp.arange(res) + 0.5) / res
+        xs = (jnp.arange(res) + 0.5) / res
+        gx, gy = jnp.meshgrid(xs, ys)                       # [res,res]
+        bw = jnp.maximum(rois_s[:, 2] - rois_s[:, 0], 1e-5)
+        bh = jnp.maximum(rois_s[:, 3] - rois_s[:, 1], 1e-5)
+        px = rois_s[:, 0, None, None] + gx[None] * bw[:, None, None]
+        py = rois_s[:, 1, None, None] + gy[None] * bh[:, None, None]
+        polys = segms[best_gt]                              # [R,P,V,2]
+
+        def poly_mask(poly, qx, qy):
+            # even-odd crossing count over the poly's finite vertices
+            vx, vy = poly[:, 0], poly[:, 1]
+            ok = jnp.isfinite(vx) & jnp.isfinite(vy)
+            nv = ok.sum()
+            idx = jnp.arange(vmax)
+            nxt = jnp.where(idx + 1 >= nv, 0, idx + 1)
+            xs_, ys_ = vx, vy
+            xe_, ye_ = vx[nxt], vy[nxt]
+            live = (idx < nv)[:, None, None]
+            ysb = ys_[:, None, None]
+            yeb = ye_[:, None, None]
+            xsb = xs_[:, None, None]
+            xeb = xe_[:, None, None]
+            non_h = jnp.abs(yeb - ysb) > 1e-12
+            t = (qy[None] - ysb) / jnp.where(non_h, yeb - ysb, 1.0)
+            ix = xsb + t * (xeb - xsb)
+            hit = live & non_h & (t >= 0) & (t < 1) & (ix > qx[None])
+            return (hit.sum(0) % 2) == 1
+
+        def roi_mask(pl, qx, qy):
+            any_poly = jnp.zeros((res, res), bool)
+            for j in range(p):
+                any_poly = any_poly | poly_mask(pl[j], qx, qy)
+            return any_poly
+
+        masks = jax.vmap(roi_mask)(polys, px, py)           # [R,res,res]
+        has_any_gt = gt_valid.any()
+        mask_rows = jnp.where(fg_sorted[:, None, None] & has_any_gt,
+                              masks, False)
+        # expand to class slots (-1 elsewhere / on non-fg rows)
+        cls = jnp.clip(labels_s, 0, ncls - 1)               # [R]
+        expanded = jnp.full((r, ncls, res * res), -1, jnp.int32)
+        expanded = expanded.at[jnp.arange(r), cls].set(
+            mask_rows.reshape(r, res * res).astype(jnp.int32))
+        expanded = jnp.where(fg_sorted[:, None, None], expanded, -1)
+        mask_rois = jnp.where(fg_sorted[:, None], rois_s * im_scale, 0.0)
+        roi_has_mask = jnp.where(fg_sorted, order, -1).astype(jnp.int32)
+        return (mask_rois, roi_has_mask,
+                expanded.reshape(r, ncls * res * res),
+                fg.sum().astype(jnp.int32))
+
+    def f(info, gtc, crowd, segms, rois_v, labels, gn):
+        return jax.vmap(one)(info, gtc, crowd, segms, rois_v, labels, gn)
+
+    import numpy as _np
+
+    if gt_num is None:
+        gt_num = _np.full((unwrap(gt_classes).shape[0],),
+                          unwrap(gt_classes).shape[1], _np.int32)
+    return dispatch(f, im_info, gt_classes, is_crowd, gt_segms, rois,
+                    labels_int32, gt_num,
+                    nondiff=(0, 1, 2, 3, 4, 5, 6))

@@ -1,0 +1,347 @@
+"""Industrial/niche long-tail ops (paddle_tpu/ops/industrial.py +
+the round-3 detection additions) vs numpy references — closes the final
+DESCOPED batch from the op inventory."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+
+def _np_of(t):
+    return np.asarray(t.numpy())
+
+
+class TestTdmOps:
+    def _tree(self):
+        # nodes 0..6: 0 pad; 1=root(children 2,3); 2(children 4,5);
+        # 3(child 6); leaves 4,5,6 are items
+        # rows: [item_id, layer_id, ancestor_id, child0, child1]
+        info = np.array([
+            [0, 0, 0, 0, 0],
+            [0, 0, 0, 2, 3],
+            [0, 1, 1, 4, 5],
+            [0, 1, 1, 6, 0],
+            [4, 2, 2, 0, 0],
+            [5, 2, 2, 0, 0],
+            [6, 2, 3, 0, 0],
+        ], np.int64)
+        return info
+
+    def test_tdm_child(self):
+        info = self._tree()
+        x = paddle.to_tensor(np.array([[1], [2], [4], [0]], np.int64))
+        child, mask = ops.tdm_child(x, paddle.to_tensor(info),
+                                    child_nums=2)
+        np.testing.assert_array_equal(
+            _np_of(child), [[2, 3], [4, 5], [0, 0], [0, 0]])
+        # node1's children 2,3 are internal (item_id 0) -> mask 0;
+        # node2's children 4,5 are items -> mask 1
+        np.testing.assert_array_equal(
+            _np_of(mask), [[0, 0], [1, 1], [0, 0], [0, 0]])
+
+    def test_tdm_sampler(self):
+        # travel paths per leaf id (row = leaf node id), layers = 2
+        travel = np.zeros((7, 2), np.int64)
+        travel[4] = [2, 4]
+        travel[5] = [2, 5]
+        travel[6] = [3, 6]
+        # layer node lists: layer0 = [2, 3], layer1 = [4, 5, 6]
+        layer = np.array([2, 3, 4, 5, 6], np.int64).reshape(-1, 1)
+        x = paddle.to_tensor(np.array([[4], [6], [0]], np.int64))
+        out, labels, mask = ops.tdm_sampler(
+            x, paddle.to_tensor(travel), paddle.to_tensor(layer),
+            neg_samples_num_list=[1, 2], layer_offset_lod=[0, 2, 5],
+            output_positive=True, seed=0)
+        o, l, m = _np_of(out), _np_of(labels), _np_of(mask)
+        assert o.shape == (3, 5)  # (1+1) + (1+2)
+        # row 0 (leaf 4): positives 2 then 4 at slots 0 and 2
+        assert o[0, 0] == 2 and o[0, 2] == 4
+        assert l[0, 0] == 1 and l[0, 2] == 1
+        # negatives differ from positives and come from the right layer
+        assert o[0, 1] == 3                   # only other layer-0 node
+        assert set(o[0, 3:]) == {5, 6}        # layer-1 minus positive
+        assert l[0, 1] == 0 and not l[0, 3:].any()
+        # padding input id 0 -> all masked
+        assert not m[2].any() and not o[2].any()
+
+
+class TestRankAttention:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        n, d, p, k = 4, 3, 5, 2
+        x = rng.randn(n, d).astype(np.float32)
+        param = rng.randn(k * k * d, p).astype(np.float32)
+        # rank_offset rows: [rank, faster_0, index_0, faster_1, index_1]
+        ro = np.array([
+            [1, 1, 0, 2, 1],
+            [2, 1, 2, 0, 0],     # faster_1 = 0 -> invalid slot
+            [0, 1, 1, 1, 2],     # rank 0 -> whole row invalid
+            [2, 2, 3, 1, 0],
+        ], np.int32)
+        out, ih, ins_rank = ops.rank_attention(
+            paddle.to_tensor(x), paddle.to_tensor(ro),
+            paddle.to_tensor(param), max_rank=k)
+        want = np.zeros((n, p), np.float32)
+        par3 = param.reshape(k * k, d, p)
+        for i in range(n):
+            lower = ro[i, 0] - 1
+            for kk in range(k):
+                faster = ro[i, 1 + 2 * kk] - 1
+                idx = ro[i, 2 + 2 * kk]
+                if lower < 0 or faster < 0:
+                    continue
+                want[i] += x[idx] @ par3[lower * k + faster]
+        np.testing.assert_allclose(_np_of(out), want, rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(_np_of(ins_rank).ravel(),
+                                   ro[:, 0].astype(np.float32))
+
+
+class TestMatchMatrixVarConv:
+    def test_match_matrix_tensor(self):
+        rng = np.random.RandomState(1)
+        b, lx, ly, d, dt = 2, 4, 3, 5, 2
+        x = rng.randn(b, lx, d).astype(np.float32)
+        y = rng.randn(b, ly, d).astype(np.float32)
+        w = rng.randn(d, dt, d).astype(np.float32)
+        xl = np.array([4, 2], np.int32)
+        yl = np.array([3, 1], np.int32)
+        out, tmp = ops.match_matrix_tensor(
+            paddle.to_tensor(x), paddle.to_tensor(y), paddle.to_tensor(w),
+            paddle.to_tensor(xl), paddle.to_tensor(yl), dim_t=dt)
+        want = np.einsum("bid,dte,bje->btij", x, w, y)
+        for bb in range(b):
+            want[bb, :, xl[bb]:, :] = 0
+            want[bb, :, :, yl[bb]:] = 0
+        np.testing.assert_allclose(_np_of(out), want, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_var_conv_2d(self):
+        rng = np.random.RandomState(2)
+        b, cin, cout, hm, wm, kh, kw = 2, 2, 3, 6, 5, 3, 3
+        x = rng.randn(b, cin, hm, wm).astype(np.float32)
+        w = rng.randn(cout, cin * kh * kw).astype(np.float32)
+        rl = np.array([6, 4], np.int32)
+        cl = np.array([5, 3], np.int32)
+        out = ops.var_conv_2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                              paddle.to_tensor(rl), paddle.to_tensor(cl),
+                              input_channel=cin, output_channel=cout,
+                              kernel_h=kh, kernel_w=kw)
+        got = _np_of(out)
+        # reference semantics per sample: own-size image, zero border
+        # padding, out = ceil(size/stride)
+        assert got.shape == (b, cout, hm, wm)
+        ker = w.reshape(cout, cin, kh, kw)
+        for bb in range(b):
+            h, wd = rl[bb], cl[bb]
+            img = x[bb, :, :h, :wd]
+            padded = np.zeros((cin, h + kh - 1, wd + kw - 1), np.float32)
+            padded[:, (kh - 1) // 2:(kh - 1) // 2 + h,
+                   (kw - 1) // 2:(kw - 1) // 2 + wd] = img
+            for oc in range(cout):
+                for i in range(h):
+                    for j in range(wd):
+                        win = padded[:, i:i + kh, j:j + kw]
+                        want = (win * ker[oc]).sum()
+                        np.testing.assert_allclose(got[bb, oc, i, j],
+                                                   want, rtol=1e-4,
+                                                   atol=1e-4)
+            # beyond the sample's own region: zero
+            assert not got[bb, :, h:, :].any()
+            assert not got[bb, :, :, wd:].any()
+
+
+class TestFilterByInstag:
+    def test_compaction(self):
+        ins = np.arange(12, dtype=np.float32).reshape(4, 3)
+        tags = np.array([[1, -1], [2, 3], [4, -1], [3, 1]], np.int64)
+        ftag = np.array([3], np.int64)
+        out, lw, idx = ops.filter_by_instag(
+            paddle.to_tensor(ins), paddle.to_tensor(tags),
+            paddle.to_tensor(ftag), out_val_if_empty=7)
+        # rows 1 and 3 kept, compacted to front
+        np.testing.assert_allclose(_np_of(out)[:2],
+                                   ins[[1, 3]])
+        assert (_np_of(out)[2:] == 7).all()
+        np.testing.assert_allclose(_np_of(lw).ravel(), [1, 1, 0, 0])
+        np.testing.assert_array_equal(_np_of(idx), [1, 3, -1, -1])
+
+
+class TestTreeConv:
+    def test_two_level_tree(self):
+        # tree: 1 -> (2, 3); max_depth=2
+        rng = np.random.RandomState(3)
+        n, fdim, osz, nf = 3, 4, 2, 2
+        feat = rng.randn(1, n, fdim).astype(np.float32)
+        edges = np.array([[[1, 2], [1, 3], [0, 0]]], np.int32)
+        filt = rng.randn(fdim, 3, osz, nf).astype(np.float32)
+        out = ops.tree_conv(paddle.to_tensor(feat),
+                            paddle.to_tensor(edges),
+                            paddle.to_tensor(filt), max_depth=2)
+        got = _np_of(out)
+        assert got.shape == (1, n, osz, nf)
+
+        md = 2.0
+        def etas(depth, idx1, pclen):
+            eta_t = (md - depth) / md
+            tmp = 0.5 if pclen == 1 else (idx1 - 1.0) / (pclen - 1.0)
+            eta_l = (1 - eta_t) * tmp
+            eta_r = (1 - eta_t) * (1 - eta_l)
+            return eta_t, eta_l, eta_r
+        # patch of node 1 = {1 at depth0} + {2,3 at depth1}
+        pt = np.zeros((n, fdim, 3), np.float32)
+        for u, members in {0: [(0, 0, 1, 1), (1, 1, 1, 2), (2, 1, 2, 2)],
+                           1: [(1, 0, 1, 1)],
+                           2: [(2, 0, 1, 1)]}.items():
+            for (v, depth, idx1, pclen) in members:
+                et, el, er = etas(depth, idx1, pclen)
+                pt[u, :, 0] += el * feat[0, v]
+                pt[u, :, 1] += er * feat[0, v]
+                pt[u, :, 2] += et * feat[0, v]
+        want = np.einsum("nfk,fkom->nom", pt, filt)
+        np.testing.assert_allclose(got[0], want, rtol=1e-4, atol=1e-5)
+
+
+class TestPyramidHash:
+    def test_shapes_mask_and_determinism(self):
+        rng = np.random.RandomState(4)
+        b, t, num_emb, rand_len, space = 2, 5, 8, 4, 64
+        x = rng.randint(1, 50, (b, t)).astype(np.int32)
+        w = rng.randn(space + rand_len).astype(np.float32)
+        lens = np.array([5, 3], np.int32)
+        out, mask = ops.pyramid_hash(
+            paddle.to_tensor(x), paddle.to_tensor(w),
+            paddle.to_tensor(lens), num_emb=num_emb, space_len=space,
+            pyramid_layer=3, rand_len=rand_len)
+        o, m = _np_of(out), _np_of(mask)
+        assert o.shape == (b, t, 2, num_emb)    # n-gram lens 2 and 3
+        # mask: bigrams valid while t+2 <= len
+        np.testing.assert_array_equal(m[0, :, 0], [1, 1, 1, 1, 0])
+        np.testing.assert_array_equal(m[1, :, 0], [1, 1, 0, 0, 0])
+        np.testing.assert_array_equal(m[1, :, 1], [1, 0, 0, 0, 0])
+        assert not o[1, 2:, 0].any()            # masked -> zeros
+        # identical n-grams hash identically
+        x2 = x.copy()
+        x2[1, :2] = x[0, :2]
+        out2, _ = ops.pyramid_hash(
+            paddle.to_tensor(x2), paddle.to_tensor(w),
+            paddle.to_tensor(lens), num_emb=num_emb, space_len=space,
+            pyramid_layer=3, rand_len=rand_len)
+        np.testing.assert_allclose(_np_of(out2)[1, 0, 0], o[0, 0, 0])
+
+
+class TestLstmpSampleLogits:
+    def test_lstmp_projection(self):
+        rng = np.random.RandomState(5)
+        b, t, d, p = 2, 4, 3, 2
+        x = rng.randn(b, t, 4 * d).astype(np.float32) * 0.5
+        w = rng.randn(p, 4 * d).astype(np.float32) * 0.3
+        pw = rng.randn(d, p).astype(np.float32) * 0.3
+        proj, cell = ops.lstmp(paddle.to_tensor(x), paddle.to_tensor(w),
+                               paddle.to_tensor(pw), use_peepholes=False)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        r = np.zeros((b, p), np.float32)
+        c = np.zeros((b, d), np.float32)
+        want_r, want_c = [], []
+        for step in range(t):
+            g = x[:, step] + r @ w
+            gc, gi, gf, go = np.split(g, 4, -1)
+            i, f, o = sig(gi), sig(gf), sig(go)
+            c = f * c + i * np.tanh(gc)
+            h = o * np.tanh(c)
+            r = np.tanh(h @ pw)
+            want_r.append(r.copy())
+            want_c.append(c.copy())
+        np.testing.assert_allclose(_np_of(proj), np.stack(want_r, 1),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_np_of(cell), np.stack(want_c, 1),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sample_logits_customized(self):
+        rng = np.random.RandomState(6)
+        n, v, t, s = 3, 20, 1, 4
+        logits = rng.randn(n, v).astype(np.float32)
+        labels = rng.randint(0, v, (n, t)).astype(np.int64)
+        samples = np.array([1, 5, labels[0, 0], 9], np.int64)
+        probs = np.full((s,), 0.05, np.float32)
+        out, new_labels = ops.sample_logits(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            num_samples=s, use_customized_samples=True,
+            customized_samples=paddle.to_tensor(samples),
+            customized_probabilities=paddle.to_tensor(probs))
+        o = _np_of(out)
+        assert o.shape == (n, t + s)
+        # true logit corrected by its log-uniform expected count
+        true_p = np.log((labels + 2.0) / (labels + 1.0)) / np.log(v + 1.0)
+        want_true = np.take_along_axis(logits, labels, 1) - \
+            np.log(true_p * s + 1e-20)
+        np.testing.assert_allclose(o[:, :t], want_true, rtol=1e-4)
+        # accidental hit (sample == row 0's true label) masked
+        assert o[0, t + 2] < -1e19
+        assert o[1, t + 2] > -1e19 or samples[2] == labels[1, 0]
+        np.testing.assert_array_equal(_np_of(new_labels),
+                                      np.zeros((n, t), np.int64))
+
+
+class TestRoiPerspectiveTransform:
+    def test_identity_rect(self):
+        from paddle_tpu.vision import detection as vdet
+
+        rng = np.random.RandomState(7)
+        x = rng.rand(1, 1, 8, 8).astype(np.float32)
+        # axis-aligned rect quad (1,1)-(6,1)-(6,6)-(1,6): the transform
+        # becomes a plain resize/crop; sample centers land on integers
+        rois = np.array([[1, 1, 6, 1, 6, 6, 1, 6]], np.float32)
+        out, mask, mat = vdet.roi_perspective_transform(
+            paddle.to_tensor(x), paddle.to_tensor(rois), 6, 6,
+            spatial_scale=1.0)
+        got = _np_of(out)
+        m = _np_of(mask)
+        assert got.shape == (1, 1, 6, 6)
+        # interior pixels equal the source crop (x maps 1..6 over 6 cols)
+        for i in range(1, 5):
+            for j in range(1, 5):
+                np.testing.assert_allclose(
+                    got[0, 0, i, j], x[0, 0, 1 + i, 1 + j], rtol=1e-4)
+        assert m[0, 0, 2, 2] == 1
+
+    def test_outside_mask(self):
+        from paddle_tpu.vision import detection as vdet
+
+        x = np.ones((1, 1, 4, 4), np.float32)
+        rois = np.array([[10, 10, 13, 10, 13, 13, 10, 13]], np.float32)
+        out, mask, _ = vdet.roi_perspective_transform(
+            paddle.to_tensor(x), paddle.to_tensor(rois), 4, 4)
+        assert not _np_of(out).any()
+        assert not _np_of(mask).any()
+
+
+class TestGenerateMaskLabels:
+    def test_square_polygon(self):
+        from paddle_tpu.vision import detection as vdet
+
+        res, ncls = 4, 3
+        im_info = np.array([[32, 32, 1.0]], np.float32)
+        gt_classes = np.array([[2]], np.int32)
+        is_crowd = np.array([[0]], np.int32)
+        # one square polygon (4,4)-(12,4)-(12,12)-(4,12)
+        segms = np.full((1, 1, 1, 8, 2), np.nan, np.float32)
+        segms[0, 0, 0, :4] = [[4, 4], [12, 4], [12, 12], [4, 12]]
+        rois = np.array([[[4, 4, 12, 12], [0, 0, 2, 2]]], np.float32)
+        labels = np.array([[2, 0]], np.int32)
+        mask_rois, has_mask, mask, counts = vdet.generate_mask_labels(
+            paddle.to_tensor(im_info), paddle.to_tensor(gt_classes),
+            paddle.to_tensor(is_crowd), paddle.to_tensor(segms),
+            paddle.to_tensor(rois), paddle.to_tensor(labels),
+            num_classes=ncls, resolution=res)
+        assert int(_np_of(counts)[0]) == 1
+        np.testing.assert_array_equal(_np_of(has_mask)[0], [0, -1])
+        m = _np_of(mask).reshape(1, 2, ncls, res, res)
+        # fg roi == polygon box: the class-2 slot is all ones
+        np.testing.assert_array_equal(m[0, 0, 2], np.ones((res, res)))
+        # other class slots are -1, non-fg row all -1
+        assert (m[0, 0, 0] == -1).all() and (m[0, 0, 1] == -1).all()
+        assert (m[0, 1] == -1).all()
+        np.testing.assert_allclose(_np_of(mask_rois)[0, 0],
+                                   [4, 4, 12, 12])

@@ -375,3 +375,198 @@ class TestShardedPS:
         c2.close()
         for s in new_servers:
             s.stop()
+
+
+class TestSSDSparseTable:
+    """reference `distributed/table/ssd_sparse_table.cc`: tables larger
+    than the memory budget spill to disk, keep training correctly, and
+    survive a save/restart/load cycle."""
+
+    def test_spill_beyond_budget_and_restart(self, tmp_path):
+        from paddle_tpu.distributed.ps import PSClient, PSServer
+
+        dim, budget, n_rows = 4, 8, 64
+        spill = str(tmp_path / "table2.spill")
+        snap = str(tmp_path / "ps.snap")
+
+        srv = PSServer()
+        srv.create_sparse_table_ssd(0, dim=dim, mem_budget_rows=budget,
+                                    spill_path=spill, lr=0.5,
+                                    optimizer="sgd")
+        port = srv.start(0, n_trainers=1)
+        cli = PSClient(port=port)
+        try:
+            ids = np.arange(1, n_rows + 1, dtype=np.uint64)
+            # push distinct grads row by row (well beyond the budget)
+            for i, rid in enumerate(ids):
+                g = np.full((1, dim), float(i + 1), np.float32)
+                cli.push_sparse_grad(0, np.array([rid], np.uint64), g)
+            # every row is readable back (spilled ones fault in) with
+            # the sgd update applied: row = -lr * grad
+            got = cli.pull_sparse(0, ids, dim)
+            want = -0.5 * np.arange(1, n_rows + 1,
+                                    dtype=np.float32)[:, None] * \
+                np.ones((1, dim), np.float32)
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+            # the spill file actually holds the overflow
+            import os
+
+            assert os.path.exists(spill)
+            assert os.path.getsize(spill) > 0
+            cli.save_tables(snap)
+        finally:
+            cli.stop_server()
+            time.sleep(0.1)
+            srv.stop()
+
+        # restart: fresh server, same SSD config, load the snapshot
+        srv2 = PSServer()
+        srv2.create_sparse_table_ssd(0, dim=dim, mem_budget_rows=budget,
+                                     spill_path=spill, lr=0.5,
+                                     optimizer="sgd")
+        srv2.load(snap)
+        port2 = srv2.start(0, n_trainers=1)
+        cli2 = PSClient(port=port2)
+        try:
+            got2 = cli2.pull_sparse(0, ids, dim)
+            want2 = -0.5 * np.arange(1, n_rows + 1,
+                                     dtype=np.float32)[:, None] * \
+                np.ones((1, dim), np.float32)
+            np.testing.assert_allclose(got2, want2, rtol=1e-6)
+        finally:
+            cli2.stop_server()
+            time.sleep(0.1)
+            srv2.stop()
+
+
+def _sample_hash_np(seed, node, j):
+    """numpy replay of the server's SampleHash (splitmix64 finalizer) —
+    python ints with explicit 64-bit wrapping."""
+    mask = (1 << 64) - 1
+    h = (seed * 0x9E3779B97F4A7C15) & mask
+    h ^= (node + 0xD1B54A32D192ED03 + ((h << 6) & mask) + (h >> 2)) & mask
+    h ^= ((j * 0x94D049BB133111EB) & mask) + ((h << 6) & mask) + (h >> 2)
+    h &= mask
+    h ^= h >> 33
+    h = (h * 0xFF51AFD7ED558CCD) & mask
+    h ^= h >> 33
+    return h & 0xFFFFFFFF
+
+
+class TestGraphTable:
+    """reference `distributed/table/common_graph_table.cc` +
+    `graph_brpc_server.cc` — GNN neighbor sampling over the PS."""
+
+    def _start(self, feat_dim=3):
+        from paddle_tpu.distributed.ps import PSClient, PSServer
+
+        srv = PSServer()
+        srv.create_graph_table(0, feat_dim=feat_dim)
+        port = srv.start(0, n_trainers=1)
+        return srv, PSClient(port=port)
+
+    def test_full_neighborhood_and_feats(self):
+        srv, cli = self._start()
+        try:
+            src = np.array([1, 1, 1, 2], np.uint64)
+            dst = np.array([10, 11, 12, 20], np.uint64)
+            cli.add_graph_edges(0, src, dst)
+            # sample_size >= degree returns the whole neighborhood
+            nbrs, counts = cli.sample_neighbors(
+                0, np.array([1, 2, 3], np.uint64), sample_size=5)
+            assert counts.tolist() == [3, 1, 0]
+            assert set(nbrs[0, :3].tolist()) == {10, 11, 12}
+            assert nbrs[1, 0] == 20
+            feats = np.array([[1, 2, 3], [4, 5, 6]], np.float32)
+            cli.set_node_feat(0, np.array([10, 20], np.uint64), feats)
+            got = cli.get_node_feat(
+                0, np.array([10, 99, 20], np.uint64), dim=3)
+            np.testing.assert_allclose(got[0], [1, 2, 3])
+            np.testing.assert_allclose(got[1], [0, 0, 0])
+            np.testing.assert_allclose(got[2], [4, 5, 6])
+        finally:
+            cli.stop_server()
+            time.sleep(0.1)
+            srv.stop()
+
+    def test_sampling_parity_with_numpy(self):
+        """The weighted sample must equal the numpy replay of the
+        documented Efraimidis-Spirakis draw (deterministic hash keys)."""
+        srv, cli = self._start()
+        try:
+            deg = 10
+            node = 7
+            dst = np.arange(100, 100 + deg, dtype=np.uint64)
+            w = np.linspace(0.5, 5.0, deg).astype(np.float32)
+            cli.add_graph_edges(0, np.full(deg, node, np.uint64), dst, w)
+            seed, k = 42, 4
+            nbrs, counts = cli.sample_neighbors(
+                0, np.array([node], np.uint64), sample_size=k, seed=seed)
+            assert counts[0] == k
+            # numpy replay
+            keys = []
+            for j in range(deg):
+                u = (float(_sample_hash_np(seed, node, j)) + 1.0) / 2**32
+                keys.append((-(u ** (1.0 / float(w[j]))), j))
+            keys.sort()
+            want = [int(dst[j]) for _, j in keys[:k]]
+            assert nbrs[0, :k].tolist() == want
+        finally:
+            cli.stop_server()
+            time.sleep(0.1)
+            srv.stop()
+
+    def test_graph_survives_snapshot(self, tmp_path):
+        srv, cli = self._start()
+        snap = str(tmp_path / "g.snap")
+        try:
+            cli.add_graph_edges(0, np.array([5], np.uint64),
+                                np.array([6], np.uint64))
+            cli.set_node_feat(0, np.array([5], np.uint64),
+                              np.array([[9, 9, 9]], np.float32))
+            cli.save_tables(snap)
+        finally:
+            cli.stop_server()
+            time.sleep(0.1)
+            srv.stop()
+        from paddle_tpu.distributed.ps import PSClient, PSServer
+
+        srv2 = PSServer()
+        srv2.create_graph_table(0, feat_dim=3)
+        srv2.load(snap)
+        port = srv2.start(0, n_trainers=1)
+        cli2 = PSClient(port=port)
+        try:
+            nbrs, counts = cli2.sample_neighbors(
+                0, np.array([5], np.uint64), sample_size=2)
+            assert counts[0] == 1 and nbrs[0, 0] == 6
+            np.testing.assert_allclose(
+                cli2.get_node_feat(0, np.array([5], np.uint64), 3)[0],
+                [9, 9, 9])
+        finally:
+            cli2.stop_server()
+            time.sleep(0.1)
+            srv2.stop()
+
+
+class TestHeterService:
+    """reference heter_client.cc/heter_server.cc: offload a named dense
+    section to a peer process service."""
+
+    def test_roundtrip_and_error(self):
+        from paddle_tpu.distributed.ps import HeterClient, HeterServer
+
+        srv = HeterServer()
+        srv.register("dense_fwd", lambda x, w: x @ w + 1.0)
+        port = srv.start()
+        cli = HeterClient(port=port)
+        try:
+            x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+            w = np.random.RandomState(1).rand(4, 2).astype(np.float32)
+            out = cli.run("dense_fwd", x, w)
+            np.testing.assert_allclose(out, x @ w + 1.0, rtol=1e-6)
+            with pytest.raises(RuntimeError, match="missing"):
+                cli.run("missing", x)
+        finally:
+            cli.close()
+            srv.stop()

@@ -183,7 +183,15 @@ ALIASES = {
     "softmax": "F:softmax",
     "lstm": "nn:LSTM", "gru": "nn:GRU", "rnn": "nn:SimpleRNN",
     "cudnn_lstm": "nn:LSTM", "lstm_unit": "nn:LSTMCell",
-    "lstmp": "nn:LSTM", "gru_unit": "nn:GRUCell",
+    "lstmp": "ops:lstmp",
+    "tree_conv": "ops:tree_conv", "tdm_child": "ops:tdm_child",
+    "tdm_sampler": "ops:tdm_sampler", "pyramid_hash": "ops:pyramid_hash",
+    "rank_attention": "ops:rank_attention",
+    "match_matrix_tensor": "ops:match_matrix_tensor",
+    "var_conv_2d": "ops:var_conv_2d",
+    "filter_by_instag": "ops:filter_by_instag",
+    "roi_perspective_transform": "vdet:roi_perspective_transform",
+    "generate_mask_labels": "vdet:generate_mask_labels", "gru_unit": "nn:GRUCell",
     "recurrent": "nn:RNN",
     "beam_search": "nn:BeamSearchDecoder",
     "beam_search_decode": "nn:BeamSearchDecoder",
@@ -230,7 +238,7 @@ ALIASES = {
     "modified_huber_loss": "F:smooth_l1_loss",
     "bpr_loss": "F:cross_entropy",
     "center_loss": "F:mse_loss",
-    "sample_logits": "F:softmax_with_cross_entropy",
+    "sample_logits": "ops:sample_logits",
     "sampling_id": "paddle:multinomial",
     "seed": "paddle:seed",
     "shard_index": "ops:shard_index",
@@ -256,6 +264,11 @@ ALIASES = {
     "pull_sparse_v2": "ps:PSClient.pull_sparse",
     "push_sparse": "ps:PSClient.push_sparse_grad",
     "push_sparse_v2": "ps:PSClient.push_sparse_grad",
+    "pull_box_sparse": "ps:PSClient.pull_sparse",
+    "pull_box_extended_sparse": "ps:PSClient.pull_sparse",
+    "push_box_sparse": "ps:PSClient.push_sparse_grad",
+    "push_box_extended_sparse": "ps:PSClient.push_sparse_grad",
+    "heter_listen_and_serv": "ps:HeterServer",
     "push_dense": "ps:PSClient.push_dense_grad",
     "send": "ps:Communicator", "listen_and_serv": "ps:PSServer",
     "send_barrier": "ps:PSClient.barrier",
@@ -375,30 +388,13 @@ TPU_OBSOLETE = {
     # p2p -> collective-permute inside compiled step
     "send_v2": "ppermute", "recv_v2": "ppermute",
     "partial_concat": "sharded activations", "partial_sum": "sharded acts",
-    # heter/box PS GPU-cache path (CPU+GPU heterogeneous serving)
-    "heter_listen_and_serv": "descoped heter-PS",
-    "pull_box_sparse": "descoped box-PS",
-    "pull_box_extended_sparse": "descoped box-PS",
-    "push_box_sparse": "descoped box-PS",
-    "push_box_extended_sparse": "descoped box-PS",
 }
 
 # fake-quant family: covered as a family by paddle_tpu/quantization
 QUANT_FAMILY = {n for n in OPS if n.startswith("fake_")}
 
-# remaining deliberate descopes (niche, with reasons) — kept visibly small
-DESCOPED = {
-    "tree_conv": "tree-structured NN (niche)",
-    "tdm_child": "tree-based deep match (industrial PS)",
-    "tdm_sampler": "tree-based deep match (industrial PS)",
-    "pyramid_hash": "industrial sparse hash embedding",
-    "rank_attention": "industrial CTR op",
-    "match_matrix_tensor": "text matching (niche)",
-    "var_conv_2d": "variable-size conv over LoD (niche)",
-    "filter_by_instag": "industrial instance-tag filter",
-    "roi_perspective_transform": "OCR-specific geometric op",
-    "generate_mask_labels": "Mask-RCNN train-time assigner",
-}
+# remaining deliberate descopes — none (round 3 closed the list)
+DESCOPED = {}
 
 
 def resolve(name: str):
