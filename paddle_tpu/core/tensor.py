@@ -112,6 +112,15 @@ class Tensor:
     def __int__(self):
         return int(self._array)
 
+    def __index__(self):
+        # lets `range(n_tensor)` work eagerly for size-1 tensors; under a
+        # trace the int() of a tracer raises ConcretizationTypeError,
+        # which jit.to_static catches to trigger the dy2static AST
+        # fallback
+        if self._array.size != 1:
+            raise TypeError("only size-1 tensors convert to an index")
+        return int(self._array.reshape(()))
+
     def __float__(self):
         return float(self._array)
 

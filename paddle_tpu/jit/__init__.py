@@ -101,7 +101,26 @@ class StaticFunction:
         )
         entry = self._compiled.get(sig)
         if entry is None:
-            entry = self._build(args, kwargs, params, buffers, pnames, bnames)
+            try:
+                entry = self._build(args, kwargs, params, buffers, pnames,
+                                    bnames)
+            except jax.errors.ConcretizationTypeError as e:
+                # data-dependent Python control flow (`if tensor:` /
+                # tensor-bounded loop): fall back to the AST pass that
+                # lowers it onto ops.cond/while_loop (reference
+                # ProgramTranslator, dygraph_to_static/
+                # program_translator.py:759), then retrace
+                from .dy2static import ast_transform
+
+                transformed = ast_transform(self._function)
+                if transformed is None:
+                    raise
+                self._function = transformed
+                try:
+                    entry = self._build(args, kwargs, params, buffers,
+                                        pnames, bnames)
+                except jax.errors.ConcretizationTypeError:
+                    raise e from None
             self._compiled[sig] = entry
         jitted, buf_targets = entry
 

@@ -1,0 +1,373 @@
+"""Dygraph-to-static AST fallback for data-dependent Python control flow.
+
+Reference: the ProgramTranslator's transformer stack
+(`fluid/dygraph/dygraph_to_static/program_translator.py:759`, ~15 AST
+transformers).  The TPU build's `jit.to_static` is trace-based (SURVEY §7
+sanctioned): Python control flow on *concrete* values folds into the
+trace for free.  What tracing cannot do is branch/loop on a TRACED
+tensor — `if tensor:` raises a jax concretization error.  This module is
+the fallback for exactly that case: a minimal AST pass that rewrites
+
+* ``if <tensor>: ... else: ...``     -> ``ops.cond`` over branch closures
+* ``while <tensor-cond>: ...``       -> ``ops.while_loop`` over loop vars
+* ``for i in range(<tensor-n>): ...``-> counter ``while`` (then as above)
+
+`StaticFunction` retries a failed trace through `maybe_transform` — so
+the AST pass only ever runs for functions that actually need it, and
+programs that trace cleanly keep the pure-trace path.
+
+Scope (documented constraints, mirroring the XLA requirements):
+branches/loops containing ``return``/``break``/``continue`` or
+``try``/``with`` are left unrewritten; loop-carried variables must be
+defined before the loop and keep loop-invariant shapes/dtypes.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable, List, Optional, Set
+
+
+class _Undef:
+    """Sentinel for names not yet bound before a rewritten `if` (they
+    must then be assigned by the taken branch before any later read)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+_PT_UNDEF = _Undef()
+
+
+def _pt_if(pred, true_fn, false_fn, operands):
+    from ..ops import control_flow as cf
+
+    return cf.cond(pred, lambda: true_fn(*operands),
+                   lambda: false_fn(*operands))
+
+
+def _pt_while(cond_fn, body_fn, init):
+    from ..ops import control_flow as cf
+
+    out = cf.while_loop(cond_fn, body_fn, list(init))
+    return tuple(out)
+
+
+class _Assigned(ast.NodeVisitor):
+    """Names bound by statements (assign targets, aug-assign, for
+    targets) — NOT descending into nested function/class defs."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def _target(self, t):
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)
+
+    def visit_AsyncFunctionDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned_names(stmts: List[ast.stmt]) -> Set[str]:
+    v = _Assigned()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+def _loaded_names(nodes) -> Set[str]:
+    out: Set[str] = set()
+    for n in nodes if isinstance(nodes, list) else [nodes]:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                out.add(sub.id)
+    return out
+
+
+def _has_flow_escape(stmts: List[ast.stmt]) -> bool:
+    """Return/break/continue/try/with anywhere in the (non-nested-def)
+    statement tree — constructs the rewrite cannot represent."""
+    for s in stmts:
+        for sub in ast.walk(s):
+            if isinstance(sub, (ast.Return, ast.Break, ast.Continue,
+                                ast.Try, ast.With, ast.Yield,
+                                ast.YieldFrom)):
+                return True
+    return False
+
+
+class _ControlFlowRewriter(ast.NodeTransformer):
+    """Rewrites If/While/For-range statements inside a function body.
+
+    Generated branch/body closures take the mutated names as PARAMETERS
+    (current values snapshotted at the call): under a traced cond both
+    branches execute, so writes from one branch must not leak into the
+    other's trace; the merged values come back through the helper's
+    return tuple."""
+
+    def __init__(self):
+        super().__init__()
+        self._uid = 0
+        # statements following the node being rewritten, per nesting
+        # level — used to decide which while-assigned names must be
+        # carried out of the loop
+        self._after_stack: List[List[ast.stmt]] = []
+
+    def _fresh(self, tag):
+        self._uid += 1
+        return f"_pt_{tag}_{self._uid}"
+
+    @staticmethod
+    def _undef_guard(name):
+        """try: name / except NameError: name = _PT_UNDEF — lets the
+        operand tuple evaluate when the name is first bound inside the
+        rewritten block (matching Python, a later real read of an
+        undefined result still fails, just less precisely)."""
+        return ast.Try(
+            body=[ast.Expr(value=ast.Name(id=name, ctx=ast.Load()))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Name(id="NameError", ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[ast.Name(id=name, ctx=ast.Store())],
+                    value=ast.Name(id="_PT_UNDEF", ctx=ast.Load()))])],
+            orelse=[], finalbody=[])
+
+    def _rewrite_body(self, stmts, after):
+        self._after_stack.append(after)
+        out = []
+        for i, s in enumerate(stmts):
+            self._after_stack[-1] = stmts[i + 1:] + after
+            r = self.visit(s)
+            out.extend(r if isinstance(r, list) else [r])
+        self._after_stack.pop()
+        return out
+
+    # -- function roots ------------------------------------------------------
+    def visit_FunctionDef(self, node):
+        node.body = self._rewrite_body(node.body, [])
+        return node
+
+    # -- if on a (possibly) traced tensor ------------------------------------
+    def visit_If(self, node):
+        after = list(self._after_stack[-1]) if self._after_stack else []
+        body = self._rewrite_body(node.body, after)
+        orelse = self._rewrite_body(node.orelse, after)
+        if _has_flow_escape(body) or _has_flow_escape(orelse):
+            node.body, node.orelse = body, orelse
+            return node
+        # carry only the mutated names that are READ after the if (the
+        # test already ran); branch-local temporaries stay local to their
+        # branch closure — carrying them would hand the other branch a
+        # _PT_UNDEF it cannot return through lax.cond
+        assigned = _assigned_names(body) | _assigned_names(orelse)
+        names = sorted(assigned & _loaded_names(after))
+        tf_name, ff_name = self._fresh("true"), self._fresh("false")
+
+        # Branch closures take the CURRENT values of every mutated name
+        # as parameters (no nonlocal: under a traced cond both branches
+        # run, and writes from the first must not leak into the second's
+        # trace); the merged values come back via the helper's result.
+        def branch(fname, stmts):
+            inner: List[ast.stmt] = list(stmts)
+            inner.append(ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+                ctx=ast.Load())))
+            return ast.FunctionDef(
+                name=fname,
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=n) for n in names],
+                    kwonlyargs=[], kw_defaults=[], defaults=[]),
+                body=inner, decorator_list=[])
+
+        # names first bound inside the branches need a placeholder so the
+        # operand tuple evaluates: try: n \n except NameError: n = _PT_UNDEF
+        guards = [self._undef_guard(n) for n in names]
+        call = ast.Call(
+            func=ast.Name(id="_pt_if", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tf_name, ctx=ast.Load()),
+                  ast.Name(id=ff_name, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in names], ctx=ast.Load())],
+            keywords=[])
+        if names:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        new = [branch(tf_name, body or [ast.Pass()]),
+               branch(ff_name, orelse or [ast.Pass()])] + guards + [assign]
+        for n in new:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return new
+
+    # -- while on a traced condition -----------------------------------------
+    def visit_While(self, node):
+        after = list(self._after_stack[-1]) if self._after_stack else []
+        body = self._rewrite_body(node.body, after)
+        if _has_flow_escape(body) or node.orelse:
+            node.body = body
+            return node
+        assigned = _assigned_names(body)
+        needed = _loaded_names([node.test]) | _loaded_names(after) | \
+            _loaded_names(body)
+        names = sorted(assigned & needed)
+        if not names:
+            node.body = body
+            return node
+        cond_name, body_name = self._fresh("cond"), self._fresh("body")
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        cond_fn = ast.FunctionDef(
+            name=cond_name, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        body_fn = ast.FunctionDef(
+            name=body_name, args=args,
+            body=list(body) + [ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+                ctx=ast.Load()))],
+            decorator_list=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="_pt_while", ctx=ast.Load()),
+                args=[ast.Name(id=cond_name, ctx=ast.Load()),
+                      ast.Name(id=body_name, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                      for n in names], ctx=ast.Load())],
+                keywords=[]))
+        guards = [self._undef_guard(n) for n in names]
+        new = [cond_fn, body_fn] + guards + [assign]
+        for n in new:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return new
+
+    # -- for i in range(n) with a possibly-traced n --------------------------
+    def visit_For(self, node):
+        if not (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and len(node.iter.args) == 1
+                and isinstance(node.target, ast.Name)
+                and not node.orelse
+                and not _has_flow_escape(node.body)):
+            # leave untransformable loops alone (break/continue would skip
+            # a desugared counter bump and hang) — but still rewrite
+            # control flow nested inside the body
+            node.body = self._rewrite_body(
+                node.body,
+                list(self._after_stack[-1]) if self._after_stack else [])
+            return node
+        # for i in range(n): body
+        #   -> _pt_i = 0; while _pt_i < n: i = _pt_i; body; _pt_i += 1
+        # The hidden counter keeps Python's post-loop semantics for the
+        # user variable: i ends at n-1, and stays unbound when n == 0.
+        i_name = node.target.id
+        ctr = self._fresh("iter")
+        init = ast.Assign(
+            targets=[ast.Name(id=ctr, ctx=ast.Store())],
+            value=ast.Constant(value=0))
+        head = ast.Assign(
+            targets=[ast.Name(id=i_name, ctx=ast.Store())],
+            value=ast.Name(id=ctr, ctx=ast.Load()))
+        bump = ast.AugAssign(
+            target=ast.Name(id=ctr, ctx=ast.Store()),
+            op=ast.Add(), value=ast.Constant(value=1))
+        loop = ast.While(
+            test=ast.Compare(left=ast.Name(id=ctr, ctx=ast.Load()),
+                             ops=[ast.Lt()],
+                             comparators=[node.iter.args[0]]),
+            body=[head] + list(node.body) + [bump], orelse=[])
+        for n in (init, loop, head, bump):
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        rewritten = self.visit_While(loop)
+        return [init] + (rewritten if isinstance(rewritten, list)
+                         else [rewritten])
+
+
+def ast_transform(fn: Callable) -> Optional[Callable]:
+    """Rewrite ``fn``'s tensor-dependent control flow; None when the
+    source is unavailable (builtins, lambdas in REPLs) or nothing was
+    rewritten."""
+    bound_self = getattr(fn, "__self__", None)
+    raw = fn.__func__ if bound_self is not None else fn
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []
+    rewriter = _ControlFlowRewriter()
+    rewriter.visit(fdef)
+    if rewriter._uid == 0:
+        return None  # nothing to rewrite
+    ast.fix_missing_locations(tree)
+
+    # evaluate in the original globals plus closure cells + helpers
+    glb = dict(raw.__globals__)
+    glb["_pt_if"] = _pt_if
+    glb["_pt_while"] = _pt_while
+    glb["_PT_UNDEF"] = _PT_UNDEF
+    if raw.__closure__:
+        for name, cell in zip(raw.__code__.co_freevars, raw.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    code = compile(tree, filename=f"<dy2static {raw.__qualname__}>",
+                   mode="exec")
+    ns: dict = {}
+    exec(code, glb, ns)  # noqa: S102 - compiling the user's own source
+    new_fn = ns[fdef.name]
+    if raw.__defaults__:
+        new_fn.__defaults__ = raw.__defaults__
+    functools.update_wrapper(new_fn, raw)
+    if bound_self is not None:
+        return new_fn.__get__(bound_self, type(bound_self))
+    return new_fn

@@ -9,10 +9,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.dispatch import dispatch
-from ..core.tensor import Tensor, unwrap
 
 __all__ = [
     "tdm_child", "tdm_sampler", "rank_attention", "match_matrix_tensor",
@@ -238,10 +236,13 @@ def tree_conv(nodes_vector, edge_set, filter, max_depth, name=None):
                                  jnp.arange(len(u))[:, None])
         child_pos_e = earlier.sum(-1)                     # per edge
         sib_cnt_e = same_parent.sum(-1)
-        child_pos = jnp.zeros((n,), jnp.float32).at[vi].add(
-            jnp.where(evalid, child_pos_e.astype(jnp.float32), 0.0))
-        sib_cnt = jnp.ones((n,), jnp.float32).at[vi].set(
-            jnp.where(evalid, sib_cnt_e.astype(jnp.float32), 1.0))
+        # padding edges must not scatter into node 0: route their writes
+        # to an out-of-range index and drop them (jnp scatter mode)
+        vi_or_oob = jnp.where(evalid, vi, n)
+        child_pos = jnp.zeros((n,), jnp.float32).at[vi_or_oob].add(
+            child_pos_e.astype(jnp.float32), mode="drop")
+        sib_cnt = jnp.ones((n,), jnp.float32).at[vi_or_oob].set(
+            sib_cnt_e.astype(jnp.float32), mode="drop")
         # eta coefficients of node v at relative depth k under an ancestor
         def etas(depth, pos, cnt):
             eta_t = (md - depth) / md
@@ -365,15 +366,18 @@ def lstmp(x, weight, proj_weight, bias=None, h0=None, c0=None,
         if single:
             xv = xv[None]
         b = xv.shape[0]
-        bias_v = rest[0].reshape(-1) if bias is not None else \
+        rest = list(rest)
+        bias_v = rest.pop(0).reshape(-1) if bias is not None else \
             jnp.zeros((4 * d,), xv.dtype)
         gb = bias_v[:4 * d]
         w_ic = w_fc = w_oc = None
         if use_peepholes and bias_v.size >= 7 * d:
             w_ic, w_fc, w_oc = (bias_v[4 * d:5 * d], bias_v[5 * d:6 * d],
                                 bias_v[6 * d:7 * d])
-        r_init = jnp.zeros((b, p), xv.dtype)
-        c_init = jnp.zeros((b, d), xv.dtype)
+        r_init = rest.pop(0).reshape(b, p) if h0 is not None else \
+            jnp.zeros((b, p), xv.dtype)
+        c_init = rest.pop(0).reshape(b, d) if c0 is not None else \
+            jnp.zeros((b, d), xv.dtype)
 
         def step(carry, xt):
             r, c = carry
@@ -405,6 +409,10 @@ def lstmp(x, weight, proj_weight, bias=None, h0=None, c0=None,
     args = [x, weight, proj_weight]
     if bias is not None:
         args.append(bias)
+    if h0 is not None:
+        args.append(h0)
+    if c0 is not None:
+        args.append(c0)
     return dispatch(f, *args)
 
 

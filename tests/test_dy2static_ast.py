@@ -1,0 +1,208 @@
+"""AST fallback for data-dependent control flow in jit.to_static
+(jit/dy2static.py) — reference ProgramTranslator
+`dygraph_to_static/program_translator.py:759`.
+
+Trace-based to_static folds concrete Python control flow for free; these
+tests exercise the cases that REQUIRE the AST pass: `if` on a traced
+tensor and Python loops bounded by a traced tensor, checked for
+eager-vs-jit equivalence."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TensorIfNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.sum() > 0:
+            y = h * 2.0
+        else:
+            y = h - 1.0
+        return y
+
+
+class TensorLoopNet(nn.Layer):
+    """while bounded by a traced tensor value."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x, n):
+        h = self.fc(x)
+        i = paddle.zeros([1], dtype="int32")
+        while i < n:
+            h = h * 1.5 + 0.1
+            i = i + 1
+        return h
+
+
+class MixedNet(nn.Layer):
+    """if + tensor-bounded for-range in one forward."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x, n):
+        h = self.fc(x)
+        if h.mean() > 0:
+            h = h + 10.0
+        else:
+            h = h - 10.0
+        acc = paddle.zeros_like(h)
+        for _ in range(n):
+            acc = acc + h
+        return acc
+
+
+class TestTensorIf:
+    @pytest.mark.parametrize("sign", [1.0, -1.0])
+    def test_matches_eager(self, sign):
+        paddle.seed(0)
+        net = TensorIfNet()
+        x = paddle.to_tensor(
+            sign * np.abs(np.random.RandomState(0).randn(2, 4))
+            .astype(np.float32))
+        eager = _np(net(x))
+        st = paddle.jit.to_static(TensorIfNet())
+        st.set_state_dict(net.state_dict())
+        got = _np(st(x))
+        np.testing.assert_allclose(got, eager, rtol=1e-5, atol=1e-6)
+
+
+class TestTensorWhile:
+    def test_matches_eager(self):
+        paddle.seed(1)
+        net = TensorLoopNet()
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 4).astype(np.float32))
+        for steps in (0, 3):
+            n = paddle.to_tensor(np.array([steps], np.int32))
+            eager = _np(net(x, n))
+            st = paddle.jit.to_static(TensorLoopNet())
+            st.set_state_dict(net.state_dict())
+            got = _np(st(x, n))
+            np.testing.assert_allclose(got, eager, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"steps={steps}")
+
+
+class TestMixed:
+    def test_if_plus_tensor_range(self):
+        paddle.seed(2)
+        net = MixedNet()
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(2, 4).astype(np.float32))
+        n = paddle.to_tensor(np.array([4], np.int32))
+        eager = _np(net(x, n))
+        st = paddle.jit.to_static(MixedNet())
+        st.set_state_dict(net.state_dict())
+        got = _np(st(x, n))
+        np.testing.assert_allclose(got, eager, rtol=1e-5, atol=1e-5)
+
+
+class TestTransformerUnit:
+    def test_clean_functions_untouched(self):
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        def clean(x):
+            return x + 1
+
+        assert ast_transform(clean) is None
+
+    def test_concrete_control_flow_still_traces(self):
+        # control flow on python values must NOT need the AST pass
+        @paddle.jit.to_static
+        def f(x, flag=True):
+            if flag:
+                return x * 2
+            return x
+
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        np.testing.assert_allclose(_np(f(x)), 2 * np.ones((2, 2)))
+
+    def test_nested_if_in_while(self):
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        def g(x, n):
+            i = paddle.zeros([1], dtype="int32")
+            while i < n:
+                if x.sum() > 0:
+                    x = x * 0.5
+                else:
+                    x = x + 1.0
+                i = i + 1
+            return x
+
+        g2 = ast_transform(g)
+        assert g2 is not None
+        x = paddle.to_tensor(np.full((2,), 8.0, np.float32))
+        n = paddle.to_tensor(np.array([3], np.int32))
+        out = _np(g2(x, n))
+        np.testing.assert_allclose(out, np.full((2,), 1.0), rtol=1e-6)
+
+
+class TestReviewRegressions:
+    def test_branch_local_temp(self):
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        def f(x):
+            if x.sum() > 0:
+                tmp = x * 2.0
+                y = tmp + 1.0
+            else:
+                y = x - 1.0
+            return y
+
+        f2 = ast_transform(f)
+        assert f2 is not None
+        xp = paddle.to_tensor(np.ones((2,), np.float32))
+        np.testing.assert_allclose(_np(f2(xp)), [3.0, 3.0])
+        xn = paddle.to_tensor(-np.ones((2,), np.float32))
+        np.testing.assert_allclose(_np(f2(xn)), [-2.0, -2.0])
+        # and under a real trace (tensor-dependent)
+        st = paddle.jit.to_static(f)
+        np.testing.assert_allclose(_np(st(xp)), [3.0, 3.0])
+
+    def test_for_loop_var_final_value(self):
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        def f(x, n):
+            if x.sum() > 0:  # force a rewrite so the For desugars too
+                x = x * 2.0
+            for i in range(5):
+                x = x + 0.0
+            return x * i
+
+        f2 = ast_transform(f)
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        n = paddle.to_tensor(np.array([5], np.int32))
+        np.testing.assert_allclose(_np(f2(x, n)), _np(f(x, n)))
+
+    def test_for_with_continue_left_alone(self):
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        def f(x):
+            if x.sum() > 0:
+                x = x * 2.0
+            acc = 0.0
+            for i in range(4):
+                if i == 2:
+                    continue
+                acc = acc + float(i)
+            return x + acc
+
+        f2 = ast_transform(f)
+        assert f2 is not None
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        np.testing.assert_allclose(_np(f2(x)), _np(f(x)))

@@ -345,3 +345,49 @@ class TestGenerateMaskLabels:
         assert (m[0, 1] == -1).all()
         np.testing.assert_allclose(_np_of(mask_rois)[0, 0],
                                    [4, 4, 12, 12])
+
+
+class TestReviewRegressions:
+    def test_lstmp_initial_state_used(self):
+        rng = np.random.RandomState(9)
+        b, t, d, p = 1, 2, 3, 2
+        x = rng.randn(b, t, 4 * d).astype(np.float32) * 0.3
+        w = rng.randn(p, 4 * d).astype(np.float32) * 0.3
+        pw = rng.randn(d, p).astype(np.float32) * 0.3
+        h0 = rng.randn(b, p).astype(np.float32)
+        c0 = rng.randn(b, d).astype(np.float32)
+        proj0, _ = ops.lstmp(paddle.to_tensor(x), paddle.to_tensor(w),
+                             paddle.to_tensor(pw), use_peepholes=False)
+        proj1, _ = ops.lstmp(paddle.to_tensor(x), paddle.to_tensor(w),
+                             paddle.to_tensor(pw),
+                             h0=paddle.to_tensor(h0),
+                             c0=paddle.to_tensor(c0), use_peepholes=False)
+        # nonzero initial state must change the outputs
+        assert not np.allclose(_np_of(proj0), _np_of(proj1))
+        # and match numpy with the same initial state
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        r, c = h0.copy(), c0.copy()
+        for step in range(t):
+            g = x[:, step] + r @ w
+            gc, gi, gf, go = np.split(g, 4, -1)
+            c = sig(gf) * c + sig(gi) * np.tanh(gc)
+            r = np.tanh((sig(go) * np.tanh(c)) @ pw)
+        np.testing.assert_allclose(_np_of(proj1)[:, -1], r, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_tree_conv_padding_edges_dont_clobber_node0(self):
+        # node ids: 2 -> children 1, 3; padding rows target (0,0).
+        # node index 0 (id 1) must keep sibling count 2, not be reset
+        # by the padding scatter.
+        rng = np.random.RandomState(10)
+        feat = rng.randn(1, 3, 2).astype(np.float32)
+        edges_pad = np.array([[[2, 1], [2, 3], [0, 0], [0, 0]]], np.int32)
+        edges_min = np.array([[[2, 1], [2, 3]]], np.int32)
+        filt = rng.randn(2, 3, 2, 1).astype(np.float32)
+        out_pad = _np_of(ops.tree_conv(paddle.to_tensor(feat),
+                                       paddle.to_tensor(edges_pad),
+                                       paddle.to_tensor(filt), max_depth=2))
+        out_min = _np_of(ops.tree_conv(paddle.to_tensor(feat),
+                                       paddle.to_tensor(edges_min),
+                                       paddle.to_tensor(filt), max_depth=2))
+        np.testing.assert_allclose(out_pad, out_min, rtol=1e-5, atol=1e-6)
