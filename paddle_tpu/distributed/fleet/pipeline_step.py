@@ -19,10 +19,20 @@ padding), giving PP its memory scaling.  Inside the schedule, a
 
 Constraints (documented, enforced):
 * stage-boundary activations must share one shape/dtype (the reference
-  exchanges fixed shape meta the same way, `pipeline_parallel.py:282`);
-* stages must be pure wrt buffers (no BatchNorm running-stat writes);
-* optimizers must have elementwise update rules (SGD/Momentum/Adam/...;
-  Lamb's per-param norms are not representable on the packed vector).
+  exchanges fixed shape meta the same way, `pipeline_parallel.py:282`).
+
+Round-3 generalizations (former constraints, now supported):
+* buffer-writing stages (BatchNorm running stats): buffers pack into a
+  second 'pp'-sharded [L, B_max] vector threaded through the schedule's
+  forward slots in micro-batch order (the backward's recompute binds the
+  step-initial buffers — sound because train-mode BN normalizes with
+  batch statistics, so running stats never affect gradients);
+* non-elementwise optimizers (Lamb/Lars per-param trust ratios): when
+  ``optimizer._elementwise_update`` is False the update unpacks each
+  stage row into its real per-parameter tensors and applies
+  ``_update_param`` per parameter before repacking (elementwise
+  optimizers keep the cheaper fused packed-vector update — numerically
+  identical for them).
 """
 from __future__ import annotations
 
@@ -79,6 +89,21 @@ class _StageMeta:
             for k, (off, shape, dtype) in self.offsets.items()
         }
 
+    def repack(self, arrays: Dict, total: int):
+        """dict of arrays -> f32 vector [total] (traced; zero padding)."""
+        pieces = []
+        off = 0
+        for k in self.names:
+            o, shape, _ = self.offsets[k]
+            assert o == off, (k, o, off)
+            a = arrays[k].astype(jnp.float32).reshape(-1)
+            pieces.append(a)
+            off += a.size
+        if total > off:
+            pieces.append(jnp.zeros((total - off,), jnp.float32))
+        return jnp.concatenate(pieces) if pieces else \
+            jnp.zeros((total,), jnp.float32)
+
 
 class PipelineTrainStep:
     """fleet.build_train_step product for pp>1 + PipelineLayer.
@@ -112,13 +137,18 @@ class PipelineTrainStep:
             model.get_stage_layers(r) for r in range(self.L)
         ]
         self.stage_meta: List[_StageMeta] = []
+        self.buf_meta: List[_StageMeta] = []
         for r in range(self.L):
             params: Dict[str, Tensor] = {}
+            bufs: Dict[str, Tensor] = {}
             for i, ly in enumerate(self.stage_layers[r]):
-                p, _ = ly.functional_state()
+                p, b = ly.functional_state()
                 for k, t in p.items():
                     params[f"l{i}.{k}"] = t
+                for k, t in b.items():
+                    bufs[f"l{i}.{k}"] = t
             self.stage_meta.append(_StageMeta(params))
+            self.buf_meta.append(_StageMeta(bufs))
         self.S = max(m.size for m in self.stage_meta)
         if self.S == 0:
             raise ValueError("PipelineLayer has no parameters")
@@ -130,6 +160,19 @@ class PipelineTrainStep:
         self.vec_sharding = NamedSharding(mesh, PartitionSpec("pp", None))
         self._repl = NamedSharding(mesh, PartitionSpec())
         self._vec = jax.device_put(jnp.asarray(packed), self.vec_sharding)
+        # [L, B] packed buffers (BatchNorm running stats etc.), threaded
+        # through the schedule's forward slots; absent when no stage has
+        # buffers
+        self.B = max(m.size for m in self.buf_meta)
+        if self.B:
+            bpacked = np.zeros((self.L, self.B), np.float32)
+            for r, m in enumerate(self.buf_meta):
+                bpacked[r, :m.size] = m.pack()
+            self._buf = jax.device_put(jnp.asarray(bpacked),
+                                       self.vec_sharding)
+        else:
+            self._buf = None
+        self._buf_placeholder = None  # created lazily for buffer-free runs
         self._opt_state = None
         self._compiled = None
         self._step = 0
@@ -137,16 +180,40 @@ class PipelineTrainStep:
         self._dirty = False    # master copy ahead of the layer Tensors?
 
     # -- stage application (traced) -----------------------------------------
-    def _apply_stage(self, r: int, vec_local, x, rng):
-        """Run stage r's layers with params bound from the packed vector.
-        x: Tensor input (activation or raw micro-batch for r=0)."""
+    def _apply_stage(self, r: int, vec_local, x, rng, buf_local=None,
+                     capture_writes=False):
+        """Run stage r's layers with params (and buffers) bound from the
+        packed vectors.  x: Tensor input (activation or raw micro-batch
+        for r=0).  With ``capture_writes`` returns (out, new_buf_row) —
+        buffer mutations (BatchNorm running stats) recorded during the
+        forward become the stage's updated buffer vector."""
         meta = self.stage_meta[r]
+        bmeta = self.buf_meta[r]
         arrays = meta.unpack(vec_local)
-        with _SwappedState(meta.tensors) as sw:
+        bound: Dict[str, Tensor] = dict(meta.tensors)
+        if buf_local is not None and bmeta.size:
+            barrays = bmeta.unpack(buf_local)
+            for k, t in bmeta.tensors.items():
+                bound[f"__buf__{k}"] = t
+            arrays = dict(arrays)
+            arrays.update({f"__buf__{k}": barrays[k] for k in bmeta.names})
+        writes: Dict[int, object] = {}
+        with _SwappedState(bound) as sw:
             sw.bind(arrays)
-            with framework.trace_guard(rng_key=rng):
+            with framework.trace_guard(rng_key=rng, writes=writes):
                 out = _call_seq(self.stage_layers[r], x)
-        return out._array if isinstance(out, Tensor) else out
+            if capture_writes:
+                new_bufs = {}
+                for k, t in bmeta.tensors.items():
+                    w = writes.get(id(t))
+                    new_bufs[k] = w if w is not None else \
+                        (barrays[k] if buf_local is not None and bmeta.size
+                         else t._array)
+        out = out._array if isinstance(out, Tensor) else out
+        if capture_writes:
+            total = buf_local.shape[0] if buf_local is not None else self.B
+            return out, bmeta.repack(new_bufs, total)
+        return out
 
     def _infer_act_spec(self, mb_input):
         """Trace stage boundaries to find the (uniform) activation spec."""
@@ -182,29 +249,50 @@ class PipelineTrainStep:
         loss_fn = self.loss_fn
         apply_stage = self._apply_stage
         unroll = self._unroll
+        with_bufs = self._buf is not None
+        buf_meta = self.buf_meta
 
         def make_fwd(r):
+            # a stage only pays buffer capture when IT has buffers (static
+            # per-stage check); a buffer-free stage under a buffered model
+            # passes the vector through untouched
+            stage_has_bufs = with_bufs and buf_meta[r].size > 0
             if r == L - 1:
                 # last stage computes nothing forward: its real work (loss
                 # fwd+bwd) happens in the backward slot via value_and_grad
-                return lambda vec, act_in, mb_x, rng: jnp.zeros(
-                    act_shape, act_dtype)
-            if r == 0:
-                def f0(vec, act_in, mb_x, rng):
-                    return apply_stage(0, vec, Tensor(mb_x),
-                                       rng).astype(act_dtype)
-                return f0
+                # EXCEPT for its buffer updates, which only the forward
+                # slot may thread (the backward recomputes)
+                def fl(vec, act_in, mb_x, rng, buf):
+                    if stage_has_bufs:
+                        _, nbuf = apply_stage(L - 1, vec, Tensor(act_in),
+                                              rng, buf, True)
+                        return jnp.zeros(act_shape, act_dtype), nbuf
+                    if with_bufs:
+                        return jnp.zeros(act_shape, act_dtype), buf
+                    return jnp.zeros(act_shape, act_dtype)
+                return fl
 
-            def fr(vec, act_in, mb_x, rng, _r=r):
-                return apply_stage(_r, vec, Tensor(act_in),
-                                   rng).astype(act_dtype)
+            def fr(vec, act_in, mb_x, rng, buf, _r=r,
+                   _has=stage_has_bufs):
+                x = Tensor(mb_x) if _r == 0 else Tensor(act_in)
+                if _has:
+                    out, nbuf = apply_stage(_r, vec, x, rng, buf, True)
+                    return out.astype(act_dtype), nbuf
+                if with_bufs:
+                    return (apply_stage(_r, vec, x, rng)
+                            .astype(act_dtype), buf)
+                return apply_stage(_r, vec, x, rng).astype(act_dtype)
             return fr
 
         def make_bwd(r):
+            # the backward's recompute binds the STEP-INITIAL buffers
+            # (closed over via init_buf): train-mode BN normalizes with
+            # batch stats, so running stats never affect the gradients
             if r == L - 1:
-                def bl(vec, act_saved, g_in, mb_y, rng):
+                def bl(vec, act_saved, g_in, mb_y, rng, init_buf):
                     def loss_of(v, a):
-                        out = apply_stage(L - 1, v, Tensor(a), rng)
+                        out = apply_stage(L - 1, v, Tensor(a), rng,
+                                          init_buf)
                         lt = loss_fn(Tensor(out), Tensor(mb_y))
                         la = lt._array if isinstance(lt, Tensor) else lt
                         return la.astype(jnp.float32)
@@ -214,10 +302,10 @@ class PipelineTrainStep:
                     return gvec, gact.astype(jnp.float32), lss
                 return bl
             if r == 0:
-                def b0(vec, act_saved, g_in, mb_x, rng):
+                def b0(vec, act_saved, g_in, mb_x, rng, init_buf):
                     def out_of(v):
-                        return apply_stage(0, v, Tensor(mb_x),
-                                           rng).astype(act_dtype)
+                        return apply_stage(0, v, Tensor(mb_x), rng,
+                                           init_buf).astype(act_dtype)
 
                     _, vjp = jax.vjp(out_of, vec)
                     (gvec,) = vjp(g_in.astype(act_dtype))
@@ -225,10 +313,10 @@ class PipelineTrainStep:
                             jnp.zeros((), jnp.float32))
                 return b0
 
-            def br(vec, act_saved, g_in, mb_y, rng, _r=r):
+            def br(vec, act_saved, g_in, mb_y, rng, init_buf, _r=r):
                 def out_of(v, a):
-                    return apply_stage(_r, v, Tensor(a),
-                                       rng).astype(act_dtype)
+                    return apply_stage(_r, v, Tensor(a), rng,
+                                       init_buf).astype(act_dtype)
 
                 _, vjp = jax.vjp(out_of, vec, act_saved)
                 gvec, gact = vjp(g_in.astype(act_dtype))
@@ -239,17 +327,18 @@ class PipelineTrainStep:
         fwd_branches = [make_fwd(r) for r in range(L)]
         bwd_branches = [make_bwd(r) for r in range(L)]
 
-        def local(vec2d, micro_in, micro_lab, rng):
+        def local(vec2d, buf2d, micro_in, micro_lab, rng):
             # vec2d: [1, S] (this device's stage); micro_*: [M, mb, ...]
             vec = vec2d[0]
+            init_buf = buf2d[0] if with_bufs else None
             rank = lax.axis_index("pp")
 
-            def fwd_apply(v, act_in, mb_idx, key):
+            def fwd_apply(v, act_in, mb_idx, key, buf=None):
                 return lax.switch(
                     rank,
                     [lambda args, _r=r: fwd_branches[_r](*args)
                      for r in range(L)],
-                    (v, act_in, micro_in[mb_idx], key))
+                    (v, act_in, micro_in[mb_idx], key, buf))
 
             def bwd_apply(v, act_saved, g_in, mb_idx, key):
                 # stage 0 needs its micro-batch input (recompute); the last
@@ -257,7 +346,7 @@ class PipelineTrainStep:
                 def branch(args, _r=0):
                     v_, a_, g_, mi, ml, k_ = args
                     mb = mi if _r == 0 else ml
-                    return bwd_branches[_r](v_, a_, g_, mb, k_)
+                    return bwd_branches[_r](v_, a_, g_, mb, k_, init_buf)
 
                 return lax.switch(
                     rank,
@@ -266,50 +355,129 @@ class PipelineTrainStep:
                     (v, act_saved, g_in, micro_in[mb_idx],
                      micro_lab[mb_idx], key))
 
-            gacc, loss_sum = pipeline_1f1b_local(
-                fwd_apply, bwd_apply, vec, M, act_shape, act_dtype,
-                axis_name="pp", rng=rng, unroll=unroll)
+            if with_bufs:
+                gacc, loss_sum, new_buf = pipeline_1f1b_local(
+                    fwd_apply, bwd_apply, vec, M, act_shape, act_dtype,
+                    axis_name="pp", rng=rng, unroll=unroll,
+                    state=init_buf)
+            else:
+                gacc, loss_sum = pipeline_1f1b_local(
+                    lambda v, a, i, k: fwd_apply(v, a, i, k, None),
+                    bwd_apply, vec, M, act_shape, act_dtype,
+                    axis_name="pp", rng=rng, unroll=unroll)
+                new_buf = jnp.zeros((0,), jnp.float32)
             # mean over micro-batches; grads also mean over dp replicas
             gacc = gacc / M
             if self.dp > 1:
                 gacc = lax.pmean(gacc, "dp")
+                # running stats advanced independently per dp replica on
+                # disjoint shards: average them (DataParallel BN stance)
+                if with_bufs:
+                    new_buf = lax.pmean(new_buf, "dp")
             loss = loss_sum / M
             # make loss visible on all pp ranks (only last stage has it)
             loss = lax.psum(loss, "pp")
             if self.dp > 1:
                 loss = lax.pmean(loss, "dp")
-            return gacc[None], loss
+            return gacc[None], new_buf[None], loss
 
-        in_specs = (PartitionSpec("pp", None),
+        in_specs = (PartitionSpec("pp", None), PartitionSpec("pp", None),
                     PartitionSpec(None, "dp"), PartitionSpec(None, "dp"),
                     PartitionSpec())
-        out_specs = (PartitionSpec("pp", None), PartitionSpec())
+        out_specs = (PartitionSpec("pp", None), PartitionSpec("pp", None),
+                     PartitionSpec())
         sched = jax.shard_map(local, mesh=self.mesh, in_specs=in_specs,
                               out_specs=out_specs, check_vma=False)
 
         optimizer = self.optimizer
+        elementwise = getattr(optimizer, "_elementwise_update", True)
+        stage_meta = self.stage_meta
 
-        def pure(vec, opt_state, micro_in, micro_lab, lr, step, rng):
-            grads, loss = sched(vec, micro_in, micro_lab, rng)
-            new_params, new_opt = optimizer.apply_gradients(
-                {"__pp_vec__": vec}, {"__pp_vec__": grads}, opt_state, lr,
-                step)
-            return loss, new_params["__pp_vec__"], new_opt
+        def _per_stage_update(vec, grads, opt_state, lr, step):
+            """Unpacked per-parameter update for non-elementwise
+            optimizers: each stage row unpacks into its real tensors,
+            `_update_param` runs per parameter (correct per-param norms
+            for Lamb/Lars), rows repack.  L is static, so this is L
+            per-row programs — XLA keeps each on its own 'pp' shard."""
+            if optimizer._grad_clip is not None:
+                # same packed-vector clip the elementwise path gets via
+                # apply_gradients (padding rows are zero, so the global
+                # norm over the packed matrix equals the per-param norm)
+                grads = optimizer._grad_clip.clip_arrays([grads])[0]
+            slots = opt_state.get("__pp_vec__", {})
+            new_rows, new_slot_rows = [], {k: [] for k in slots}
+            scalar_out = {}
+            for r in range(L):
+                meta = stage_meta[r]
+                p_r = meta.unpack(vec[r])
+                g_r = meta.unpack(grads[r].astype(jnp.float32))
+                np_r, ns_r = {}, {k: {} for k in slots}
+                for name in meta.names:
+                    slot_p = {}
+                    for sk, sv in slots.items():
+                        if getattr(sv, "ndim", 0) == 2:
+                            off, shape, _ = meta.offsets[name]
+                            n = int(np.prod(shape) if shape else 1)
+                            slot_p[sk] = sv[r][off:off + n].reshape(shape)
+                        else:
+                            slot_p[sk] = sv
+                    g = optimizer._apply_decay(p_r[name], g_r[name]
+                                               .astype(p_r[name].dtype))
+                    newp, news = optimizer._update_param(
+                        p_r[name], g, slot_p, lr, step)
+                    np_r[name] = newp
+                    for sk in slots:
+                        ns_r[sk][name] = news.get(sk)
+                new_rows.append(meta.repack(np_r, S))
+                for sk, sv in slots.items():
+                    if getattr(sv, "ndim", 0) == 2:
+                        new_slot_rows[sk].append(
+                            meta.repack(ns_r[sk], S))
+                    else:
+                        scalar_out[sk] = next(iter(ns_r[sk].values())) \
+                            if ns_r[sk] else sv
+            new_vec = jnp.stack(new_rows)
+            new_slots = {}
+            for sk, sv in slots.items():
+                if getattr(sv, "ndim", 0) == 2:
+                    new_slots[sk] = jnp.stack(new_slot_rows[sk])
+                else:
+                    new_slots[sk] = scalar_out.get(sk, sv)
+            return new_vec, {"__pp_vec__": new_slots}
+
+        def pure(vec, bufvec, opt_state, micro_in, micro_lab, lr, step,
+                 rng):
+            grads, new_buf, loss = sched(vec, bufvec, micro_in, micro_lab,
+                                         rng)
+            if elementwise:
+                new_params, new_opt = optimizer.apply_gradients(
+                    {"__pp_vec__": vec}, {"__pp_vec__": grads}, opt_state,
+                    lr, step)
+                new_vec = new_params["__pp_vec__"]
+            else:
+                new_vec, new_opt = _per_stage_update(vec, grads, opt_state,
+                                                     lr, step)
+            return loss, new_vec, new_buf, new_opt
 
         opt_shardings = {
             "__pp_vec__": {
-                sk: self.vec_sharding
-                for sk in (self._opt_state or {}).get("__pp_vec__", {})
+                sk: (self.vec_sharding
+                     if getattr(sv, "ndim", 0) == 2 else self._repl)
+                for sk, sv in (self._opt_state or {}).get("__pp_vec__",
+                                                          {}).items()
             }
         }
         in_shardings = (
-            self.vec_sharding, opt_shardings,
+            self.vec_sharding, self.vec_sharding, opt_shardings,
             NamedSharding(self.mesh, PartitionSpec(None, "dp")),
             NamedSharding(self.mesh, PartitionSpec(None, "dp")),
             self._repl, self._repl, self._repl,
         )
-        out_shardings = (self._repl, self.vec_sharding, opt_shardings)
-        donate = (0, 1) if self._donate else ()
+        out_shardings = (self._repl, self.vec_sharding, self.vec_sharding,
+                         opt_shardings)
+        # the buffer-free placeholder is persistent — don't donate it
+        donate = ((0, 1, 2) if with_bufs else (0, 2)) if self._donate \
+            else ()
         with self.mesh:
             return jax.jit(pure, in_shardings=in_shardings,
                            out_shardings=out_shardings,
@@ -337,7 +505,9 @@ class PipelineTrainStep:
             state = self.optimizer.init_state({"__pp_vec__": self._vec})
             self._opt_state = {
                 "__pp_vec__": {
-                    sk: jax.device_put(sv, self.vec_sharding)
+                    sk: jax.device_put(
+                        sv, self.vec_sharding
+                        if getattr(sv, "ndim", 0) == 2 else self._repl)
                     for sk, sv in state["__pp_vec__"].items()
                 }
             }
@@ -347,8 +517,16 @@ class PipelineTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         rng = framework.default_generator.next_key()
         self._dirty = True
-        loss, self._vec, self._opt_state = self._compiled(
-            self._vec, self._opt_state,
+        if self._buf is not None:
+            bufvec = self._buf
+        else:
+            if self._buf_placeholder is None:
+                self._buf_placeholder = jax.device_put(
+                    jnp.zeros((self.L, 1), jnp.float32),
+                    self.vec_sharding)
+            bufvec = self._buf_placeholder
+        loss, self._vec, new_buf, self._opt_state = self._compiled(
+            self._vec, bufvec, self._opt_state,
             jax.device_put(micro_in,
                            NamedSharding(self.mesh,
                                          PartitionSpec(None, "dp"))),
@@ -356,6 +534,8 @@ class PipelineTrainStep:
                            NamedSharding(self.mesh,
                                          PartitionSpec(None, "dp"))),
             lr, self._step, rng)
+        if self._buf is not None:
+            self._buf = new_buf
         return Tensor(loss)
 
     # -- state sync ----------------------------------------------------------
@@ -373,6 +553,14 @@ class PipelineTrainStep:
                 arrays = meta.unpack(jnp.asarray(packed[r]))
                 for k, t in meta.tensors.items():
                     t._array = arrays[k]
+            if self._buf is not None:
+                bpacked = np.asarray(jax.device_get(self._buf))
+                for r, bmeta in enumerate(self.buf_meta):
+                    if not bmeta.size:
+                        continue
+                    barrays = bmeta.unpack(jnp.asarray(bpacked[r]))
+                    for k, t in bmeta.tensors.items():
+                        t._array = barrays[k]
 
     def state_dict(self):
         self.sync_params()

@@ -207,6 +207,8 @@ class RMSProp(Optimizer):
 class Lamb(Optimizer):
     """reference `operators/optimizers/lamb_op.h`."""
 
+    _elementwise_update = False  # trust ratio uses per-param norms
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, name=None):
@@ -238,6 +240,8 @@ class Lamb(Optimizer):
 
 class Lars(Momentum):
     """reference `operators/optimizers/lars_momentum_op.*`."""
+
+    _elementwise_update = False  # local lr uses per-param norms
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  lars_coeff=0.001, lars_weight_decay=0.0005, epsilon=1e-9,
@@ -294,6 +298,8 @@ class Dpsgd(Optimizer):
     """reference `operators/optimizers/dpsgd_op.h` (differentially
     private SGD): per-step gradient clipping to `clip` + gaussian noise
     scaled by `sigma`, then a plain SGD step."""
+
+    _elementwise_update = False  # per-param grad-norm clip + noise
 
     def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
                  sigma=1.0, parameters=None, seed=0, name=None, **kw):

@@ -69,6 +69,12 @@ class Optimizer:
     def _learning_rate(self):
         return self._lr
 
+    # Updates that are strictly elementwise in (p, g, slot) may be packed
+    # across parameters (the pipeline step's fused packed-vector update);
+    # optimizers using per-parameter norms (Lamb, Lars, Dpsgd trust/clip
+    # ratios) must opt out so callers fall back to per-param updates.
+    _elementwise_update = True
+
     # -- functional core (override) ----------------------------------------
     def _init_slot(self, param_array) -> dict:
         return {}

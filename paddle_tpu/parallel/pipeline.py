@@ -72,7 +72,8 @@ def pipeline_local(stage_fn: Callable, stage_params, microbatches,
 
 def pipeline_1f1b_local(fwd_apply: Callable, bwd_apply: Callable, vec,
                         n_micro: int, act_shape, act_dtype,
-                        axis_name: str = "pp", rng=None, unroll: int = 1):
+                        axis_name: str = "pp", rng=None, unroll: int = 1,
+                        state=None):
     """Per-device 1F1B micro-batch schedule (call inside shard_map).
 
     The lockstep-SPMD realization of the reference 1F1B schedule
@@ -90,8 +91,12 @@ def pipeline_1f1b_local(fwd_apply: Callable, bwd_apply: Callable, vec,
     bwd_apply(vec, act_saved, g_in, mb_idx, rng)
         -> (grad_vec, g_out, loss)                           [all ranks]
     Both dispatch on ``lax.axis_index(axis_name)`` internally (lax.switch).
-    Returns (grad_vec_accum, loss_sum) — loss only nonzero on the last
-    stage; psum/scale at the caller.
+
+    ``state`` (optional) threads a per-stage mutable-buffer vector (e.g.
+    BatchNorm running stats) through the forward slots in micro-batch
+    order: fwd_apply then takes a 5th argument and returns
+    (act_out, new_state).  Returns (grad_vec_accum, loss_sum[, state]) —
+    loss only nonzero on the last stage; psum/scale at the caller.
     """
     L = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
@@ -110,9 +115,10 @@ def pipeline_1f1b_local(fwd_apply: Callable, bwd_apply: Callable, vec,
     brot = jnp.zeros(act_shape, jnp.float32)  # incoming activation grad
     gacc = jnp.zeros(vec.shape, jnp.float32)
     loss_acc = jnp.zeros((), jnp.float32)
+    with_state = state is not None
 
     def tick(t, carry):
-        rot, brot, resid, gacc, loss_acc = carry
+        rot, brot, resid, gacc, loss_acc, st = carry
         f = t - r                      # forward micro-batch at this stage
         b = t - (2 * L - 1) + r        # backward micro-batch at this stage
         f_valid = (f >= 0) & (f < M)
@@ -122,7 +128,11 @@ def pipeline_1f1b_local(fwd_apply: Callable, bwd_apply: Callable, vec,
         # forward slot: per-micro-batch rng must be reproducible at the
         # backward slot's recompute, so key = fold(mb, rank) only
         fkey = jax.random.fold_in(jax.random.fold_in(rng, fc), r)
-        act_out = fwd_apply(vec, rot, fc, fkey)
+        if with_state:
+            act_out, new_st = fwd_apply(vec, rot, fc, fkey, st)
+            st = jnp.where(f_valid, new_st, st)
+        else:
+            act_out = fwd_apply(vec, rot, fc, fkey)
         resid = jnp.where(f_valid, resid.at[jnp.mod(fc, D)].set(rot), resid)
         act_out = jnp.where(f_valid, act_out,
                             jnp.zeros(act_shape, act_dtype))
@@ -137,15 +147,18 @@ def pipeline_1f1b_local(fwd_apply: Callable, bwd_apply: Callable, vec,
                          jnp.zeros(act_shape, jnp.float32))
         rot = lax.ppermute(act_out, axis_name, fwd_perm)
         brot = lax.ppermute(gout, axis_name, bwd_perm)
-        return rot, brot, resid, gacc, loss_acc
+        return rot, brot, resid, gacc, loss_acc, st
 
-    carry = (rot, brot, resid, gacc, loss_acc)
+    carry = (rot, brot, resid, gacc, loss_acc,
+             state if with_state else jnp.zeros((), jnp.float32))
     if unroll >= T:
         for t in range(T):
             carry = tick(t, carry)
     else:
         carry = lax.fori_loop(0, T, tick, carry, unroll=unroll)
-    _, _, _, gacc, loss_acc = carry
+    _, _, _, gacc, loss_acc, st = carry
+    if with_state:
+        return gacc, loss_acc, st
     return gacc, loss_acc
 
 
