@@ -1,0 +1,3 @@
+module github.com/paddle-tpu/goapi
+
+go 1.20

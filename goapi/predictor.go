@@ -1,0 +1,236 @@
+// Package paddle wraps the paddle_tpu C inference API (csrc/capi.cc) for
+// Go deployments — the counterpart of the reference's
+// `paddle/fluid/inference/goapi/predictor.go` over `capi_exp/`.
+//
+// Build: requires cgo and the built native libraries:
+//
+//	cmake -B build -G Ninja csrc && ninja -C build
+//	CGO_LDFLAGS="-L${REPO}/build -lpaddle_tpu_capi" go build ./goapi
+//
+// The library embeds CPython to drive the XLA predictor, so the process
+// must be able to locate the Python runtime used at build time (see
+// csrc/capi.cc).  This file is committed build-gated: the repository's
+// CI image carries no Go toolchain, so it is compile-verified only where
+// one exists (tests/test_goapi.py gates on `go` being available).
+package paddle
+
+/*
+#cgo LDFLAGS: -lpaddle_tpu_capi
+
+#include <stdlib.h>
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+
+const char* PD_GetLastError();
+PD_Config* PD_ConfigCreate();
+void PD_ConfigDestroy(PD_Config* c);
+void PD_ConfigSetModel(PD_Config* c, const char* prog_file,
+                       const char* params_file);
+void PD_ConfigSwitchIrOptim(PD_Config* c, int on);
+void PD_ConfigEnableMemoryOptim(PD_Config* c, int on);
+PD_Predictor* PD_PredictorCreate(PD_Config* c);
+void PD_PredictorDestroy(PD_Predictor* p);
+int PD_PredictorGetInputNum(PD_Predictor* p);
+int PD_PredictorRunFloat(PD_Predictor* p, const float* const* input_data,
+                         const int* const* input_shapes,
+                         const int* input_ndims, int num_inputs);
+int PD_PredictorGetOutputNum(PD_Predictor* p);
+int PD_PredictorGetOutputNDim(PD_Predictor* p, int idx);
+int PD_PredictorGetOutputShape(PD_Predictor* p, int idx, int* shape_out);
+int PD_PredictorGetOutputData(PD_Predictor* p, int idx, float* dst);
+*/
+import "C"
+
+import (
+	"errors"
+	"runtime"
+	"unsafe"
+)
+
+// Config mirrors the reference AnalysisConfig subset the C API exposes.
+type Config struct {
+	c *C.PD_Config
+}
+
+// NewConfig creates a Config; release with Destroy.
+func NewConfig() *Config {
+	cfg := &Config{c: C.PD_ConfigCreate()}
+	runtime.SetFinalizer(cfg, (*Config).Destroy)
+	return cfg
+}
+
+// SetModel points the config at a `.pdmodel` + `.pdiparams` pair (or a
+// legacy `__model__` + `__params__` directory layout).
+func (cfg *Config) SetModel(progFile, paramsFile string) {
+	p := C.CString(progFile)
+	q := C.CString(paramsFile)
+	defer C.free(unsafe.Pointer(p))
+	defer C.free(unsafe.Pointer(q))
+	C.PD_ConfigSetModel(cfg.c, p, q)
+}
+
+// SwitchIrOptim toggles whole-program XLA compilation (jit) vs the
+// op-by-op interpreter.
+func (cfg *Config) SwitchIrOptim(on bool) {
+	C.PD_ConfigSwitchIrOptim(cfg.c, boolToInt(on))
+}
+
+// EnableMemoryOptim donates feed buffers to the compiled executable.
+func (cfg *Config) EnableMemoryOptim(on bool) {
+	C.PD_ConfigEnableMemoryOptim(cfg.c, boolToInt(on))
+}
+
+// Destroy releases the native config.
+func (cfg *Config) Destroy() {
+	if cfg.c != nil {
+		C.PD_ConfigDestroy(cfg.c)
+		cfg.c = nil
+	}
+}
+
+// Predictor runs a serialized inference program.
+type Predictor struct {
+	p *C.PD_Predictor
+}
+
+// NewPredictor builds a predictor from the config (reference
+// CreatePaddlePredictor).
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	h := C.PD_PredictorCreate(cfg.c)
+	runtime.KeepAlive(cfg)
+	if h == nil {
+		return nil, lastError()
+	}
+	pred := &Predictor{p: h}
+	runtime.SetFinalizer(pred, (*Predictor).Destroy)
+	return pred, nil
+}
+
+// InputNum reports the number of feed targets.
+func (pred *Predictor) InputNum() int {
+	n := int(C.PD_PredictorGetInputNum(pred.p))
+	runtime.KeepAlive(pred)
+	return n
+}
+
+// Run feeds float32 tensors (data + shapes, feed order) and executes the
+// program; fetch results with OutputNum/Output.  Inputs are copied into
+// C memory for the call (cgo forbids passing pointer-to-Go-pointer
+// arrays and storing Go pointers in C memory).
+func (pred *Predictor) Run(inputs [][]float32, shapes [][]int32) error {
+	n := len(inputs)
+	if n != len(shapes) {
+		return errors.New("paddle: len(inputs) != len(shapes)")
+	}
+	if n == 0 {
+		if C.PD_PredictorRunFloat(pred.p, nil, nil, nil, 0) != 0 {
+			return lastError()
+		}
+		runtime.KeepAlive(pred)
+		return nil
+	}
+	ptrSize := unsafe.Sizeof(uintptr(0))
+	dataArr := C.malloc(C.size_t(uintptr(n) * ptrSize))
+	shapeArr := C.malloc(C.size_t(uintptr(n) * ptrSize))
+	ndimArr := C.malloc(C.size_t(n) * C.size_t(unsafe.Sizeof(C.int(0))))
+	defer C.free(dataArr)
+	defer C.free(shapeArr)
+	defer C.free(ndimArr)
+	freeList := make([]unsafe.Pointer, 0, 2*n)
+	defer func() {
+		for _, p := range freeList {
+			C.free(p)
+		}
+	}()
+	dataSlice := unsafe.Slice((**C.float)(dataArr), n)
+	shapeSlice := unsafe.Slice((**C.int)(shapeArr), n)
+	ndimSlice := unsafe.Slice((*C.int)(ndimArr), n)
+	for i := range inputs {
+		nb := C.size_t(len(inputs[i])+1) * C.size_t(unsafe.Sizeof(C.float(0)))
+		dbuf := C.malloc(nb)
+		freeList = append(freeList, dbuf)
+		db := unsafe.Slice((*C.float)(dbuf), len(inputs[i])+1)
+		for j, v := range inputs[i] {
+			db[j] = C.float(v)
+		}
+		dataSlice[i] = (*C.float)(dbuf)
+		sb := C.size_t(len(shapes[i])+1) * C.size_t(unsafe.Sizeof(C.int(0)))
+		sbuf := C.malloc(sb)
+		freeList = append(freeList, sbuf)
+		ss := unsafe.Slice((*C.int)(sbuf), len(shapes[i])+1)
+		for j, d := range shapes[i] {
+			ss[j] = C.int(d)
+		}
+		shapeSlice[i] = (*C.int)(sbuf)
+		ndimSlice[i] = C.int(len(shapes[i]))
+	}
+	rc := C.PD_PredictorRunFloat(pred.p, (**C.float)(dataArr),
+		(**C.int)(shapeArr), (*C.int)(ndimArr), C.int(n))
+	runtime.KeepAlive(pred)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// OutputNum reports the number of fetch targets of the last Run.
+func (pred *Predictor) OutputNum() int {
+	n := int(C.PD_PredictorGetOutputNum(pred.p))
+	runtime.KeepAlive(pred)
+	return n
+}
+
+// Output copies fetch target idx out as (data, shape).
+func (pred *Predictor) Output(idx int) ([]float32, []int32, error) {
+	nd := int(C.PD_PredictorGetOutputNDim(pred.p, C.int(idx)))
+	if nd < 0 {
+		return nil, nil, lastError()
+	}
+	shape := make([]C.int, nd)
+	var sptr *C.int
+	if nd > 0 {
+		sptr = &shape[0]
+	}
+	rcS := C.PD_PredictorGetOutputShape(pred.p, C.int(idx), sptr)
+	runtime.KeepAlive(pred)
+	if rcS != 0 {
+		return nil, nil, lastError()
+	}
+	numel := 1
+	out := make([]int32, nd)
+	for i, d := range shape {
+		out[i] = int32(d)
+		numel *= int(d)
+	}
+	data := make([]float32, numel)
+	var dptr *C.float
+	if numel > 0 {
+		dptr = (*C.float)(unsafe.Pointer(&data[0]))
+	}
+	rc := C.PD_PredictorGetOutputData(pred.p, C.int(idx), dptr)
+	runtime.KeepAlive(pred)
+	if rc != 0 {
+		return nil, nil, lastError()
+	}
+	return data, out, nil
+}
+
+// Destroy releases the native predictor.
+func (pred *Predictor) Destroy() {
+	if pred.p != nil {
+		C.PD_PredictorDestroy(pred.p)
+		pred.p = nil
+	}
+}
+
+func lastError() error {
+	return errors.New("paddle: " + C.GoString(C.PD_GetLastError()))
+}
+
+func boolToInt(b bool) C.int {
+	if b {
+		return 1
+	}
+	return 0
+}

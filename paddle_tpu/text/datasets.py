@@ -8,10 +8,10 @@ yields numpy samples through the `paddle.io.Dataset` protocol.
 
 TPU-image note: this build environment has **zero network egress**, so
 every dataset provides a deterministic synthetic corpus (the default when
-no file is given) preserving the reference's sample *schema* exactly;
-Imdb/Imikolov/UCIHousing additionally accept `data_file=` pointing at a
-pre-downloaded corpus in the reference's archive format, and the others
-raise NotImplementedError on `data_file` rather than silently ignoring it.
+no file is given) preserving the reference's sample *schema* exactly.
+Every dataset ALSO accepts `data_file=` pointing at a pre-downloaded
+corpus in the reference's real archive format (Imdb/Imikolov/UCIHousing/
+Movielens/WMT14/WMT16/Conll05st each carry their reference parser).
 """
 from __future__ import annotations
 
@@ -279,15 +279,39 @@ class UCIHousing(Dataset):
 
 class Conll05st(Dataset):
     """SRL dataset: (word_ids, ctx_n2/n1/0/p1/p2, verb_ids, mark, labels).
-    Reference `text/datasets/conll05.py`."""
+    Reference `text/datasets/conll05.py`.
 
-    def __init__(self, data_file: Optional[str] = None, mode="train",
+    With ``data_file`` (+ the three dictionary files) the REAL CoNLL-2005
+    archive format is parsed: a tar containing
+    ``conll05st-release/test.wsj/words/test.wsj.words.gz`` (one token per
+    line, blank line between sentences) and
+    ``.../props/test.wsj.props.gz`` (per-token columns: predicate lemma or
+    '-', then one bracketed SRL tag column per predicate).  Each
+    (sentence, predicate) pair becomes one sample with the B-/I-/O tag
+    expansion and the +-2 verb context windows the reference emits.
+    Without files, a deterministic synthetic corpus is generated."""
+
+    UNK_IDX = 0
+
+    def __init__(self, data_file: Optional[str] = None,
+                 word_dict_file: Optional[str] = None,
+                 verb_dict_file: Optional[str] = None,
+                 target_dict_file: Optional[str] = None, mode="train",
                  num_samples=256, vocab_size=5000, num_labels=67,
                  seq_len=24):
         if data_file is not None:
-            raise NotImplementedError(
-                f"{type(self).__name__}: archive loading is not implemented;"
-                " omit data_file for the deterministic synthetic corpus")
+            missing = [n for n, f in [("word_dict_file", word_dict_file),
+                                      ("verb_dict_file", verb_dict_file),
+                                      ("target_dict_file",
+                                       target_dict_file)] if f is None]
+            if missing:
+                raise ValueError(
+                    f"Conll05st with data_file also needs {missing}")
+            self.word_dict = self._load_dict(word_dict_file)
+            self.predicate_dict = self._load_dict(verb_dict_file)
+            self.label_dict = self._load_label_dict(target_dict_file)
+            self.samples = self._parse_archive(data_file)
+            return
         r = _rng("conll05", mode)
         self.samples = []
         for _ in range(num_samples):
@@ -299,6 +323,115 @@ class Conll05st(Dataset):
             mark = r.randint(0, 2, (n,)).astype(np.int64)
             labels = r.randint(0, num_labels, (n,)).astype(np.int64)
             self.samples.append((words, *ctxs, verb, mark, labels))
+
+    # -- archive parsing (reference conll05.py formats) ---------------------
+    @staticmethod
+    def _load_dict(filename):
+        with open(filename, "r") as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(filename):
+        """targetDict.txt: B-/I- tag names; ids are (B, I) pairs per tag
+        in sorted order, then 'O' last (the reference iterates a set —
+        sorted here for determinism across runs)."""
+        tags = set()
+        with open(filename, "r") as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d = {}
+        for i, tag in enumerate(sorted(tags)):
+            d["B-" + tag] = 2 * i
+            d["I-" + tag] = 2 * i + 1
+        d["O"] = 2 * len(tags)
+        return d
+
+    def _parse_archive(self, data_file):
+        import gzip
+        import tarfile
+
+        with tarfile.open(data_file) as tf:
+            base = "conll05st-release/test.wsj"
+            wf = tf.extractfile(f"{base}/words/test.wsj.words.gz")
+            pf = tf.extractfile(f"{base}/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words_f, \
+                    gzip.GzipFile(fileobj=pf) as props_f:
+                word_lines = [ln.decode().strip() for ln in words_f]
+                prop_lines = [ln.decode().strip().split()
+                              for ln in props_f]
+        samples = []
+        sent: List[str] = []
+        rows: List[List[str]] = []
+        for word, row in zip(word_lines + [""], prop_lines + [[]]):
+            if row:
+                sent.append(word)
+                rows.append(row)
+                continue
+            if sent:
+                # column 0: predicate lemmas ('-' elsewhere); columns
+                # 1..: one bracketed tag sequence per predicate
+                verbs = [r[0] for r in rows if r[0] != "-"]
+                n_preds = len(rows[0]) - 1
+                for p in range(n_preds):
+                    tags = self._expand_tags([r[p + 1] for r in rows])
+                    samples.append(
+                        self._make_sample(sent, verbs[p], tags))
+            sent, rows = [], []
+        return samples
+
+    @staticmethod
+    def _expand_tags(col):
+        """Bracketed props column -> B-/I-/O sequence (the reference's
+        in-bracket state machine)."""
+        out = []
+        cur, inside = "O", False
+        for tok in col:
+            if tok == "*":
+                out.append("I-" + cur if inside else "O")
+            elif tok == "*)":
+                out.append("I-" + cur)
+                inside = False
+            elif "(" in tok and ")" in tok:
+                cur = tok[1:tok.find("*")]
+                out.append("B-" + cur)
+                inside = False
+            elif "(" in tok:
+                cur = tok[1:tok.find("*")]
+                out.append("B-" + cur)
+                inside = True
+            else:
+                raise RuntimeError(f"unexpected SRL tag {tok!r}")
+        return out
+
+    def _make_sample(self, sentence, predicate, labels):
+        n = len(sentence)
+        v = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, key, pad in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                              (0, "0", None), (1, "p1", "eos"),
+                              (2, "p2", "eos")):
+            j = v + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[key] = sentence[j]
+            else:
+                ctx[key] = pad
+        wd, UNK = self.word_dict, self.UNK_IDX
+        word_idx = np.array([wd.get(w, UNK) for w in sentence], np.int64)
+        ctxs = [np.full((n,), wd.get(ctx[k], UNK), np.int64)
+                for k in ("n2", "n1", "0", "p1", "p2")]
+        pred = np.full((n,), self.predicate_dict.get(predicate, 0),
+                       np.int64)
+        lab = np.array([self.label_dict[w] for w in labels], np.int64)
+        return (word_idx, *ctxs, pred,
+                np.array(mark, np.int64), lab)
+
+    def get_dict(self):
+        """reference `Conll05st.get_dict`."""
+        return self.word_dict, self.predicate_dict, self.label_dict
 
     def __len__(self):
         return len(self.samples)

@@ -211,3 +211,107 @@ class TestWMT:
         val = WMT16(data_file=path, mode="val", lang="en",
                     src_lang_dict_size=5, trg_lang_dict_size=5)
         assert len(val) == 1
+
+
+class TestConll05st:
+    """Real CoNLL-2005 archive format (reference
+    `text/datasets/conll05.py`): words/props gz members in a tar, the
+    bracketed-SRL -> B/I/O expansion, verb context windows."""
+
+    def _archive(self, tmp_path):
+        import gzip
+        import io
+        import tarfile
+
+        # sentence 1: "the cat chased mice ." — predicate 'chase'
+        #   props col0: lemma at the verb row, '-' elsewhere
+        #   props col1: (A0*  *)  (V*)  (A1*)  *
+        words = "the\ncat\nchased\nmice\n.\n\n"
+        props = ("-\t(A0*\n"
+                 "-\t*)\n"
+                 "chase\t(V*)\n"
+                 "-\t(A1*)\n"
+                 "-\t*\n"
+                 "\n")
+        tar_path = tmp_path / "conll05st-tests.tar.gz"
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for member, text in (
+                    ("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                     words),
+                    ("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                     props)):
+                blob = gzip.compress(text.encode())
+                info = tarfile.TarInfo(member)
+                info.size = len(blob)
+                tf.addfile(info, io.BytesIO(blob))
+        (tmp_path / "wordDict.txt").write_text(
+            "the\ncat\nchased\nmice\n.\nbos\neos\n")
+        (tmp_path / "verbDict.txt").write_text("chase\n")
+        (tmp_path / "targetDict.txt").write_text("B-A0\nB-A1\nB-V\nO\n")
+        return tar_path, tmp_path
+
+    def test_parse(self, tmp_path):
+        from paddle_tpu.text.datasets import Conll05st
+
+        tar_path, d = self._archive(tmp_path)
+        ds = Conll05st(data_file=str(tar_path),
+                       word_dict_file=str(d / "wordDict.txt"),
+                       verb_dict_file=str(d / "verbDict.txt"),
+                       target_dict_file=str(d / "targetDict.txt"))
+        assert len(ds) == 1
+        words, n2, n1, c0, p1, p2, pred, mark, lab = ds[0]
+        np.testing.assert_array_equal(words, [0, 1, 2, 3, 4])
+        word_dict, pred_dict, label_dict = ds.get_dict()
+        # verb at index 2: ctx windows the/cat/chased/mice/.
+        assert (n2 == word_dict["the"]).all()
+        assert (n1 == word_dict["cat"]).all()
+        assert (c0 == word_dict["chased"]).all()
+        assert (p1 == word_dict["mice"]).all()
+        assert (p2 == word_dict["."]).all()
+        assert (pred == pred_dict["chase"]).all()
+        np.testing.assert_array_equal(mark, [1, 1, 1, 1, 1])
+        # tags: (A0* *) (V*) (A1*) *  ->  B-A0 I-A0 B-V B-A1 O
+        want = [label_dict[t] for t in
+                ("B-A0", "I-A0", "B-V", "B-A1", "O")]
+        np.testing.assert_array_equal(lab, want)
+
+    def test_context_padding_at_edges(self, tmp_path):
+        from paddle_tpu.text.datasets import Conll05st
+
+        import gzip
+        import io
+        import tarfile
+
+        # verb at index 0 -> n1/n2 pad to 'bos'
+        words = "runs\nfast\n\n"
+        props = "run\t(V*)\n-\t(A1*)\n\n"
+        tar_path = tmp_path / "t.tar.gz"
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for member, text in (
+                    ("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                     words),
+                    ("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                     props)):
+                blob = gzip.compress(text.encode())
+                info = tarfile.TarInfo(member)
+                info.size = len(blob)
+                tf.addfile(info, io.BytesIO(blob))
+        (tmp_path / "w.txt").write_text("runs\nfast\nbos\neos\n")
+        (tmp_path / "v.txt").write_text("run\n")
+        (tmp_path / "t.txt").write_text("B-A1\nB-V\n")
+        ds = Conll05st(data_file=str(tar_path),
+                       word_dict_file=str(tmp_path / "w.txt"),
+                       verb_dict_file=str(tmp_path / "v.txt"),
+                       target_dict_file=str(tmp_path / "t.txt"))
+        words_i, n2, n1, c0, p1, p2, pred, mark, lab = ds[0]
+        wd = ds.word_dict
+        assert (n2 == wd["bos"]).all() and (n1 == wd["bos"]).all()
+        assert (c0 == wd["runs"]).all() and (p1 == wd["fast"]).all()
+        assert (p2 == wd["eos"]).all()
+        np.testing.assert_array_equal(mark, [1, 1])
+
+    def test_synthetic_fallback_unchanged(self):
+        from paddle_tpu.text.datasets import Conll05st
+
+        ds = Conll05st(num_samples=4)
+        assert len(ds) == 4 and len(ds[0]) == 9
