@@ -14,6 +14,8 @@
 
 #include <Python.h>
 
+#include "capi.h"
+
 #include <cstdint>
 #include <cstring>
 #include <mutex>

@@ -1,0 +1,38 @@
+// C inference API (reference inference/capi_exp/pd_*.h surface subset).
+// Implemented by capi.cc (embedded CPython driving the XLA predictor);
+// the Go wrapper (goapi/predictor.go) mirrors these prototypes in its
+// cgo preamble — capi.cc includes this header so the compiler enforces
+// that the canonical signatures never drift from the implementation.
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+
+const char* PD_GetLastError();
+PD_Config* PD_ConfigCreate();
+void PD_ConfigDestroy(PD_Config* c);
+void PD_ConfigSetModel(PD_Config* c, const char* prog_file,
+                       const char* params_file);
+void PD_ConfigSwitchIrOptim(PD_Config* c, int on);
+void PD_ConfigEnableMemoryOptim(PD_Config* c, int on);
+PD_Predictor* PD_PredictorCreate(PD_Config* c);
+void PD_PredictorDestroy(PD_Predictor* p);
+int PD_PredictorGetInputNum(PD_Predictor* p);
+int PD_PredictorRunFloat(PD_Predictor* p, const float* const* input_data,
+                         const int* const* input_shapes,
+                         const int* input_ndims, int num_inputs);
+int PD_PredictorGetOutputNum(PD_Predictor* p);
+int PD_PredictorGetOutputNDim(PD_Predictor* p, int idx);
+int PD_PredictorGetOutputShape(PD_Predictor* p, int idx, int* shape_out);
+int PD_PredictorGetOutputData(PD_Predictor* p, int idx, float* dst);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // PADDLE_TPU_CAPI_H_

@@ -123,6 +123,16 @@ func (pred *Predictor) Run(inputs [][]float32, shapes [][]int32) error {
 	if n != len(shapes) {
 		return errors.New("paddle: len(inputs) != len(shapes)")
 	}
+	for i := range inputs {
+		numel := 1
+		for _, d := range shapes[i] {
+			numel *= int(d)
+		}
+		if numel != len(inputs[i]) {
+			return errors.New("paddle: input data length does not match " +
+				"the product of its shape")
+		}
+	}
 	if n == 0 {
 		if C.PD_PredictorRunFloat(pred.p, nil, nil, nil, 0) != 0 {
 			return lastError()

@@ -218,22 +218,13 @@ class TestConll05st:
     `text/datasets/conll05.py`): words/props gz members in a tar, the
     bracketed-SRL -> B/I/O expansion, verb context windows."""
 
-    def _archive(self, tmp_path):
+    @staticmethod
+    def _build_tar(tmp_path, words, props, name="conll05st-tests.tar.gz"):
         import gzip
         import io
         import tarfile
 
-        # sentence 1: "the cat chased mice ." — predicate 'chase'
-        #   props col0: lemma at the verb row, '-' elsewhere
-        #   props col1: (A0*  *)  (V*)  (A1*)  *
-        words = "the\ncat\nchased\nmice\n.\n\n"
-        props = ("-\t(A0*\n"
-                 "-\t*)\n"
-                 "chase\t(V*)\n"
-                 "-\t(A1*)\n"
-                 "-\t*\n"
-                 "\n")
-        tar_path = tmp_path / "conll05st-tests.tar.gz"
+        tar_path = tmp_path / name
         with tarfile.open(tar_path, "w:gz") as tf:
             for member, text in (
                     ("conll05st-release/test.wsj/words/test.wsj.words.gz",
@@ -244,6 +235,20 @@ class TestConll05st:
                 info = tarfile.TarInfo(member)
                 info.size = len(blob)
                 tf.addfile(info, io.BytesIO(blob))
+        return tar_path
+
+    def _archive(self, tmp_path):
+        # sentence 1: "the cat chased mice ." — predicate 'chase'
+        #   props col0: lemma at the verb row, '-' elsewhere
+        #   props col1: (A0*  *)  (V*)  (A1*)  *
+        words = "the\ncat\nchased\nmice\n.\n\n"
+        props = ("-\t(A0*\n"
+                 "-\t*)\n"
+                 "chase\t(V*)\n"
+                 "-\t(A1*)\n"
+                 "-\t*\n"
+                 "\n")
+        tar_path = self._build_tar(tmp_path, words, props)
         (tmp_path / "wordDict.txt").write_text(
             "the\ncat\nchased\nmice\n.\nbos\neos\n")
         (tmp_path / "verbDict.txt").write_text("chase\n")
@@ -278,24 +283,10 @@ class TestConll05st:
     def test_context_padding_at_edges(self, tmp_path):
         from paddle_tpu.text.datasets import Conll05st
 
-        import gzip
-        import io
-        import tarfile
-
         # verb at index 0 -> n1/n2 pad to 'bos'
         words = "runs\nfast\n\n"
         props = "run\t(V*)\n-\t(A1*)\n\n"
-        tar_path = tmp_path / "t.tar.gz"
-        with tarfile.open(tar_path, "w:gz") as tf:
-            for member, text in (
-                    ("conll05st-release/test.wsj/words/test.wsj.words.gz",
-                     words),
-                    ("conll05st-release/test.wsj/props/test.wsj.props.gz",
-                     props)):
-                blob = gzip.compress(text.encode())
-                info = tarfile.TarInfo(member)
-                info.size = len(blob)
-                tf.addfile(info, io.BytesIO(blob))
+        tar_path = self._build_tar(tmp_path, words, props, "t.tar.gz")
         (tmp_path / "w.txt").write_text("runs\nfast\nbos\neos\n")
         (tmp_path / "v.txt").write_text("run\n")
         (tmp_path / "t.txt").write_text("B-A1\nB-V\n")

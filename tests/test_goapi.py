@@ -15,18 +15,36 @@ GOAPI = os.path.join(REPO, "goapi")
 
 
 class TestGoApi:
-    def test_wrapper_covers_capi_surface(self):
-        """Every PD_* function exported by csrc/capi.cc appears in the Go
-        wrapper's cgo declarations."""
+    @staticmethod
+    def _prototypes(text):
+        """PD_* prototypes normalized to whitespace-free strings."""
         import re
 
-        capi = open(os.path.join(REPO, "csrc", "capi.cc")).read()
+        out = {}
+        for m in re.finditer(
+                r"^[\w* ]*?\b(PD_\w+)\s*\(([^;{]*)\)\s*;", text,
+                re.MULTILINE | re.DOTALL):
+            sig = re.sub(r"\s+", " ", m.group(2)).strip()
+            out[m.group(1)] = sig
+        return out
+
+    def test_wrapper_matches_capi_header(self):
+        """The Go cgo preamble must carry EXACTLY the prototypes of
+        csrc/capi.h (which capi.cc includes, so the compiler pins the
+        header to the implementation — the Go side would otherwise
+        compile against a stale ABI silently)."""
+        header = self._prototypes(
+            open(os.path.join(REPO, "csrc", "capi.h")).read())
         gosrc = open(os.path.join(GOAPI, "predictor.go")).read()
-        exported = set(re.findall(r"^\w[\w* ]*\b(PD_\w+)\(", capi,
-                                  re.MULTILINE))
-        assert exported, "no PD_ exports found in capi.cc?"
-        missing = [f for f in exported if f not in gosrc]
-        assert not missing, f"goapi missing C API functions: {missing}"
+        preamble = gosrc.split("*/")[0]
+        godecls = self._prototypes(preamble)
+        assert header, "no PD_ prototypes found in capi.h?"
+        assert godecls == header, (
+            f"goapi cgo declarations drift from csrc/capi.h:\n"
+            f"only in header: "
+            f"{ {k: v for k, v in header.items() if godecls.get(k) != v} }\n"
+            f"only in go: "
+            f"{ {k: v for k, v in godecls.items() if header.get(k) != v} }")
 
     @pytest.mark.skipif(shutil.which("go") is None,
                         reason="no Go toolchain in this image")

@@ -59,3 +59,24 @@ def test_op_bench_and_gate(tmp_path):
         [sys.executable, "tools/check_op_benchmark_result.py", base,
          empty], cwd=REPO, capture_output=True, text=True, timeout=60)
     assert e.returncode == 2
+
+
+def test_op_bench_gate_device_mismatch(tmp_path):
+    """Cross-device comparisons are incommensurable (a CPU run vs a TPU
+    baseline); the checker must refuse rather than mis-gate."""
+    import json
+    import subprocess
+    import sys
+
+    a = str(tmp_path / "a.json")
+    b = str(tmp_path / "b.json")
+    with open(a, "w") as f:
+        json.dump({"device": "TFRT_CPU_0",
+                   "results": [{"op": "matmul", "mean_us": 10.0}]}, f)
+    with open(b, "w") as f:
+        json.dump({"device": "TPU v5 lite0",
+                   "results": [{"op": "matmul", "mean_us": 10.0}]}, f)
+    r = subprocess.run(
+        [sys.executable, "tools/check_op_benchmark_result.py", a, b],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert r.returncode == 2 and "device mismatch" in r.stdout

@@ -2,12 +2,13 @@
 # Build + test driver (reference counterpart: paddle/scripts/paddle_build.sh,
 # reduced to the TPU build's real steps).
 #
-#   tools/build_and_test.sh [native|test|bench|all]
+#   tools/build_and_test.sh [native|test|bench|bench-ops|all]
 #
-# native : cmake-build csrc/ (runtime lib + C API)
-# test   : full pytest suite on the 8-device virtual CPU mesh
-# bench  : flagship benchmark on the attached accelerator
-# all    : native + test
+# native    : cmake-build csrc/ (runtime lib + C API)
+# test      : full pytest suite on the 8-device virtual CPU mesh
+# bench     : flagship benchmark on the attached accelerator
+# bench-ops : per-op perf regression gate vs the committed CPU baseline
+# all       : native + test + bench-ops
 set -euo pipefail
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 MODE="${1:-all}"
@@ -31,10 +32,34 @@ run_bench() {
   python bench.py
 }
 
+# Op-perf regression gate (VERDICT round-2 item 10): run the per-op
+# micro-benchmarks and compare against the committed baseline; a >2.5x
+# slowdown on any op fails the build.  The wide threshold absorbs
+# shared-runner noise while still catching retrace-per-call /
+# accidental-O(n^2) classes of regression.  Baseline and gate both pin
+# the CPU platform (the checker refuses cross-device comparison).
+# Refresh the baseline with:
+#   python tools/op_bench.py --platform cpu --iters 20 \
+#       --out tools/op_bench_baseline.json
+bench_ops_gate() {
+  cd "$ROOT"
+  local baseline="tools/op_bench_baseline.json"
+  if [ ! -f "$baseline" ]; then
+    echo "no committed op-bench baseline ($baseline) — skipping gate"
+    return 0
+  fi
+  local out
+  out="$(mktemp)"
+  python tools/op_bench.py --platform cpu --out "$out" --iters 20
+  python tools/check_op_benchmark_result.py "$baseline" "$out" \
+    --threshold "${OP_BENCH_THRESHOLD:-2.5}"
+}
+
 case "$MODE" in
   native) build_native ;;
   test)   run_tests ;;
   bench)  run_bench ;;
-  all)    build_native; run_tests ;;
-  *) echo "usage: $0 [native|test|bench|all]" >&2; exit 2 ;;
+  bench-ops) bench_ops_gate ;;
+  all)    build_native; run_tests; bench_ops_gate ;;
+  *) echo "usage: $0 [native|test|bench|bench-ops|all]" >&2; exit 2 ;;
 esac

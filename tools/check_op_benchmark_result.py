@@ -25,9 +25,15 @@ def main():
     def load(path):
         with open(path) as f:
             data = json.load(f)
-        return {r["op"]: r["mean_us"] for r in data["results"]}
+        return (data.get("device", ""),
+                {r["op"]: r["mean_us"] for r in data["results"]})
 
-    base, new = load(args.base), load(args.new)
+    (base_dev, base), (new_dev, new) = load(args.base), load(args.new)
+    if base_dev != new_dev:
+        print(f"device mismatch: baseline {base_dev!r} vs new "
+              f"{new_dev!r} — times are incommensurable; regenerate the "
+              "baseline on the same platform")
+        sys.exit(2)
     if not new:
         print("no results in the new benchmark output — refusing to pass")
         sys.exit(2)

@@ -74,7 +74,15 @@ def main():
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--ops", default=None,
                     help="comma-separated subset of the suite")
+    ap.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                    help="force a jax platform (the CI gate pins cpu so "
+                         "numbers are comparable to the committed "
+                         "baseline; env vars are too late — the axon "
+                         "plugin registers at interpreter start)")
     args = ap.parse_args()
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
 
     suite = default_suite()
     if args.ops:
