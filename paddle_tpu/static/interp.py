@@ -509,6 +509,11 @@ def _gelu(op, scope, feeds, fetches):
 def _leaky_relu(op, scope, feeds, fetches):
     x = scope.fetch(op.input("X"))
     alpha = op.attr("alpha", 0.02)
+    if op.attr("__legacy_formula__", False):
+        # pre-version-1 programs (op_version.py): out = max(x, alpha*x),
+        # which differs when alpha < 0 or alpha > 1
+        scope[op.output("Out")] = jnp.maximum(x, alpha * x)
+        return
     scope[op.output("Out")] = jnp.where(x > 0, x, alpha * x)
 
 

@@ -249,13 +249,51 @@ class Program:
 
     # -- serialization (the reference interchange contract) ------------------
     def serialize_to_string(self) -> bytes:
-        return proto.serialize_program(self.desc)
+        # Stamp current op versions (reference REGISTER_OP_VERSION
+        # registry): a reader of this program must not apply
+        # pre-version-1 compat upgrades to ops we emitted with current
+        # conventions (static/op_version.py).  Serialization works on a
+        # COPY: interpreter-internal attrs are stripped from the wire
+        # format, entries for op types outside our registry are
+        # preserved verbatim, and ops still carrying legacy semantics
+        # (__legacy_formula__ from a v0 load) keep version 0 so any
+        # reader re-applies its own compat translation.
+        import copy
+
+        from .op_version import UPGRADERS
+
+        desc = copy.deepcopy(self.desc)
+        legacy_types = set()
+        present = set()
+        for b in desc.get("blocks", []):
+            for op in b.get("ops", []):
+                present.add(op["type"])
+                attrs = op.get("attrs", [])
+                if any(a.get("name") == "__legacy_formula__"
+                       for a in attrs):
+                    legacy_types.add(op["type"])
+                    op["attrs"] = [a for a in attrs if
+                                   a.get("name") != "__legacy_formula__"]
+        vmap = desc.get("op_version_map") or {}
+        pairs = {p.get("op_name"): p for p in vmap.get("pair", [])}
+        for t in sorted(present & set(UPGRADERS)):
+            ver = 0 if t in legacy_types else                 max(v for v, _ in UPGRADERS[t])
+            pairs[t] = {"op_name": t, "op_version": {"version": ver}}
+        if pairs:
+            desc["op_version_map"] = {
+                "pair": [pairs[k] for k in sorted(pairs)]}
+        return proto.serialize_program(desc)
 
     @classmethod
     def parse_from_string(cls, data: bytes) -> "Program":
+        from .op_version import upgrade_program
+
         p = cls()
         p.desc = proto.parse_program(data)
         p.desc.setdefault("blocks", [])
+        # translate old-version op conventions to current semantics
+        # (reference op_version_registry checkpoint application)
+        upgrade_program(p.desc)
         return p
 
     def clone(self, for_test=False) -> "Program":

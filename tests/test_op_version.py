@@ -1,0 +1,118 @@
+"""Op-version compatibility upgrades (static/op_version.py) —
+reference `framework/op_version_registry.h:142`: programs saved before an
+op's checkpoint carry old conventions that the loader must translate."""
+import numpy as np
+
+import paddle_tpu as paddle  # noqa: F401
+from paddle_tpu.static import Program, proto
+from paddle_tpu.static.interp import ProgramRunner
+from paddle_tpu.static.op_version import (program_op_versions,
+                                          upgrade_program)
+
+
+def _leaky_program(alpha):
+    prog = Program()
+    b = prog.global_block()
+    b.create_var("feed", type=proto.VarType.FEED_MINIBATCH, persistable=True)
+    b.create_var("fetch", type=proto.VarType.FETCH_LIST, persistable=True)
+    b.create_var("x", [-1, 4], "float32", need_check_feed=True)
+    b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+    b.create_var("y", [-1, 4], "float32")
+    b.append_op("leaky_relu", {"X": "x"}, {"Out": "y"}, {"alpha": alpha})
+    b.append_op("fetch", {"X": "y"}, {"Out": "fetch"}, {"col": 0})
+    return prog
+
+
+class TestLeakyReluCheckpoint:
+    """activation_op.cc BugfixWithBehaviorChanged: pre-v1 formula was
+    max(x, alpha*x) — for alpha=2 the two formulas swap branches."""
+
+    X = np.array([[-1.0, 1.0, -2.0, 3.0]], np.float32)
+
+    def test_old_program_keeps_old_math(self):
+        import copy
+
+        prog = _leaky_program(2.0)
+        # a reference-era (v0) program: same ops, no version stamp
+        old = Program()
+        old.desc = copy.deepcopy(prog.desc)
+        old.desc.pop("op_version_map", None)
+        assert program_op_versions(old.desc) == {}
+        upgrade_program(old.desc)
+        (out,) = ProgramRunner(old, {})(self.X)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.maximum(self.X, 2.0 * self.X))
+
+    def test_current_program_roundtrips_with_new_math(self):
+        prog = _leaky_program(2.0)
+        reloaded = Program.parse_from_string(prog.serialize_to_string())
+        # the serializer stamped version 1, so no downgrade to old math
+        assert program_op_versions(reloaded.desc)["leaky_relu"] >= 1
+        (out,) = ProgramRunner(reloaded, {})(self.X)
+        want = np.where(self.X > 0, self.X, 2.0 * self.X)
+        np.testing.assert_allclose(np.asarray(out), want)
+
+
+class TestArgMaxDtypeCheckpoint:
+    """arg_max_op.cc: the dtype default changed -1 -> 3 (int64); old
+    programs carrying -1 mean int64 indices."""
+
+    def test_old_dtype_minus_one_upgraded(self):
+        prog = Program()
+        b = prog.global_block()
+        b.create_var("feed", type=proto.VarType.FEED_MINIBATCH,
+                     persistable=True)
+        b.create_var("fetch", type=proto.VarType.FETCH_LIST,
+                     persistable=True)
+        b.create_var("x", [-1, 4], "float32", need_check_feed=True)
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.create_var("idx", [-1], "int64")
+        b.append_op("arg_max", {"X": "x"}, {"Out": "idx"},
+                    {"axis": -1, "dtype": -1, "keepdims": False})
+        b.append_op("fetch", {"X": "idx"}, {"Out": "fetch"}, {"col": 0})
+        touched = upgrade_program(prog.desc)
+        assert touched == 1
+        from paddle_tpu.static.op_version import _get_attr
+
+        assert _get_attr(prog.desc["blocks"][0]["ops"][1],
+                         "dtype")["i"] == 3
+        x = np.array([[1.0, 5.0, 2.0, 3.0]], np.float32)
+        (out,) = ProgramRunner(prog, {})(x)
+        np.testing.assert_array_equal(np.asarray(out), [1])
+
+
+class TestIoDeletions:
+    def test_roi_align_rpnroislod_dropped(self):
+        desc = {"blocks": [{"idx": 0, "parent_idx": -1, "vars": [],
+                            "ops": [{
+                                "type": "roi_align",
+                                "inputs": [
+                                    {"parameter": "X", "arguments": ["x"]},
+                                    {"parameter": "RpnRoisLod",
+                                     "arguments": ["lod"]}],
+                                "outputs": [], "attrs": []}]}]}
+        assert upgrade_program(desc) == 1
+        params = [s["parameter"]
+                  for s in desc["blocks"][0]["ops"][0]["inputs"]]
+        assert params == ["X"]
+
+
+class TestLegacyRoundtrip:
+    def test_resaved_v0_program_stays_v0_without_internal_attrs(self):
+        import copy
+
+        X = np.array([[-1.0, 1.0, -2.0, 3.0]], np.float32)
+        prog = _leaky_program(2.0)
+        old = Program()
+        old.desc = copy.deepcopy(prog.desc)
+        old.desc.pop("op_version_map", None)
+        upgrade_program(old.desc)  # marks __legacy_formula__
+        # re-save: the wire format must NOT leak the internal attr, and
+        # leaky_relu must stay version 0 so any reader re-upgrades
+        data = old.serialize_to_string()
+        assert b"__legacy_formula__" not in data
+        again = Program.parse_from_string(data)
+        assert program_op_versions(again.desc).get("leaky_relu", 0) == 0
+        (out,) = ProgramRunner(again, {})(X)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.maximum(X, 2.0 * X))
