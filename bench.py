@@ -49,8 +49,11 @@ def main():
     def loss_fn(m, tokens, labels):
         with amp.auto_cast(enable=True, dtype="bfloat16"):
             logits = m(tokens)
-        return nn.functional.cross_entropy(
-            logits.astype("float32"), labels, reduction="mean")
+        # bf16 logits straight into CE: the loss upcasts with f32
+        # accumulation internally (Megatron-style vocab CE) instead of
+        # materializing a [B,S,V] f32 logits tensor
+        return nn.functional.cross_entropy(logits, labels,
+                                           reduction="mean")
 
     step = jit.train_step(model, loss_fn, opt)
 
