@@ -140,6 +140,25 @@ class Tensor:
             raise RuntimeError("copy_from_cpu on an output handle")
         self._owner._inputs[self._name] = np.ascontiguousarray(arr)
 
+    def set_lod(self, lod):
+        """reference ZeroCopyTensor::SetLoD.  Accepts the reference's
+        offset-based level-0 LoD ([[0, l1, l1+l2, ...]]) or a flat
+        per-sequence lengths list; stored as the padded+lengths sidecar
+        the lod_* interchange ops consume."""
+        if not self._is_input:
+            raise RuntimeError("set_lod on an output handle")
+        lod = list(lod)
+        if lod and isinstance(lod[0], (list, tuple, np.ndarray)):
+            if len(lod) != 1:
+                raise NotImplementedError(
+                    "only 1-level LoD is supported by the padded+lengths "
+                    f"redesign; got {len(lod)} levels")
+            off = np.asarray(lod[0], np.int64)
+            lengths = np.diff(off)
+        else:
+            lengths = np.asarray(lod, np.int64)
+        self._owner._lods[self._name] = lengths.astype(np.int32)
+
     def copy_to_cpu(self) -> np.ndarray:
         if self._is_input:
             raise RuntimeError("copy_to_cpu on an input handle")
@@ -171,6 +190,7 @@ class Predictor:
 
         self._config = config
         self._inputs: Dict[str, np.ndarray] = {}
+        self._lods: Dict[str, np.ndarray] = {}
         self._outputs: Dict[str, np.ndarray] = {}
         self._output_names: List[str] = []
         prefix = config._model_prefix or ""
@@ -238,8 +258,16 @@ class Predictor:
         if inputs is None:
             inputs = [self._inputs[n] for n in self._input_names]
         if self._runner is not None:
-            outs = self._runner(*[np.asarray(i) for i in inputs])
+            if self._lods:
+                outs = self._runner.run_with_lods(
+                    [np.asarray(i) for i in inputs], self._lods)
+            else:
+                outs = self._runner(*[np.asarray(i) for i in inputs])
         else:
+            if self._lods:
+                raise NotImplementedError(
+                    "set_lod applies to reference-format (ProgramDesc) "
+                    "models only; the StableHLO export has no LoD inputs")
             outs = self._layer(*inputs)
             outs = outs if isinstance(outs, tuple) else (outs,)
         self._output_names = [f"output_{i}" for i in range(len(outs))]

@@ -118,6 +118,34 @@ def run_block(block_ops: List[Dict[str, Any]], scope: Scope,
                 f"ProgramDesc op {op.type!r} has no TPU translation yet")
         fn(op, scope, feeds, fetch_holder)
         _fold_consts(op)
+        _propagate_lod(op, scope)
+
+
+# Ops whose outputs keep row-for-row correspondence with their primary
+# input, so the padded+lengths @LOD sidecar travels through them (the
+# fluid DynamicRNN pattern applies lod_rank_table to an EMBEDDING output,
+# not the raw feed).
+_LOD_PRESERVING = {
+    "lookup_table", "lookup_table_v2", "c_embedding", "cast", "scale",
+    "assign", "dropout", "relu", "sigmoid", "tanh", "gelu", "softmax",
+    "layer_norm", "matmul_v2", "matmul", "mul", "fc",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "reshape2", "reshape", "sequence_softmax",
+}
+
+
+def _propagate_lod(op: OpView, scope: Scope):
+    if op.type not in _LOD_PRESERVING:
+        return
+    src_args = [a for s in op.desc.get("inputs", [])
+                for a in s.get("arguments", [])]
+    lod = next((scope[a + "@LOD"] for a in src_args
+                if a + "@LOD" in scope), None)
+    if lod is None:
+        return
+    for s in op.desc.get("outputs", []):
+        for a in s.get("arguments", []):
+            scope[a + "@LOD"] = lod
 
 
 def _consts() -> Dict[str, Any]:
@@ -295,6 +323,15 @@ class ProgramRunner:
         outs, _ = self._jit(self.params, feeds)
         return outs
 
+    def run_with_lods(self, inputs, lods):
+        """Run with per-feed sequence lengths (`<name>@LOD` sidecars,
+        the padded+lengths LoD redesign — Predictor handle set_lod)."""
+        feeds = dict(zip(self.feed_names, (jnp.asarray(i) for i in inputs)))
+        for name, lengths in lods.items():
+            feeds[name + "@LOD"] = jnp.asarray(lengths)
+        outs, _ = self._jit(self.params, feeds)
+        return outs
+
     def run_with_scope(self, feeds, params=None):
         """`params` overrides the construction-time parameter values, so
         callers can update weights between runs — the static training
@@ -331,6 +368,10 @@ def _feed(op, scope, feeds, fetches):
     if name not in feeds:
         raise KeyError(f"feed variable {name!r} missing from feed dict")
     scope[name] = jnp.asarray(feeds[name])
+    # padded+lengths LoD sidecar (Predictor handle set_lod): travels with
+    # the feed for the lod_* op family
+    if name + "@LOD" in feeds:
+        scope[name + "@LOD"] = jnp.asarray(feeds[name + "@LOD"])
 
 
 @register("fetch")
@@ -1397,13 +1438,16 @@ _BLOCKS_TLS = _threading.local()
 def blocks_context(blocks):
     prev = getattr(_BLOCKS_TLS, "blocks", None)
     prev_c = getattr(_BLOCKS_TLS, "consts", None)
+    prev_b = getattr(_BLOCKS_TLS, "bounds", None)
     _BLOCKS_TLS.blocks = blocks
     _BLOCKS_TLS.consts = {}
+    _BLOCKS_TLS.bounds = {}
     try:
         yield
     finally:
         _BLOCKS_TLS.blocks = prev
         _BLOCKS_TLS.consts = prev_c
+        _BLOCKS_TLS.bounds = prev_b
 
 
 def _current_blocks():
@@ -1638,7 +1682,12 @@ def _infer_trip_bound(op, scope, sub_ops):
             y = _consts().get(v.input("Y"))
             if y is not None:
                 bound = int(np.asarray(y).reshape(-1)[0])
-                return bound + (1 if v.type == "less_equal" else 0)
+            else:
+                # a STATIC upper bound registered for the RHS (e.g.
+                # max_sequence_len: dynamic value, static T_max)
+                bound = _consts_bounds().get(v.input("Y"))
+            if bound is not None:
+                return int(bound) + (1 if v.type == "less_equal" else 0)
     from ..core import flags as _flags
 
     try:
@@ -1946,3 +1995,173 @@ def _beam_search_decode(op, scope, feeds, fetches):
         keepdims=False).reshape(bsz, k)
     scope[op.output("SentenceIds")] = sent
     scope[op.output("SentenceScores")] = final_scores
+
+
+# ---------------------------------------------------------------------------
+# LoD dynamic-RNN interchange family: lod_rank_table /
+# lod_tensor_to_array / array_to_lod_tensor / shrink_rnn_memory /
+# max_sequence_len / reorder_lod_tensor_by_rank / split_lod_tensor /
+# merge_lod_tensor / lod_reset.
+#
+# Reference: `operators/lod_rank_table_op.cc`,
+# `operators/lod_tensor_to_array_op.cc`, `operators/array_to_lod_tensor_op.cc`,
+# `operators/shrink_rnn_memory_op.cc`, `operators/max_sequence_len_op.cc`,
+# `operators/reorder_lod_tensor_by_rank_op.cc`,
+# `operators/controlflow/` split/merge — the op set fluid's DynamicRNN and
+# IfElse layers emit into machine-translation-era programs.
+#
+# Padded+lengths redesign (the repo's LoD stance): sequence feeds arrive
+# padded [B, T, ...] with their lengths in a `<name>@LOD` sidecar feed
+# (the Predictor input handle's `set_lod`).  The reference SHRINKS the
+# batch as sequences finish (sorted-by-length batches); here the batch
+# stays FULL-width with masking implied by lengths — rows past a
+# sequence's end compute garbage that `array_to_lod_tensor` never emits
+# (it zero-masks beyond each row's length), which preserves the observable
+# semantics with static shapes.  `shrink_rnn_memory` is therefore the
+# identity.
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+class RankTableVal:
+    """LoDRankTable stand-in: sequence order sorted by decreasing length
+    (stable) + the lengths, with the source's static max time kept as
+    pytree aux so while-loop TensorArray capacities stay inferable."""
+
+    def __init__(self, idx, lengths, t_max: int):
+        self.idx = idx            # [B] int32, sorted by length desc
+        self.lengths = lengths    # [B] int32, ORIGINAL order
+        self.t_max = int(t_max)
+
+    def tree_flatten(self):
+        return (self.idx, self.lengths), self.t_max
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+def _lod_lengths(scope, name):
+    key = name + "@LOD"
+    if key not in scope:
+        raise NotImplementedError(
+            f"op needs sequence lengths for {name!r}: feed them via the "
+            "Predictor input handle's set_lod() (padded+lengths LoD "
+            "redesign) — the `<name>@LOD` sidecar is missing")
+    return jnp.asarray(scope[key]).reshape(-1).astype(jnp.int32)
+
+
+@register("lod_rank_table")
+def _lod_rank_table(op, scope, feeds, fetches):
+    name = op.input("X")
+    x = scope.fetch(name)
+    lengths = _lod_lengths(scope, name)
+    # stable sort by decreasing length (reference sorts (len, index))
+    order = jnp.argsort(-lengths, stable=True).astype(jnp.int32)
+    t_max = int(x.shape[1]) if getattr(x, "ndim", 0) >= 2 else \
+        int(lengths.shape[0])
+    scope[op.output("Out")] = RankTableVal(order, lengths, t_max)
+
+
+@register("max_sequence_len")
+def _max_sequence_len(op, scope, feeds, fetches):
+    rt = scope.fetch(op.input("RankTable"))
+    out = op.output("Out")
+    scope[out] = jnp.max(rt.lengths).reshape(1).astype(jnp.int64)
+    # static upper bound for while-loop TensorArray capacity inference
+    _consts_bounds()[out] = rt.t_max
+
+
+def _consts_bounds() -> Dict[str, int]:
+    b = getattr(_BLOCKS_TLS, "bounds", None)
+    if b is None:
+        b = _BLOCKS_TLS.bounds = {}
+    return b
+
+
+@register("lod_tensor_to_array")
+def _lod_tensor_to_array(op, scope, feeds, fetches):
+    """x [B, T, ...] -> TensorArray of T steps, each [B, ...] with rows
+    reordered by the rank table (longest first, like the reference's
+    shrinking batches — but full-width)."""
+    x = jnp.asarray(scope.fetch(op.input("X")))
+    rt = scope.fetch(op.input("RankTable"))
+    xr = x[rt.idx]                       # reorder rows
+    buf = jnp.moveaxis(xr, 1, 0)         # [T, B, ...]
+    scope[op.output("Out")] = TensorArrayVal(
+        buf, jnp.asarray(buf.shape[0], jnp.int32))
+
+
+@register("array_to_lod_tensor")
+def _array_to_lod_tensor(op, scope, feeds, fetches):
+    """TensorArray of per-step [B, ...] rows (rank order) -> padded
+    [B, T, ...] in ORIGINAL order, zero past each sequence's length."""
+    arr = scope.fetch(op.input("X"))
+    rt = scope.fetch(op.input("RankTable"))
+    stacked = jnp.moveaxis(arr.buffer, 0, 1)    # [B(rank order), T, ...]
+    inv = jnp.zeros_like(rt.idx).at[rt.idx].set(
+        jnp.arange(rt.idx.shape[0], dtype=rt.idx.dtype))
+    out = stacked[inv]                          # original order
+    t = out.shape[1]
+    mask = jnp.arange(t)[None, :] < rt.lengths[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (out.ndim - 2))
+    name = op.output("Out")
+    scope[name] = jnp.where(mask, out, 0)
+    scope[name + "@LOD"] = rt.lengths
+
+
+@register("shrink_rnn_memory")
+def _shrink_rnn_memory(op, scope, feeds, fetches):
+    # full-width masked batches: nothing shrinks; rows belonging to
+    # finished sequences keep computing and are masked at emission
+    scope[op.output("Out")] = scope.fetch(op.input("X"))
+
+
+@register("reorder_lod_tensor_by_rank")
+def _reorder_lod_tensor_by_rank(op, scope, feeds, fetches):
+    x = jnp.asarray(scope.fetch(op.input("X")))
+    rt = scope.fetch(op.input("RankTable"))
+    scope[op.output("Out")] = x[rt.idx]
+
+
+@register("split_lod_tensor")
+def _split_lod_tensor(op, scope, feeds, fetches):
+    """reference controlflow/split_lod_tensor_op: route rows by Mask —
+    masked full-width (rows keep their slot; the untaken branch's rows
+    are zeroed), merged back by merge_lod_tensor."""
+    x = jnp.asarray(scope.fetch(op.input("X")))
+    mask = jnp.asarray(scope.fetch(op.input("Mask"))).reshape(-1)
+    m = mask.astype(bool).reshape((-1,) + (1,) * (x.ndim - 1))
+    scope[op.output("OutTrue")] = jnp.where(m, x, 0)
+    scope[op.output("OutFalse")] = jnp.where(m, 0, x)
+
+
+@register("merge_lod_tensor", "merge_lod_tensor_infer")
+def _merge_lod_tensor(op, scope, feeds, fetches):
+    t = jnp.asarray(scope.fetch(op.input("InTrue")))
+    f = jnp.asarray(scope.fetch(op.input("InFalse")))
+    mask = jnp.asarray(scope.fetch(op.input("Mask"))).reshape(-1)
+    m = mask.astype(bool).reshape((-1,) + (1,) * (t.ndim - 1))
+    scope[op.output("Out")] = jnp.where(m, t, f)
+
+
+@register("lod_reset")
+def _lod_reset(op, scope, feeds, fetches):
+    x = scope.fetch(op.input("X"))
+    name = op.output("Out")
+    scope[name] = x
+    if op.input("Y"):
+        ykey = op.input("Y") + "@LOD"
+        if ykey in scope:
+            scope[name + "@LOD"] = scope[ykey]
+        else:
+            # reference lod_reset_op: a plain int Y supplies the target
+            # OFFSETS as data
+            yv = jnp.asarray(scope.fetch(op.input("Y"))).reshape(-1)
+            scope[name + "@LOD"] = jnp.diff(yv).astype(jnp.int32)
+    else:
+        target = op.attr("target_lod", [])
+        if target:
+            # offset-based lod -> lengths
+            off = np.asarray(target, np.int64)
+            scope[name + "@LOD"] = jnp.asarray(np.diff(off), jnp.int32)

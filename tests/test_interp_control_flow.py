@@ -599,3 +599,121 @@ class TestSeq2SeqBeamSearchEndToEnd:
         np.testing.assert_array_equal(np.asarray(sent_ids), want_ids)
         np.testing.assert_allclose(np.asarray(sent_scores), want_scores,
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestDynamicRNNInterchange:
+    """The LoD dynamic-RNN op family fluid's DynamicRNN emits
+    (lod_rank_table / lod_tensor_to_array / shrink_rnn_memory /
+    array_to_lod_tensor ...; reference `operators/lod_rank_table_op.cc`
+    etc.) on the padded+lengths redesign, run end-to-end through the
+    Predictor with the reference's SetLoD input-handle surface."""
+
+    B, T, VOCAB, D = 3, 5, 17, 4
+
+    def _program(self):
+        B, T, D = self.B, self.T, self.D
+        prog = Program()
+        b = prog.global_block()
+        _feed_fetch_vars(b)
+        b.create_var("x", [B, T], "int64", need_check_feed=True,
+                     lod_level=1)
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        for name, shape in (("emb_w", [self.VOCAB, D]),
+                            ("w_x", [D, D]), ("w_h", [D, D])):
+            b.create_var(name, shape, "float32", persistable=True)
+        b.append_op("lookup_table_v2", {"Ids": "x", "W": "emb_w"},
+                    {"Out": "emb"}, {})
+        # the canonical fluid DynamicRNN emission builds the rank table
+        # from the EMBEDDING output (step_input), relying on @LOD
+        # sidecar propagation through lookup_table_v2
+        b.append_op("lod_rank_table", {"X": "emb"}, {"Out": "rt"}, {})
+        b.append_op("max_sequence_len", {"RankTable": "rt"},
+                    {"Out": "maxlen"}, {})
+        b.append_op("lod_tensor_to_array", {"X": "emb", "RankTable": "rt"},
+                    {"Out": "in_arr"}, {})
+        b.append_op("fill_constant", {}, {"Out": "i"},
+                    {"shape": [1], "dtype": 3, "value": 0.0})
+        b.append_op("fill_constant", {}, {"Out": "mem"},
+                    {"shape": [B, D], "dtype": 5, "value": 0.0})
+        b.append_op("less_than", {"X": "i", "Y": "maxlen"},
+                    {"Out": "cond"}, {})
+        body = prog.create_block()
+        body.append_op("read_from_array", {"X": "in_arr", "I": "i"},
+                       {"Out": "x_t"}, {})
+        body.append_op("shrink_rnn_memory",
+                       {"X": "mem", "RankTable": "rt", "I": "i"},
+                       {"Out": "mem_prev"}, {})
+        body.append_op("matmul_v2", {"X": "x_t", "Y": "w_x"},
+                       {"Out": "xp"}, {})
+        body.append_op("matmul_v2", {"X": "mem_prev", "Y": "w_h"},
+                       {"Out": "hp"}, {})
+        body.append_op("elementwise_add", {"X": "xp", "Y": "hp"},
+                       {"Out": "pre"}, {"axis": -1})
+        body.append_op("tanh", {"X": "pre"}, {"Out": "h"}, {})
+        body.append_op("assign", {"X": "h"}, {"Out": "mem"}, {})
+        body.append_op("write_to_array", {"X": "h", "I": "i"},
+                       {"Out": "out_arr"}, {})
+        body.append_op("increment", {"X": "i"}, {"Out": "i"},
+                       {"step": 1.0})
+        body.append_op("less_than", {"X": "i", "Y": "maxlen"},
+                       {"Out": "cond"}, {})
+        b.append_op("while", {"X": ["mem", "i"], "Condition": "cond"},
+                    {"Out": ["out_arr", "mem", "i"], "StepScopes": "ws"},
+                    {"sub_block": BlockRef(body.idx)})
+        b.append_op("array_to_lod_tensor",
+                    {"X": "out_arr", "RankTable": "rt"},
+                    {"Out": "out"}, {})
+        b.append_op("fetch", {"X": "out"}, {"Out": "fetch"}, {"col": 0})
+        return prog
+
+    def test_predictor_with_set_lod_matches_numpy(self, tmp_path):
+        from paddle_tpu import inference
+        from paddle_tpu.static import save_inference_model
+
+        B, T, D = self.B, self.T, self.D
+        rng = np.random.RandomState(11)
+        params = {
+            "emb_w": rng.randn(self.VOCAB, D).astype(np.float32) * 0.5,
+            "w_x": rng.randn(D, D).astype(np.float32) * 0.5,
+            "w_h": rng.randn(D, D).astype(np.float32) * 0.5,
+        }
+        prefix = str(tmp_path / "dynrnn" / "model")
+        save_inference_model(prefix, program=self._program(),
+                             scope=params)
+        pred = inference.create_predictor(inference.Config(prefix))
+
+        x = rng.randint(1, self.VOCAB, (B, T)).astype(np.int64)
+        lengths = np.array([5, 2, 4], np.int64)
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(x)
+        # reference-style offset LoD: [[0, 5, 7, 11]]
+        h.set_lod([np.concatenate([[0], np.cumsum(lengths)])])
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]) \
+            .copy_to_cpu()
+
+        want = np.zeros((B, T, D), np.float32)
+        for j in range(B):
+            hst = np.zeros(D, np.float32)
+            for t in range(int(lengths[j])):
+                hst = np.tanh(params["emb_w"][x[j, t]] @ params["w_x"] +
+                              hst @ params["w_h"])
+                want[j, t] = hst
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_missing_lod_raises_actionably(self, tmp_path):
+        from paddle_tpu.static.interp import ProgramRunner
+        import pytest
+
+        prog = self._program()
+        rng = np.random.RandomState(0)
+        params = {
+            "emb_w": rng.randn(self.VOCAB, self.D).astype(np.float32),
+            "w_x": np.eye(self.D, dtype=np.float32),
+            "w_h": np.eye(self.D, dtype=np.float32),
+        }
+        runner = ProgramRunner(prog, params)
+        x = rng.randint(1, self.VOCAB, (self.B, self.T)).astype(np.int64)
+        with pytest.raises(Exception, match="set_lod"):
+            runner(x)

@@ -41,7 +41,7 @@ class TestInventory:
         import sys
 
         out = subprocess.run(
-            [sys.executable, "tools/op_inventory.py", "--floor", "410"],
+            [sys.executable, "tools/op_inventory.py", "--floor", "422"],
             capture_output=True, text=True)
         assert out.returncode == 0, out.stdout + out.stderr
         assert "0 missing" in out.stdout, out.stdout

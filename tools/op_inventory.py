@@ -184,6 +184,14 @@ ALIASES = {
     "lstm": "nn:LSTM", "gru": "nn:GRU", "rnn": "nn:SimpleRNN",
     "cudnn_lstm": "nn:LSTM", "lstm_unit": "nn:LSTMCell",
     "lstmp": "ops:lstmp",
+    # LoD dynamic-RNN interchange family: interp translators on the
+    # padded+lengths representation (static/interp.py round 3)
+    "lod_rank_table": "interp", "lod_tensor_to_array": "interp",
+    "array_to_lod_tensor": "interp", "shrink_rnn_memory": "interp",
+    "max_sequence_len": "interp", "reorder_lod_tensor_by_rank": "interp",
+    "split_lod_tensor": "interp", "merge_lod_tensor": "interp",
+    "merge_lod_tensor_infer": "interp", "lod_reset": "interp",
+    "lod_array_length": "interp",
     "tree_conv": "ops:tree_conv", "tdm_child": "ops:tdm_child",
     "tdm_sampler": "ops:tdm_sampler", "pyramid_hash": "ops:pyramid_hash",
     "rank_attention": "ops:rank_attention",
@@ -344,15 +352,7 @@ TPU_OBSOLETE = {
     # vendor engines
     "tensorrt_engine": "XLA", "lite_engine": "XLA", "dlnne_engine": "XLA",
     "ascend_trigger": "N/A (Ascend)", "alloc_float_status": "N/A (Ascend)",
-    # LoD plumbing -> padded+lengths representation (ops/sequence.py)
-    "array_to_lod_tensor": "padded repr", "lod_tensor_to_array": "padded",
-    "lod_rank_table": "padded repr", "lod_array_length": "padded repr",
-    "lod_reset": "padded repr", "max_sequence_len": "padded repr",
-    "merge_lod_tensor": "padded repr", "merge_lod_tensor_infer": "padded",
-    "split_lod_tensor": "padded repr",
-    "reorder_lod_tensor_by_rank": "padded repr",
     "rnn_memory_helper": "lax.scan carries",
-    "shrink_rnn_memory": "lax.scan carries",
     "copy_cross_scope": "functional state",
     "delete_var": "XLA buffer lifetime", "get_places": "jax.devices",
     "coalesce_tensor": "XLA fusion",
