@@ -154,6 +154,10 @@ class Tensor:
                     "only 1-level LoD is supported by the padded+lengths "
                     f"redesign; got {len(lod)} levels")
             off = np.asarray(lod[0], np.int64)
+            if off.size < 2 or off[0] != 0 or (np.diff(off) < 0).any():
+                raise ValueError(
+                    "offset LoD must start at 0 and be non-decreasing "
+                    f"(got {off.tolist()})")
             lengths = np.diff(off)
         else:
             lengths = np.asarray(lod, np.int64)

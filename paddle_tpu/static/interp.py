@@ -137,15 +137,23 @@ _LOD_PRESERVING = {
 def _propagate_lod(op: OpView, scope: Scope):
     if op.type not in _LOD_PRESERVING:
         return
-    src_args = [a for s in op.desc.get("inputs", [])
-                for a in s.get("arguments", [])]
-    lod = next((scope[a + "@LOD"] for a in src_args
-                if a + "@LOD" in scope), None)
-    if lod is None:
+    # the sidecar comes only from the ROW operand (X, or Ids for
+    # embeddings) and lands only on outputs whose leading dim still
+    # equals the batch — a reshape2 flatten or a matmul whose LoD
+    # operand is Y must NOT inherit the lengths
+    slot = "Ids" if op.type in ("lookup_table", "lookup_table_v2",
+                                "c_embedding") else "X"
+    name = op.input(slot)
+    if not name or name + "@LOD" not in scope:
         return
+    lod = scope[name + "@LOD"]
+    b = lod.shape[0]
     for s in op.desc.get("outputs", []):
         for a in s.get("arguments", []):
-            scope[a + "@LOD"] = lod
+            out = scope.get(a)
+            if out is not None and getattr(out, "ndim", 0) >= 1 and \
+                    out.shape[0] == b:
+                scope[a + "@LOD"] = lod
 
 
 def _consts() -> Dict[str, Any]:
@@ -328,7 +336,12 @@ class ProgramRunner:
         the padded+lengths LoD redesign — Predictor handle set_lod)."""
         feeds = dict(zip(self.feed_names, (jnp.asarray(i) for i in inputs)))
         for name, lengths in lods.items():
-            feeds[name + "@LOD"] = jnp.asarray(lengths)
+            lengths = jnp.asarray(lengths)
+            if name in feeds and lengths.shape[0] != feeds[name].shape[0]:
+                raise ValueError(
+                    f"set_lod for {name!r}: {lengths.shape[0]} sequence "
+                    f"lengths for a batch of {feeds[name].shape[0]} rows")
+            feeds[name + "@LOD"] = lengths
         outs, _ = self._jit(self.params, feeds)
         return outs
 
@@ -2057,7 +2070,8 @@ def _lod_rank_table(op, scope, feeds, fetches):
     x = scope.fetch(name)
     lengths = _lod_lengths(scope, name)
     # stable sort by decreasing length (reference sorts (len, index))
-    order = jnp.argsort(-lengths, stable=True).astype(jnp.int32)
+    order = jnp.argsort(lengths, stable=True,
+                        descending=True).astype(jnp.int32)
     t_max = int(x.shape[1]) if getattr(x, "ndim", 0) >= 2 else \
         int(lengths.shape[0])
     scope[op.output("Out")] = RankTableVal(order, lengths, t_max)
@@ -2134,6 +2148,10 @@ def _split_lod_tensor(op, scope, feeds, fetches):
     m = mask.astype(bool).reshape((-1,) + (1,) * (x.ndim - 1))
     scope[op.output("OutTrue")] = jnp.where(m, x, 0)
     scope[op.output("OutFalse")] = jnp.where(m, 0, x)
+    xkey = op.input("X") + "@LOD"
+    if xkey in scope:  # full-width rows: both halves keep the lengths
+        scope[op.output("OutTrue") + "@LOD"] = scope[xkey]
+        scope[op.output("OutFalse") + "@LOD"] = scope[xkey]
 
 
 @register("merge_lod_tensor", "merge_lod_tensor_infer")
@@ -2143,6 +2161,11 @@ def _merge_lod_tensor(op, scope, feeds, fetches):
     mask = jnp.asarray(scope.fetch(op.input("Mask"))).reshape(-1)
     m = mask.astype(bool).reshape((-1,) + (1,) * (t.ndim - 1))
     scope[op.output("Out")] = jnp.where(m, t, f)
+    for side in ("InTrue", "InFalse"):
+        key = op.input(side) + "@LOD"
+        if key in scope:
+            scope[op.output("Out") + "@LOD"] = scope[key]
+            break
 
 
 @register("lod_reset")
@@ -2165,3 +2188,149 @@ def _lod_reset(op, scope, feeds, fetches):
             # offset-based lod -> lengths
             off = np.asarray(target, np.int64)
             scope[name + "@LOD"] = jnp.asarray(np.diff(off), jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Sequence-family translators (reference `operators/sequence_ops/`) on the
+# padded+lengths representation: the time dim is X.shape[1], valid steps
+# come from the `@LOD` sidecar (a feed's set_lod, or full length when
+# absent — the dense-batch degenerate case).
+# ---------------------------------------------------------------------------
+
+
+def _seq_lengths_or_full(scope, name, x):
+    key = name + "@LOD"
+    if key in scope:
+        return jnp.asarray(scope[key]).reshape(-1).astype(jnp.int32)
+    t = x.shape[1] if getattr(x, "ndim", 0) >= 2 else 1
+    return jnp.full((x.shape[0],), t, jnp.int32)
+
+
+@register("sequence_pool")
+def _sequence_pool_op(op, scope, feeds, fetches):
+    from ..ops.sequence import sequence_pool
+
+    name = op.input("X")
+    x = scope.fetch(name)
+    lengths = _seq_lengths_or_full(scope, name, x)
+    scope[op.output("Out")] = _via_functional(
+        sequence_pool, x, lengths,
+        pool_type=str(op.attr("pooltype", "SUM")).lower())
+
+
+@register("sequence_softmax")
+def _sequence_softmax_op(op, scope, feeds, fetches):
+    from ..ops.sequence import sequence_softmax
+
+    name = op.input("X")
+    x = scope.fetch(name)
+    lengths = _seq_lengths_or_full(scope, name, x)
+    # @LOD propagation is handled centrally (_LOD_PRESERVING)
+    scope[op.output("Out")] = _via_functional(sequence_softmax, x,
+                                              lengths)
+
+
+@register("sequence_reverse")
+def _sequence_reverse_op(op, scope, feeds, fetches):
+    from ..ops.sequence import sequence_reverse
+
+    name = op.input("X")
+    x = scope.fetch(name)
+    lengths = _seq_lengths_or_full(scope, name, x)
+    scope[op.output("Y")] = _via_functional(sequence_reverse, x,
+                                            lengths)
+    if name + "@LOD" in scope:  # sequence_reverse is not in the
+        # central set (its Y slot name differs); forward explicitly
+        scope[op.output("Y") + "@LOD"] = scope[name + "@LOD"]
+
+
+@register("sequence_mask")
+def _sequence_mask_op(op, scope, feeds, fetches):
+    from .proto import vartype_to_np_dtype
+
+    x = jnp.asarray(scope.fetch(op.input("X")))
+    maxlen = op.attr("maxlen", -1)
+    if maxlen is None or maxlen <= 0:
+        c = _consts().get(op.input("X"))
+        if c is None:
+            raise NotImplementedError(
+                "sequence_mask without a static maxlen attr needs "
+                "statically-known lengths (XLA static shapes); set the "
+                "maxlen attribute")
+        maxlen = int(np.max(np.asarray(c)))
+    dt = vartype_to_np_dtype(op.attr("out_dtype", 3))
+    mask = (jnp.arange(int(maxlen))[None, :] <
+            x.reshape(-1, 1)).astype(dt)
+    scope[op.output("Y")] = mask.reshape(tuple(x.shape) + (int(maxlen),))
+
+
+@register("sequence_pad")
+def _sequence_pad_op(op, scope, feeds, fetches):
+    """Padded+lengths stance: X already arrives padded [B, T, ...]; the
+    op re-pads to the attr maxlen (crop/extend) and emits Length."""
+    name = op.input("X")
+    x = jnp.asarray(scope.fetch(name))
+    lengths = _seq_lengths_or_full(scope, name, x)
+    pad_value = 0.0
+    if op.input("PadValue"):
+        pad_value = scope.fetch(op.input("PadValue"))
+    maxlen = op.attr("padded_length", -1)
+    t = x.shape[1]
+    if maxlen and maxlen > 0 and maxlen != t:
+        if maxlen < t:
+            x = x[:, :maxlen]
+        else:
+            pads = [(0, 0), (0, int(maxlen) - t)] + \
+                [(0, 0)] * (x.ndim - 2)
+            x = jnp.pad(x, pads)
+        t = int(maxlen)
+    # the reference enforces padded_length >= max length; the padded
+    # redesign clamps instead so Length never exceeds the time dim
+    lengths = jnp.minimum(lengths, t)
+    mask = jnp.arange(t)[None, :] < lengths[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    scope[op.output("Out")] = jnp.where(mask, x, pad_value)
+    if op.output("Length"):
+        scope[op.output("Length")] = lengths.astype(jnp.int64)
+
+
+@register("one_hot", "one_hot_v2")
+def _one_hot_op(op, scope, feeds, fetches):
+    from ..ops.creation import one_hot
+
+    x = jnp.asarray(scope.fetch(op.input("X"))).astype(jnp.int32)
+    if x.ndim and x.shape[-1] == 1 and op.type == "one_hot":
+        x = x[..., 0]
+    scope[op.output("Out")] = _via_functional(
+        one_hot, x, int(op.attr("depth", 1)))
+
+
+@register("gather_nd")
+def _gather_nd_op(op, scope, feeds, fetches):
+    from ..ops.manipulation import gather_nd
+
+    scope[op.output("Out")] = _via_functional(
+        gather_nd, scope.fetch(op.input("X")),
+        scope.fetch(op.input("Index")))
+
+
+@register("scatter")
+def _scatter_op(op, scope, feeds, fetches):
+    from ..ops.manipulation import scatter
+
+    scope[op.output("Out")] = _via_functional(
+        scatter, scope.fetch(op.input("X")),
+        scope.fetch(op.input("Ids")), scope.fetch(op.input("Updates")),
+        overwrite=bool(op.attr("overwrite", True)))
+
+
+@register("argsort")
+def _argsort_op(op, scope, feeds, fetches):
+    x = jnp.asarray(scope.fetch(op.input("X")))
+    axis = op.attr("axis", -1)
+    # descending=True (not argsort(-x)): negation mis-sorts unsigned
+    # and bool dtypes
+    idx = jnp.argsort(x, axis=axis, stable=True,
+                      descending=bool(op.attr("descending", False)))
+    scope[op.output("Indices")] = idx.astype(jnp.int64)
+    scope[op.output("Out")] = jnp.take_along_axis(x, idx, axis=axis)

@@ -1,0 +1,157 @@
+"""Sequence-family + utility interp translators
+(`operators/sequence_ops/`, gather_nd/one_hot/argsort/scatter) on the
+padded+lengths representation with @LOD sidecars."""
+import numpy as np
+
+import paddle_tpu as paddle  # noqa: F401
+from paddle_tpu.static import Program, proto
+from paddle_tpu.static.interp import ProgramRunner
+
+
+def _base(prog, feed_specs):
+    b = prog.global_block()
+    b.create_var("feed", type=proto.VarType.FEED_MINIBATCH, persistable=True)
+    b.create_var("fetch", type=proto.VarType.FETCH_LIST, persistable=True)
+    for col, (name, shape, dtype) in enumerate(feed_specs):
+        b.create_var(name, shape, dtype, need_check_feed=True)
+        b.append_op("feed", {"X": "feed"}, {"Out": name}, {"col": col})
+    return b
+
+
+def _run(prog, inputs, lods=None):
+    runner = ProgramRunner(prog, {})
+    if lods:
+        outs = runner.run_with_lods([np.asarray(i) for i in inputs], lods)
+    else:
+        outs = runner(*inputs)
+    return [np.asarray(o) for o in outs]
+
+
+class TestSequenceFamily:
+    X = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    LENS = np.array([3, 2], np.int32)
+
+    def _seq_prog(self, op_type, out_slot="Out", attrs=None):
+        prog = Program()
+        b = _base(prog, [("x", [2, 4, 3], "float32")])
+        b.append_op(op_type, {"X": "x"}, {out_slot: "y"}, attrs or {})
+        b.append_op("fetch", {"X": "y"}, {"Out": "fetch"}, {"col": 0})
+        return prog
+
+    def test_sequence_pool_mean_respects_lod(self):
+        prog = self._seq_prog("sequence_pool", attrs={"pooltype": "MEAN"})
+        (out,) = _run(prog, [self.X], lods={"x": self.LENS})
+        want = np.stack([self.X[0, :3].mean(0), self.X[1, :2].mean(0)])
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    def test_sequence_pool_defaults_full_length(self):
+        prog = self._seq_prog("sequence_pool", attrs={"pooltype": "SUM"})
+        (out,) = _run(prog, [self.X])
+        np.testing.assert_allclose(out, self.X.sum(1), rtol=1e-6)
+
+    def test_sequence_softmax_masks_padding(self):
+        prog = self._seq_prog("sequence_softmax")
+        x = np.random.RandomState(0).randn(2, 4, 1).astype(np.float32)
+        (out,) = _run(prog, [x], lods={"x": self.LENS})
+        # valid positions sum to 1; padding is 0
+        np.testing.assert_allclose(out[0, :3].sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(out[1, :2].sum(), 1.0, rtol=1e-5)
+        assert np.abs(out[1, 2:]).max() == 0
+
+    def test_sequence_reverse(self):
+        prog = self._seq_prog("sequence_reverse", out_slot="Y")
+        (out,) = _run(prog, [self.X], lods={"x": self.LENS})
+        np.testing.assert_allclose(out[0, :3], self.X[0, :3][::-1])
+        np.testing.assert_allclose(out[0, 3], self.X[0, 3])  # pad stays
+        np.testing.assert_allclose(out[1, :2], self.X[1, :2][::-1])
+
+    def test_sequence_mask(self):
+        prog = Program()
+        b = _base(prog, [("lens", [3], "int64")])
+        b.append_op("sequence_mask", {"X": "lens"}, {"Y": "m"},
+                    {"maxlen": 5, "out_dtype": 3})
+        b.append_op("fetch", {"X": "m"}, {"Out": "fetch"}, {"col": 0})
+        (out,) = _run(prog, [np.array([2, 0, 5], np.int64)])
+        want = (np.arange(5)[None, :] <
+                np.array([2, 0, 5])[:, None]).astype(np.int64)
+        np.testing.assert_array_equal(out, want)
+
+    def test_sequence_pad_repads_and_lengths(self):
+        prog = Program()
+        b = _base(prog, [("x", [2, 4, 3], "float32")])
+        b.append_op("sequence_pad", {"X": "x"},
+                    {"Out": "y", "Length": "n"},
+                    {"padded_length": 6})
+        b.append_op("fetch", {"X": "y"}, {"Out": "fetch"}, {"col": 0})
+        b.append_op("fetch", {"X": "n"}, {"Out": "fetch"}, {"col": 1})
+        out, n = _run(prog, [self.X], lods={"x": self.LENS})
+        assert out.shape == (2, 6, 3)
+        np.testing.assert_allclose(out[0, :3], self.X[0, :3])
+        assert np.abs(out[0, 3:]).max() == 0  # padding zeroed
+        np.testing.assert_array_equal(n, [3, 2])
+
+
+class TestUtilityOps:
+    def test_one_hot_v2(self):
+        prog = Program()
+        b = _base(prog, [("x", [4], "int64")])
+        b.append_op("one_hot_v2", {"X": "x"}, {"Out": "y"}, {"depth": 5})
+        b.append_op("fetch", {"X": "y"}, {"Out": "fetch"}, {"col": 0})
+        (out,) = _run(prog, [np.array([0, 3, 1, 4], np.int64)])
+        np.testing.assert_array_equal(out, np.eye(5)[[0, 3, 1, 4]])
+
+    def test_gather_nd(self):
+        prog = Program()
+        b = _base(prog, [("x", [2, 3, 4], "float32"),
+                         ("idx", [2, 2], "int64")])
+        b.append_op("gather_nd", {"X": "x", "Index": "idx"},
+                    {"Out": "y"}, {})
+        b.append_op("fetch", {"X": "y"}, {"Out": "fetch"}, {"col": 0})
+        x = np.random.RandomState(1).randn(2, 3, 4).astype(np.float32)
+        idx = np.array([[1, 2], [0, 0]], np.int64)
+        (out,) = _run(prog, [x, idx])
+        np.testing.assert_allclose(out, np.stack([x[1, 2], x[0, 0]]))
+
+    def test_scatter_overwrite_and_add(self):
+        for overwrite, want_fn in (
+                (True, lambda x, u: np.array([u[0], x[1], u[1]])),
+                (False, lambda x, u: np.array([u[0], x[1], u[1]]))):
+            prog = Program()
+            b = _base(prog, [("x", [3, 2], "float32"),
+                             ("ids", [2], "int64"),
+                             ("u", [2, 2], "float32")])
+            b.append_op("scatter", {"X": "x", "Ids": "ids", "Updates": "u"},
+                        {"Out": "y"}, {"overwrite": overwrite})
+            b.append_op("fetch", {"X": "y"}, {"Out": "fetch"}, {"col": 0})
+            x = np.ones((3, 2), np.float32)
+            u = np.full((2, 2), 7.0, np.float32)
+            (out,) = _run(prog, [x, np.array([0, 2], np.int64), u])
+            np.testing.assert_allclose(out, want_fn(x, u))
+
+    def test_scatter_duplicate_ids_add_accumulates(self):
+        prog = Program()
+        b = _base(prog, [("x", [2, 2], "float32"), ("ids", [2], "int64"),
+                         ("u", [2, 2], "float32")])
+        b.append_op("scatter", {"X": "x", "Ids": "ids", "Updates": "u"},
+                    {"Out": "y"}, {"overwrite": False})
+        b.append_op("fetch", {"X": "y"}, {"Out": "fetch"}, {"col": 0})
+        x = np.ones((2, 2), np.float32)
+        u = np.full((2, 2), 3.0, np.float32)
+        (out,) = _run(prog, [x, np.array([0, 0], np.int64), u])
+        # non-overwrite: slot zeroed then BOTH updates accumulate
+        np.testing.assert_allclose(out[0], [6.0, 6.0])
+        np.testing.assert_allclose(out[1], [1.0, 1.0])
+
+    def test_argsort_descending_stable(self):
+        prog = Program()
+        b = _base(prog, [("x", [2, 4], "float32")])
+        b.append_op("argsort", {"X": "x"},
+                    {"Out": "y", "Indices": "idx"},
+                    {"axis": -1, "descending": True})
+        b.append_op("fetch", {"X": "y"}, {"Out": "fetch"}, {"col": 0})
+        b.append_op("fetch", {"X": "idx"}, {"Out": "fetch"}, {"col": 1})
+        x = np.array([[1.0, 3.0, 3.0, 0.0], [2.0, 2.0, 1.0, 5.0]],
+                     np.float32)
+        y, idx = _run(prog, [x])
+        np.testing.assert_allclose(y[0], [3, 3, 1, 0])
+        np.testing.assert_array_equal(idx[0], [1, 2, 0, 3])  # stable
