@@ -2334,3 +2334,131 @@ def _argsort_op(op, scope, feeds, fetches):
                       descending=bool(op.attr("descending", False)))
     scope[op.output("Indices")] = idx.astype(jnp.int64)
     scope[op.output("Out")] = jnp.take_along_axis(x, idx, axis=axis)
+
+
+@register("rnn")
+def _rnn_unified_op(op, scope, feeds, fetches):
+    """The unified cudnn-style RNN op (`operators/rnn_op.cc`) that
+    paddle-2.x `nn.LSTM/GRU/SimpleRNN` serialize to: Input [T, B, I]
+    (time-major), WeightList flattened as [w_ih, w_hh per (layer, dir)
+    ... then b_ih, b_hh per (layer, dir)], PreState = (h0[, c0]) each
+    [L*D, B, H], optional SequenceLength [B].  Gate orders follow the
+    python cells (`python/paddle/nn/layer/rnn.py`): LSTM i,f,g,o; GRU
+    r,z,c with the reset gate applied AFTER the hidden matmul and
+    h = (h_prev - c) * z + c.  With SequenceLength, states freeze and
+    outputs zero past each row's length (cudnn semantics); the backward
+    direction reverses within the valid region."""
+    mode = op.attr("mode", "LSTM")
+    nl = int(op.attr("num_layers", 1))
+    bidirec = bool(op.attr("is_bidirec", False))
+    nd = 2 if bidirec else 1
+    if not op.attr("is_test", True) and op.attr("dropout_prob", 0.0):
+        raise NotImplementedError(
+            "rnn op: train-mode inter-layer dropout is not translated "
+            "(inference interpreter); run with is_test=True or train "
+            "through the eager nn.LSTM/GRU layers")
+
+    x = jnp.asarray(scope.fetch(op.input("Input")))  # [T, B, I]
+    t_len, bsz = x.shape[0], x.shape[1]
+    # valid-region reverse index map for the backward direction (loop
+    # invariant: depends only on t_len / seq_len)
+    rev_src = None
+    weights = [jnp.asarray(scope.fetch(n))
+               for n in op.inputs("WeightList")]
+    npairs = nl * nd
+    w_ih = weights[0:2 * npairs:2]
+    w_hh = weights[1:2 * npairs:2]
+    has_bias = len(weights) >= 4 * npairs
+    b_ih = weights[2 * npairs:4 * npairs:2] if has_bias else \
+        [0.0] * npairs
+    b_hh = weights[2 * npairs + 1:4 * npairs:2] if has_bias else \
+        [0.0] * npairs
+    pre = [jnp.asarray(scope.fetch(n)) for n in op.inputs("PreState")]
+    seq_len = None
+    if op.input("SequenceLength"):
+        seq_len = jnp.asarray(
+            scope.fetch(op.input("SequenceLength"))).reshape(-1) \
+            .astype(jnp.int32)
+
+    def cell_step(kind, wi, wh, bi, bh, xt, h, c):
+        gates_x = xt @ wi.T + bi
+        gates_h = h @ wh.T + bh
+        if kind == "LSTM":
+            g = gates_x + gates_h
+            i_, f_, g_, o_ = jnp.split(g, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f_) * c + \
+                jax.nn.sigmoid(i_) * jnp.tanh(g_)
+            return jax.nn.sigmoid(o_) * jnp.tanh(c_new), c_new
+        if kind == "GRU":
+            x_r, x_z, x_c = jnp.split(gates_x, 3, axis=-1)
+            h_r, h_z, h_c = jnp.split(gates_h, 3, axis=-1)
+            r = jax.nn.sigmoid(x_r + h_r)
+            z = jax.nn.sigmoid(x_z + h_z)
+            cand = jnp.tanh(x_c + r * h_c)
+            return (h - cand) * z + cand, c
+        act = jnp.tanh if kind == "RNN_TANH" else \
+            (lambda v: jnp.maximum(v, 0))
+        return act(gates_x + gates_h), c
+
+    def run_dir(xs, pair, h0, c0, reverse):
+        wi, wh, bi, bh = (w_ih[pair], w_hh[pair],
+                          b_ih[pair], b_hh[pair])
+        def rev(a):
+            # reverse WITHIN each row's valid region (padding stays)
+            if seq_len is None:
+                return a[::-1]
+            return jnp.take_along_axis(
+                a, rev_src.reshape(t_len, bsz, 1), axis=0)
+
+        if reverse:
+            xs = rev(xs)
+
+        def step(carry, xt_t):
+            h, c = carry
+            xt, tt = xt_t
+            h_new, c_new = cell_step(mode, wi, wh, bi, bh, xt, h, c)
+            if seq_len is not None:
+                live = (tt < seq_len)[:, None]
+                h_new = jnp.where(live, h_new, h)
+                c_new = jnp.where(live, c_new, c)
+            return (h_new, c_new), h_new
+
+        (hT, cT), ys = jax.lax.scan(
+            step, (h0, c0), (xs, jnp.arange(t_len)))
+        if reverse:
+            ys = rev(ys)
+        return ys, hT, cT
+
+    if seq_len is not None and bidirec:
+        tpos = jnp.arange(t_len)[:, None]
+        rev_src = jnp.where(tpos < seq_len[None, :],
+                            seq_len[None, :] - 1 - tpos, tpos)
+    h0s = pre[0]
+    c0s = pre[1] if mode == "LSTM" and len(pre) > 1 else \
+        jnp.zeros_like(pre[0])
+    out = x
+    fin_h, fin_c = [], []
+    for layer in range(nl):
+        ys_dirs = []
+        for d in range(nd):
+            pair = layer * nd + d
+            ys, hT, cT = run_dir(out, pair, h0s[pair], c0s[pair],
+                                 reverse=(d == 1))
+            ys_dirs.append(ys)
+            fin_h.append(hT)
+            fin_c.append(cT)
+        out = ys_dirs[0] if nd == 1 else \
+            jnp.concatenate(ys_dirs, axis=-1)
+    if seq_len is not None:
+        live = (jnp.arange(t_len)[:, None] < seq_len[None, :])
+        out = jnp.where(live[..., None], out, 0)
+    scope[op.output("Out")] = out
+    states = op._out.get("State", [])
+    if states:
+        scope[states[0]] = jnp.stack(fin_h)
+        if mode == "LSTM" and len(states) > 1:
+            scope[states[1]] = jnp.stack(fin_c)
+    if op.output("Reserve"):
+        scope[op.output("Reserve")] = jnp.zeros((1,), jnp.uint8)
+    if op.output("DropoutState"):
+        scope[op.output("DropoutState")] = jnp.zeros((1,), jnp.uint8)

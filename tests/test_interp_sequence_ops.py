@@ -155,3 +155,143 @@ class TestUtilityOps:
         y, idx = _run(prog, [x])
         np.testing.assert_allclose(y[0], [3, 3, 1, 0])
         np.testing.assert_array_equal(idx[0], [1, 2, 0, 3])  # stable
+
+
+class TestUnifiedRnnOp:
+    """The cudnn-style `rnn` op paddle-2.x nn.LSTM/GRU serialize to
+    (`operators/rnn_op.cc`), checked against this framework's eager
+    nn.LSTM/GRU with identical weights."""
+
+    T, B, I, H = 5, 3, 4, 6
+
+    def _weights(self, rng, mode, nl=1, nd=1):
+        g = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1}[mode]
+        ws = []
+        for layer in range(nl):
+            isz = self.I if layer == 0 else self.H * nd
+            for d in range(nd):
+                ws.append(rng.randn(g * self.H, isz).astype(np.float32)
+                          * 0.3)
+                ws.append(rng.randn(g * self.H, self.H).astype(np.float32)
+                          * 0.3)
+        bs = []
+        for layer in range(nl):
+            for d in range(nd):
+                bs.append(rng.randn(g * self.H).astype(np.float32) * 0.1)
+                bs.append(rng.randn(g * self.H).astype(np.float32) * 0.1)
+        return ws + bs
+
+    def _run_op(self, mode, weights, x, h0, c0=None, nl=1, nd=1,
+                seq_len=None):
+        prog = Program()
+        b = _base(prog, [("x", list(x.shape), "float32")])
+        wnames = []
+        for i, w in enumerate(weights):
+            n = f"w{i}"
+            b.create_var(n, list(w.shape), "float32", persistable=True)
+            wnames.append(n)
+        pre = ["h0"] + (["c0"] if c0 is not None else [])
+        b.create_var("h0", list(h0.shape), "float32", persistable=True)
+        params = {f"w{i}": w for i, w in enumerate(weights)}
+        params["h0"] = h0
+        if c0 is not None:
+            b.create_var("c0", list(c0.shape), "float32",
+                         persistable=True)
+            params["c0"] = c0
+        inputs = {"Input": "x", "WeightList": wnames, "PreState": pre}
+        if seq_len is not None:
+            b.create_var("sl", [len(seq_len)], "int32", persistable=True)
+            params["sl"] = seq_len
+            inputs["SequenceLength"] = "sl"
+        outs = {"Out": "out", "State": ["hT"] +
+                (["cT"] if c0 is not None else []),
+                "Reserve": "rsv", "DropoutState": "ds"}
+        b.append_op("rnn", inputs, outs,
+                    {"mode": mode, "num_layers": nl, "is_bidirec": nd == 2,
+                     "hidden_size": self.H, "input_size": self.I,
+                     "dropout_prob": 0.0, "is_test": True})
+        b.append_op("fetch", {"X": "out"}, {"Out": "fetch"}, {"col": 0})
+        b.append_op("fetch", {"X": "hT"}, {"Out": "fetch"}, {"col": 1})
+        runner = ProgramRunner(prog, params)
+        return [np.asarray(o) for o in runner(x)]
+
+    def test_lstm_matches_eager_layer(self):
+        from paddle_tpu import nn
+        import paddle_tpu as paddle
+
+        rng = np.random.RandomState(0)
+        ws = self._weights(rng, "LSTM")
+        x = rng.randn(self.T, self.B, self.I).astype(np.float32)
+        h0 = np.zeros((1, self.B, self.H), np.float32)
+        c0 = np.zeros((1, self.B, self.H), np.float32)
+        out, hT = self._run_op("LSTM", ws, x, h0, c0)
+
+        lstm = nn.LSTM(self.I, self.H, time_major=True)
+        cell = lstm._all_layers[0].cell
+        cell.weight_ih.set_value(paddle.to_tensor(ws[0]))
+        cell.weight_hh.set_value(paddle.to_tensor(ws[1]))
+        cell.bias_ih.set_value(paddle.to_tensor(ws[2]))
+        cell.bias_hh.set_value(paddle.to_tensor(ws[3]))
+        want, _ = lstm(paddle.to_tensor(x))
+        np.testing.assert_allclose(out, np.asarray(want.numpy()),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gru_matches_eager_layer(self):
+        from paddle_tpu import nn
+        import paddle_tpu as paddle
+
+        rng = np.random.RandomState(1)
+        ws = self._weights(rng, "GRU")
+        x = rng.randn(self.T, self.B, self.I).astype(np.float32)
+        h0 = np.zeros((1, self.B, self.H), np.float32)
+        out, hT = self._run_op("GRU", ws, x, h0)
+
+        gru = nn.GRU(self.I, self.H, time_major=True)
+        cell = gru._all_layers[0].cell
+        cell.weight_ih.set_value(paddle.to_tensor(ws[0]))
+        cell.weight_hh.set_value(paddle.to_tensor(ws[1]))
+        cell.bias_ih.set_value(paddle.to_tensor(ws[2]))
+        cell.bias_hh.set_value(paddle.to_tensor(ws[3]))
+        want, _ = gru(paddle.to_tensor(x))
+        np.testing.assert_allclose(out, np.asarray(want.numpy()),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_lstm_sequence_length_freezes_state(self):
+        rng = np.random.RandomState(2)
+        ws = self._weights(rng, "LSTM")
+        x = rng.randn(self.T, self.B, self.I).astype(np.float32)
+        h0 = np.zeros((1, self.B, self.H), np.float32)
+        c0 = np.zeros((1, self.B, self.H), np.float32)
+        seq = np.array([5, 2, 3], np.int32)
+        out, hT = self._run_op("LSTM", ws, x, h0, c0, seq_len=seq)
+        # outputs past each row's length are zero
+        assert np.abs(out[2:, 1]).max() == 0
+        assert np.abs(out[3:, 2]).max() == 0
+        # final state equals the state at t = len-1: recompute row 1 on
+        # its truncated input
+        out2, hT2 = self._run_op("LSTM", ws, x[:2, 1:2].copy(),
+                                 h0[:, 1:2].copy(), c0[:, 1:2].copy())
+        np.testing.assert_allclose(hT[0, 1], hT2[0, 0], rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_bidirectional_multilayer(self):
+        rng = np.random.RandomState(3)
+        ws = self._weights(rng, "GRU", nl=2, nd=2)
+        x = rng.randn(self.T, self.B, self.I).astype(np.float32)
+        h0 = np.zeros((4, self.B, self.H), np.float32)
+        out, hT = self._run_op("GRU", ws, x, h0, nl=2, nd=2)
+        assert out.shape == (self.T, self.B, 2 * self.H)
+        assert hT.shape == (4, self.B, self.H)
+        # numpy reference for layer 0 forward direction, step 0
+        g = ws[0] @ x[0].T  # [3H, B]
+        x_r, x_z, x_c = np.split(g.T + ws[8], 3, axis=-1)
+        h_r, h_z, h_c = np.split(ws[9], 3)
+        r = 1 / (1 + np.exp(-(x_r + h_r)))
+        z = 1 / (1 + np.exp(-(x_z + h_z)))
+        cand = np.tanh(x_c + r * h_c)
+        h1 = (0 - cand) * z + cand
+        # compare against a single-layer single-dir run's first step
+        out1, _ = self._run_op("GRU", [ws[0], ws[1], ws[8], ws[9]],
+                               x[:1], np.zeros((1, self.B, self.H),
+                                               np.float32))
+        np.testing.assert_allclose(out1[0], h1, rtol=1e-4, atol=1e-5)

@@ -181,7 +181,7 @@ ALIASES = {
     "leaky_relu": "F:leaky_relu", "maxout": "F:maxout",
     "sigmoid": "F:sigmoid", "log_softmax": "F:log_softmax",
     "softmax": "F:softmax",
-    "lstm": "nn:LSTM", "gru": "nn:GRU", "rnn": "nn:SimpleRNN",
+    "lstm": "interp", "gru": "interp", "rnn": "interp",
     "cudnn_lstm": "nn:LSTM", "lstm_unit": "nn:LSTMCell",
     "lstmp": "ops:lstmp",
     # LoD dynamic-RNN interchange family: interp translators on the
