@@ -22,6 +22,12 @@ import jax.numpy as jnp
 
 OP_TRANSLATORS: Dict[str, Callable] = {}
 
+# op types whose OUTPUT SHAPE depends on input VALUES (XLA cannot trace
+# them); ProgramRunner falls back to op-by-op execution for programs
+# containing one.  op_bridge extends this set as it registers such ops.
+DYNAMIC_SHAPE_OPS = {"masked_select", "where_index", "unique",
+                     "unique_with_counts", "linspace"}
+
 
 def register(*names):
     def deco(fn):
@@ -55,6 +61,9 @@ class OpView:
     def output(self, name, idx=0, default=None):
         args = self._out.get(name) or []
         return args[idx] if len(args) > idx else default
+
+    def outputs(self, name):
+        return self._out.get(name) or []
 
     def attr(self, name, default=None):
         return self._attrs.get(name, default)
@@ -302,6 +311,22 @@ class ProgramRunner:
         self.feed_names = program.feed_target_names()
         self.fetch_names = program.fetch_target_names()
         ops = program.desc["blocks"][0]["ops"]
+
+        # data-dependent-output-shape ops (masked_select, unique, ...)
+        # cannot live under an XLA trace; the reference executor runs
+        # them fine because it dispatches op-by-op — fall back to that
+        # mode (the un-jitted NaiveExecutor loop) when the program
+        # contains one
+        if jit:
+            dyn = {o["type"] for blk in program.desc["blocks"]
+                   for o in blk["ops"]} & DYNAMIC_SHAPE_OPS
+            if dyn:
+                import warnings
+
+                warnings.warn(
+                    f"program contains data-dependent-shape ops {sorted(dyn)}; "
+                    "running op-by-op without whole-graph XLA compile")
+                jit = False
 
         blocks = program.desc["blocks"]
 
@@ -2462,3 +2487,11 @@ def _rnn_unified_op(op, scope, feeds, fetches):
         scope[op.output("Reserve")] = jnp.zeros((1,), jnp.uint8)
     if op.output("DropoutState"):
         scope[op.output("DropoutState")] = jnp.zeros((1,), jnp.uint8)
+
+
+# ---------------------------------------------------------------------------
+# declarative OpDesc->eager bridge: registers translators for every
+# remaining implemented eager op (reference executor.cc:166 contract —
+# any registered op is runnable from a ProgramDesc)
+# ---------------------------------------------------------------------------
+from . import op_bridge  # noqa: E402,F401  (registers into OP_TRANSLATORS)

@@ -1,0 +1,462 @@
+"""Declarative OpDesc -> eager bridge.
+
+Reference counterpart: the reference executor runs ANY registered op out
+of a ProgramDesc (`paddle/fluid/framework/executor.cc:166` — OpRegistry
+lookup + `op->Run`), so every op in the operator library is reachable
+from a serialized program.  Rounds 1-3 hand-wrote ~178 translators; this
+module closes the remaining gap *declaratively*: each entry names the
+eager function (already implemented under paddle_tpu/*) plus the OpDesc
+input / attr / output parameter-name map, with the parameter and attr
+names taken from the reference op makers (the interchange schema, e.g.
+`paddle/fluid/operators/flip_op.cc` AddInput("X")/AddAttr("axis")).
+
+The generic runner fetches inputs from the interp scope, converts attrs,
+calls the eager function inside the interp trace (dispatch handles
+tracers transparently — same mechanism as interp._via_functional), and
+stores outputs — so a bridged block still compiles to ONE XLA
+computation.
+
+Spec DSL
+--------
+``b("flip reverse", "P:flip", ins="X", attrs="axis")``
+
+* names: space-separated op types sharing one spec
+* target: "<mod>:<attr>" resolved lazily (P=paddle_tpu, F=nn.functional,
+  ops, seq=ops.sequence, vops=vision.ops, vdet=vision.detection,
+  quant=quantization, metric) or a callable ``fn(*arrays, **attrs)``
+* ins: tokens ``Name`` (required), ``?Name`` (optional -> omitted),
+  ``*Name`` (variadic -> list of arrays)
+* attrs: tokens ``name``, ``name->kw`` (rename), with optional ``@conv``
+  converter (``dtype`` = VarType code -> numpy dtype string, ``ints`` =
+  coerce to list of int).  An attr absent from the OpDesc is not passed,
+  so the eager default applies.
+* outs: tokens ``Name`` (required), ``?Name`` (skipped when the op desc
+  doesn't declare it or the fn returned None), ``*Name`` (fn returns a
+  sequence distributed over the output slot's argument list)
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .interp import OP_TRANSLATORS, register
+
+_MODS = {
+    "P": "paddle_tpu",
+    "F": "paddle_tpu.nn.functional",
+    "ops": "paddle_tpu.ops",
+    "seq": "paddle_tpu.ops.sequence",
+    "vops": "paddle_tpu.vision.ops",
+    "vdet": "paddle_tpu.vision.detection",
+    "quant": "paddle_tpu.quantization",
+    "metric": "paddle_tpu.metric",
+    "nnu": "paddle_tpu.nn.utils",
+}
+
+
+def _resolve(target: str) -> Callable:
+    mod, _, attr = target.partition(":")
+    fn = importlib.import_module(_MODS[mod])
+    for part in attr.split("."):
+        fn = getattr(fn, part)
+    return fn
+
+
+def _conv_dtype(v):
+    from .proto import vartype_to_np_dtype
+
+    return vartype_to_np_dtype(int(v))
+
+
+_CONVS = {
+    "dtype": _conv_dtype,
+    "ints": lambda v: [int(x) for x in v],
+    "int": int,
+    "float": float,
+    "bool": bool,
+}
+
+
+def _unwrap(x):
+    from ..core.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        return x._array
+    return x
+
+
+class _Spec:
+    __slots__ = ("target", "ins", "attrs", "outs", "_fn")
+
+    def __init__(self, target, ins, attrs, outs):
+        self.target = target
+        self.ins = [(t.lstrip("?*"), t[0] if t[0] in "?*" else "")
+                    for t in ins.split()] if ins else []
+        self.attrs = []
+        for tok in (attrs.split() if attrs else []):
+            name, _, conv = tok.partition("@")
+            src, _, kw = name.partition("->")
+            self.attrs.append((src, kw or src,
+                               _CONVS[conv] if conv else None))
+        self.outs = [(t.lstrip("?*"), t[0] if t[0] in "?*" else "")
+                     for t in outs.split()] if outs else []
+        self._fn = None
+
+    def fn(self):
+        if self._fn is None:
+            self._fn = (self.target if callable(self.target)
+                        else _resolve(self.target))
+        return self._fn
+
+
+def _run_spec(spec: _Spec, op, scope, feeds, fetches):
+    args = []
+    for name, mode in spec.ins:
+        if mode == "*":
+            args.append([scope.fetch(a) for a in op.inputs(name)])
+        else:
+            arg = op.input(name)
+            if not arg:
+                if mode == "?":
+                    args.append(None)  # keep positional alignment
+                    continue
+                raise KeyError(
+                    f"{op.type}: required input {name!r} missing")
+            args.append(scope.fetch(arg))
+    kw = {}
+    for src, dst, conv in spec.attrs:
+        if src in op._attrs:
+            v = op._attrs[src]
+            kw[dst] = conv(v) if conv else v
+    out = spec.fn()(*args, **kw)
+    _store_outs(spec, op, scope, out)
+
+
+def _store_outs(spec, op, scope, out):
+    if isinstance(out, (tuple, list)) and not (
+            len(spec.outs) == 1 and spec.outs[0][1] != "*"):
+        vals = list(out)
+    else:
+        vals = [out]
+    vi = 0
+    for name, mode in spec.outs:
+        slots = op.outputs(name)
+        if mode == "*":
+            seq = vals[vi] if len(spec.outs) > 1 else vals
+            if len(seq) == 1 and isinstance(seq[0], (tuple, list)):
+                seq = seq[0]
+            for slot, v in zip(slots, seq):
+                scope[slot] = _unwrap(v)
+            vi += 1
+            continue
+        if not slots:
+            if mode == "?":
+                vi += 1
+                continue
+            raise KeyError(f"{op.type}: output slot {name!r} undeclared")
+        v = vals[vi] if vi < len(vals) else None
+        vi += 1
+        if v is None:
+            if mode == "?":
+                continue
+            raise ValueError(f"{op.type}: no value for output {name!r}")
+        scope[slots[0]] = _unwrap(v)
+
+
+BRIDGED: Dict[str, _Spec] = {}
+
+
+def b(names: str, target, ins="X", attrs="", outs="Out"):
+    spec = _Spec(target, ins, attrs, outs)
+    for n in names.split():
+        if n in OP_TRANSLATORS:  # hand-written translators win
+            continue
+        BRIDGED[n] = spec
+
+        def _t(op, scope, feeds, fetches, _s=spec):
+            _run_spec(_s, op, scope, feeds, fetches)
+
+        OP_TRANSLATORS[n] = _t
+
+
+# ---------------------------------------------------------------------------
+# tensor math / manipulation (reference op makers under
+# paddle/fluid/operators/*.cc — names cited per entry where non-obvious)
+# ---------------------------------------------------------------------------
+b("flip", "P:flip", ins="X", attrs="axis")
+b("reverse", "P:flip", ins="X", attrs="axis")  # reverse_op.cc: axis ints
+b("roll", "P:roll", ins="X", attrs="shifts axis")
+b("strided_slice", lambda x, axes=(), starts=(), ends=(), strides=(),
+    decrease_axis=(), infer_flags=():
+    _strided_slice(x, axes, starts, ends, strides, decrease_axis),
+  ins="Input", attrs="axes starts ends strides decrease_axis infer_flags")
+b("index_select", "P:index_select", ins="X Index", attrs="dim->axis")
+b("index_sample", "P:index_sample", ins="X Index")
+b("tril_triu", lambda x, diagonal=0, lower=True:
+    (jnp.tril if lower else jnp.triu)(x, k=int(diagonal)),
+  ins="X", attrs="diagonal lower")
+b("unbind", "P:unbind", ins="X", attrs="axis", outs="*Out")
+b("unstack", "P:unstack", ins="X", attrs="axis num", outs="*Y")
+b("meshgrid", "P:meshgrid", ins="*X", outs="*Out")
+b("expand", lambda x, expand_times=():
+    jnp.tile(x, tuple(int(t) for t in expand_times)),
+  ins="X", attrs="expand_times")
+b("expand_as", lambda x, y: jnp.tile(
+    x, tuple(t // s for t, s in zip(y.shape, x.shape))),
+  ins="X target_tensor")  # fluid v1 expand_as tiles by integer multiples
+b("expand_as_v2", lambda x, target_shape=():
+    jnp.broadcast_to(x, tuple(int(s) for s in target_shape)),
+  ins="X", attrs="target_shape")
+b("bmm", "P:bmm", ins="X Y")
+b("mv", lambda x, vec: jnp.matmul(x, vec), ins="X Vec")
+b("dot", "P:dot", ins="X Y")
+b("cross", "P:cross", ins="X Y", attrs="dim->axis")
+b("kron", "P:kron", ins="X Y")
+b("addmm", "P:addmm", ins="Input X Y", attrs="Alpha->alpha Beta->beta")
+b("diag_v2", "P:diag", ins="X", attrs="offset padding_value")
+b("diag_embed", "P:diag_embed", ins="Input",
+  attrs="offset dim1 dim2")
+b("diagonal", "P:diagonal", ins="Input", attrs="offset axis1 axis2")
+b("trace", "P:trace", ins="Input", attrs="offset axis1 axis2")
+b("inverse", "P:inverse", ins="Input", outs="Output")
+b("cholesky", "P:cholesky", ins="X", attrs="upper")
+b("histogram", "P:histogram", ins="X", attrs="bins min max")
+b("masked_select", "P:masked_select", ins="X Mask", outs="Y")
+b("multiplex", lambda inputs, ids:
+    jnp.take_along_axis(
+        jnp.stack(inputs), ids.reshape(1, -1, *([1] * (inputs[0].ndim - 1))
+                                       ).astype(jnp.int32), axis=0)[0],
+  ins="*X Ids")
+b("broadcast_tensors", "P:broadcast_tensors", ins="*X", outs="*Out")
+b("allclose", "P:allclose", ins="Input Other",
+  attrs="rtol@float atol@float equal_nan")
+b("atan2", "P:atan2", ins="X1 X2")
+b("digamma", "P:digamma")
+b("lgamma", "P:lgamma")
+b("expm1", lambda x: jnp.expm1(x))
+b("trunc", "P:trunc", ins="X")
+b("logsumexp", "P:logsumexp", ins="X",
+  attrs="axis keepdim")
+b("conj", "P:conj")
+b("real", "P:real")
+b("imag", "P:imag")
+b("arg_min", lambda x, axis=0, keepdims=False, dtype=3, flatten=False:
+    jnp.argmin(x.reshape(-1) if flatten else x,
+               axis=None if flatten else int(axis),
+               keepdims=keepdims and not flatten).astype(_conv_dtype(dtype)),
+  ins="X", attrs="axis keepdims dtype flatten")
+b("dist", "P:dist", ins="X Y", attrs="p")
+b("eye", lambda num_rows=0, num_columns=-1, dtype=5:
+    jnp.eye(int(num_rows),
+            int(num_columns) if int(num_columns) >= 0 else None,
+            dtype=_conv_dtype(dtype)),
+  ins="", attrs="num_rows num_columns dtype")
+b("size", lambda x: jnp.asarray(int(np.prod(x.shape)), jnp.int64),
+  ins="Input")
+b("linspace", lambda start, stop, num, dtype=5:
+    jnp.linspace(start.reshape(()), stop.reshape(()),
+                 int(num.reshape(())),
+                 dtype=_conv_dtype(dtype)),
+  ins="Start Stop Num", attrs="dtype")
+b("crop", lambda x, offsets=(), shape=():
+    jax.lax.dynamic_slice(x, [int(o) for o in offsets],
+                          [int(s) for s in shape]),
+  ins="X", attrs="offsets shape")
+b("crop_tensor", lambda x, offsets=(), shape=():
+    jax.lax.dynamic_slice(
+        x, [int(o) for o in (offsets or [0] * x.ndim)],
+        [x.shape[i] if int(s) == -1 else int(s)
+         for i, s in enumerate(shape or x.shape)]),
+  ins="X", attrs="offsets shape")
+b("scatter_nd_add", "P:scatter_nd_add", ins="X Index Updates")
+b("gather_tree", "ops:gather_tree", ins="Ids Parents")
+b("segment_pool", lambda x, seg, pooltype="SUM":
+    _seg_pool(x, seg, pooltype),
+  ins="X SegmentIds", attrs="pooltype", outs="Out ?SummedIds")
+
+
+def _seg_pool(x, seg, pooltype):
+    from paddle_tpu import ops as _ops
+
+    return _ops.segment_pool(x, seg, pool_type=pooltype.lower())
+b("where_index", lambda x: jnp.stack(jnp.nonzero(x), axis=1)
+    .astype(jnp.int64), ins="Condition")
+b("minus", lambda x, y: x - y, ins="X Y")
+b("grad_add", lambda x, y: x + y, ins="X Y")
+b("squared_l2_norm", lambda x: jnp.sum(jnp.square(x)).reshape(1))
+b("l1_norm", lambda x: jnp.sum(jnp.abs(x)).reshape(1))
+b("frobenius_norm", lambda x, dim=(), keep_dim=False, reduce_all=False:
+    jnp.sqrt(jnp.sum(jnp.square(x),
+                     axis=None if reduce_all or not dim
+                     else tuple(int(d) for d in dim),
+                     keepdims=keep_dim)),
+  ins="X", attrs="dim keep_dim reduce_all")
+b("shard_index", "ops:shard_index", ins="X",
+  attrs="index_num nshards shard_id ignore_value")
+b("unique", lambda x, dtype=3, return_index=False, return_inverse=False,
+    return_counts=False, axis=(), is_sorted=True:
+    _unique(x, dtype, return_index, return_inverse, return_counts, axis),
+  ins="X", attrs="dtype return_index return_inverse return_counts "
+                 "axis is_sorted",
+  outs="Out ?Indices ?Index ?Counts")
+b("unique_with_counts", lambda x, dtype=2:
+    _unique_with_counts(x, dtype),
+  ins="X", attrs="dtype", outs="Out Index Count")
+b("fill", lambda shape=(), value=0.0, dtype=5:
+    jnp.full([int(s) for s in shape], value, _conv_dtype(dtype)),
+  ins="", attrs="shape value dtype")
+b("fill_constant_batch_size_like",
+  lambda x, shape=(), value=0.0, dtype=5, input_dim_idx=0,
+  output_dim_idx=0: _batch_size_like(x, shape, input_dim_idx,
+                                     output_dim_idx, value,
+                                     _conv_dtype(dtype)),
+  ins="Input", attrs="shape value dtype input_dim_idx output_dim_idx")
+b("empty", lambda shape=(), dtype=5:
+    jnp.zeros([int(s) for s in shape], _conv_dtype(dtype)),
+  ins="", attrs="shape dtype")
+b("seed", lambda seed=0: jnp.asarray(seed or 0, jnp.int32),
+  ins="", attrs="seed")
+
+
+def _strided_slice(x, axes, starts, ends, strides, decrease_axis):
+    idx = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        ax = int(ax) % x.ndim
+        n = x.shape[ax]
+        s, e, st = int(s), int(e), int(st)
+        # reference clamps INT_MAX/negative bounds (strided_slice_op.h)
+        if s < 0:
+            s += n
+        if e < 0:
+            e += n
+        if st > 0:
+            e = min(e, n)
+        elif e < 0:
+            # end walked past the front (e.g. ends=[-n-1] or INT_MIN with
+            # a negative stride): python slice needs None, -1 would mean
+            # "stop before the last element"
+            e = None
+        idx[ax] = slice(s, e, st)
+    out = x[tuple(idx)]
+    if decrease_axis:
+        out = out.reshape([d for i, d in enumerate(out.shape)
+                           if i not in {int(a) for a in decrease_axis}])
+    return out
+
+
+def _unique(x, dtype, return_index, return_inverse, return_counts, axis):
+    axis = int(axis[0]) if axis else None
+    res = jnp.unique(x, return_index=True, return_inverse=True,
+                     return_counts=True, axis=axis)
+    out, index, inverse, counts = res
+    idt = _conv_dtype(dtype)
+    vals = [out]
+    vals.append(index.astype(idt) if return_index else None)
+    vals.append(inverse.reshape(-1).astype(idt) if return_inverse else None)
+    vals.append(counts.astype(idt) if return_counts else None)
+    return tuple(vals)
+
+
+def _unique_with_counts(x, dtype):
+    out, inverse, counts = jnp.unique(x, return_inverse=True,
+                                      return_counts=True)
+    idt = _conv_dtype(dtype)
+    return out, inverse.reshape(-1).astype(idt), counts.astype(idt)
+
+
+def _batch_size_like(x, shape, in_idx, out_idx, value, dtype):
+    return jnp.full(_bsl_shape(x, shape, in_idx, out_idx), value, dtype)
+
+
+# random family: key = PRNGKey(op seed attr) folded with a crc of the
+# output var name, so two random ops in one program draw DIFFERENT
+# samples (the hand-written uniform_random translator's stance, hardened
+# per round-4 review). Program-level reproducibility still holds: same
+# program + same seeds -> same draws.
+def _op_key(op, seed=0):
+    import zlib
+
+    return jax.random.fold_in(jax.random.PRNGKey(seed or 0),
+                              zlib.crc32(op.output("Out").encode()))
+
+
+def braw(*names):
+    """Register a raw translator (full op access) under the bridge's
+    'hand over only if unclaimed' rule, and record it as bridged."""
+    def deco(fn):
+        for n in names:
+            if n not in OP_TRANSLATORS:
+                OP_TRANSLATORS[n] = fn
+                BRIDGED[n] = fn
+        return fn
+    return deco
+
+
+@braw("bernoulli")
+def _bernoulli(op, scope, feeds, fetches):
+    x = scope.fetch(op.input("X"))
+    scope[op.output("Out")] = jax.random.bernoulli(
+        _op_key(op), x.astype(jnp.float32)).astype(x.dtype)
+
+
+@braw("multinomial")
+def _multinomial(op, scope, feeds, fetches):
+    x = scope.fetch(op.input("X"))
+    k = int(op.attr("num_samples", 1))
+    logits = jnp.log(x.astype(jnp.float32) + 1e-30)
+    if op.attr("replacement", False):
+        out = jax.random.categorical(_op_key(op), logits,
+                                     shape=x.shape[:-1] + (k,))
+    else:
+        # Gumbel top-k == sampling without replacement
+        g = jax.random.gumbel(_op_key(op), logits.shape)
+        _, out = jax.lax.top_k(logits + g, k)
+    scope[op.output("Out")] = out.astype(jnp.int64)
+
+
+@braw("sampling_id")
+def _sampling_id(op, scope, feeds, fetches):
+    x = scope.fetch(op.input("X"))
+    scope[op.output("Out")] = jax.random.categorical(
+        _op_key(op, op.attr("seed", 0)), jnp.log(x + 1e-30),
+        axis=-1).astype(jnp.int64)
+b("randint", lambda shape=(), low=0, high=0, dtype=3, seed=0:
+    jax.random.randint(jax.random.PRNGKey(seed or 0),
+                       [int(s) for s in shape], int(low), int(high)
+                       ).astype(_conv_dtype(dtype)),
+  ins="", attrs="shape low high dtype seed")
+b("randperm", lambda n=0, dtype=3, seed=0:
+    jax.random.permutation(jax.random.PRNGKey(seed or 0), int(n)
+                           ).astype(_conv_dtype(dtype)),
+  ins="", attrs="n dtype seed")
+b("gaussian_random_batch_size_like",
+  lambda x, shape=(), input_dim_idx=0, output_dim_idx=0, mean=0.0,
+  std=1.0, seed=0, dtype=5: mean + std * jax.random.normal(
+      jax.random.PRNGKey(seed or 0),
+      _bsl_shape(x, shape, input_dim_idx, output_dim_idx),
+      jnp.float32).astype(_conv_dtype(dtype)),
+  ins="Input", attrs="shape input_dim_idx output_dim_idx mean std "
+                     "seed dtype")
+b("uniform_random_batch_size_like",
+  lambda x, shape=(), input_dim_idx=0, output_dim_idx=0, min=-1.0,
+  max=1.0, seed=0, dtype=5: jax.random.uniform(
+      jax.random.PRNGKey(seed or 0),
+      _bsl_shape(x, shape, input_dim_idx, output_dim_idx),
+      jnp.float32, min, max).astype(_conv_dtype(dtype)),
+  ins="Input", attrs="shape input_dim_idx output_dim_idx min max "
+                     "seed dtype")
+b("truncated_gaussian_random", lambda shape=(), mean=0.0, std=1.0,
+    seed=0, dtype=5: mean + std * jax.random.truncated_normal(
+        jax.random.PRNGKey(seed or 0), -2.0, 2.0,
+        [int(s) for s in shape]).astype(_conv_dtype(dtype)),
+  ins="", attrs="shape mean std seed dtype")
+
+
+def _bsl_shape(x, shape, in_idx, out_idx):
+    shape = [int(s) for s in shape]
+    shape[int(out_idx)] = x.shape[int(in_idx)]
+    return shape
