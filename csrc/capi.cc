@@ -82,6 +82,8 @@ typedef struct PD_Config {
 typedef struct PD_Predictor {
   PyObject* predictor = nullptr;       // paddle_tpu.inference.Predictor
   PyObject* outputs = nullptr;         // list of contiguous f32 ndarrays
+  std::vector<std::string> input_names;    // c_str cache for name getters
+  std::vector<std::string> output_names;
 } PD_Predictor;
 
 const char* PD_GetLastError() { return g_last_error.c_str(); }
@@ -286,5 +288,261 @@ int PD_PredictorGetOutputData(PD_Predictor* p, int idx, float* dst) {
   PyBuffer_Release(&view);
   return 0;
 }
+
+
+// ---------------------------------------------------------------------------
+// named-handle + typed-tensor surface (reference capi_exp/pd_predictor.h
+// handle API, pd_tensor.h:78,133,182,222 typed CopyFrom/ToCpu).  A
+// PD_Tensor wraps the Python-side inference.Tensor handle; CopyFromCpu
+// materializes a numpy array of the declared shape/dtype and hands it to
+// the handle, CopyToCpu memcpys out of the handle's fetched ndarray.
+// ---------------------------------------------------------------------------
+
+typedef struct PD_Tensor {
+  PyObject* handle = nullptr;           // paddle_tpu.inference.Tensor
+  std::vector<int32_t> pending_shape;   // set by PD_TensorReshape
+  PyObject* fetched = nullptr;          // contiguous ndarray after CopyToCpu
+} PD_Tensor;
+
+namespace {
+
+PyObject* predictor_names(PD_Predictor* p, const char* method) {
+  PyObject* names = PyObject_CallMethod(p->predictor, method, "");
+  if (!names) fetch_py_error();
+  return names;
+}
+
+const char* name_at(PD_Predictor* p, const char* method, int idx,
+                    std::vector<std::string>* cache) {
+  GIL gil;
+  PyObject* names = predictor_names(p, method);
+  if (!names) return nullptr;
+  if (idx < 0 || idx >= PyList_Size(names)) {
+    set_error("name index out of range");
+    Py_DECREF(names);
+    return nullptr;
+  }
+  cache->resize(PyList_Size(names));
+  const char* u = PyUnicode_AsUTF8(PyList_GetItem(names, idx));
+  if (u) (*cache)[idx] = u;
+  Py_DECREF(names);
+  return u ? (*cache)[idx].c_str() : nullptr;
+}
+
+PD_Tensor* handle_for(PD_Predictor* p, const char* method,
+                      const char* name) {
+  ensure_python();
+  GIL gil;
+  PyObject* h = PyObject_CallMethod(p->predictor, method, "s", name);
+  if (!h) {
+    fetch_py_error();
+    return nullptr;
+  }
+  auto* t = new PD_Tensor();
+  t->handle = h;
+  return t;
+}
+
+// numpy dtype string for each typed entry point
+int copy_from_cpu(PD_Tensor* t, const void* data, const char* dtype,
+                  size_t elem_size) {
+  GIL gil;
+  if (t->pending_shape.empty()) {
+    set_error("call PD_TensorReshape before CopyFromCpu");
+    return -1;
+  }
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) {
+    fetch_py_error();
+    return -1;
+  }
+  int64_t numel = 1;
+  for (int32_t d : t->pending_shape) numel *= d;
+  PyObject* mem = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<void*>(data)),
+      numel * elem_size, PyBUF_READ);
+  PyObject* flat =
+      mem ? PyObject_CallMethod(np, "frombuffer", "Os", mem, dtype)
+          : nullptr;
+  PyObject* shape = PyTuple_New(t->pending_shape.size());
+  for (size_t d = 0; d < t->pending_shape.size(); ++d) {
+    PyTuple_SET_ITEM(shape, d, PyLong_FromLong(t->pending_shape[d]));
+  }
+  PyObject* arr =
+      flat ? PyObject_CallMethod(flat, "reshape", "O", shape) : nullptr;
+  PyObject* copy = arr ? PyObject_CallMethod(arr, "copy", "") : nullptr;
+  PyObject* res =
+      copy ? PyObject_CallMethod(t->handle, "copy_from_cpu", "O", copy)
+           : nullptr;
+  bool ok = res != nullptr;
+  if (!ok) fetch_py_error();
+  Py_XDECREF(res);
+  Py_XDECREF(copy);
+  Py_XDECREF(arr);
+  Py_XDECREF(shape);
+  Py_XDECREF(flat);
+  Py_XDECREF(mem);
+  Py_DECREF(np);
+  return ok ? 0 : -1;
+}
+
+// fetch the handle's value as a contiguous ndarray of `dtype` (or its
+// native dtype when dtype == nullptr), cache it on the tensor
+PyObject* fetch_contiguous(PD_Tensor* t, const char* dtype) {
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) {
+    fetch_py_error();
+    return nullptr;
+  }
+  PyObject* val = PyObject_CallMethod(t->handle, "copy_to_cpu", "");
+  PyObject* arr = nullptr;
+  if (val) {
+    arr = dtype ? PyObject_CallMethod(np, "ascontiguousarray", "Os", val,
+                                      dtype)
+                : PyObject_CallMethod(np, "ascontiguousarray", "O", val);
+  }
+  if (!arr) fetch_py_error();
+  Py_XDECREF(val);
+  Py_DECREF(np);
+  Py_XDECREF(t->fetched);
+  t->fetched = arr;  // cache (owned)
+  return arr;
+}
+
+int copy_to_cpu(PD_Tensor* t, void* dst, const char* dtype) {
+  GIL gil;
+  PyObject* arr = fetch_contiguous(t, dtype);
+  if (!arr) return -1;
+  Py_buffer view;
+  if (PyObject_GetBuffer(arr, &view, PyBUF_CONTIG_RO) != 0) {
+    fetch_py_error();
+    return -1;
+  }
+  std::memcpy(dst, view.buf, view.len);
+  PyBuffer_Release(&view);
+  return 0;
+}
+
+}  // namespace
+
+const char* PD_PredictorGetInputName(PD_Predictor* p, int idx) {
+  return name_at(p, "get_input_names", idx, &p->input_names);
+}
+
+const char* PD_PredictorGetOutputName(PD_Predictor* p, int idx) {
+  return name_at(p, "get_output_names", idx, &p->output_names);
+}
+
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name) {
+  return handle_for(p, "get_input_handle", name);
+}
+
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name) {
+  return handle_for(p, "get_output_handle", name);
+}
+
+void PD_TensorDestroy(PD_Tensor* t) {
+  if (!t) return;
+  GIL gil;
+  Py_XDECREF(t->handle);
+  Py_XDECREF(t->fetched);
+  delete t;
+}
+
+int PD_TensorReshape(PD_Tensor* t, int ndim, const int32_t* shape) {
+  if (!t || ndim < 0) {
+    set_error("PD_TensorReshape: bad arguments");
+    return -1;
+  }
+  t->pending_shape.assign(shape, shape + ndim);
+  return 0;
+}
+
+int PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* data) {
+  return copy_from_cpu(t, data, "float32", sizeof(float));
+}
+
+int PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* data) {
+  return copy_from_cpu(t, data, "int64", sizeof(int64_t));
+}
+
+int PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* data) {
+  return copy_from_cpu(t, data, "int32", sizeof(int32_t));
+}
+
+int PD_TensorCopyFromCpuUint8(PD_Tensor* t, const uint8_t* data) {
+  return copy_from_cpu(t, data, "uint8", sizeof(uint8_t));
+}
+
+int PD_TensorCopyToCpuFloat(PD_Tensor* t, float* data) {
+  return copy_to_cpu(t, data, "float32");
+}
+
+int PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* data) {
+  return copy_to_cpu(t, data, "int64");
+}
+
+int PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* data) {
+  return copy_to_cpu(t, data, "int32");
+}
+
+int PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* data) {
+  return copy_to_cpu(t, data, "uint8");
+}
+
+int PD_TensorGetShape(PD_Tensor* t, int* shape_out) {
+  GIL gil;
+  // always re-fetch: a cached first-run array would report a stale
+  // shape after the predictor reruns with different batch dims, and the
+  // caller sizes its CopyToCpu buffer from this
+  PyObject* arr = fetch_contiguous(t, nullptr);
+  if (!arr) return -1;
+  PyObject* shape = PyObject_GetAttrString(arr, "shape");
+  if (!shape) {
+    fetch_py_error();
+    return -1;
+  }
+  int n = static_cast<int>(PyTuple_Size(shape));
+  if (shape_out) {
+    for (Py_ssize_t d = 0; d < n; ++d) {
+      shape_out[d] =
+          static_cast<int>(PyLong_AsLong(PyTuple_GetItem(shape, d)));
+    }
+  }
+  Py_DECREF(shape);
+  return n;
+}
+
+PD_DataType PD_TensorGetDataType(PD_Tensor* t) {
+  GIL gil;
+  PyObject* arr = fetch_contiguous(t, nullptr);
+  if (!arr) return PD_DATA_UNK;
+  PyObject* dtype = PyObject_GetAttrString(arr, "dtype");
+  PyObject* name = dtype ? PyObject_GetAttrString(dtype, "name") : nullptr;
+  const char* u = name ? PyUnicode_AsUTF8(name) : nullptr;
+  PD_DataType out = PD_DATA_UNK;
+  if (u) {
+    std::string s(u);
+    if (s == "float32") out = PD_DATA_FLOAT32;
+    else if (s == "int32") out = PD_DATA_INT32;
+    else if (s == "int64") out = PD_DATA_INT64;
+    else if (s == "uint8") out = PD_DATA_UINT8;
+  }
+  Py_XDECREF(name);
+  Py_XDECREF(dtype);
+  return out;
+}
+
+int PD_PredictorRun(PD_Predictor* p) {
+  GIL gil;
+  PyObject* res = PyObject_CallMethod(p->predictor, "run", "");
+  if (!res) {
+    fetch_py_error();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
 
 }  // extern "C"

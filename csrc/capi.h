@@ -6,12 +6,24 @@
 #ifndef PADDLE_TPU_CAPI_H_
 #define PADDLE_TPU_CAPI_H_
 
+#include <stdint.h>
+
 #ifdef __cplusplus
 extern "C" {
 #endif
 
 typedef struct PD_Config PD_Config;
 typedef struct PD_Predictor PD_Predictor;
+typedef struct PD_Tensor PD_Tensor;
+
+// matches reference PD_DataType intent (capi_exp/pd_common.h)
+typedef enum {
+  PD_DATA_UNK = -1,
+  PD_DATA_FLOAT32 = 0,
+  PD_DATA_INT32 = 1,
+  PD_DATA_INT64 = 2,
+  PD_DATA_UINT8 = 3,
+} PD_DataType;
 
 const char* PD_GetLastError();
 PD_Config* PD_ConfigCreate();
@@ -30,6 +42,33 @@ int PD_PredictorGetOutputNum(PD_Predictor* p);
 int PD_PredictorGetOutputNDim(PD_Predictor* p, int idx);
 int PD_PredictorGetOutputShape(PD_Predictor* p, int idx, int* shape_out);
 int PD_PredictorGetOutputData(PD_Predictor* p, int idx, float* dst);
+
+// ---- named-handle + typed-tensor surface (reference
+// capi_exp/pd_predictor.h PD_PredictorGetInputHandle and
+// capi_exp/pd_tensor.h:78,133,182,222 CopyFromCpu/CopyToCpu
+// Float/Int64/Int32/Uint8) ----
+
+// name at `idx`; pointer valid until the predictor is destroyed
+const char* PD_PredictorGetInputName(PD_Predictor* p, int idx);
+const char* PD_PredictorGetOutputName(PD_Predictor* p, int idx);
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name);
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name);
+void PD_TensorDestroy(PD_Tensor* t);
+// declare the shape of the next CopyFromCpu (reference PD_TensorReshape)
+int PD_TensorReshape(PD_Tensor* t, int ndim, const int32_t* shape);
+int PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* data);
+int PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* data);
+int PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* data);
+int PD_TensorCopyFromCpuUint8(PD_Tensor* t, const uint8_t* data);
+int PD_TensorCopyToCpuFloat(PD_Tensor* t, float* data);
+int PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* data);
+int PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* data);
+int PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* data);
+// returns ndim (or -1); writes the dims into shape_out when non-NULL
+int PD_TensorGetShape(PD_Tensor* t, int* shape_out);
+PD_DataType PD_TensorGetDataType(PD_Tensor* t);
+// run from the values previously copied into the input handles
+int PD_PredictorRun(PD_Predictor* p);
 
 #ifdef __cplusplus
 }  // extern "C"

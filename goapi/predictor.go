@@ -19,8 +19,19 @@ package paddle
 
 #include <stdlib.h>
 
+#include <stdint.h>
+
 typedef struct PD_Config PD_Config;
 typedef struct PD_Predictor PD_Predictor;
+typedef struct PD_Tensor PD_Tensor;
+
+typedef enum {
+  PD_DATA_UNK = -1,
+  PD_DATA_FLOAT32 = 0,
+  PD_DATA_INT32 = 1,
+  PD_DATA_INT64 = 2,
+  PD_DATA_UINT8 = 3,
+} PD_DataType;
 
 const char* PD_GetLastError();
 PD_Config* PD_ConfigCreate();
@@ -39,6 +50,23 @@ int PD_PredictorGetOutputNum(PD_Predictor* p);
 int PD_PredictorGetOutputNDim(PD_Predictor* p, int idx);
 int PD_PredictorGetOutputShape(PD_Predictor* p, int idx, int* shape_out);
 int PD_PredictorGetOutputData(PD_Predictor* p, int idx, float* dst);
+const char* PD_PredictorGetInputName(PD_Predictor* p, int idx);
+const char* PD_PredictorGetOutputName(PD_Predictor* p, int idx);
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name);
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name);
+void PD_TensorDestroy(PD_Tensor* t);
+int PD_TensorReshape(PD_Tensor* t, int ndim, const int32_t* shape);
+int PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* data);
+int PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* data);
+int PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* data);
+int PD_TensorCopyFromCpuUint8(PD_Tensor* t, const uint8_t* data);
+int PD_TensorCopyToCpuFloat(PD_Tensor* t, float* data);
+int PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* data);
+int PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* data);
+int PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* data);
+int PD_TensorGetShape(PD_Tensor* t, int* shape_out);
+PD_DataType PD_TensorGetDataType(PD_Tensor* t);
+int PD_PredictorRun(PD_Predictor* p);
 */
 import "C"
 
@@ -232,6 +260,224 @@ func (pred *Predictor) Destroy() {
 		C.PD_PredictorDestroy(pred.p)
 		pred.p = nil
 	}
+}
+
+
+// DataType mirrors the C PD_DataType enum (reference pd_common.h).
+type DataType int
+
+const (
+	Unk     DataType = -1
+	Float32 DataType = 0
+	Int32   DataType = 1
+	Int64   DataType = 2
+	Uint8   DataType = 3
+)
+
+// InputName returns the feed target name at idx (reference
+// GetInputNames).
+func (pred *Predictor) InputName(idx int) (string, error) {
+	s := C.PD_PredictorGetInputName(pred.p, C.int(idx))
+	runtime.KeepAlive(pred)
+	if s == nil {
+		return "", lastError()
+	}
+	return C.GoString(s), nil
+}
+
+// OutputName returns the fetch target name at idx.
+func (pred *Predictor) OutputName(idx int) (string, error) {
+	s := C.PD_PredictorGetOutputName(pred.p, C.int(idx))
+	runtime.KeepAlive(pred)
+	if s == nil {
+		return "", lastError()
+	}
+	return C.GoString(s), nil
+}
+
+// Tensor is a named input/output handle (reference
+// GetInputHandle/GetOutputHandle over pd_tensor.h).
+type Tensor struct {
+	t *C.PD_Tensor
+}
+
+// GetInputHandle returns the named input handle.
+func (pred *Predictor) GetInputHandle(name string) (*Tensor, error) {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	h := C.PD_PredictorGetInputHandle(pred.p, cn)
+	runtime.KeepAlive(pred)
+	if h == nil {
+		return nil, lastError()
+	}
+	t := &Tensor{t: h}
+	runtime.SetFinalizer(t, (*Tensor).Destroy)
+	return t, nil
+}
+
+// GetOutputHandle returns the named output handle.
+func (pred *Predictor) GetOutputHandle(name string) (*Tensor, error) {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	h := C.PD_PredictorGetOutputHandle(pred.p, cn)
+	runtime.KeepAlive(pred)
+	if h == nil {
+		return nil, lastError()
+	}
+	t := &Tensor{t: h}
+	runtime.SetFinalizer(t, (*Tensor).Destroy)
+	return t, nil
+}
+
+// Destroy releases the native tensor handle.
+func (t *Tensor) Destroy() {
+	if t.t != nil {
+		C.PD_TensorDestroy(t.t)
+		t.t = nil
+	}
+}
+
+// Reshape declares the shape of the next CopyFromCpu* call.
+func (t *Tensor) Reshape(shape []int32) error {
+	var p *C.int32_t
+	if len(shape) > 0 {
+		p = (*C.int32_t)(unsafe.Pointer(&shape[0]))
+	}
+	rc := C.PD_TensorReshape(t.t, C.int(len(shape)), p)
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// CopyFromCpuFloat32 feeds float32 data of the Reshape()d shape.
+func (t *Tensor) CopyFromCpuFloat32(data []float32) error {
+	rc := C.PD_TensorCopyFromCpuFloat(t.t,
+		(*C.float)(unsafe.Pointer(&data[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// CopyFromCpuInt64 feeds int64 data (token ids) of the Reshape()d shape.
+func (t *Tensor) CopyFromCpuInt64(data []int64) error {
+	rc := C.PD_TensorCopyFromCpuInt64(t.t,
+		(*C.int64_t)(unsafe.Pointer(&data[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// CopyFromCpuInt32 feeds int32 data of the Reshape()d shape.
+func (t *Tensor) CopyFromCpuInt32(data []int32) error {
+	rc := C.PD_TensorCopyFromCpuInt32(t.t,
+		(*C.int32_t)(unsafe.Pointer(&data[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// CopyFromCpuUint8 feeds uint8 data of the Reshape()d shape.
+func (t *Tensor) CopyFromCpuUint8(data []uint8) error {
+	rc := C.PD_TensorCopyFromCpuUint8(t.t,
+		(*C.uint8_t)(unsafe.Pointer(&data[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// Shape fetches the tensor's current shape.
+func (t *Tensor) Shape() ([]int32, error) {
+	nd := int(C.PD_TensorGetShape(t.t, nil))
+	if nd < 0 {
+		return nil, lastError()
+	}
+	shape := make([]C.int, nd)
+	var p *C.int
+	if nd > 0 {
+		p = &shape[0]
+	}
+	if int(C.PD_TensorGetShape(t.t, p)) < 0 {
+		return nil, lastError()
+	}
+	runtime.KeepAlive(t)
+	out := make([]int32, nd)
+	for i, d := range shape {
+		out[i] = int32(d)
+	}
+	return out, nil
+}
+
+// Type reports the tensor's element dtype.
+func (t *Tensor) Type() DataType {
+	dt := DataType(C.PD_TensorGetDataType(t.t))
+	runtime.KeepAlive(t)
+	return dt
+}
+
+// CopyToCpuFloat32 copies the tensor out as float32 (dst sized to the
+// product of Shape()).
+func (t *Tensor) CopyToCpuFloat32(dst []float32) error {
+	rc := C.PD_TensorCopyToCpuFloat(t.t,
+		(*C.float)(unsafe.Pointer(&dst[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// CopyToCpuInt64 copies the tensor out as int64.
+func (t *Tensor) CopyToCpuInt64(dst []int64) error {
+	rc := C.PD_TensorCopyToCpuInt64(t.t,
+		(*C.int64_t)(unsafe.Pointer(&dst[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// CopyToCpuInt32 copies the tensor out as int32.
+func (t *Tensor) CopyToCpuInt32(dst []int32) error {
+	rc := C.PD_TensorCopyToCpuInt32(t.t,
+		(*C.int32_t)(unsafe.Pointer(&dst[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// CopyToCpuUint8 copies the tensor out as uint8.
+func (t *Tensor) CopyToCpuUint8(dst []uint8) error {
+	rc := C.PD_TensorCopyToCpuUint8(t.t,
+		(*C.uint8_t)(unsafe.Pointer(&dst[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// RunFromHandles executes the program from the values previously copied
+// into the input handles (reference PD_PredictorRun).
+func (pred *Predictor) RunFromHandles() error {
+	rc := C.PD_PredictorRun(pred.p)
+	runtime.KeepAlive(pred)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
 }
 
 func lastError() error {

@@ -337,3 +337,130 @@ class Dpsgd(Optimizer):
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
            "Adadelta", "Adamax", "RMSProp", "Lamb", "Lars", "Ftrl",
            "Dpsgd", "lr"]
+
+
+# ---------------------------------------------------------------------------
+# static-graph update-op lowerings (reference `fluid/optimizer.py`
+# _append_optimize_op per class): minimize() emits the same in-program
+# optimizer ops a reference training program carries, so exported
+# training programs round-trip through static.Executor.  Slot vars are
+# created persistable; the interp's optimizer translators default them
+# (zeros / beta powers) on first step.
+# ---------------------------------------------------------------------------
+def _mk_slot_var(block, param, suffix):
+    name = f"{param.name}_{suffix}"
+    block.create_var(name, param.shape, "float32", persistable=True)
+    return name
+
+
+def _adam_static_update(self, block, param, grad, lr_name):
+    m1 = _mk_slot_var(block, param, "moment1_0")
+    m2 = _mk_slot_var(block, param, "moment2_0")
+    b1p = f"{param.name}_beta1_pow_acc_0"
+    b2p = f"{param.name}_beta2_pow_acc_0"
+    block.create_var(b1p, [1], "float32", persistable=True)
+    block.create_var(b2p, [1], "float32", persistable=True)
+    attrs = {"beta1": float(self._beta1), "beta2": float(self._beta2),
+             "epsilon": float(self._epsilon)}
+    optype = "adam"
+    if getattr(self, "_decoupled_weight_decay", None) and \
+            self._decoupled_weight_decay():
+        optype = "adamw"
+        wd = self._weight_decay
+        attrs["coeff"] = float(wd if isinstance(wd, (int, float))
+                               else 0.01)
+        attrs["with_decay"] = True
+    block.append_op(
+        optype,
+        {"Param": param.name, "Grad": grad.name, "LearningRate": lr_name,
+         "Moment1": m1, "Moment2": m2, "Beta1Pow": b1p, "Beta2Pow": b2p},
+        {"ParamOut": param.name, "Moment1Out": m1, "Moment2Out": m2,
+         "Beta1PowOut": b1p, "Beta2PowOut": b2p}, attrs)
+
+
+Adam._append_static_update = _adam_static_update
+
+
+def _adagrad_static_update(self, block, param, grad, lr_name):
+    mom = _mk_slot_var(block, param, "moment_0")
+    block.append_op(
+        "adagrad",
+        {"Param": param.name, "Grad": grad.name, "Moment": mom,
+         "LearningRate": lr_name},
+        {"ParamOut": param.name, "MomentOut": mom},
+        {"epsilon": float(self._epsilon)})
+
+
+Adagrad._append_static_update = _adagrad_static_update
+
+
+def _adadelta_static_update(self, block, param, grad, lr_name):
+    asg = _mk_slot_var(block, param, "avg_squared_grad_0")
+    asu = _mk_slot_var(block, param, "avg_squared_update_0")
+    block.append_op(
+        "adadelta",
+        {"Param": param.name, "Grad": grad.name, "AvgSquaredGrad": asg,
+         "AvgSquaredUpdate": asu},
+        {"ParamOut": param.name, "AvgSquaredGradOut": asg,
+         "AvgSquaredUpdateOut": asu},
+        {"rho": float(self._rho), "epsilon": float(self._epsilon)})
+
+
+Adadelta._append_static_update = _adadelta_static_update
+
+
+def _adamax_static_update(self, block, param, grad, lr_name):
+    mom = _mk_slot_var(block, param, "moment_0")
+    inf = _mk_slot_var(block, param, "inf_norm_0")
+    b1p = f"{param.name}_beta1_pow_acc_0"
+    block.create_var(b1p, [1], "float32", persistable=True)
+    block.append_op(
+        "adamax",
+        {"Param": param.name, "Grad": grad.name, "LearningRate": lr_name,
+         "Moment": mom, "InfNorm": inf, "Beta1Pow": b1p},
+        {"ParamOut": param.name, "MomentOut": mom, "InfNormOut": inf,
+         "Beta1PowOut": b1p},
+        {"beta1": float(self._beta1), "beta2": float(self._beta2),
+         "epsilon": float(self._epsilon)})
+
+
+Adamax._append_static_update = _adamax_static_update
+
+
+def _rmsprop_static_update(self, block, param, grad, lr_name):
+    ms = _mk_slot_var(block, param, "mean_square_0")
+    mg = _mk_slot_var(block, param, "mean_grad_0")
+    mom = _mk_slot_var(block, param, "momentum_0")
+    block.append_op(
+        "rmsprop",
+        {"Param": param.name, "Grad": grad.name, "LearningRate": lr_name,
+         "MeanSquare": ms, "MeanGrad": mg, "Moment": mom},
+        {"ParamOut": param.name, "MeanSquareOut": ms,
+         "MeanGradOut": mg, "MomentOut": mom},
+        {"decay": float(self._rho), "epsilon": float(self._epsilon),
+         "momentum": float(self._momentum),
+         "centered": bool(self._centered)})
+
+
+RMSProp._append_static_update = _rmsprop_static_update
+
+
+def _lamb_static_update(self, block, param, grad, lr_name):
+    m1 = _mk_slot_var(block, param, "moment1_0")
+    m2 = _mk_slot_var(block, param, "moment2_0")
+    b1p = f"{param.name}_beta1_pow_acc_0"
+    b2p = f"{param.name}_beta2_pow_acc_0"
+    block.create_var(b1p, [1], "float32", persistable=True)
+    block.create_var(b2p, [1], "float32", persistable=True)
+    block.append_op(
+        "lamb",
+        {"Param": param.name, "Grad": grad.name, "LearningRate": lr_name,
+         "Moment1": m1, "Moment2": m2, "Beta1Pow": b1p, "Beta2Pow": b2p},
+        {"ParamOut": param.name, "Moment1Out": m1, "Moment2Out": m2,
+         "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+        {"beta1": float(self._beta1), "beta2": float(self._beta2),
+         "epsilon": float(self._epsilon),
+         "weight_decay": float(self._lamb_wd)})
+
+
+Lamb._append_static_update = _lamb_static_update

@@ -151,9 +151,13 @@ def ring_attention_local(q, k, v, axis_name: str = "sp", causal: bool = False,
         o_acc = o_acc * alpha[..., None].astype(o_acc.dtype) + \
             o_blk * beta[..., None].astype(o_blk.dtype)
         l_acc = l_acc * alpha + l_blk * beta
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return o_acc, m_new, l_acc, k_nxt, v_nxt
+        if i < n - 1:
+            # N-1 rotations suffice: after the last block is consumed a
+            # further rotation's result is never read (round-4 comm fix
+            # — also what the schedule-accounting test pins)
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+        return o_acc, m_new, l_acc, k_cur, v_cur
 
     b, h = q.shape[0], q.shape[1]
     o0 = jnp.zeros(q.shape, jnp.float32)

@@ -26,7 +26,7 @@ OP_TRANSLATORS: Dict[str, Callable] = {}
 # them); ProgramRunner falls back to op-by-op execution for programs
 # containing one.  op_bridge extends this set as it registers such ops.
 DYNAMIC_SHAPE_OPS = {"masked_select", "where_index", "unique",
-                     "unique_with_counts", "linspace"}
+                     "unique_with_counts", "linspace", "sequence_unpad"}
 
 
 def register(*names):

@@ -547,6 +547,15 @@ def program_from_layer(layer, input_spec, scope: Optional[Dict] = None
     if isinstance(layer, nn.Sequential):
         out_name = emit(layer, in_name)
     else:
+        # chaining the children is only faithful when forward() IS that
+        # chain; a custom forward (functional ops, branching) would get
+        # silently mis-captured — refuse instead (round-4 fix)
+        if type(layer).forward is not nn.Layer.forward:
+            raise NotImplementedError(
+                f"program_from_layer: {type(layer).__name__} defines a "
+                "custom forward(); its children cannot be assumed to "
+                "chain sequentially. Compose the model from nn layers "
+                "(e.g. nn.Sequential) or use paddle_tpu.jit.save")
         children = [ly for _, ly in layer.named_children()]
         if not children:
             raise NotImplementedError("layer has no convertible structure")

@@ -1,0 +1,78 @@
+/* C driver for the NAMED-HANDLE + typed-tensor C API surface
+ * (csrc/capi.h — reference capi_exp/pd_predictor.h handle API +
+ * pd_tensor.h typed CopyFromCpu/CopyToCpu).  Serves a token-id model:
+ * int64 ids in, float logits out.
+ * Usage: capi_driver_tokens <model_prefix.pdmodel> <N> <T>
+ * Feeds an N x T ramp of token ids, prints output dtype/shape/values. */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../csrc/capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s model.pdmodel N T\n", argv[0]);
+    return 2;
+  }
+  int n = atoi(argv[2]), t = atoi(argv[3]);
+
+  PD_Config* cfg = PD_ConfigCreate();
+  PD_ConfigSetModel(cfg, argv[1], "");
+  PD_Predictor* pred = PD_PredictorCreate(cfg);
+  if (!pred) {
+    fprintf(stderr, "create failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  const char* in_name = PD_PredictorGetInputName(pred, 0);
+  if (!in_name) {
+    fprintf(stderr, "input name failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  printf("input_name=%s\n", in_name);
+
+  PD_Tensor* in = PD_PredictorGetInputHandle(pred, in_name);
+  int64_t* ids = (int64_t*)malloc(sizeof(int64_t) * n * t);
+  for (int i = 0; i < n * t; ++i) ids[i] = i % 7;
+  int32_t shape[2];
+  shape[0] = n;
+  shape[1] = t;
+  if (PD_TensorReshape(in, 2, shape) != 0 ||
+      PD_TensorCopyFromCpuInt64(in, ids) != 0) {
+    fprintf(stderr, "copy_from failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  if (PD_PredictorRun(pred) != 0) {
+    fprintf(stderr, "run failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  const char* out_name = PD_PredictorGetOutputName(pred, 0);
+  PD_Tensor* out = PD_PredictorGetOutputHandle(pred, out_name);
+  int out_shape[8];
+  int ndim = PD_TensorGetShape(out, out_shape);
+  if (ndim < 0) {
+    fprintf(stderr, "shape failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  printf("output_name=%s dtype=%d ndim=%d shape=", out_name,
+         (int)PD_TensorGetDataType(out), ndim);
+  long numel = 1;
+  for (int i = 0; i < ndim; ++i) {
+    printf("%d%s", out_shape[i], i + 1 < ndim ? "x" : "\n");
+    numel *= out_shape[i];
+  }
+  float* vals = (float*)malloc(sizeof(float) * numel);
+  if (PD_TensorCopyToCpuFloat(out, vals) != 0) {
+    fprintf(stderr, "copy_to failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  for (long i = 0; i < numel; ++i) printf("%.6f\n", vals[i]);
+
+  free(vals);
+  free(ids);
+  PD_TensorDestroy(in);
+  PD_TensorDestroy(out);
+  PD_PredictorDestroy(pred);
+  PD_ConfigDestroy(cfg);
+  return 0;
+}

@@ -78,3 +78,54 @@ def test_c_driver_matches_python_predictor(tmp_path):
     x = (np.arange(n * d, dtype=np.float32) / (n * d)).reshape(n, d)
     want = np.asarray(net(paddle.to_tensor(x)).numpy())
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.skipif(shutil.which("cmake") is None or
+                    shutil.which("g++") is None,
+                    reason="native toolchain unavailable")
+def test_token_id_model_through_handle_api(tmp_path):
+    """VERDICT r3 #3 acceptance: a token-id transformer-style model
+    (int64 inputs) served end-to-end through the NAMED-HANDLE C API
+    (PD_PredictorGetInputHandle + PD_TensorCopyFromCpuInt64 +
+    PD_PredictorRun + PD_TensorCopyToCpuFloat)."""
+    paddle.seed(0)
+    # embedding -> flatten -> linear: a token-id model in the layer set
+    # program_from_layer converts faithfully
+    net = nn.Sequential(nn.Embedding(16, 8), nn.Flatten(),
+                        nn.Linear(40, 4))
+    net.eval()
+    prefix = str(tmp_path / "tok")
+    static.save_inference_model(
+        prefix, layer=net,
+        input_spec=[static.InputSpec([None, 5], "int64")])
+
+    build = tmp_path / "build"
+    _build_capi(tmp_path)
+    drv = build / "capi_driver_tokens"
+    subprocess.run(
+        ["g++", os.path.join(REPO, "tests", "capi_driver_tokens.c"),
+         "-o", str(drv), "-L", str(build), "-lpaddle_tpu_capi",
+         f"-Wl,-rpath,{build}"],
+        check=True, capture_output=True)
+
+    n, t = 3, 5
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, sysconfig.get_path("purelib")] +
+        [p for p in sys.path if p.endswith("site-packages")])
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([str(drv), prefix + ".pdmodel", str(n), str(t)],
+                       capture_output=True, text=True, env=env,
+                       timeout=300)
+    assert r.returncode == 0, r.stderr + r.stdout
+    lines = r.stdout.strip().splitlines()
+    assert lines[0].startswith("input_name=")
+    head = lines[1]
+    assert "dtype=0" in head and f"shape={n}x4" in head, head
+    got = np.array([float(v) for v in lines[2:2 + n * 4]],
+                   np.float32).reshape(n, 4)
+
+    ids = (np.arange(n * t, dtype=np.int64) % 7).reshape(n, t)
+    want = np.asarray(net(paddle.to_tensor(ids)).numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)

@@ -383,6 +383,529 @@ class TestTensorFamily:
         assert int(got["Out"]) == 7
 
 
+def sigmoid(x):
+    return 1 / (1 + np.exp(-x))
+
+
+class TestNNFamily:
+    def test_activations(self):
+        x = r(3, 4) - 0.5
+        check("elu", {"X": x}, {"alpha": 1.0},
+              np.where(x > 0, x, np.exp(x) - 1), rtol=1e-4)
+        scale, alpha = 1.0507009873554805, 1.6732632423543772
+        check("selu", {"X": x}, None,
+              scale * np.where(x > 0, x, alpha * (np.exp(x) - 1)),
+              rtol=1e-4)
+        xm = r(2, 4, 3)  # maxout over channel groups
+        check("maxout", {"X": xm}, {"groups": 2, "axis": 1},
+              xm.reshape(2, 2, 2, 3).max(2))
+
+    def test_label_smooth(self):
+        lab = np.eye(3, dtype=np.float32)[[0, 2]]
+        check("label_smooth", {"X": lab}, {"epsilon": 0.1},
+              0.9 * lab + 0.1 / 3)
+        prior = np.array([0.5, 0.3, 0.2], np.float32)
+        check("label_smooth", {"X": lab, "PriorDist": prior},
+              {"epsilon": 0.1}, 0.9 * lab + 0.1 * prior)
+
+    def test_elementwise_losses(self):
+        p = np.clip(r(4), 0.01, 0.99)
+        y = (r(4, seed=1) > 0.5).astype(np.float32)
+        check("log_loss", {"Predicted": p, "Labels": y},
+              {"epsilon": 1e-4},
+              -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4),
+              outs=("Loss",), rtol=1e-4)
+        check("bce_loss", {"X": p, "Label": y}, None,
+              -y * np.log(p) - (1 - y) * np.log(1 - p), rtol=1e-4)
+        x, t = r(4) - 0.5, r(4, seed=1) - 0.5
+        d = t - x
+        check("huber_loss", {"X": x, "Y": t}, {"delta": 0.3},
+              {"Out": np.where(np.abs(d) <= 0.3, 0.5 * d * d,
+                               0.3 * (np.abs(d) - 0.15))},
+              outs=("Residual", "Out"), rtol=1e-4)
+        lab = np.array([1.0, -1.0, 1.0, -1.0], np.float32)
+        check("margin_rank_loss", {"X1": x, "X2": t, "Label": lab},
+              {"margin": 0.1},
+              {"Out": np.maximum(0, 0.1 - lab * (x - t))},
+              outs=("Activated", "Out"), rtol=1e-4)
+        left, right = r(4), r(4, seed=2)
+        pl = (lab > 0).astype(np.float32)
+        check("rank_loss", {"Label": pl, "Left": left, "Right": right},
+              None, np.log1p(np.exp(left - right)) - pl * (left - right),
+              rtol=1e-4)
+        check("hinge_loss", {"Logits": x, "Labels": pl}, None,
+              np.maximum(0, 1 - (2 * pl - 1) * x), outs=("Loss",))
+
+    def test_fluid_smooth_l1(self):
+        x, y = r(2, 3), r(2, 3, seed=1)
+        d = x - y
+        val = np.where(np.abs(d) < 1, 0.5 * d * d, np.abs(d) - 0.5)
+        check("smooth_l1_loss", {"X": x, "Y": y}, {"sigma": 1.0},
+              {"Out": val.sum(1, keepdims=True)},
+              outs=("Diff", "Out"), rtol=1e-4)
+
+    def test_bpr_and_cos_sim(self):
+        x = r(3, 4)
+        lab = np.array([1, 0, 3], np.int64)
+        xy = np.take_along_axis(x, lab[:, None], 1)
+        ls = -np.log1p(np.exp(-(xy - x)))
+        mask = np.ones_like(x)
+        mask[np.arange(3), lab] = 0
+        check("bpr_loss", {"X": x, "Label": lab}, None,
+              {"Y": -(ls * mask).sum(1, keepdims=True) / 3},
+              outs=("Y",), rtol=1e-4)
+        a, bb = r(3, 4), r(3, 4, seed=1)
+        cs = (a * bb).sum(1, keepdims=True) / (
+            np.linalg.norm(a, axis=1, keepdims=True)
+            * np.linalg.norm(bb, axis=1, keepdims=True))
+        check("cos_sim", {"X": a, "Y": bb}, None, {"Out": cs},
+              outs=("Out", "XNorm", "YNorm"), rtol=1e-4)
+
+    def test_squared_l2_distance(self):
+        x, y = r(3, 4), r(3, 4, seed=1)
+        check("squared_l2_distance", {"X": x, "Y": y}, None,
+              {"Out": np.square(x - y).sum(1, keepdims=True)},
+              outs=("sub_result", "Out"), rtol=1e-4)
+
+    def test_pad_family(self):
+        x = r(2, 3)
+        check("pad", {"X": x}, {"paddings": [0, 1, 2, 0],
+                                "pad_value": 9.0},
+              np.pad(x, [(0, 1), (2, 0)], constant_values=9.0))
+        y = r(1, 2)
+        check("pad_constant_like", {"X": x, "Y": y}, {"pad_value": 5.0},
+              np.pad(y, [(0, 1), (0, 1)], constant_values=5.0))
+
+    def test_channel_ops(self):
+        x = r(1, 4, 2, 2)
+        sc, bi = r(4, seed=1), r(4, seed=2)
+        check("affine_channel", {"X": x, "Scale": sc, "Bias": bi}, None,
+              x * sc.reshape(1, 4, 1, 1) + bi.reshape(1, 4, 1, 1))
+        got = bridge_run("shuffle_channel", {"X": x}, {"group": 2})
+        exp = x.reshape(1, 2, 2, 2, 2).transpose(0, 2, 1, 3, 4)\
+            .reshape(1, 4, 2, 2)
+        np.testing.assert_allclose(got["Out"], exp)
+        xs = r(1, 4, 2, 2)
+        got = bridge_run("space_to_depth", {"X": xs}, {"blocksize": 2})
+        assert got["Out"].shape == (1, 16, 1, 1)
+
+    def test_temporal_shift(self):
+        x = r(4, 2, 2, 2)  # NT x C x H x W with seg_num=2
+        got = bridge_run("temporal_shift", {"X": x},
+                         {"seg_num": 2, "shift_ratio": 0.25})
+        assert got["Out"].shape == x.shape
+
+    def test_bilinear_tensor_product(self):
+        x, y = r(2, 3), r(2, 4)
+        w = r(5, 3, 4, seed=1)
+        exp = np.einsum("ni,kij,nj->nk", x, w, y)
+        check("bilinear_tensor_product", {"X": x, "Y": y, "Weight": w},
+              None, exp, rtol=1e-4)
+        bias = r(5, seed=2)
+        check("bilinear_tensor_product",
+              {"X": x, "Y": y, "Weight": w, "Bias": bias}, None,
+              exp + bias, rtol=1e-4)
+
+    def test_multihead_matmul(self):
+        np.random.seed(0)
+        b_, s, h, heads = 2, 3, 4, 2
+        inp = r(b_, s, h)
+        w = r(h, 3 * h, seed=1)
+        bias = np.zeros(3 * h, np.float32)
+        got = bridge_run("multihead_matmul",
+                         {"Input": inp, "W": w, "Bias": bias},
+                         {"alpha": 0.5, "head_number": heads})
+        qkv = inp @ w
+        q, k, v = np.split(qkv, 3, -1)
+
+        def sh(t):
+            return t.reshape(b_, s, heads, h // heads).transpose(0, 2, 1, 3)
+
+        q, k, v = sh(q), sh(k), sh(v)
+        sc = (q @ k.transpose(0, 1, 3, 2)) * 0.5
+        e = np.exp(sc - sc.max(-1, keepdims=True))
+        att = e / e.sum(-1, keepdims=True)
+        exp = (att @ v).transpose(0, 2, 1, 3).reshape(b_, s, h)
+        np.testing.assert_allclose(got["Out"], exp, rtol=1e-4, atol=1e-5)
+
+    def test_conv3d_pool3d(self):
+        x = r(1, 2, 4, 4, 4)
+        w = r(3, 2, 2, 2, 2, seed=1)
+        got = bridge_run("conv3d", {"Input": x, "Filter": w},
+                         {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                          "dilations": [1, 1, 1], "groups": 1},
+                         outs=("Output",))
+        assert got["Output"].shape == (1, 3, 3, 3, 3)
+        got = bridge_run("pool3d", {"X": x},
+                         {"pooling_type": "max", "ksize": [2, 2, 2],
+                          "strides": [2, 2, 2], "paddings": [0, 0, 0]})
+        exp = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7))
+        np.testing.assert_allclose(got["Out"], exp)
+        got = bridge_run("pool3d", {"X": x},
+                         {"pooling_type": "avg",
+                          "global_pooling": True, "ksize": [1, 1, 1]})
+        np.testing.assert_allclose(got["Out"],
+                                   x.mean((2, 3, 4), keepdims=True),
+                                   rtol=1e-5)
+
+    def test_pool_with_index(self):
+        x = r(1, 1, 4, 4)
+        got = bridge_run("max_pool2d_with_index", {"X": x},
+                         {"ksize": [2, 2], "strides": [2, 2],
+                          "paddings": [0, 0]}, outs=("Out", "Mask"))
+        exp = x.reshape(1, 1, 2, 2, 2, 2).max((3, 5))
+        np.testing.assert_allclose(got["Out"], exp)
+        assert got["Mask"].shape == exp.shape
+
+    def test_data_norm(self):
+        x = r(4, 3)
+        bsize = np.full(3, 10.0, np.float32)
+        bsum = r(3, seed=1) * 10
+        bsq = r(3, seed=2) * 10 + 5
+        means, scales = bsum / bsize, np.sqrt(bsize / bsq)
+        check("data_norm", {"X": x, "BatchSize": bsize, "BatchSum": bsum,
+                            "BatchSquareSum": bsq}, None,
+              {"Y": (x - means) * scales},
+              outs=("Y", "Means", "Scales"), rtol=1e-4)
+
+    def test_spectral_norm(self):
+        w = r(4, 3)
+        u, v = r(4, seed=1), r(3, seed=2)
+        got = bridge_run("spectral_norm", {"Weight": w, "U": u, "V": v},
+                         {"dim": 0, "power_iters": 5, "eps": 1e-12})
+        # after enough power iters sigma ~= top singular value
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(got["Out"], w / sigma, rtol=1e-3)
+
+    def test_lrn(self):
+        x = r(1, 4, 2, 2)
+        got = bridge_run("lrn", {"X": x}, {"n": 5, "k": 1.0,
+                                           "alpha": 1e-4, "beta": 0.75})
+        assert got["Out"].shape == x.shape
+
+    def test_industrial_glue(self):
+        x = r(3, 4)
+        got = bridge_run("fsp", {"X": r(1, 2, 3, 3),
+                                 "Y": r(1, 4, 3, 3, seed=1)})
+        assert got["Out"].shape == (1, 2, 4)
+        got = bridge_run("add_position_encoding", {"X": r(2, 3, 4)},
+                         {"alpha": 1.0, "beta": 1.0})
+        assert got["Out"].shape == (2, 3, 4)
+        got = bridge_run("cvm", {"X": r(3, 6), "CVM": r(3, 2)},
+                         {"use_cvm": True}, outs=("Y",))
+        assert got["Y"].shape[0] == 3
+        got = bridge_run("hash", {"X": ri(3, 1, hi=100)},
+                         {"num_hash": 2, "mod_by": 1000})
+        assert got["Out"].shape[-2:] == (2, 1) or got["Out"].size == 6
+        got = bridge_run("batch_fc", {"Input": r(2, 3, 4),
+                                      "W": r(2, 4, 5, seed=1)})
+        np.testing.assert_allclose(
+            got["Out"], r(2, 3, 4) @ r(2, 4, 5, seed=1), rtol=1e-4)
+
+    def test_shuffle_batch(self):
+        x = np.arange(12, dtype=np.float32).reshape(6, 2)
+        got = bridge_run("shuffle_batch", {"X": x},
+                         {"startup_seed": 3},
+                         outs=("Out", "ShuffleIdx", "SeedOut"))
+        np.testing.assert_allclose(np.sort(got["Out"], 0), x)
+        np.testing.assert_array_equal(
+            got["Out"], x[got["ShuffleIdx"].astype(int)])
+
+    def test_set_value(self):
+        x = np.zeros((4, 3), np.float32)
+        got = bridge_run("set_value", {"Input": x},
+                         {"axes": [0], "starts": [1], "ends": [3],
+                          "steps": [1], "shape": [1],
+                          "fp32_values": [7.0]})
+        exp = x.copy()
+        exp[1:3] = 7.0
+        np.testing.assert_allclose(got["Out"], exp)
+
+    def test_warpctc_shape(self):
+        logits = r(5, 2, 4)  # T, B, C
+        labels = ri(2, 3, hi=3, dtype=np.int32) + 1
+        got = bridge_run("warpctc", {"Logits": logits, "Label": labels},
+                         {"blank": 0, "norm_by_times": False},
+                         outs=("Loss",))
+        assert got["Loss"].shape == (2, 1) and (got["Loss"] > 0).all()
+
+    def test_im2sequence(self):
+        x = r(1, 1, 4, 4)
+        got = bridge_run("im2sequence", {"X": x},
+                         {"kernels": [2, 2], "strides": [2, 2],
+                          "paddings": [0, 0, 0, 0]})
+        assert got["Out"].shape == (4, 4)
+
+    def test_sigmoid_focal_loss_detection(self):
+        x = r(4, 3) - 0.5
+        lab = np.array([[1], [0], [2], [3]], np.int64)
+        fg = np.array([3], np.int32)
+        got = bridge_run("sigmoid_focal_loss",
+                         {"X": x, "Label": lab, "FgNum": fg},
+                         {"gamma": 2.0, "alpha": 0.25})
+        assert got["Out"].shape == x.shape and (got["Out"] >= 0).all()
+
+    def test_nll_kldiv(self):
+        logp = np.log(np.clip(r(3, 4), 0.05, 1))
+        lab = np.array([0, 2, 3], np.int64)
+        check("nll_loss", {"X": logp, "Label": lab},
+              {"reduction": "mean", "ignore_index": -100},
+              -logp[np.arange(3), lab].mean(),
+              outs=("Out", "Total_weight"), rtol=1e-4)
+        t = np.clip(r(3, 4, seed=1), 0.05, 1)
+        check("kldiv_loss", {"X": logp, "Target": t},
+              {"reduction": "none"}, t * (np.log(t) - logp),
+              outs=("Loss",), rtol=1e-4)
+
+
+def bridge_run_lod(optype, ins, lods, attrs=None, outs=("Out",)):
+    """Like bridge_run but with `@LOD` sidecars for named inputs."""
+    scope = Scope()
+    desc_in, desc_out = [], []
+    for p, v in ins.items():
+        if isinstance(v, list):
+            names = [f"{p.lower()}_{i}" for i in range(len(v))]
+            for n, a in zip(names, v):
+                scope[n] = jnp.asarray(a)
+        else:
+            names = [p.lower() + "_v"]
+            scope[names[0]] = jnp.asarray(v)
+            if p in lods:
+                scope[names[0] + "@LOD"] = jnp.asarray(lods[p])
+        desc_in.append({"parameter": p, "arguments": names})
+    out_names = {}
+    for o in outs:
+        pp, _, k = o.partition("*")
+        names = [f"{pp.lower()}_out_{i}" for i in range(int(k or 1))]
+        out_names[pp] = (names, bool(k))
+        desc_out.append({"parameter": pp, "arguments": names})
+    desc = {"type": optype, "inputs": desc_in, "outputs": desc_out,
+            "attrs": [_encode_attr(k, v) for k, v in (attrs or {}).items()]}
+    with blocks_context([{"ops": [desc]}]):
+        run_block([desc], scope, {}, {})
+    res = {}
+    for pp, (names, variadic) in out_names.items():
+        vals = [np.asarray(scope[n]) for n in names if n in scope]
+        res[pp] = vals if variadic else (vals[0] if vals else None)
+        if not variadic and names[0] + "@LOD" in scope:
+            res[pp + "@LOD"] = np.asarray(scope[names[0] + "@LOD"])
+    return res
+
+
+class TestSequenceFamily:
+    def test_sequence_expand_as(self):
+        x = r(2, 3)
+        y = r(5, 1)
+        got = bridge_run_lod("sequence_expand_as", {"X": x, "Y": y},
+                             {"Y": [3, 2]})
+        # row 0 repeated 3x, row 1 repeated 2x — padded [B, T, D]
+        out = got["Out"]
+        assert out.shape[0] == 2
+        np.testing.assert_array_equal(got["Out@LOD"], [3, 2])
+
+    def test_sequence_erase(self):
+        x = np.array([[1, 2, 0, 2], [3, 2, 1, 0]], np.int64)
+        got = bridge_run_lod("sequence_erase", {"X": x},
+                             {"X": [4, 3]}, {"tokens": [2]})
+        # token 2 removed, sequences repacked left: [1,2,0,2]->[1,0],
+        # [3,2,1]->[3,1]
+        np.testing.assert_array_equal(got["Out@LOD"], [2, 2])
+        np.testing.assert_array_equal(got["Out"][0][:2], [1, 0])
+
+    def test_sequence_enumerate(self):
+        x = np.array([[1, 2, 3, 0]], np.int64)
+        got = bridge_run_lod("sequence_enumerate", {"X": x},
+                             {"X": [3]}, {"win_size": 2, "pad_value": 0})
+        np.testing.assert_array_equal(got["Out"][0][:3],
+                                      [[1, 2], [2, 3], [3, 0]])
+
+    def test_sequence_slice_and_unpad(self):
+        x = r(2, 5, 2)
+        got = bridge_run_lod(
+            "sequence_slice",
+            {"X": x, "Offset": np.array([[1], [0]], np.int64),
+             "Length": np.array([[2], [3]], np.int64)}, {"X": [5, 4]})
+        np.testing.assert_allclose(got["Out"][0][:2], x[0, 1:3])
+        got = bridge_run_lod(
+            "sequence_unpad",
+            {"X": x, "Length": np.array([3, 2], np.int64)}, {})
+        assert got["Out"].shape == (5, 2)  # packed sum(L) rows
+
+    def test_sequence_reshape(self):
+        x = r(2, 4, 2)
+        got = bridge_run_lod("sequence_reshape", {"X": x}, {"X": [4, 2]},
+                             {"new_dim": 4})
+        np.testing.assert_array_equal(got["Out@LOD"], [2, 1])
+
+    def test_sequence_concat(self):
+        a, bb = r(2, 2, 3), r(2, 3, 3, seed=1)
+        got = bridge_run_lod("sequence_concat", {"X": [a, bb]},
+                             {}, None)
+        assert got["Out"].shape[1] == 5  # concat along time
+
+    def test_sequence_conv(self):
+        x = r(2, 4, 3)
+        w = r(9, 5, seed=1)  # ctx_len=3 * D=3 -> 5
+        got = bridge_run_lod("sequence_conv",
+                             {"X": x, "Filter": w}, {"X": [4, 3]},
+                             {"contextLength": 3, "contextStart": -1})
+        assert got["Out"].shape == (2, 4, 5)
+
+
+class TestVisionFamily:
+    def test_iou_similarity(self):
+        x = np.array([[0, 0, 2, 2]], np.float32)
+        y = np.array([[1, 1, 3, 3], [0, 0, 2, 2]], np.float32)
+        got = bridge_run("iou_similarity", {"X": x, "Y": y},
+                         {"box_normalized": False})
+        np.testing.assert_allclose(got["Out"][0, 1], 1.0, rtol=1e-5)
+
+    def test_box_clip(self):
+        boxes = np.array([[[-1, -1, 5, 5]]], np.float32)
+        im = np.array([[4, 4, 1]], np.float32)
+        got = bridge_run("box_clip", {"Input": boxes, "ImInfo": im},
+                         outs=("Output",))
+        assert got["Output"].max() <= 4 and got["Output"].min() >= 0
+
+    def test_target_assign(self):
+        x = r(1, 2, 3, 4)  # [N, G, P, K] gt-major encoded targets
+        mi = np.array([[0, -1, 1]], np.int32)
+        got = bridge_run("target_assign", {"X": x, "MatchIndices": mi},
+                         {"mismatch_value": 0},
+                         outs=("Out", "OutWeight"))
+        assert got["Out"].shape[1] == 3
+
+    def test_bipartite_match(self):
+        dist = r(2, 3)
+        got = bridge_run("bipartite_match", {"DistMat": dist},
+                         {"match_type": "bipartite",
+                          "dist_threshold": 0.5},
+                         outs=("ColToRowMatchIndices",
+                               "ColToRowMatchDist"))
+        assert got["ColToRowMatchIndices"].shape[-1] == 3
+
+    def test_anchor_generator(self):
+        x = r(1, 3, 4, 4)
+        got = bridge_run("anchor_generator", {"Input": x},
+                         {"anchor_sizes": [32.0],
+                          "aspect_ratios": [1.0],
+                          "variances": [0.1, 0.1, 0.2, 0.2],
+                          "stride": [16.0, 16.0], "offset": 0.5},
+                         outs=("Anchors", "Variances"))
+        assert got["Anchors"].shape == (4, 4, 1, 4)
+
+    def test_roi_pool(self):
+        x = r(1, 2, 8, 8)
+        rois = np.array([[0, 0, 4, 4]], np.float32)
+        got = bridge_run("roi_pool", {"X": x, "ROIs": rois},
+                         {"pooled_height": 2, "pooled_width": 2,
+                          "spatial_scale": 1.0},
+                         outs=("Out", "Argmax"))
+        assert got["Out"].shape == (1, 2, 2, 2)
+
+    def test_deformable_conv_zero_offset_matches_conv(self):
+        x = r(1, 2, 5, 5)
+        w = r(3, 2, 3, 3, seed=1)
+        off = np.zeros((1, 2 * 3 * 3, 3, 3), np.float32)
+        got = bridge_run("deformable_conv",
+                         {"Input": x, "Offset": off, "Filter": w},
+                         {"strides": [1, 1], "paddings": [0, 0],
+                          "dilations": [1, 1], "groups": 1,
+                          "deformable_groups": 1, "im2col_step": 1},
+                         outs=("Output",))
+        ref = bridge_run("conv2d", {"Input": x, "Filter": w},
+                         {"strides": [1, 1], "paddings": [0, 0],
+                          "dilations": [1, 1], "groups": 1},
+                         outs=("Output",))
+        np.testing.assert_allclose(got["Output"], ref["Output"],
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_polygon_box_transform(self):
+        x = r(1, 8, 2, 2)
+        got = bridge_run("polygon_box_transform", {"Input": x},
+                         outs=("Output",))
+        assert got["Output"].shape == x.shape
+
+    def test_matrix_nms_smoke(self):
+        boxes = np.array([[[0, 0, 2, 2], [0, 0, 2.1, 2.1]]], np.float32)
+        scores = np.array([[[0.9, 0.8]]], np.float32)
+        got = bridge_run("matrix_nms", {"BBoxes": boxes,
+                                        "Scores": scores},
+                         {"score_threshold": 0.0, "post_threshold": 0.0,
+                          "nms_top_k": 2, "keep_top_k": 2,
+                          "background_label": -1},
+                         outs=("Out", "Index", "RoisNum"))
+        assert got["Out"].shape[-1] == 6
+
+
+class TestIndustrialFamily:
+    def test_tdm_child(self):
+        # tree_info rows: [item_id, layer_id, ancestor_id, child0, child1];
+        # node 0 is the null slot
+        tree = np.array([[0, 0, 0, 0, 0], [1, 0, 0, 2, 3],
+                         [2, 1, 1, 0, 0], [3, 1, 1, 0, 0]], np.int64)
+        got = bridge_run("tdm_child",
+                         {"X": np.array([[1]], np.int64),
+                          "TreeInfo": tree},
+                         {"child_nums": 2, "dtype": 3},
+                         outs=("Child", "LeafMask"))
+        np.testing.assert_array_equal(got["Child"].reshape(-1), [2, 3])
+
+    def test_crf_decoding(self):
+        em = r(1, 4, 3)
+        tr = r(5, 3, seed=1)
+        ln = np.array([4], np.int64)
+        got = bridge_run("crf_decoding",
+                         {"Emission": em, "Transition": tr,
+                          "Length": ln}, outs=("ViterbiPath",))
+        assert got["ViterbiPath"].shape[0] == 1
+
+    def test_center_loss(self):
+        x = r(4, 3)
+        lab = np.array([0, 1, 0, 1], np.int64)
+        centers = r(2, 3, seed=1)
+        rate = np.array([0.1], np.float32)
+        got = bridge_run("center_loss",
+                         {"X": x, "Label": lab, "Centers": centers,
+                          "CenterUpdateRate": rate},
+                         {"cluster_num": 2, "need_update": True},
+                         outs=("CentersOut", "SampleCenterDiff",
+                               "Loss"))
+        exp_loss = 0.5 * np.square(x - centers[lab]).sum(
+            1, keepdims=True)
+        np.testing.assert_allclose(got["Loss"], exp_loss, rtol=1e-4)
+        assert not np.allclose(got["CentersOut"], centers)
+
+    def test_quant_runtime(self):
+        x = (r(3, 4) * 20 - 10).astype(np.float32)
+        q = np.round(x / np.abs(x).max() * 127)
+        got = bridge_run("dequantize_abs_max",
+                         {"X": q.astype(np.int8),
+                          "Scale": np.abs(x).max().reshape(1)},
+                         {"max_range": 127.0})
+        np.testing.assert_allclose(got["Out"], q * np.abs(x).max() / 127,
+                                   rtol=1e-4)
+        got = bridge_run("moving_average_abs_max_scale", {"X": x},
+                         {"moving_rate": 0.9, "is_test": False},
+                         outs=("Out", "OutScale"))
+        # state=0.9*1+1=1.9, accum=0.9*0+max|x| -> scale=max|x|/1.9
+        np.testing.assert_allclose(got["OutScale"].reshape(()),
+                                   np.abs(x).max() / 1.9, rtol=1e-4)
+
+    def test_lstmp(self):
+        # fluid lstmp: Input pre-projected [B, T, 4D], Weight [P, 4D],
+        # ProjWeight [D, P]
+        d, p = 4, 3
+        x = r(2, 3, 4 * d)
+        w = r(p, 4 * d, seed=1) * 0.1
+        pw = r(d, p, seed=2) * 0.1
+        got = bridge_run("lstmp",
+                         {"Input": x, "Weight": w, "ProjWeight": pw},
+                         {"use_peepholes": False},
+                         outs=("Projection", "Cell"))
+        assert got["Projection"].shape == (2, 3, p)
+
+
 class TestReviewRegressions:
     """Round-4 review findings, each pinned by a regression test."""
 

@@ -240,8 +240,10 @@ class TestAppendBackward:
         from paddle_tpu import optimizer
 
         prog, b = self._train_program()
+        # round 4: Adam/AdamW/Adagrad/Adadelta/Adamax/RMSProp/Lamb now
+        # lower to in-program update ops; Ftrl remains eager-only
         with pytest.raises(NotImplementedError, match="static-graph"):
-            optimizer.Adam(learning_rate=1e-3).minimize(b.var("loss"))
+            optimizer.Ftrl(learning_rate=1e-3).minimize(b.var("loss"))
 
     def test_inplace_forward_var_rejected(self):
         import pytest

@@ -499,12 +499,43 @@ def resolve(name: str):
     return ("missing", None)
 
 
+def check_program_form(floor: int) -> int:
+    """Cross-check: every IMPLEMENTED op must have an interp translator
+    or a documented PROGRAM_FORM_NA reason (VERDICT r3 #1).  Returns the
+    translator count; exits nonzero on an unaccounted op or a floor
+    regression."""
+    from paddle_tpu.static.interp import OP_TRANSLATORS
+    from paddle_tpu.static.op_bridge import PROGRAM_FORM_NA
+
+    unaccounted = []
+    for op in OPS:
+        cat, _ = resolve(op)
+        if cat != "implemented":
+            continue
+        if op not in OP_TRANSLATORS and op not in PROGRAM_FORM_NA:
+            unaccounted.append(op)
+    n_types = sum(1 for op in set(OPS) if op in OP_TRANSLATORS)
+    print(f"program-form: {n_types} of the 487 reference op types "
+          f"translate; {len(PROGRAM_FORM_NA)} documented program-form-N/A")
+    if unaccounted:
+        print("UNACCOUNTED (implemented but no translator and no "
+              "documented N/A):", " ".join(unaccounted))
+        sys.exit(1)
+    if n_types < floor:
+        print(f"REGRESSION: translator coverage {n_types} < floor {floor}")
+        sys.exit(1)
+    return n_types
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--missing", action="store_true")
     ap.add_argument("--floor", type=int, default=0,
                     help="fail if implemented count drops below this")
+    ap.add_argument("--program-form-floor", type=int, default=400,
+                    help="fail if translator coverage drops below this")
     args = ap.parse_args()
+    check_program_form(args.program_form_floor)
 
     cats = {"implemented": [], "obsolete": [], "descoped": [],
             "missing": []}
