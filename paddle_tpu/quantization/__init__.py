@@ -267,3 +267,7 @@ class ImperativePTQ:
                 if isinstance(sub, (QuantizedLinear, QuantizedConv2D)):
                     sub.training = was_training
         return model
+
+
+from .int8 import (Int8Conv2D, Int8Linear, convert_to_int8,  # noqa: E402
+                   quantize_act, quantize_weight)

@@ -1,0 +1,161 @@
+"""TRUE int8 execution (round-4 VERDICT #4).
+
+Reference capability: deployed int8 inference —
+`inference/api/mkldnn_quantizer.cc:1` (CPU int8 via oneDNN) and the
+TensorRT int8 path.  TPU-native redesign: the MXU multiplies s8 x s8 into
+s32 natively, so int8 layers run `lax.dot_general` /
+`lax.conv_general_dilated` with `preferred_element_type=int32` on int8
+operands and dequantize the s32 accumulator with the folded
+`act_scale * w_scale / q_max^2` factor — no fake-quant simulation in the
+serving path, the arithmetic itself is int8.
+
+Flow: QAT/PTQ (`ImperativeQuantAware`/`ImperativePTQ`) calibrates
+activation scales -> `convert_to_int8(model)` materializes int8 weights
++ frozen scales and swaps the fake-quant twins for these executing
+layers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+from ..nn import Layer
+
+Q_MAX = 127.0
+
+
+def quantize_weight(w, quant_axis: int):
+    """Per-channel symmetric int8: returns (q_w int8, scale f32[channels])."""
+    w = unwrap(w)
+    red = tuple(i for i in range(w.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(w), axis=red)
+    shape = [1] * w.ndim
+    shape[quant_axis] = -1
+    q = jnp.clip(jnp.round(w / jnp.maximum(scale.reshape(shape), 1e-30)
+                           * Q_MAX), -Q_MAX, Q_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_act(x, scale):
+    """Per-tensor symmetric int8 with a calibrated static scale."""
+    return jnp.clip(jnp.round(unwrap(x) / jnp.maximum(scale, 1e-30)
+                              * Q_MAX), -Q_MAX, Q_MAX).astype(jnp.int8)
+
+
+class Int8Linear(Layer):
+    """y = dequant(s8(x) @ s8(W)) + b — the matmul executes in int8 on
+    the MXU (s32 accumulation), per-out-channel weight scales."""
+
+    def __init__(self, weight, bias, act_scale):
+        super().__init__()
+        qw, wscale = quantize_weight(weight, quant_axis=1)
+        self.register_buffer("qweight", Tensor(qw))
+        self.register_buffer("w_scale", Tensor(wscale))
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(unwrap(act_scale),
+                                                jnp.float32).reshape(())))
+        if bias is not None:
+            self.register_buffer("bias_f32",
+                                 Tensor(unwrap(bias).astype(jnp.float32)))
+        else:
+            self.bias_f32 = None
+
+    def forward(self, x):
+        qx = quantize_act(x, self.act_scale._array)
+        acc = jax.lax.dot_general(
+            qx, self.qweight._array,
+            dimension_numbers=(((qx.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        deq = acc.astype(jnp.float32) * (
+            self.act_scale._array * self.w_scale._array / (Q_MAX * Q_MAX))
+        if self.bias_f32 is not None:
+            deq = deq + self.bias_f32._array
+        return Tensor(deq.astype(unwrap(x).dtype))
+
+
+class Int8Conv2D(Layer):
+    """conv executes in int8 (s32 accumulation), per-out-channel weight
+    scales (quant_axis=0 — OIHW)."""
+
+    def __init__(self, weight, bias, act_scale, stride, padding, dilation,
+                 groups, data_format="NCHW"):
+        super().__init__()
+        qw, wscale = quantize_weight(weight, quant_axis=0)
+        self.register_buffer("qweight", Tensor(qw))
+        self.register_buffer("w_scale", Tensor(wscale))
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(unwrap(act_scale),
+                                                jnp.float32).reshape(())))
+        if bias is not None:
+            self.register_buffer("bias_f32",
+                                 Tensor(unwrap(bias).astype(jnp.float32)))
+        else:
+            self.bias_f32 = None
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+
+    def forward(self, x):
+        # same stride/padding normalization contract as F.conv2d — the
+        # layer this replaces accepted "SAME"/"VALID"/asymmetric pads
+        from ..nn.functional.conv import _padding, _pair
+
+        qx = quantize_act(x, self.act_scale._array)
+        stride = tuple(_pair(self._stride, 2))
+        pad = _padding(self._padding, 2)
+        dil = tuple(_pair(self._dilation, 2))
+        nhwc = self._data_format not in ("NCHW", "NCL", "NCDHW")
+        dn = ("NHWC", "OIHW", "NHWC") if nhwc else \
+            ("NCHW", "OIHW", "NCHW")
+        ch_shape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
+        acc = jax.lax.conv_general_dilated(
+            qx, self.qweight._array, window_strides=stride,
+            padding=pad, rhs_dilation=dil,
+            feature_group_count=max(self._groups, 1),
+            dimension_numbers=dn, preferred_element_type=jnp.int32)
+        deq = acc.astype(jnp.float32) * (
+            self.act_scale._array
+            * self.w_scale._array.reshape(ch_shape) / (Q_MAX * Q_MAX))
+        if self.bias_f32 is not None:
+            deq = deq + self.bias_f32._array.reshape(ch_shape)
+        return Tensor(deq.astype(unwrap(x).dtype))
+
+
+def convert_to_int8(model: Layer) -> Layer:
+    """Swap PTQ/QAT fake-quant twins (QuantizedLinear/QuantizedConv2D,
+    with calibrated `_act_scale`) for EXECUTING int8 layers in place."""
+    from . import QuantizedConv2D, QuantizedLinear
+
+    def _scale_or_raise(sub, name):
+        s = float(np.asarray(jax.device_get(sub._act_scale._array)))
+        if not s > 0:
+            raise ValueError(
+                f"convert_to_int8: layer {name!r} has no calibrated "
+                "activation scale — run PTQ calibration (ImperativePTQ."
+                "quantize(model, calib_fn=...)) or QAT steps first; "
+                "converting with scale 0 would saturate every "
+                "activation")
+        return sub._act_scale._array
+
+    def convert(layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, QuantizedLinear):
+                layer._sub_layers[name] = Int8Linear(
+                    sub.weight, getattr(sub, "bias", None),
+                    _scale_or_raise(sub, name))
+            elif isinstance(sub, QuantizedConv2D):
+                inner = sub._inner
+                layer._sub_layers[name] = Int8Conv2D(
+                    sub.weight, getattr(sub, "bias", None),
+                    _scale_or_raise(sub, name), inner._stride,
+                    inner._padding, inner._dilation, inner._groups,
+                    getattr(inner, "_data_format", "NCHW"))
+            else:
+                convert(sub)
+
+    convert(model)
+    return model

@@ -1,0 +1,208 @@
+"""TRUE int8 execution (round-4 VERDICT #4): PTQ scales -> int8
+dot_general/conv with s32 accumulation and per-channel dequant, gated on
+accuracy vs fp32.  Reference capability:
+`inference/api/mkldnn_quantizer.cc:1` (deployed int8 inference)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.quantization import (ImperativePTQ, Int8Conv2D,
+                                     Int8Linear, convert_to_int8)
+
+
+def _calibrated_int8(model, calib_x):
+    ptq = ImperativePTQ()
+    ptq.quantize(model, calib_fn=lambda m: m(paddle.to_tensor(calib_x)))
+    return convert_to_int8(model)
+
+
+class TestInt8Arithmetic:
+    def test_linear_really_runs_int8(self):
+        """The matmul operand dtypes ARE int8 with an int32 accumulator —
+        checked from the jaxpr, not inferred from accuracy."""
+        paddle.seed(0)
+        lin = nn.Linear(8, 4)
+        x = np.random.RandomState(0).rand(2, 8).astype(np.float32)
+        m = nn.Sequential(lin)
+        m.eval()
+        qm = _calibrated_int8(m, x)
+        layer = qm[0]
+        assert isinstance(layer, Int8Linear)
+        assert layer.qweight._array.dtype == jnp.int8
+
+        jaxpr = jax.make_jaxpr(
+            lambda a: layer(paddle.to_tensor(a))._array)(x)
+        dots = [e for e in jaxpr.jaxpr.eqns if
+                e.primitive.name == "dot_general"]
+        assert dots, "no dot_general in int8 linear"
+        (dot,) = dots
+        assert str(dot.invars[0].aval.dtype) == "int8"
+        assert str(dot.invars[1].aval.dtype) == "int8"
+        assert str(dot.outvars[0].aval.dtype) == "int32"
+
+    def test_linear_matches_manual_quant_math(self):
+        paddle.seed(1)
+        lin = nn.Linear(6, 3)
+        x = (np.random.RandomState(1).rand(4, 6).astype(np.float32)
+             - 0.5) * 2
+        m = nn.Sequential(lin)
+        m.eval()
+        w = np.asarray(lin.weight.numpy()).copy()
+        b = np.asarray(lin.bias.numpy()).copy()
+        qm = _calibrated_int8(m, x)
+        got = np.asarray(qm(paddle.to_tensor(x)).numpy())
+
+        a_s = np.abs(x).max()
+        w_s = np.abs(w).max(0)
+        qx = np.clip(np.round(x / a_s * 127), -127, 127)
+        qw = np.clip(np.round(w / w_s * 127), -127, 127)
+        exp = (qx @ qw) * (a_s * w_s / 127 / 127) + b
+        np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+    def test_conv_really_runs_int8(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Conv2D(2, 4, 3))
+        m.eval()
+        x = np.random.RandomState(0).rand(1, 2, 8, 8).astype(np.float32)
+        qm = _calibrated_int8(m, x)
+        layer = qm[0]
+        assert isinstance(layer, Int8Conv2D)
+        jaxpr = jax.make_jaxpr(
+            lambda a: layer(paddle.to_tensor(a))._array)(x)
+        convs = [e for e in jaxpr.jaxpr.eqns if
+                 e.primitive.name == "conv_general_dilated"]
+        (conv,) = convs
+        assert str(conv.invars[0].aval.dtype) == "int8"
+        assert str(conv.outvars[0].aval.dtype) == "int32"
+
+
+class TestInt8AccuracyGates:
+    def test_vision_top1_within_1pct(self):
+        """CNN classifier: int8 top-1 on held-out data within 1% of the
+        fp32 model (the VERDICT gate)."""
+        paddle.seed(7)
+        rng = np.random.RandomState(7)
+        # separable 4-class problem on 8x8 images
+        n = 512
+        ys = rng.randint(0, 4, n)
+        xs = rng.rand(n, 1, 8, 8).astype(np.float32) * 0.1
+        for i, y in enumerate(ys):
+            xs[i, 0, y * 2:y * 2 + 2, :] += 1.0
+        model = nn.Sequential(
+            nn.Conv2D(1, 8, 3, padding=1), nn.ReLU(),
+            nn.Flatten(), nn.Linear(8 * 64, 4))
+        opt = optimizer.Adam(0.005, parameters=model.parameters())
+        lossf = nn.CrossEntropyLoss()
+        for step in range(60):
+            sl = slice((step * 64) % 448, (step * 64) % 448 + 64)
+            loss = lossf(model(paddle.to_tensor(xs[sl])),
+                         paddle.to_tensor(ys[sl]))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        model.eval()
+        test_x, test_y = xs[448:], ys[448:]
+        fp32_pred = np.asarray(
+            model(paddle.to_tensor(test_x)).numpy()).argmax(1)
+        fp32_acc = (fp32_pred == test_y).mean()
+        assert fp32_acc > 0.9, fp32_acc  # the gate needs a trained model
+
+        qm = _calibrated_int8(model, xs[:128])
+        int8_pred = np.asarray(
+            qm(paddle.to_tensor(test_x)).numpy()).argmax(1)
+        int8_acc = (int8_pred == test_y).mean()
+        assert int8_acc >= fp32_acc - 0.01, (fp32_acc, int8_acc)
+
+    def test_lm_ppl_within_half_point(self):
+        """Tiny LM: int8 perplexity within 0.5 of fp32 (the VERDICT
+        gate's ppl-equivalent)."""
+        paddle.seed(3)
+        rng = np.random.RandomState(3)
+        vocab, ctx, n = 16, 8, 256
+        # learnable structure: next token = (sum of ctx) % vocab
+        xs = rng.randint(0, vocab, (n, ctx)).astype(np.int64)
+        ys = (xs.sum(1) % vocab).astype(np.int64)
+        model = nn.Sequential(
+            nn.Embedding(vocab, 16), nn.Flatten(),
+            nn.Linear(ctx * 16, 64), nn.ReLU(), nn.Linear(64, vocab))
+        opt = optimizer.Adam(0.01, parameters=model.parameters())
+        lossf = nn.CrossEntropyLoss()
+        for step in range(80):
+            loss = lossf(model(paddle.to_tensor(xs)),
+                         paddle.to_tensor(ys))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        model.eval()
+
+        def ppl(m):
+            logits = np.asarray(m(paddle.to_tensor(xs)).numpy())
+            logp = logits - np.log(
+                np.exp(logits - logits.max(1, keepdims=True)).sum(
+                    1, keepdims=True)) - logits.max(1, keepdims=True)
+            nll = -logp[np.arange(n), ys].mean()
+            return float(np.exp(nll))
+
+        fp32_ppl = ppl(model)
+        qm = _calibrated_int8(model, xs[:64])
+        int8_ppl = ppl(qm)
+        assert abs(int8_ppl - fp32_ppl) <= 0.5, (fp32_ppl, int8_ppl)
+
+    def test_int8_weights_halve_memory(self):
+        """The deployment win the reference's int8 path exists for: the
+        stored weight bytes really are 1/4 of f32."""
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(64, 64))
+        m.eval()
+        x = np.random.RandomState(0).rand(4, 64).astype(np.float32)
+        f32_bytes = 64 * 64 * 4
+        qm = _calibrated_int8(m, x)
+        assert qm[0].qweight._array.nbytes == f32_bytes // 4
+
+
+class TestReviewRegressionsInt8:
+    def test_nhwc_conv_matches_nchw(self):
+        paddle.seed(0)
+        x_nchw = np.random.RandomState(0).rand(1, 2, 8, 8).astype(
+            np.float32)
+        m1 = nn.Sequential(nn.Conv2D(2, 4, 3, padding=1))
+        m1.eval()
+        w0, b0 = m1[0].weight.numpy(), m1[0].bias.numpy()
+        q1 = _calibrated_int8(m1, x_nchw)
+        out1 = np.asarray(q1(paddle.to_tensor(x_nchw)).numpy())
+
+        m2 = nn.Sequential(nn.Conv2D(2, 4, 3, padding=1,
+                                     data_format="NHWC"))
+        m2.eval()
+        m2[0].weight.set_value(w0)
+        m2[0].bias.set_value(b0)
+        x_nhwc = x_nchw.transpose(0, 2, 3, 1)
+        q2 = _calibrated_int8(m2, x_nhwc)
+        out2 = np.asarray(q2(paddle.to_tensor(x_nhwc)).numpy())
+        np.testing.assert_allclose(out1, out2.transpose(0, 3, 1, 2),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_string_and_asymmetric_padding(self):
+        paddle.seed(0)
+        x = np.random.RandomState(0).rand(1, 2, 8, 8).astype(np.float32)
+        m = nn.Sequential(nn.Conv2D(2, 4, 3, padding="SAME"))
+        m.eval()
+        q = _calibrated_int8(m, x)
+        assert q(paddle.to_tensor(x)).numpy().shape == (1, 4, 8, 8)
+        m2 = nn.Sequential(nn.Conv2D(2, 4, 3, padding=[0, 1, 0, 1]))
+        m2.eval()
+        ref_shape = m2(paddle.to_tensor(x)).numpy().shape
+        q2 = _calibrated_int8(m2, x)
+        assert q2(paddle.to_tensor(x)).numpy().shape == ref_shape
+
+    def test_uncalibrated_convert_raises(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 2))
+        m.eval()
+        ImperativePTQ().quantize(m)  # no calib_fn: scale stays 0
+        with pytest.raises(ValueError, match="calibrated"):
+            convert_to_int8(m)

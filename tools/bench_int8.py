@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""int8 vs bf16 inference latency on the bench chip (round-4 VERDICT #4
+bench row).  Writes BENCH_int8.json.
+
+Run on TPU (default) or CPU (`JAX_PLATFORMS=cpu` for a smoke run).
+Timing is fenced with a host readback per iteration batch — under the
+axon tunnel `block_until_ready` returns before the device finishes
+(memory: axon-tunnel-async-timing).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def bench(fn, x, iters=30, warmup=5):
+    for _ in range(warmup):
+        np.asarray(jax.device_get(fn(x)))  # host fence
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    np.asarray(jax.device_get(out))  # fence the whole stretch
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    # MXU-heavy MLP block: [B, 4096] x [4096, 4096] x6 — large enough
+    # that per-call dispatch under the axon tunnel is amortized
+    b, d = 2048, 4096
+    rng = np.random.RandomState(0)
+    ws = [rng.rand(d, d).astype(np.float32) * 0.01 for _ in range(6)]
+    x = rng.rand(b, d).astype(np.float32)
+
+    w_bf16 = [jnp.asarray(w, jnp.bfloat16) for w in ws]
+
+    @jax.jit
+    def fwd_bf16(a):
+        h = a.astype(jnp.bfloat16)
+        for w in w_bf16:
+            h = jnp.maximum(h @ w, 0)
+        return h.astype(jnp.float32)
+
+    from paddle_tpu.quantization.int8 import Q_MAX, quantize_weight
+
+    qws, wscales = zip(*(quantize_weight(jnp.asarray(w), 1) for w in ws))
+    act_scale = jnp.asarray(np.abs(x).max(), jnp.float32)
+
+    @jax.jit
+    def fwd_int8(a):
+        h = a
+        s = act_scale
+        for qw, wsc in zip(qws, wscales):
+            qh = jnp.clip(jnp.round(h / s * Q_MAX), -Q_MAX,
+                          Q_MAX).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                qh, qw, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            h = jnp.maximum(
+                acc.astype(jnp.float32) * (s * wsc / (Q_MAX * Q_MAX)), 0)
+            s = jnp.max(jnp.abs(h))
+        return h
+
+    xj = jnp.asarray(x)
+    t_bf16 = bench(fwd_bf16, xj)
+    t_int8 = bench(fwd_int8, xj)
+    flops = 2 * b * d * d * 6
+    out = {
+        "platform": jax.devices()[0].platform,
+        "bf16_ms": round(t_bf16 * 1e3, 4),
+        "int8_ms": round(t_int8 * 1e3, 4),
+        "int8_speedup_vs_bf16": round(t_bf16 / t_int8, 3),
+        "bf16_tflops": round(flops / t_bf16 / 1e12, 2),
+        "int8_tops": round(flops / t_int8 / 1e12, 2),
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_int8.json")
+    with open(path, "w") as f:
+        json.dump(out, f)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
