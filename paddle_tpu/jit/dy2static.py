@@ -16,10 +16,24 @@ the fallback for exactly that case: a minimal AST pass that rewrites
 the AST pass only ever runs for functions that actually need it, and
 programs that trace cleanly keep the pure-trace path.
 
-Scope (documented constraints, mirroring the XLA requirements):
-branches/loops containing ``return``/``break``/``continue`` or
-``try``/``with`` are left unrewritten; loop-carried variables must be
-defined before the loop and keep loop-invariant shapes/dtypes.
+Flow-escape statements (round 4, mirroring the reference's
+`break_continue_transformer.py` / `return_transformer.py`):
+``return``/``break``/``continue`` inside rewritten blocks desugar to
+BOOLEAN GUARD CARRIES before the control-flow rewrite —
+``return e`` -> ``_pt_ret_val = e; _pt_ret_flag = True`` with every
+subsequent statement guarded by ``if _pt_not(_pt_ret_flag)``, loop tests
+conjoined with the negated flags, ``break``/``continue`` -> per-loop
+flags with the same guarding (the for-range counter bump stays
+unguarded so ``continue`` still advances).
+
+Remaining constraints (XLA requirements): ``try``/``with``/``yield``
+inside rewritten blocks are left unrewritten; every return path through
+tensor-dependent control flow must produce the same pytree structure;
+loop-carried variables must be defined before the loop and keep
+loop-invariant shapes/dtypes; reverse-mode gradients do NOT flow through
+a rewritten ``while`` (lax.while_loop is not reverse-differentiable —
+use a bounded ``for i in range(n)`` when the loop must be trained
+through).
 """
 from __future__ import annotations
 
@@ -42,19 +56,155 @@ class _Undef:
 
 _PT_UNDEF = _Undef()
 
+# empty-pytree registration: _PT_UNDEF survives jax.eval_shape probing
+# and lax.cond structure checks as a zero-leaf container
+import jax as _jax  # noqa: E402
+
+_jax.tree_util.register_pytree_node(
+    _Undef, lambda u: ((), None), lambda aux, ch: _PT_UNDEF)
+
+
+def _is_hole(v):
+    return v is None or isinstance(v, _Undef) or isinstance(v, bool)
+
 
 def _pt_if(pred, true_fn, false_fn, operands):
+    """cond over the branch closures.  Slots a branch leaves undefined
+    (None/_PT_UNDEF — e.g. `_pt_ret_val` on the path that doesn't
+    return) are PROMOTED to zeros of the other branch's shape/dtype so
+    lax.cond sees matching pytrees; the guard flags guarantee a promoted
+    placeholder is never read."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
     from ..ops import control_flow as cf
 
+    # structural holes can only enter through hole OPERANDS (a branch
+    # that doesn't bind a name returns the incoming placeholder) — skip
+    # the double abstract trace entirely in the common no-hole case
+    if not any(v is None or isinstance(v, _Undef) for v in operands):
+        return cf.cond(pred, lambda: true_fn(*operands),
+                       lambda: false_fn(*operands))
+
+    def spec_of(fn):
+        def probe(ops):
+            out = fn(*ops)
+            if not isinstance(out, tuple):
+                return out
+            return tuple(v._array if isinstance(v, Tensor) else v
+                         for v in out)
+
+        try:
+            probe_ops = tuple(v._array if isinstance(v, Tensor) else v
+                              for v in operands)
+            return jax.eval_shape(probe, probe_ops)
+        except Exception:
+            return None
+
+    s_t, s_f = spec_of(true_fn), spec_of(false_fn)
+    if (isinstance(s_t, tuple) and isinstance(s_f, tuple)
+            and len(s_t) == len(s_f)):
+        promos = []
+        for a, b in zip(s_t, s_f):
+            a_arr, b_arr = hasattr(a, "shape"), hasattr(b, "shape")
+            promos.append((a if a_arr else b) if a_arr != b_arr
+                          else None)
+        if any(p is not None for p in promos):
+            def fill(out):
+                vals = out if isinstance(out, tuple) else (out,)
+                return tuple(
+                    jnp.zeros(p.shape, p.dtype)
+                    if p is not None and _is_hole(
+                        v._array if isinstance(v, Tensor) else v)
+                    else v
+                    for v, p in zip(vals, promos))
+
+            return cf.cond(pred, lambda: fill(true_fn(*operands)),
+                           lambda: fill(false_fn(*operands)))
     return cf.cond(pred, lambda: true_fn(*operands),
                    lambda: false_fn(*operands))
 
 
 def _pt_while(cond_fn, body_fn, init):
+    """while_loop over the carried names.  Carry slots whose initial
+    value is a hole (None/_PT_UNDEF — e.g. `_pt_ret_val` before any
+    return ran) are promoted to zeros of the body's output spec; slots
+    that STAY holes (per eval_shape) are excluded from the lax carry and
+    passed through as constants."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
     from ..ops import control_flow as cf
 
-    out = cf.while_loop(cond_fn, body_fn, list(init))
+    init = list(init)
+
+    def uw(v):
+        return v._array if isinstance(v, Tensor) else v
+
+    if not any(v is None or isinstance(v, _Undef) for v in init):
+        out = cf.while_loop(cond_fn, body_fn, init)
+        return tuple(out)
+
+    try:
+        spec = jax.eval_shape(
+            lambda ops: tuple(uw(v) for v in body_fn(*ops)),
+            tuple(uw(v) for v in init))
+    except Exception:
+        spec = None
+    holes = set()
+    if isinstance(spec, tuple) and len(spec) == len(init):
+        for i, (iv, sp) in enumerate(zip(init, spec)):
+            iv_hole = iv is None or isinstance(iv, _Undef)
+            if iv_hole and hasattr(sp, "shape"):
+                init[i] = jnp.zeros(sp.shape, sp.dtype)
+            elif iv_hole:
+                holes.add(i)
+    if holes:
+        const = {i: init[i] for i in holes}
+        carried = [i for i in range(len(init)) if i not in holes]
+
+        def expand(args):
+            full, it = [], iter(args)
+            for i in range(len(init)):
+                full.append(const[i] if i in holes else next(it))
+            return full
+
+        out = cf.while_loop(
+            lambda *a: cond_fn(*expand(a)),
+            lambda *a: tuple(body_fn(*expand(a))[i] for i in carried),
+            [init[i] for i in carried])
+        return tuple(expand(out))
+    out = cf.while_loop(cond_fn, body_fn, init)
     return tuple(out)
+
+
+def _pt_not(x):
+    """Logical not that works on python bools AND traced tensors (the
+    guard flags start as python False and become traced after the first
+    rewritten branch writes them)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        x = x._array
+    if isinstance(x, bool):
+        return not x
+    return jnp.logical_not(x)
+
+
+def _pt_and(a, b):
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    a = a._array if isinstance(a, Tensor) else a
+    b = b._array if isinstance(b, Tensor) else b
+    if isinstance(a, bool) and isinstance(b, bool):
+        return a and b
+    return jnp.logical_and(a, b)
 
 
 class _Assigned(ast.NodeVisitor):
@@ -63,6 +213,7 @@ class _Assigned(ast.NodeVisitor):
 
     def __init__(self):
         self.names: Set[str] = set()
+        self.funcs: Set[str] = set()
 
     def _target(self, t):
         if isinstance(t, ast.Name):
@@ -90,10 +241,13 @@ class _Assigned(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_FunctionDef(self, node):
-        self.names.add(node.name)
+        # a def binds a FUNCTION object — never carryable through
+        # lax.cond/while (and the rewriter regenerates its closures
+        # inside each branch/body anyway)
+        self.funcs.add(node.name)
 
     def visit_AsyncFunctionDef(self, node):
-        self.names.add(node.name)
+        self.funcs.add(node.name)
 
     def visit_Lambda(self, node):
         pass
@@ -103,7 +257,7 @@ def _assigned_names(stmts: List[ast.stmt]) -> Set[str]:
     v = _Assigned()
     for s in stmts:
         v.visit(s)
-    return v.names
+    return v.names - v.funcs
 
 
 def _loaded_names(nodes) -> Set[str]:
@@ -116,15 +270,323 @@ def _loaded_names(nodes) -> Set[str]:
 
 
 def _has_flow_escape(stmts: List[ast.stmt]) -> bool:
-    """Return/break/continue/try/with anywhere in the (non-nested-def)
-    statement tree — constructs the rewrite cannot represent."""
-    for s in stmts:
-        for sub in ast.walk(s):
-            if isinstance(sub, (ast.Return, ast.Break, ast.Continue,
-                                ast.Try, ast.With, ast.Yield,
-                                ast.YieldFrom)):
+    """try/with/yield anywhere in the (non-nested-def) statement tree —
+    constructs the rewrite cannot represent.  return/break/continue are
+    DESUGARED to guard flags before this check runs (round 4); a
+    leftover one (e.g. inside try) still blocks the rewrite.  The
+    undef-guard Try statements the desugar itself emits are exempt."""
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if getattr(child, "_pt_generated", False):
+                continue
+            if isinstance(child, (ast.Return, ast.Break, ast.Continue,
+                                  ast.Try, ast.With, ast.Yield,
+                                  ast.YieldFrom)):
                 return True
+            # a return/break inside a nested def does NOT escape the
+            # enclosing block (and generated branch closures end in one)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if walk(child):
+                return True
+        return False
+
+    for s in stmts:
+        if getattr(s, "_pt_generated", False):
+            continue
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested defs (incl. generated closures) don't escape
+        if isinstance(s, (ast.Return, ast.Break, ast.Continue, ast.Try,
+                          ast.With)):
+            return True
+        if walk(s):
+            return True
     return False
+
+
+
+
+# ---------------------------------------------------------------------------
+# flow-escape desugaring (round 4) — the reference's
+# `dygraph_to_static/return_transformer.py` and
+# `break_continue_transformer.py` re-thought as boolean guard carries:
+# the flags travel through lax.cond/while carries like any other value.
+# ---------------------------------------------------------------------------
+def _name(n, ctx=None):
+    return ast.Name(id=n, ctx=ctx or ast.Load())
+
+
+def _assign(target, value):
+    a = ast.Assign(targets=[_name(target, ast.Store())], value=value)
+    a._pt_flagset = True
+    return a
+
+
+def _call(fn, *args):
+    return ast.Call(func=_name(fn), args=list(args), keywords=[])
+
+
+def _sets_flags(stmt, flags) -> bool:
+    """Does stmt (not descending into nested defs) assign any flag?"""
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                if isinstance(t, ast.Name) and t.id in flags:
+                    return True
+    return False
+
+
+def _guard_after(stmts, flags, guard_expr_fn):
+    """Wrap every statement FOLLOWING a flag-setting one in
+    ``if <not flags>:`` so a taken return/break skips the rest of the
+    block — recursively, preserving relative order."""
+    out: List[ast.stmt] = []
+    for i, s in enumerate(stmts):
+        out.append(s)
+        if _sets_flags(s, flags) and i + 1 < len(stmts):
+            rest = _guard_after(stmts[i + 1:], flags, guard_expr_fn)
+            g = ast.If(test=guard_expr_fn(), body=rest, orelse=[])
+            ast.copy_location(g, s)
+            out.append(g)
+            break
+    return out
+
+
+class _ReturnDesugar:
+    """``return e`` (below the top level) ->
+    ``_pt_ret_val = e; _pt_ret_flag = True`` + guards + loop-test
+    conjuncts + a single trailing ``return _pt_ret_val``."""
+
+    FLAG = "_pt_ret_flag"
+    VAL = "_pt_ret_val"
+
+    def run(self, fdef) -> bool:
+        if not self._has_nested_return(fdef.body):
+            return False
+        body = self._rewrite(fdef.body)
+        body = _guard_after(body, {self.FLAG}, self._guard)
+        init = [
+            _assign(self.FLAG, ast.Constant(value=False)),
+            _assign(self.VAL, ast.Constant(value=None)),
+        ]
+        tail = [ast.Return(value=_name(self.VAL))]
+        for n in init + tail:
+            ast.copy_location(n, fdef.body[0])
+        fdef.body = init + body + tail
+        ast.fix_missing_locations(fdef)
+        return True
+
+    def _guard(self):
+        return _call("_pt_not", _name(self.FLAG))
+
+    @staticmethod
+    def _has_nested_return(stmts) -> bool:
+        def walk(ss, top):
+            for s in ss:
+                if isinstance(s, ast.Return) and not top:
+                    return True
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                for field in ("body", "orelse", "finalbody"):
+                    if walk(getattr(s, field, []) or [], False):
+                        return True
+            return False
+
+        return walk(stmts, True)
+
+    def _rewrite(self, stmts):
+        out = []
+        for s in stmts:
+            if isinstance(s, ast.Return):
+                val = s.value if s.value is not None else \
+                    ast.Constant(value=None)
+                a1 = _assign(self.VAL, val)
+                a2 = _assign(self.FLAG, ast.Constant(value=True))
+                for a in (a1, a2):
+                    ast.copy_location(a, s)
+                out += [a1, a2]
+                continue
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(s)
+                continue
+            if isinstance(s, ast.If):
+                s.body = _guard_after(self._rewrite(s.body),
+                                      {self.FLAG}, self._guard)
+                s.orelse = _guard_after(self._rewrite(s.orelse),
+                                        {self.FLAG}, self._guard)
+            elif isinstance(s, (ast.While, ast.For)):
+                had = self._subtree_returns(s)
+                s.body = _guard_after(self._rewrite(s.body),
+                                      {self.FLAG}, self._guard)
+                if had:
+                    if isinstance(s, ast.While):
+                        s.test = _call("_pt_and", s.test, self._guard())
+                    else:
+                        # range-form fors get the while-test conjunct in
+                        # visit_For; CONCRETE fors (e.g. over layers)
+                        # keep iterating in python, so each iteration's
+                        # whole body must be skipped once returned
+                        s._pt_ret_inside = True
+                        g = ast.If(test=self._guard(), body=s.body,
+                                   orelse=[])
+                        ast.copy_location(g, s)
+                        s.body = [g]
+            out.append(s)
+        return out
+
+    @staticmethod
+    def _subtree_returns(node) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(sub, ast.Return):
+                return True
+        return False
+
+
+class _BreakContinueDesugar:
+    """Per-loop ``break``/``continue`` -> flags + guards.  Runs
+    inner-loops-first so each break binds to ITS loop."""
+
+    def __init__(self):
+        self._n = 0
+        self.rewrote = False
+
+    def _fresh(self, tag):
+        self._n += 1
+        self.rewrote = True
+        return f"_pt_{tag}_{self._n}"
+
+    def run(self, fdef):
+        fdef.body = self._walk_block(fdef.body)
+        ast.fix_missing_locations(fdef)
+
+    def _walk_block(self, stmts):
+        out = []
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(s)
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(s, field, None)
+                if sub:
+                    setattr(s, field, self._walk_block(sub))
+            if isinstance(s, (ast.While, ast.For)):
+                self._desugar_loop(s)
+                # flags must exist before the loop: they ride the while
+                # carry (assigned in body, read in test/guards)
+                for f in getattr(s, "_pt_flag_inits", []):
+                    init = _assign(f, ast.Constant(value=False))
+                    ast.copy_location(init, s)
+                    out.append(init)
+            out.append(s)
+        return out
+
+    @staticmethod
+    def _collect(stmts, kinds):
+        """break/continue at THIS loop level (descend into ifs, not into
+        nested loops/defs)."""
+        found = []
+
+        def walk(ss):
+            for s in ss:
+                if isinstance(s, kinds):
+                    found.append(s)
+                # Try/With block the control-flow rewrite, so a
+                # break/continue inside them must stay a real statement
+                # (leaving it makes _has_flow_escape refuse cleanly)
+                if isinstance(s, (ast.While, ast.For, ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Try,
+                                  ast.With)):
+                    continue
+                for field in ("body", "orelse", "finalbody"):
+                    walk(getattr(s, field, []) or [])
+
+        walk(stmts)
+        return found
+
+    @staticmethod
+    def _is_range_for(loop) -> bool:
+        return (isinstance(loop, ast.For)
+                and isinstance(loop.iter, ast.Call)
+                and isinstance(loop.iter.func, ast.Name)
+                and loop.iter.func.id == "range"
+                and len(loop.iter.args) == 1
+                and isinstance(loop.target, ast.Name))
+
+    def _desugar_loop(self, loop):
+        brks = self._collect(loop.body, ast.Break)
+        conts = self._collect(loop.body, ast.Continue)
+        if not brks and not conts:
+            return
+        # python skips a loop's else on break — removing the break would
+        # make it always run; leave the statements so the rewrite refuses
+        if loop.orelse:
+            return
+        # break needs a test that consults the flag: only While and
+        # single-arg-range For (desugared to While) have one.  A break
+        # in a concrete for (e.g. over layers) has nothing to stop the
+        # iteration — leave it so _has_flow_escape blocks the rewrite.
+        if brks and not (isinstance(loop, ast.While)
+                         or self._is_range_for(loop)):
+            return
+        flags = []
+        brk = cont = None
+        if brks:
+            brk = self._fresh("brk")
+            flags.append(brk)
+        if conts:
+            cont = self._fresh("cont")
+            flags.append(cont)
+
+        def replace(ss):
+            out = []
+            for s in ss:
+                if isinstance(s, ast.Break) and brk:
+                    a = _assign(brk, ast.Constant(value=True))
+                    ast.copy_location(a, s)
+                    out.append(a)
+                elif isinstance(s, ast.Continue) and cont:
+                    a = _assign(cont, ast.Constant(value=True))
+                    ast.copy_location(a, s)
+                    out.append(a)
+                else:
+                    if not isinstance(s, (ast.While, ast.For,
+                                          ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Try, ast.With)):
+                        for field in ("body", "orelse", "finalbody"):
+                            if getattr(s, field, None):
+                                setattr(s, field,
+                                        replace(getattr(s, field)))
+                    out.append(s)
+            return out
+
+        def guard():
+            e = _call("_pt_not", _name(flags[0]))
+            if len(flags) == 2:
+                e = _call("_pt_and",
+                          _call("_pt_not", _name(flags[0])),
+                          _call("_pt_not", _name(flags[1])))
+            return e
+
+        body = _guard_after(replace(loop.body), set(flags), guard)
+        head = []
+        if cont:
+            head.append(_assign(cont, ast.Constant(value=False)))
+        loop.body = head + body
+        if brk:
+            if isinstance(loop, ast.While):
+                loop.test = _call("_pt_and", loop.test,
+                                  _call("_pt_not", _name(brk)))
+            else:
+                loop._pt_brk_flag = brk
+        # every flag must exist before the loop runs (they ride the
+        # while carry)
+        loop._pt_flag_inits = getattr(loop, "_pt_flag_inits", []) + flags
+        ast.fix_missing_locations(loop)
 
 
 class _ControlFlowRewriter(ast.NodeTransformer):
@@ -154,7 +616,7 @@ class _ControlFlowRewriter(ast.NodeTransformer):
         operand tuple evaluate when the name is first bound inside the
         rewritten block (matching Python, a later real read of an
         undefined result still fails, just less precisely)."""
-        return ast.Try(
+        t = ast.Try(
             body=[ast.Expr(value=ast.Name(id=name, ctx=ast.Load()))],
             handlers=[ast.ExceptHandler(
                 type=ast.Name(id="NameError", ctx=ast.Load()),
@@ -163,6 +625,8 @@ class _ControlFlowRewriter(ast.NodeTransformer):
                     targets=[ast.Name(id=name, ctx=ast.Store())],
                     value=ast.Name(id="_PT_UNDEF", ctx=ast.Load()))])],
             orelse=[], finalbody=[])
+        t._pt_generated = True
+        return t
 
     def _rewrite_body(self, stmts, after):
         self._after_stack.append(after)
@@ -187,12 +651,16 @@ class _ControlFlowRewriter(ast.NodeTransformer):
         if _has_flow_escape(body) or _has_flow_escape(orelse):
             node.body, node.orelse = body, orelse
             return node
-        # carry only the mutated names that are READ after the if (the
-        # test already ran); branch-local temporaries stay local to their
-        # branch closure — carrying them would hand the other branch a
-        # _PT_UNDEF it cannot return through lax.cond
+        # carry mutated names read after the if OR read inside a branch
+        # (read-before-write of the outer value would otherwise become
+        # an UnboundLocalError in the closure).  Pure branch-local temps
+        # ride along as holes: _pt_if promotes a slot the other branch
+        # leaves undefined (_PT_UNDEF -> zeros), and the guard flags
+        # keep promoted placeholders unread.
         assigned = _assigned_names(body) | _assigned_names(orelse)
-        names = sorted(assigned & _loaded_names(after))
+        names = sorted(assigned & (_loaded_names(after)
+                                   | _loaded_names(body)
+                                   | _loaded_names(orelse)))
         tf_name, ff_name = self._fresh("true"), self._fresh("false")
 
         # Branch closures take the CURRENT values of every mutated name
@@ -241,7 +709,12 @@ class _ControlFlowRewriter(ast.NodeTransformer):
     # -- while on a traced condition -----------------------------------------
     def visit_While(self, node):
         after = list(self._after_stack[-1]) if self._after_stack else []
-        body = self._rewrite_body(node.body, after)
+        # inside a loop body, "read later" includes the NEXT iteration:
+        # the loop test and the body itself load names the current
+        # iteration's rewritten ifs must carry out
+        test_probe = ast.Expr(value=node.test)
+        loop_after = [test_probe] + list(node.body) + after
+        body = self._rewrite_body(node.body, loop_after)
         if _has_flow_escape(body) or node.orelse:
             node.body = body
             return node
@@ -315,10 +788,18 @@ class _ControlFlowRewriter(ast.NodeTransformer):
         bump = ast.AugAssign(
             target=ast.Name(id=ctr, ctx=ast.Store()),
             op=ast.Add(), value=ast.Constant(value=1))
+        test = ast.Compare(left=ast.Name(id=ctr, ctx=ast.Load()),
+                           ops=[ast.Lt()],
+                           comparators=[node.iter.args[0]])
+        brk_flag = getattr(node, "_pt_brk_flag", None)
+        if brk_flag:  # break inside: stop as soon as the flag is set
+            test = _call("_pt_and", test,
+                         _call("_pt_not", _name(brk_flag)))
+        if getattr(node, "_pt_ret_inside", False):
+            test = _call("_pt_and", test,
+                         _call("_pt_not", _name(_ReturnDesugar.FLAG)))
         loop = ast.While(
-            test=ast.Compare(left=ast.Name(id=ctr, ctx=ast.Load()),
-                             ops=[ast.Lt()],
-                             comparators=[node.iter.args[0]]),
+            test=test,
             body=[head] + list(node.body) + [bump], orelse=[])
         for n in (init, loop, head, bump):
             ast.copy_location(n, node)
@@ -343,9 +824,13 @@ def ast_transform(fn: Callable) -> Optional[Callable]:
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
     fdef.decorator_list = []
+    ret_pass = _ReturnDesugar()
+    ret_rewrote = ret_pass.run(fdef)
+    bc_pass = _BreakContinueDesugar()
+    bc_pass.run(fdef)
     rewriter = _ControlFlowRewriter()
     rewriter.visit(fdef)
-    if rewriter._uid == 0:
+    if rewriter._uid == 0 and not ret_rewrote and not bc_pass.rewrote:
         return None  # nothing to rewrite
     ast.fix_missing_locations(tree)
 
@@ -353,6 +838,8 @@ def ast_transform(fn: Callable) -> Optional[Callable]:
     glb = dict(raw.__globals__)
     glb["_pt_if"] = _pt_if
     glb["_pt_while"] = _pt_while
+    glb["_pt_not"] = _pt_not
+    glb["_pt_and"] = _pt_and
     glb["_PT_UNDEF"] = _PT_UNDEF
     if raw.__closure__:
         for name, cell in zip(raw.__code__.co_freevars, raw.__closure__):
@@ -365,6 +852,7 @@ def ast_transform(fn: Callable) -> Optional[Callable]:
     ns: dict = {}
     exec(code, glb, ns)  # noqa: S102 - compiling the user's own source
     new_fn = ns[fdef.name]
+    new_fn.__pt_rewritten__ = True  # "the AST fallback engaged" marker
     if raw.__defaults__:
         new_fn.__defaults__ = raw.__defaults__
     functools.update_wrapper(new_fn, raw)

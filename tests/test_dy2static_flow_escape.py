@@ -1,0 +1,298 @@
+"""dy2static flow-escape statements (round-4 VERDICT #5): return/break/
+continue inside rewritten tensor-dependent control flow, desugared to
+boolean guard carries — the reference's
+`dygraph_to_static/break_continue_transformer.py:1` /
+`return_transformer.py` capability — plus the model-scale equivalence
+suite (reference `tests/unittests/dygraph_to_static/test_bert.py` et
+al.), with assertions that the AST fallback actually engaged.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+
+
+def r(*shape, seed=0):
+    return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+
+def assert_rewritten(sf):
+    """The trace-first path must have FAILED and the AST fallback must
+    have produced the running function."""
+    assert getattr(sf._function, "__pt_rewritten__", False), \
+        "AST rewriter did not engage — the test no longer exercises it"
+
+
+class TestReturnInside:
+    def test_return_in_if(self):
+        @jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                return x * 2
+            return x - 1
+
+        xp = paddle.to_tensor(r(3) + 1)
+        np.testing.assert_allclose(f(xp).numpy(), (r(3) + 1) * 2,
+                                   rtol=1e-6)
+        xn = paddle.to_tensor(-r(3, seed=1) - 1)
+        np.testing.assert_allclose(f(xn).numpy(), -r(3, seed=1) - 2,
+                                   rtol=1e-6)
+        assert_rewritten(f)
+
+    def test_return_in_while(self):
+        @jit.to_static
+        def f(x):
+            i = paddle.to_tensor(np.int32(0))
+            while i < 10:
+                x = x + 1
+                if x.sum() > 5:
+                    return x  # early exit mid-loop
+                i = i + 1
+            return x
+
+        out = f(paddle.to_tensor(np.zeros(2, np.float32)))
+        # sum crosses 5 after 3 increments (sum=6)
+        np.testing.assert_allclose(out.numpy(), [3.0, 3.0])
+        assert_rewritten(f)
+
+    def test_statements_after_taken_return_are_skipped(self):
+        @jit.to_static
+        def f(x):
+            y = x * 1
+            if x.sum() > 0:
+                return y
+            y = y + 100  # must NOT execute on the early-return path
+            return y
+
+        out = f(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(out.numpy(), [1.0, 1.0])
+        out = f(paddle.to_tensor(-np.ones(2, np.float32)))
+        np.testing.assert_allclose(out.numpy(), [99.0, 99.0])
+        assert_rewritten(f)
+
+
+class TestBreakContinue:
+    def test_break_in_while(self):
+        @jit.to_static
+        def f(x):
+            i = paddle.to_tensor(np.int32(0))
+            acc = x * 0
+            while i < 100:
+                acc = acc + x
+                if acc.sum() > 4:
+                    break
+                i = i + 1
+            return acc
+
+        out = f(paddle.to_tensor(np.ones(2, np.float32)))
+        # acc grows by 2/iter; breaks once sum > 4 -> acc = [3, 3]
+        np.testing.assert_allclose(out.numpy(), [3.0, 3.0])
+        assert_rewritten(f)
+
+    def test_continue_in_for_range(self):
+        @jit.to_static
+        def f(x, n):
+            acc = x * 0
+            for i in range(n):
+                if paddle.to_tensor(np.int32(0)) + i == 1:
+                    continue  # skip iteration 1
+                acc = acc + i
+            return acc
+
+        out = f(paddle.to_tensor(np.zeros(1, np.float32)),
+                paddle.to_tensor(np.int32(4)))
+        np.testing.assert_allclose(out.numpy(), [0 + 2 + 3])
+        assert_rewritten(f)
+
+    def test_break_in_for_range_preserves_loop_var(self):
+        @jit.to_static
+        def f(x, n):
+            hit = x * 0
+            for i in range(n):
+                hit = hit + 1
+                if hit.sum() >= 3:
+                    break
+            return hit
+
+        out = f(paddle.to_tensor(np.zeros(1, np.float32)),
+                paddle.to_tensor(np.int32(10)))
+        np.testing.assert_allclose(out.numpy(), [3.0])
+        assert_rewritten(f)
+
+
+class TestModelScale:
+    """Eager vs to_static equivalence on model-sized programs with
+    tensor-dependent control flow — the reference's de-facto
+    integration suite (dygraph_to_static/test_bert.py and the seq2seq
+    tests), with the rewriter-engaged assertion."""
+
+    def _mini_bert(self):
+        paddle.seed(0)
+
+        d, heads, layers = 32, 4, 3
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.attn = nn.MultiHeadAttention(d, heads)
+                self.ln1 = nn.LayerNorm(d)
+                self.fc1 = nn.Linear(d, d * 4)
+                self.fc2 = nn.Linear(d * 4, d)
+                self.ln2 = nn.LayerNorm(d)
+
+            def forward(self, h):
+                h = self.ln1(h + self.attn(h, h, h))
+                return self.ln2(h + self.fc2(
+                    nn.functional.gelu(self.fc1(h))))
+
+        class MiniBert(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(50, d)
+                self.blocks = nn.LayerList([Block()
+                                            for _ in range(layers)])
+                self.head = nn.Linear(d, 2)
+
+            def forward(self, ids, halt_threshold):
+                h = self.emb(ids)
+                for blk in self.blocks:
+                    h = blk(h)
+                    # adaptive early exit on a TENSOR condition: the
+                    # trace-only path cannot branch on this
+                    if paddle.abs(h).mean() > halt_threshold:
+                        return self.head(h.mean(axis=1))
+                return self.head(h.mean(axis=1))
+
+        return MiniBert()
+
+    def test_bert_eager_vs_to_static(self):
+        model = self._mini_bert()
+        model.eval()
+        ids = np.random.RandomState(0).randint(0, 50, (2, 8))
+        ids = ids.astype(np.int64)
+        thr = paddle.to_tensor(np.float32(0.35))
+        eager_out = model(paddle.to_tensor(ids), thr).numpy()
+
+        sf = jit.to_static(model.forward)
+        static_out = sf(paddle.to_tensor(ids), thr).numpy()
+        np.testing.assert_allclose(np.asarray(static_out),
+                                   np.asarray(eager_out), rtol=2e-4,
+                                   atol=2e-5)
+        assert_rewritten(sf)
+
+    def test_seq2seq_greedy_decode_with_break(self):
+        """Greedy decoder: a tensor while-loop over steps with an EOS
+        break — the reference's seq2seq dy2static shape."""
+        paddle.seed(1)
+        d, vocab, eos, max_len = 16, 12, 0, 7
+
+        class Decoder(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.cell = nn.GRUCell(d, d)
+                self.emb = nn.Embedding(vocab, d)
+                self.out = nn.Linear(d, vocab)
+
+            def forward(self, h0):
+                h = h0
+                tok = paddle.full([h0.shape[0]], 3, dtype="int64")
+                toks = paddle.zeros([h0.shape[0], max_len],
+                                    dtype="int64")
+                i = paddle.to_tensor(np.int32(0))
+                while i < max_len:
+                    _, h = self.cell(self.emb(tok), h)
+                    logits = self.out(h)
+                    tok = paddle.argmax(logits, axis=-1)
+                    toks = paddle.scatter_col(toks, i, tok) if hasattr(
+                        paddle, "scatter_col") else \
+                        _set_col(toks, i, tok)
+                    if (tok == eos).all():
+                        break  # every sequence emitted EOS
+                    i = i + 1
+                return toks
+
+        def _set_col(t, i, v):
+            import jax.numpy as jnp
+
+            from paddle_tpu.core.tensor import Tensor, unwrap
+
+            arr = unwrap(t)
+            return Tensor(jax.lax.dynamic_update_slice(
+                arr, unwrap(v).astype(arr.dtype)[:, None],
+                (0, jnp.asarray(unwrap(i), jnp.int32))))
+
+        import jax
+
+        dec = Decoder()
+        dec.eval()
+        h0 = paddle.to_tensor(r(2, 16, seed=3) * 0.1)
+        eager = dec(h0).numpy()
+        sf = jit.to_static(dec.forward)
+        static = sf(h0).numpy()
+        np.testing.assert_array_equal(np.asarray(eager),
+                                      np.asarray(static))
+        assert_rewritten(sf)
+
+
+class TestDesugarRefusals:
+    """Round-4 review: loops the desugar CANNOT represent must keep
+    their break/continue so the AST pass refuses (ast_transform finds
+    nothing rewritable and the clean trace error propagates) — never
+    silently compute wrong values."""
+
+    @staticmethod
+    def _transform(fn):
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        return ast_transform(fn)
+
+    def test_break_in_concrete_for_refused(self):
+        def f(x):
+            acc = x * 0
+            for k in [1.0, 2.0, 3.0]:
+                acc = acc + k
+                if acc.sum() > 0.5:
+                    break
+            return acc
+
+        # nothing to stop a concrete-iterable loop: the break must
+        # survive, blocking the if-rewrite -> nothing rewritten
+        assert self._transform(f) is None
+
+    def test_loop_else_with_break_refused(self):
+        def f(x):
+            acc = x * 0
+            i = paddle.to_tensor(np.int32(0))
+            while i < 3:
+                acc = acc + 1
+                if acc.sum() > 0.5:
+                    break
+                i = i + 1
+            else:
+                acc = acc + 100
+            return acc
+
+        # python skips else on break; the desugar cannot represent that
+        assert self._transform(f) is None
+
+    def test_continue_in_try_refused(self):
+        def f(x):
+            acc = x * 0
+            for k in range(3):
+                try:
+                    if x.sum() + k > 2.5:
+                        continue
+                    acc = acc + k
+                finally:
+                    pass
+            return acc
+
+        g = self._transform(f)
+        if g is not None:
+            # if anything was rewritten, the try-block's continue must
+            # STILL be a real continue (eager semantics preserved)
+            import numpy as _np
+
+            out = g(paddle.to_tensor(_np.zeros(1, _np.float32)))
+            _np.testing.assert_allclose(out.numpy(), [0 + 1 + 2])
