@@ -80,3 +80,83 @@ def test_op_bench_gate_device_mismatch(tmp_path):
         [sys.executable, "tools/check_op_benchmark_result.py", a, b],
         cwd=REPO, capture_output=True, text=True, timeout=60)
     assert r.returncode == 2 and "device mismatch" in r.stdout
+
+
+class TestTpuOpGate:
+    """Round-4 VERDICT #8: the TPU op-perf gate (matmul-normalized
+    units, tools/op_bench_tpu_baseline.json + bench._tpu_op_gate)."""
+
+    def _fake_results(self, flash_units):
+        import json
+
+        base = json.load(open(os.path.join(REPO, "tools",
+                                           "op_bench_tpu_baseline.json")))
+        res = []
+        for r in base["results"]:
+            u = flash_units if r["op"] == "flash_attention" else \
+                r["matmul_units"]
+            res.append({"op": r["op"], "mean_us": u * 1000.0,
+                        "iters": 8, "matmul_units": u})
+        return {"device": base["device"], "results": res}
+
+    def test_deoptimized_flash_trips_gate(self, tmp_path):
+        """A flash kernel collapsing to >2x its baseline units (falling
+        back to composed attention at S=2048 is ~2.8-3.7x) must FAIL
+        the gate."""
+        import json
+        import subprocess
+        import sys
+
+        base_path = os.path.join(REPO, "tools",
+                                 "op_bench_tpu_baseline.json")
+        base = json.load(open(base_path))
+        flash_base = next(r["matmul_units"] for r in base["results"]
+                          if r["op"] == "flash_attention")
+        bad = tmp_path / "bad.json"
+        json.dump(self._fake_results(flash_base * 3.2), open(bad, "w"))
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "check_op_benchmark_result.py"),
+             base_path, str(bad), "--threshold", "2.0"],
+            capture_output=True, text=True)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "flash_attention" in r.stdout
+
+    def test_healthy_run_passes_gate(self, tmp_path):
+        import json
+        import subprocess
+        import sys
+
+        base_path = os.path.join(REPO, "tools",
+                                 "op_bench_tpu_baseline.json")
+        base = json.load(open(base_path))
+        flash_base = next(r["matmul_units"] for r in base["results"]
+                          if r["op"] == "flash_attention")
+        ok = tmp_path / "ok.json"
+        # 1.3x = the measured session-to-session swing: must NOT trip
+        json.dump(self._fake_results(flash_base * 1.3), open(ok, "w"))
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "check_op_benchmark_result.py"),
+             base_path, str(ok), "--threshold", "2.0"],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_missing_op_trips_gate(self, tmp_path):
+        import json
+        import subprocess
+        import sys
+
+        base_path = os.path.join(REPO, "tools",
+                                 "op_bench_tpu_baseline.json")
+        data = self._fake_results(1.0)
+        data["results"] = [r for r in data["results"]
+                           if r["op"] != "flash_attention"]
+        new = tmp_path / "short.json"
+        json.dump(data, open(new, "w"))
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "check_op_benchmark_result.py"),
+             base_path, str(new), "--threshold", "2.0"],
+            capture_output=True, text=True)
+        assert r.returncode == 1
