@@ -163,6 +163,53 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     return Tensor(np.asarray(corr, dtype=np.float32))
 
 
+def extract_chunk_spans(tags, scheme="IOB", num_chunk_types=1,
+                        excluded=()):
+    """[(start, end_exclusive, type)] per the reference's GetSegments
+    rules (`operators/metrics/chunk_eval_op.h`): label = chunk_type *
+    num_tag_types + tag; labels outside [0, num_chunk_types*num_tag_types)
+    are Outside.  Schemes: plain (every in-range position its own
+    chunk), IOB (B=0/I=1), IOE (I=0/E=1), IOBES (B/I/E/S=0..3).  The
+    ONE chunk decoder — ChunkEvaluator and the `chunk_eval` interp
+    translator both use it."""
+    n_tag = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    excluded = set(excluded)
+    chunks = []
+    start = None
+    cur_type = None
+
+    def flush(end):
+        if start is not None and cur_type not in excluded:
+            chunks.append((start, end, cur_type))
+
+    tags = [int(t) for t in tags]
+    for i, lab in enumerate(tags):
+        if lab < 0 or lab >= num_chunk_types * n_tag:
+            flush(i)
+            start, cur_type = None, None
+            continue
+        ctype, tag = divmod(lab, n_tag)
+        if scheme == "plain":
+            begins, ends_here = True, True
+        elif scheme == "IOB":
+            begins = tag == 0 or ctype != cur_type or start is None
+            ends_here = False
+        elif scheme == "IOE":
+            begins = ctype != cur_type or start is None
+            ends_here = tag == 1
+        else:  # IOBES
+            begins = tag in (0, 3) or ctype != cur_type or start is None
+            ends_here = tag in (2, 3)
+        if begins:
+            flush(i)
+            start, cur_type = i, ctype
+        if ends_here:
+            flush(i + 1)
+            start, cur_type = None, None
+    flush(len(tags))
+    return chunks
+
+
 class ChunkEvaluator(Metric):
     """Chunking (NER) precision/recall/F1 over IOB-style tag sequences
     (reference `operators/metrics/chunk_eval_op.*` + `metric` wrapper).
@@ -199,35 +246,14 @@ class ChunkEvaluator(Metric):
 
     @staticmethod
     def extract_chunks(tags, scheme="IOB", n_types=None):
-        """Decode (start, end, type) chunks from an IOB tag sequence where
-        tag = type*2 (+0=B, +1=I) and any tag >= 2*n_types (conventionally
-        2*n_types itself) is Outside, matching chunk_eval_op's plain
-        scheme."""
-        # Mirrors the reference's ChunkBegin/ChunkEnd for the IOB scheme
-        # (`chunk_eval_op.h:88-112`): a chunk ends on Outside, on a type
-        # switch, or on a B tag; it begins on B, on a type switch, or on
-        # any non-Outside tag following Outside (stray I starts a chunk).
-        tags = [int(t) for t in tags]
-        o_floor = 2 * n_types if n_types is not None else None
-        chunks = []
-        start, ctype = None, None
-        for i, tg in enumerate(tags):
-            if o_floor is not None and tg >= o_floor:  # Outside
-                if start is not None:
-                    chunks.append((start, i - 1, ctype))
-                start, ctype = None, None
-                continue
-            ty, io = tg // 2, tg % 2
-            ends = start is not None and (ty != ctype or io == 0)
-            if ends:
-                chunks.append((start, i - 1, ctype))
-                start, ctype = None, None
-            begins = (start is None) or io == 0 or ty != ctype
-            if begins:
-                start, ctype = i, ty
-        if start is not None:
-            chunks.append((start, len(tags) - 1, ctype))
-        return chunks
+        """Decode (start, end_inclusive, type) chunks; thin wrapper over
+        the module-level multi-scheme `extract_chunk_spans` (the single
+        chunk decoder, shared with the `chunk_eval` interp translator)."""
+        spans = extract_chunk_spans(
+            tags, scheme=scheme,
+            num_chunk_types=n_types if n_types is not None else 1 << 30,
+            excluded=())
+        return [(s, e - 1, t) for s, e, t in spans]
 
     def compute(self, infer_tags, label_tags, lengths=None, n_types=None):
         """Host-side chunk extraction; returns the three counts update()

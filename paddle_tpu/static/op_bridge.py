@@ -2554,10 +2554,9 @@ PROGRAM_FORM_NA = {
     # paddle-2.x `rnn` op (translated) is the serialized form our nn.LSTM
     # emits
     "cudnn_lstm": "interp `rnn` translator + nn.LSTM",
-    # host-side evaluation metrics over variable-length outputs; the
-    # metric classes compute these on fetched results (reference uses
-    # them the same way in Python evaluators)
-    "chunk_eval": "metric.ChunkEvaluator (host)",
+    # host-side evaluation metric over variable-length detection
+    # outputs (LoD state tensors); metric.DetectionMAP computes it on
+    # fetched results (reference uses it the same way in evaluators)
     "detection_map": "metric.DetectionMAP (host)",
     # host IO with data-dependent output shapes
     "read_file": "vision.read_file (host)",
@@ -2715,4 +2714,72 @@ for _n in ("save", "load", "save_combine", "load_combine", "dgc"):
     from .interp import DYNAMIC_SHAPE_OPS as _DSO
 
     _DSO.add(_n)
+
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval (operators/metrics/chunk_eval_op.h): IOB-family chunk
+# extraction + batch precision/recall/F1.  The reference runs this
+# CPU-side; here the extraction runs as a host callback
+# (jax.pure_callback is jit-compatible), so the op is a real translator
+# in both execution modes.
+# ---------------------------------------------------------------------------
+def _chunk_counts(inf, lab, lengths, scheme, num_chunk_types, excluded):
+    inf = np.asarray(inf)
+    lab = np.asarray(lab)
+    if inf.ndim == 1:
+        inf, lab = inf[None], lab[None]
+    n_inf = n_lab = n_cor = 0
+    for row in range(inf.shape[0]):
+        ln = int(lengths[row]) if lengths is not None else inf.shape[1]
+        from paddle_tpu.metric import extract_chunk_spans
+
+        ci = extract_chunk_spans(inf[row, :ln], scheme,
+                                 num_chunk_types, excluded)
+        cl = extract_chunk_spans(lab[row, :ln], scheme,
+                                 num_chunk_types, excluded)
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(set(ci) & set(cl))
+    return (np.asarray([n_inf], np.int32), np.asarray([n_lab], np.int32),
+            np.asarray([n_cor], np.int32))
+
+
+@braw("chunk_eval")
+def _chunk_eval_op(op, scope, feeds, fetches):
+    inf = scope.fetch(op.input("Inference"))
+    lab = scope.fetch(op.input("Label"))
+    seq_in = op.input("SeqLength")
+    lengths = scope.fetch(seq_in).reshape(-1) if seq_in else None
+    scheme = op.attr("chunk_scheme", "IOB")
+    nct = int(op.attr("num_chunk_types", 1))
+    excl = set(int(e) for e in op.attr("excluded_chunk_types", []))
+
+    def host(i_, l_, ln_):
+        return _chunk_counts(i_, l_, ln_, scheme, nct, excl)
+
+    # int32 shapes: x64 is disabled in this stack (callback results
+    # must match); counts cast up for the declared int64 outputs after
+    shapes = (jax.ShapeDtypeStruct((1,), jnp.int32),) * 3
+    if lengths is not None:
+        n_inf, n_lab, n_cor = jax.pure_callback(
+            host, shapes, inf, lab, lengths)
+    else:
+        n_inf, n_lab, n_cor = jax.pure_callback(
+            lambda i_, l_: host(i_, l_, None), shapes, inf, lab)
+    fi = n_inf.astype(jnp.float32)
+    fl = n_lab.astype(jnp.float32)
+    fc = n_cor.astype(jnp.float32)
+    p = jnp.where(fi > 0, fc / jnp.maximum(fi, 1), 0.0)
+    r = jnp.where(fl > 0, fc / jnp.maximum(fl, 1), 0.0)
+    f1 = jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+    scope[op.output("Precision")] = p
+    scope[op.output("Recall")] = r
+    scope[op.output("F1-Score")] = f1
+    if op.output("NumInferChunks"):
+        scope[op.output("NumInferChunks")] = n_inf.astype(jnp.int64)
+    if op.output("NumLabelChunks"):
+        scope[op.output("NumLabelChunks")] = n_lab.astype(jnp.int64)
+    if op.output("NumCorrectChunks"):
+        scope[op.output("NumCorrectChunks")] = n_cor.astype(jnp.int64)
 

@@ -644,3 +644,57 @@ class TestMetricOps:
                                      "NeutralPair"))
         assert float(got["PositivePair"][0]) == 2.0
         assert float(got["NegativePair"][0]) == 0.0
+
+
+class TestChunkEval:
+    """chunk_eval translator (operators/metrics/chunk_eval_op.h):
+    IOB chunk extraction vs hand-counted spans."""
+
+    def test_iob_counts_and_f1(self):
+        # 2 chunk types, IOB: label = type*2 + {B:0, I:1}; 4 = outside
+        #          B0 I0 O  B1 I1   (label row: two chunks)
+        lab = np.array([[0, 1, 4, 2, 3]], np.int64)
+        #          B0 I0 O  B1 B1   (inference: chunk (3,5,1) broken)
+        inf = np.array([[0, 1, 4, 2, 2]], np.int64)
+        got = bridge_run("chunk_eval",
+                         {"Inference": inf, "Label": lab},
+                         {"num_chunk_types": 2, "chunk_scheme": "IOB"},
+                         outs=("Precision", "Recall", "F1-Score",
+                               "NumInferChunks", "NumLabelChunks",
+                               "NumCorrectChunks"))
+        assert int(got["NumLabelChunks"][0]) == 2
+        assert int(got["NumInferChunks"][0]) == 3  # B0I0, B1, B1
+        assert int(got["NumCorrectChunks"][0]) == 1  # only (0,2,0)
+        np.testing.assert_allclose(got["Precision"], [1 / 3], rtol=1e-5)
+        np.testing.assert_allclose(got["Recall"], [0.5], rtol=1e-5)
+
+    def test_exact_match_and_seq_length(self):
+        lab = np.array([[0, 1, 1, 4, 4]], np.int64)
+        got = bridge_run("chunk_eval",
+                         {"Inference": lab, "Label": lab,
+                          "SeqLength": np.array([3], np.int64)},
+                         {"num_chunk_types": 2, "chunk_scheme": "IOB"},
+                         outs=("Precision", "Recall", "F1-Score"))
+        np.testing.assert_allclose(got["F1-Score"], [1.0], rtol=1e-6)
+
+    def test_iobes_and_plain(self):
+        # IOBES 1 type: B=0 I=1 E=2 S=3, outside=4
+        lab = np.array([[0, 1, 2, 4, 3]], np.int64)  # chunks (0,3),(4,5)
+        got = bridge_run("chunk_eval",
+                         {"Inference": lab, "Label": lab},
+                         {"num_chunk_types": 1,
+                          "chunk_scheme": "IOBES"},
+                         outs=("Precision", "Recall", "F1-Score",
+                               "NumInferChunks", "NumLabelChunks",
+                               "NumCorrectChunks"))
+        assert int(got["NumLabelChunks"][0]) == 2
+        np.testing.assert_allclose(got["F1-Score"], [1.0], rtol=1e-6)
+        # plain: every in-range position is its own single-token chunk
+        lab2 = np.array([[0, 1, 9]], np.int64)
+        got = bridge_run("chunk_eval",
+                         {"Inference": lab2, "Label": lab2},
+                         {"num_chunk_types": 2, "chunk_scheme": "plain"},
+                         outs=("Precision", "Recall", "F1-Score",
+                               "NumInferChunks", "NumLabelChunks",
+                               "NumCorrectChunks"))
+        assert int(got["NumLabelChunks"][0]) == 2  # 9 out of range

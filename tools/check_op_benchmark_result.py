@@ -23,7 +23,8 @@ def compare_units(base_results, new_results, threshold,
     construction so normalization is blind to a matmul-path collapse —
     gate its RAW time at this looser ratio (above the measured ~2.6x
     session swing of the shared chip)."""
-    normed = all("matmul_units" in r for r in base_results) and         all("matmul_units" in r for r in new_results)
+    normed = (all("matmul_units" in r for r in base_results)
+              and all("matmul_units" in r for r in new_results))
     key = "matmul_units" if normed else "mean_us"
     base = {r["op"]: r[key] for r in base_results}
     new = {r["op"]: r[key] for r in new_results}
@@ -79,10 +80,25 @@ def main():
         print("normalization mismatch: one file has matmul_units, the "
               "other does not — regenerate with the same op_bench mode")
         sys.exit(2)
+    def platform_of(dev):
+        # "TFRT_CPU_0" / "TpuDevice(...)" / "cuda:0" -> coarse platform
+        d = dev.lower()
+        for kind in ("tpu", "cpu", "cuda", "gpu"):
+            if kind in d:
+                return kind
+        return d
+
     if not base_norm and base_dev != new_dev:
         print(f"device mismatch: baseline {base_dev!r} vs new "
               f"{new_dev!r} — times are incommensurable; regenerate the "
               "baseline on the same platform")
+        sys.exit(2)
+    if base_norm and platform_of(base_dev) != platform_of(new_dev):
+        # matmul-normalized units survive one chip's clock swing, NOT a
+        # different architecture's op-cost ratios
+        print(f"platform mismatch: baseline {base_dev!r} vs new "
+              f"{new_dev!r} — normalized units do not transfer across "
+              "architectures; regenerate the baseline")
         sys.exit(2)
     if not new_res:
         print("no results in the new benchmark output — refusing to pass")
