@@ -88,61 +88,90 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     if return_mask:
-        return _max_pool2d_with_index(x, kernel_size, stride, padding,
-                                      ceil_mode, data_format)
+        return _max_pool_nd_with_index(x, kernel_size, stride, padding,
+                                       ceil_mode, 2,
+                                       data_format == "NHWC")
     return _pool_nd(x, kernel_size, stride, padding, 2, "max", ceil_mode,
                     data_format=data_format)
 
 
-def _max_pool2d_with_index(x, kernel_size, stride, padding, ceil_mode,
-                           data_format):
-    """reference `max_pool2d_with_index` (`operators/pool_with_index_op.*`):
-    also returns the argmax position of each window, flattened into the
-    input's H*W plane (what max_unpool2d consumes)."""
-    ks = _tup(kernel_size, 2)
-    st = _tup(stride or kernel_size, 2)
-    pd = _tup(padding, 2)
-    nhwc = data_format == "NHWC"
+def _max_pool_nd_with_index(x, kernel_size, stride, padding, ceil_mode,
+                            nd, channel_last):
+    """reference `max_pool{2,3}d_with_index`
+    (`operators/pool_with_index_op.*`): max pool + each window's argmax
+    position flattened into the input's spatial volume (what
+    max_unpool consumes).  One implementation parameterized over nd."""
+    ks = _tup(kernel_size, nd)
+    st = _tup(stride or kernel_size, nd)
+    pd = _tup(padding, nd)
 
     def f(a):
-        if nhwc:
-            a = a.transpose(0, 3, 1, 2)
-        n, c, h, w = a.shape
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+        n, c = a.shape[:2]
+        spatial = a.shape[2:]
 
-        def osize(i, k, p, s):
-            num = i + 2 * p - k
-            return (num + s - 1) // s + 1 if ceil_mode else num // s + 1
+        def osize(i_, k_, p_, s_):
+            num = i_ + 2 * p_ - k_
+            return (num + s_ - 1) // s_ + 1 if ceil_mode else \
+                num // s_ + 1
 
-        oh = osize(h, ks[0], pd[0], st[0])
-        ow = osize(w, ks[1], pd[1], st[1])
-        # ceil_mode may read past the padded edge: extend with -inf
-        extra_h = max((oh - 1) * st[0] + ks[0] - (h + 2 * pd[0]), 0)
-        extra_w = max((ow - 1) * st[1] + ks[1] - (w + 2 * pd[1]), 0)
-        neg = jnp.finfo(a.dtype).min
-        ap = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0] + extra_h),
-                         (pd[1], pd[1] + extra_w)), constant_values=neg)
-        hh = jnp.arange(oh)[:, None] * st[0] + jnp.arange(ks[0])[None, :]
-        ww = jnp.arange(ow)[:, None] * st[1] + jnp.arange(ks[1])[None, :]
-        # windows [N, C, OH, OW, KH, KW]
-        win = ap[:, :, hh[:, None, :, None], ww[None, :, None, :]]
-        flat = win.reshape(n, c, oh, ow, -1)
+        osz = [osize(i_, k_, p_, s_)
+               for i_, k_, p_, s_ in zip(spatial, ks, pd, st)]
+        # ceil_mode may read past the padded edge: extend with the
+        # dtype's minimum (ints use iinfo — round-4 fix)
+        extra = [max((o - 1) * s_ + k_ - (i_ + 2 * p_), 0)
+                 for o, s_, k_, i_, p_ in zip(osz, st, ks, spatial, pd)]
+        neg = (jnp.iinfo(a.dtype).min
+               if jnp.issubdtype(a.dtype, jnp.integer)
+               else jnp.finfo(a.dtype).min)
+        ap = jnp.pad(a, [(0, 0), (0, 0)] +
+                     [(p_, p_ + e) for p_, e in zip(pd, extra)],
+                     constant_values=neg)
+        # window index grids per spatial dim: [O_d, K_d]
+        grids = [jnp.arange(o)[:, None] * s_ + jnp.arange(k_)[None, :]
+                 for o, s_, k_ in zip(osz, st, ks)]
+        # gather windows -> [N, C, *O, *K] via an outer advanced index
+        idx = []
+        for d in range(nd):
+            shape = [1] * (2 * nd)
+            shape[d] = osz[d]
+            shape[nd + d] = ks[d]
+            idx.append(grids[d].reshape(shape))
+        win = ap[(slice(None), slice(None)) + tuple(idx)]
+        flat = win.reshape((n, c) + tuple(osz) + (-1,))
         arg = jnp.argmax(flat, axis=-1)
         out = jnp.take_along_axis(flat, arg[..., None], axis=-1)[..., 0]
-        kh = arg // ks[1]
-        kw = arg % ks[1]
-        gh = jnp.arange(oh)[None, None, :, None] * st[0] + kh - pd[0]
-        gw = jnp.arange(ow)[None, None, None, :] * st[1] + kw - pd[1]
-        idx = (gh * w + gw).astype(jnp.int64)
-        if nhwc:
-            out = out.transpose(0, 2, 3, 1)
-            idx = idx.transpose(0, 2, 3, 1)
-        return out, idx
+        # decode the window-flat argmax back to global spatial coords,
+        # then flatten into the input volume (row-major over spatial)
+        pos = arg
+        kcoord = []
+        for k_ in reversed(ks):
+            kcoord.append(pos % k_)
+            pos = pos // k_
+        kcoord = list(reversed(kcoord))
+        gidx = jnp.zeros_like(arg)
+        for d in range(nd):
+            oshape = [1] * arg.ndim
+            oshape[2 + d] = osz[d]
+            base = jnp.arange(osz[d]).reshape(oshape) * st[d]
+            coord = base + kcoord[d] - pd[d]
+            gidx = gidx * spatial[d] + coord
+        gidx = gidx.astype(jnp.int64)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+            gidx = jnp.moveaxis(gidx, 1, -1)
+        return out, gidx
 
     return dispatch(f, x)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_nd_with_index(x, kernel_size, stride, padding,
+                                       ceil_mode, 3,
+                                       data_format == "NDHWC")
     return _pool_nd(x, kernel_size, stride, padding, 3, "max", ceil_mode,
                     data_format=data_format)
 

@@ -159,13 +159,14 @@ def ctc_align(input, blank=0, merge_repeated=True, padding_value=0,
     """CTC alignment decode (`operators/ctc_align_op.*`): squeeze repeats +
     drop blanks per row; output padded with padding_value (static shape)."""
     arr = np.asarray(jax.device_get(unwrap(input)))
+    in_lens = (np.asarray(jax.device_get(unwrap(input_length)))
+               .reshape(-1) if input_length is not None else None)
     out = np.full_like(arr, padding_value)
     lens = np.zeros((arr.shape[0],), np.int64)
     for i, row in enumerate(arr):
         prev = None
         k = 0
-        n = (int(input_length.numpy()[i]) if input_length is not None
-             else len(row))
+        n = int(in_lens[i]) if in_lens is not None else len(row)
         for v in row[:n]:
             if merge_repeated and prev is not None and v == prev:
                 prev = v

@@ -141,6 +141,16 @@ def _store_outs(spec, op, scope, out):
             len(spec.outs) == 1 and spec.outs[0][1] != "*"):
         vals = list(out)
     else:
+        if isinstance(out, (tuple, list)):
+            if len(out) != 1:
+                # a silent tuple-into-one-slot store corrupts
+                # downstream ops (round-4 sweep caught two) — fail loud
+                raise ValueError(
+                    f"{op.type}: eager fn returned {len(out)} values "
+                    f"but the spec declares one output slot "
+                    f"{spec.outs[0][0]!r}; fix the spec's outs or "
+                    "index the adapter's return")
+            out = out[0]  # 1-tuple: store the value, not the tuple
         vals = [out]
     vi = 0
     for name, mode in spec.outs:
@@ -1333,7 +1343,7 @@ b("locality_aware_nms", lambda bb, sc, score_threshold=0.05,
         _vops().locality_aware_nms, bb, sc, score_threshold,
         int(nms_top_k), int(keep_top_k), nms_threshold=nms_threshold,
         normalized=normalized, nms_eta=nms_eta,
-        background_label=int(background_label)),
+        background_label=int(background_label))[0],
   ins="BBoxes Scores",
   attrs="score_threshold nms_top_k keep_top_k nms_threshold "
         "normalized nms_eta background_label")
@@ -1567,7 +1577,7 @@ b("sample_logits", lambda logits, labels, cs=None, cp=None,
   ins="Logits Labels ?CustomizedSamples ?CustomizedProbabilities",
   attrs="num_samples uniq remove_accidental_hits "
         "use_customized_samples seed",
-  outs="Samples ?Probabilities ?SampledLogits ?SampledLabels")
+  outs="SampledLogits SampledLabels ?Samples ?Probabilities")
 b("match_matrix_tensor", lambda x, y, w, dim_t=1: _via(
     _ops().match_matrix_tensor, x, y, w, dim_t=int(dim_t)),
   ins="X Y W", attrs="dim_t", outs="Out ?Tmp")
