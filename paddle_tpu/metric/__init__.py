@@ -277,6 +277,25 @@ class ChunkEvaluator(Metric):
         return ni, nl, nc
 
 
+def _voc_ap(rec, prec, ap_type):
+    """Average precision per the reference `detection_map_op.h:460-482`:
+    '11point' = mean of max-precision at 11 recall thresholds;
+    'integral' = the NATURAL integral sum(prec[i] * delta_rec[i]) — NOT
+    the VOC-interpolated variant (round-4 fix: both callers previously
+    right-maxed the curve, inflating integral AP)."""
+    if ap_type == "11point":
+        return float(np.mean(
+            [prec[rec >= t].max() if (rec >= t).any() else 0.0
+             for t in np.linspace(0, 1, 11)]))
+    ap = 0.0
+    prev = 0.0
+    for r, pv in zip(rec, prec):
+        if abs(r - prev) > 1e-6:
+            ap += float(pv) * abs(float(r) - prev)
+        prev = float(r)
+    return ap
+
+
 class DetectionMAP(Metric):
     """VOC-style detection mAP (reference `operators/metrics/` detection
     map machinery + `fluid/metrics.py DetectionMAP`): 11-point or
@@ -326,7 +345,8 @@ class DetectionMAP(Metric):
                 iou = inter / max(a1 + a2 - inter, 1e-10)
                 if iou > best:
                     best, best_j = iou, j
-            hit = best >= self.overlap_threshold and best_j >= 0
+            # strict >, matching detection_map_op.h:401
+            hit = best > self.overlap_threshold and best_j >= 0
             if hit:
                 taken[best_j] = True
             self._dets.append((c, float(ps[i]), hit))
@@ -342,19 +362,9 @@ class DetectionMAP(Metric):
             fp = np.cumsum([0.0 if r[2] else 1.0 for r in rows])
             rec = tp / total
             prec = tp / np.maximum(tp + fp, 1e-10)
-            if self.ap_version == "11point":
-                ap = np.mean([prec[rec >= t].max() if (rec >= t).any()
-                              else 0.0
-                              for t in np.linspace(0, 1, 11)])
-            else:  # integral
-                mrec = np.concatenate([[0.0], rec, [1.0]])
-                mpre = np.concatenate([[0.0], prec, [0.0]])
-                for i in range(len(mpre) - 2, -1, -1):
-                    mpre[i] = max(mpre[i], mpre[i + 1])
-                idx = np.where(mrec[1:] != mrec[:-1])[0]
-                ap = float(((mrec[idx + 1] - mrec[idx])
-                            * mpre[idx + 1]).sum())
-            aps.append(ap)
+            aps.append(_voc_ap(rec, prec, self.ap_version
+                               if self.ap_version == "11point"
+                               else "integral"))
         return float(np.mean(aps)) if aps else 0.0
 
     def name(self):
@@ -372,3 +382,114 @@ def mean_iou(pred, label, num_classes, name=None):
     if not hasattr(label, "numpy"):
         label = Tensor(np.asarray(label))
     return _mi(pred, label, num_classes)
+
+
+def detection_map_update(det, det_lens, gt, gt_lens, pos_count,
+                         true_pos, tp_count, false_pos, fp_count,
+                         class_num, overlap_threshold=0.5,
+                         ap_type="11point", evaluate_difficult=True):
+    """Numpy core of the `detection_map` op
+    (`operators/detection/detection_map_op.cc`), shared by the interp
+    translator and host evaluators.
+
+    det: [B, M, 6] padded (label, score, x1, y1, x2, y2) with per-image
+    det_lens [B]; gt: [B, G, 6] (label, [difficult,] x1, y1, x2, y2 —
+    6-wide rows carry `difficult` at col 1) with gt_lens [B].  States
+    are FIXED-CAPACITY dense stand-ins for the reference's growing LoD
+    tensors: pos_count [C], (true|false)_pos [C, CAP, 2] (score, flag)
+    with valid counts (tp|fp)_count [C].  Returns the accumulated
+    states + mAP.  Overflow beyond CAP raises (silent drops would skew
+    the metric).
+    """
+    det = np.asarray(det, np.float32)
+    gt = np.asarray(gt, np.float32)
+    C = int(class_num)
+    pos_count = np.array(pos_count, np.int64).reshape(C).copy()
+    cap = int(np.shape(true_pos)[1])
+    true_pos = np.array(true_pos, np.float32).reshape(C, cap, 2).copy()
+    false_pos = np.array(false_pos, np.float32).reshape(C, cap, 2).copy()
+    tp_count = np.array(tp_count, np.int64).reshape(C).copy()
+    fp_count = np.array(fp_count, np.int64).reshape(C).copy()
+
+    def iou(a, b):
+        ix1 = np.maximum(a[0], b[0])
+        iy1 = np.maximum(a[1], b[1])
+        ix2 = np.minimum(a[2], b[2])
+        iy2 = np.minimum(a[3], b[3])
+        iw = max(ix2 - ix1, 0.0)
+        ih = max(iy2 - iy1, 0.0)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def push(buf, cnt, c, score, flag):
+        if cnt[c] >= cap:
+            raise ValueError(
+                f"detection_map: class {c} exceeded the state capacity "
+                f"{cap}; raise the TruePos/FalsePos state size")
+        buf[c, cnt[c], 0] = score
+        buf[c, cnt[c], 1] = flag
+        cnt[c] += 1
+
+    wide = gt.shape[-1] >= 6  # rows carry a difficult flag at col 1
+    for b in range(det.shape[0]):
+        g = gt[b, : int(gt_lens[b])]
+        d = det[b, : int(det_lens[b])]
+        g_lab = g[:, 0].astype(np.int64)
+        g_diff = g[:, 1].astype(bool) if wide else \
+            np.zeros(len(g), bool)
+        g_box = g[:, 2:6] if wide else g[:, 1:5]
+        for c in range(C):
+            sel = (g_lab == c) & (evaluate_difficult | ~g_diff)
+            pos_count[c] += int(sel.sum())
+        matched = np.zeros(len(g), bool)
+        order = np.argsort(-d[:, 1], kind="stable")
+        for i in order:
+            c = int(d[i, 0])
+            if c < 0 or c >= C:
+                continue
+            score = float(d[i, 1])
+            box = d[i, 2:6]
+            best, best_j = 0.0, -1
+            for j in range(len(g)):
+                if g_lab[j] != c:
+                    continue
+                ov = iou(box, g_box[j])
+                if ov > best:
+                    best, best_j = ov, j
+            # strict >, matching detection_map_op.h:401
+            if best > overlap_threshold and best_j >= 0:
+                if not evaluate_difficult and g_diff[best_j]:
+                    continue  # difficult gt: ignore the detection
+                if not matched[best_j]:
+                    matched[best_j] = True
+                    push(true_pos, tp_count, c, score, 1.0)
+                else:
+                    push(false_pos, fp_count, c, score, 1.0)
+            else:
+                push(false_pos, fp_count, c, score, 1.0)
+
+    # AP over the ACCUMULATED state
+    aps = []
+    for c in range(C):
+        npos = int(pos_count[c])
+        if npos == 0:
+            continue
+        tps = true_pos[c, : tp_count[c]]
+        fps = false_pos[c, : fp_count[c]]
+        ent = np.concatenate(
+            [np.stack([tps[:, 0], np.ones(len(tps))], 1),
+             np.stack([fps[:, 0], np.zeros(len(fps))], 1)])
+        if len(ent) == 0:
+            aps.append(0.0)
+            continue
+        ent = ent[np.argsort(-ent[:, 0], kind="stable")]
+        tp_cum = np.cumsum(ent[:, 1])
+        fp_cum = np.cumsum(1 - ent[:, 1])
+        rec = tp_cum / npos
+        prec = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+        aps.append(_voc_ap(rec, prec, ap_type))
+    m_ap = float(np.mean(aps)) if aps else 0.0
+    return (pos_count, true_pos, tp_count, false_pos, fp_count,
+            np.asarray([m_ap], np.float32))

@@ -7,7 +7,9 @@ whole block is interpreted ONCE under a jax trace (each op translated to
 jnp / paddle_tpu functional calls), so the program compiles to a single
 XLA computation — no per-op dispatch at run time.
 
-Coverage (round 4): 401/487 reference op types — the hand-written
+Coverage (round 4): 403/487 reference op types (the CI floor in
+`tools/op_inventory.py --program-form-floor` is the authoritative
+number) — the hand-written
 translators here plus the declarative OpDesc→eager bridge
 (`op_bridge.py`, imported at the end of this module); the remainder are
 documented in `op_bridge.PROGRAM_FORM_NA`.  Unknown ops raise with the

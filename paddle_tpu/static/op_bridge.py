@@ -2564,10 +2564,6 @@ PROGRAM_FORM_NA = {
     # paddle-2.x `rnn` op (translated) is the serialized form our nn.LSTM
     # emits
     "cudnn_lstm": "interp `rnn` translator + nn.LSTM",
-    # host-side evaluation metric over variable-length detection
-    # outputs (LoD state tensors); metric.DetectionMAP computes it on
-    # fetched results (reference uses it the same way in evaluators)
-    "detection_map": "metric.DetectionMAP (host)",
     # host IO with data-dependent output shapes
     "read_file": "vision.read_file (host)",
     "decode_jpeg": "vision.decode_jpeg (host)",
@@ -2792,4 +2788,72 @@ def _chunk_eval_op(op, scope, feeds, fetches):
         scope[op.output("NumLabelChunks")] = n_lab.astype(jnp.int64)
     if op.output("NumCorrectChunks"):
         scope[op.output("NumCorrectChunks")] = n_cor.astype(jnp.int64)
+
+
+
+@braw("detection_map")
+def _detection_map_op(op, scope, feeds, fetches):
+    """operators/detection/detection_map_op.cc on the padded+lengths
+    representation: DetectRes/Label are [B, M, 6] with `@LOD` sidecars
+    (full length when absent); the reference's growing LoD state
+    tensors become fixed-capacity dense buffers (PosCount [C],
+    TruePos/FalsePos [C, CAP, 2] + valid-count vectors) accumulated
+    across calls.  Matching + AP run on host via pure_callback (the
+    reference kernel is CPU-side too)."""
+    from .interp import _seq_lengths_or_full
+    from paddle_tpu.metric import detection_map_update
+
+    dname, lname = op.input("DetectRes"), op.input("Label")
+    det = scope.fetch(dname)
+    gt = scope.fetch(lname)
+    det_lens = _seq_lengths_or_full(scope, dname, det)
+    gt_lens = _seq_lengths_or_full(scope, lname, gt)
+    C = int(op.attr("class_num", 1))
+    cap = int(op.attr("state_capacity", 1024))
+
+    def state(name, shape, dtype):
+        vname = op.input(name)
+        if vname and vname in scope:
+            return jnp.asarray(scope[vname], dtype).reshape(shape)
+        return jnp.zeros(shape, dtype)
+
+    pos_count = state("PosCount", (C,), jnp.int32)
+    true_pos = state("TruePos", (C, cap, 2), jnp.float32)
+    tp_count = state("TruePosCount", (C,), jnp.int32)
+    false_pos = state("FalsePos", (C, cap, 2), jnp.float32)
+    fp_count = state("FalsePosCount", (C,), jnp.int32)
+    overlap = op.attr("overlap_threshold", 0.5)
+    ap_type = op.attr("ap_type", "11point")
+    eval_diff = op.attr("evaluate_difficult", True)
+
+    def host(d_, dl_, g_, gl_, pc_, tp_, tc_, fp_, fc_):
+        out = detection_map_update(
+            d_, dl_, g_, gl_, pc_, tp_, tc_, fp_, fc_, C,
+            overlap_threshold=overlap, ap_type=ap_type,
+            evaluate_difficult=eval_diff)
+        pc, tpb, tcn, fpb, fcn, m = out
+        return (pc.astype(np.int32), tpb.astype(np.float32),
+                tcn.astype(np.int32), fpb.astype(np.float32),
+                fcn.astype(np.int32), m.astype(np.float32))
+
+    shapes = (jax.ShapeDtypeStruct((C,), jnp.int32),
+              jax.ShapeDtypeStruct((C, cap, 2), jnp.float32),
+              jax.ShapeDtypeStruct((C,), jnp.int32),
+              jax.ShapeDtypeStruct((C, cap, 2), jnp.float32),
+              jax.ShapeDtypeStruct((C,), jnp.int32),
+              jax.ShapeDtypeStruct((1,), jnp.float32))
+    pc, tpb, tcn, fpb, fcn, m_ap = jax.pure_callback(
+        host, shapes, det, det_lens, gt, gt_lens, pos_count, true_pos,
+        tp_count, false_pos, fp_count)
+    scope[op.output("MAP")] = m_ap
+    if op.output("AccumPosCount"):
+        scope[op.output("AccumPosCount")] = pc
+    if op.output("AccumTruePos"):
+        scope[op.output("AccumTruePos")] = tpb
+        if op.output("AccumTruePosCount"):
+            scope[op.output("AccumTruePosCount")] = tcn
+    if op.output("AccumFalsePos"):
+        scope[op.output("AccumFalsePos")] = fpb
+        if op.output("AccumFalsePosCount"):
+            scope[op.output("AccumFalsePosCount")] = fcn
 

@@ -121,6 +121,23 @@ class TestBreakContinue:
         assert_rewritten(f)
 
 
+class TestReturnInForRange:
+    def test_return_inside_tensor_range_loop(self):
+        @jit.to_static
+        def f(x, n):
+            acc = x * 0
+            for i in range(n):
+                acc = acc + 1
+                if acc.sum() >= 2:
+                    return acc * 10  # early exit from a tensor loop
+            return acc
+
+        out = f(paddle.to_tensor(np.zeros(1, np.float32)),
+                paddle.to_tensor(np.int32(8)))
+        np.testing.assert_allclose(out.numpy(), [20.0])
+        assert_rewritten(f)
+
+
 class TestModelScale:
     """Eager vs to_static equivalence on model-sized programs with
     tensor-dependent control flow — the reference's de-facto

@@ -698,3 +698,77 @@ class TestChunkEval:
                                "NumInferChunks", "NumLabelChunks",
                                "NumCorrectChunks"))
         assert int(got["NumLabelChunks"][0]) == 2  # 9 out of range
+
+
+class TestDetectionMap:
+    """detection_map translator (detection/detection_map_op.cc) on the
+    padded+lengths representation with fixed-capacity states."""
+
+    def _run(self, det, gt, states=None, attrs=None):
+        from test_op_bridge import bridge_run_lod
+
+        ins = {"DetectRes": det, "Label": gt}
+        if states:
+            ins.update(states)
+        return bridge_run_lod(
+            "detection_map", ins, {},
+            {"class_num": 2, "overlap_threshold": 0.5,
+             "ap_type": "11point", "state_capacity": 8,
+             **(attrs or {})},
+            outs=("MAP", "AccumPosCount", "AccumTruePos",
+                  "AccumTruePosCount", "AccumFalsePos",
+                  "AccumFalsePosCount"))
+
+    def test_perfect_detections_map_1(self):
+        # one image, two gt (class 0 and 1), two exact detections
+        gt = np.array([[[0, 0, 0, 0, 2, 2],
+                        [1, 0, 4, 4, 6, 6]]], np.float32)
+        det = np.array([[[0, 0.9, 0, 0, 2, 2],
+                         [1, 0.8, 4, 4, 6, 6]]], np.float32)
+        got = self._run(det, gt)
+        np.testing.assert_allclose(got["MAP"], [1.0], rtol=1e-5)
+        np.testing.assert_array_equal(got["AccumPosCount"], [1, 1])
+        np.testing.assert_array_equal(got["AccumTruePosCount"], [1, 1])
+
+    def test_false_positive_halves_class_ap(self):
+        gt = np.array([[[0, 0, 0, 0, 2, 2]]], np.float32)
+        det = np.array([[[0, 0.9, 10, 10, 12, 12],   # miss (fp)
+                         [0, 0.8, 0, 0, 2, 2]]], np.float32)  # hit
+        got = self._run(det, gt)
+        # 11-point AP with prec curve [0, .5]: recall>=t all hit p=0.5
+        np.testing.assert_allclose(got["MAP"], [0.5], atol=0.06)
+        np.testing.assert_array_equal(got["AccumFalsePosCount"][0], 1)
+
+
+    def test_integral_ap_is_natural_not_interpolated(self):
+        """Reference detection_map_op.h:472-481: integral AP is the raw
+        sum(prec * delta_recall), NOT the VOC right-maxed variant.
+        fp(.9), tp(.8), tp(.7) over 2 gt: rec=[0,.5,1], prec=[0,.5,.667]
+        -> natural AP = .5*.5 + .667*.5 = .583 (interpolated would give
+        .667)."""
+        gt = np.array([[[0, 0, 0, 0, 2, 2],
+                        [0, 0, 4, 4, 6, 6]]], np.float32)
+        det = np.array([[[0, 0.9, 10, 10, 12, 12],
+                         [0, 0.8, 0, 0, 2, 2],
+                         [0, 0.7, 4, 4, 6, 6]]], np.float32)
+        got = self._run(det, gt, attrs={"ap_type": "integral",
+                                        "class_num": 1})
+        np.testing.assert_allclose(got["MAP"], [0.5 * 0.5 + (2 / 3) * 0.5],
+                                   rtol=1e-3)
+
+    def test_state_accumulates_across_calls(self):
+        gt = np.array([[[0, 0, 0, 0, 2, 2]]], np.float32)
+        hit = np.array([[[0, 0.9, 0, 0, 2, 2]]], np.float32)
+        miss = np.array([[[0, 0.8, 10, 10, 12, 12]]], np.float32)
+        first = self._run(hit, gt)
+        states = {"PosCount": first["AccumPosCount"],
+                  "TruePos": first["AccumTruePos"],
+                  "TruePosCount": first["AccumTruePosCount"],
+                  "FalsePos": first["AccumFalsePos"],
+                  "FalsePosCount": first["AccumFalsePosCount"]}
+        second = self._run(miss, gt, states=states)
+        # 2 gt total, 1 tp + 1 fp accumulated
+        np.testing.assert_array_equal(second["AccumPosCount"], [2, 0])
+        np.testing.assert_array_equal(second["AccumTruePosCount"][0], 1)
+        np.testing.assert_array_equal(second["AccumFalsePosCount"][0], 1)
+        assert 0.0 < float(second["MAP"][0]) < 1.0
