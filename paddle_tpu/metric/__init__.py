@@ -362,9 +362,7 @@ class DetectionMAP(Metric):
             fp = np.cumsum([0.0 if r[2] else 1.0 for r in rows])
             rec = tp / total
             prec = tp / np.maximum(tp + fp, 1e-10)
-            aps.append(_voc_ap(rec, prec, self.ap_version
-                               if self.ap_version == "11point"
-                               else "integral"))
+            aps.append(_voc_ap(rec, prec, self.ap_version))
         return float(np.mean(aps)) if aps else 0.0
 
     def name(self):

@@ -2551,11 +2551,8 @@ PROGRAM_FORM_NA = {
     "push_box_extended_sparse":
         "distributed.ps.PSClient.push_sparse_grad",
     "push_dense": "distributed.ps.PSClient.push_dense_grad",
-    # host-python callbacks: the reference deserializes a pickled python
-    # callable registry index (py_func_op.cc) — a cross-process python
-    # registry is not part of the interchange format we honor; the
-    # capability is jax.pure_callback / autograd.PyLayer in eager
-    "py_func": "jax.pure_callback (eager)",
+    # host-python callback backed by a class registry the interchange
+    # format cannot carry (reference py_layer is eager-only anyway)
     "py_layer": "autograd.PyLayer (eager)",
     # a program-in-program trampoline for dy2static; jit.StaticFunction
     # IS that mechanism here (run_program_op.cc)
@@ -2564,9 +2561,6 @@ PROGRAM_FORM_NA = {
     # paddle-2.x `rnn` op (translated) is the serialized form our nn.LSTM
     # emits
     "cudnn_lstm": "interp `rnn` translator + nn.LSTM",
-    # host IO with data-dependent output shapes
-    "read_file": "vision.read_file (host)",
-    "decode_jpeg": "vision.decode_jpeg (host)",
 }
 
 
@@ -2857,3 +2851,80 @@ def _detection_map_op(op, scope, feeds, fetches):
         if op.output("AccumFalsePosCount"):
             scope[op.output("AccumFalsePosCount")] = fcn
 
+
+
+# ---------------------------------------------------------------------------
+# host IO ops (operators/read_file_op.cc, decode_jpeg_op.cc) — concrete
+# file IO with data-dependent output shapes: real translators on the
+# op-by-op path (DYNAMIC set), exactly how the reference executes them
+# (CPU-side, imperative op loop)
+# ---------------------------------------------------------------------------
+@braw("read_file")
+def _read_file_op(op, scope, feeds, fetches):
+    from paddle_tpu.vision.transforms import read_file
+
+    scope[op.output("Out")] = _unwrap(
+        read_file(op.attr("filename", "")))
+
+
+@braw("decode_jpeg")
+def _decode_jpeg_op(op, scope, feeds, fetches):
+    from paddle_tpu.vision.transforms import decode_jpeg
+
+    scope[op.output("Out")] = _unwrap(decode_jpeg(
+        scope.fetch(op.input("X")), mode=op.attr("mode", "unchanged")))
+
+
+# ---------------------------------------------------------------------------
+# py_func (operators/py_func_op.cc): the reference stores a PROCESS-LOCAL
+# registry index in `forward_callable_id` — in-process programs (built
+# with this API in the same interpreter) run their callable through a
+# host callback; a program deserialized in another process raises with
+# the reason, same as the reference (its registry is process-local too).
+# ---------------------------------------------------------------------------
+PY_FUNC_REGISTRY: list = []
+
+
+def register_py_func(fn) -> int:
+    """Register a python callable for `py_func` ops; returns the id the
+    op's `forward_callable_id` attr must carry (reference
+    `layers/nn.py py_func` registration contract)."""
+    PY_FUNC_REGISTRY.append(fn)
+    return len(PY_FUNC_REGISTRY) - 1
+
+
+@braw("py_func")
+def _py_func_op(op, scope, feeds, fetches):
+    cid = op.attr("forward_callable_id", -1)
+    if not 0 <= cid < len(PY_FUNC_REGISTRY):
+        raise NotImplementedError(
+            f"py_func: forward_callable_id={cid} is not registered in "
+            "this process (the registry is process-local, as in the "
+            "reference py_func_op.cc); rebuild the program with "
+            "op_bridge.register_py_func in this interpreter")
+    fn = PY_FUNC_REGISTRY[cid]
+    ins = [scope.fetch(n) for n in op.inputs("X")]
+    outs = op.outputs("Out")
+    if any(isinstance(v, jax.core.Tracer) for v in ins):
+        # py_func is in DYNAMIC_SHAPE_OPS so the runner de-jits the
+        # program; a traced value here means someone bypassed that path
+        raise NotImplementedError(
+            "py_func requires concrete inputs (op-by-op execution); "
+            "it cannot run under an XLA trace")
+    # inputs are concrete: run the callable ONCE (a pure_callback would
+    # need a shape probe, executing stateful callables twice per step)
+    res = fn(*[np.asarray(jax.device_get(v)) for v in ins])
+    res = res if isinstance(res, (tuple, list)) else (res,)
+    for name, v in zip(outs, res):
+        scope[name] = jnp.asarray(np.asarray(v))
+
+
+for _n in ("read_file", "decode_jpeg", "py_func"):
+    from .interp import DYNAMIC_SHAPE_OPS as _DSO2
+
+    _DSO2.add(_n)
+
+
+# paddle-2.x scalar ops the jaxpr exporter can emit
+b("log1p", lambda x: jnp.log1p(x))
+b("isfinite isfinite_v2", lambda x: jnp.isfinite(x))

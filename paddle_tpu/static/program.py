@@ -548,14 +548,12 @@ def program_from_layer(layer, input_spec, scope: Optional[Dict] = None
         out_name = emit(layer, in_name)
     else:
         # chaining the children is only faithful when forward() IS that
-        # chain; a custom forward (functional ops, branching) would get
-        # silently mis-captured — refuse instead (round-4 fix)
+        # chain; a custom forward (functional ops, branching) is
+        # captured by TRACING instead (round 4: jaxpr -> ProgramDesc,
+        # static/jaxpr_export.py) — any jax-traceable model exports
         if type(layer).forward is not nn.Layer.forward:
-            raise NotImplementedError(
-                f"program_from_layer: {type(layer).__name__} defines a "
-                "custom forward(); its children cannot be assumed to "
-                "chain sequentially. Compose the model from nn layers "
-                "(e.g. nn.Sequential) or use paddle_tpu.jit.save")
+            return _program_from_layer_traced(layer, spec, scope,
+                                              in_name)
         children = [ly for _, ly in layer.named_children()]
         if not children:
             raise NotImplementedError("layer has no convertible structure")
@@ -563,4 +561,39 @@ def program_from_layer(layer, input_spec, scope: Optional[Dict] = None
         for ly in children:
             out_name = emit(ly, out_name)
     block.append_op("fetch", {"X": out_name}, {"Out": "fetch"}, {"col": 0})
+    return prog
+
+
+def _program_from_layer_traced(layer, spec, scope, in_name):
+    """Trace-based capture for custom-forward layers (round 4): the
+    jaxpr of `layer.forward` maps onto reference ops; parameters ride
+    as jaxpr consts -> persistable vars."""
+    import numpy as np
+
+    from ..core.tensor import Tensor, unwrap
+    from .jaxpr_export import program_from_traced
+
+    if any(s in (-1, None) for s in spec.shape):
+        raise NotImplementedError(
+            "program_from_layer: traced export specializes to the "
+            "EXACT input shape — a dynamic dim (None/-1) in "
+            f"InputSpec{list(spec.shape)} would be silently baked to a "
+            "concrete size. Export with concrete shapes (one program "
+            "per shape), or compose the model from nn layers for the "
+            "shape-polymorphic sequential path")
+    shape = [int(s) for s in spec.shape]
+    example = np.zeros(shape, spec.dtype or "float32")
+
+    was_training = layer.training
+    layer.eval()  # inference export: dropout off, BN in eval form
+    try:
+        def fn(x):
+            out = layer(Tensor(x))
+            return unwrap(out)
+
+        prog = program_from_traced(fn, [example], scope,
+                                   input_names=[in_name])
+    finally:
+        if was_training:
+            layer.train()
     return prog

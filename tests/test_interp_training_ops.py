@@ -20,7 +20,7 @@ from paddle_tpu import static
 from paddle_tpu.static.interp import OP_TRANSLATORS, Scope, \
     blocks_context, run_block
 from paddle_tpu.static.op_bridge import collective_axes
-from test_op_bridge import bridge_run, check, r, _encode_attr
+from test_op_bridge import bridge_run, bridge_run_lod, check, r, _encode_attr
 
 
 class TestOptimizerOps:
@@ -772,3 +772,42 @@ class TestDetectionMap:
         np.testing.assert_array_equal(second["AccumTruePosCount"][0], 1)
         np.testing.assert_array_equal(second["AccumFalsePosCount"][0], 1)
         assert 0.0 < float(second["MAP"][0]) < 1.0
+
+
+class TestHostOps:
+    """read_file/decode_jpeg/py_func translators (host-side ops the
+    reference executes in the imperative op loop)."""
+
+    def test_read_file_decode_jpeg(self, tmp_path):
+        from PIL import Image
+
+        # smooth gradient (random noise is pathological for JPEG)
+        gy, gx = np.mgrid[0:8, 0:6]
+        img = np.stack([gy * 30, gx * 40, gy * 10 + gx * 10],
+                       -1).astype(np.uint8)
+        path = str(tmp_path / "x.jpg")
+        Image.fromarray(img).save(path, quality=95)
+        got = bridge_run("read_file", None, {"filename": path})
+        assert got["Out"].dtype == np.uint8 and got["Out"].ndim == 1
+        dec = bridge_run("decode_jpeg", {"X": got["Out"]},
+                         {"mode": "rgb"})
+        assert dec["Out"].shape == (3, 8, 6)
+        # lossy codec: channels should still correlate strongly
+        assert np.abs(dec["Out"].transpose(1, 2, 0).astype(int)
+                      - img.astype(int)).mean() < 16
+
+    def test_py_func_registry(self):
+        from paddle_tpu.static.op_bridge import register_py_func
+
+        cid = register_py_func(lambda a, b: (a + b, a * b))
+        x, y = r(3), r(3, seed=1)
+        got = bridge_run_lod("py_func", {"X": [x, y]}, {},
+                             {"forward_callable_id": cid},
+                             outs=("Out*2",))
+        np.testing.assert_allclose(got["Out"][0], x + y, rtol=1e-6)
+        np.testing.assert_allclose(got["Out"][1], x * y, rtol=1e-6)
+
+    def test_py_func_unregistered_raises(self):
+        with pytest.raises(NotImplementedError, match="process-local"):
+            bridge_run("py_func", {"X": r(2)},
+                       {"forward_callable_id": 12345})

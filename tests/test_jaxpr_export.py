@@ -1,0 +1,205 @@
+"""jaxpr -> ProgramDesc export (static/jaxpr_export.py): ANY traceable
+model serializes to the reference wire format and round-trips with
+value parity — the capability of the reference's ProgramTranslator
+capture (`dygraph/jit.py`) without its 15-transformer source rewrite.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+def _roundtrip(net, spec, feed_val, tmp_path, rtol=1e-4, atol=1e-5):
+    """save_inference_model(layer=...) -> parse -> Executor -> compare
+    against the eager output (the full interchange loop)."""
+    net.eval()
+    want = np.asarray(net(paddle.to_tensor(feed_val)).numpy())
+    prefix = str(tmp_path / "m")
+    static.save_inference_model(prefix, layer=net, input_spec=[spec])
+    prog, feeds, fetches = static.load_inference_model(prefix)
+    exe = static.Executor()
+    exe.scope.update(getattr(prog, "_param_scope", {}))
+    got = exe.run(prog, feed={feeds[0]: feed_val},
+                  fetch_list=fetches)[0]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=rtol,
+                               atol=atol)
+    return prog
+
+
+class TestTracedExport:
+    def test_custom_forward_with_mean_and_embedding(self, tmp_path):
+        paddle.seed(0)
+
+        class TokenModel(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(16, 8)
+                self.fc = nn.Linear(8, 4)
+
+            def forward(self, ids):
+                h = self.emb(ids)
+                h = paddle.mean(h, axis=1)  # not layer-chainable
+                return self.fc(h)
+
+        ids = (np.arange(15) % 7).reshape(3, 5).astype(np.int64)
+        prog = _roundtrip(TokenModel(),
+                          static.InputSpec([3, 5], "int64"), ids,
+                          tmp_path)
+        types = {o["type"] for o in prog.desc["blocks"][0]["ops"]}
+        assert "lookup_table_v2" in types and "matmul_v2" in types
+
+    def test_residual_mlp_with_gelu(self, tmp_path):
+        paddle.seed(1)
+
+        class ResMLP(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(6, 6)
+                self.b = nn.Linear(6, 6)
+
+            def forward(self, x):
+                h = nn.functional.gelu(self.a(x))
+                h = x + self.b(h)          # residual
+                return h * paddle.rsqrt(
+                    paddle.mean(h * h, axis=-1, keepdim=True) + 1e-5)
+
+        x = np.random.RandomState(0).rand(4, 6).astype(np.float32)
+        _roundtrip(ResMLP(), static.InputSpec([4, 6], "float32"), x,
+                   tmp_path)
+
+    def test_cnn_with_pooling(self, tmp_path):
+        paddle.seed(2)
+
+        class SmallCNN(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(1, 4, 3, padding=1)
+                self.fc = nn.Linear(4 * 4 * 4, 5)
+
+            def forward(self, x):
+                h = nn.functional.relu(self.conv(x))
+                h = nn.functional.max_pool2d(h, 2, 2)
+                h = paddle.reshape(h, [x.shape[0], -1])
+                return self.fc(h)
+
+        x = np.random.RandomState(1).rand(2, 1, 8, 8).astype(np.float32)
+        prog = _roundtrip(SmallCNN(),
+                          static.InputSpec([2, 1, 8, 8], "float32"), x,
+                          tmp_path)
+        types = {o["type"] for o in prog.desc["blocks"][0]["ops"]}
+        assert "conv2d" in types and "pool2d" in types
+
+    def test_attention_block(self, tmp_path):
+        paddle.seed(3)
+        d, heads = 16, 2
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.attn = nn.MultiHeadAttention(d, heads)
+                self.ln = nn.LayerNorm(d)
+
+            def forward(self, x):
+                return self.ln(x + self.attn(x, x, x))
+
+        x = np.random.RandomState(2).rand(2, 6, d).astype(np.float32)
+        _roundtrip(Block(), static.InputSpec([2, 6, d], "float32"), x,
+                   tmp_path, rtol=2e-4, atol=2e-5)
+
+    def test_predictor_serves_traced_export(self, tmp_path):
+        """The exported program serves through the inference Predictor
+        (the surface a reference user deploys with)."""
+        paddle.seed(4)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(5, 3)
+
+            def forward(self, x):
+                h = self.fc(x)
+                return h / (paddle.sum(paddle.abs(h), axis=-1,
+                                       keepdim=True) + 1e-6)
+
+        net = M()
+        net.eval()
+        x = np.random.RandomState(3).rand(2, 5).astype(np.float32)
+        want = np.asarray(net(paddle.to_tensor(x)).numpy())
+        prefix = str(tmp_path / "m")
+        static.save_inference_model(
+            prefix, layer=net, input_spec=[static.InputSpec([2, 5],
+                                                            "float32")])
+        from paddle_tpu.inference import Config, create_predictor
+
+        pred = create_predictor(Config(prefix + ".pdmodel",
+                                       prefix + ".pdiparams"))
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(x)
+        pred.run()
+        got = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_unmapped_primitive_raises_with_name(self):
+        class Weird(nn.Layer):
+            def forward(self, x):
+                return paddle.cumsum(x, axis=0)
+
+        with pytest.raises(NotImplementedError, match="cumsum"):
+            static.save_inference_model(
+                "/tmp/nope", layer=Weird(),
+                input_spec=[static.InputSpec([3], "float32")])
+
+    def test_sequential_path_still_preferred(self, tmp_path):
+        """Sequential models keep the canonical layer-op emitters (fc as
+        matmul+add, named params) — tracing is only the fallback."""
+        paddle.seed(5)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = np.random.RandomState(4).rand(3, 4).astype(np.float32)
+        prog = _roundtrip(net, static.InputSpec([3, 4], "float32"), x,
+                          tmp_path)
+        types = [o["type"] for o in prog.desc["blocks"][0]["ops"]]
+        assert "relu" in types  # the emitter's named op, not jnp max
+
+
+class TestExportRefusals:
+    """Round-4 review: exports that cannot be faithful refuse loudly."""
+
+    def test_dynamic_dim_refused(self):
+        class M(nn.Layer):
+            def forward(self, x):
+                return x + paddle.mean(x)
+
+        with pytest.raises(NotImplementedError, match="dynamic dim"):
+            static.save_inference_model(
+                "/tmp/nope2", layer=M(),
+                input_spec=[static.InputSpec([None, 4], "float32")])
+
+    def test_int_bitwise_refused(self):
+        class M(nn.Layer):
+            def forward(self, x):
+                import paddle_tpu as P
+
+                return P.bitwise_and(x, x) if hasattr(P, "bitwise_and") \
+                    else x & x
+
+        with pytest.raises(NotImplementedError,
+                           match="bitwise|cumsum|'and'"):
+            static.save_inference_model(
+                "/tmp/nope3", layer=M(),
+                input_spec=[static.InputSpec([3], "int32")])
+
+    def test_cbrt_negative_parity(self, tmp_path):
+        class M(nn.Layer):
+            def forward(self, x):
+                from paddle_tpu.core.tensor import Tensor, unwrap
+                import jax.numpy as jnp
+
+                return Tensor(jnp.cbrt(unwrap(x)))
+
+        x = np.array([-8.0, 27.0], np.float32)
+        _roundtrip(M(), static.InputSpec([2], "float32"), x, tmp_path)
