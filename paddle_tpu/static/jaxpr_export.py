@@ -34,6 +34,7 @@ class _Emitter:
         self.block = block
         self.scope = scope
         self.names: Dict[int, str] = {}  # id(var) -> program var name
+        self.known: Dict[int, np.ndarray] = {}  # id(var) -> const value
         self.counter = 0
 
     # -- naming -------------------------------------------------------------
@@ -44,6 +45,11 @@ class _Emitter:
     def var_of(self, v) -> str:
         key = id(v)
         if key not in self.names:
+            if key in self.known:
+                # constant-folded value used as a real input here:
+                # materialize it once
+                self.names[key] = self.emit_constant(self.known[key])
+                return self.names[key]
             raise KeyError(f"unbound jaxpr var {v}")
         return self.names[key]
 
@@ -58,33 +64,53 @@ class _Emitter:
         self.block.append_op(optype, ins, outs, attrs)
 
     # -- values -------------------------------------------------------------
+    def emit_constant(self, val: np.ndarray, tag="lit") -> str:
+        """Emit a constant as fill_constant/assign_value; the ONE
+        dtype->attr-key mapping (shared by literals and iota)."""
+        val = np.asarray(val)
+        name = self.fresh(tag)
+        self.declare(name, jax.ShapeDtypeStruct(val.shape, val.dtype))
+        if val.ndim == 0:
+            self.emit("fill_constant", {}, {"Out": name},
+                      {"shape": [1],
+                       "dtype": proto.np_dtype_to_vartype(val.dtype),
+                       "value": float(val)})
+        else:
+            key = {"float32": "fp32_values", "int32": "int32_values",
+                   "int64": "int64_values",
+                   "bool": "bool_values"}.get(str(val.dtype))
+            if key is None:
+                raise NotImplementedError(
+                    f"jaxpr export: constant dtype {val.dtype} has no "
+                    "assign_value attr key")
+            self.emit("assign_value", {}, {"Out": name},
+                      {"shape": list(val.shape),
+                       "dtype": proto.np_dtype_to_vartype(val.dtype),
+                       key: val.reshape(-1).tolist()})
+        return name
+
     def literal_or_var(self, a):
-        """Return the program var name holding atom `a` (emit an
-        assign_value/fill_constant for literals)."""
+        """Return the program var name holding atom `a` (emit a
+        constant for literals)."""
         from jax.extend.core import Literal
 
         if isinstance(a, Literal):
-            val = np.asarray(a.val)
-            name = self.fresh("lit")
-            self.declare(name, jax.ShapeDtypeStruct(val.shape, val.dtype))
-            if val.ndim == 0:
-                self.emit("fill_constant", {}, {"Out": name},
-                          {"shape": [1] if val.ndim == 0 else
-                           list(val.shape),
-                           "dtype": proto.np_dtype_to_vartype(val.dtype),
-                           "value": float(val)})
-            else:
-                key = {"float32": "fp32_values",
-                       "int32": "int32_values",
-                       "int64": "int64_values",
-                       "bool": "bool_values"}.get(str(val.dtype),
-                                                  "fp32_values")
-                self.emit("assign_value", {}, {"Out": name},
-                          {"shape": list(val.shape),
-                           "dtype": proto.np_dtype_to_vartype(val.dtype),
-                           key: np.asarray(val).reshape(-1).tolist()})
-            return name
+            return self.emit_constant(np.asarray(a.val))
         return self.var_of(a)
+
+    def const_value(self, a):
+        """Concrete value of atom `a` when statically known (a Literal,
+        or a var bound to a captured const/param in scope); else None."""
+        from jax.extend.core import Literal
+
+        if isinstance(a, Literal):
+            return np.asarray(a.val)
+        if id(a) in self.known:
+            return self.known[id(a)]
+        name = self.names.get(id(a))
+        if name is not None and name in self.scope:
+            return np.asarray(self.scope[name])
+        return None
 
 
 def _elementwise(em, eqn, optype):
@@ -360,6 +386,133 @@ def _cbrt(em, eqn):
     em.bind(eqn.outvars[0], out)
 
 
+def _atan2(em, eqn):
+    # the atan2 op's input slots are X1/X2 (atan2_op.cc), not X/Y
+    out = em.fresh("atan2")
+    em.declare(out, eqn.outvars[0].aval)
+    em.emit("atan2", {"X1": em.literal_or_var(eqn.invars[0]),
+                      "X2": em.literal_or_var(eqn.invars[1])},
+            {"Out": out}, {})
+    em.bind(eqn.outvars[0], out)
+
+
+def _cumsum(em, eqn):
+    if eqn.params.get("reverse"):
+        raise NotImplementedError("jaxpr export: reverse cumsum")
+    _unary(em, eqn, "cumsum",
+           {"axis": int(eqn.params["axis"]), "flatten": False,
+            "exclusive": False, "reverse": False})
+
+
+def _argminmax(em, eqn, optype):
+    axes = eqn.params["axes"]
+    if len(axes) != 1:
+        raise NotImplementedError(
+            f"jaxpr export: {optype} over multiple axes")
+    out = em.fresh(optype)
+    em.declare(out, eqn.outvars[0].aval)
+    em.emit(optype, {"X": em.literal_or_var(eqn.invars[0])},
+            {"Out": out},
+            {"axis": int(axes[0]), "keepdims": False, "flatten": False,
+             "dtype": proto.np_dtype_to_vartype(
+                 np.dtype(eqn.params["index_dtype"]))})
+    em.bind(eqn.outvars[0], out)
+
+
+def _clamp(em, eqn):
+    lo_atom, x, hi_atom = eqn.invars
+    lo, hi = em.const_value(lo_atom), em.const_value(hi_atom)
+    if lo is None or hi is None:
+        raise NotImplementedError(
+            "jaxpr export: clamp with runtime tensor bounds (clip "
+            "takes scalar attrs)")
+    out = em.fresh("clip")
+    em.declare(out, eqn.outvars[0].aval)
+    em.emit("clip", {"X": em.literal_or_var(x)}, {"Out": out},
+            {"min": float(lo), "max": float(hi)})
+    em.bind(eqn.outvars[0], out)
+
+
+def _iota(em, eqn):
+    # static shape: materialize as a constant (range/eye/linspace all
+    # reduce to this for a serialized inference program)
+    aval = eqn.outvars[0].aval
+    dim = int(eqn.params["dimension"])
+    arr = np.asarray(np.broadcast_to(
+        np.arange(aval.shape[dim],
+                  dtype=np.dtype(aval.dtype)).reshape(
+            [-1 if i == dim else 1 for i in range(aval.ndim)]),
+        aval.shape))
+    em.bind(eqn.outvars[0], em.emit_constant(arr, tag="iota"))
+
+
+def _pad(em, eqn):
+    cfg = eqn.params["padding_config"]
+    if any(int(i) != 0 for _, _, i in cfg):
+        raise NotImplementedError("jaxpr export: interior (dilating) pad")
+    if any(int(lo) < 0 or int(hi) < 0 for lo, hi, _ in cfg):
+        raise NotImplementedError("jaxpr export: negative pad")
+    pval = em.const_value(eqn.invars[1])
+    if pval is None:
+        raise NotImplementedError(
+            "jaxpr export: pad value is a runtime tensor (the pad op "
+            "takes a scalar attr)")
+    out = em.fresh("pad")
+    em.declare(out, eqn.outvars[0].aval)
+    paddings = []
+    for lo, hi, _ in cfg:
+        paddings += [int(lo), int(hi)]
+    em.emit("pad", {"X": em.literal_or_var(eqn.invars[0])},
+            {"Out": out},
+            {"paddings": paddings, "pad_value": float(pval)})
+    em.bind(eqn.outvars[0], out)
+
+
+def _top_k(em, eqn):
+    out_v = em.fresh("topk_v")
+    out_i = em.fresh("topk_i")
+    em.declare(out_v, eqn.outvars[0].aval)
+    em.declare(out_i, eqn.outvars[1].aval)
+    em.emit("top_k_v2", {"X": em.literal_or_var(eqn.invars[0])},
+            {"Out": out_v, "Indices": out_i},
+            {"k": int(eqn.params["k"]), "axis": -1, "largest": True,
+             "sorted": True})
+    em.bind(eqn.outvars[0], out_v)
+    em.bind(eqn.outvars[1], out_i)
+
+
+def _reduce_window_sum(em, eqn):
+    """sum-pool window -> pool2d avg un-divided (scale by the window
+    size); the avg-pool pattern (reduce_window_sum + div) then stays
+    numerically exact."""
+    p = eqn.params
+    wd = p["window_dimensions"]
+    ws = p["window_strides"]
+    pads = p.get("padding", ((0, 0),) * len(wd))
+    if len(wd) != 4 or wd[0] != 1 or wd[1] != 1:
+        raise NotImplementedError(
+            f"jaxpr export: reduce_window_sum dims {wd} is not NCHW "
+            "pooling")
+    if any(a != b for a, b in pads):
+        raise NotImplementedError(
+            f"jaxpr export: asymmetric pooling pad {pads}")
+    mid = em.fresh("avgpool")
+    em.declare(mid, eqn.outvars[0].aval)
+    em.emit("pool2d", {"X": em.literal_or_var(eqn.invars[0])},
+            {"Out": mid},
+            {"pooling_type": "avg", "ksize": [int(wd[2]), int(wd[3])],
+             "strides": [int(ws[2]), int(ws[3])],
+             "paddings": [int(pads[2][0]), int(pads[3][0])],
+             "ceil_mode": False, "global_pooling": False,
+             "exclusive": False, "adaptive": False})
+    out = em.fresh("sumpool")
+    em.declare(out, eqn.outvars[0].aval)
+    em.emit("scale", {"X": mid}, {"Out": out},
+            {"scale": float(int(wd[2]) * int(wd[3])), "bias": 0.0,
+             "bias_after_scale": True})
+    em.bind(eqn.outvars[0], out)
+
+
 def _erfc(em, eqn):
     mid = em.fresh("erf")
     em.declare(mid, eqn.outvars[0].aval)
@@ -436,6 +589,17 @@ _HANDLERS = {
     "reduce_and": lambda em, e: _reduce(em, e, "reduce_all"),
     "reduce_or": lambda em, e: _reduce(em, e, "reduce_any"),
     "reduce_window_max": _reduce_window,
+    "cumsum": _cumsum,
+    "argmax": lambda em, e: _argminmax(em, e, "arg_max"),
+    "argmin": lambda em, e: _argminmax(em, e, "arg_min"),
+    "clamp": _clamp,
+    "iota": _iota,
+    "pad": _pad,
+    "atan2": _atan2,
+    "expm1": lambda em, e: _unary(em, e, "expm1"),
+    "top_k": _top_k,
+    "reduce_window_sum": _reduce_window_sum,
+
     "broadcast_in_dim": _broadcast_in_dim,
     "transpose": _transpose,
     "reshape": _reshape,
@@ -454,9 +618,39 @@ _HANDLERS = {
 }
 
 
+def _try_const_fold(em, eqn) -> bool:
+    """When every input is statically known, evaluate the primitive
+    eagerly and record the result — no ops emitted (materialized on
+    demand by var_of).  Keeps pad/clip attr resolution working when
+    values route through convert/broadcast chains, and exports leaner
+    programs."""
+    if eqn.primitive.name in ("pjit", "jit", "closed_call"):
+        return False
+    vals = [em.const_value(a) for a in eqn.invars]
+    if any(v is None for v in vals):
+        return False
+    # only fold small constants: folding a big computed tensor would
+    # bloat the program with assign_value blobs
+    if any(np.asarray(v).size > 4096 for v in vals):
+        return False
+    try:
+        out = eqn.primitive.bind(*[jnp.asarray(v) for v in vals],
+                                 **eqn.params)
+    except Exception:
+        return False
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    if len(outs) != len(eqn.outvars):
+        return False
+    for v, val in zip(eqn.outvars, outs):
+        em.known[id(v)] = np.asarray(val)
+    return True
+
+
 def _walk(em: _Emitter, jaxpr):
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
+        if _try_const_fold(em, eqn):
+            continue
         if prim in ("pjit", "jit", "closed_call", "custom_jvp_call",
                     "custom_vjp_call", "custom_vjp_call_jaxpr",
                     "remat", "checkpoint"):
@@ -473,10 +667,19 @@ def _walk(em: _Emitter, jaxpr):
                 em.scope[name] = arr
                 em.bind(cv, name)
             for outer, innerv in zip(eqn.invars, closed.invars):
-                em.bind(innerv, em.literal_or_var(outer))
+                cv = em.const_value(outer)
+                if cv is not None:
+                    # keep constants foldable across the jit boundary
+                    em.known[id(innerv)] = cv
+                else:
+                    em.bind(innerv, em.literal_or_var(outer))
             _walk(em, closed)
             for outer, innerv in zip(eqn.outvars, closed.outvars):
-                em.bind(outer, em.literal_or_var(innerv))
+                cv = em.const_value(innerv)
+                if cv is not None and id(innerv) not in em.names:
+                    em.known[id(outer)] = cv
+                else:
+                    em.bind(outer, em.literal_or_var(innerv))
             continue
         handler = _HANDLERS.get(prim)
         if handler is None:

@@ -2915,6 +2915,10 @@ def _py_func_op(op, scope, feeds, fetches):
     # need a shape probe, executing stateful callables twice per step)
     res = fn(*[np.asarray(jax.device_get(v)) for v in ins])
     res = res if isinstance(res, (tuple, list)) else (res,)
+    if len(res) != len(outs):
+        raise ValueError(
+            f"py_func callable returned {len(res)} values but the op "
+            f"declares {len(outs)} outputs {outs}")
     for name, v in zip(outs, res):
         scope[name] = jnp.asarray(np.asarray(v))
 

@@ -147,9 +147,12 @@ class TestTracedExport:
     def test_unmapped_primitive_raises_with_name(self):
         class Weird(nn.Layer):
             def forward(self, x):
-                return paddle.cumsum(x, axis=0)
+                from paddle_tpu.core.tensor import Tensor, unwrap
+                import jax.numpy as jnp
 
-        with pytest.raises(NotImplementedError, match="cumsum"):
+                return Tensor(jnp.fft.fft(unwrap(x)).real)
+
+        with pytest.raises(NotImplementedError, match="fft"):
             static.save_inference_model(
                 "/tmp/nope", layer=Weird(),
                 input_spec=[static.InputSpec([3], "float32")])
@@ -203,3 +206,54 @@ class TestExportRefusals:
 
         x = np.array([-8.0, 27.0], np.float32)
         _roundtrip(M(), static.InputSpec([2], "float32"), x, tmp_path)
+
+
+class TestExtendedPrimitives:
+    """Round-4 extension: cumsum/argmax/clamp/iota/pad/top_k/avg-pool
+    primitive mappings."""
+
+    def test_scalar_and_index_prims(self, tmp_path):
+        class M(nn.Layer):
+            def forward(self, x):
+                h = paddle.cumsum(x, axis=1)
+                h = paddle.clip(h, 0.0, 5.0)
+                return h + paddle.argmax(h, axis=1, keepdim=True) \
+                    .astype("float32")
+
+        x = np.random.RandomState(0).rand(3, 6).astype(np.float32)
+        _roundtrip(M(), static.InputSpec([3, 6], "float32"), x,
+                   tmp_path)
+
+    def test_avg_pool_pattern(self, tmp_path):
+        paddle.seed(7)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(1, 2, 3, padding=1)
+
+            def forward(self, x):
+                h = self.conv(x)
+                return nn.functional.avg_pool2d(h, 2, 2)
+
+        x = np.random.RandomState(2).rand(1, 1, 8, 8).astype(np.float32)
+        prog = _roundtrip(M(), static.InputSpec([1, 1, 8, 8],
+                                                "float32"), x, tmp_path)
+        types = [o["type"] for o in prog.desc["blocks"][0]["ops"]]
+        assert "pool2d" in types
+
+    def test_topk_and_pad(self, tmp_path):
+        class M(nn.Layer):
+            def forward(self, x):
+                v, idx = paddle.topk(x, k=2, axis=-1)
+                from paddle_tpu.core.tensor import Tensor, unwrap
+                import jax.numpy as jnp
+
+                return Tensor(jnp.pad(unwrap(v), ((0, 0), (0, 1)),
+                                      constant_values=0.5))
+
+        x = np.random.RandomState(3).rand(3, 5).astype(np.float32)
+        prog = _roundtrip(M(), static.InputSpec([3, 5], "float32"), x,
+                          tmp_path)
+        types = [o["type"] for o in prog.desc["blocks"][0]["ops"]]
+        assert "top_k_v2" in types and "pad" in types
