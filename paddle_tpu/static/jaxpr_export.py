@@ -33,8 +33,14 @@ class _Emitter:
         self.program = program
         self.block = block
         self.scope = scope
-        self.names: Dict[int, str] = {}  # id(var) -> program var name
-        self.known: Dict[int, np.ndarray] = {}  # id(var) -> const value
+        # keyed on the jaxpr Var OBJECTS (identity hash): an id(v) key
+        # is unstable — inner-jaxpr vars are garbage-collected after
+        # their pjit region inlines and CPython reuses the addresses,
+        # silently cross-binding variables (found via a BERT export
+        # feeding token ids into the token-type table).  Var keys also
+        # pin the objects alive.
+        self.names: Dict[object, str] = {}
+        self.known: Dict[object, np.ndarray] = {}
         self.counter = 0
 
     # -- naming -------------------------------------------------------------
@@ -43,18 +49,18 @@ class _Emitter:
         return f"jx_{tag}_{self.counter}"
 
     def var_of(self, v) -> str:
-        key = id(v)
-        if key not in self.names:
-            if key in self.known:
+        if v not in self.names:
+            if v in self.known:
                 # constant-folded value used as a real input here:
                 # materialize it once
-                self.names[key] = self.emit_constant(self.known[key])
-                return self.names[key]
+                self.names[v] = self.emit_constant(self.known[v])
+                return self.names[v]
             raise KeyError(f"unbound jaxpr var {v}")
-        return self.names[key]
+        return self.names[v]
 
     def bind(self, v, name: str):
-        self.names[id(v)] = name
+        self.names[v] = name
+        self.known.pop(v, None)  # a cached-region var may be re-bound
 
     def declare(self, name, aval, persistable=False):
         self.block.create_var(name, list(aval.shape), str(aval.dtype),
@@ -105,9 +111,9 @@ class _Emitter:
 
         if isinstance(a, Literal):
             return np.asarray(a.val)
-        if id(a) in self.known:
-            return self.known[id(a)]
-        name = self.names.get(id(a))
+        if a in self.known:
+            return self.known[a]
+        name = self.names.get(a)
         if name is not None and name in self.scope:
             return np.asarray(self.scope[name])
         return None
@@ -642,7 +648,8 @@ def _try_const_fold(em, eqn) -> bool:
     if len(outs) != len(eqn.outvars):
         return False
     for v, val in zip(eqn.outvars, outs):
-        em.known[id(v)] = np.asarray(val)
+        em.names.pop(v, None)  # cached-region var may be re-bound
+        em.known[v] = np.asarray(val)
     return True
 
 
@@ -666,18 +673,33 @@ def _walk(em: _Emitter, jaxpr):
                            persistable=True)
                 em.scope[name] = arr
                 em.bind(cv, name)
+            # NOTE: jax CACHES identical inner jaxprs, so the same Var
+            # objects recur across different pjit eqns (two
+            # structurally-equal embedding wraps share one jaxpr) — a
+            # re-bind must clear the var's previous-region state or a
+            # stale name wins over the new const (found via BERT's
+            # token-type ids resolving to the word-ids chain)
             for outer, innerv in zip(eqn.invars, closed.invars):
+                em.names.pop(innerv, None)
+                em.known.pop(innerv, None)
                 cv = em.const_value(outer)
                 if cv is not None:
                     # keep constants foldable across the jit boundary
-                    em.known[id(innerv)] = cv
+                    em.known[innerv] = cv
                 else:
                     em.bind(innerv, em.literal_or_var(outer))
             _walk(em, closed)
+            from jax.extend.core import Literal
+
             for outer, innerv in zip(eqn.outvars, closed.outvars):
                 cv = em.const_value(innerv)
-                if cv is not None and id(innerv) not in em.names:
-                    em.known[id(outer)] = cv
+                # Literal outvars (inner region returns a constant) are
+                # unhashable — guard before any dict membership test
+                inner_named = (not isinstance(innerv, Literal)
+                               and innerv in em.names)
+                if cv is not None and not inner_named:
+                    em.names.pop(outer, None)  # stale walk-1 binding
+                    em.known[outer] = cv
                 else:
                     em.bind(outer, em.literal_or_var(innerv))
             continue

@@ -589,6 +589,8 @@ def _program_from_layer_traced(layer, spec, scope, in_name):
     try:
         def fn(x):
             out = layer(Tensor(x))
+            if isinstance(out, (tuple, list)):
+                return tuple(unwrap(o) for o in out)
             return unwrap(out)
 
         prog = program_from_traced(fn, [example], scope,
