@@ -352,14 +352,18 @@ def program_from_layer(layer, input_spec, scope: Optional[Dict] = None
     scope = scope if scope is not None else {}
     counter = [0]
 
-    spec = input_spec[0] if isinstance(input_spec, (list, tuple)) else \
-        input_spec
-    if isinstance(spec, InputSpec):
-        in_name = spec.name or "x"
-        in_shape = [(-1 if s is None else int(s)) for s in spec.shape]
-        in_dtype = str(spec.dtype or "float32")
-    else:
+    specs = list(input_spec) if isinstance(input_spec, (list, tuple)) \
+        else [input_spec]
+    if not all(isinstance(s, InputSpec) for s in specs):
         raise TypeError("input_spec must be InputSpec(s)")
+    if len(specs) > 1:
+        # multi-input models have no sequential-chain reading — capture
+        # by tracing (round 4)
+        return _program_from_layer_traced_multi(layer, specs, scope)
+    spec = specs[0]
+    in_name = spec.name or "x"
+    in_shape = [(-1 if s is None else int(s)) for s in spec.shape]
+    in_dtype = str(spec.dtype or "float32")
 
     block.create_var("feed", type=VarType.FEED_MINIBATCH, persistable=True)
     block.create_var("fetch", type=VarType.FETCH_LIST, persistable=True)
@@ -564,38 +568,51 @@ def program_from_layer(layer, input_spec, scope: Optional[Dict] = None
     return prog
 
 
-def _program_from_layer_traced(layer, spec, scope, in_name):
-    """Trace-based capture for custom-forward layers (round 4): the
-    jaxpr of `layer.forward` maps onto reference ops; parameters ride
-    as jaxpr consts -> persistable vars."""
-    import numpy as np
-
+def _program_from_layer_traced_multi(layer, specs, scope,
+                                     names=None):
+    """Traced capture for layers with any number of inputs (the ONE
+    trace-capture path; the single-input helper delegates here): every
+    input becomes a feed target."""
     from ..core.tensor import Tensor, unwrap
     from .jaxpr_export import program_from_traced
 
-    if any(s in (-1, None) for s in spec.shape):
-        raise NotImplementedError(
-            "program_from_layer: traced export specializes to the "
-            "EXACT input shape — a dynamic dim (None/-1) in "
-            f"InputSpec{list(spec.shape)} would be silently baked to a "
-            "concrete size. Export with concrete shapes (one program "
-            "per shape), or compose the model from nn layers for the "
-            "shape-polymorphic sequential path")
-    shape = [int(s) for s in spec.shape]
-    example = np.zeros(shape, spec.dtype or "float32")
+    names = list(names) if names else \
+        [s.name or f"input_{i}" for i, s in enumerate(specs)]
+    reserved = {"feed", "fetch"}
+    if len(set(names)) != len(names) or reserved & set(names):
+        raise ValueError(
+            f"program_from_layer: input names {names} must be unique "
+            "and must not use the reserved names 'feed'/'fetch' (a "
+            "collision would silently alias feeds)")
+    examples = []
+    for i, spec in enumerate(specs):
+        if any(s in (-1, None) for s in spec.shape):
+            raise NotImplementedError(
+                "program_from_layer: traced export needs concrete "
+                f"shapes; InputSpec[{i}] has a dynamic dim "
+                f"{list(spec.shape)}")
+        examples.append(np.zeros([int(s) for s in spec.shape],
+                                 spec.dtype or "float32"))
 
     was_training = layer.training
-    layer.eval()  # inference export: dropout off, BN in eval form
+    layer.eval()
     try:
-        def fn(x):
-            out = layer(Tensor(x))
+        def fn(*xs):
+            out = layer(*[Tensor(x) for x in xs])
             if isinstance(out, (tuple, list)):
                 return tuple(unwrap(o) for o in out)
             return unwrap(out)
 
-        prog = program_from_traced(fn, [example], scope,
-                                   input_names=[in_name])
+        prog = program_from_traced(fn, examples, scope,
+                                   input_names=names)
     finally:
         if was_training:
             layer.train()
     return prog
+
+
+def _program_from_layer_traced(layer, spec, scope, in_name):
+    """Single-input traced capture — delegates to the multi-input
+    helper (one implementation to maintain)."""
+    return _program_from_layer_traced_multi(layer, [spec], scope,
+                                            names=[in_name])

@@ -337,3 +337,51 @@ class TestInnerRegionEdges:
         out = exe.run(prog, feed={"input_0": x},
                       fetch_list=["output_0"])[0]
         np.testing.assert_allclose(np.asarray(out), x + 3.0)
+
+
+class TestMultiInputExport:
+    def test_bert_with_token_type_ids(self, tmp_path):
+        """Multi-input traced export: BERT fed explicit token_type_ids
+        (two int64 feeds) round-trips with parity."""
+        from paddle_tpu.models.bert import BertConfig, BertModel
+
+        paddle.seed(0)
+        cfg = BertConfig(vocab_size=100, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64,
+                         max_position_embeddings=32)
+        net = BertModel(cfg)
+        net.eval()
+        rng = np.random.RandomState(2)
+        ids = rng.randint(0, 100, (2, 10)).astype(np.int64)
+        tt = rng.randint(0, 2, (2, 10)).astype(np.int64)
+        out = net(paddle.to_tensor(ids), paddle.to_tensor(tt))
+        want = np.asarray((out[0] if isinstance(out, (tuple, list))
+                           else out).numpy())
+        prefix = str(tmp_path / "bert2in")
+        static.save_inference_model(
+            prefix, layer=net,
+            input_spec=[static.InputSpec([2, 10], "int64", name="ids"),
+                        static.InputSpec([2, 10], "int64",
+                                         name="token_types")])
+        prog, feeds, fetches = static.load_inference_model(prefix)
+        assert set(feeds) == {"ids", "token_types"}
+        exe = static.Executor()
+        exe.scope.update(getattr(prog, "_param_scope", {}))
+        got = exe.run(prog, feed={"ids": ids, "token_types": tt},
+                      fetch_list=[fetches[0]])[0]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3,
+                                   atol=2e-4)
+
+    def test_colliding_input_names_refused(self):
+        from paddle_tpu.models.bert import BertConfig, BertModel
+
+        cfg = BertConfig(vocab_size=20, hidden_size=16, num_layers=1,
+                         num_heads=2, intermediate_size=32,
+                         max_position_embeddings=16)
+        net = BertModel(cfg)
+        with pytest.raises(ValueError, match="unique"):
+            static.save_inference_model(
+                "/tmp/nope4", layer=net,
+                input_spec=[
+                    static.InputSpec([2, 8], "int64"),
+                    static.InputSpec([2, 8], "int64", name="input_0")])
