@@ -2557,10 +2557,6 @@ PROGRAM_FORM_NA = {
     # a program-in-program trampoline for dy2static; jit.StaticFunction
     # IS that mechanism here (run_program_op.cc)
     "run_program": "jit.StaticFunction",
-    # legacy packed-cudnn flat-weight layout (cudnn_lstm_op.cc); the
-    # paddle-2.x `rnn` op (translated) is the serialized form our nn.LSTM
-    # emits
-    "cudnn_lstm": "interp `rnn` translator + nn.LSTM",
 }
 
 
@@ -2932,3 +2928,107 @@ for _n in ("read_file", "decode_jpeg", "py_func"):
 # paddle-2.x scalar ops the jaxpr exporter can emit
 b("log1p", lambda x: jnp.log1p(x))
 b("isfinite isfinite_v2", lambda x: jnp.isfinite(x))
+
+
+# ---------------------------------------------------------------------------
+# cudnn_lstm (operators/cudnn_lstm_op.cc / fluid.layers.lstm): the flat
+# packed weight W follows cuDNN's canonical parameter order — for every
+# layer, for every direction: 4 input-weight matrices then 4 recurrent
+# matrices (gate order i, f, g, o); after ALL matrices, the biases in
+# the same traversal order (4 input biases + 4 recurrent biases per
+# layer/direction).  Total size matches fluid/layers/rnn.py:2564-2575.
+# Input is TIME-MAJOR [T, B, in]; inference form (is_test) — dropout
+# between layers is identity.
+# ---------------------------------------------------------------------------
+@braw("cudnn_lstm")
+def _cudnn_lstm_op(op, scope, feeds, fetches):
+    from .interp import OP_TRANSLATORS as _T, OpView
+
+    x = scope.fetch(op.input("Input"))
+    w = scope.fetch(op.input("W")).reshape(-1)
+    hidden = int(op.attr("hidden_size", 0))
+    layers = int(op.attr("num_layers", 1))
+    ndir = 2 if bool(op.attr("is_bidirec", False)) else 1
+    t_len, bsz, in_sz = x.shape
+
+    expected = 0
+    for layer in range(layers):
+        isz = in_sz if layer == 0 else hidden * ndir
+        expected += (isz * hidden + hidden * hidden) * 4 * ndir
+        expected += hidden * 8 * ndir
+    if int(w.shape[0]) != expected:
+        raise ValueError(
+            f"cudnn_lstm: flat weight has {w.shape[0]} elements, the "
+            f"layout for hidden={hidden} layers={layers} ndir={ndir} "
+            f"input={in_sz} needs {expected}")
+
+    # unpack into the unified rnn op's WeightList order ([w_ih, w_hh
+    # per (layer, dir)] then [b_ih, b_hh per (layer, dir)]) and
+    # DELEGATE to the `rnn` translator — one scan implementation, and
+    # SequenceLength masking + the train-dropout guard come with it
+    uid = f"__cudnn_lstm_{op.output('Out')}"
+    wnames, bnames = [], []
+    off = 0
+    for layer in range(layers):
+        isz = in_sz if layer == 0 else hidden * ndir
+        for d in range(ndir):
+            n_ih = f"{uid}_wih_{layer}_{d}"
+            scope[n_ih] = w[off: off + 4 * hidden * isz].reshape(
+                4 * hidden, isz)
+            off += 4 * hidden * isz
+            n_hh = f"{uid}_whh_{layer}_{d}"
+            scope[n_hh] = w[off: off + 4 * hidden * hidden].reshape(
+                4 * hidden, hidden)
+            off += 4 * hidden * hidden
+            wnames += [n_ih, n_hh]
+    for layer in range(layers):
+        for d in range(ndir):
+            n_bi = f"{uid}_bih_{layer}_{d}"
+            scope[n_bi] = w[off: off + 4 * hidden]
+            off += 4 * hidden
+            n_bh = f"{uid}_bhh_{layer}_{d}"
+            scope[n_bh] = w[off: off + 4 * hidden]
+            off += 4 * hidden
+            bnames += [n_bi, n_bh]
+
+    h0_in, c0_in = op.input("InitH"), op.input("InitC")
+    h0_name, c0_name = f"{uid}_h0", f"{uid}_c0"
+    scope[h0_name] = scope.fetch(h0_in) if h0_in else jnp.zeros(
+        (layers * ndir, bsz, hidden), x.dtype)
+    scope[c0_name] = scope.fetch(c0_in) if c0_in else jnp.zeros(
+        (layers * ndir, bsz, hidden), x.dtype)
+
+    lh = op.output("LastH") or f"{uid}_lh"
+    lc = op.output("LastC") or f"{uid}_lc"
+    inputs = [
+        {"parameter": "Input", "arguments": [op.input("Input")]},
+        {"parameter": "WeightList", "arguments": wnames + bnames},
+        {"parameter": "PreState", "arguments": [h0_name, c0_name]},
+    ]
+    if op.input("SequenceLength"):
+        inputs.append({"parameter": "SequenceLength",
+                       "arguments": [op.input("SequenceLength")]})
+    outputs = [
+        {"parameter": "Out", "arguments": [op.output("Out")]},
+        {"parameter": "State", "arguments": [lh, lc]},
+    ]
+    from .proto import AttrType as _AT
+
+    desc = {
+        "type": "rnn", "inputs": inputs, "outputs": outputs,
+        "attrs": [
+            {"name": "mode", "type": _AT.STRING, "s": "LSTM"},
+            {"name": "hidden_size", "type": _AT.INT, "i": hidden},
+            {"name": "num_layers", "type": _AT.INT, "i": layers},
+            {"name": "is_bidirec", "type": _AT.BOOLEAN,
+             "b": ndir == 2},
+            {"name": "is_test", "type": _AT.BOOLEAN,
+             "b": bool(op.attr("is_test", True))},
+            {"name": "dropout_prob", "type": _AT.FLOAT,
+             "f": float(op.attr("dropout_prob", 0.0))},
+        ],
+    }
+    _T["rnn"](OpView(desc), scope, feeds, fetches)
+    for aux in ("Reserve", "StateOut"):
+        if op.output(aux):
+            scope[op.output(aux)] = jnp.zeros((1,), jnp.uint8)

@@ -811,3 +811,118 @@ class TestHostOps:
         with pytest.raises(NotImplementedError, match="process-local"):
             bridge_run("py_func", {"X": r(2)},
                        {"forward_callable_id": 12345})
+
+
+def sigmoid(x):
+    return 1 / (1 + np.exp(-x))
+
+
+class TestCudnnLstm:
+    """cudnn_lstm translator: the flat cuDNN-canonical packed weight
+    (matrices for all layer/dirs, then biases; gates i,f,g,o) unpacked
+    and run as lax.scan — parity vs a numpy LSTM built from the SAME
+    sub-weights."""
+
+    @staticmethod
+    def _np_lstm(x, w_ih, w_hh, b, h0, c0):
+        T, B, _ = x.shape
+        h, c = h0.copy(), c0.copy()
+        ys = []
+        for t in range(T):
+            gates = x[t] @ w_ih.T + h @ w_hh.T + b
+            i, f, g, o = np.split(gates, 4, axis=-1)
+            c = sigmoid(f) * c + sigmoid(i) * np.tanh(g)
+            h = sigmoid(o) * np.tanh(c)
+            ys.append(h)
+        return np.stack(ys), h, c
+
+    def test_single_layer_parity_and_states(self):
+        rng = np.random.RandomState(0)
+        T, B, I, H = 4, 2, 3, 5
+        w_ih = rng.randn(4 * H, I).astype(np.float32) * 0.3
+        w_hh = rng.randn(4 * H, H).astype(np.float32) * 0.3
+        b_ih = rng.randn(4 * H).astype(np.float32) * 0.1
+        b_hh = rng.randn(4 * H).astype(np.float32) * 0.1
+        flat = np.concatenate([w_ih.ravel(), w_hh.ravel(), b_ih, b_hh])
+        x = rng.randn(T, B, I).astype(np.float32)
+        h0 = rng.randn(1, B, H).astype(np.float32) * 0.1
+        c0 = rng.randn(1, B, H).astype(np.float32) * 0.1
+        got = bridge_run("cudnn_lstm",
+                         {"Input": x, "W": flat, "InitH": h0,
+                          "InitC": c0},
+                         {"hidden_size": H, "num_layers": 1,
+                          "is_bidirec": False, "is_test": True},
+                         outs=("Out", "LastH", "LastC"))
+        ys, hT, cT = self._np_lstm(x, w_ih, w_hh, b_ih + b_hh,
+                                   h0[0], c0[0])
+        np.testing.assert_allclose(got["Out"], ys, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(got["LastH"][0], hT, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(got["LastC"][0], cT, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_bidirectional_two_layer_shapes(self):
+        rng = np.random.RandomState(1)
+        T, B, I, H, L, ND = 3, 2, 4, 5, 2, 2
+        size = 0
+        for layer in range(L):
+            isz = I if layer == 0 else H * ND
+            size += (isz * H + H * H) * 4 * ND
+            size += H * 8 * ND
+        flat = (rng.randn(size) * 0.1).astype(np.float32)
+        x = rng.randn(T, B, I).astype(np.float32)
+        got = bridge_run("cudnn_lstm", {"Input": x, "W": flat},
+                         {"hidden_size": H, "num_layers": L,
+                          "is_bidirec": True, "is_test": True},
+                         outs=("Out", "LastH", "LastC"))
+        assert got["Out"].shape == (T, B, H * ND)
+        assert got["LastH"].shape == (L * ND, B, H)
+
+
+    def test_sequence_length_masks(self):
+        """Delegation to the unified rnn runner brings cudnn's
+        variable-length semantics: states freeze and outputs zero past
+        each row's length."""
+        rng = np.random.RandomState(2)
+        T, B, I, H = 5, 2, 3, 4
+        w_ih = rng.randn(4 * H, I).astype(np.float32) * 0.3
+        w_hh = rng.randn(4 * H, H).astype(np.float32) * 0.3
+        b = rng.randn(8 * H).astype(np.float32) * 0.1
+        flat = np.concatenate([w_ih.ravel(), w_hh.ravel(), b])
+        x = rng.randn(T, B, I).astype(np.float32)
+        lens = np.array([3, 5], np.int32)
+        got = bridge_run("cudnn_lstm",
+                         {"Input": x, "W": flat,
+                          "SequenceLength": lens},
+                         {"hidden_size": H, "num_layers": 1,
+                          "is_bidirec": False, "is_test": True},
+                         outs=("Out", "LastH", "LastC"))
+        # row 0 finished at t=3: outputs beyond are zero, LastH equals
+        # the t=2 output
+        np.testing.assert_allclose(got["Out"][3:, 0], 0.0, atol=1e-7)
+        np.testing.assert_allclose(got["LastH"][0, 0],
+                                   got["Out"][2, 0], rtol=1e-5)
+
+    def test_train_dropout_refused(self):
+        x = np.zeros((2, 1, 3), np.float32)
+        H = 4
+        size = (3 * H + H * H) * 4 + H * 8
+        with pytest.raises(NotImplementedError, match="dropout"):
+            bridge_run("cudnn_lstm",
+                       {"Input": x,
+                        "W": np.zeros(size, np.float32)},
+                       {"hidden_size": H, "num_layers": 1,
+                        "is_bidirec": False, "is_test": False,
+                        "dropout_prob": 0.5},
+                       outs=("Out",))
+
+    def test_wrong_weight_size_raises(self):
+        x = np.zeros((2, 1, 3), np.float32)
+        with pytest.raises(ValueError, match="flat weight"):
+            bridge_run("cudnn_lstm",
+                       {"Input": x,
+                        "W": np.zeros(7, np.float32)},
+                       {"hidden_size": 4, "num_layers": 1,
+                        "is_bidirec": False},
+                       outs=("Out",))
