@@ -385,3 +385,75 @@ class TestMultiInputExport:
                 input_spec=[
                     static.InputSpec([2, 8], "int64"),
                     static.InputSpec([2, 8], "int64", name="input_0")])
+
+
+class TestRandomizedExportEquivalence:
+    """Property-style sweep: randomly composed (but seeded,
+    deterministic) models over the mapped primitive set must round-trip
+    with eager parity — catches interaction bugs no hand-written case
+    covers (the BERT token-type aliasing was exactly this class)."""
+
+    OPS = ["linear", "relu", "gelu", "tanh", "sigmoid", "residual",
+           "layernorm", "scale_shift", "clip", "cumsum", "mean_keep",
+           "softmax_last"]
+
+    def _build(self, rng, width):
+        P = paddle
+        n_ops = rng.randint(3, 8)
+        choices = [self.OPS[i] for i in rng.randint(0, len(self.OPS),
+                                                    n_ops)]
+
+        class RandNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lins = nn.LayerList(
+                    [nn.Linear(width, width) for _ in range(4)])
+                self.ln = nn.LayerNorm(width)
+
+            def forward(self, x):
+                li = 0
+                h = x
+                for opname in choices:
+                    if opname == "linear":
+                        h = self.lins[li % 4](h)
+                        li += 1
+                    elif opname == "relu":
+                        h = nn.functional.relu(h)
+                    elif opname == "gelu":
+                        h = nn.functional.gelu(h)
+                    elif opname == "tanh":
+                        h = P.tanh(h)
+                    elif opname == "sigmoid":
+                        h = nn.functional.sigmoid(h)
+                    elif opname == "residual":
+                        h = h + self.lins[li % 4](h)
+                        li += 1
+                    elif opname == "layernorm":
+                        h = self.ln(h)
+                    elif opname == "scale_shift":
+                        h = h * 1.5 - 0.25
+                    elif opname == "clip":
+                        h = P.clip(h, -2.0, 2.0)
+                    elif opname == "cumsum":
+                        h = P.cumsum(h, axis=-1)
+                    elif opname == "mean_keep":
+                        h = h - P.mean(h, axis=-1, keepdim=True)
+                    elif opname == "softmax_last":
+                        h = nn.functional.softmax(h, axis=-1)
+                return h
+
+        return RandNet(), choices
+
+    @pytest.mark.parametrize("seed", [11, 23, 37, 51, 77])
+    def test_random_compositions(self, seed, tmp_path):
+        rng = np.random.RandomState(seed)
+        paddle.seed(seed)
+        width = int(rng.choice([4, 6, 8]))
+        net, choices = self._build(rng, width)
+        x = rng.rand(3, width).astype(np.float32) - 0.5
+        try:
+            _roundtrip(net, static.InputSpec([3, width], "float32"), x,
+                       tmp_path, rtol=5e-4, atol=5e-5)
+        except AssertionError as e:
+            raise AssertionError(
+                f"composition {choices} diverged") from e
