@@ -16,6 +16,7 @@
 
 #include "capi.h"
 
+#include <climits>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -491,10 +492,15 @@ int PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* data) {
 }
 
 int PD_TensorGetShape(PD_Tensor* t, int* shape_out) {
+  // always re-fetch (inside GetShapeDims): a cached first-run array
+  // would report a stale shape after the predictor reruns with
+  // different batch dims, and the caller sizes its CopyToCpu buffer
+  // from this
+  return PD_TensorGetShapeDims(t, shape_out, INT_MAX);
+}
+
+int PD_TensorGetShapeDims(PD_Tensor* t, int* dims_out, int max_dims) {
   GIL gil;
-  // always re-fetch: a cached first-run array would report a stale
-  // shape after the predictor reruns with different batch dims, and the
-  // caller sizes its CopyToCpu buffer from this
   PyObject* arr = fetch_contiguous(t, nullptr);
   if (!arr) return -1;
   PyObject* shape = PyObject_GetAttrString(arr, "shape");
@@ -503,9 +509,9 @@ int PD_TensorGetShape(PD_Tensor* t, int* shape_out) {
     return -1;
   }
   int n = static_cast<int>(PyTuple_Size(shape));
-  if (shape_out) {
-    for (Py_ssize_t d = 0; d < n; ++d) {
-      shape_out[d] =
+  if (dims_out) {
+    for (Py_ssize_t d = 0; d < n && d < max_dims; ++d) {
+      dims_out[d] =
           static_cast<int>(PyLong_AsLong(PyTuple_GetItem(shape, d)));
     }
   }

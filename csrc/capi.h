@@ -66,6 +66,9 @@ int PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* data);
 int PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* data);
 // returns ndim (or -1); writes the dims into shape_out when non-NULL
 int PD_TensorGetShape(PD_Tensor* t, int* shape_out);
+// one-fetch variant: returns ndim (or -1) and writes up to max_dims
+// dims into dims_out in the same call
+int PD_TensorGetShapeDims(PD_Tensor* t, int* dims_out, int max_dims);
 PD_DataType PD_TensorGetDataType(PD_Tensor* t);
 // run from the values previously copied into the input handles
 int PD_PredictorRun(PD_Predictor* p);

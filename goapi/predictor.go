@@ -65,6 +65,7 @@ int PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* data);
 int PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* data);
 int PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* data);
 int PD_TensorGetShape(PD_Tensor* t, int* shape_out);
+int PD_TensorGetShapeDims(PD_Tensor* t, int* dims_out, int max_dims);
 PD_DataType PD_TensorGetDataType(PD_Tensor* t);
 int PD_PredictorRun(PD_Predictor* p);
 */
@@ -72,6 +73,7 @@ import "C"
 
 import (
 	"errors"
+	"fmt"
 	"runtime"
 	"unsafe"
 )
@@ -395,24 +397,23 @@ func (t *Tensor) CopyFromCpuUint8(data []uint8) error {
 	return nil
 }
 
-// Shape fetches the tensor's current shape.
+// Shape fetches the tensor's current shape in one host readback
+// (PD_TensorGetShapeDims returns ndim and the dims together; the old
+// two-call pattern fetched the full tensor to host twice).
 func (t *Tensor) Shape() ([]int32, error) {
-	nd := int(C.PD_TensorGetShape(t.t, nil))
+	const maxDims = 16
+	var dims [maxDims]C.int
+	nd := int(C.PD_TensorGetShapeDims(t.t, &dims[0], maxDims))
+	runtime.KeepAlive(t)
 	if nd < 0 {
 		return nil, lastError()
 	}
-	shape := make([]C.int, nd)
-	var p *C.int
-	if nd > 0 {
-		p = &shape[0]
+	if nd > maxDims {
+		return nil, fmt.Errorf("tensor rank %d exceeds %d", nd, maxDims)
 	}
-	if int(C.PD_TensorGetShape(t.t, p)) < 0 {
-		return nil, lastError()
-	}
-	runtime.KeepAlive(t)
 	out := make([]int32, nd)
-	for i, d := range shape {
-		out[i] = int32(d)
+	for i := 0; i < nd; i++ {
+		out[i] = int32(dims[i])
 	}
 	return out, nil
 }

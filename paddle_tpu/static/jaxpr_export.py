@@ -174,6 +174,13 @@ def _conv(em, eqn):
             "jaxpr export: conv with non-NCHW/OIHW layout")
     if len(p["window_strides"]) != 2:
         raise NotImplementedError("jaxpr export: only 2-D convs")
+    if any(int(d) != 1 for d in p.get("lhs_dilation", ())):
+        raise NotImplementedError(
+            "jaxpr export: conv with lhs_dilation (transposed conv) has "
+            "no plain conv2d form")
+    if int(p.get("batch_group_count", 1)) != 1:
+        raise NotImplementedError(
+            "jaxpr export: conv with batch_group_count != 1")
     pads = p["padding"]
     if any(a != b for a, b in pads):
         raise NotImplementedError("jaxpr export: asymmetric conv pad")
@@ -203,12 +210,21 @@ def _reduce(em, eqn, optype):
     em.bind(eqn.outvars[0], out)
 
 
+def _check_window_dilations(p):
+    for key in ("window_dilation", "base_dilation"):
+        if any(int(d) != 1 for d in p.get(key, ())):
+            raise NotImplementedError(
+                f"jaxpr export: reduce_window with {key} != 1 has no "
+                "pool2d form")
+
+
 def _reduce_window(em, eqn):
     """lax pooling: window over the trailing two dims -> pool2d."""
     p = eqn.params
     wd = p["window_dimensions"]
     ws = p["window_strides"]
     pads = p.get("padding", ((0, 0),) * len(wd))
+    _check_window_dilations(p)
     if len(wd) != 4 or wd[0] != 1 or wd[1] != 1:
         raise NotImplementedError(
             f"jaxpr export: reduce_window dims {wd} is not NCHW pooling")
@@ -495,6 +511,7 @@ def _reduce_window_sum(em, eqn):
     wd = p["window_dimensions"]
     ws = p["window_strides"]
     pads = p.get("padding", ((0, 0),) * len(wd))
+    _check_window_dilations(p)
     if len(wd) != 4 or wd[0] != 1 or wd[1] != 1:
         raise NotImplementedError(
             f"jaxpr export: reduce_window_sum dims {wd} is not NCHW "

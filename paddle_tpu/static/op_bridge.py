@@ -527,7 +527,7 @@ b("modified_huber_loss", lambda x, y: _modified_huber(x, y)[::-1],
   ins="X Y", outs="?IntermediateVal Out")
 b("teacher_student_sigmoid_loss",
   lambda x, z, soft_max_up_bound=15.0, soft_max_lower_bound=-15.0:
-    jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x))),
+    _teacher_student_loss(x, z),
   ins="X Label", attrs="soft_max_up_bound soft_max_lower_bound",
   outs="Y")
 b("bpr_loss", lambda x, label: _bpr_loss(x, label), ins="X Label",
@@ -689,6 +689,17 @@ def _modified_huber(x, y):
     return loss, z
 
 
+def _teacher_student_loss(x, label):
+    # delegate to the eager op's 4-case piecewise formula
+    # (teacher_student_sigmoid_loss_op.h; the soft_max_*_bound attrs
+    # only clip the sigmoid in the reference GRAD kernel) — the bridge
+    # previously computed plain sigmoid CE, which is only the label<0
+    # half of the reference encoding
+    from paddle_tpu import ops as _o
+
+    return _unwrap(_o.teacher_student_sigmoid_loss(x, label))
+
+
 def _bpr_loss(x, label):
     # reference bpr_loss_op.h: -mean_{j != y} log(sigmoid(x_y - x_j))
     n, c = x.shape
@@ -775,6 +786,10 @@ def _multihead_matmul(inp, w, bias, bias_qk, alpha, heads):
     # fused QKV self-attention (operators/fused/multihead_matmul_op.cc):
     # Input [B,S,H], W [H, 3H] (or [3,H,H] packed), Bias [3H]
     bsz, seq, hid = inp.shape
+    if w.ndim == 3:
+        # packed [3,H,H]: a flat reshape would row-major-interleave the
+        # three matrices; the [H,3H] form is their last-axis concat
+        w = jnp.concatenate([w[0], w[1], w[2]], axis=-1)
     qkv = inp @ w.reshape(hid, -1)
     if bias is not None:
         qkv = qkv + bias.reshape(-1)

@@ -75,9 +75,11 @@ def test_eager_backward_overhead():
     float(np.asarray(loss.numpy()))
     p95 = float(np.percentile(ts, 95))
     # measured: fwd+bwd+tape for this 3-layer net p95 ~= 300x one raw jit
-    # call (the step is a few dozen ops plus tape bookkeeping).  3x
-    # headroom on the measured ratio.
-    limit = 1000 * raw_p95 + 2e-3
+    # call (the step is a few dozen ops plus tape bookkeeping).  ~2x
+    # headroom on the measured ratio (round-5 tightening; was 1000x,
+    # which would have let a 2-3x tape/backward regression pass); the
+    # absolute floor still absorbs shared-runner scheduling noise.
+    limit = 600 * raw_p95 + 2e-3
     assert p95 < limit, (
         f"eager fwd+bwd p95 {p95*1e3:.2f}ms vs raw jit p95 "
         f"{raw_p95*1e6:.0f}us (limit {limit*1e3:.2f}ms)")

@@ -528,6 +528,18 @@ class TestNNFamily:
         exp = (att @ v).transpose(0, 2, 1, 3).reshape(b_, s, h)
         np.testing.assert_allclose(got["Out"], exp, rtol=1e-4, atol=1e-5)
 
+        # packed [3,H,H] weight layout: w3[i] are the q/k/v matrices —
+        # must equal the [H,3H] last-axis concat, NOT a flat reshape
+        # (row-major reorder scrambles rows)
+        w3 = np.stack(np.split(w, 3, axis=-1))
+        assert w3.shape == (3, h, h) and not np.allclose(
+            w3.reshape(h, 3 * h), w)  # flat reshape really does scramble
+        got3 = bridge_run("multihead_matmul",
+                          {"Input": inp, "W": w3, "Bias": bias},
+                          {"alpha": 0.5, "head_number": heads})
+        np.testing.assert_allclose(got3["Out"], exp, rtol=1e-4,
+                                   atol=1e-5)
+
     def test_conv3d_pool3d(self):
         x = r(1, 2, 4, 4, 4)
         w = r(3, 2, 2, 2, 2, seed=1)

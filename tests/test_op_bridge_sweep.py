@@ -38,8 +38,18 @@ class TestMathStragglers:
         exp = np.where(zz >= -1, np.maximum(0, 1 - zz) ** 2, -4 * zz)
         check("modified_huber_loss", {"X": x, "Y": y}, None,
               {"Out": exp}, outs=("IntermediateVal", "Out"), rtol=1e-4)
-        exp2 = np.maximum(x, 0) - x * y + np.log1p(np.exp(-np.abs(x)))
-        check("teacher_student_sigmoid_loss", {"X": x, "Label": y},
+        # reference label encoding spans 4 cases: <-1 (no teacher,
+        # no click), [-1,0) (no teacher, click), [0,1) (teacher score,
+        # no click), >=1 (1 + teacher score, click)
+        lab = np.linspace(-2.0, 1.5, x.size).reshape(
+            x.shape).astype(np.float32)
+        ce = np.maximum(x, 0) + np.log1p(np.exp(-np.abs(x)))
+        exp2 = np.where(
+            lab < -1, ce,
+            np.where(lab < 0, ce - x,
+                     np.where(lab < 1, 2 * ce - x * lab,
+                              2 * ce - x - x * (lab - 1))))
+        check("teacher_student_sigmoid_loss", {"X": x, "Label": lab},
               None, {"Y": exp2}, outs=("Y",), rtol=1e-4)
 
     def test_row_conv_conv_shift(self):
