@@ -107,8 +107,39 @@ def _attr_value(a: Dict[str, Any]):
     return None
 
 
+class FusedSlice:
+    """coalesce_tensor output alias: a live view into the fused buffer
+    (reference `operators/coalesce_tensor_op.cc` makes each Output a
+    sub-tensor of FusedOutput, so a later write to the fused buffer —
+    the fleet's single fused allreduce — must be observed by reads of
+    the component vars).  Resolved lazily at scope-read time; a direct
+    write to the component var replaces the view (same as the reference
+    re-allocating the output away from the fused space)."""
+
+    __slots__ = ("fused", "offset", "shape")
+
+    def __init__(self, fused, offset, shape):
+        self.fused = fused
+        self.offset = int(offset)
+        self.shape = tuple(int(s) for s in shape)
+
+    def resolve(self, scope):
+        buf = jnp.ravel(scope[self.fused])
+        n = int(np.prod(self.shape)) if self.shape else 1
+        return buf[self.offset:self.offset + n].reshape(self.shape)
+
+
 class Scope(dict):
     """name -> jnp array."""
+
+    def __getitem__(self, name):
+        v = dict.__getitem__(self, name)
+        if isinstance(v, FusedSlice):
+            return v.resolve(self)
+        return v
+
+    def get(self, name, default=None):  # route through view resolution
+        return self[name] if name in self else default
 
     def fetch(self, name):
         if name not in self:
@@ -342,8 +373,10 @@ class ProgramRunner:
                 run_block(ops, s, feeds, fetches)
             # also return the full scope (as a plain dict pytree) so the
             # Executor can satisfy fetch_list entries that aren't
-            # fetch-op targets
-            return tuple(fetches[k] for k in sorted(fetches)), dict(s)
+            # fetch-op targets; indexing through the Scope resolves any
+            # coalesce_tensor FusedSlice views into arrays
+            return tuple(fetches[k] for k in sorted(fetches)), \
+                {k: s[k] for k in s}
 
         if jit:
             self._jit = jax.jit(

@@ -2197,6 +2197,127 @@ def _barrier_op(op, scope, feeds, fetches):
 
 
 # ---------------------------------------------------------------------------
+# fleet-inserted bootstrap/sync ops (SURVEY §3.3 steps 3-4): a genuinely
+# distributed-rewritten reference program carries NCCL bootstrap ops in
+# its startup program and stream-sync/fusion ops in its main program.
+# All are TPU-obsolete as *work* (PJRT coordination replaces rendezvous;
+# XLA's global schedule replaces stream syncs; XLA fusion replaces
+# buffer coalescing) but must still CONSUME in program form so the real
+# fleet output loads — no-op / identity-alias semantics.
+# ---------------------------------------------------------------------------
+@braw("c_gen_nccl_id", "c_gen_bkcl_id", "c_gen_hccl_id")
+def _c_gen_comm_id_op(op, scope, feeds, fetches):
+    # reference c_gen_nccl_id_op.cc:107 writes an opaque UniqueId RAW
+    # var consumed only by c_comm_init; PJRT's coordination service is
+    # the rendezvous here, so the id is a placeholder token
+    scope[op.output("Out")] = jnp.zeros((1,), jnp.int32)
+
+
+@braw("gen_nccl_id", "gen_bkcl_id", "gen_hccl_id")
+def _gen_comm_id_op(op, scope, feeds, fetches):
+    # legacy spelling (gen_nccl_id_op.cc:215): output slot is NCCLID
+    for slot in ("NCCLID", "Out"):
+        if op.output(slot):
+            scope[op.output(slot)] = jnp.zeros((1,), jnp.int32)
+
+
+@braw("c_comm_init", "c_comm_init_all", "c_comm_init_hccl",
+      "c_comm_init_multitrainer", "comm_init")
+def _c_comm_init_op(op, scope, feeds, fetches):
+    # communicator construction (c_comm_init_op.cc:105 consumes the
+    # UniqueId); mesh axes are bound by `collective_axes(...)` instead —
+    # nothing to do, and no outputs to write
+    return
+
+
+@braw("c_sync_comm_stream", "c_sync_calc_stream", "c_wait_comm",
+      "c_wait_compute")
+def _c_stream_sync_op(op, scope, feeds, fetches):
+    # stream fences (c_sync_comm_stream_op.cc etc.): X -> Out are the
+    # same vars in fleet programs (a dependency edge, not a compute);
+    # alias every pair so a differently-named Out still resolves.  Copy
+    # the RAW scope entry (not through __getitem__): a coalesced
+    # component must stay a live FusedSlice view, not freeze into its
+    # pre-allreduce snapshot
+    xs = op.inputs("X")
+    outs = op.outputs("Out")
+    for x_name, out_name in zip(xs, outs):
+        if out_name != x_name and x_name in scope:
+            scope[out_name] = dict.__getitem__(scope, x_name)
+
+
+@braw("marker")
+def _marker_op(op, scope, feeds, fetches):
+    # profiler span marker (marker_op.cc): no inputs, no outputs
+    return
+
+
+def _partial_cols(op, scope):
+    # partial_concat/partial_sum (operators/partial_concat_op.h):
+    # columns [start, start+length) of each 2-D input (length=-1: to
+    # the end; negative start wraps)
+    xs = [jnp.asarray(scope.fetch(n)) for n in op.inputs("X")]
+    start = int(op.attr("start_index", 0))
+    length = int(op.attr("length", -1))
+    size = xs[0].shape[1]
+    if start < 0:
+        start += size
+    stop = size if length < 0 else start + length
+    return [x[:, start:stop] for x in xs]
+
+
+@braw("partial_concat")
+def _partial_concat_op(op, scope, feeds, fetches):
+    scope[op.output("Out")] = jnp.concatenate(_partial_cols(op, scope),
+                                              axis=1)
+
+
+@braw("partial_sum")
+def _partial_sum_op(op, scope, feeds, fetches):
+    cols = _partial_cols(op, scope)
+    out = cols[0]
+    for c in cols[1:]:
+        out = out + c
+    scope[op.output("Out")] = out
+
+
+@braw("coalesce_tensor")
+def _coalesce_tensor_op(op, scope, feeds, fetches):
+    """reference `operators/coalesce_tensor_op.cc`: pack Input tensors
+    into one contiguous FusedOutput whose sub-ranges ALIAS the Output
+    vars (the fleet then allreduces the fused buffer once and the
+    optimizer reads the component grads through the aliases).  The
+    functional redesign packs with jnp.concatenate and registers
+    `FusedSlice` views for the outputs — reads of a component var
+    resolve against the CURRENT fused buffer, so the post-allreduce
+    values flow through exactly as the reference's sub-tensor aliasing
+    does.  Alignment padding (use_align/align_size) only moves offsets;
+    tight packing is observably equivalent through the views and is
+    what we emit."""
+    from .interp import FusedSlice
+    from .proto import vartype_to_np_dtype
+
+    in_names = op.inputs("Input")
+    out_names = op.outputs("Output")
+    fused_name = op.output("FusedOutput")
+    dtype = np.dtype(vartype_to_np_dtype(op.attr("dtype", 5)))
+    xs = [jnp.asarray(scope.fetch(n)).astype(dtype) for n in in_names]
+    if op.attr("set_constant", False):
+        const = float(op.attr("constant", 0.0))
+        flat = jnp.full((sum(x.size for x in xs),), const, dtype)
+    elif op.attr("copy_data", True):
+        flat = jnp.concatenate([jnp.ravel(x) for x in xs]) if xs else \
+            jnp.zeros((0,), dtype)
+    else:
+        flat = jnp.zeros((sum(x.size for x in xs),), dtype)
+    scope[fused_name] = flat
+    offset = 0
+    for out_name, x in zip(out_names, xs):
+        scope[out_name] = FusedSlice(fused_name, offset, x.shape)
+        offset += x.size
+
+
+# ---------------------------------------------------------------------------
 # fake-quant family (reference operators/fake_quantize_op.cc /
 # fake_dequantize_op.cc): QAT/PTQ simulation ops
 # ---------------------------------------------------------------------------

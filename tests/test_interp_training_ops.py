@@ -546,6 +546,136 @@ class TestCollectiveOps:
         w_ref = w0 - 0.1 * gw
         np.testing.assert_allclose(w_dp, w_ref, rtol=1e-5, atol=1e-6)
 
+    def test_full_raw_program_op_set(self):
+        """The FULL RawProgramOptimizer output (SURVEY §3.3 steps 3-4):
+        startup bootstrap ops (c_gen_nccl_id + c_comm_init), main-program
+        sync/marker ops, and coalesce_tensor whose Output vars ALIAS the
+        fused buffer — the optimizer reads each grad through the alias
+        AFTER the single fused c_allreduce_sum, so wrong aliasing gives
+        a numerically wrong step, not just a load failure."""
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        startup = static.Program()
+        sb = startup.global_block()
+        sb.append_op("c_gen_nccl_id", {}, {"Out": "nccl_id_0"},
+                     {"ring_id": 0})
+        sb.append_op("c_comm_init", {"X": "nccl_id_0"}, {},
+                     {"ring_id": 0, "nranks": 2, "rank": 0})
+
+        prog = static.Program()
+        b = prog.global_block()
+        b.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+        b.append_op("feed", {"X": "feed"}, {"Out": "y"}, {"col": 1})
+        b.create_var("x", [4, 3], "float32")
+        b.create_var("y", [4, 1], "float32")
+        for w in ("w1", "w2"):
+            b.create_var(w, [3, 1], "float32", persistable=True)
+        b.append_op("marker", {}, {}, {"marker_role": "forward",
+                                       "marker_pos": "B"})
+        b.append_op("matmul_v2", {"X": "x", "Y": "w1"}, {"Out": "p1"},
+                    {})
+        b.append_op("matmul_v2", {"X": "x", "Y": "w2"}, {"Out": "p2"},
+                    {})
+        b.append_op("elementwise_add", {"X": "p1", "Y": "p2"},
+                    {"Out": "pred"}, {})
+        b.append_op("elementwise_sub", {"X": "pred", "Y": "y"},
+                    {"Out": "diff"}, {})
+        b.append_op("transpose2", {"X": "x"}, {"Out": "xT"},
+                    {"axis": [1, 0]})
+        b.append_op("matmul_v2", {"X": "xT", "Y": "diff"},
+                    {"Out": "g1"}, {})
+        b.append_op("matmul_v2", {"X": "xT", "Y": "diff"},
+                    {"Out": "g2"}, {})
+        b.append_op("c_sync_calc_stream", {"X": ["g1", "g2"]},
+                    {"Out": ["g1", "g2"]}, {})
+        b.append_op("coalesce_tensor", {"Input": ["g1", "g2"]},
+                    {"Output": ["g1", "g2"],
+                     "FusedOutput": "fused_grad"},
+                    {"copy_data": True, "dtype": 5, "use_align": True})
+        b.append_op("c_allreduce_sum", {"X": "fused_grad"},
+                    {"Out": "fused_grad"}, {"ring_id": 0})
+        b.append_op("c_sync_comm_stream", {"X": "fused_grad"},
+                    {"Out": "fused_grad"}, {"ring_id": 0})
+        b.append_op("fill_constant", {}, {"Out": "lr"},
+                    {"shape": [1], "dtype": 5, "value": 0.1})
+        for w, g in (("w1", "g1"), ("w2", "g2")):
+            b.append_op("scale", {"X": g}, {"Out": w + "@GRAD"},
+                        {"scale": 2.0 / 8.0, "bias": 0.0,
+                         "bias_after_scale": True})
+            b.append_op("sgd", {"Param": w, "Grad": w + "@GRAD",
+                                "LearningRate": "lr"},
+                        {"ParamOut": w}, {})
+
+        rng = np.random.RandomState(1)
+        xv = rng.rand(8, 3).astype(np.float32)
+        yv = rng.rand(8, 1).astype(np.float32)
+        w0 = {"w1": rng.rand(3, 1).astype(np.float32),
+              "w2": rng.rand(3, 1).astype(np.float32)}
+
+        sops = startup.desc["blocks"][0]["ops"]
+        mops = prog.desc["blocks"][0]["ops"]
+
+        def one_step(xs, ys, w1, w2):
+            scope = Scope({"w1": w1, "w2": w2})
+            with blocks_context([{"ops": sops + mops}]), \
+                    collective_axes(default="dp"):
+                run_block(sops, scope, {}, {})
+                run_block(mops, scope, {"x": xs, "y": ys}, {})
+            return scope["w1"], scope["w2"]
+
+        devs = np.array(jax.devices()[:2])
+        mesh = Mesh(devs, ("dp",))
+        stepped = shard_map(
+            one_step, mesh=mesh,
+            in_specs=(P("dp"), P("dp"), P(), P()),
+            out_specs=(P(), P()), check_rep=False)
+        w1_dp, w2_dp = stepped(jnp.asarray(xv), jnp.asarray(yv),
+                               jnp.asarray(w0["w1"]),
+                               jnp.asarray(w0["w2"]))
+
+        # single-process fused batch reference
+        diff = xv @ w0["w1"] + xv @ w0["w2"] - yv
+        gw = 2.0 / 8.0 * (xv.T @ diff)
+        np.testing.assert_allclose(np.asarray(w1_dp),
+                                   w0["w1"] - 0.1 * gw,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(w2_dp),
+                                   w0["w2"] - 0.1 * gw,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_coalesce_alias_reads_post_write_values(self):
+        """FusedSlice semantics in isolation: after coalesce, a write to
+        the fused buffer is observed by reads of the component vars."""
+        from paddle_tpu.static.interp import Scope, run_block, \
+            blocks_context
+
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        c = np.arange(4, dtype=np.float32).reshape(4) + 100
+        desc = [
+            {"type": "coalesce_tensor",
+             "inputs": [{"parameter": "Input", "arguments": ["a", "c"]}],
+             "outputs": [
+                 {"parameter": "Output", "arguments": ["a", "c"]},
+                 {"parameter": "FusedOutput", "arguments": ["fused"]}],
+             "attrs": [_encode_attr("copy_data", True),
+                       _encode_attr("dtype", 5)]},
+            {"type": "scale",
+             "inputs": [{"parameter": "X", "arguments": ["fused"]}],
+             "outputs": [{"parameter": "Out", "arguments": ["fused"]}],
+             "attrs": [_encode_attr("scale", 2.0),
+                       _encode_attr("bias", 0.0),
+                       _encode_attr("bias_after_scale", True)]},
+        ]
+        scope = Scope({"a": jnp.asarray(a), "c": jnp.asarray(c)})
+        with blocks_context([{"ops": desc}]):
+            run_block(desc, scope, {}, {})
+        np.testing.assert_allclose(np.asarray(scope["fused"]),
+                                   np.concatenate([a.ravel(),
+                                                   c.ravel()]) * 2)
+        np.testing.assert_allclose(np.asarray(scope["a"]), a * 2)
+        np.testing.assert_allclose(np.asarray(scope["c"]), c * 2)
+
 
 class TestQuantFakeOps:
     def test_fake_quantize_abs_max(self):
