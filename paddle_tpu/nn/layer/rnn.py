@@ -233,7 +233,11 @@ class _RNNBase(Layer):
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from ...ops import stack
+        from ...static import export_marker
 
+        if (export_marker.export_tracing() and initial_states is None
+                and sequence_length is None):
+            return self._export_forward(inputs)
         out = inputs
         final_h, final_c = [], []
         for i, rnn_l in enumerate(self._all_layers):
@@ -258,6 +262,43 @@ class _RNNBase(Layer):
         if self.mode == "LSTM":
             return out, (h_stack, stack(final_c, axis=0))
         return out, h_stack
+
+    def _export_forward(self, inputs):
+        """Serialize-time lowering: bind the `paddle_rnn` marker so the
+        exporter emits ONE unified `rnn` op (`operators/rnn_op.cc`) —
+        the same single-fused-op form the reference's `nn.LSTM` always
+        executes — instead of unrolling the python time loop into T
+        cell copies.  Weight order matches the op's WeightList contract:
+        (w_ih, w_hh) per (layer, direction), then (b_ih, b_hh) per
+        (layer, direction)."""
+        from ...static.export_marker import rnn_p
+
+        x = unwrap(inputs)
+        nd = self.num_directions
+        cells = []
+        for lyr in self._all_layers:
+            if nd == 2:
+                cells += [lyr.rnn_fw.cell, lyr.rnn_bw.cell]
+            else:
+                cells.append(lyr.cell)
+        ws = [unwrap(w) for c in cells
+              for w in (c.weight_ih, c.weight_hh)]
+        bs = [unwrap(b) for c in cells
+              for b in (c.bias_ih, c.bias_hh)]
+        batch = x.shape[1] if self.time_major else x.shape[0]
+        h0 = jnp.zeros((self.num_layers * nd, batch, self.hidden_size),
+                       x.dtype)
+        outs = rnn_p.bind(x, h0, jnp.zeros_like(h0), *ws, *bs,
+                          mode=self.mode, hidden_size=self.hidden_size,
+                          num_layers=self.num_layers,
+                          is_bidirec=(nd == 2),
+                          time_major=self.time_major,
+                          dropout=float(self.dropout))
+        if self.mode == "LSTM":
+            out, h, c = outs
+            return Tensor(out), (Tensor(h), Tensor(c))
+        out, h = outs
+        return Tensor(out), Tensor(h)
 
 
 class SimpleRNN(_RNNBase):

@@ -1,0 +1,70 @@
+"""Export-time lowering marker for the unified RNN op.
+
+In the reference, the dygraph RNN layers ARE one fused op: `nn.LSTM`'s
+forward binds `_C_ops.rnn` (`python/paddle/nn/layer/rnn.py`, kernel
+`operators/rnn_op.cc`), so `jit.save` serializes a single compact `rnn`
+op.  The TPU build's eager RNN layers are a traced python time loop
+(XLA fuses it), which would *unroll* into T copies of the cell under
+`make_jaxpr` — correct but bloated, and it loses the reference-format
+`rnn` op the interchange contract calls for.
+
+So during export tracing (`jaxpr_export.program_from_traced` sets the
+flag below), `_RNNBase.forward` binds this marker primitive instead of
+running its python loop; the exporter maps it 1:1 onto the `rnn` op.
+The primitive exists only inside `make_jaxpr` under the flag — eager
+execution and training never see it, so no jvp/batching rules are
+needed.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.core
+from jax.extend.core import Primitive
+
+_TLS = threading.local()
+
+
+def export_tracing() -> bool:
+    """True while jaxpr_export is tracing a model for serialization."""
+    return getattr(_TLS, "on", False)
+
+
+@contextlib.contextmanager
+def export_trace_context():
+    prev = getattr(_TLS, "on", False)
+    _TLS.on = True
+    try:
+        yield
+    finally:
+        _TLS.on = prev
+
+
+rnn_p = Primitive("paddle_rnn")
+rnn_p.multiple_results = True
+
+
+@rnn_p.def_abstract_eval
+def _rnn_abstract(x, h0, c0, *weights, mode, hidden_size, num_layers,
+                  is_bidirec, time_major, dropout):
+    nd = 2 if is_bidirec else 1
+    if time_major:
+        T, B = x.shape[0], x.shape[1]
+        out_shape = (T, B, hidden_size * nd)
+    else:
+        B, T = x.shape[0], x.shape[1]
+        out_shape = (B, T, hidden_size * nd)
+    state = jax.core.ShapedArray((num_layers * nd, B, hidden_size),
+                                 x.dtype)
+    outs = [jax.core.ShapedArray(out_shape, x.dtype), state]
+    if mode == "LSTM":
+        outs.append(state)
+    return outs
+
+
+@rnn_p.def_impl
+def _rnn_impl(*args, **kwargs):
+    raise RuntimeError(
+        "paddle_rnn is an export-tracing marker and is never executed; "
+        "eager RNN layers run their traced time loop instead")

@@ -138,6 +138,34 @@ class Scope(dict):
             return v.resolve(self)
         return v
 
+    def __setitem__(self, name, value):
+        # two-way aliasing for coalesce_tensor components (reference
+        # sub-tensors SHARE the fused storage): a write to a var that
+        # currently holds a FusedSlice view lands in the fused buffer
+        # — the fuse-grad-space layout has backward ops write component
+        # grads BEFORE the fused allreduce reads the buffer
+        cur = dict.get(self, name)
+        if isinstance(cur, FusedSlice) and \
+                not isinstance(value, FusedSlice):
+            n = int(np.prod(cur.shape)) if cur.shape else 1
+            flat = jnp.ravel(jnp.asarray(value))
+            if flat.size == n and cur.fused in self:
+                buf = jnp.ravel(self[cur.fused])
+                self[cur.fused] = buf.at[
+                    cur.offset:cur.offset + n].set(
+                    flat.astype(buf.dtype))
+                return  # the view stays live over the updated buffer
+        dict.__setitem__(self, name, value)
+
+    def update(self, other=(), **kw):
+        # dict.update bypasses __setitem__ at the C level; route through
+        # it so aliased writes keep their write-through semantics
+        items = other.items() if hasattr(other, "items") else other
+        for k, v in items:
+            self[k] = v
+        for k, v in kw.items():
+            self[k] = v
+
     def get(self, name, default=None):  # route through view resolution
         return self[name] if name in self else default
 
@@ -1135,8 +1163,21 @@ def _range(op, scope, feeds, fetches):
 
 @register("cumsum")
 def _cumsum(op, scope, feeds, fetches):
-    x = scope.fetch(op.input("X"))
-    scope[op.output("Out")] = jnp.cumsum(x, axis=op.attr("axis", -1))
+    """reference `operators/cum_op.cc`: flatten/exclusive/reverse
+    attrs (exclusive shifts the window by one; reverse accumulates
+    from the far end)."""
+    x = jnp.asarray(scope.fetch(op.input("X")))
+    axis = op.attr("axis", -1)
+    if op.attr("flatten", False):
+        x, axis = x.reshape(-1), 0
+    if op.attr("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if op.attr("exclusive", False):
+        out = out - x
+    if op.attr("reverse", False):
+        out = jnp.flip(out, axis)
+    scope[op.output("Out")] = out
 
 
 # ---------------------------------------------------------------------------

@@ -13,8 +13,24 @@ reference-era tooling (and our own Predictor) can load.
 
 Unmapped primitives raise with the primitive name (explicit coverage
 boundary, same stance as the interp's unknown-op error).
+
+Control flow (round 5): `lax.while_loop`/`lax.scan`/`lax.cond` serialize
+as the reference's sub-block ops — `while_op` with the carry written
+back each step and the Condition recomputed at the end of the body
+(`operators/controlflow/while_op.cc:59`), `scan` as a counter `while`
+whose per-step outputs land in `write_to_array` TensorArrays and stack
+via `tensor_array_to_tensor` after the loop (the exact program shape
+the reference's dy2static loop transformer emits —
+`dygraph_to_static/loop_transformer.py`), and `cond`/`switch` as
+one `conditional_block` per branch reconciled by `select_input`
+(`conditional_block_op.cc:29`, `select_input_op.cc`).  nn.LSTM/GRU/
+SimpleRNN lower to the unified `rnn` op (`operators/rnn_op.cc`) via the
+export-time marker primitive in `export_marker.py` — the reference's
+dygraph RNN layers likewise serialize to that single fused op.
 """
 from __future__ import annotations
+
+import contextlib
 
 from typing import Dict, List
 
@@ -42,6 +58,42 @@ class _Emitter:
         self.names: Dict[object, str] = {}
         self.known: Dict[object, np.ndarray] = {}
         self.counter = 0
+        # ClosedJaxpr id -> bound const names (a cond_jaxpr is walked
+        # once outside the loop and once per body; its consts bind once)
+        self.closed_consts: Dict[int, List[str]] = {}
+        # vars that must never materialize (PRNG keys closed over by a
+        # jitted eval-mode forward: dead unless an op actually consumes
+        # them, in which case this carries the refusal message)
+        self.poison: Dict[object, str] = {}
+
+    def bind_const_value(self, cv, cval, tag, persistable=True):
+        """Bind a closed-over constant.  Extended-dtype values (PRNG
+        keys) are poisoned rather than materialized: an eval-mode
+        forward jitted through StaticFunction closes over its rng key,
+        which is dead in the inference program unless a random op
+        actually consumes it."""
+        import jax.dtypes as jdt
+
+        dt = getattr(cval, "dtype", None)
+        if dt is not None and jdt.issubdtype(dt, jdt.extended):
+            self.names.pop(cv, None)
+            self.known.pop(cv, None)
+            self.poison[cv] = (
+                f"jaxpr export: a constant of extended dtype {dt} "
+                "(PRNG key / RNG state) feeds a serialized op — "
+                "inference programs cannot carry RNG state; export "
+                "with the layer in eval() mode")
+            return None
+        arr = np.asarray(cval)
+        name = self.fresh(tag)
+        self.declare_global(name, jax.ShapeDtypeStruct(arr.shape,
+                                                       arr.dtype),
+                            persistable=persistable)
+        self.scope[name] = arr
+        self.names.pop(cv, None)
+        self.known.pop(cv, None)
+        self.bind(cv, name)
+        return name
 
     # -- naming -------------------------------------------------------------
     def fresh(self, tag="tmp"):
@@ -49,6 +101,8 @@ class _Emitter:
         return f"jx_{tag}_{self.counter}"
 
     def var_of(self, v) -> str:
+        if v in self.poison:
+            raise NotImplementedError(self.poison[v])
         if v not in self.names:
             if v in self.known:
                 # constant-folded value used as a real input here:
@@ -66,8 +120,34 @@ class _Emitter:
         self.block.create_var(name, list(aval.shape), str(aval.dtype),
                               persistable=persistable)
 
+    def declare_global(self, name, aval, persistable=True):
+        """Persistables (params, closed-jaxpr consts) live in the global
+        block regardless of which sub-block is being emitted (reference
+        layout: `framework.py` puts parameters in block 0)."""
+        self.program.global_block().create_var(
+            name, list(aval.shape), str(aval.dtype),
+            persistable=persistable)
+
     def emit(self, optype, ins, outs, attrs):
         self.block.append_op(optype, ins, outs, attrs)
+
+    @contextlib.contextmanager
+    def in_block(self, block):
+        """Emit into a sub-block.  Names materialized for lazily-known
+        constants while inside are forgotten on exit: the defining op
+        lives in the sub-block (whose scope is discarded per reference
+        step-scope semantics), so a later outer-block use must
+        re-materialize in a block that's actually visible there."""
+        prev = self.block
+        before = set(self.names)
+        self.block = block
+        try:
+            yield
+        finally:
+            self.block = prev
+            for v in [v for v in list(self.names)
+                      if v not in before and v in self.known]:
+                del self.names[v]
 
     # -- values -------------------------------------------------------------
     def emit_constant(self, val: np.ndarray, tag="lit") -> str:
@@ -160,9 +240,57 @@ def _dot_general(em, eqn):
                     {"trans_x": bool(trans_x), "trans_y": bool(trans_y)})
             em.bind(eqn.outvars[0], out)
             return
-    raise NotImplementedError(
-        f"jaxpr export: dot_general with dimension_numbers {dnums} has "
-        "no matmul_v2 form (general tensor contraction)")
+    _dot_general_contraction(em, eqn)
+
+
+def _dot_general_contraction(em, eqn):
+    """General tensor contraction: canonicalize both operands to
+    batched 3-D via transpose2+reshape2, one matmul_v2, reshape to the
+    dot_general output layout (batch dims, lhs free, rhs free — which
+    is exactly the [B, M, N] reshape order, so no output transpose)."""
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    x, y = eqn.invars
+    xa, ya = x.aval, y.aval
+
+    def prod(dims, shape):
+        out = 1
+        for d in dims:
+            out *= int(shape[d])
+        return out
+
+    lfree = [d for d in range(xa.ndim) if d not in lc and d not in lb]
+    rfree = [d for d in range(ya.ndim) if d not in rc and d not in rb]
+    bsz = prod(lb, xa.shape)
+    m, k = prod(lfree, xa.shape), prod(lc, xa.shape)
+    n = prod(rfree, ya.shape)
+
+    def canon(atom, aval, perm, shape3):
+        name = em.literal_or_var(atom)
+        if list(perm) != list(range(aval.ndim)):
+            t = em.fresh("dg_t")
+            em.declare(t, jax.ShapeDtypeStruct(
+                tuple(int(aval.shape[p]) for p in perm), aval.dtype))
+            em.emit("transpose2", {"X": name}, {"Out": t},
+                    {"axis": [int(p) for p in perm]})
+            name = t
+        r = em.fresh("dg_r")
+        em.declare(r, jax.ShapeDtypeStruct(tuple(shape3), aval.dtype))
+        em.emit("reshape2", {"X": name}, {"Out": r},
+                {"shape": list(shape3)})
+        return r
+
+    xr = canon(x, xa, list(lb) + lfree + list(lc), [bsz, m, k])
+    yr = canon(y, ya, list(rb) + list(rc) + rfree, [bsz, k, n])
+    mm = em.fresh("dg_mm")
+    em.declare(mm, jax.ShapeDtypeStruct((bsz, m, n), eqn.outvars[0]
+                                        .aval.dtype))
+    em.emit("matmul_v2", {"X": xr, "Y": yr}, {"Out": mm},
+            {"trans_x": False, "trans_y": False})
+    out = em.fresh("dg")
+    em.declare(out, eqn.outvars[0].aval)
+    em.emit("reshape2", {"X": mm}, {"Out": out},
+            {"shape": [int(s) for s in eqn.outvars[0].aval.shape]})
+    em.bind(eqn.outvars[0], out)
 
 
 def _conv(em, eqn):
@@ -334,18 +462,49 @@ def _concatenate(em, eqn):
 
 
 def _select_n(em, eqn):
-    if len(eqn.invars) != 3:
-        raise NotImplementedError("jaxpr export: select_n arity != 3")
-    pred, on_false, on_true = eqn.invars
-    out = em.fresh("where")
-    em.declare(out, eqn.outvars[0].aval)
-    # lax.select_n(pred, false_case, true_case); reference `where` is
-    # (Condition ? X : Y)
-    em.emit("where", {"Condition": em.literal_or_var(pred),
-                      "X": em.literal_or_var(on_true),
-                      "Y": em.literal_or_var(on_false)},
-            {"Out": out}, {})
-    em.bind(eqn.outvars[0], out)
+    if len(eqn.invars) == 3:
+        pred, on_false, on_true = eqn.invars
+        out = em.fresh("where")
+        em.declare(out, eqn.outvars[0].aval)
+        # lax.select_n(pred, false_case, true_case); reference `where`
+        # is (Condition ? X : Y)
+        em.emit("where", {"Condition": em.literal_or_var(pred),
+                          "X": em.literal_or_var(on_true),
+                          "Y": em.literal_or_var(on_false)},
+                {"Out": out}, {})
+        em.bind(eqn.outvars[0], out)
+        return
+    # arity > 3: integer selector; fold right as nested `where`
+    # (out = pred==0 ? c0 : (pred==1 ? c1 : ... c_{n-1}))
+    pred, cases = eqn.invars[0], eqn.invars[1:]
+    pa = pred.aval
+    pn = em.literal_or_var(pred)
+    if np.dtype(pa.dtype) != np.dtype(np.int32):
+        # selector may be int8/uint8/int64; compare in int32 — the
+        # reference compare kernels require matching operand dtypes
+        # (and assign_value has no small-int attr key)
+        c = em.fresh("selcast")
+        em.declare(c, jax.ShapeDtypeStruct(pa.shape, np.int32))
+        em.emit("cast", {"X": pn}, {"Out": c},
+                {"in_dtype": proto.np_dtype_to_vartype(np.dtype(pa.dtype)),
+                 "out_dtype": proto.np_dtype_to_vartype(np.dtype(np.int32))})
+        pn = c
+    aval = eqn.outvars[0].aval
+    cur = em.literal_or_var(cases[-1])
+    for k in range(len(cases) - 2, -1, -1):
+        kname = em.emit_constant(
+            np.full([1] if pa.ndim == 0 else list(pa.shape), k,
+                    np.int32), tag="selk")
+        mask = em.fresh("selmask")
+        em.declare(mask, jax.ShapeDtypeStruct(pa.shape, np.bool_))
+        em.emit("equal", {"X": pn, "Y": kname}, {"Out": mask}, {"axis": -1})
+        out = em.fresh("sel")
+        em.declare(out, aval)
+        em.emit("where", {"Condition": mask,
+                          "X": em.literal_or_var(cases[k]), "Y": cur},
+                {"Out": out}, {})
+        cur = out
+    em.bind(eqn.outvars[0], cur)
 
 
 def _gather_as_lookup(em, eqn):
@@ -419,11 +578,12 @@ def _atan2(em, eqn):
 
 
 def _cumsum(em, eqn):
-    if eqn.params.get("reverse"):
-        raise NotImplementedError("jaxpr export: reverse cumsum")
+    # the reference cumsum op carries reverse/exclusive attrs
+    # (`operators/cum_op.cc` CumOpMaker), so both forms serialize
     _unary(em, eqn, "cumsum",
            {"axis": int(eqn.params["axis"]), "flatten": False,
-            "exclusive": False, "reverse": False})
+            "exclusive": False,
+            "reverse": bool(eqn.params.get("reverse", False))})
 
 
 def _argminmax(em, eqn, optype):
@@ -472,20 +632,37 @@ def _pad(em, eqn):
     cfg = eqn.params["padding_config"]
     if any(int(i) != 0 for _, _, i in cfg):
         raise NotImplementedError("jaxpr export: interior (dilating) pad")
-    if any(int(lo) < 0 or int(hi) < 0 for lo, hi, _ in cfg):
-        raise NotImplementedError("jaxpr export: negative pad")
     pval = em.const_value(eqn.invars[1])
     if pval is None:
         raise NotImplementedError(
             "jaxpr export: pad value is a runtime tensor (the pad op "
             "takes a scalar attr)")
+    xa = eqn.invars[0].aval
+    cur = em.literal_or_var(eqn.invars[0])
+    if any(int(lo) < 0 or int(hi) < 0 for lo, hi, _ in cfg):
+        # lax semantics: negative pad trims; serialize as slice of the
+        # negative components, then a plain pad of the positive ones
+        starts = [max(0, -int(lo)) for lo, _, _ in cfg]
+        ends = [int(xa.shape[d]) + min(0, int(hi))
+                for d, (_, hi, _) in enumerate(cfg)]
+        sl_shape = tuple(e - s for s, e in zip(starts, ends))
+        sl = em.fresh("padtrim")
+        em.declare(sl, jax.ShapeDtypeStruct(sl_shape, xa.dtype))
+        em.emit("slice", {"Input": cur}, {"Out": sl},
+                {"axes": list(range(xa.ndim)), "starts": starts,
+                 "ends": ends, "infer_flags": [1] * xa.ndim,
+                 "decrease_axis": []})
+        cur = sl
+        cfg = [(max(0, int(lo)), max(0, int(hi)), 0) for lo, hi, _ in cfg]
+        if all(lo == 0 and hi == 0 for lo, hi, _ in cfg):
+            em.bind(eqn.outvars[0], cur)
+            return
     out = em.fresh("pad")
     em.declare(out, eqn.outvars[0].aval)
     paddings = []
     for lo, hi, _ in cfg:
         paddings += [int(lo), int(hi)]
-    em.emit("pad", {"X": em.literal_or_var(eqn.invars[0])},
-            {"Out": out},
+    em.emit("pad", {"X": cur}, {"Out": out},
             {"paddings": paddings, "pad_value": float(pval)})
     em.bind(eqn.outvars[0], out)
 
@@ -552,6 +729,257 @@ def _rsqrt(em, eqn):
     _unary(em, eqn, "rsqrt")
 
 
+def _split_prim(em, eqn):
+    """lax.split -> reference `split` op (`operators/split_op.cc`):
+    equal sizes use the `num` attr, ragged use `sections`."""
+    sizes = [int(s) for s in eqn.params["sizes"]]
+    axis = int(eqn.params["axis"])
+    outs = []
+    for v in eqn.outvars:
+        n = em.fresh("split")
+        em.declare(n, v.aval)
+        outs.append(n)
+    attrs = {"axis": axis}
+    if len(set(sizes)) == 1:
+        attrs["num"] = len(sizes)
+        attrs["sections"] = []
+    else:
+        attrs["num"] = 0
+        attrs["sections"] = sizes
+    em.emit("split", {"X": em.literal_or_var(eqn.invars[0])},
+            {"Out": outs}, attrs)
+    for v, n in zip(eqn.outvars, outs):
+        em.bind(v, n)
+
+
+def _start_vals(em, atoms):
+    return [em.const_value(a) for a in atoms]
+
+
+def _scalar_to_index_tensor(em, atom, clamp_hi=None):
+    """Materialize a scalar start index as a [1] int tensor var (the
+    shape the reference gather/scatter Ids and the interp expect).
+    With clamp_hi, clamp into [0, clamp_hi] — the lax guarantee for
+    dynamic_slice/dynamic_update_slice start indices, which gather/
+    scatter would otherwise turn into OOB garbage."""
+    name = em.literal_or_var(atom)
+    aval = atom.aval
+    dt = np.dtype(aval.dtype)
+    if tuple(aval.shape) != (1,):
+        r = em.fresh("idx")
+        em.declare(r, jax.ShapeDtypeStruct((1,), dt))
+        em.emit("reshape2", {"X": name}, {"Out": r}, {"shape": [1]})
+        name = r
+    if clamp_hi is not None:
+        lo = em.emit_constant(np.asarray([0], dt), tag="idx_lo")
+        hi = em.emit_constant(np.asarray([int(clamp_hi)], dt),
+                              tag="idx_hi")
+        mx = em.fresh("idx_clip_lo")
+        em.declare(mx, jax.ShapeDtypeStruct((1,), dt))
+        em.emit("elementwise_max", {"X": name, "Y": lo}, {"Out": mx},
+                {"axis": -1})
+        mn = em.fresh("idx_clip")
+        em.declare(mn, jax.ShapeDtypeStruct((1,), dt))
+        em.emit("elementwise_min", {"X": mx, "Y": hi}, {"Out": mn},
+                {"axis": -1})
+        name = mn
+    return name
+
+
+def _single_dynamic_axis(em, svals, sizes, xa):
+    """Validate the loop-indexing pattern: exactly one dynamic axis k
+    (size 1 there), every other axis statically 0-start and full-size.
+    Returns k or None."""
+    dyn = [i for i, v in enumerate(svals) if v is None]
+    if len(dyn) != 1:
+        return None
+    k = dyn[0]
+    if sizes[k] != 1:
+        return None
+    for i in range(xa.ndim):
+        if i == k:
+            continue
+        if svals[i] is None or int(np.asarray(svals[i]).reshape(())) != 0:
+            return None
+        if sizes[i] != int(xa.shape[i]):
+            return None
+    return k
+
+
+def _dynamic_slice(em, eqn):
+    """x[i] at a runtime index.  Statically-known starts serialize as a
+    plain `slice`; the loop pattern (one dynamic axis, unit width)
+    becomes transpose2 + `gather` + reshape2 — the reference gather op
+    (`operators/gather_op.cc`) does the dim-0 dynamic row read."""
+    x = eqn.invars[0]
+    starts = eqn.invars[1:]
+    sizes = [int(s) for s in eqn.params["slice_sizes"]]
+    xa = x.aval
+    svals = _start_vals(em, starts)
+    if all(v is not None for v in svals):
+        st = [int(np.asarray(v).reshape(())) for v in svals]
+        # lax clamps starts into [0, dim - size]
+        st = [min(max(s, 0), int(d) - z)
+              for s, d, z in zip(st, xa.shape, sizes)]
+        out = em.fresh("dsl")
+        em.declare(out, eqn.outvars[0].aval)
+        em.emit("slice", {"Input": em.literal_or_var(x)}, {"Out": out},
+                {"axes": list(range(xa.ndim)), "starts": st,
+                 "ends": [s + z for s, z in zip(st, sizes)],
+                 "infer_flags": [1] * xa.ndim, "decrease_axis": []})
+        em.bind(eqn.outvars[0], out)
+        return
+    k = _single_dynamic_axis(em, svals, sizes, xa)
+    if k is None:
+        raise NotImplementedError(
+            "jaxpr export: dynamic_slice beyond the single-dynamic-axis "
+            f"unit-width pattern (sizes {sizes} over shape "
+            f"{tuple(xa.shape)})")
+    xn = em.literal_or_var(x)
+    shape = [int(s) for s in xa.shape]
+    if k != 0:
+        perm = [k] + [i for i in range(xa.ndim) if i != k]
+        t = em.fresh("dsl_t")
+        em.declare(t, jax.ShapeDtypeStruct(
+            tuple(shape[p] for p in perm), xa.dtype))
+        em.emit("transpose2", {"X": xn}, {"Out": t},
+                {"axis": perm})
+        xn = t
+    idx = _scalar_to_index_tensor(em, starts[k],
+                                  clamp_hi=int(xa.shape[k]) - 1)
+    g = em.fresh("dsl_g")
+    rest = [shape[i] for i in range(xa.ndim) if i != k]
+    em.declare(g, jax.ShapeDtypeStruct(tuple([1] + rest), xa.dtype))
+    em.emit("gather", {"X": xn, "Index": idx}, {"Out": g}, {})
+    out = em.fresh("dsl")
+    em.declare(out, eqn.outvars[0].aval)
+    # [1, rest...] and the unit-width output have identical linear
+    # element order, so a reshape2 restores the axis-k placement
+    em.emit("reshape2", {"X": g}, {"Out": out},
+            {"shape": [int(s) for s in eqn.outvars[0].aval.shape]})
+    em.bind(eqn.outvars[0], out)
+
+
+def _emit_row_overwrite(em, eqn, x_atom, upd_name, k, idx_atom,
+                        overwrite=True, clamp=False):
+    """Shared tail of dynamic_update_slice/scatter export: overwrite (or
+    accumulate) one row of x along axis k at a runtime index, via the
+    reference `scatter` op (dim-0 rows), bracketed by transpose2 when
+    k != 0.  `upd_name` must already be [1, *other-dims-in-perm-order]."""
+    xa = x_atom.aval
+    shape = [int(s) for s in xa.shape]
+    xn = em.literal_or_var(x_atom)
+    perm = [k] + [i for i in range(xa.ndim) if i != k]
+    inv_perm = [perm.index(i) for i in range(xa.ndim)]
+    if k != 0:
+        t = em.fresh("dus_t")
+        em.declare(t, jax.ShapeDtypeStruct(
+            tuple(shape[p] for p in perm), xa.dtype))
+        em.emit("transpose2", {"X": xn}, {"Out": t}, {"axis": perm})
+        xn = t
+    idx = _scalar_to_index_tensor(
+        em, idx_atom, clamp_hi=(shape[k] - 1) if clamp else None)
+    if not overwrite:
+        # accumulate: the reference scatter kernel's add mode zeroes
+        # the target row first (scatter_op.h), so x[i] += u must
+        # serialize as read-modify-write with an overwriting scatter
+        g = em.fresh("rmw_row")
+        row_aval = jax.ShapeDtypeStruct(
+            tuple([1] + [shape[p] for p in perm[1:]]), xa.dtype)
+        em.declare(g, row_aval)
+        em.emit("gather", {"X": xn, "Index": idx}, {"Out": g}, {})
+        s = em.fresh("rmw_sum")
+        em.declare(s, row_aval)
+        em.emit("elementwise_add", {"X": g, "Y": upd_name}, {"Out": s},
+                {"axis": -1})
+        upd_name = s
+        overwrite = True
+    sc = em.fresh("dus_sc")
+    em.declare(sc, jax.ShapeDtypeStruct(
+        tuple(shape[p] for p in perm), xa.dtype))
+    em.emit("scatter", {"X": xn, "Ids": idx, "Updates": upd_name},
+            {"Out": sc}, {"overwrite": bool(overwrite)})
+    if k != 0:
+        out = em.fresh("dus")
+        em.declare(out, eqn.outvars[0].aval)
+        em.emit("transpose2", {"X": sc}, {"Out": out},
+                {"axis": inv_perm})
+        sc = out
+    em.bind(eqn.outvars[0], sc)
+
+
+def _dynamic_update_slice(em, eqn):
+    """x with a block overwritten at a runtime offset.  Static starts
+    serialize as `set_value` (`operators/set_value_op.cc`); the loop
+    pattern (one dynamic axis, unit width) becomes the reference
+    `scatter` op on rows."""
+    x, upd = eqn.invars[0], eqn.invars[1]
+    starts = eqn.invars[2:]
+    xa, ua = x.aval, upd.aval
+    sizes = [int(s) for s in ua.shape]
+    svals = _start_vals(em, starts)
+    if all(v is not None for v in svals):
+        st = [min(max(int(np.asarray(v).reshape(())), 0), int(d) - z)
+              for v, d, z in zip(svals, xa.shape, sizes)]
+        out = em.fresh("setv")
+        em.declare(out, eqn.outvars[0].aval)
+        em.emit("set_value",
+                {"Input": em.literal_or_var(x),
+                 "ValueTensor": em.literal_or_var(upd)},
+                {"Out": out},
+                {"axes": list(range(xa.ndim)), "starts": st,
+                 "ends": [s + z for s, z in zip(st, sizes)],
+                 "steps": [1] * xa.ndim, "decrease_axes": [],
+                 "none_axes": [], "shape": []})
+        em.bind(eqn.outvars[0], out)
+        return
+    k = _single_dynamic_axis(em, svals, sizes, xa)
+    if k is None:
+        raise NotImplementedError(
+            "jaxpr export: dynamic_update_slice beyond the "
+            "single-dynamic-axis unit-width pattern")
+    # update arrives with the unit axis in place; move it to dim 0
+    perm = [k] + [i for i in range(xa.ndim) if i != k]
+    upd_shape = [1] + [int(xa.shape[i]) for i in range(xa.ndim)
+                       if i != k]
+    un = em.literal_or_var(upd)
+    if k != 0:
+        ut = em.fresh("dus_u")
+        em.declare(ut, jax.ShapeDtypeStruct(tuple(upd_shape), ua.dtype))
+        em.emit("transpose2", {"X": un}, {"Out": ut}, {"axis": perm})
+        un = ut
+    # lax clamps dynamic_update_slice starts into range (the update is
+    # always applied); gather/scatter would drop an OOB row instead
+    _emit_row_overwrite(em, eqn, x, un, k, starts[k], clamp=True)
+
+
+def _scatter_prim(em, eqn, overwrite):
+    """`.at[i].set/add` row form -> reference `scatter` op: indices [1]
+    over operand dim 0 with the update covering the full row."""
+    dn = eqn.params["dimension_numbers"]
+    x, idx, upd = eqn.invars
+    xa, ia, ua = x.aval, idx.aval, upd.aval
+    row_ok = (tuple(dn.scatter_dims_to_operand_dims) == (0,)
+              and tuple(dn.inserted_window_dims) == (0,)
+              and not dn.operand_batching_dims
+              and int(np.prod(ia.shape)) == 1
+              and tuple(ua.shape[-(xa.ndim - 1):] if xa.ndim > 1 else ())
+              == tuple(xa.shape[1:]))
+    if not row_ok:
+        raise NotImplementedError(
+            "jaxpr export: general lax.scatter (only the single-row "
+            ".at[i].set/.add pattern maps to the scatter op)")
+    un = em.literal_or_var(upd)
+    row_shape = [1] + [int(s) for s in xa.shape[1:]]
+    if list(ua.shape) != row_shape:
+        r = em.fresh("scat_u")
+        em.declare(r, jax.ShapeDtypeStruct(tuple(row_shape), ua.dtype))
+        em.emit("reshape2", {"X": un}, {"Out": r},
+                {"shape": row_shape})
+        un = r
+    _emit_row_overwrite(em, eqn, x, un, 0, idx, overwrite=overwrite)
+
+
 def _pow(em, eqn):
     y = int(eqn.params["y"])
     out = em.fresh("pow")
@@ -559,6 +987,443 @@ def _pow(em, eqn):
     em.emit("pow", {"X": em.literal_or_var(eqn.invars[0])},
             {"Out": out}, {"factor": float(y)})
     em.bind(eqn.outvars[0], out)
+
+
+# ---------------------------------------------------------------------------
+# Structured control flow -> reference sub-block ops.
+#
+# The reference captures dygraph loops/branches into ProgramDesc
+# sub-blocks (`dygraph/jit.py` jit.save via the ProgramTranslator,
+# `controlflow/while_op.cc`, `conditional_block_op.cc`); this is the
+# produce side of the interchange contract whose consume side lives in
+# `interp.py` (its `while` translator carries every body-written outer
+# var and re-reads Condition each step — the program shapes emitted here
+# are exactly what it consumes, and what the reference executor runs).
+# ---------------------------------------------------------------------------
+def _bind_closed_consts(em, closed):
+    """Bind a ClosedJaxpr's constvars to persistable global-block vars
+    (once per closed jaxpr — a cond_jaxpr is walked once outside the
+    loop and once per body)."""
+    jx = closed.jaxpr
+    if id(closed) in em.closed_consts:
+        for cv, name in zip(jx.constvars, em.closed_consts[id(closed)]):
+            em.names.pop(cv, None)
+            em.known.pop(cv, None)
+            if name is not None:  # poisoned consts stay poisoned
+                em.bind(cv, name)
+        return jx
+    names = []
+    for cv, cval in zip(jx.constvars, closed.consts):
+        names.append(em.bind_const_value(cv, cval, "const"))
+    em.closed_consts[id(closed)] = names
+    return jx
+
+
+def _poison_msg(em, atom):
+    """Refusal message if this atom must never materialize (an RNG key
+    threaded through the jitted forward's loop carry / closure), else
+    None."""
+    from jax.extend.core import Literal
+
+    import jax.dtypes as jdt
+
+    if isinstance(atom, Literal):
+        return None
+    if atom in em.poison:
+        return em.poison[atom]
+    dt = getattr(atom.aval, "dtype", None)
+    if dt is not None and jdt.issubdtype(dt, jdt.extended):
+        return (f"jaxpr export: value of extended dtype {dt} (PRNG "
+                "key / RNG state) feeds a serialized op — inference "
+                "programs cannot carry RNG state; export with the "
+                "layer in eval() mode")
+    return None
+
+
+def _resolve_atoms(em, atoms):
+    """Program var names for a list of atoms; poisoned atoms resolve to
+    None (they stay dead unless something inside actually reads them)."""
+    out = []
+    for a in atoms:
+        msg = _poison_msg(em, a)
+        out.append(None if msg else em.literal_or_var(a))
+    return out
+
+
+def _walk_closed(em, closed, in_names, const_atoms=None):
+    """Walk a closed sub-jaxpr with its invars bound to program var
+    names (None = poisoned: the refusal fires only if read); returns
+    the inner jaxpr (caller reads .outvars).  Rebinding clears stale
+    state from a previous walk of the same (cached) jaxpr — each eqn
+    refreshes its outvars in program order, so in-order reads never see
+    the prior walk's bindings."""
+    jx = _bind_closed_consts(em, closed)
+    const_atoms = const_atoms or {}
+    for i, (v, n) in enumerate(zip(jx.invars, in_names)):
+        em.names.pop(v, None)
+        em.known.pop(v, None)
+        atom = const_atoms.get(i)
+        if n is None:
+            em.poison[v] = (
+                _poison_msg(em, atom) if atom is not None else None
+            ) or ("jaxpr export: RNG state feeds a serialized op — "
+                  "export with the layer in eval() mode")
+            continue
+        em.poison.pop(v, None)
+        cv = em.const_value(atom) if atom is not None else None
+        if cv is not None:
+            # loop-invariant constant operand: keep it foldable inside
+            em.known[v] = cv
+        else:
+            em.bind(v, n)
+    _walk(em, jx)
+    return jx
+
+
+def _assign_carries(em, outvar_atoms, carry_names):
+    """Write back loop-carried values (poisoned slots skipped).  Copy
+    through fresh temps first: an identity carry's outvar can BE
+    another carry's name, and a direct in-place assignment sequence
+    would read already-overwritten slots (the (a, b) = (b, a) hazard)."""
+    tmps = []
+    for a, nm in zip(outvar_atoms, carry_names):
+        if nm is None:
+            tmps.append(None)
+            continue
+        t = em.fresh("carry_tmp")
+        em.declare(t, a.aval)
+        em.emit("assign", {"X": em.literal_or_var(a)}, {"Out": t}, {})
+        tmps.append(t)
+    for t, nm in zip(tmps, carry_names):
+        if t is not None:
+            em.emit("assign", {"X": t}, {"Out": nm}, {})
+
+
+def _emit_condition(em, cond_closed, cond_const_names, cond_const_atoms,
+                    carry_names, cond_name):
+    jx = _walk_closed(em, cond_closed,
+                      cond_const_names + carry_names,
+                      const_atoms=cond_const_atoms)
+    em.emit("assign", {"X": em.literal_or_var(jx.outvars[0])},
+            {"Out": cond_name}, {})
+
+
+def _init_carries(em, carry_atoms, tag):
+    """Outer loop-var names aligned with the carry atoms; poisoned
+    carries (an RNG key threaded through the jitted forward's loop)
+    stay None — dead unless the body actually reads them."""
+    names = []
+    for a in carry_atoms:
+        if _poison_msg(em, a):
+            names.append(None)
+            continue
+        nm = em.fresh(tag)
+        em.declare(nm, a.aval)
+        em.emit("assign", {"X": em.literal_or_var(a)}, {"Out": nm}, {})
+        names.append(nm)
+    return names
+
+
+def _emit_while_op(em, read_names, cond_name, carry_names, sub):
+    from .program import BlockRef
+
+    scopes = em.fresh("step_scopes")
+    em.block.create_var(scopes, type=proto.VarType.STEP_SCOPES)
+    em.emit("while",
+            {"X": sorted(set(read_names)), "Condition": cond_name},
+            {"Out": list(carry_names), "StepScopes": scopes},
+            {"sub_block": BlockRef(sub.idx), "is_test": True})
+
+
+def _while_prim(em, eqn):
+    """lax.while_loop -> `while` op.  Carries become outer vars the
+    sub-block writes back each step (the reference's step-scope
+    write-back); the Condition var is computed once before the loop and
+    recomputed at the end of each body — the exact shape fluid's
+    `layers.while_loop` builds (`control_flow.py:1014`)."""
+    p = eqn.params
+    cn, bn = p["cond_nconsts"], p["body_nconsts"]
+    cond_closed, body_closed = p["cond_jaxpr"], p["body_jaxpr"]
+    cond_consts = eqn.invars[:cn]
+    body_consts = eqn.invars[cn:cn + bn]
+    carry_in = eqn.invars[cn + bn:]
+
+    cond_const_names = _resolve_atoms(em, cond_consts)
+    body_const_names = _resolve_atoms(em, body_consts)
+    carry_names = _init_carries(em, carry_in, "loopvar")
+
+    cond_name = em.fresh("while_cond")
+    em.declare(cond_name, cond_closed.jaxpr.outvars[0].aval)
+    cond_const_atoms = {i: a for i, a in enumerate(cond_consts)}
+    _emit_condition(em, cond_closed, cond_const_names, cond_const_atoms,
+                    carry_names, cond_name)
+
+    sub = em.program.create_block(parent_idx=em.block.idx)
+    with em.in_block(sub):
+        bjx = _walk_closed(
+            em, body_closed, body_const_names + carry_names,
+            const_atoms={i: a for i, a in enumerate(body_consts)})
+        _assign_carries(em, bjx.outvars, carry_names)
+        _emit_condition(em, cond_closed, cond_const_names,
+                        cond_const_atoms, carry_names, cond_name)
+
+    live = [n for n in carry_names if n is not None]
+    _emit_while_op(em,
+                   [n for n in cond_const_names + body_const_names
+                    if n is not None] + live,
+                   cond_name, live, sub)
+    for v, nm in zip(eqn.outvars, carry_names):
+        if nm is None:
+            em.poison[v] = _poison_msg(em, v) or (
+                "jaxpr export: RNG state flows out of a serialized "
+                "loop — export with the layer in eval() mode")
+        else:
+            em.bind(v, nm)
+
+
+def _scan_prim(em, eqn):
+    """lax.scan -> counter `while` + TensorArrays: xs rows read via
+    `gather` at the loop index, per-step ys written with
+    `write_to_array`, stacked by `tensor_array_to_tensor` after the
+    loop.  The trip bound is a `less_than(i, length)` against an outer
+    fill_constant, which is also how the interp (and the reference's
+    LoDTensorArray sizing) statically infer TensorArray capacity."""
+    p = eqn.params
+    nc, ncar = p["num_consts"], p["num_carry"]
+    length, reverse = int(p["length"]), bool(p["reverse"])
+    closed = p["jaxpr"]
+    consts = eqn.invars[:nc]
+    carry_in = eqn.invars[nc:nc + ncar]
+    xs = eqn.invars[nc + ncar:]
+    ys_outs = eqn.outvars[ncar:]
+
+    const_names = _resolve_atoms(em, consts)
+    xs_names = _resolve_atoms(em, xs)
+    carry_names = _init_carries(em, carry_in, "scanvar")
+
+    i64 = jax.ShapeDtypeStruct((1,), np.int64)
+    i_name = em.fresh("scan_i")
+    em.declare(i_name, i64)
+    em.emit("fill_constant", {}, {"Out": i_name},
+            {"shape": [1], "dtype": proto.np_dtype_to_vartype(np.dtype(np.int64)),
+             "value": 0.0})
+    t_name = em.fresh("scan_n")
+    em.declare(t_name, i64)
+    em.emit("fill_constant", {}, {"Out": t_name},
+            {"shape": [1], "dtype": proto.np_dtype_to_vartype(np.dtype(np.int64)),
+             "value": float(length)})
+    cond_name = em.fresh("scan_cond")
+    em.declare(cond_name, jax.ShapeDtypeStruct((1,), np.bool_))
+    em.emit("less_than", {"X": i_name, "Y": t_name}, {"Out": cond_name},
+            {})
+
+    ta_names = []
+    for v in ys_outs:
+        ta = em.fresh("ys_ta")
+        em.block.create_var(ta, type=proto.VarType.LOD_TENSOR_ARRAY)
+        ta_names.append(ta)
+
+    body_invars = closed.jaxpr.invars
+    sub = em.program.create_block(parent_idx=em.block.idx)
+    with em.in_block(sub):
+        step_idx = i_name
+        if reverse:
+            # write/read position runs from the far end so ys stay in
+            # source order (lax.scan reverse semantics)
+            tm1 = em.fresh("scan_nm1")
+            em.declare(tm1, i64)
+            em.emit("fill_constant", {}, {"Out": tm1},
+                    {"shape": [1],
+                     "dtype": proto.np_dtype_to_vartype(np.dtype(np.int64)),
+                     "value": float(length - 1)})
+            rev = em.fresh("scan_rev_i")
+            em.declare(rev, i64)
+            em.emit("elementwise_sub", {"X": tm1, "Y": i_name},
+                    {"Out": rev}, {"axis": -1})
+            step_idx = rev
+        xt_names = []
+        for j, xsn in enumerate(xs_names):
+            if xsn is None:
+                xt_names.append(None)
+                continue
+            elem = body_invars[nc + ncar + j].aval
+            g = em.fresh("xt_row")
+            em.declare(g, jax.ShapeDtypeStruct((1,) + tuple(elem.shape),
+                                               elem.dtype))
+            em.emit("gather", {"X": xsn, "Index": step_idx},
+                    {"Out": g}, {})
+            r = em.fresh("xt")
+            em.declare(r, elem)
+            em.emit("reshape2", {"X": g}, {"Out": r},
+                    {"shape": [int(s) for s in elem.shape]})
+            xt_names.append(r)
+        bjx = _walk_closed(
+            em, closed, const_names + carry_names + xt_names,
+            const_atoms={i: a for i, a in enumerate(consts)})
+        for ta, yv in zip(ta_names, bjx.outvars[ncar:]):
+            em.emit("write_to_array",
+                    {"X": em.literal_or_var(yv), "I": step_idx},
+                    {"Out": ta}, {})
+        _assign_carries(em, bjx.outvars[:ncar], carry_names)
+        em.emit("increment", {"X": i_name}, {"Out": i_name},
+                {"step": 1.0})
+        em.emit("less_than", {"X": i_name, "Y": t_name},
+                {"Out": cond_name}, {})
+
+    live = [n for n in carry_names if n is not None]
+    _emit_while_op(em,
+                   [n for n in const_names + xs_names if n is not None]
+                   + live + [i_name, t_name],
+                   cond_name, live + [i_name] + ta_names, sub)
+    for v, nm in zip(eqn.outvars[:ncar], carry_names):
+        if nm is None:
+            em.poison[v] = _poison_msg(em, v) or (
+                "jaxpr export: RNG state flows out of a serialized "
+                "loop — export with the layer in eval() mode")
+        else:
+            em.bind(v, nm)
+    for v, ta in zip(ys_outs, ta_names):
+        out = em.fresh("ys")
+        em.declare(out, v.aval)
+        ln = em.fresh("ys_len")
+        em.declare(ln, jax.ShapeDtypeStruct((1,), np.int32))
+        em.emit("tensor_array_to_tensor", {"X": ta},
+                {"Out": out, "OutIndex": ln},
+                {"axis": 0, "use_stack": True})
+        em.bind(v, out)
+
+
+def _cond_prim(em, eqn):
+    """lax.cond / lax.switch -> one `conditional_block` per branch
+    (scalar-condition mode, Cond = `equal(index, k)`) reconciled with
+    `select_input` on the branch index — the reference `layers.cond`
+    program shape (`conditional_block_op.cc:29`, `select_input_op.cc`)."""
+    branches = eqn.params["branches"]
+    idx_atom = eqn.invars[0]
+    operand_atoms = eqn.invars[1:]
+    iv = em.const_value(idx_atom)
+    operand_names = _resolve_atoms(em, operand_atoms)
+    const_atoms = {i: a for i, a in enumerate(operand_atoms)}
+    if iv is not None:
+        # statically-taken branch: inline it, no sub-blocks
+        k = int(np.clip(int(np.asarray(iv).reshape(())), 0,
+                        len(branches) - 1))
+        jx = _walk_closed(em, branches[k], operand_names,
+                          const_atoms=const_atoms)
+        for v, a in zip(eqn.outvars, jx.outvars):
+            em.bind(v, em.literal_or_var(a))
+        return
+
+    idx_name = em.literal_or_var(idx_atom)
+    ia = idx_atom.aval
+    if np.dtype(ia.dtype) != np.dtype(np.int32):
+        c = em.fresh("branch_idx")
+        em.declare(c, jax.ShapeDtypeStruct(ia.shape, np.int32))
+        em.emit("cast", {"X": idx_name}, {"Out": c},
+                {"in_dtype": proto.np_dtype_to_vartype(
+                    np.dtype(ia.dtype)),
+                 "out_dtype": proto.np_dtype_to_vartype(
+                     np.dtype(np.int32))})
+        idx_name = c
+
+    from .program import BlockRef
+
+    branch_outs: List[List[str]] = []
+    for k, br in enumerate(branches):
+        kconst = em.emit_constant(np.asarray([k], np.int32),
+                                  tag="branch_k")
+        mask = em.fresh("branch_mask")
+        em.declare(mask, jax.ShapeDtypeStruct((1,), np.bool_))
+        em.emit("equal", {"X": idx_name, "Y": kconst}, {"Out": mask},
+                {"axis": -1})
+        outs_k = []
+        for v in eqn.outvars:
+            nm = em.fresh("branch_out")
+            em.declare(nm, v.aval)
+            outs_k.append(nm)
+        sub = em.program.create_block(parent_idx=em.block.idx)
+        with em.in_block(sub):
+            jx = _walk_closed(em, br, operand_names,
+                              const_atoms=const_atoms)
+            for a, nm in zip(jx.outvars, outs_k):
+                em.emit("assign", {"X": em.literal_or_var(a)},
+                        {"Out": nm}, {})
+        scope_var = em.fresh("cond_scope")
+        em.block.create_var(scope_var, type=proto.VarType.STEP_SCOPES)
+        em.emit("conditional_block",
+                {"Cond": mask,
+                 "Input": [n for n in operand_names if n is not None]},
+                {"Out": outs_k, "Scope": scope_var},
+                {"sub_block": BlockRef(sub.idx),
+                 "is_scalar_condition": True})
+        branch_outs.append(outs_k)
+
+    for j, v in enumerate(eqn.outvars):
+        sel = em.fresh("branch_sel")
+        em.declare(sel, v.aval)
+        em.emit("select_input",
+                {"X": [branch_outs[k][j] for k in range(len(branches))],
+                 "Mask": idx_name},
+                {"Out": sel}, {})
+        em.bind(v, sel)
+
+
+def _paddle_rnn_prim(em, eqn):
+    """Export-marker primitive from `export_marker.py` (bound by
+    nn.LSTM/GRU/SimpleRNN during export tracing) -> the unified `rnn`
+    op (`operators/rnn_op.cc`), which is time-major: batch-major models
+    get transpose2 brackets, exactly as the reference python layer does
+    around its fused op call."""
+    p = eqn.params
+    mode = p["mode"]
+    lstm = mode == "LSTM"
+    x_atom, h0_atom, c0_atom = eqn.invars[:3]
+    weights = eqn.invars[3:]
+    xn = em.literal_or_var(x_atom)
+    xa = x_atom.aval
+    if not p["time_major"]:
+        t = em.fresh("rnn_tm")
+        em.declare(t, jax.ShapeDtypeStruct(
+            (xa.shape[1], xa.shape[0], xa.shape[2]), xa.dtype))
+        em.emit("transpose2", {"X": xn}, {"Out": t},
+                {"axis": [1, 0, 2]})
+        xn = t
+    pre = [em.literal_or_var(h0_atom)]
+    if lstm:
+        pre.append(em.literal_or_var(c0_atom))
+    wnames = [em.literal_or_var(w) for w in weights]
+    T, B = (xa.shape[0], xa.shape[1]) if p["time_major"] else \
+        (xa.shape[1], xa.shape[0])
+    nd = 2 if p["is_bidirec"] else 1
+    H = int(p["hidden_size"])
+    o = em.fresh("rnn_out")
+    em.declare(o, jax.ShapeDtypeStruct((T, B, H * nd), xa.dtype))
+    states = []
+    for _ in range(2 if lstm else 1):
+        s = em.fresh("rnn_state")
+        em.declare(s, eqn.outvars[1].aval)
+        states.append(s)
+    ds = em.fresh("rnn_dropout_state")
+    em.block.create_var(ds, type=proto.VarType.RAW)
+    rv = em.fresh("rnn_reserve")
+    em.declare(rv, jax.ShapeDtypeStruct((0,), np.float32))
+    em.emit("rnn",
+            {"Input": xn, "WeightList": wnames, "PreState": pre},
+            {"Out": o, "State": states, "DropoutState": ds,
+             "Reserve": rv},
+            {"mode": mode, "hidden_size": H,
+             "num_layers": int(p["num_layers"]),
+             "is_bidirec": bool(p["is_bidirec"]), "is_test": True,
+             "dropout_prob": float(p["dropout"]), "seed": 0})
+    if not p["time_major"]:
+        ob = em.fresh("rnn_out_bm")
+        em.declare(ob, eqn.outvars[0].aval)
+        em.emit("transpose2", {"X": o}, {"Out": ob},
+                {"axis": [1, 0, 2]})
+        o = ob
+    em.bind(eqn.outvars[0], o)
+    for v, nm in zip(eqn.outvars[1:], states):
+        em.bind(v, nm)
 
 
 _HANDLERS = {
@@ -638,6 +1503,17 @@ _HANDLERS = {
         {"axis": [int(d) for d in e.params["dimensions"]]}),
     "stop_gradient": lambda em, e: _unary(em, e, "assign"),
     "copy": lambda em, e: _unary(em, e, "assign"),
+
+    "split": _split_prim,
+    "dynamic_slice": _dynamic_slice,
+    "dynamic_update_slice": _dynamic_update_slice,
+    "scatter": lambda em, e: _scatter_prim(em, e, overwrite=True),
+    "scatter-add": lambda em, e: _scatter_prim(em, e, overwrite=False),
+
+    "while": _while_prim,
+    "scan": _scan_prim,
+    "cond": _cond_prim,
+    "paddle_rnn": _paddle_rnn_prim,
 }
 
 
@@ -647,7 +1523,8 @@ def _try_const_fold(em, eqn) -> bool:
     demand by var_of).  Keeps pad/clip attr resolution working when
     values route through convert/broadcast chains, and exports leaner
     programs."""
-    if eqn.primitive.name in ("pjit", "jit", "closed_call"):
+    if eqn.primitive.name in ("pjit", "jit", "closed_call",
+                              "paddle_rnn"):
         return False
     vals = [em.const_value(a) for a in eqn.invars]
     if any(v is None for v in vals):
@@ -664,16 +1541,65 @@ def _try_const_fold(em, eqn) -> bool:
     outs = out if isinstance(out, (tuple, list)) else (out,)
     if len(outs) != len(eqn.outvars):
         return False
+    import jax.dtypes as jdt
+
     for v, val in zip(eqn.outvars, outs):
         em.names.pop(v, None)  # cached-region var may be re-bound
+        dt = getattr(val, "dtype", None)
+        if dt is not None and jdt.issubdtype(dt, jdt.extended):
+            # a folded RNG key (random_wrap of const bits): poisoned,
+            # not materialized — dead in an eval-mode inference export
+            em.poison[v] = (
+                f"jaxpr export: value of extended dtype {dt} (PRNG "
+                "key / RNG state) feeds a serialized op — inference "
+                "programs cannot carry RNG state; export with the "
+                "layer in eval() mode")
+            continue
         em.known[v] = np.asarray(val)
     return True
 
 
 def _walk(em: _Emitter, jaxpr):
+    import jax.dtypes as jdt
+
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
         if _try_const_fold(em, eqn):
+            continue
+        # RNG plumbing traced by the jit path (key splits per call,
+        # key slicing/reshaping) is dead in an eval-mode export; poison
+        # rather than emit, so the refusal only fires if a real op's
+        # result actually depends on randomness (train-mode dropout)
+        rng_msg = None
+        if prim.startswith("random_") or prim == "threefry2x32":
+            rng_msg = (
+                f"jaxpr export: RNG primitive {prim!r} feeds a "
+                "serialized op — inference programs cannot carry RNG "
+                "state; export with the layer in eval() mode")
+        if rng_msg is None and prim not in (
+                # region prims handle poison per operand slot
+                "pjit", "jit", "closed_call", "while", "scan", "cond",
+                "custom_jvp_call", "custom_vjp_call", "remat",
+                "checkpoint"):
+            for a in eqn.invars:
+                rng_msg = _poison_msg(em, a)
+                if rng_msg:
+                    break
+            else:
+                for v in eqn.outvars:
+                    dt = getattr(v.aval, "dtype", None)
+                    if dt is not None and jdt.issubdtype(dt,
+                                                         jdt.extended):
+                        rng_msg = (
+                            f"jaxpr export: {prim!r} produces extended "
+                            f"dtype {dt} (RNG state) — inference "
+                            "programs cannot carry RNG state")
+                        break
+        if rng_msg is not None:
+            for v in eqn.outvars:
+                em.names.pop(v, None)
+                em.known.pop(v, None)
+                em.poison[v] = rng_msg
             continue
         if prim in ("pjit", "jit", "closed_call", "custom_jvp_call",
                     "custom_vjp_call", "custom_vjp_call_jaxpr",
@@ -683,22 +1609,21 @@ def _walk(em: _Emitter, jaxpr):
             closed = getattr(inner, "jaxpr", inner)
             consts = getattr(inner, "consts", [])
             for cv, cval in zip(closed.constvars, consts):
-                name = em.fresh("const")
-                arr = np.asarray(cval)
-                em.declare(name, jax.ShapeDtypeStruct(arr.shape,
-                                                      arr.dtype),
-                           persistable=True)
-                em.scope[name] = arr
-                em.bind(cv, name)
+                em.bind_const_value(cv, cval, "const")
             # NOTE: jax CACHES identical inner jaxprs, so the same Var
             # objects recur across different pjit eqns (two
             # structurally-equal embedding wraps share one jaxpr) — a
             # re-bind must clear the var's previous-region state or a
             # stale name wins over the new const (found via BERT's
             # token-type ids resolving to the word-ids chain)
+            from jax.extend.core import Literal as _Lit
+
             for outer, innerv in zip(eqn.invars, closed.invars):
                 em.names.pop(innerv, None)
                 em.known.pop(innerv, None)
+                if not isinstance(outer, _Lit) and outer in em.poison:
+                    em.poison[innerv] = em.poison[outer]
+                    continue
                 cv = em.const_value(outer)
                 if cv is not None:
                     # keep constants foldable across the jit boundary
@@ -709,6 +1634,10 @@ def _walk(em: _Emitter, jaxpr):
             from jax.extend.core import Literal
 
             for outer, innerv in zip(eqn.outvars, closed.outvars):
+                if not isinstance(innerv, Literal) and \
+                        innerv in em.poison:
+                    em.poison[outer] = em.poison[innerv]
+                    continue
                 cv = em.const_value(innerv)
                 # Literal outvars (inner region returns a constant) are
                 # unhashable — guard before any dict membership test
@@ -740,11 +1669,14 @@ def program_from_traced(fn, example_inputs: List, scope: Dict,
     from .program import Program
     from .proto import VarType
 
+    from .export_marker import export_trace_context
+
     specs = [jax.ShapeDtypeStruct(np.shape(x),
                                   np.asarray(x).dtype if not
                                   hasattr(x, "dtype") else x.dtype)
              for x in example_inputs]
-    closed = jax.make_jaxpr(fn)(*specs)
+    with export_trace_context():
+        closed = jax.make_jaxpr(fn)(*specs)
 
     program = Program()
     block = program.global_block()
@@ -754,12 +1686,7 @@ def program_from_traced(fn, example_inputs: List, scope: Dict,
     em = _Emitter(program, block, scope)
 
     for cv, cval in zip(closed.jaxpr.constvars, closed.consts):
-        arr = np.asarray(cval)
-        name = em.fresh("param")
-        em.declare(name, jax.ShapeDtypeStruct(arr.shape, arr.dtype),
-                   persistable=True)
-        scope[name] = arr
-        em.bind(cv, name)
+        em.bind_const_value(cv, cval, "param")
 
     names = input_names or [f"input_{i}" for i in range(len(specs))]
     for i, (v, spec, name) in enumerate(zip(closed.jaxpr.invars, specs,

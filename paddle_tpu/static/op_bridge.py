@@ -2294,27 +2294,52 @@ def _coalesce_tensor_op(op, scope, feeds, fetches):
     does.  Alignment padding (use_align/align_size) only moves offsets;
     tight packing is observably equivalent through the views and is
     what we emit."""
-    from .interp import FusedSlice
+    from .interp import FusedSlice, _current_blocks
     from .proto import vartype_to_np_dtype
 
     in_names = op.inputs("Input")
     out_names = op.outputs("Output")
     fused_name = op.output("FusedOutput")
     dtype = np.dtype(vartype_to_np_dtype(op.attr("dtype", 5)))
-    xs = [jnp.asarray(scope.fetch(n)).astype(dtype) for n in in_names]
-    if op.attr("set_constant", False):
-        const = float(op.attr("constant", 0.0))
-        flat = jnp.full((sum(x.size for x in xs),), const, dtype)
-    elif op.attr("copy_data", True):
-        flat = jnp.concatenate([jnp.ravel(x) for x in xs]) if xs else \
-            jnp.zeros((0,), dtype)
+    copy_data = op.attr("copy_data", True) and \
+        not op.attr("set_constant", False)
+
+    def shape_of(name):
+        # the fuse-grad-space layout coalesces BEFORE the backward ops
+        # first write the components — sizes then come from the block's
+        # static var descs, not from (absent) scope values
+        if name in scope:
+            return tuple(jnp.asarray(scope[name]).shape)
+        for blk in _current_blocks():
+            for v in blk.get("vars", []):
+                if v.get("name") == name:
+                    dims = (v.get("type", {}).get("lod_tensor", {})
+                            .get("tensor", {}).get("dims", []))
+                    if dims and all(int(d) >= 0 for d in dims):
+                        return tuple(int(d) for d in dims)
+        raise KeyError(
+            f"coalesce_tensor: component {name!r} has neither a scope "
+            "value nor a statically-shaped var desc to size the fused "
+            "buffer from")
+
+    shapes = [shape_of(n) for n in in_names]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    if copy_data:
+        flat = jnp.concatenate(
+            [jnp.ravel(jnp.asarray(scope.fetch(n))).astype(dtype)
+             for n in in_names]) if in_names else jnp.zeros((0,), dtype)
     else:
-        flat = jnp.zeros((sum(x.size for x in xs),), dtype)
+        const = float(op.attr("constant", 0.0)) \
+            if op.attr("set_constant", False) else 0.0
+        flat = jnp.full((sum(sizes),), const, dtype)
     scope[fused_name] = flat
     offset = 0
-    for out_name, x in zip(out_names, xs):
-        scope[out_name] = FusedSlice(fused_name, offset, x.shape)
-        offset += x.size
+    for out_name, shp, n in zip(out_names, shapes, sizes):
+        # plain dict write: establishing the view must not write-through
+        # into a previous aliasing of the same name
+        dict.__setitem__(scope, out_name,
+                         FusedSlice(fused_name, offset, shp))
+        offset += n
 
 
 # ---------------------------------------------------------------------------

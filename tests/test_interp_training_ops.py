@@ -676,6 +676,67 @@ class TestCollectiveOps:
         np.testing.assert_allclose(np.asarray(scope["a"]), a * 2)
         np.testing.assert_allclose(np.asarray(scope["c"]), c * 2)
 
+    def test_coalesce_component_writes_land_in_fused_buffer(self):
+        """The fuse-grad-space layout: coalesce_tensor(set_constant)
+        runs BEFORE the grad-producing ops, which then write the
+        component vars — the writes must land in the fused buffer
+        (reference sub-tensors share storage) so the later fused
+        allreduce reads live gradients, not the initial constant."""
+        from paddle_tpu.static.interp import Scope, run_block, \
+            blocks_context
+
+        g1 = np.arange(6, dtype=np.float32).reshape(2, 3)
+        g2 = np.arange(4, dtype=np.float32) + 100
+        desc = [
+            {"type": "coalesce_tensor",
+             "inputs": [{"parameter": "Input",
+                         "arguments": ["g1", "g2"]}],
+             "outputs": [
+                 {"parameter": "Output", "arguments": ["g1", "g2"]},
+                 {"parameter": "FusedOutput", "arguments": ["fused"]}],
+             "attrs": [_encode_attr("set_constant", True),
+                       _encode_attr("constant", 0.0),
+                       _encode_attr("dtype", 5)]},
+            # "backward": writes the component vars after coalescing
+            {"type": "scale",
+             "inputs": [{"parameter": "X", "arguments": ["src1"]}],
+             "outputs": [{"parameter": "Out", "arguments": ["g1"]}],
+             "attrs": [_encode_attr("scale", 1.0),
+                       _encode_attr("bias", 0.0),
+                       _encode_attr("bias_after_scale", True)]},
+            {"type": "scale",
+             "inputs": [{"parameter": "X", "arguments": ["src2"]}],
+             "outputs": [{"parameter": "Out", "arguments": ["g2"]}],
+             "attrs": [_encode_attr("scale", 1.0),
+                       _encode_attr("bias", 0.0),
+                       _encode_attr("bias_after_scale", True)]},
+            # fused "allreduce" stand-in reads the buffer
+            {"type": "scale",
+             "inputs": [{"parameter": "X", "arguments": ["fused"]}],
+             "outputs": [{"parameter": "Out", "arguments": ["fused"]}],
+             "attrs": [_encode_attr("scale", 2.0),
+                       _encode_attr("bias", 0.0),
+                       _encode_attr("bias_after_scale", True)]},
+        ]
+        # like a real program: g1/g2 have NO value yet when coalesce
+        # runs — their sizes come from the block var descs
+        def _vdesc(name, dims):
+            return {"name": name,
+                    "type": {"lod_tensor": {"tensor": {
+                        "data_type": 5, "dims": list(dims)}}}}
+
+        scope = Scope({"src1": jnp.asarray(g1),
+                       "src2": jnp.asarray(g2)})
+        with blocks_context([{"ops": desc,
+                              "vars": [_vdesc("g1", g1.shape),
+                                       _vdesc("g2", g2.shape)]}]):
+            run_block(desc, scope, {}, {})
+        np.testing.assert_allclose(
+            np.asarray(scope["fused"]),
+            np.concatenate([g1.ravel(), g2.ravel()]) * 2)
+        np.testing.assert_allclose(np.asarray(scope["g1"]), g1 * 2)
+        np.testing.assert_allclose(np.asarray(scope["g2"]), g2 * 2)
+
 
 class TestQuantFakeOps:
     def test_fake_quantize_abs_max(self):
