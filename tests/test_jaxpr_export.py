@@ -457,3 +457,50 @@ class TestRandomizedExportEquivalence:
         except AssertionError as e:
             raise AssertionError(
                 f"composition {choices} diverged") from e
+
+
+class TestRandomizedRecurrentExport:
+    """Round-5 sweep extension (round-4 verdict: the zoo was
+    straight-line only, so the scan/while refusals sat outside CI by
+    construction).  Randomly configured RNN stacks export through the
+    unified `rnn` op path and round-trip with eager parity."""
+
+    @pytest.mark.parametrize("seed", [5, 19, 42, 63])
+    def test_random_rnn_stacks(self, seed, tmp_path):
+        rng = np.random.RandomState(seed)
+        paddle.seed(seed)
+        mode = ["LSTM", "GRU", "SimpleRNN"][int(rng.randint(0, 3))]
+        layers = int(rng.randint(1, 3))
+        direction = ["forward", "bidirect"][int(rng.randint(0, 2))]
+        insz = int(rng.choice([4, 6]))
+        hid = int(rng.choice([5, 8]))
+        nd = 2 if direction == "bidirect" else 1
+        head = ["last", "mean"][int(rng.randint(0, 2))]
+
+        class RandRNN(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                cls = {"LSTM": nn.LSTM, "GRU": nn.GRU,
+                       "SimpleRNN": nn.SimpleRNN}[mode]
+                self.rnn = cls(insz, hid, num_layers=layers,
+                               direction=direction)
+                self.fc = nn.Linear(hid * nd, 3)
+
+            def forward(self, x):
+                out, _ = self.rnn(x)
+                h = out[:, -1] if head == "last" else \
+                    paddle.mean(out, axis=1)
+                return self.fc(h)
+
+        x = rng.rand(2, 6, insz).astype(np.float32) - 0.5
+        try:
+            prog = _roundtrip(RandRNN(),
+                              static.InputSpec([2, 6, insz],
+                                               "float32"), x,
+                              tmp_path, rtol=5e-4, atol=5e-5)
+        except AssertionError as e:
+            raise AssertionError(
+                f"rnn config ({mode}, layers={layers}, {direction}, "
+                f"head={head}) diverged") from e
+        types = [o["type"] for o in prog.desc["blocks"][0]["ops"]]
+        assert types.count("rnn") == 1
