@@ -1267,16 +1267,26 @@ b("prroi_pool", lambda x, rois, rois_num=None, spatial_scale=1.0,
         int(pooled_height), int(pooled_width), spatial_scale)),
   ins="X ROIs ?BatchRoINums",
   attrs="spatial_scale pooled_height pooled_width")
-b("deformable_conv deformable_conv_v1",
-  lambda x, offset, mask, w, strides=(1, 1), paddings=(0, 0),
-  dilations=(1, 1), groups=1, deformable_groups=1, im2col_step=1:
-    _unwrap(_vops().deform_conv2d(
+# deformable_conv (v2, modulated: Mask input) vs deformable_conv_v1
+# (no Mask in the maker — deformable_conv_v1_op.cc; absent optionals
+# keep positional alignment via the None append in _run_spec, so the
+# split is for maker-schema fidelity, caught by
+# tools/validate_bridge_specs.py).
+def _deform_conv(x, offset, w, mask=None, strides=(1, 1),
+                 paddings=(0, 0), dilations=(1, 1), groups=1,
+                 deformable_groups=1, im2col_step=1):
+    return _unwrap(_vops().deform_conv2d(
         x, offset, w, stride=[int(s) for s in strides],
         padding=[int(p) for p in paddings],
         dilation=[int(d) for d in dilations],
         deformable_groups=int(deformable_groups), groups=int(groups),
-        mask=mask)),
-  ins="Input Offset ?Mask Filter",
+        mask=mask))
+
+
+b("deformable_conv", _deform_conv, ins="Input Offset Filter ?Mask",
+  attrs="strides paddings dilations groups deformable_groups "
+        "im2col_step", outs="Output")
+b("deformable_conv_v1", _deform_conv, ins="Input Offset Filter",
   attrs="strides paddings dilations groups deformable_groups "
         "im2col_step", outs="Output")
 b("deformable_psroi_pooling",
@@ -1407,15 +1417,31 @@ b("box_decoder_and_assign", lambda pb, pbv, tb, bs, box_clip=4.135:
          box_clip=float(box_clip)),
   ins="PriorBox PriorBoxVar TargetBox BoxScore", attrs="box_clip",
   outs="DecodeBox OutputAssignBox")
-b("generate_proposals generate_proposals_v2",
-  lambda scores, deltas, im, anchors, var, pre_nms_topN=6000,
-  post_nms_topN=1000, nms_thresh=0.5, min_size=0.1, eta=1.0,
-  pixel_offset=True: _via(
-      _vops().generate_proposals, scores, deltas, im[..., :2], anchors,
-      var, pre_nms_top_n=int(pre_nms_topN),
-      post_nms_top_n=int(post_nms_topN), nms_thresh=nms_thresh,
-      min_size=min_size, eta=eta, pixel_offset=pixel_offset),
+# generate_proposals (v1: ImInfo [N,3] = H,W,scale, always offset) vs
+# generate_proposals_v2 (ImShape [N,2], pixel_offset attr) — the two
+# makers differ (generate_proposals_op.cc vs
+# detection/generate_proposals_v2_op.cc), caught by
+# tools/validate_bridge_specs.py
+def _gen_proposals(scores, deltas, im, anchors, var, pre_nms_topN=6000,
+                   post_nms_topN=1000, nms_thresh=0.5, min_size=0.1,
+                   eta=1.0, pixel_offset=True):
+    # im passes through unsliced: v1's ImInfo carries [H, W, scale] and
+    # the eager fn divides box sizes by the scale column during
+    # min-size filtering when present (reference bbox_util.h
+    # FilterBoxes is_scale=true); v2's ImShape is just [H, W]
+    return _via(
+        _vops().generate_proposals, scores, deltas, im,
+        anchors, var, pre_nms_top_n=int(pre_nms_topN),
+        post_nms_top_n=int(post_nms_topN), nms_thresh=nms_thresh,
+        min_size=min_size, eta=eta, pixel_offset=pixel_offset)
+
+
+b("generate_proposals", _gen_proposals,
   ins="Scores BboxDeltas ImInfo Anchors Variances",
+  attrs="pre_nms_topN post_nms_topN nms_thresh min_size eta",
+  outs="RpnRois RpnRoiProbs ?RpnRoisNum")
+b("generate_proposals_v2", _gen_proposals,
+  ins="Scores BboxDeltas ImShape Anchors Variances",
   attrs="pre_nms_topN post_nms_topN nms_thresh min_size eta "
         "pixel_offset",
   outs="RpnRois RpnRoiProbs ?RpnRoisNum")

@@ -24,6 +24,10 @@ build_native() {
 
 run_tests() {
   cd "$ROOT"
+  # total bridge-spec validation against the reference op makers
+  # (VERDICT round-4 item 3): a typo'd input/attr/output name in any
+  # declarative spec fails the build before the suite runs
+  python tools/validate_bridge_specs.py
   python -m pytest tests/ -x -q
 }
 

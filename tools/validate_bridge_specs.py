@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Total bridge-spec validation against the reference op makers.
+
+The declarative OpDesc->eager bridge (`paddle_tpu/static/op_bridge.py`)
+maps reference op input/attr/output *names* onto eager functions; a
+typo'd name silently falls back to the eager default — the exact
+failure class the round-4 parity sweep sampled (~133 of ~229 specs).
+This tool closes the gap TOTALLY and mechanically: it scrapes the
+`AddInput`/`AddOutput`/`AddAttr` strings from the reference op makers
+(`/root/reference/paddle/fluid/operators/**/*.cc|h`, the protos that
+define the interchange schema — `framework/op_proto_maker.h`), links
+maker classes to op types through the literal `REGISTER_OPERATOR` /
+`REGISTER_OP_WITHOUT_GRADIENT` sites, and asserts every bridged spec's
+names against the schema.
+
+Ops registered through expander macros (activation / elementwise /
+reduce families stamp one shared maker per op via FOR_EACH_* macros)
+have no literal register site to scrape; their shared makers are
+encoded here once, by hand, with the reference file cited.
+
+Exit non-zero on any violation.  Wired into tools/build_and_test.sh.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Set
+
+REF_OPS = "/root/reference/paddle/fluid/operators"
+
+# Attrs every operator owns via the proto maker / registry machinery
+# (op_proto_maker.cc Validate + common attrs), legal in any OpDesc.
+COMMON_ATTRS = {
+    "op_role", "op_role_var", "op_namescope", "op_callstack",
+    "op_device", "use_mkldnn", "use_cudnn", "is_test", "use_quantizer",
+    "mkldnn_data_type", "name", "with_quant_attr",
+}
+
+_CLASS_RE = re.compile(
+    r"class\s+(\w+)\s*(?:final\s*)?:\s*public\s+"
+    r"(?:framework::)?OpProtoAndCheckerMaker")
+# some makers define Make() out of line: `void XOpMaker::Make() {...}`
+_OUTLINE_MAKE_RE = re.compile(r"void\s+(\w+)::Make\(\)")
+_ADD_IN_RE = re.compile(r'AddInput\(\s*"([^"]+)"')
+_ADD_OUT_RE = re.compile(r'AddOutput\(\s*"([^"]+)"')
+# attr types nest templates (AddAttr<std::vector<int>>), so match up
+# to the opening paren, not the first '>'
+_ADD_ATTR_RE = re.compile(r'AddAttr<[^(]+>\(\s*"([^"]+)"')
+_DISPENSABLE_RE = re.compile(
+    r'Add(Input|Output)\(\s*"([^"]+)"[^;]*?AsDispensable', re.S)
+_REGISTER_RE = re.compile(
+    r"REGISTER_OPERATOR\(\s*\n?\s*(\w+)\s*,([^;]*?)\)\s*;", re.S)
+_REGISTER_NOGRAD_RE = re.compile(
+    r"REGISTER_OP_WITHOUT_GRADIENT\(\s*(\w+)\s*,([^;]*?)\)\s*;", re.S)
+
+
+def _class_bodies(text: str):
+    """(class_name, body_text) for each op-maker class — body ends at
+    the next maker class or EOF (string scraping, not a C++ parse)."""
+    hits = list(_CLASS_RE.finditer(text))
+    for i, m in enumerate(hits):
+        end = hits[i + 1].start() if i + 1 < len(hits) else len(text)
+        yield m.group(1), text[m.start():end]
+
+
+def scrape_reference() -> Dict[str, Dict[str, Set[str]]]:
+    """op type -> {inputs, outputs, attrs, required_inputs}."""
+    makers: Dict[str, Dict[str, Set[str]]] = {}
+    registrations: List[tuple] = []  # (op_type, register-arg text)
+    for root, _, files in os.walk(REF_OPS):
+        for fname in files:
+            if not fname.endswith((".cc", ".h", ".cu.cc")):
+                continue
+            path = os.path.join(root, fname)
+            try:
+                with open(path, errors="ignore") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            bodies = list(_class_bodies(text))
+            for m in _OUTLINE_MAKE_RE.finditer(text):
+                end = text.find("\n}", m.end())
+                bodies.append((m.group(1),
+                               text[m.start():end if end != -1
+                                    else len(text)]))
+            for cls, body in bodies:
+                disp = {m.group(2)
+                        for m in _DISPENSABLE_RE.finditer(body)}
+                ins = set(_ADD_IN_RE.findall(body))
+                entry = makers.setdefault(
+                    cls, {"inputs": set(), "outputs": set(),
+                          "attrs": set(), "required_inputs": set()})
+                entry["inputs"] |= ins
+                entry["outputs"] |= set(_ADD_OUT_RE.findall(body))
+                entry["attrs"] |= set(_ADD_ATTR_RE.findall(body))
+                entry["required_inputs"] |= ins - disp
+            for m in list(_REGISTER_RE.finditer(text)) + \
+                    list(_REGISTER_NOGRAD_RE.finditer(text)):
+                registrations.append((m.group(1), m.group(2)))
+
+    schema: Dict[str, Dict[str, Set[str]]] = {}
+    for op_type, args in registrations:
+        if op_type.endswith("_grad"):
+            continue
+        for cls in re.findall(r"[\w:]+", args):
+            cls = cls.split("::")[-1]
+            if cls in makers:
+                schema[op_type] = makers[cls]
+                break
+    return schema
+
+
+def _family(inputs, outputs, attrs, required=None):
+    return {"inputs": set(inputs), "outputs": set(outputs),
+            "attrs": set(attrs),
+            "required_inputs": set(required if required is not None
+                                   else inputs)}
+
+
+# Makers stamped by expander macros (no literal REGISTER_OPERATOR site).
+# Schemas transcribed from the shared maker the macro instantiates.
+MACRO_FAMILIES: Dict[str, Dict[str, Set[str]]] = {}
+
+
+def _add_macro_families():
+    # activation_op.cc ActivationOpMaker (FOR_EACH_ACTIVATION_OP):
+    # AddInput("X") AddOutput("Out"); per-op attrs added by specific
+    # makers below where they exist
+    act = "sigmoid logsigmoid exp relu tanh tanh_shrink sqrt rsqrt " \
+          "abs ceil floor cos sin sinh cosh round reciprocal log " \
+          "log2 log10 log1p square softsign silu".split()
+    for name in act:
+        MACRO_FAMILIES[name] = _family(["X"], ["Out"], [])
+    for name, extra in [("leaky_relu", ["alpha"]),
+                        ("softplus", ["beta", "threshold"]),
+                        ("elu", ["alpha"]),
+                        ("celu", ["alpha"]),
+                        ("hard_shrink", ["threshold"]),
+                        ("softshrink", ["lambda"]),
+                        ("thresholded_relu", ["threshold"]),
+                        ("hard_sigmoid", ["slope", "offset"]),
+                        ("swish", ["beta"]),
+                        ("relu6", ["threshold"]),
+                        ("brelu", ["t_min", "t_max"]),
+                        ("pow", ["factor"]),
+                        ("stanh", ["scale_a", "scale_b"]),
+                        ("hard_swish", ["threshold", "scale",
+                                        "offset"]),
+                        ("mish", ["threshold"])]:
+        MACRO_FAMILIES[name] = _family(["X"], ["Out"], extra)
+    # elementwise_op.h ElementwiseOpMaker (REGISTER_ELEMENTWISE_OP):
+    ew = "elementwise_add elementwise_sub elementwise_mul " \
+         "elementwise_div elementwise_max elementwise_min " \
+         "elementwise_mod elementwise_floordiv elementwise_pow".split()
+    for name in ew:
+        MACRO_FAMILIES[name] = _family(
+            ["X", "Y"], ["Out"],
+            ["axis", "x_data_format", "y_data_format", "act",
+             "Scale_x", "Scale_y", "Scale_out"])
+    # reduce_op.h ReduceOpMaker (REGISTER_REDUCE_OP):
+    red = "reduce_sum reduce_mean reduce_max reduce_min reduce_prod " \
+          "reduce_all reduce_any".split()
+    for name in red:
+        MACRO_FAMILIES[name] = _family(
+            ["X"], ["Out"],
+            ["dim", "keep_dim", "reduce_all", "in_dtype", "out_dtype"])
+    # cum_op.cc CumsumOpMaker is registered via REGISTER_OPERATOR but
+    # the class name check can miss using-decls; pin it explicitly
+    MACRO_FAMILIES.setdefault(
+        "cumsum", _family(["X"], ["Out"],
+                          ["axis", "flatten", "exclusive", "reverse"]))
+    # activation family stragglers stamped by the same FOR_EACH macro
+    MACRO_FAMILIES["expm1"] = _family(["X"], ["Out"], [])
+    # arg_min_max_base.h ArgMinMaxOpMaker (REGISTER_ARG_MINMAX_OP)
+    for name in ("arg_min", "arg_max"):
+        MACRO_FAMILIES[name] = _family(
+            ["X"], ["Out"],
+            ["axis", "keepdims", "flatten", "dtype"])
+    # reduce_op.h REGISTER_REDUCE_OP(frobenius_norm)
+    MACRO_FAMILIES["frobenius_norm"] = _family(
+        ["X"], ["Out"],
+        ["dim", "keep_dim", "reduce_all", "in_dtype", "out_dtype"])
+    # elementwise_op.h REGISTER_GRAD_ADD (grad_add = elementwise_add
+    # without the maker sugar)
+    MACRO_FAMILIES["grad_add"] = _family(["X", "Y"], ["Out"], ["axis"])
+    # isfinite_op.cc / isfinite_v2_op.cc REGISTER_V2OP_MAKER
+    for name in ("isfinite", "isinf", "isnan", "isfinite_v2",
+                 "isinf_v2", "isnan_v2"):
+        MACRO_FAMILIES[name] = _family(["X"], ["Out"], [])
+    # batch_size_like.h BatchSizeLikeOpMaker
+    MACRO_FAMILIES["fill_constant_batch_size_like"] = _family(
+        ["Input"], ["Out"],
+        ["shape", "input_dim_idx", "output_dim_idx", "dtype", "value",
+         "str_value", "force_cpu"])
+
+
+_add_macro_families()
+
+
+def validate(verbose=True, schema=None):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.static.op_bridge import BRIDGED
+
+    if schema is None:
+        schema = scrape_reference()
+    for k, v in MACRO_FAMILIES.items():
+        schema.setdefault(k, v)
+
+    violations: List[str] = []
+    validated, unscraped, raw = [], [], []
+    for op_type, spec in sorted(BRIDGED.items()):
+        if not hasattr(spec, "ins"):
+            # @braw hand-written translator: name usage is python code,
+            # covered by the explicit parity suites, not by this sweep
+            raw.append(op_type)
+            continue
+        sch = schema.get(op_type)
+        if sch is None:
+            unscraped.append(op_type)
+            continue
+        validated.append(op_type)
+        for name, _mode in spec.ins:
+            if name not in sch["inputs"]:
+                violations.append(
+                    f"{op_type}: spec input {name!r} not in maker "
+                    f"inputs {sorted(sch['inputs'])}")
+        for name, mode in spec.outs:
+            if name not in sch["outputs"]:
+                violations.append(
+                    f"{op_type}: spec output {name!r} not in maker "
+                    f"outputs {sorted(sch['outputs'])}")
+        for src, _kw, _conv in spec.attrs:
+            if src not in sch["attrs"] and src not in COMMON_ATTRS:
+                violations.append(
+                    f"{op_type}: spec attr {src!r} not in maker attrs "
+                    f"{sorted(sch['attrs'])}")
+        # required (non-dispensable) maker inputs must be mapped
+        mapped = {name for name, _ in spec.ins}
+        missing = sch["required_inputs"] - mapped
+        if missing:
+            violations.append(
+                f"{op_type}: required maker input(s) {sorted(missing)} "
+                "unmapped in spec")
+    if verbose:
+        print(f"bridge specs: {len(BRIDGED)} | schema-validated: "
+              f"{len(validated)} | raw translators: {len(raw)} | "
+              f"no scraped schema: {len(unscraped)}")
+        if unscraped:
+            print("unscraped:", " ".join(unscraped))
+    return violations, validated, unscraped
+
+
+def main():
+    if not os.path.isdir(REF_OPS):
+        # no reference checkout on this machine: nothing to validate
+        # against (the pytest counterpart skips the same way)
+        print(f"SKIP: reference tree {REF_OPS} not present")
+        return 0
+    violations, validated, unscraped = validate()
+    if violations:
+        print(f"FAIL: {len(violations)} spec/schema mismatches:")
+        for v in violations:
+            print(" -", v)
+        return 1
+    # the scraper itself is part of the contract: a regression that
+    # stops finding makers must fail loudly, not shrink coverage
+    if len(validated) < 150:
+        print(f"FAIL: only {len(validated)} specs schema-validated "
+              "(scraper regression?)")
+        return 1
+    if unscraped:
+        print(f"FAIL: {len(unscraped)} specs have no schema "
+              f"(scrape or encode their makers): {unscraped}")
+        return 1
+    print("OK: every declarative bridged spec matches the reference "
+          "maker schema")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
