@@ -149,6 +149,22 @@ PD_Predictor* PD_PredictorCreate(PD_Config* c) {
   return h;
 }
 
+PD_Predictor* PD_PredictorClone(PD_Predictor* p) {
+  if (!p || !p->predictor) {
+    set_error("PD_PredictorClone: null predictor");
+    return nullptr;
+  }
+  GIL gil;
+  PyObject* twin = PyObject_CallMethod(p->predictor, "clone", "");
+  if (!twin) {
+    fetch_py_error();
+    return nullptr;
+  }
+  auto* h = new PD_Predictor();
+  h->predictor = twin;
+  return h;
+}
+
 void PD_PredictorDestroy(PD_Predictor* p) {
   if (!p) return;
   GIL gil;
@@ -475,6 +491,19 @@ int PD_TensorCopyFromCpuUint8(PD_Tensor* t, const uint8_t* data) {
   return copy_from_cpu(t, data, "uint8", sizeof(uint8_t));
 }
 
+int PD_TensorCopyFromCpuInt8(PD_Tensor* t, const int8_t* data) {
+  return copy_from_cpu(t, data, "int8", sizeof(int8_t));
+}
+
+int PD_TensorCopyFromCpuFloat16(PD_Tensor* t, const uint16_t* data) {
+  // raw binary16 bits: numpy reinterprets the buffer as float16
+  return copy_from_cpu(t, data, "float16", sizeof(uint16_t));
+}
+
+int PD_TensorCopyFromCpuBool(PD_Tensor* t, const uint8_t* data) {
+  return copy_from_cpu(t, data, "bool", sizeof(uint8_t));
+}
+
 int PD_TensorCopyToCpuFloat(PD_Tensor* t, float* data) {
   return copy_to_cpu(t, data, "float32");
 }
@@ -489,6 +518,88 @@ int PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* data) {
 
 int PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* data) {
   return copy_to_cpu(t, data, "uint8");
+}
+
+int PD_TensorCopyToCpuInt8(PD_Tensor* t, int8_t* data) {
+  return copy_to_cpu(t, data, "int8");
+}
+
+int PD_TensorCopyToCpuFloat16(PD_Tensor* t, uint16_t* data) {
+  return copy_to_cpu(t, data, "float16");
+}
+
+int PD_TensorCopyToCpuBool(PD_Tensor* t, uint8_t* data) {
+  return copy_to_cpu(t, data, "bool");
+}
+
+int PD_TensorSetLod(PD_Tensor* t, const PD_TwoDimArraySize* lod) {
+  if (!t || !lod) {
+    set_error("PD_TensorSetLod: null arguments");
+    return -1;
+  }
+  GIL gil;
+  PyObject* levels = PyList_New(lod->size);
+  for (size_t i = 0; i < lod->size; ++i) {
+    const PD_OneDimArraySize* row = lod->data[i];
+    PyObject* level = PyList_New(row ? row->size : 0);
+    for (size_t j = 0; row && j < row->size; ++j) {
+      PyList_SET_ITEM(level, j,
+                      PyLong_FromSize_t(row->data[j]));
+    }
+    PyList_SET_ITEM(levels, i, level);
+  }
+  PyObject* res = PyObject_CallMethod(t->handle, "set_lod", "O", levels);
+  Py_DECREF(levels);
+  if (!res) {
+    fetch_py_error();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+PD_TwoDimArraySize* PD_TensorGetLod(PD_Tensor* t) {
+  if (!t) {
+    set_error("PD_TensorGetLod: null tensor");
+    return nullptr;
+  }
+  GIL gil;
+  PyObject* levels = PyObject_CallMethod(t->handle, "lod", "");
+  if (!levels) {
+    fetch_py_error();
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Size(levels);
+  auto* out = new PD_TwoDimArraySize();
+  out->size = static_cast<size_t>(n < 0 ? 0 : n);
+  out->data = out->size ? new PD_OneDimArraySize*[out->size] : nullptr;
+  for (size_t i = 0; i < out->size; ++i) {
+    PyObject* level = PySequence_GetItem(levels, i);  // new ref
+    Py_ssize_t m = level ? PySequence_Size(level) : 0;
+    auto* row = new PD_OneDimArraySize();
+    row->size = static_cast<size_t>(m < 0 ? 0 : m);
+    row->data = row->size ? new size_t[row->size] : nullptr;
+    for (size_t j = 0; j < row->size; ++j) {
+      PyObject* v = PySequence_GetItem(level, j);
+      row->data[j] = v ? static_cast<size_t>(PyLong_AsSize_t(v)) : 0;
+      Py_XDECREF(v);
+    }
+    Py_XDECREF(level);
+    out->data[i] = row;
+  }
+  Py_DECREF(levels);
+  if (PyErr_Occurred()) PyErr_Clear();
+  return out;
+}
+
+void PD_TwoDimArraySizeDestroy(PD_TwoDimArraySize* lod) {
+  if (!lod) return;
+  for (size_t i = 0; i < lod->size; ++i) {
+    if (lod->data[i]) delete[] lod->data[i]->data;
+    delete lod->data[i];
+  }
+  delete[] lod->data;
+  delete lod;
 }
 
 int PD_TensorGetShape(PD_Tensor* t, int* shape_out) {
@@ -533,6 +644,9 @@ PD_DataType PD_TensorGetDataType(PD_Tensor* t) {
     else if (s == "int32") out = PD_DATA_INT32;
     else if (s == "int64") out = PD_DATA_INT64;
     else if (s == "uint8") out = PD_DATA_UINT8;
+    else if (s == "float16") out = PD_DATA_FLOAT16;
+    else if (s == "bool") out = PD_DATA_BOOL;
+    else if (s == "int8") out = PD_DATA_INT8;
   }
   Py_XDECREF(name);
   Py_XDECREF(dtype);

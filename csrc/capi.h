@@ -23,7 +23,22 @@ typedef enum {
   PD_DATA_INT32 = 1,
   PD_DATA_INT64 = 2,
   PD_DATA_UINT8 = 3,
+  PD_DATA_FLOAT16 = 4,
+  PD_DATA_BOOL = 5,
+  PD_DATA_INT8 = 6,
 } PD_DataType;
+
+// LoD carrier (reference capi_exp/pd_common.h PD_OneDimArraySize /
+// PD_TwoDimArraySize): lod->data[level] is one offset row per level
+typedef struct PD_OneDimArraySize {
+  size_t size;
+  size_t* data;
+} PD_OneDimArraySize;
+
+typedef struct PD_TwoDimArraySize {
+  size_t size;
+  PD_OneDimArraySize** data;
+} PD_TwoDimArraySize;
 
 const char* PD_GetLastError();
 PD_Config* PD_ConfigCreate();
@@ -33,6 +48,10 @@ void PD_ConfigSetModel(PD_Config* c, const char* prog_file,
 void PD_ConfigSwitchIrOptim(PD_Config* c, int on);
 void PD_ConfigEnableMemoryOptim(PD_Config* c, int on);
 PD_Predictor* PD_PredictorCreate(PD_Config* c);
+// clone-per-thread concurrency model (reference
+// capi_exp/pd_predictor.h:52 PD_PredictorClone): shares the loaded
+// program + compiled executables, owns its input/output state
+PD_Predictor* PD_PredictorClone(PD_Predictor* p);
 void PD_PredictorDestroy(PD_Predictor* p);
 int PD_PredictorGetInputNum(PD_Predictor* p);
 int PD_PredictorRunFloat(PD_Predictor* p, const float* const* input_data,
@@ -60,10 +79,25 @@ int PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* data);
 int PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* data);
 int PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* data);
 int PD_TensorCopyFromCpuUint8(PD_Tensor* t, const uint8_t* data);
+int PD_TensorCopyFromCpuInt8(PD_Tensor* t, const int8_t* data);
+// fp16 buffers carry raw IEEE binary16 bits in uint16_t slots (C has
+// no half type; same convention as the reference's uint16_t plumbing)
+int PD_TensorCopyFromCpuFloat16(PD_Tensor* t, const uint16_t* data);
+// bools are one byte each (numpy bool layout)
+int PD_TensorCopyFromCpuBool(PD_Tensor* t, const uint8_t* data);
 int PD_TensorCopyToCpuFloat(PD_Tensor* t, float* data);
 int PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* data);
 int PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* data);
 int PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* data);
+int PD_TensorCopyToCpuInt8(PD_Tensor* t, int8_t* data);
+int PD_TensorCopyToCpuFloat16(PD_Tensor* t, uint16_t* data);
+int PD_TensorCopyToCpuBool(PD_Tensor* t, uint8_t* data);
+// LoD for sequence models (reference pd_tensor.h:261 PD_TensorSetLod /
+// PD_TensorGetLod); GetLod result is freed with
+// PD_TwoDimArraySizeDestroy
+int PD_TensorSetLod(PD_Tensor* t, const PD_TwoDimArraySize* lod);
+PD_TwoDimArraySize* PD_TensorGetLod(PD_Tensor* t);
+void PD_TwoDimArraySizeDestroy(PD_TwoDimArraySize* lod);
 // returns ndim (or -1); writes the dims into shape_out when non-NULL
 int PD_TensorGetShape(PD_Tensor* t, int* shape_out);
 // one-fetch variant: returns ndim (or -1) and writes up to max_dims

@@ -31,7 +31,20 @@ typedef enum {
   PD_DATA_INT32 = 1,
   PD_DATA_INT64 = 2,
   PD_DATA_UINT8 = 3,
+  PD_DATA_FLOAT16 = 4,
+  PD_DATA_BOOL = 5,
+  PD_DATA_INT8 = 6,
 } PD_DataType;
+
+typedef struct PD_OneDimArraySize {
+  size_t size;
+  size_t* data;
+} PD_OneDimArraySize;
+
+typedef struct PD_TwoDimArraySize {
+  size_t size;
+  PD_OneDimArraySize** data;
+} PD_TwoDimArraySize;
 
 const char* PD_GetLastError();
 PD_Config* PD_ConfigCreate();
@@ -41,6 +54,7 @@ void PD_ConfigSetModel(PD_Config* c, const char* prog_file,
 void PD_ConfigSwitchIrOptim(PD_Config* c, int on);
 void PD_ConfigEnableMemoryOptim(PD_Config* c, int on);
 PD_Predictor* PD_PredictorCreate(PD_Config* c);
+PD_Predictor* PD_PredictorClone(PD_Predictor* p);
 void PD_PredictorDestroy(PD_Predictor* p);
 int PD_PredictorGetInputNum(PD_Predictor* p);
 int PD_PredictorRunFloat(PD_Predictor* p, const float* const* input_data,
@@ -60,10 +74,19 @@ int PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* data);
 int PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* data);
 int PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* data);
 int PD_TensorCopyFromCpuUint8(PD_Tensor* t, const uint8_t* data);
+int PD_TensorCopyFromCpuInt8(PD_Tensor* t, const int8_t* data);
+int PD_TensorCopyFromCpuFloat16(PD_Tensor* t, const uint16_t* data);
+int PD_TensorCopyFromCpuBool(PD_Tensor* t, const uint8_t* data);
 int PD_TensorCopyToCpuFloat(PD_Tensor* t, float* data);
 int PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* data);
 int PD_TensorCopyToCpuInt32(PD_Tensor* t, int32_t* data);
 int PD_TensorCopyToCpuUint8(PD_Tensor* t, uint8_t* data);
+int PD_TensorCopyToCpuInt8(PD_Tensor* t, int8_t* data);
+int PD_TensorCopyToCpuFloat16(PD_Tensor* t, uint16_t* data);
+int PD_TensorCopyToCpuBool(PD_Tensor* t, uint8_t* data);
+int PD_TensorSetLod(PD_Tensor* t, const PD_TwoDimArraySize* lod);
+PD_TwoDimArraySize* PD_TensorGetLod(PD_Tensor* t);
+void PD_TwoDimArraySizeDestroy(PD_TwoDimArraySize* lod);
 int PD_TensorGetShape(PD_Tensor* t, int* shape_out);
 int PD_TensorGetShapeDims(PD_Tensor* t, int* dims_out, int max_dims);
 PD_DataType PD_TensorGetDataType(PD_Tensor* t);
@@ -135,6 +158,20 @@ func NewPredictor(cfg *Config) (*Predictor, error) {
 	pred := &Predictor{p: h}
 	runtime.SetFinalizer(pred, (*Predictor).Destroy)
 	return pred, nil
+}
+
+// Clone shares the loaded program and compiled executables but owns
+// its input/output state — the clone-per-thread concurrency model
+// (reference pd_predictor.h:52 PD_PredictorClone).
+func (pred *Predictor) Clone() (*Predictor, error) {
+	h := C.PD_PredictorClone(pred.p)
+	runtime.KeepAlive(pred)
+	if h == nil {
+		return nil, lastError()
+	}
+	twin := &Predictor{p: h}
+	runtime.SetFinalizer(twin, (*Predictor).Destroy)
+	return twin, nil
 }
 
 // InputNum reports the number of feed targets.
@@ -274,6 +311,9 @@ const (
 	Int32   DataType = 1
 	Int64   DataType = 2
 	Uint8   DataType = 3
+	Float16 DataType = 4
+	Bool    DataType = 5
+	Int8    DataType = 6
 )
 
 // InputName returns the feed target name at idx (reference
@@ -468,6 +508,154 @@ func (t *Tensor) CopyToCpuUint8(dst []uint8) error {
 		return lastError()
 	}
 	return nil
+}
+
+// CopyFromCpuInt8 feeds int8 data of the Reshape()d shape.
+func (t *Tensor) CopyFromCpuInt8(data []int8) error {
+	rc := C.PD_TensorCopyFromCpuInt8(t.t,
+		(*C.int8_t)(unsafe.Pointer(&data[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// CopyFromCpuFloat16 feeds raw IEEE binary16 bits (one uint16 per
+// element) of the Reshape()d shape.
+func (t *Tensor) CopyFromCpuFloat16(data []uint16) error {
+	rc := C.PD_TensorCopyFromCpuFloat16(t.t,
+		(*C.uint16_t)(unsafe.Pointer(&data[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// CopyFromCpuBool feeds one-byte bools of the Reshape()d shape.
+func (t *Tensor) CopyFromCpuBool(data []bool) error {
+	buf := make([]uint8, len(data))
+	for i, v := range data {
+		if v {
+			buf[i] = 1
+		}
+	}
+	rc := C.PD_TensorCopyFromCpuBool(t.t,
+		(*C.uint8_t)(unsafe.Pointer(&buf[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// CopyToCpuInt8 copies the tensor out as int8.
+func (t *Tensor) CopyToCpuInt8(dst []int8) error {
+	rc := C.PD_TensorCopyToCpuInt8(t.t,
+		(*C.int8_t)(unsafe.Pointer(&dst[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// CopyToCpuFloat16 copies the tensor out as raw binary16 bits.
+func (t *Tensor) CopyToCpuFloat16(dst []uint16) error {
+	rc := C.PD_TensorCopyToCpuFloat16(t.t,
+		(*C.uint16_t)(unsafe.Pointer(&dst[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// CopyToCpuBool copies the tensor out as bools.
+func (t *Tensor) CopyToCpuBool(dst []bool) error {
+	buf := make([]uint8, len(dst))
+	rc := C.PD_TensorCopyToCpuBool(t.t,
+		(*C.uint8_t)(unsafe.Pointer(&buf[0])))
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	for i, v := range buf {
+		dst[i] = v != 0
+	}
+	return nil
+}
+
+// SetLod declares the input's LoD as offset rows per level (reference
+// pd_tensor.h:261 PD_TensorSetLod).  All nested structures live in
+// C.malloc'd memory — a Go-allocated pointer array would violate
+// cgo's no-Go-pointer-to-Go-pointer rule (same approach as Run's
+// input marshalling above).
+func (t *Tensor) SetLod(lod [][]uint) error {
+	n := len(lod)
+	var c C.PD_TwoDimArraySize
+	c.size = C.size_t(n)
+	freeList := make([]unsafe.Pointer, 0, 2*n+1)
+	defer func() {
+		for _, p := range freeList {
+			C.free(p)
+		}
+	}()
+	if n > 0 {
+		rowArr := C.malloc(C.size_t(uintptr(n) *
+			unsafe.Sizeof(uintptr(0))))
+		freeList = append(freeList, rowArr)
+		rows := unsafe.Slice((**C.PD_OneDimArraySize)(rowArr), n)
+		for i, level := range lod {
+			row := (*C.PD_OneDimArraySize)(C.malloc(
+				C.size_t(unsafe.Sizeof(C.PD_OneDimArraySize{}))))
+			freeList = append(freeList, unsafe.Pointer(row))
+			row.size = C.size_t(len(level))
+			row.data = nil
+			if len(level) > 0 {
+				buf := C.malloc(C.size_t(uintptr(len(level)) *
+					unsafe.Sizeof(C.size_t(0))))
+				freeList = append(freeList, buf)
+				vals := unsafe.Slice((*C.size_t)(buf), len(level))
+				for j, v := range level {
+					vals[j] = C.size_t(v)
+				}
+				row.data = (*C.size_t)(buf)
+			}
+			rows[i] = row
+		}
+		c.data = (**C.PD_OneDimArraySize)(rowArr)
+	}
+	rc := C.PD_TensorSetLod(t.t, &c)
+	runtime.KeepAlive(t)
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// Lod reads the tensor's LoD back as offset rows per level (reference
+// PD_TensorGetLod).
+func (t *Tensor) Lod() ([][]uint, error) {
+	got := C.PD_TensorGetLod(t.t)
+	runtime.KeepAlive(t)
+	if got == nil {
+		return nil, lastError()
+	}
+	defer C.PD_TwoDimArraySizeDestroy(got)
+	n := int(got.size)
+	out := make([][]uint, n)
+	rows := unsafe.Slice(got.data, n)
+	for i := 0; i < n; i++ {
+		m := int(rows[i].size)
+		out[i] = make([]uint, m)
+		vals := unsafe.Slice(rows[i].data, m)
+		for j := 0; j < m; j++ {
+			out[i][j] = uint(vals[j])
+		}
+	}
+	return out, nil
 }
 
 // RunFromHandles executes the program from the values previously copied

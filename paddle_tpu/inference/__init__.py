@@ -142,26 +142,64 @@ class Tensor:
 
     def set_lod(self, lod):
         """reference ZeroCopyTensor::SetLoD.  Accepts the reference's
-        offset-based level-0 LoD ([[0, l1, l1+l2, ...]]) or a flat
-        per-sequence lengths list; stored as the padded+lengths sidecar
-        the lod_* interchange ops consume."""
+        offset-based LoD (1 or 2 levels — `framework/lod_tensor.h:109`)
+        or a flat per-sequence lengths list.  The INNERMOST level
+        becomes the padded+lengths sidecar the lod_* interchange ops
+        consume — faithful to the reference sequence kernels, which
+        read `lod[lod_level - 1]` (e.g. `math/sequence_pooling.cc:70`);
+        the outer level of a 2-level LoD is kept for lod() round-trip.
+        Deeper nesting has no consumer in the interchange op set and
+        refuses explicitly."""
         if not self._is_input:
             raise RuntimeError("set_lod on an output handle")
         lod = list(lod)
         if lod and isinstance(lod[0], (list, tuple, np.ndarray)):
-            if len(lod) != 1:
+            if len(lod) > 2:
                 raise NotImplementedError(
-                    "only 1-level LoD is supported by the padded+lengths "
-                    f"redesign; got {len(lod)} levels")
-            off = np.asarray(lod[0], np.int64)
-            if off.size < 2 or off[0] != 0 or (np.diff(off) < 0).any():
+                    "LoD deeper than 2 levels is not supported by the "
+                    f"padded+lengths redesign; got {len(lod)} levels "
+                    "(see PARITY.md 'Multi-level LoD')")
+
+            def offsets(level):
+                off = np.asarray(level, np.int64)
+                if off.size < 2 or off[0] != 0 or \
+                        (np.diff(off) < 0).any():
+                    raise ValueError(
+                        "offset LoD must start at 0 and be "
+                        f"non-decreasing (got {off.tolist()})")
+                return off
+
+            levels = [offsets(lv) for lv in lod]
+            if len(levels) == 2 and \
+                    levels[0][-1] != len(levels[1]) - 1:
                 raise ValueError(
-                    "offset LoD must start at 0 and be non-decreasing "
-                    f"(got {off.tolist()})")
-            lengths = np.diff(off)
+                    "2-level LoD mismatch: outer level ends at "
+                    f"{levels[0][-1]} but the inner level describes "
+                    f"{len(levels[1]) - 1} sequences")
+            lengths = np.diff(levels[-1])
+            self._owner._outer_lods[self._name] = \
+                [lv.tolist() for lv in levels[:-1]]
         else:
             lengths = np.asarray(lod, np.int64)
+            self._owner._outer_lods.pop(self._name, None)
         self._owner._lods[self._name] = lengths.astype(np.int32)
+
+    def lod(self):
+        """reference ZeroCopyTensor::lod: offset-based levels.  Input
+        handles echo what set_lod stored; output handles report the
+        lengths sidecar the program produced for that fetch target."""
+        if self._is_input:
+            lengths = self._owner._lods.get(self._name)
+            if lengths is None:
+                return []
+            outer = self._owner._outer_lods.get(self._name, [])
+            off = np.concatenate([[0], np.cumsum(lengths)]).tolist()
+            return [list(lv) for lv in outer] + [off]
+        lengths = self._owner._output_lods.get(self._name)
+        if lengths is None:
+            return []
+        off = np.concatenate([[0], np.cumsum(np.asarray(lengths))])
+        return [[int(v) for v in off]]
 
     def copy_to_cpu(self) -> np.ndarray:
         if self._is_input:
@@ -195,7 +233,9 @@ class Predictor:
         self._config = config
         self._inputs: Dict[str, np.ndarray] = {}
         self._lods: Dict[str, np.ndarray] = {}
+        self._outer_lods: Dict[str, list] = {}
         self._outputs: Dict[str, np.ndarray] = {}
+        self._output_lods: Dict[str, np.ndarray] = {}
         self._output_names: List[str] = []
         prefix = config._model_prefix or ""
         # sniff the artifact: a reference-era .pdmodel parses as a
@@ -261,10 +301,12 @@ class Predictor:
         Either pass `inputs` positionally or pre-fill via input handles."""
         if inputs is None:
             inputs = [self._inputs[n] for n in self._input_names]
+        out_lods = None
         if self._runner is not None:
             if self._lods:
-                outs = self._runner.run_with_lods(
-                    [np.asarray(i) for i in inputs], self._lods)
+                outs, out_lods = self._runner.run_with_lods(
+                    [np.asarray(i) for i in inputs], self._lods,
+                    return_lods=True)
             else:
                 outs = self._runner(*[np.asarray(i) for i in inputs])
         else:
@@ -279,10 +321,33 @@ class Predictor:
             n: np.asarray(o.numpy() if hasattr(o, "numpy") else o)
             for n, o in zip(self._output_names, outs)
         }
+        self._output_lods = {}
+        if out_lods is not None:
+            for n, lv in zip(self._output_names, out_lods):
+                if lv is not None:
+                    self._output_lods[n] = np.asarray(lv)
         return [self._outputs[n] for n in self._output_names]
 
     def clone(self):
-        return Predictor(self._config)
+        """reference AnalysisPredictor::Clone
+        (`inference/capi_exp/pd_predictor.h:52` — the documented
+        one-predictor-per-thread concurrency model): the clone SHARES
+        the loaded program, weights, and compiled-executable cache (no
+        reload, no recompile) but owns its input/output/LoD state, so
+        each thread runs through its own clone without racing another's
+        feeds."""
+        twin = object.__new__(Predictor)
+        twin._config = self._config
+        twin._runner = self._runner
+        twin._layer = self._layer
+        twin._input_names = list(self._input_names)
+        twin._output_names = list(self._output_names)
+        twin._inputs = {}
+        twin._lods = {}
+        twin._outer_lods = {}
+        twin._outputs = {}
+        twin._output_lods = {}
+        return twin
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -422,9 +422,11 @@ class ProgramRunner:
         outs, _ = self._jit(self.params, feeds)
         return outs
 
-    def run_with_lods(self, inputs, lods):
+    def run_with_lods(self, inputs, lods, return_lods=False):
         """Run with per-feed sequence lengths (`<name>@LOD` sidecars,
-        the padded+lengths LoD redesign — Predictor handle set_lod)."""
+        the padded+lengths LoD redesign — Predictor handle set_lod).
+        With ``return_lods``, also return each fetch target's output
+        lengths sidecar (for ZeroCopyTensor::lod on output handles)."""
         feeds = dict(zip(self.feed_names, (jnp.asarray(i) for i in inputs)))
         for name, lengths in lods.items():
             lengths = jnp.asarray(lengths)
@@ -433,8 +435,11 @@ class ProgramRunner:
                     f"set_lod for {name!r}: {lengths.shape[0]} sequence "
                     f"lengths for a batch of {feeds[name].shape[0]} rows")
             feeds[name + "@LOD"] = lengths
-        outs, _ = self._jit(self.params, feeds)
-        return outs
+        outs, scope = self._jit(self.params, feeds)
+        if not return_lods:
+            return outs
+        out_lods = [scope.get(fn + "@LOD") for fn in self.fetch_names]
+        return outs, out_lods
 
     def run_with_scope(self, feeds, params=None):
         """`params` overrides the construction-time parameter values, so

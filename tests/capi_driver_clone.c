@@ -1,0 +1,114 @@
+/* Two-thread clone-per-thread C driver (reference
+ * capi_exp/pd_predictor.h:52 PD_PredictorClone concurrency model):
+ * each thread serves its own clone of one loaded predictor —
+ * concurrent requests with per-clone input/output state, shared
+ * program + compiled executables (GIL-serialized execution is the
+ * documented model; the API contract is what is exercised).
+ * Usage: capi_driver_clone <model_prefix.pdmodel> <N> <D>
+ * Thread k feeds an N x D ramp scaled by (k+1); prints both outputs. */
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../csrc/capi.h"
+
+typedef struct {
+  PD_Predictor* pred;
+  int n, d, scale;
+  int rc;
+  float* out;     /* filled by the thread (numel_out floats) */
+  int out_numel;
+} Job;
+
+static void* serve(void* arg) {
+  Job* job = (Job*)arg;
+  job->rc = 1;
+  const char* in_name = PD_PredictorGetInputName(job->pred, 0);
+  if (!in_name) return NULL;
+  PD_Tensor* in = PD_PredictorGetInputHandle(job->pred, in_name);
+  float* x = (float*)malloc(sizeof(float) * job->n * job->d);
+  for (int i = 0; i < job->n * job->d; ++i) {
+    x[i] = (float)(i * job->scale) / (float)(job->n * job->d);
+  }
+  int32_t shape[2];
+  shape[0] = job->n;
+  shape[1] = job->d;
+  if (PD_TensorReshape(in, 2, shape) != 0 ||
+      PD_TensorCopyFromCpuFloat(in, x) != 0) {
+    free(x);
+    return NULL;
+  }
+  free(x);
+  if (PD_PredictorRun(job->pred) != 0) return NULL;
+  const char* out_name = PD_PredictorGetOutputName(job->pred, 0);
+  PD_Tensor* out = PD_PredictorGetOutputHandle(job->pred, out_name);
+  int dims[8];
+  int ndim = PD_TensorGetShapeDims(out, dims, 8);
+  if (ndim < 0) return NULL;
+  int numel = 1;
+  for (int i = 0; i < ndim; ++i) numel *= dims[i];
+  job->out = (float*)malloc(sizeof(float) * numel);
+  job->out_numel = numel;
+  if (PD_TensorCopyToCpuFloat(out, job->out) != 0) return NULL;
+  PD_TensorDestroy(out);
+  PD_TensorDestroy(in);
+  job->rc = 0;
+  return NULL;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s model.pdmodel N D\n", argv[0]);
+    return 2;
+  }
+  int n = atoi(argv[2]), d = atoi(argv[3]);
+
+  PD_Config* cfg = PD_ConfigCreate();
+  PD_ConfigSetModel(cfg, argv[1], "");
+  PD_Predictor* base = PD_PredictorCreate(cfg);
+  if (!base) {
+    fprintf(stderr, "create failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  PD_Predictor* c1 = PD_PredictorClone(base);
+  PD_Predictor* c2 = PD_PredictorClone(base);
+  if (!c1 || !c2) {
+    fprintf(stderr, "clone failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  printf("clones=2\n");
+
+  Job jobs[2];
+  jobs[0].pred = c1;
+  jobs[1].pred = c2;
+  for (int k = 0; k < 2; ++k) {
+    jobs[k].n = n;
+    jobs[k].d = d;
+    jobs[k].scale = k + 1;
+    jobs[k].out = NULL;
+    jobs[k].out_numel = 0;
+  }
+  pthread_t th[2];
+  for (int k = 0; k < 2; ++k) {
+    pthread_create(&th[k], NULL, serve, &jobs[k]);
+  }
+  for (int k = 0; k < 2; ++k) pthread_join(th[k], NULL);
+  for (int k = 0; k < 2; ++k) {
+    if (jobs[k].rc != 0) {
+      fprintf(stderr, "thread %d failed: %s\n", k, PD_GetLastError());
+      return 1;
+    }
+    printf("out%d =", k);
+    for (int i = 0; i < jobs[k].out_numel; ++i) {
+      printf(" %.6f", jobs[k].out[i]);
+    }
+    printf("\n");
+    free(jobs[k].out);
+  }
+  PD_PredictorDestroy(c1);
+  PD_PredictorDestroy(c2);
+  PD_PredictorDestroy(base);
+  PD_ConfigDestroy(cfg);
+  return 0;
+}

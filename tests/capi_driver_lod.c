@@ -1,0 +1,117 @@
+/* LoD-bearing sequence model served through C (reference
+ * capi_exp/pd_tensor.h:261 PD_TensorSetLod / PD_TensorGetLod): the
+ * per-sequence lengths enter through SetLod (offset format), flow
+ * through the sequence kernels as the padded+lengths sidecar, and the
+ * lod-preserving fetch reports its offsets back through GetLod.
+ * Usage: capi_driver_lod <model_prefix.pdmodel> <B> <T> <D>
+ * Feeds a B x T x D ramp with lengths T, T-1, ...; prints the pooled
+ * output values and the echoed output LoD. */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../csrc/capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s model.pdmodel B T D\n", argv[0]);
+    return 2;
+  }
+  int b = atoi(argv[2]), t = atoi(argv[3]), d = atoi(argv[4]);
+
+  PD_Config* cfg = PD_ConfigCreate();
+  PD_ConfigSetModel(cfg, argv[1], "");
+  PD_Predictor* pred = PD_PredictorCreate(cfg);
+  if (!pred) {
+    fprintf(stderr, "create failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  const char* in_name = PD_PredictorGetInputName(pred, 0);
+  PD_Tensor* in = PD_PredictorGetInputHandle(pred, in_name);
+
+  float* x = (float*)malloc(sizeof(float) * b * t * d);
+  for (int i = 0; i < b * t * d; ++i) {
+    x[i] = (float)i / (float)(b * t * d);
+  }
+  int32_t shape[3];
+  shape[0] = b;
+  shape[1] = t;
+  shape[2] = d;
+  if (PD_TensorReshape(in, 3, shape) != 0 ||
+      PD_TensorCopyFromCpuFloat(in, x) != 0) {
+    fprintf(stderr, "copy_from failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  free(x);
+
+  /* offsets [0, l1, l1+l2, ...] with lengths T, T-1, ... (min 1) */
+  size_t* offs = (size_t*)malloc(sizeof(size_t) * (b + 1));
+  offs[0] = 0;
+  for (int i = 0; i < b; ++i) {
+    int len = t - i > 1 ? t - i : 1;
+    offs[i + 1] = offs[i] + (size_t)len;
+  }
+  PD_OneDimArraySize row;
+  row.size = (size_t)(b + 1);
+  row.data = offs;
+  PD_OneDimArraySize* rows[1];
+  rows[0] = &row;
+  PD_TwoDimArraySize lod;
+  lod.size = 1;
+  lod.data = rows;
+  if (PD_TensorSetLod(in, &lod) != 0) {
+    fprintf(stderr, "set_lod failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  free(offs);
+
+  if (PD_PredictorRun(pred) != 0) {
+    fprintf(stderr, "run failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+
+  /* output 0: sequence_pool result (values depend on the lengths) */
+  const char* pool_name = PD_PredictorGetOutputName(pred, 0);
+  PD_Tensor* pool = PD_PredictorGetOutputHandle(pred, pool_name);
+  int dims[8];
+  int ndim = PD_TensorGetShapeDims(pool, dims, 8);
+  if (ndim < 0) {
+    fprintf(stderr, "shape failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  int numel = 1;
+  for (int i = 0; i < ndim; ++i) numel *= dims[i];
+  float* out = (float*)malloc(sizeof(float) * numel);
+  if (PD_TensorCopyToCpuFloat(pool, out) != 0) {
+    fprintf(stderr, "copy_to failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  printf("pool =");
+  for (int i = 0; i < numel; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  free(out);
+
+  /* output 1: lod-preserving branch — GetLod echoes the offsets */
+  const char* seq_name = PD_PredictorGetOutputName(pred, 1);
+  PD_Tensor* seq = PD_PredictorGetOutputHandle(pred, seq_name);
+  PD_TwoDimArraySize* got = PD_TensorGetLod(seq);
+  if (!got) {
+    fprintf(stderr, "get_lod failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  printf("lod levels=%zu:", got->size);
+  for (size_t i = 0; i < got->size; ++i) {
+    for (size_t j = 0; j < got->data[i]->size; ++j) {
+      printf(" %zu", got->data[i]->data[j]);
+    }
+  }
+  printf("\n");
+  PD_TwoDimArraySizeDestroy(got);
+
+  PD_TensorDestroy(seq);
+  PD_TensorDestroy(pool);
+  PD_TensorDestroy(in);
+  PD_PredictorDestroy(pred);
+  PD_ConfigDestroy(cfg);
+  return 0;
+}

