@@ -579,16 +579,25 @@ PD_TwoDimArraySize* PD_TensorGetLod(PD_Tensor* t) {
     auto* row = new PD_OneDimArraySize();
     row->size = static_cast<size_t>(m < 0 ? 0 : m);
     row->data = row->size ? new size_t[row->size] : nullptr;
+    out->data[i] = row;
     for (size_t j = 0; j < row->size; ++j) {
       PyObject* v = PySequence_GetItem(level, j);
-      row->data[j] = v ? static_cast<size_t>(PyLong_AsSize_t(v)) : 0;
+      size_t off = v ? PyLong_AsSize_t(v) : static_cast<size_t>(-1);
       Py_XDECREF(v);
+      if (PyErr_Occurred()) {
+        // a non-integer offset must FAIL, not ship SIZE_MAX into the
+        // caller's sequence handling
+        fetch_py_error();
+        Py_XDECREF(level);
+        Py_DECREF(levels);
+        PD_TwoDimArraySizeDestroy(out);
+        return nullptr;
+      }
+      row->data[j] = off;
     }
     Py_XDECREF(level);
-    out->data[i] = row;
   }
   Py_DECREF(levels);
-  if (PyErr_Occurred()) PyErr_Clear();
   return out;
 }
 

@@ -179,6 +179,12 @@ class Tensor:
             lengths = np.diff(levels[-1])
             self._owner._outer_lods[self._name] = \
                 [lv.tolist() for lv in levels[:-1]]
+        elif not lod:
+            # reference semantics: an empty LoD clears the tensor's
+            # sequence structure entirely
+            self._owner._lods.pop(self._name, None)
+            self._owner._outer_lods.pop(self._name, None)
+            return
         else:
             lengths = np.asarray(lod, np.int64)
             self._owner._outer_lods.pop(self._name, None)
@@ -186,8 +192,12 @@ class Tensor:
 
     def lod(self):
         """reference ZeroCopyTensor::lod: offset-based levels.  Input
-        handles echo what set_lod stored; output handles report the
-        lengths sidecar the program produced for that fetch target."""
+        handles echo what set_lod stored (all levels); output handles
+        report the lengths sidecar the program produced for that fetch
+        target — the INNERMOST level only, since that is what the
+        padded+lengths sidecar carries through ops (outer grouping
+        levels of a 2-level input are input-side metadata; see
+        PARITY.md 'Multi-level LoD')."""
         if self._is_input:
             lengths = self._owner._lods.get(self._name)
             if lengths is None:

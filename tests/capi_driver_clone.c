@@ -17,15 +17,26 @@ typedef struct {
   PD_Predictor* pred;
   int n, d, scale;
   int rc;
+  char err[512];  /* snapshot of the worker's thread-local error */
   float* out;     /* filled by the thread (numel_out floats) */
   int out_numel;
 } Job;
 
+static void snap_err(Job* job) {
+  /* g_last_error is thread_local: read it on THIS thread or the main
+   * thread's join-time read sees an empty string */
+  snprintf(job->err, sizeof(job->err), "%s", PD_GetLastError());
+}
+
 static void* serve(void* arg) {
   Job* job = (Job*)arg;
   job->rc = 1;
+  job->err[0] = '\0';
   const char* in_name = PD_PredictorGetInputName(job->pred, 0);
-  if (!in_name) return NULL;
+  if (!in_name) {
+    snap_err(job);
+    return NULL;
+  }
   PD_Tensor* in = PD_PredictorGetInputHandle(job->pred, in_name);
   float* x = (float*)malloc(sizeof(float) * job->n * job->d);
   for (int i = 0; i < job->n * job->d; ++i) {
@@ -36,21 +47,31 @@ static void* serve(void* arg) {
   shape[1] = job->d;
   if (PD_TensorReshape(in, 2, shape) != 0 ||
       PD_TensorCopyFromCpuFloat(in, x) != 0) {
+    snap_err(job);
     free(x);
     return NULL;
   }
   free(x);
-  if (PD_PredictorRun(job->pred) != 0) return NULL;
+  if (PD_PredictorRun(job->pred) != 0) {
+    snap_err(job);
+    return NULL;
+  }
   const char* out_name = PD_PredictorGetOutputName(job->pred, 0);
   PD_Tensor* out = PD_PredictorGetOutputHandle(job->pred, out_name);
   int dims[8];
   int ndim = PD_TensorGetShapeDims(out, dims, 8);
-  if (ndim < 0) return NULL;
+  if (ndim < 0) {
+    snap_err(job);
+    return NULL;
+  }
   int numel = 1;
   for (int i = 0; i < ndim; ++i) numel *= dims[i];
   job->out = (float*)malloc(sizeof(float) * numel);
   job->out_numel = numel;
-  if (PD_TensorCopyToCpuFloat(out, job->out) != 0) return NULL;
+  if (PD_TensorCopyToCpuFloat(out, job->out) != 0) {
+    snap_err(job);
+    return NULL;
+  }
   PD_TensorDestroy(out);
   PD_TensorDestroy(in);
   job->rc = 0;
@@ -96,7 +117,7 @@ int main(int argc, char** argv) {
   for (int k = 0; k < 2; ++k) pthread_join(th[k], NULL);
   for (int k = 0; k < 2; ++k) {
     if (jobs[k].rc != 0) {
-      fprintf(stderr, "thread %d failed: %s\n", k, PD_GetLastError());
+      fprintf(stderr, "thread %d failed: %s\n", k, jobs[k].err);
       return 1;
     }
     printf("out%d =", k);

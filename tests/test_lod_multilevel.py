@@ -81,6 +81,22 @@ class TestTwoLevelLod:
                            match="2 levels.*3 levels|3 levels"):
             h.set_lod([[0, 1], [0, 2], [0, 2, 5]])
 
+    def test_empty_lod_clears(self, tmp_path):
+        """set_lod([]) removes the sequence structure (reference
+        semantics) — the next run must take the plain no-LoD path."""
+        prefix = _seq_pool_model(tmp_path)
+        b, t, d = 2, 3, 2
+        x = (np.arange(b * t * d, dtype=np.float32)).reshape(b, t, d)
+        pred, h, _ = _predict(prefix, x, [[0, 2, 3]])
+        h.set_lod([])
+        assert h.lod() == []
+        pred.run()
+        out = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        # full-length pooling now (no lengths sidecar)
+        np.testing.assert_allclose(np.asarray(out), x.mean(axis=1),
+                                   rtol=1e-6)
+
     def test_mismatched_levels_rejected(self, tmp_path):
         prefix = _seq_pool_model(tmp_path)
         pred = create_predictor(Config(prefix + ".pdmodel",
