@@ -572,7 +572,9 @@ PD_TwoDimArraySize* PD_TensorGetLod(PD_Tensor* t) {
   Py_ssize_t n = PySequence_Size(levels);
   auto* out = new PD_TwoDimArraySize();
   out->size = static_cast<size_t>(n < 0 ? 0 : n);
-  out->data = out->size ? new PD_OneDimArraySize*[out->size] : nullptr;
+  // value-initialized (trailing ()): the error path may Destroy a
+  // partially-filled array, which must see nulls, not garbage
+  out->data = out->size ? new PD_OneDimArraySize*[out->size]() : nullptr;
   for (size_t i = 0; i < out->size; ++i) {
     PyObject* level = PySequence_GetItem(levels, i);  // new ref
     Py_ssize_t m = level ? PySequence_Size(level) : 0;

@@ -13,13 +13,35 @@ Asserts, from the compiled HLO (the test_schedule_accounting pattern):
   * every mesh axis participates in some collective (no axis silently
     unused by the composition).
 """
+import os
 import re
 import sys
 
-import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 16)
+def run_as_subprocess(timeout=900):
+    """Invoke this runner in a fresh process with the 16-device CPU
+    backend env — the ONE invocation shared by tests/test_dryrun16.py
+    and the __graft_entry__ dryrun leg.  Returns the CompletedProcess;
+    callers assert returncode/stdout."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        capture_output=True, text=True, env=env, timeout=timeout)
+
+
+import jax  # noqa: E402
+
+if __name__ == "__main__":
+    # only the subprocess owns its backend; an IMPORT of this module
+    # (for run_as_subprocess) must not touch the host's jax config
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 16)
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402

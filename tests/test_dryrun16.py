@@ -4,22 +4,11 @@ inside the composition, asserted from compiled HLO.  Runs in a
 subprocess because the 16-device CPU backend must be configured before
 jax initializes (this test session runs on the 8-device conftest
 mesh)."""
-import os
-import subprocess
-import sys
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+import dryrun16_runner
 
 
 def test_16_device_4d_leg_with_dp_grad_reduction():
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
-    r = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tests",
-                                      "dryrun16_runner.py")],
-        capture_output=True, text=True, env=env, timeout=900)
+    r = dryrun16_runner.run_as_subprocess()
     assert r.returncode == 0, r.stderr + r.stdout
     assert "DRYRUN16 OK" in r.stdout
     assert "dp_spanning_allreduce=4" in r.stdout

@@ -69,7 +69,8 @@ def main():
 
         best = None
         for bq, bk in ((256, 256), (512, 256), (256, 512), (512, 512),
-                       (128, 256), (256, 128)):
+                       (128, 256), (256, 128), (1024, 512), (512, 1024),
+                       (1024, 1024), (1024, 256)):
             if S % bq or S % bk:
                 continue
             pl_attn = lambda q, k, v: fa._flash_diff(q, k, v, causal, None,
@@ -110,6 +111,26 @@ def main():
     with open(os.path.join(root, "BENCH_kernels.json"), "w") as f:
         json.dump(out, f, indent=1)
     print("wrote BENCH_kernels.json")
+
+    # commit the measured winners as the production block cache
+    # (round-5 VERDICT #6): flash_attention_fwd consults this before
+    # its divisibility default, so the flagship and the op gate run on
+    # tuned blocks without re-measuring.  MERGE with existing entries —
+    # other dtype/shape sweeps must survive a re-run of this one.
+    entries = {}
+    try:
+        with open(fa._AUTOTUNE_FILE) as f:
+            entries.update(json.load(f).get("entries", {}))
+    except (OSError, ValueError):
+        pass
+    for S, (bq, bk) in best_blocks.items():
+        entries[fa._autotune_key(S, S, D, jnp.bfloat16, causal)] = \
+            [bq, bk]
+    with open(fa._AUTOTUNE_FILE, "w") as f:
+        json.dump({"device": str(jax.devices()[0]),
+                   "objective": "fwd+bwd train step (this bench)",
+                   "entries": entries}, f, indent=1)
+    print(f"wrote {fa._AUTOTUNE_FILE} ({len(entries)} entries)")
 
 
 if __name__ == "__main__":
