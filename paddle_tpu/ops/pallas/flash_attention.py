@@ -586,7 +586,7 @@ def _load_autotune():
 
         with open(_AUTOTUNE_FILE) as f:
             _AUTOTUNE.update(json.load(f).get("entries", {}))
-    except (OSError, ValueError, AttributeError):
+    except (OSError, ValueError, AttributeError, TypeError):
         # a missing/truncated/corrupt cache must degrade to the
         # divisibility default, never crash the attention hot path
         pass

@@ -65,6 +65,10 @@ class _Emitter:
         # jitted eval-mode forward: dead unless an op actually consumes
         # them, in which case this carries the refusal message)
         self.poison: Dict[object, str] = {}
+        # vars produced by an unfolded iota eqn -> its dimension (lets
+        # the sort handler recognize the argsort index payload without
+        # materializing it)
+        self.iota_axes: Dict[object, int] = {}
 
     def bind_const_value(self, cv, cval, tag, persistable=True):
         """Bind a closed-over constant.  Extended-dtype values (PRNG
@@ -620,6 +624,7 @@ def _iota(em, eqn):
     # reduce to this for a serialized inference program)
     aval = eqn.outvars[0].aval
     dim = int(eqn.params["dimension"])
+    em.iota_axes[eqn.outvars[0]] = dim
     arr = np.asarray(np.broadcast_to(
         np.arange(aval.shape[dim],
                   dtype=np.dtype(aval.dtype)).reshape(
@@ -727,6 +732,70 @@ def _erfc(em, eqn):
 
 def _rsqrt(em, eqn):
     _unary(em, eqn, "rsqrt")
+
+
+def _sort_prim(em, eqn):
+    """lax.sort -> reference `argsort` op (`operators/argsort_op.cc`,
+    which emits BOTH the sorted values and the indices).  jnp.sort is
+    the 1-operand form; jnp.argsort arrives as (x, iota) with
+    num_keys=1 — the iota operand IS the index payload, so the op's
+    Indices output binds to it."""
+    p = eqn.params
+    if int(p.get("num_keys", 1)) != 1:
+        raise NotImplementedError(
+            "jaxpr export: multi-key lax.sort has no argsort form")
+    axis = int(p["dimension"])
+    x = eqn.invars[0]
+    va = x.aval
+    payload_is_iota = False
+    if len(eqn.invars) == 2:
+        pay = eqn.invars[1]
+        pv = em.const_value(pay)
+        if pv is not None:
+            # the jnp.argsort iota usually const-folds: verify it IS
+            # the axis iota, not an arbitrary sort_key_val payload
+            expect = np.broadcast_to(
+                np.arange(va.shape[axis]).reshape(
+                    [-1 if i == axis else 1
+                     for i in range(len(va.shape))]),
+                va.shape)
+            payload_is_iota = (
+                np.issubdtype(np.asarray(pv).dtype, np.integer)
+                and np.array_equal(np.asarray(pv), expect))
+        else:
+            from jax.extend.core import Literal
+
+            payload_is_iota = (not isinstance(pay, Literal)
+                               and em.iota_axes.get(pay) == axis)
+    if len(eqn.invars) > 2 or (len(eqn.invars) == 2
+                               and not payload_is_iota):
+        raise NotImplementedError(
+            "jaxpr export: lax.sort with a non-index payload (only "
+            "jnp.sort / jnp.argsort map to the argsort op)")
+    out_v = em.fresh("sort_v")
+    out_i = em.fresh("sort_i")
+    em.declare(out_v, va)
+    em.declare(out_i, jax.ShapeDtypeStruct(va.shape, np.int64))
+    em.emit("argsort", {"X": em.literal_or_var(x)},
+            {"Out": out_v, "Indices": out_i},
+            {"axis": axis, "descending": False})
+    if payload_is_iota:
+        # argsort's Indices are int64; the traced indices dtype may be
+        # int32 — cast to match the jaxpr contract
+        idx_var = eqn.outvars[1]
+        want = np.dtype(idx_var.aval.dtype)
+        if want != np.dtype(np.int64):
+            c = em.fresh("sort_ic")
+            em.declare(c, idx_var.aval)
+            em.emit("cast", {"X": out_i}, {"Out": c},
+                    {"in_dtype": proto.np_dtype_to_vartype(
+                        np.dtype(np.int64)),
+                     "out_dtype": proto.np_dtype_to_vartype(want)})
+            out_i = c
+        em.bind(eqn.outvars[0], out_v)
+        em.bind(idx_var, out_i)
+    else:
+        em.bind(eqn.outvars[0], out_v)
 
 
 def _split_prim(em, eqn):
@@ -1505,6 +1574,7 @@ _HANDLERS = {
     "copy": lambda em, e: _unary(em, e, "assign"),
 
     "split": _split_prim,
+    "sort": _sort_prim,
     "dynamic_slice": _dynamic_slice,
     "dynamic_update_slice": _dynamic_update_slice,
     "scatter": lambda em, e: _scatter_prim(em, e, overwrite=True),

@@ -301,6 +301,23 @@ class TestMechanicalStragglers:
         _roundtrip_fn(f, [np.random.RandomState(16)
                           .rand(3, 4).astype(np.float32)])
 
+    def test_sort_and_argsort(self):
+        """jnp.sort / jnp.argsort -> the reference argsort op (both
+        outputs); a sort_key_val with a real (non-iota) payload
+        refuses."""
+        def f(v):
+            return jnp.sort(v, axis=-1), jnp.argsort(v, axis=-1)
+
+        x = np.random.RandomState(17).rand(3, 7).astype(np.float32)
+        prog = _roundtrip_fn(f, [x])
+        assert "argsort" in _block_types(prog, 0)
+
+        def bad(v):
+            return lax.sort_key_val(v, v * 2)[1]
+
+        with pytest.raises(NotImplementedError, match="payload"):
+            program_from_traced(bad, [x], {})
+
     def test_interior_pad_still_refuses(self):
         def f(x):
             return lax.pad(x, 0.0, [(0, 0, 1), (0, 0, 0)])

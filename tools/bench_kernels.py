@@ -124,8 +124,9 @@ def main():
     except (OSError, ValueError):
         pass
     for S, (bq, bk) in best_blocks.items():
-        entries[fa._autotune_key(S, S, D, jnp.bfloat16, causal)] = \
-            [bq, bk]
+        # key with the SWEEP's dtype: key and measurement must never
+        # diverge if the sweep dtype changes
+        entries[fa._autotune_key(S, S, D, dtype, causal)] = [bq, bk]
     with open(fa._AUTOTUNE_FILE, "w") as f:
         json.dump({"device": str(jax.devices()[0]),
                    "objective": "fwd+bwd train step (this bench)",
