@@ -538,13 +538,32 @@ int PD_TensorSetLod(PD_Tensor* t, const PD_TwoDimArraySize* lod) {
     return -1;
   }
   GIL gil;
+  // every allocation is checked: on failure, drop the partially built
+  // lists (list dealloc tolerates NULL slots from PyList_New) and report
+  // through the same error channel as the other tensor entry points,
+  // instead of letting PyList_SET_ITEM dereference NULL
   PyObject* levels = PyList_New(lod->size);
+  if (!levels) {
+    fetch_py_error();
+    return -1;
+  }
   for (size_t i = 0; i < lod->size; ++i) {
     const PD_OneDimArraySize* row = lod->data[i];
     PyObject* level = PyList_New(row ? row->size : 0);
+    if (!level) {
+      fetch_py_error();
+      Py_DECREF(levels);
+      return -1;
+    }
     for (size_t j = 0; row && j < row->size; ++j) {
-      PyList_SET_ITEM(level, j,
-                      PyLong_FromSize_t(row->data[j]));
+      PyObject* v = PyLong_FromSize_t(row->data[j]);
+      if (!v) {
+        fetch_py_error();
+        Py_DECREF(level);
+        Py_DECREF(levels);
+        return -1;
+      }
+      PyList_SET_ITEM(level, j, v);
     }
     PyList_SET_ITEM(levels, i, level);
   }

@@ -37,9 +37,10 @@ if (_os.environ.get("PADDLE_MASTER") or
         _warnings.warn(f"paddle_tpu multi-controller bootstrap skipped: {_e}")
 
 from .core import (CPUPlace, CUDAPlace, Place, Tensor, TPUPlace, XPUPlace,
-                   bfloat16, bool_, complex64, complex128, float16, float32,
-                   float64, get_default_dtype, get_device, get_flags, int8,
-                   int16, int32, int64, is_compiled_with_tpu, seed,
+                   bfloat16, bool_, clear_dispatch_cache, complex64,
+                   complex128, dispatch_stats, float16, float32, float64,
+                   get_default_dtype, get_device, get_flags, int8, int16,
+                   int32, int64, is_compiled_with_tpu, seed,
                    set_default_dtype, set_device, set_flags, to_tensor, uint8)
 from .ops import *  # noqa: F401,F403 — functional tensor API
 from . import ops

@@ -10,6 +10,7 @@ OperatorBase/OpRegistry per-kernel dispatch.
 from .dtype import (bfloat16, bool_, complex64, complex128, float16, float32,
                     float64, get_default_dtype, int8, int16, int32, int64,
                     set_default_dtype, uint8)
+from .dispatch import clear_dispatch_cache, dispatch_stats
 from .flags import get_flags, set_flags
 from .place import (CPUPlace, CUDAPlace, Place, TPUPlace, XPUPlace, get_device,
                     is_compiled_with_tpu, set_device)

@@ -12,6 +12,16 @@ from typing import Any, Dict, Iterable
 
 _REGISTRY: Dict[str, Any] = {}
 
+# invalidation hooks: traced-executable caches bake flag values read at
+# trace time (e.g. FLAGS_use_pallas_layernorm inside a dispatched op), so
+# a flag change must drop them or set_flags would be silently ignored for
+# already-cached signatures
+_ON_CHANGE = []
+
+
+def on_flags_changed(callback):
+    _ON_CHANGE.append(callback)
+
 
 def define_flag(name: str, default, help_str: str = ""):
     env = os.environ.get(f"FLAGS_{name}")
@@ -29,11 +39,17 @@ def define_flag(name: str, default, help_str: str = ""):
 
 
 def set_flags(flags: Dict[str, Any]):
+    changed = False
     for k, v in flags.items():
         k = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
         if k not in _REGISTRY:
             raise KeyError(f"unknown flag {k!r}")
+        if _REGISTRY[k] != v:
+            changed = True
         _REGISTRY[k] = v
+    if changed:
+        for cb in _ON_CHANGE:
+            cb()
 
 
 def get_flags(names) -> Dict[str, Any]:
@@ -57,7 +73,17 @@ define_flag("check_nan_inf", False,
             "sync per op, serializing the device)")
 define_flag("benchmark", False, "sync + log after every eager op")
 define_flag("deterministic", False, "force deterministic reductions")
-define_flag("eager_jit_ops", True, "allow per-op jit caching in eager mode")
+define_flag("eager_jit_ops", True,
+            "enable the signature-keyed eager dispatch cache (jitted "
+            "fwd/vjp executables memoized per op signature; off = legacy "
+            "per-call tracing)")
+define_flag("eager_cache_size", 4096,
+            "LRU bound on memoized dispatch executables (<=0 = unbounded); "
+            "shape-polymorphic loops should also call "
+            "clear_dispatch_cache() between phases")
+define_flag("eager_dispatch_report", False,
+            "print the per-op dispatch telemetry table (calls, cache "
+            "hits/misses, retraces, wall time) at interpreter exit")
 define_flag("amp_dtype", "bfloat16", "autocast compute dtype (TPU: bfloat16)")
 define_flag("allocator_strategy", "pjrt", "memory is managed by PJRT")
 define_flag("log_level", 0, "VLOG-style verbosity")

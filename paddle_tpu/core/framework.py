@@ -167,3 +167,11 @@ def get_rng_key():
 # AMP state accessors (used by core.dispatch autocast and paddle_tpu.amp)
 def amp_state():
     return _state
+
+
+def amp_sig():
+    """(enabled, compute_dtype) pair for dispatch cache keying: the
+    autocast white/black-list pass is folded into the cached traced
+    computation (core/dispatch.py), so the AMP state must be part of the
+    executable cache key rather than a per-call Python pass."""
+    return _state.amp_enabled, _state.amp_dtype

@@ -146,7 +146,12 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             cot[t._uid] = cot.get(t._uid, 0) + g
         if not retain_graph:
             node.released = True
+            # drop both callables: the vjp (cached path: a _CachedVjp
+            # pinning the call's operand arrays) and the primal closure
+            # (which pins the same arrays for double-grad replay) — a
+            # released node must not keep activations alive
             node.vjp_fn = None
+            node.primal_fn = None
 
     # deposit grads once per distinct tensor (GradientAccumulator role)
     seen = set()

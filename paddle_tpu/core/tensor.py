@@ -216,6 +216,13 @@ class Tensor:
         from .dispatch import dispatch
 
         if isinstance(idx, Tensor):
+            if jnp.issubdtype(idx._array.dtype, jnp.integer):
+                # integer gather: feed the index as a (nondiff) operand so
+                # the lookup hits the dispatch executable cache — closing
+                # over the live array would bypass it on every call.  Bool
+                # masks stay closed over (data-dependent output shape
+                # cannot be jitted and must run eagerly).
+                return dispatch(lambda a, i: a[i], self, idx, nondiff=(1,))
             idx = idx._array
         elif isinstance(idx, tuple):
             idx = tuple(i._array if isinstance(i, Tensor) else i for i in idx)

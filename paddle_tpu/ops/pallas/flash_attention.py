@@ -595,15 +595,23 @@ def _load_autotune():
 
 def cached_blocks(seq_q, seq_k, d, dtype, causal):
     """Measured (block_q, block_k) for this shape, or None.  A stale
-    or malformed entry (no longer tiling the sequences, wrong arity)
-    is ignored."""
+    or malformed entry (wrong arity, sub-tile block, no longer tiling
+    the sequences) is ignored — cached values must survive the same
+    minimum-tile/shrink rules `pick_blocks` enforces before they reach
+    the Pallas kernel, degrading to the default rather than failing the
+    hot path on a hand-edited or stale cache file (ADVICE round 5)."""
     ent = _load_autotune().get(
         _autotune_key(seq_q, seq_k, d, dtype, causal))
     try:
         bq, bk = int(ent[0]), int(ent[1])
     except (TypeError, ValueError, IndexError, KeyError):
         return None
-    if seq_q % bq or seq_k % bk:
+    if bq < 128 or bk < 128:
+        # below the kernel's minimum tile (pick_blocks' shrink floor)
+        return None
+    if pick_blocks(seq_q, seq_k, bq, bk) != (bq, bk):
+        # pick_blocks would have shrunk or rejected these blocks — the
+        # entry no longer tiles this shape; fall back to the default
         return None
     return bq, bk
 

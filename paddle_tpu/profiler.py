@@ -21,11 +21,20 @@ from collections import defaultdict
 
 from .core import native
 from .core.native import RecordEvent, now_ns  # re-export  # noqa: F401
+# Eager dispatch telemetry (core/dispatch.py): per-op call/hit/miss/
+# retrace counters + wall time for the signature-keyed executable cache —
+# the dispatch-level complement of the host-event tables below.
+from .core.dispatch import (  # noqa: F401
+    clear_dispatch_cache, dispatch_cache_size, dispatch_stats,
+    dispatch_summary_string, reset_dispatch_stats,
+)
 
 __all__ = [
     "RecordEvent", "profiler", "start_profiler", "stop_profiler",
     "reset_profiler", "start_trace", "stop_trace", "trace",
     "summary_string", "export_chrome_tracing",
+    "dispatch_stats", "dispatch_summary_string", "reset_dispatch_stats",
+    "clear_dispatch_cache", "dispatch_cache_size",
 ]
 
 _state = {"device": False}

@@ -508,6 +508,16 @@ def _select_n(em, eqn):
                           "X": em.literal_or_var(cases[k]), "Y": cur},
                 {"Out": out}, {})
         cur = out
+    if pa.ndim == 0:
+        # the per-case constants were emitted shape (1,) (assign_value
+        # has no 0-d form), so the folded equal/where chain is (1,) while
+        # the outvar's declared aval is scalar — reshape back (ADVICE
+        # round 5), mirroring the dynamic_slice tail
+        rs = em.fresh("sel_rs")
+        em.declare(rs, aval)
+        em.emit("reshape2", {"X": cur}, {"Out": rs},
+                {"shape": [int(s) for s in aval.shape]})
+        cur = rs
     em.bind(eqn.outvars[0], cur)
 
 
@@ -840,19 +850,24 @@ def _scalar_to_index_tensor(em, atom, clamp_hi=None):
         em.emit("reshape2", {"X": name}, {"Out": r}, {"shape": [1]})
         name = r
     if clamp_hi is not None:
-        lo = em.emit_constant(np.asarray([0], dt), tag="idx_lo")
-        hi = em.emit_constant(np.asarray([int(clamp_hi)], dt),
-                              tag="idx_hi")
-        mx = em.fresh("idx_clip_lo")
-        em.declare(mx, jax.ShapeDtypeStruct((1,), dt))
-        em.emit("elementwise_max", {"X": name, "Y": lo}, {"Out": mx},
-                {"axis": -1})
-        mn = em.fresh("idx_clip")
-        em.declare(mn, jax.ShapeDtypeStruct((1,), dt))
-        em.emit("elementwise_min", {"X": mx, "Y": hi}, {"Out": mn},
-                {"axis": -1})
-        name = mn
+        name = _clamp_index(em, name, dt, clamp_hi)
     return name
+
+
+def _clamp_index(em, name, dt, clamp_hi):
+    """Clamp a [1] index var into [0, clamp_hi] via max/min ops."""
+    lo = em.emit_constant(np.asarray([0], dt), tag="idx_lo")
+    hi = em.emit_constant(np.asarray([int(clamp_hi)], dt),
+                          tag="idx_hi")
+    mx = em.fresh("idx_clip_lo")
+    em.declare(mx, jax.ShapeDtypeStruct((1,), dt))
+    em.emit("elementwise_max", {"X": name, "Y": lo}, {"Out": mx},
+            {"axis": -1})
+    mn = em.fresh("idx_clip")
+    em.declare(mn, jax.ShapeDtypeStruct((1,), dt))
+    em.emit("elementwise_min", {"X": mx, "Y": hi}, {"Out": mn},
+            {"axis": -1})
+    return mn
 
 
 def _single_dynamic_axis(em, svals, sizes, xa):
@@ -930,11 +945,18 @@ def _dynamic_slice(em, eqn):
 
 
 def _emit_row_overwrite(em, eqn, x_atom, upd_name, k, idx_atom,
-                        overwrite=True, clamp=False):
+                        overwrite=True, clamp=False, drop_oob=False):
     """Shared tail of dynamic_update_slice/scatter export: overwrite (or
     accumulate) one row of x along axis k at a runtime index, via the
     reference `scatter` op (dim-0 rows), bracketed by transpose2 when
-    k != 0.  `upd_name` must already be [1, *other-dims-in-perm-order]."""
+    k != 0.  `upd_name` must already be [1, *other-dims-in-perm-order].
+
+    `clamp` implements lax's dynamic_update_slice contract (starts clamp
+    into range, the update always lands); `drop_oob` implements lax's
+    default scatter mode FILL_OR_DROP (an out-of-bounds update is
+    DROPPED): the index is clamped for addressing, but the written row is
+    selected back to the original row when the raw index was out of
+    bounds, so the program leaves x untouched exactly like lax does."""
     xa = x_atom.aval
     shape = [int(s) for s in xa.shape]
     xn = em.literal_or_var(x_atom)
@@ -946,23 +968,49 @@ def _emit_row_overwrite(em, eqn, x_atom, upd_name, k, idx_atom,
             tuple(shape[p] for p in perm), xa.dtype))
         em.emit("transpose2", {"X": xn}, {"Out": t}, {"axis": perm})
         xn = t
-    idx = _scalar_to_index_tensor(
-        em, idx_atom, clamp_hi=(shape[k] - 1) if clamp else None)
+    raw = _scalar_to_index_tensor(em, idx_atom)
+    if clamp or drop_oob:
+        idx = _clamp_index(em, raw, np.dtype(idx_atom.aval.dtype),
+                           shape[k] - 1)
+    else:
+        idx = raw
+    in_bounds = None
+    if drop_oob:
+        # raw == clamped  <=>  raw was already in [0, rows-1]
+        in_bounds = em.fresh("scat_ok")
+        em.declare(in_bounds, jax.ShapeDtypeStruct((1,), np.bool_))
+        em.emit("equal", {"X": raw, "Y": idx}, {"Out": in_bounds},
+                {"axis": -1})
+    row_aval = jax.ShapeDtypeStruct(
+        tuple([1] + [shape[p] for p in perm[1:]]), xa.dtype)
     if not overwrite:
         # accumulate: the reference scatter kernel's add mode zeroes
         # the target row first (scatter_op.h), so x[i] += u must
         # serialize as read-modify-write with an overwriting scatter
         g = em.fresh("rmw_row")
-        row_aval = jax.ShapeDtypeStruct(
-            tuple([1] + [shape[p] for p in perm[1:]]), xa.dtype)
         em.declare(g, row_aval)
         em.emit("gather", {"X": xn, "Index": idx}, {"Out": g}, {})
         s = em.fresh("rmw_sum")
         em.declare(s, row_aval)
         em.emit("elementwise_add", {"X": g, "Y": upd_name}, {"Out": s},
                 {"axis": -1})
+        if in_bounds is not None:
+            d = em.fresh("rmw_drop")
+            em.declare(d, row_aval)
+            em.emit("where", {"Condition": in_bounds, "X": s, "Y": g},
+                    {"Out": d}, {})
+            s = d
         upd_name = s
         overwrite = True
+    elif in_bounds is not None:
+        g = em.fresh("drop_row")
+        em.declare(g, row_aval)
+        em.emit("gather", {"X": xn, "Index": idx}, {"Out": g}, {})
+        d = em.fresh("drop_sel")
+        em.declare(d, row_aval)
+        em.emit("where", {"Condition": in_bounds, "X": upd_name,
+                          "Y": g}, {"Out": d}, {})
+        upd_name = d
     sc = em.fresh("dus_sc")
     em.declare(sc, jax.ShapeDtypeStruct(
         tuple(shape[p] for p in perm), xa.dtype))
@@ -1046,7 +1094,11 @@ def _scatter_prim(em, eqn, overwrite):
         em.emit("reshape2", {"X": un}, {"Out": r},
                 {"shape": row_shape})
         un = r
-    _emit_row_overwrite(em, eqn, x, un, 0, idx, overwrite=overwrite)
+    # lax's default scatter mode is FILL_OR_DROP: an out-of-bounds row
+    # index drops the update; the exported program must match (ADVICE
+    # round 5 — the old emission silently clamped, corrupting a row)
+    _emit_row_overwrite(em, eqn, x, un, 0, idx, overwrite=overwrite,
+                        drop_oob=True)
 
 
 def _pow(em, eqn):

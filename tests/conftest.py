@@ -17,7 +17,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax spells this only via XLA_FLAGS (set above); if jax was
+    # imported before this conftest the device count stays 1, which the
+    # multi-device tests detect and skip on
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

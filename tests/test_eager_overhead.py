@@ -4,12 +4,41 @@ baseline, not loose constants).  Eager mode runs each op as its own
 cached XLA executable (`core/dispatch.py`); a regression that defeats the
 per-op jit cache or adds per-dispatch tracing shows up as a large
 multiple of the RAW cached-jit call cost measured in the same process —
-which self-calibrates to whatever the CI runner's load is."""
+which self-calibrates to whatever the CI runner's load is.
+
+Also the functional contract of the signature-keyed dispatch cache: a
+second identical call must NOT retrace (counted via a traced-function
+side counter), the key must split on AMP state / shapes / static
+closures, double-grad must flow through the cached vjp, the cached path
+must be bit-identical to the uncached one, and clear_dispatch_cache()
+must force a retrace."""
 import time
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
+from paddle_tpu.core import dispatch as dispatch_mod
+from paddle_tpu.core.dispatch import dispatch
+
+# side counter: module-global on purpose — a closure cell would become
+# part of the cache key and change on every call
+TRACE_COUNT = 0
+
+
+def _traced_double(a):
+    global TRACE_COUNT
+    TRACE_COUNT += 1  # runs only while jax traces the function
+    return a * 2.0
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    paddle.set_flags({"eager_jit_ops": True})
+    dispatch_mod.clear_dispatch_cache()
+    dispatch_mod.reset_dispatch_stats()
+    yield
+    paddle.set_flags({"eager_jit_ops": True})
 
 
 def _raw_jit_p95(n=200):
@@ -83,3 +112,319 @@ def test_eager_backward_overhead():
     assert p95 < limit, (
         f"eager fwd+bwd p95 {p95*1e3:.2f}ms vs raw jit p95 "
         f"{raw_p95*1e6:.0f}us (limit {limit*1e3:.2f}ms)")
+
+
+class TestDispatchCache:
+    """Functional contract of the signature-keyed executable cache."""
+
+    def test_second_identical_call_does_not_retrace(self):
+        global TRACE_COUNT
+        TRACE_COUNT = 0
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        with paddle.no_grad():
+            dispatch(_traced_double, x)
+            n_first = TRACE_COUNT
+            dispatch(_traced_double, x)
+            dispatch(_traced_double, x)
+        assert n_first >= 1
+        assert TRACE_COUNT == n_first, "second identical call retraced"
+        stats = dispatch_mod.dispatch_stats()
+        s = next(v for k, v in stats.items() if "_traced_double" in k)
+        assert s["hits"] == 2 and s["misses"] == 1 and s["bypasses"] == 0
+
+    def test_changed_shape_retraces_then_hits(self):
+        global TRACE_COUNT
+        TRACE_COUNT = 0
+        with paddle.no_grad():
+            dispatch(_traced_double,
+                     paddle.to_tensor(np.ones((4, 4), np.float32)))
+            dispatch(_traced_double,
+                     paddle.to_tensor(np.ones((2, 8), np.float32)))
+            n_two_shapes = TRACE_COUNT
+            dispatch(_traced_double,
+                     paddle.to_tensor(np.ones((2, 8), np.float32)))
+        assert n_two_shapes == 2, "each distinct shape traces exactly once"
+        assert TRACE_COUNT == n_two_shapes, "shape-keyed entry retraced"
+
+    def test_changed_static_closure_is_a_different_entry(self):
+        x = paddle.to_tensor(np.ones((3, 3), np.float32))
+        with paddle.no_grad():
+            a = paddle.clip(x, 0.0, 0.5)
+            b = paddle.clip(x, 0.0, 2.0)
+        assert float(a.numpy().max()) == 0.5
+        assert float(b.numpy().max()) == 1.0
+
+    def test_changed_static_kwarg_is_a_different_entry(self):
+        global TRACE_COUNT
+        TRACE_COUNT = 0
+
+        def f(a, scale=1.0):
+            global TRACE_COUNT
+            TRACE_COUNT += 1
+            return a * scale
+
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with paddle.no_grad():
+            r1 = dispatch(f, x, scale=2.0)
+            r2 = dispatch(f, x, scale=3.0)
+            r3 = dispatch(f, x, scale=2.0)
+        assert TRACE_COUNT == 2
+        assert float(r1.numpy()[0, 0]) == 2.0
+        assert float(r2.numpy()[0, 0]) == 3.0
+        assert float(r3.numpy()[0, 0]) == 2.0
+
+    def test_float_scalars_key_by_bit_pattern(self):
+        """-0.0 must not alias +0.0 (a stale 0.0-baked executable would
+        return the wrong sign), and NaN must hit its own entry instead
+        of retracing forever (NaN != NaN under == keying)."""
+        import math
+
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        with paddle.no_grad():
+            a = dispatch(lambda t, s: t * s, x, 0.0)
+            b = dispatch(lambda t, s: t * s, x, -0.0)
+            assert math.copysign(1, float(a.numpy()[0])) == 1.0
+            assert math.copysign(1, float(b.numpy()[0])) == -1.0
+
+            def mk(s):
+                return lambda t: t * s
+
+            c = dispatch(mk(-0.0), x)
+            assert math.copysign(1, float(c.numpy()[0])) == -1.0
+
+            dispatch_mod.clear_dispatch_cache()
+            for _ in range(5):
+                dispatch(lambda t, s: t * s, x, float("nan"))
+            assert dispatch_mod.dispatch_cache_size() == 1, \
+                "NaN key missed itself: duplicate entries per call"
+
+    def test_none_positional_input_routes_correctly(self):
+        """A literal None input must stay a baked scalar and not swallow
+        the array-position marker (argument misrouting)."""
+        def f(flag, a):
+            assert flag is None
+            return a * 3.0
+
+        x = paddle.to_tensor(np.ones((3,), np.float32) * 2.0)
+        with paddle.no_grad():
+            r1 = dispatch(f, None, x)   # miss path
+            r2 = dispatch(f, None, x)   # hit path
+        assert float(r1.numpy()[0]) == 6.0
+        assert float(r2.numpy()[0]) == 6.0
+
+    def test_stateful_callable_closure_bypasses(self):
+        """A callable instance can mutate behind its id — it must bypass
+        the cache so the mutation is visible (legacy per-call reads)."""
+        class Scale:
+            def __init__(self, v):
+                self.v = v
+
+            def __call__(self, a):
+                return a * self.v
+
+        sc = Scale(2.0)
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        with paddle.no_grad():
+            a1 = dispatch(lambda t: sc(t), x)
+            sc.v = 3.0
+            a2 = dispatch(lambda t: sc(t), x)
+        assert float(a1.numpy()[0]) == 2.0
+        assert float(a2.numpy()[0]) == 3.0
+
+    def test_unsortable_dict_static_bypasses(self):
+        """A dict static with mixed-type keys can't be fingerprinted —
+        the call must fall back to the legacy path, not crash."""
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        with paddle.no_grad():
+            r = dispatch(lambda t, cfg=None: t * cfg["s"], x,
+                         cfg={"s": 2.0, 1: "x"})
+        assert float(r.numpy()[0]) == 2.0
+
+    def test_dict_keys_do_not_alias_across_types(self):
+        """{1: v} and {True: v} compare equal key-wise in Python — the
+        fingerprint must type-tag dict keys so they stay separate
+        entries."""
+        def f(t, cfg=None):
+            return t * (2.0 if list(cfg)[0] is True else 5.0)
+
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        with paddle.no_grad():
+            a = dispatch(f, x, cfg={1: "x"})
+            b = dispatch(f, x, cfg={True: "x"})
+        assert float(a.numpy()[0]) == 5.0
+        assert float(b.numpy()[0]) == 2.0
+
+    def test_amp_toggle_splits_key_and_restores(self):
+        import paddle_tpu.amp as amp
+
+        a = paddle.to_tensor(np.ones((4, 4), np.float32))
+        b = paddle.to_tensor(np.ones((4, 4), np.float32))
+        r_off = paddle.matmul(a, b)
+        with amp.auto_cast():
+            r_on = paddle.matmul(a, b)
+        r_off2 = paddle.matmul(a, b)
+        assert str(r_off.dtype) == "float32"
+        assert "bfloat16" in str(r_on.dtype)
+        assert str(r_off2.dtype) == "float32"
+        np.testing.assert_array_equal(r_off.numpy(), r_off2.numpy())
+
+    def test_grad_vs_nograd_are_separate_entries(self):
+        x = paddle.to_tensor(np.ones((4, 4), np.float32),
+                             stop_gradient=False)
+        y = paddle.to_tensor(np.ones((4, 4), np.float32))
+        out = paddle.matmul(x, y)
+        assert not out.stop_gradient
+        with paddle.no_grad():
+            out2 = paddle.matmul(x, y)
+        assert out2.stop_gradient
+        np.testing.assert_array_equal(out.numpy(), out2.numpy())
+
+    def test_cached_backward_reuses_jitted_vjp(self):
+        """The recorded pullback must be the entry's jitted executable
+        (no per-call jax.vjp retrace on the hot path)."""
+        from paddle_tpu.core.tape import default_tape
+
+        x = paddle.to_tensor(np.ones((4, 4), np.float32),
+                             stop_gradient=False)
+        y = paddle.to_tensor(np.ones((4, 4), np.float32))
+        out = paddle.matmul(x, y)
+        node = default_tape().nodes[-1]
+        assert isinstance(node.vjp_fn, dispatch_mod._CachedVjp)
+        out.sum().backward()
+        np.testing.assert_array_equal(x.grad.numpy(),
+                                      np.full((4, 4), 4.0, np.float32))
+
+    def test_bit_identical_to_uncached_path(self):
+        rs = np.random.RandomState(7)
+        xv = rs.rand(8, 8).astype(np.float32)
+        yv = rs.rand(8, 8).astype(np.float32)
+
+        def run():
+            dispatch_mod.clear_dispatch_cache()
+            x = paddle.to_tensor(xv, stop_gradient=False)
+            y = paddle.to_tensor(yv)
+            out = paddle.nn.functional.softmax(paddle.matmul(x, y) + x)
+            out.sum().backward()
+            return out.numpy().copy(), x.grad.numpy().copy()
+
+        paddle.set_flags({"eager_jit_ops": True})
+        o_c, g_c = run()
+        paddle.set_flags({"eager_jit_ops": False})
+        o_u, g_u = run()
+        np.testing.assert_array_equal(o_c, o_u)
+        np.testing.assert_array_equal(g_c, g_u)
+
+    def test_double_grad_through_cached_vjp(self):
+        def run(flag):
+            paddle.set_flags({"eager_jit_ops": flag})
+            dispatch_mod.clear_dispatch_cache()
+            x = paddle.to_tensor(
+                np.linspace(0.1, 1.0, 6).astype(np.float32),
+                stop_gradient=False)
+            y = (x * x * x).sum()
+            (gx,) = paddle.grad(y, [x], create_graph=True)
+            z = (gx * gx).sum()
+            z.backward()
+            return gx.numpy().copy(), x.grad.numpy().copy()
+
+        g_c, gg_c = run(True)
+        g_u, gg_u = run(False)
+        np.testing.assert_array_equal(g_c, g_u)
+        np.testing.assert_array_equal(gg_c, gg_u)
+
+    def test_clear_dispatch_cache_forces_retrace(self):
+        global TRACE_COUNT
+        TRACE_COUNT = 0
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        with paddle.no_grad():
+            dispatch(_traced_double, x)
+            dispatch(_traced_double, x)
+        n = TRACE_COUNT
+        assert dispatch_mod.dispatch_cache_size() > 0
+        dispatch_mod.clear_dispatch_cache()
+        assert dispatch_mod.dispatch_cache_size() == 0
+        with paddle.no_grad():
+            dispatch(_traced_double, x)
+        assert TRACE_COUNT == n + 1, "clear_dispatch_cache did not " \
+                                     "invalidate the entry"
+
+    def test_rng_closures_bypass_the_cache(self):
+        """dropout closes over a fresh PRNG key per call: the
+        fingerprinter must refuse to cache it (a frozen key would
+        replay the same mask forever)."""
+        import paddle_tpu.nn.functional as F
+
+        paddle.seed(123)
+        x = paddle.to_tensor(np.ones((16, 16), np.float32))
+        a = F.dropout(x, 0.5, training=True)
+        b = F.dropout(x, 0.5, training=True)
+        assert not np.array_equal(a.numpy(), b.numpy())
+        stats = dispatch_mod.dispatch_stats()
+        drop = [v for k, v in stats.items()
+                if v["bypasses"] > 0]
+        assert drop, "dropout dispatches were not counted as bypasses"
+
+    def test_lru_bound_evicts(self):
+        prev = paddle.get_flags("eager_cache_size")["eager_cache_size"]
+        try:
+            paddle.set_flags({"eager_cache_size": 4})
+            with paddle.no_grad():
+                for n in range(1, 9):
+                    dispatch(_traced_double,
+                             paddle.to_tensor(
+                                 np.ones((n,), np.float32)))
+            assert dispatch_mod.dispatch_cache_size() <= 4
+        finally:
+            paddle.set_flags({"eager_cache_size": prev})
+
+    def test_set_flags_invalidates_cache(self):
+        """Op functions read kernel-policy flags at trace time (e.g.
+        FLAGS_use_pallas_layernorm), baking them into the executable —
+        set_flags must drop cached entries or the change is silently
+        ignored for already-cached signatures."""
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        with paddle.no_grad():
+            dispatch(_traced_double, x)
+        assert dispatch_mod.dispatch_cache_size() > 0
+        prev = paddle.get_flags("log_level")["log_level"]
+        try:
+            paddle.set_flags({"log_level": int(prev) + 1})
+            assert dispatch_mod.dispatch_cache_size() == 0
+        finally:
+            paddle.set_flags({"log_level": prev})
+
+    def test_telemetry_report_renders(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with paddle.no_grad():
+            dispatch(_traced_double, x)
+            dispatch(_traced_double, x)
+        table = dispatch_mod.dispatch_summary_string()
+        assert "Eager Dispatch Report" in table
+        assert "_traced_double" in table
+        import paddle_tpu.profiler as profiler
+
+        assert profiler.dispatch_stats() == dispatch_mod.dispatch_stats()
+
+    def test_steady_state_hit_rate_is_full(self):
+        """Acceptance: after warmup an eager loop's hit-rate is ~100%
+        and no further retraces happen."""
+        import paddle_tpu.nn as nn
+
+        model = nn.Sequential(nn.Linear(16, 16), nn.ReLU(),
+                              nn.Linear(16, 16))
+        x = paddle.to_tensor(np.ones((4, 16), np.float32))
+        for _ in range(3):  # warmup traces every entry once
+            loss = model(x).sum()
+            loss.backward()
+        dispatch_mod.reset_dispatch_stats()
+        for _ in range(5):
+            loss = model(x).sum()
+            loss.backward()
+        stats = dispatch_mod.dispatch_stats()
+        total_cached = sum(s["hits"] + s["misses"]
+                           for s in stats.values())
+        total_hits = sum(s["hits"] for s in stats.values())
+        total_retrace = sum(s["retraces"] for s in stats.values())
+        assert total_cached > 0
+        assert total_retrace == 0
+        assert total_hits == total_cached

@@ -50,6 +50,39 @@ class TestCachedBlocks:
         assert fa.cached_blocks(2048, 2048, 64, jnp.bfloat16,
                                 True) is None
 
+    def test_sub_tile_entry_degrades_to_default(self, cache_file):
+        """A hand-edited/stale entry below the kernel's 128 tile
+        minimum divides the sequence fine but would fail inside the
+        Pallas kernel — it must be rejected, not trusted (ADVICE
+        round 5)."""
+        key = fa._autotune_key(2048, 2048, 64, jnp.bfloat16, True)
+        _write(cache_file, {key: [64, 512]})
+        assert fa.cached_blocks(2048, 2048, 64, jnp.bfloat16,
+                                True) is None
+
+    def test_sub_tile_bk_entry_degrades_to_default(self, cache_file):
+        key = fa._autotune_key(2048, 2048, 64, jnp.bfloat16, True)
+        _write(cache_file, {key: [512, 32]})
+        assert fa.cached_blocks(2048, 2048, 64, jnp.bfloat16,
+                                True) is None
+
+    def test_entry_pick_blocks_would_shrink_is_rejected(self, cache_file):
+        """pick_blocks would shrink a non-dividing 384 block for
+        S=2048; a cached entry that doesn't survive the same shrink
+        rules untouched must degrade to the default."""
+        key = fa._autotune_key(2048, 2048, 64, jnp.bfloat16, True)
+        _write(cache_file, {key: [384, 512]})
+        assert fa.cached_blocks(2048, 2048, 64, jnp.bfloat16,
+                                True) is None
+
+    def test_valid_non_pow2_multiple_of_tile_accepted(self, cache_file):
+        """384 = 3*128 tiles S=1536 exactly and meets the tile
+        minimum: a legitimate measured winner passes validation."""
+        key = fa._autotune_key(1536, 1536, 64, jnp.bfloat16, True)
+        _write(cache_file, {key: [384, 384]})
+        assert fa.cached_blocks(1536, 1536, 64, jnp.bfloat16,
+                                True) == (384, 384)
+
     @pytest.mark.parametrize("content", [
         "{ truncated", '{"entries": [1, 2]}', '{"entries": null}', "",
     ])

@@ -301,6 +301,47 @@ class TestMechanicalStragglers:
         _roundtrip_fn(f, [np.random.RandomState(16)
                           .rand(3, 4).astype(np.float32)])
 
+    def test_select_n_scalar_selector_keeps_scalar_shape(self):
+        """arity>3 select_n with a 0-d selector: the per-case constants
+        are emitted shape (1,), so without the trailing reshape2 the
+        program's value drifts to (1,) against a scalar declared aval
+        (ADVICE round 5)."""
+        def f(x):
+            s = (x.sum() * 0).astype(jnp.int32) + 2
+            t = x.sum()
+            return lax.select_n(s, t, t * 2.0, t * 3.0, t * 4.0)
+
+        x = np.random.RandomState(21).rand(3, 4).astype(np.float32)
+        scope = {}
+        prog = program_from_traced(f, [x], scope)
+        exe = static.Executor()
+        exe.scope.update(scope)
+        fetches = prog.fetch_target_names
+        fetches = fetches() if callable(fetches) else fetches
+        got = exe.run(prog, feed={"input_0": x}, fetch_list=fetches)[0]
+        want = f(jnp.asarray(x))
+        got = np.asarray(got)
+        assert got.shape == (), f"scalar outvar drifted to {got.shape}"
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5)
+
+    def test_scatter_oob_row_index_drops_update(self):
+        """lax's default scatter mode is FILL_OR_DROP: .at[i].set/.add
+        with i out of bounds leaves x untouched.  The exported program
+        must match instead of clamp-corrupting a row (ADVICE round 5)."""
+        def f_set(x, i, u):
+            return x.at[i[0]].set(u)
+
+        def f_add(x, i, u):
+            return x.at[i[0]].add(u)
+
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        u = np.full(3, 10.0, np.float32)
+        for f in (f_set, f_add):
+            for oob in (7, -2):
+                _roundtrip_fn(f, [x, np.array([oob], np.int32), u])
+            # in-bounds behaviour is unchanged by the drop guard
+            _roundtrip_fn(f, [x, np.array([2], np.int32), u])
+
     def test_sort_and_argsort(self):
         """jnp.sort / jnp.argsort -> the reference argsort op (both
         outputs); a sort_key_val with a real (non-iota) payload

@@ -61,6 +61,34 @@ def test_op_bench_and_gate(tmp_path):
     assert e.returncode == 2
 
 
+def test_bench_eager_smoke(tmp_path):
+    """tools/bench_eager.py --smoke runs end-to-end: the eager dispatch
+    bench can't rot.  Asserts the emitted JSON shape and that the cached
+    leg reports a warm hit-rate of ~100% with zero steady-state
+    retraces (the ISSUE-1 acceptance signal, at smoke scale)."""
+    out = str(tmp_path / "bench_eager.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_eager.py", "--smoke", "--out",
+         out], cwd=REPO, capture_output=True, text=True, env=ENV,
+        timeout=300)
+    assert r.returncode == 0, r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert set(data["configs"]) == {"mlp", "gpt_block"}
+    for name, cfg in data["configs"].items():
+        for leg in ("cached", "uncached"):
+            for field in ("us_per_op", "ops_per_s", "dispatches",
+                          "hit_rate", "retraces", "wall_s"):
+                assert field in cfg[leg], (name, leg, field)
+        assert cfg["cached"]["dispatches"] > 0
+        assert cfg["cached"]["hit_rate"] > 0.99, (
+            name, cfg["cached"])
+        assert cfg["cached"]["retraces"] == 0
+        assert cfg["uncached"]["bypasses"] == \
+            cfg["uncached"]["dispatches"]
+        assert cfg["per_op_speedup"] > 0
+
+
 def test_op_bench_gate_device_mismatch(tmp_path):
     """Cross-device comparisons are incommensurable (a CPU run vs a TPU
     baseline); the checker must refuse rather than mis-gate."""

@@ -1,0 +1,171 @@
+"""Eager dispatch fast-path benchmark.
+
+Measures the per-op dispatch cost of representative *eager* training
+steps (an MLP and a GPT-style transformer block, forward + backward +
+SGD update) with the signature-keyed executable cache ON vs OFF
+(`FLAGS_eager_jit_ops`), and emits `BENCH_eager.json`.
+
+Reference counterpart: the per-op Tracer::TraceOp cost the reference's
+OpKernelMap cache keeps flat (`imperative/tracer.cc:144`); here the
+cached path replaces per-call `jax.vjp` retracing with memoized jitted
+fwd/vjp executables (core/dispatch.py), so this bench is the direct
+before/after of that cache.
+
+Usage:
+    python tools/bench_eager.py [--out BENCH_eager.json] [--iters 30]
+                                [--smoke] [--configs mlp,gpt_block]
+
+`--smoke` shrinks shapes and iteration counts so CI can assert the
+script end-to-end without timing noise mattering (tests/test_tooling.py).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.core import dispatch as _dispatch  # noqa: E402
+
+
+def _mlp_step(smoke):
+    d = 32 if smoke else 256
+    bs = 4 if smoke else 32
+    model = nn.Sequential(
+        nn.Linear(d, d), nn.ReLU(), nn.Linear(d, d), nn.ReLU(),
+        nn.Linear(d, d))
+    opt = paddle.optimizer.SGD(learning_rate=1e-3,
+                               parameters=model.parameters())
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(bs, d).astype(np.float32))
+
+    def step():
+        loss = model(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return step
+
+
+def _gpt_block_step(smoke):
+    d = 32 if smoke else 128
+    heads = 2 if smoke else 4
+    bs, seq = (2, 8) if smoke else (4, 64)
+    attn = nn.MultiHeadAttention(d, heads)
+    ln1, ln2 = nn.LayerNorm(d), nn.LayerNorm(d)
+    ffn = nn.Sequential(nn.Linear(d, 4 * d), nn.GELU(),
+                        nn.Linear(4 * d, d))
+    params = (list(attn.parameters()) + list(ln1.parameters())
+              + list(ln2.parameters()) + list(ffn.parameters()))
+    opt = paddle.optimizer.SGD(learning_rate=1e-3, parameters=params)
+    x = paddle.to_tensor(
+        np.random.RandomState(1).rand(bs, seq, d).astype(np.float32))
+
+    def step():
+        h = ln1(x)
+        h = x + attn(h, h, h)
+        out = h + ffn(ln2(h))
+        loss = (out * out).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return step
+
+
+CONFIGS = {"mlp": _mlp_step, "gpt_block": _gpt_block_step}
+
+
+def _measure(step, iters, warmup):
+    for _ in range(warmup):
+        loss = step()
+    float(np.asarray(loss.numpy()))  # fence
+    _dispatch.reset_dispatch_stats()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step()
+    float(np.asarray(loss.numpy()))  # fence
+    wall = time.perf_counter() - t0
+    stats = _dispatch.dispatch_stats()
+    calls = sum(s["calls"] for s in stats.values())
+    cached = sum(s["hits"] + s["misses"] for s in stats.values())
+    hits = sum(s["hits"] for s in stats.values())
+    retraces = sum(s["retraces"] for s in stats.values())
+    bypasses = sum(s["bypasses"] for s in stats.values())
+    return {
+        "iters": iters,
+        "wall_s": wall,
+        "dispatches": calls,
+        "us_per_op": wall / max(calls, 1) * 1e6,
+        "ops_per_s": calls / wall if wall > 0 else 0.0,
+        "steps_per_s": iters / wall if wall > 0 else 0.0,
+        "hit_rate": hits / cached if cached else 0.0,
+        "retraces": retraces,
+        "bypasses": bypasses,
+    }
+
+
+def run(configs, iters, warmup, smoke):
+    import jax
+
+    out = {
+        "bench": "eager_dispatch",
+        "device": str(jax.devices()[0].device_kind)
+        if jax.devices() else "unknown",
+        "smoke": bool(smoke),
+        "configs": {},
+    }
+    for name in configs:
+        step_factory = CONFIGS[name]
+        entry = {}
+        for label, flag in (("uncached", False), ("cached", True)):
+            paddle.set_flags({"eager_jit_ops": flag})
+            _dispatch.clear_dispatch_cache()
+            step = step_factory(smoke)
+            entry[label] = _measure(step, iters, warmup)
+        paddle.set_flags({"eager_jit_ops": True})
+        unc, cac = entry["uncached"], entry["cached"]
+        entry["per_op_speedup"] = (unc["us_per_op"] / cac["us_per_op"]
+                                   if cac["us_per_op"] else 0.0)
+        out["configs"][name] = entry
+        print(f"{name}: uncached {unc['us_per_op']:.1f} us/op, "
+              f"cached {cac['us_per_op']:.1f} us/op "
+              f"({entry['per_op_speedup']:.2f}x), cached hit-rate "
+              f"{cac['hit_rate']:.1%}, retraces {cac['retraces']}")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_eager.json"))
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--configs", default="mlp,gpt_block")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes + 2 iters: CI end-to-end check")
+    args = ap.parse_args()
+    iters, warmup = (2, 2) if args.smoke else (args.iters, args.warmup)
+    configs = [c for c in args.configs.split(",") if c]
+    for c in configs:
+        if c not in CONFIGS:
+            ap.error(f"unknown config {c!r} (have {sorted(CONFIGS)})")
+    result = run(configs, iters, warmup, args.smoke)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
