@@ -39,11 +39,17 @@ def define_flag(name: str, default, help_str: str = ""):
 
 
 def set_flags(flags: Dict[str, Any]):
-    changed = False
-    for k, v in flags.items():
-        k = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+    # validate every key BEFORE mutating: a partial apply that raised on
+    # a later unknown key would skip the invalidation callbacks below,
+    # leaving cached executables replaying the old value of the flags
+    # that did change
+    items = [(k[len("FLAGS_"):] if k.startswith("FLAGS_") else k, v)
+             for k, v in flags.items()]
+    for k, _ in items:
         if k not in _REGISTRY:
             raise KeyError(f"unknown flag {k!r}")
+    changed = False
+    for k, v in items:
         if _REGISTRY[k] != v:
             changed = True
         _REGISTRY[k] = v

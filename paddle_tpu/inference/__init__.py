@@ -26,7 +26,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
-           "PrecisionType", "PlaceType"]
+           "PrecisionType", "PlaceType", "serving"]
 
 
 class PrecisionType:
@@ -282,6 +282,18 @@ class Predictor:
             self._layer = pjit.load(prefix)
             n_in = self._n_model_inputs()
             self._input_names = [f"input_{i}" for i in range(n_in)]
+            # enable_memory_optim for the StableHLO artifact: wrap the
+            # exported call in a jit whose FEED buffers are donated so
+            # outputs may alias them (the ProgramDesc path gets the same
+            # via ProgramRunner(donate_feeds=True))
+            self._donated_infer = None
+            if config._memory_optim:
+                import jax as _jax
+
+                ex = self._layer._exported
+                self._donated_infer = _jax.jit(
+                    lambda parrs, barrs, *ins: ex.call(parrs, barrs, *ins),
+                    donate_argnums=tuple(range(2, 2 + n_in)))
 
     def _n_model_inputs(self) -> int:
         ex = self._layer._exported
@@ -324,7 +336,25 @@ class Predictor:
                 raise NotImplementedError(
                     "set_lod applies to reference-format (ProgramDesc) "
                     "models only; the StableHLO export has no LoD inputs")
-            outs = self._layer(*inputs)
+            if self._donated_infer is not None:
+                import jax.numpy as _jnp
+
+                layer = self._layer
+                parrs = [layer._param_map[k]._array
+                         for k in layer._pnames]
+                barrs = [layer._buf_map[k]._array for k in layer._bnames]
+                import jax as _jax
+
+                # a caller-owned jax.Array fed directly would itself be
+                # donated (deleted) — copy ONLY those; numpy feeds (the
+                # normal predictor path) already produce fresh device
+                # buffers via asarray, no extra traffic
+                feeds = [_jnp.array(i, copy=True)
+                         if isinstance(i, _jax.Array) else _jnp.asarray(i)
+                         for i in inputs]
+                outs = self._donated_infer(parrs, barrs, *feeds)
+            else:
+                outs = self._layer(*inputs)
             outs = outs if isinstance(outs, tuple) else (outs,)
         self._output_names = [f"output_{i}" for i in range(len(outs))]
         self._outputs = {
@@ -350,6 +380,7 @@ class Predictor:
         twin._config = self._config
         twin._runner = self._runner
         twin._layer = self._layer
+        twin._donated_infer = getattr(self, "_donated_infer", None)
         twin._input_names = list(self._input_names)
         twin._output_names = list(self._output_names)
         twin._inputs = {}
@@ -362,3 +393,17 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+def __getattr__(name):
+    # PEP 562 lazy submodule: `paddle_tpu.inference.serving` resolves on
+    # first attribute access without loading the serving engine (and its
+    # Pallas kernel chain) into every `import paddle_tpu`.  Must go
+    # through importlib — a `from . import serving` here would re-enter
+    # this __getattr__ via _handle_fromlist and recurse.
+    if name == "serving":
+        import importlib
+
+        return importlib.import_module(".serving", __name__)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

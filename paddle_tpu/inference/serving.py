@@ -1,0 +1,573 @@
+"""LLM decode serving: paged KV-cache pool + continuous-batching engine.
+
+The serving-side analog of `jit.TrainStep`: the per-step decode —
+embedding, per-layer paged-attention over block-table-indexed KV pages,
+in-place cache write, sampling — is ONE donated jitted executable with
+signature-keyed reuse, so steady-state serving never retraces and the KV
+pool buffers are updated in place.  Scheduling (admitting queued
+requests into free slots, evicting finished sequences, growing a
+sequence's block table page by page) happens on the host *between*
+steps, changing only array contents — never shapes — which is what keeps
+the executable cache warm.
+
+Layers:
+
+* `KVBlockPool` — host-side page allocator over the device-resident
+  K/V page pools (`[layers, kv_heads, num_pages, page_size, head_dim]`);
+* `Request` / `DecodeEngine` — continuous batching over a fixed slot
+  grid: prefill per admitted request (bucket-padded so prompt lengths
+  share executables), then batched decode steps over every active slot;
+* telemetry — step latency, batch occupancy, KV-block utilization and
+  executable (re)compilation counts, surfaced through
+  `paddle_tpu.profiler.decode_stats`.
+
+Numerics deliberately mirror the eager GPT path op for op (same
+layer_norm kernel, same sdpa reference, same sampling), so greedy decode
+through the engine reproduces `GPT.generate`'s tokens exactly — the
+parity contract tests/test_paged_decode.py pins.
+"""
+from __future__ import annotations
+
+import functools
+import time
+from collections import deque
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import unwrap
+from ..ops.pallas import paged_attention as pa
+
+__all__ = ["KVBlockPool", "Request", "DecodeEngine", "sample_logits",
+           "decode_stats", "reset_decode_stats"]
+
+
+# ---------------------------------------------------------------------------
+# Telemetry (profiler.decode_stats).  The key schema lives in profiler
+# (DECODE_STAT_COUNTERS) so profiler's not-imported zero fallback and
+# this live dict can never diverge.
+# ---------------------------------------------------------------------------
+from ..profiler import (DECODE_STAT_COUNTERS, _decode_stat_zero)
+
+_STATS = {k: _decode_stat_zero(k) for k in DECODE_STAT_COUNTERS}
+
+
+def decode_stats(reset=False):
+    """Serving-loop telemetry: decode step latency, batch occupancy,
+    KV-block utilization and executable compile counts.
+    ``retraces_after_warmup`` must stay 0 in steady state — any nonzero
+    value means a step signature changed mid-serve.
+
+    Counters are PROCESS-WIDE aggregates across every DecodeEngine (the
+    same contract as ``dispatch_stats``); serving several engines
+    concurrently blends their occupancy/utilization averages."""
+    out = dict(_STATS)
+    steps = max(out["steps"], 1)
+    out["avg_step_ms"] = out["decode_time_s"] / steps * 1e3
+    out["batch_occupancy"] = out["occupancy_sum"] / steps
+    out["kv_block_utilization"] = out["kv_util_sum"] / steps
+    if reset:
+        reset_decode_stats()
+    return out
+
+
+def reset_decode_stats():
+    for k in _STATS:
+        _STATS[k] = 0.0 if isinstance(_STATS[k], float) else 0
+
+
+# Sampling lives in nn.decode (neutral layer — eager GPT.generate must
+# not depend on the serving module); re-exported here for the engine's
+# public surface.
+from ..nn.decode import sample_logits  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# KV page pool (host-side allocator; device arrays live on the engine)
+# ---------------------------------------------------------------------------
+class KVBlockPool:
+    """Free-list allocator over ``num_pages`` KV pages.  Allocation and
+    reservation accounting are host-side bookkeeping; the page payloads
+    are the engine's donated device arrays."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = int(num_pages)
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self.reserved = 0  # pages promised to running requests
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def utilization(self) -> float:
+        return self.used_count / max(self.num_pages, 1)
+
+    def alloc_page(self) -> int:
+        if not self._free:
+            raise RuntimeError("KV page pool exhausted")
+        return self._free.pop()
+
+    def free_pages(self, pages):
+        for p in pages:
+            self._free.append(int(p))
+
+
+class Request:
+    """One generation request moving through the engine:
+    queued -> running (bound to a slot + pages) -> done."""
+
+    _next_id = 0
+
+    def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None):
+        self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.output_ids: List[int] = []
+        self.state = "queued"
+        self.slot: Optional[int] = None
+        self.pages: List[int] = []
+        self.request_id = Request._next_id
+        Request._next_id += 1
+
+    def total_kv_tokens(self) -> int:
+        # KV rows ever written: prompt + all generated-token writes except
+        # the final sampled token (its KV is never needed)
+        return len(self.prompt_ids) + max(self.max_new_tokens - 1, 0)
+
+
+# ---------------------------------------------------------------------------
+# Functional GPT forward (pure, jit-compiled once per signature)
+# ---------------------------------------------------------------------------
+def _extract_gpt_params(model):
+    """Pull the weight arrays out of a models.gpt.GPT into a plain pytree
+    for the pure step functions."""
+    def arr(t):
+        return None if t is None else unwrap(t)
+
+    blocks = []
+    for blk in model.blocks:
+        blocks.append({
+            "ln1_w": arr(blk.ln1.weight), "ln1_b": arr(blk.ln1.bias),
+            "ln2_w": arr(blk.ln2.weight), "ln2_b": arr(blk.ln2.bias),
+            "qkv_w": arr(blk.qkv.weight), "qkv_b": arr(blk.qkv.bias),
+            "out_w": arr(blk.out_proj.weight),
+            "out_b": arr(blk.out_proj.bias),
+            "fc1_w": arr(blk.fc1.weight), "fc1_b": arr(blk.fc1.bias),
+            "fc2_w": arr(blk.fc2.weight), "fc2_b": arr(blk.fc2.bias),
+        })
+    params = {
+        "wte": arr(model.wte.weight), "wpe": arr(model.wpe.weight),
+        "lnf_w": arr(model.ln_f.weight), "lnf_b": arr(model.ln_f.bias),
+        "blocks": blocks,
+    }
+    if not model.cfg.tie_embeddings:
+        params["head_w"] = arr(model.lm_head.weight)
+        params["head_b"] = arr(getattr(model.lm_head, "bias", None))
+    return params
+
+
+def _ln(x2d, w, b, eps):
+    # the SAME layer_norm implementation the eager path runs on CPU
+    # (ops/pallas/layer_norm._fwd_xla) — row-local, so applying it to a
+    # single decode row matches the batched eager call bit for bit
+    from ..ops.pallas.layer_norm import _fwd_xla
+
+    return _fwd_xla(x2d, w, b, eps)
+
+
+def _logits_of(params, h):
+    if "head_w" in params:
+        out = jnp.matmul(h, params["head_w"])
+        if params.get("head_b") is not None:
+            out = out + params["head_b"]
+        return out
+    return jnp.matmul(h, params["wte"].T)
+
+
+def _gpt_prefill(params, ids, true_len, bt_row, k_pages, v_pages, key, *,
+                 num_heads, head_dim, eps, sampler, temperature, top_k,
+                 top_p):
+    """Prompt pass for ONE request: full causal attention over the
+    (bucket-padded) prompt, K/V scattered into the request's pages,
+    first token sampled from the last valid position's logits.
+
+    ids: [1, S_pad] int32; true_len: scalar int32; bt_row: [pages_max]
+    int32; k_pages/v_pages: [L, Hkv, num_pages, page, D] (donated).
+    """
+    from ..nn.functional.attention import _sdpa_reference
+
+    s_pad = ids.shape[1]
+    h = num_heads * head_dim
+    num_pages_total = k_pages.shape[2]
+    page = k_pages.shape[3]
+    pos = jnp.arange(s_pad, dtype=jnp.int32)
+    x = params["wte"][ids[0]] + params["wpe"][pos]  # [S, h]
+
+    valid = pos < true_len
+    page_idx = jnp.where(valid, bt_row[pos // page], num_pages_total)
+    slot = pos % page
+
+    for li, blk in enumerate(params["blocks"]):
+        y = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
+        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = qkv.reshape(s_pad, 3, num_heads, head_dim)
+        q = qkv[:, 0].transpose(1, 0, 2)[None]  # [1, H, S, D]
+        k = qkv[:, 1].transpose(1, 0, 2)[None]
+        v = qkv[:, 2].transpose(1, 0, 2)[None]
+        # out-of-bounds page index (padded rows) -> scatter drops the
+        # row.  The int layer index joins the advanced-index group, so
+        # the result dims lead: slice shape is [S, Hkv, D]
+        k_pages = k_pages.at[li, :, page_idx, slot, :].set(
+            k[0].transpose(1, 0, 2))
+        v_pages = v_pages.at[li, :, page_idx, slot, :].set(
+            v[0].transpose(1, 0, 2))
+        attn = _sdpa_reference(q, k, v, None, 0.0, None, True)[0]
+        attn = attn.transpose(1, 0, 2).reshape(s_pad, h)
+        x = x + jnp.matmul(attn, blk["out_w"]) + blk["out_b"]
+        y = _ln(x, blk["ln2_w"], blk["ln2_b"], eps)
+        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+                        approximate=True)
+        x = x + jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+
+    h_last = jnp.take(x, true_len - 1, axis=0)[None]  # [1, h]
+    h_last = _ln(h_last, params["lnf_w"], params["lnf_b"], eps)
+    logits = _logits_of(params, h_last).astype(jnp.float32)
+    token = sample_logits(logits, sampler=sampler, temperature=temperature,
+                          top_k=top_k, top_p=top_p, key=key)[0]
+    return k_pages, v_pages, token
+
+
+def _gpt_decode_step(params, k_pages, v_pages, block_tables, seq_lens,
+                     tokens, active, key, *, num_heads, head_dim, eps,
+                     sampler, temperature, top_k, top_p):
+    """One batched decode step over every slot: write the incoming
+    token's K/V into its page, ragged paged attention over the pool,
+    sample the next token.  Donated k_pages/v_pages make the cache
+    update in place; inactive slots write nowhere (OOB page index) and
+    read length 0."""
+    b = tokens.shape[0]
+    h = num_heads * head_dim
+    num_pages_total = k_pages.shape[2]
+    page = k_pages.shape[3]
+
+    pos = seq_lens  # the incoming token's position
+    x = params["wte"][tokens] + params["wpe"][pos]  # [B, h]
+    page_idx = jnp.where(
+        active, block_tables[jnp.arange(b), pos // page], num_pages_total)
+    slot = pos % page
+    lens_now = seq_lens + active.astype(jnp.int32)
+
+    for li, blk in enumerate(params["blocks"]):
+        y = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
+        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = qkv.reshape(b, 3, num_heads, head_dim)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, H, D]
+        # slice shape [B, Hkv, D] (int layer index joins the advanced
+        # group — batch dims lead); inactive rows have an OOB page index
+        # and are dropped by the scatter
+        k_pages = k_pages.at[li, :, page_idx, slot, :].set(k)
+        v_pages = v_pages.at[li, :, page_idx, slot, :].set(v)
+        attn = pa.paged_attention(q, k_pages[li], v_pages[li],
+                                  block_tables, lens_now)
+        x = x + jnp.matmul(attn.reshape(b, h), blk["out_w"]) + blk["out_b"]
+        y = _ln(x, blk["ln2_w"], blk["ln2_b"], eps)
+        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+                        approximate=True)
+        x = x + jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+
+    x = _ln(x, params["lnf_w"], params["lnf_b"], eps)
+    logits = _logits_of(params, x).astype(jnp.float32)
+    nxt = sample_logits(logits, sampler=sampler, temperature=temperature,
+                        top_k=top_k, top_p=top_p, key=key)
+    return k_pages, v_pages, jnp.where(active, nxt, 0)
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+class DecodeEngine:
+    """Continuous-batching decode over a paged KV cache.
+
+    ``model`` is a `models.gpt.GPT` (dropout must be inactive — call
+    ``model.eval()``).  Requests are admitted into ``max_batch_size``
+    slots as they arrive and evicted the step they finish; the per-step
+    decode is one donated jitted executable reused across the whole
+    serve (signature-keyed: shapes never change, so it compiles once).
+    """
+
+    def __init__(self, model, max_batch_size=4, max_seq_len=None,
+                 page_size=None, num_pages=None, sampler="greedy",
+                 temperature=1.0, top_k=0, top_p=1.0, seed=0,
+                 eos_token_id=None, dtype=None):
+        cfg = model.cfg
+        if getattr(cfg, "dropout", 0.0) and model.training:
+            # don't silently flip the caller's train/eval mode — dropout
+            # is simply not part of the decode step functions
+            raise ValueError(
+                "DecodeEngine serves inference only: call model.eval() "
+                "first (cfg.dropout > 0 and the model is in train mode)")
+        self._params = _extract_gpt_params(model)
+        self._num_heads = cfg.num_heads
+        self._head_dim = cfg.hidden_size // cfg.num_heads
+        self._eps = float(getattr(model.ln_f, "_epsilon", 1e-5))
+        self._num_layers = cfg.num_layers
+        self._slots = int(max_batch_size)
+        self._max_seq_len = int(max_seq_len or cfg.max_seq_len)
+        if self._max_seq_len > cfg.max_seq_len:
+            # positions past the wpe table would silently CLAMP in the
+            # embedding gather (wrong logits, no error) — refuse instead
+            raise ValueError(
+                f"max_seq_len {self._max_seq_len} exceeds the model's "
+                f"position table ({cfg.max_seq_len})")
+        kv_dtype = jnp.dtype(dtype) if dtype is not None else \
+            self._params["wte"].dtype
+        self._page = int(page_size or pa.default_page_size(
+            self._max_seq_len, self._head_dim, kv_dtype))
+        # block tables round UP: a horizon that doesn't tile just leaves
+        # the last page partially used (ragged lengths mask the rest)
+        self._pages_per_seq = -(-self._max_seq_len // self._page)
+        n_pages = int(num_pages or self._slots * self._pages_per_seq)
+        self.pool = KVBlockPool(n_pages)
+        shape = (self._num_layers, self._num_heads, n_pages, self._page,
+                 self._head_dim)
+        self._k_pages = jnp.zeros(shape, kv_dtype)
+        self._v_pages = jnp.zeros(shape, kv_dtype)
+
+        self._bt = np.zeros((self._slots, self._pages_per_seq), np.int32)
+        self._lens = np.zeros(self._slots, np.int32)
+        self._active = np.zeros(self._slots, bool)
+        self._last = np.zeros(self._slots, np.int32)
+        self._by_slot: List[Optional[Request]] = [None] * self._slots
+
+        self._sampling = dict(sampler=sampler,
+                              temperature=float(temperature),
+                              top_k=int(top_k), top_p=float(top_p))
+        self._eos = eos_token_id
+        self._key = jax.random.PRNGKey(seed)
+        self._step_no = 0
+        self._prefill_no = 0
+        self._queue: "deque[Request]" = deque()
+        self._decode_fn = None  # shapes are fixed: ONE jitted step
+        self._prefill_fns = {}
+        self._warm = False
+        self._decode_jit_compiles = 0  # actual XLA compiles observed
+
+    # -- request lifecycle ---------------------------------------------------
+    def add_request(self, prompt_ids, max_new_tokens=32,
+                    eos_token_id=...) -> Request:
+        # sentinel default: eos_token_id=None is a real per-request
+        # opt-out of the engine-level eos, not "use the default"
+        req = Request(prompt_ids, max_new_tokens,
+                      self._eos if eos_token_id is ... else eos_token_id)
+        if not req.prompt_ids:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(req.prompt_ids) + req.max_new_tokens > self._max_seq_len:
+            raise ValueError(
+                f"prompt ({len(req.prompt_ids)}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds max_seq_len "
+                f"{self._max_seq_len}")
+        if self._pages_for(req.total_kv_tokens()) > self.pool.num_pages:
+            raise ValueError(
+                "request needs more KV pages than the pool holds")
+        self._queue.append(req)
+        return req
+
+    def _pages_for(self, tokens: int) -> int:
+        return -(-tokens // self._page)  # ceil
+
+    def _admit(self):
+        while self._queue:
+            free_slots = [i for i in range(self._slots)
+                          if not self._active[i]]
+            if not free_slots:
+                return
+            req = self._queue[0]
+            total_pages = self._pages_for(req.total_kv_tokens())
+            # conservative admission: never admit a request the pool
+            # cannot see through to completion (running requests' not-yet
+            # -allocated pages are reserved)
+            if self.pool.free_count - self.pool.reserved < total_pages:
+                return
+            self._queue.popleft()
+            slot = free_slots[0]
+            self._prefill_into(req, slot, total_pages)
+
+    def _prefill_into(self, req: Request, slot: int, total_pages: int):
+        p_len = len(req.prompt_ids)
+        for _ in range(self._pages_for(p_len)):
+            req.pages.append(self.pool.alloc_page())
+        self.pool.reserved += total_pages - len(req.pages)
+        row = np.zeros(self._pages_per_seq, np.int32)
+        row[:len(req.pages)] = req.pages
+        self._bt[slot] = row
+
+        bucket = 16
+        while bucket < p_len:
+            bucket *= 2
+        bucket = min(bucket, self._max_seq_len)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :p_len] = req.prompt_ids
+
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(_gpt_prefill, num_heads=self._num_heads,
+                                  head_dim=self._head_dim, eps=self._eps,
+                                  **self._sampling),
+                donate_argnums=(4, 5))
+            self._prefill_fns[bucket] = fn
+            # prefill buckets compile on first use by design (a new
+            # prompt-length bucket is an expected warmup event, not a
+            # steady-state retrace) — only decode-step recompiles count
+            # toward retraces_after_warmup
+            _STATS["prefill_compiles"] += 1
+        t0 = time.perf_counter()
+        # prefill keys live in the upper fold_in domain (decode steps use
+        # 1..2^30), derived from a PER-ENGINE counter so `seed` actually
+        # pins the sampling stream regardless of process-global state
+        self._prefill_no += 1
+        key = jax.random.fold_in(self._key, (1 << 30) + self._prefill_no)
+        self._k_pages, self._v_pages, tok = fn(
+            self._params, jnp.asarray(ids), jnp.int32(p_len),
+            jnp.asarray(self._bt[slot]), self._k_pages, self._v_pages,
+            key)
+        tok = int(tok)
+        _STATS["prefill_time_s"] += time.perf_counter() - t0
+        _STATS["prefills"] += 1
+        _STATS["tokens"] += 1
+
+        req.state = "running"
+        req.slot = slot
+        req.output_ids = [tok]
+        self._by_slot[slot] = req
+        self._lens[slot] = p_len
+        self._last[slot] = tok
+        self._active[slot] = True
+        if self._done(req, tok):
+            self._finish(slot)
+
+    def _done(self, req: Request, tok: int) -> bool:
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            return True
+        return len(req.output_ids) >= req.max_new_tokens
+
+    def _finish(self, slot: int):
+        req = self._by_slot[slot]
+        self.pool.free_pages(req.pages)
+        self.pool.reserved -= max(
+            self._pages_for(req.total_kv_tokens()) - len(req.pages), 0)
+        req.state = "done"
+        req.slot = None
+        req.pages = []
+        self._by_slot[slot] = None
+        self._active[slot] = False
+        self._lens[slot] = 0
+        self._last[slot] = 0
+        self._bt[slot] = 0
+
+    def _grow_block_tables(self):
+        # the next step writes at position lens[slot]; make sure the page
+        # holding that position exists (slot reuse keeps this a pop from
+        # the free list, not an allocation)
+        for slot in range(self._slots):
+            if not self._active[slot]:
+                continue
+            req = self._by_slot[slot]
+            pidx = int(self._lens[slot]) // self._page
+            while pidx >= len(req.pages):
+                req.pages.append(self.pool.alloc_page())
+                self.pool.reserved -= 1
+                self._bt[slot, len(req.pages) - 1] = req.pages[-1]
+
+    # -- the serve loop ------------------------------------------------------
+    def step(self) -> bool:
+        """Admit what fits, run one batched decode step.  Returns False
+        when there is nothing left to do."""
+        from ..profiler import RecordEvent
+
+        self._admit()
+        if not self._active.any():
+            return bool(self._queue)
+        self._grow_block_tables()
+
+        fn = self._decode_fn
+        if fn is None:
+            fn = self._decode_fn = jax.jit(
+                functools.partial(_gpt_decode_step,
+                                  num_heads=self._num_heads,
+                                  head_dim=self._head_dim, eps=self._eps,
+                                  **self._sampling),
+                donate_argnums=(1, 2))
+            _STATS["decode_compiles"] += 1
+
+        self._step_no += 1
+        key = jax.random.fold_in(self._key, self._step_no)
+        t0 = time.perf_counter()
+        with RecordEvent("serving.decode_step"):
+            self._k_pages, self._v_pages, toks = fn(
+                self._params, self._k_pages, self._v_pages,
+                jnp.asarray(self._bt), jnp.asarray(self._lens),
+                jnp.asarray(self._last), jnp.asarray(self._active), key)
+            toks = np.asarray(toks)
+        dt = time.perf_counter() - t0
+
+        # retrace telemetry counts ACTUAL XLA compiles (the jit's own
+        # trace-cache size) — a dtype/weak_type flapping in the step
+        # operands would recompile inside the same jitted wrapper and
+        # must not go unnoticed
+        try:
+            n_compiled = fn._cache_size()
+        except AttributeError:  # older jax without _cache_size
+            n_compiled = 1
+        if self._warm and n_compiled > self._decode_jit_compiles:
+            _STATS["retraces_after_warmup"] += \
+                n_compiled - self._decode_jit_compiles
+        self._decode_jit_compiles = n_compiled
+
+        n_active = int(self._active.sum())
+        _STATS["steps"] += 1
+        _STATS["decode_time_s"] += dt
+        _STATS["tokens"] += n_active
+        _STATS["occupancy_sum"] += n_active / self._slots
+        _STATS["kv_util_sum"] += self.pool.utilization()
+        self._warm = True
+
+        for slot in range(self._slots):
+            if not self._active[slot]:
+                continue
+            tok = int(toks[slot])
+            req = self._by_slot[slot]
+            self._lens[slot] += 1
+            self._last[slot] = tok
+            req.output_ids.append(tok)
+            if self._done(req, tok):
+                self._finish(slot)
+        return True
+
+    def run(self, max_steps=100000):
+        """Drive the loop until every queued/running request finishes."""
+        steps = 0
+        while (self._queue or self._active.any()) and steps < max_steps:
+            self.step()
+            steps += 1
+        return steps
+
+    def generate(self, prompts, max_new_tokens=32):
+        """Convenience batch API: submit all prompts, serve to
+        completion, return one token list per prompt (in order).
+        Loops run() until the queue drains — every step advances each
+        active slot by one token, so progress is guaranteed and no
+        request can be silently truncated at run()'s step cap."""
+        reqs = [self.add_request(p, max_new_tokens) for p in prompts]
+        while self._queue or self._active.any():
+            self.run()
+        return [list(r.output_ids) for r in reqs]

@@ -66,7 +66,20 @@ class GPTBlock(nn.Layer):
             self.fc2 = nn.Linear(cfg.intermediate_size, h)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, prealloc_mask=None):
+        """``cache`` enables incremental decode:
+
+        * ``("concat", k, v)`` or ``("concat", None, None)`` — legacy
+          concat-growth cache (O(S^2) reallocation over a generation;
+          kept as the bit-parity baseline for the paged engine);
+        * a ``PreallocKVCache`` (see ``GPT.gen_caches``) — preallocated
+          buffers written in place, shape-stable per step so every
+          decode step hits the eager dispatch cache.
+
+        Returns ``x`` (no cache) or ``(x, new_cache)``.
+        """
+        from ..nn.layer.transformer import (PreallocKVCache,
+                                            kv_cache_write, kv_valid_mask)
         from ..ops import concat, reshape, transpose
 
         b, s, h = x.shape
@@ -75,13 +88,37 @@ class GPTBlock(nn.Layer):
         qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         qkv = transpose(qkv, [2, 0, 3, 1, 4])  # [3, b, H, s, d]
         q, k, v = qkv[0], qkv[1], qkv[2]
-        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        if cache is None:
+            attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        elif isinstance(cache, PreallocKVCache):
+            # GPT.forward hoists the (identical-across-layers) validity
+            # mask and the capacity check to once per step; standalone
+            # block use computes/checks locally
+            hoisted = prealloc_mask is not None
+            full_k = kv_cache_write(cache.k, k, cache.length,
+                                    check_capacity=not hoisted)
+            full_v = kv_cache_write(cache.v, v, cache.length,
+                                    check_capacity=False)
+            mask = prealloc_mask if hoisted else \
+                kv_valid_mask(cache.length, s, full_k.shape[2])
+            attn = F.scaled_dot_product_attention(q, full_k, full_v,
+                                                  attn_mask=mask)
+            cache = PreallocKVCache(full_k, full_v, cache.length + s)
+        else:
+            _, k_prev, v_prev = cache
+            if k_prev is not None:
+                k = concat([k_prev, k], axis=2)
+                v = concat([v_prev, v], axis=2)
+            cache = ("concat", k, v)
+            # bottom-right-aligned causal mask: an appended query row
+            # attends to the whole prefix plus its own causal tail
+            attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         attn = transpose(attn, [0, 2, 1, 3])
         attn = reshape(attn, [b, s, h])
         x = x + self.dropout(self.out_proj(attn))
         y = self.ln2(x)
         x = x + self.dropout(self.fc2(F.gelu(self.fc1(y), approximate=True)))
-        return x
+        return x if cache is None else (x, cache)
 
 
 class GPT(nn.Layer):
@@ -111,20 +148,142 @@ class GPT(nn.Layer):
             init.Normal(0.0, std)(blk.out_proj.weight)
             init.Normal(0.0, std)(blk.fc2.weight)
 
-    def forward(self, input_ids):
-        from ..ops import arange, matmul
+    def forward(self, input_ids, caches=None, pos_start=0):
+        import jax.numpy as jnp
+
+        from ..ops import matmul
 
         b, s = input_ids.shape
-        pos = arange(s, dtype="int64")
+        pos = Tensor(jnp.arange(pos_start, pos_start + s, dtype=jnp.int32))
         x = self.wte(input_ids) + self.wpe(pos)
-        for blk in self.blocks:
-            x = blk(x)
+        new_caches = [] if caches is not None else None
+        prealloc_mask = None
+        if caches:
+            from ..nn.layer.transformer import (PreallocKVCache,
+                                                kv_capacity_check,
+                                                kv_valid_mask)
+
+            if isinstance(caches[0], PreallocKVCache):
+                # every layer shares (length, s, max_length): build the
+                # validity mask and run the overflow check ONCE per step
+                smax = caches[0].k.shape[2]
+                kv_capacity_check(caches[0].length, s, smax)
+                prealloc_mask = kv_valid_mask(caches[0].length, s, smax)
+        for i, blk in enumerate(self.blocks):
+            if caches is None:
+                x = blk(x)
+            else:
+                x, c = blk(x, cache=caches[i],
+                           prealloc_mask=prealloc_mask)
+                new_caches.append(c)
         x = self.ln_f(x)
         if self.cfg.tie_embeddings:
             logits = matmul(x, self.wte.weight, transpose_y=True)
         else:
             logits = self.lm_head(x)
-        return logits
+        return logits if caches is None else (logits, new_caches)
+
+    def gen_caches(self, batch_size, mode="prealloc", max_length=None,
+                   dtype=None):
+        """Per-block decode caches.  ``prealloc`` allocates the full
+        ``max_length`` horizon once (in-place writes, shape-stable
+        steps); ``concat`` is the legacy growth cache.  ``dtype``
+        defaults to the model's weight dtype so bf16 models keep bf16
+        K/V (kv_cache_write casts into the buffer dtype — a f32 default
+        would silently upcast and break concat/prealloc parity)."""
+        if mode == "concat":
+            return [("concat", None, None) for _ in self.blocks]
+        if mode != "prealloc":
+            raise ValueError(
+                f"cache mode must be 'prealloc' or 'concat', got {mode!r}")
+        if max_length is None:
+            max_length = self.cfg.max_seq_len
+        if dtype is None:
+            dtype = str(self.wte.weight.dtype)
+        import jax.numpy as jnp
+
+        from ..nn.layer.transformer import PreallocKVCache
+        from ..ops import zeros
+
+        caches = []
+        for blk in self.blocks:
+            k = zeros([batch_size, blk.num_heads, int(max_length),
+                       blk.head_dim], dtype=dtype)
+            v = zeros([batch_size, blk.num_heads, int(max_length),
+                       blk.head_dim], dtype=dtype)
+            caches.append(PreallocKVCache(
+                k, v, Tensor(jnp.zeros((), jnp.int32))))
+        return caches
+
+    def generate(self, input_ids, max_new_tokens=32, eos_token_id=None,
+                 sampler="greedy", temperature=1.0, top_k=0, top_p=1.0,
+                 seed=0, use_cache="prealloc"):
+        """Eager autoregressive decode (the single-model counterpart of
+        `inference.serving.DecodeEngine`).  ``use_cache="concat"`` runs
+        the legacy concat-growth KV cache (the slow baseline measured by
+        tools/bench_decode.py); ``"prealloc"`` the in-place cache.
+        Returns generated token ids [B, <=max_new_tokens] int32."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..core import framework
+        from ..nn.decode import sample_logits
+
+        b, p_len = input_ids.shape
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if self.cfg.dropout and self.training:
+            # same contract as DecodeEngine: decoding under live dropout
+            # would corrupt tokens and break cache-mode parity
+            raise ValueError(
+                "generate() is inference only: call model.eval() first "
+                "(cfg.dropout > 0 and the model is in train mode)")
+        if p_len + max_new_tokens > self.cfg.max_seq_len:
+            # positions past the wpe table would silently clamp in the
+            # embedding gather and corrupt every later token
+            raise ValueError(
+                f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len {self.cfg.max_seq_len}")
+        if use_cache == "prealloc":
+            caches = self.gen_caches(b, "prealloc",
+                                     max_length=p_len + max_new_tokens)
+        elif use_cache == "concat":
+            caches = self.gen_caches(b, "concat")
+        else:
+            # a typo'd mode silently hitting the slow concat baseline
+            # would corrupt benchmarks — validate loudly
+            raise ValueError(
+                f"use_cache must be 'prealloc' or 'concat', "
+                f"got {use_cache!r}")
+        # inference only: without no_grad every step's ops would record
+        # tape nodes whose saved inputs pin whole KV buffers per step
+        with framework.no_grad_guard():
+            logits, caches = self(input_ids, caches=caches, pos_start=0)
+            key = jax.random.PRNGKey(seed)
+            last = logits[:, -1]._array.astype(jnp.float32)
+            out = []
+            finished = np.zeros(b, bool)
+            for step in range(max_new_tokens):
+                tok = np.asarray(sample_logits(
+                    last, sampler=sampler, temperature=temperature,
+                    top_k=top_k, top_p=top_p,
+                    key=jax.random.fold_in(key, step)))
+                if eos_token_id is not None:
+                    # rows that already finished keep emitting eos (the
+                    # per-request semantics of the serving engine,
+                    # padded)
+                    tok = np.where(finished, np.int32(eos_token_id), tok)
+                    finished |= tok == eos_token_id
+                out.append(tok)
+                if eos_token_id is not None and bool(finished.all()):
+                    break
+                if step == max_new_tokens - 1:
+                    break
+                logits, caches = self(Tensor(tok[:, None]), caches=caches,
+                                      pos_start=p_len + step)
+                last = logits[:, -1]._array.astype(jnp.float32)
+        return Tensor(jnp.asarray(np.stack(out, axis=1)))
 
 
 def gpt_loss_fn(model, input_ids, labels):

@@ -20,7 +20,42 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, unwrap
 from ..ops.misc import gather_tree
 
-__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+__all__ = ["BeamSearchDecoder", "dynamic_decode", "sample_logits"]
+
+
+def sample_logits(logits, sampler="greedy", temperature=1.0, top_k=0,
+                  top_p=1.0, key=None):
+    """Token sampling over vocab logits [B, V] -> [B] int32: ``greedy``
+    (deterministic argmax), ``top_k``, ``top_p`` (nucleus).  Shared by
+    eager `models.gpt.GPT.generate` and the serving engine
+    (`inference.serving.DecodeEngine`) so both decode paths draw from
+    the exact same distribution; stochastic samplers need ``key``."""
+    logits = unwrap(logits)
+    if sampler == "greedy":
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if key is None:
+        raise ValueError(f"sampler {sampler!r} needs a PRNG key")
+    logits = logits / jnp.maximum(jnp.float32(temperature), 1e-6)
+    if sampler == "top_k":
+        if int(top_k) < 1:
+            raise ValueError(
+                f"sampler 'top_k' needs top_k >= 1, got {top_k}")
+        # clamp to the vocab: k > V would raise deep inside lax.top_k
+        k = min(int(top_k), logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits, -1e30)
+        return jax.random.categorical(key, logits).astype(jnp.int32)
+    if sampler == "top_p":
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_l = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < jnp.float32(top_p)  # always keeps rank 0
+        filt = jnp.where(keep, sorted_l, -1e30)
+        pick = jax.random.categorical(key, filt)
+        return jnp.take_along_axis(order, pick[..., None],
+                                   axis=-1)[..., 0].astype(jnp.int32)
+    raise ValueError(f"unknown sampler {sampler!r}")
 
 
 class BeamSearchDecoder:
@@ -47,8 +82,14 @@ class BeamSearchDecoder:
 
         def tile(s):
             a = unwrap(s)
+            if a.ndim == 0:
+                # shared scalar state (e.g. a PreallocKVCache length):
+                # identical across beams, nothing to tile
+                return s
+            # explicit target shape: -1 can't be inferred for zero-size
+            # leaves (an empty concat-growth KV cache has a 0 dim)
             return Tensor(jnp.repeat(a[:, None], w, axis=1).reshape(
-                (-1,) + a.shape[1:]))
+                (a.shape[0] * w,) + a.shape[1:]))
 
         states = jax.tree_util.tree_map(
             tile, initial_states,
@@ -83,6 +124,11 @@ class BeamSearchDecoder:
 
         # reorder states by parent beam
         def reorder(s):
+            if unwrap(s).ndim == 0:
+                # shared scalar state (PreallocKVCache length): identical
+                # across beams, identity.  ONLY 0-d leaves are skipped —
+                # a mis-shaped per-beam leaf must still fail loudly below
+                return s
             a = unwrap(s).reshape((batch_size, w) + unwrap(s).shape[1:])
             out = jnp.take_along_axis(
                 a, parent.reshape((batch_size, w) +

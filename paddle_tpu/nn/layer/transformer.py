@@ -9,7 +9,15 @@ from __future__ import annotations
 
 import collections
 
-from ...core.tensor import Tensor
+# module level, NOT function-local: the op fns below close over these
+# names; a function-local `import jax` would put the MODULE object in a
+# closure cell, which the dispatch fingerprinter rejects as uncacheable
+# — every call would bypass the executable cache (review round 10)
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import dispatch
+from ...core.tensor import Tensor, unwrap
 from .. import functional as F
 from .common import Dropout, Linear
 from .layers import Layer
@@ -20,9 +28,93 @@ def _convert_attention_mask(attn_mask, dtype):
     return attn_mask
 
 
+# Preallocated KV cache (shared container + write/mask helpers):
+# `gen_cache(..., max_length=)` allocates the K/V buffers ONCE at the
+# decode horizon and every step writes its new rows via
+# `lax.dynamic_update_slice`.  The win over the concat-growth cache is
+# SHAPE STABILITY: every decode step has the same signature, so steps
+# 2..N replay one cached executable instead of retracing (concat's
+# growing shapes missed the dispatch cache every token).  The write
+# itself still produces a fresh XLA buffer (the generic dispatch path
+# cannot donate operands) — true in-place updates belong to the paged
+# serving engine, whose jitted step donates its page pools.  `length` is
+# a 0-d int32 Tensor (an array operand, not a python scalar) so all
+# decode steps share ONE cached executable per signature.
+PreallocKVCache = collections.namedtuple("PreallocKVCache",
+                                         ["k", "v", "length"])
+
+
+def kv_capacity_check(length, s_new, max_length):
+    """Loud eager-mode overflow check: dynamic_update_slice would CLAMP
+    an out-of-range start onto the last rows and kv_valid_mask would
+    expose the whole (stale) buffer — silent corruption.  Under a jit
+    trace the length is abstract and the caller owns the horizon
+    (GPT.generate and DecodeEngine both guard theirs).  NOTE: reading
+    the length forces a host sync — callers writing several buffers at
+    one position should check once and pass check_capacity=False to the
+    writes."""
+    ln = unwrap(length)
+    if not isinstance(ln, jax.core.Tracer) and \
+            int(ln) + s_new > max_length:
+        raise ValueError(
+            f"PreallocKVCache overflow: writing {s_new} rows at "
+            f"position {int(ln)} exceeds max_length {max_length}")
+
+
+def kv_cache_write(buf, new, length, check_capacity=True):
+    """Write `new` [B,H,s,D] into `buf` [B,H,Smax,D] at row `length`
+    (0-d int32 Tensor) — shape-stable for the dispatch cache (the
+    returned buffer is a fresh XLA allocation; see the module
+    comment)."""
+    if check_capacity:
+        kv_capacity_check(length, new.shape[2], buf.shape[2])
+
+    def f(b, n, s):
+        return jax.lax.dynamic_update_slice(b, n.astype(b.dtype),
+                                            (0, 0, s, 0))
+
+    return dispatch(f, buf, new, length, nondiff=(2,))
+
+
+def kv_valid_mask(length, s_new, max_length, causal=True):
+    """Bool mask [1,1,s_new,max_length] over a preallocated KV buffer.
+
+    ``causal=True``: key j visible to new query row i iff
+    j <= length + i — buffer validity plus causality within the
+    appended chunk (the decoder-block contract, used by GPT).
+
+    ``causal=False``: key j visible iff j < length + s_new — buffer
+    validity only, matching the legacy concat ``Cache`` contract where
+    within-chunk causality is the caller's attn_mask's business."""
+
+    def f(ln):
+        kpos = jax.lax.broadcasted_iota(jnp.int32,
+                                        (1, 1, s_new, max_length), 3)
+        if causal:
+            qpos = jax.lax.broadcasted_iota(jnp.int32,
+                                            (1, 1, s_new, max_length), 2)
+            return kpos <= ln + qpos
+        return kpos < ln + s_new
+
+    return dispatch(f, length)
+
+
+def _combine_masks(valid, user_mask):
+    if user_mask is None:
+        return valid
+    if user_mask.dtype == jnp.bool_:
+        return dispatch(lambda a, b: a & b, valid, user_mask)
+    # additive float mask: invalid positions forced to -1e30
+    return dispatch(
+        lambda a, m: jnp.where(a, m.astype(jnp.float32),
+                               jnp.float32(-1e30)),
+        valid, user_mask)
+
+
 class MultiHeadAttention(Layer):
     Cache = collections.namedtuple("Cache", ["k", "v"])
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+    PreallocCache = PreallocKVCache
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None):
@@ -47,15 +139,21 @@ class MultiHeadAttention(Layer):
         x = reshape(x, [b, s, self.num_heads, self.head_dim])
         return transpose(x, [0, 2, 1, 3])  # [B, H, S, D]
 
-    def gen_cache(self, key, value=None, type=None):
-        from ...ops import concat
-
+    def gen_cache(self, key, value=None, type=None, max_length=None):
         if type == MultiHeadAttention.StaticCache:
             k, v = self._shape(self.k_proj(key)), self._shape(self.v_proj(value if value is not None else key))
             return self.StaticCache(k, v)
         from ...ops import zeros
 
         b = key.shape[0]
+        if max_length is not None:
+            # preallocated cache: one buffer for the whole decode
+            # horizon, written via dynamic_update_slice
+            k = zeros([b, self.num_heads, int(max_length), self.head_dim],
+                      dtype=str(key.dtype))
+            v = zeros([b, self.num_heads, int(max_length), self.head_dim],
+                      dtype=str(key.dtype))
+            return self.PreallocCache(k, v, Tensor(jnp.zeros((), jnp.int32)))
         k = zeros([b, self.num_heads, 0, self.head_dim], dtype=str(key.dtype))
         v = zeros([b, self.num_heads, 0, self.head_dim], dtype=str(key.dtype))
         return self.Cache(k, v)
@@ -72,7 +170,24 @@ class MultiHeadAttention(Layer):
         else:
             k = self._shape(self.k_proj(key))
             v = self._shape(self.v_proj(value))
-            if isinstance(cache, self.Cache):
+            if isinstance(cache, self.PreallocCache):
+                s_new = k.shape[2]
+                # one capacity check (host sync) covers both writes
+                kv_capacity_check(cache.length, s_new, cache.k.shape[2])
+                full_k = kv_cache_write(cache.k, k, cache.length,
+                                        check_capacity=False)
+                full_v = kv_cache_write(cache.v, v, cache.length,
+                                        check_capacity=False)
+                # buffer-validity only (causal=False): the legacy Cache
+                # contract leaves within-chunk causality to the caller's
+                # attn_mask, and a drop-in replacement must too
+                valid = kv_valid_mask(cache.length, s_new,
+                                      full_k.shape[2], causal=False)
+                attn_mask = _combine_masks(valid, attn_mask)
+                k, v = full_k, full_v
+                cache = self.PreallocCache(full_k, full_v,
+                                           cache.length + s_new)
+            elif isinstance(cache, self.Cache):
                 k = concat([cache.k, k], axis=2)
                 v = concat([cache.v, v], axis=2)
                 cache = self.Cache(k, v)

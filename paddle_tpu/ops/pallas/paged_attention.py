@@ -1,0 +1,283 @@
+"""Ragged paged-attention decode kernel (TPU Pallas) + XLA reference.
+
+The serving-side complement of flash_attention.py: decode-step attention
+over a paged KV cache ("Ragged Paged Attention", PAPERS.md).  K/V live in
+HBM as fixed-size pages indexed by a per-sequence block table; each
+sequence in the batch has its own length (ragged batch), and query heads
+may outnumber KV heads (grouped-query attention).
+
+Kernel shape (the TPU paged-decode idiom):
+
+* grid ``(batch, kv_heads, pages_max)`` with the page axis fastest;
+* the block table and sequence lengths ride in as **scalar-prefetch**
+  operands (`pltpu.PrefetchScalarGridSpec`) so the K/V BlockSpec index
+  maps can translate the streamed page number through the block table —
+  the gather indirection happens in the DMA engine, not in compute;
+* pages past a sequence's live range clamp their fetch index to the last
+  live page (Pallas skips the re-fetch when the index repeats — same
+  dead-block trick as flash_attention's causal clamps) and gate compute
+  off with ``pl.when``;
+* per-(batch, kv-head) online-softmax state (f32 acc / running max /
+  running sum) stays resident in VMEM scratch across the page stream, so
+  VMEM usage is constant in sequence length.
+
+The XLA reference (`_xla_paged_attention`) is the numerics ground truth
+and the CPU path; it mirrors `nn.functional.attention._sdpa_reference`'s
+cast discipline exactly (scale in input dtype, f32 softmax) so the paged
+engine bit-matches the eager concat-cache decode path.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from . import flash_attention as fa
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+_LANES = 128
+# minimum query-group rows per compute tile: pad the GQA group dim up to
+# the f32 sublane tile (8) so the [group, head_dim] blocks map onto the
+# VPU/MXU without sub-tile layouts
+_MIN_GROUP_ROWS = 8
+# page floor: below 16 tokens the per-page DMA descriptor overhead beats
+# the payload (the page-axis analog of pick_blocks' 128 floor)
+_MIN_PAGE_SIZE = 16
+_DEFAULT_PAGE_SIZE = 64
+
+
+# ---------------------------------------------------------------------------
+# Page-size selection — the pick_blocks/cached_blocks machinery from
+# flash_attention applied to the page axis: explicit caller values win,
+# then a measured winner from the shared autotune cache (validated through
+# the same shrink rules so a stale/hand-edited entry degrades instead of
+# crashing the pool constructor), then the power-of-two shrink default.
+# ---------------------------------------------------------------------------
+def pick_page_size(max_len: int, page_size: int = _DEFAULT_PAGE_SIZE):
+    """Largest power-of-two page (floor _MIN_PAGE_SIZE) that tiles
+    ``max_len`` — pick_blocks' shrink rule on the page axis; None when no
+    page size tiles the budget."""
+    while page_size > _MIN_PAGE_SIZE and max_len % page_size:
+        page_size //= 2
+    if page_size < _MIN_PAGE_SIZE or max_len % page_size:
+        return None
+    return page_size
+
+
+def _paged_key(max_len, d, dtype):
+    return f"paged:{max_len}x{d}:{jnp.dtype(dtype).name}"
+
+
+def cached_page_size(max_len, d, dtype):
+    """Measured page size for this (max_len, head_dim, dtype) from the
+    shared flash autotune cache (tools/bench_decode.py commits winners),
+    or None.  Entries must survive `pick_page_size`'s floor/tiling rules
+    — the same validation discipline as flash_attention.cached_blocks."""
+    ent = fa._load_autotune().get(_paged_key(max_len, d, dtype))
+    try:
+        ps = int(ent[0]) if isinstance(ent, (list, tuple)) else int(ent)
+    except (TypeError, ValueError, IndexError):
+        return None
+    if pick_page_size(max_len, ps) != ps:
+        return None
+    return ps
+
+
+def default_page_size(max_len, d, dtype=jnp.float32):
+    """The page size the serving pool uses when the caller doesn't pick:
+    measured winner if cached, else the shrink default."""
+    return cached_page_size(max_len, d, dtype) or \
+        pick_page_size(max_len) or _MIN_PAGE_SIZE
+
+
+# ---------------------------------------------------------------------------
+# XLA reference — CPU path and parity ground truth
+# ---------------------------------------------------------------------------
+def _xla_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                         scale=None):
+    """q: [B, Hq, D]; k_pages/v_pages: [Hkv, num_pages, page, D];
+    block_tables: [B, pages_max] int32; seq_lens: [B] int32 (valid KV
+    tokens per sequence; 0 = inactive slot -> zero output).
+    Returns [B, Hq, D].
+
+    Mirrors _sdpa_reference's numerics: logits scaled in the input dtype,
+    masked + softmaxed in f32, probs cast back — a sequence's output is
+    bit-identical to dense attention over its first ``seq_len`` tokens.
+    """
+    hkv, _, page, d = k_pages.shape
+    b, hq, _ = q.shape
+    g = hq // hkv
+    s = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    # gather each sequence's pages: [Hkv, B, pages_max, page, D]
+    k = k_pages[:, block_tables]
+    v = v_pages[:, block_tables]
+    k = jnp.moveaxis(k, 1, 0).reshape(b, hkv, -1, d)
+    v = jnp.moveaxis(v, 1, 0).reshape(b, hkv, -1, d)
+    qg = q.reshape(b, hkv, g, d)
+    logits = jnp.einsum("bhgd,bhsd->bhgs", qg, k) * s
+    logits = logits.astype(jnp.float32)
+    pos = jnp.arange(k.shape[2], dtype=jnp.int32)
+    valid = pos[None, :] < seq_lens[:, None]  # [B, S]
+    logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    # a fully-masked row (seq_len == 0) softmaxes to uniform; zero it so
+    # inactive slots emit exact zeros instead of the page-pool mean
+    probs = jnp.where(valid[:, None, None, :], probs,
+                      jnp.zeros((), probs.dtype))
+    out = jnp.einsum("bhgs,bhsd->bhgd", probs, v)
+    return out.reshape(b, hq, d)
+
+
+# ---------------------------------------------------------------------------
+# Pallas decode kernel
+# ---------------------------------------------------------------------------
+def _decode_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc, m_scr, l_scr, *, page, pages_max, scale):
+    # grid (b, h_kv, p): one KV page streams through VMEM per step while
+    # the (b, h)-pinned query tile and f32 softmax state stay resident
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    sl = sl_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    @pl.when(p * page < sl)
+    def _compute():
+        q = q_ref[...].astype(jnp.float32) * scale    # [gp, d]
+        k = k_ref[...].astype(jnp.float32)            # [page, d]
+        v = v_ref[...].astype(jnp.float32)
+        m = m_scr[...][:, 0]
+        l = l_scr[...][:, 0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [gp, page]
+        pos = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page), 1)[0]
+        logits = jnp.where((pos < sl)[None, :], logits, -1e30)
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        pr = jnp.exp(logits - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(pr, axis=-1)
+        acc[...] = acc[...] * alpha[:, None] + jax.lax.dot_general(
+            pr, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(p == pages_max - 1)
+    def _flush():
+        l = l_scr[...][:, 0]
+        o_ref[...] = (acc[...] / jnp.maximum(l, 1e-30)[:, None]
+                      ).astype(o_ref.dtype)
+
+
+def _pallas_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                            scale=None):
+    hkv, num_pages, page, d = k_pages.shape
+    b, hq, _ = q.shape
+    g = hq // hkv
+    gp = max(_MIN_GROUP_ROWS, g)
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qg = q.reshape(b, hkv, g, d)
+    if gp != g:
+        # pad the query-group rows up to the sublane tile; padded rows
+        # compute garbage that is sliced away after the call
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    pages_max = block_tables.shape[1]
+    block_tables = block_tables.astype(jnp.int32)
+    seq_lens = seq_lens.astype(jnp.int32)
+
+    def q_map(bi, h, p, bt, sl):
+        return (bi, h, 0, 0)
+
+    def kv_map(bi, h, p, bt, sl):
+        # dead pages clamp to the last live page: the repeated index
+        # skips the DMA (flash_attention's dead-block clamp, paged form).
+        # max(live, 1) keeps a zero-length slot pointing at a real page.
+        live = jnp.maximum((sl[bi] + page - 1) // page, 1)
+        return (h, bt[bi, jnp.minimum(p, live - 1)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, pages_max),
+        in_specs=[
+            pl.BlockSpec((None, None, gp, d), q_map),
+            pl.BlockSpec((None, None, page, d), kv_map),
+            pl.BlockSpec((None, None, page, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((None, None, gp, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((gp, d), jnp.float32),
+            pltpu.VMEM((gp, _LANES), jnp.float32),
+            pltpu.VMEM((gp, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, page=page, pages_max=pages_max,
+                          scale=s),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), q.dtype),
+    )(block_tables, seq_lens, qg, k_pages, v_pages)
+    return out[:, :, :g, :].reshape(b, hq, d)
+
+
+# ---------------------------------------------------------------------------
+# public entry point
+# ---------------------------------------------------------------------------
+def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                    scale=None):
+    """Decode-step attention over a paged KV cache.
+
+    q: [B, Hq, D] (one query token per sequence);
+    k_pages/v_pages: [Hkv, num_pages, page_size, D];
+    block_tables: [B, pages_max] int32 page ids in position order;
+    seq_lens: [B] int32 valid KV tokens per sequence (0 = inactive slot).
+
+    Hq must be a multiple of Hkv (grouped-query attention).  Uses the
+    Pallas kernel on TPU (FLAGS_use_pallas_attention '1'/'auto'; '0'
+    forces the reference), the XLA reference elsewhere.
+    """
+    hkv, _, page, d = k_pages.shape
+    b, hq, dq = q.shape
+    if hq % hkv:
+        raise ValueError(
+            f"query heads {hq} not a multiple of kv heads {hkv}")
+    if dq != d:
+        raise ValueError(f"head_dim mismatch: q {dq} vs pages {d}")
+    if _paged_kernel_wanted():
+        return _pallas_paged_attention(q, k_pages, v_pages, block_tables,
+                                       seq_lens, scale)
+    return _xla_paged_attention(q, k_pages, v_pages, block_tables,
+                                seq_lens, scale)
+
+
+def _paged_kernel_wanted() -> bool:
+    # decode over pages has no composed-XLA crossover to respect (the
+    # gather alone re-materializes the whole cache), so 'auto' means ON;
+    # '0' still forces the reference for debugging
+    from ...core import flags as _flags
+
+    if not _HAS_PALLAS or jax.default_backend() != "tpu":
+        return False
+    try:
+        pol = str(_flags.flag("use_pallas_attention"))
+    except Exception:
+        return False
+    return pol in ("1", "True", "true", "auto")

@@ -35,7 +35,49 @@ __all__ = [
     "summary_string", "export_chrome_tracing",
     "dispatch_stats", "dispatch_summary_string", "reset_dispatch_stats",
     "clear_dispatch_cache", "dispatch_cache_size",
+    "decode_stats", "reset_decode_stats",
 ]
+
+
+# Decode-telemetry schema, shared with inference.serving (which builds
+# its live counter dict from these) so the not-imported fallback below
+# can never silently diverge from the real key set.
+DECODE_STAT_COUNTERS = (
+    "steps", "tokens", "prefills", "decode_time_s", "prefill_time_s",
+    "decode_compiles", "prefill_compiles", "retraces_after_warmup",
+    "occupancy_sum", "kv_util_sum",
+)
+DECODE_STAT_DERIVED = ("avg_step_ms", "batch_occupancy",
+                       "kv_block_utilization")
+
+
+def _decode_stat_zero(key):
+    return 0.0 if key.endswith(("_s", "_sum", "_ms")) or \
+        key in DECODE_STAT_DERIVED else 0
+
+
+def decode_stats(reset=False):
+    """Serving-loop telemetry (inference.serving.DecodeEngine): decode
+    step latency, batch occupancy, KV-block utilization, executable
+    compile/retrace counts.  If no engine was ever created in this
+    process, returns all-zero counters WITHOUT importing the serving
+    module (a telemetry poller must not pay the engine's import)."""
+    import sys
+
+    mod = sys.modules.get("paddle_tpu.inference.serving")
+    if mod is None:
+        return {k: _decode_stat_zero(k)
+                for k in DECODE_STAT_COUNTERS + DECODE_STAT_DERIVED}
+    return mod.decode_stats(reset)
+
+
+def reset_decode_stats():
+    import sys
+
+    mod = sys.modules.get("paddle_tpu.inference.serving")
+    if mod is not None:
+        mod.reset_decode_stats()
+
 
 _state = {"device": False}
 

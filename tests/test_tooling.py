@@ -89,6 +89,35 @@ def test_bench_eager_smoke(tmp_path):
         assert cfg["per_op_speedup"] > 0
 
 
+def test_bench_decode_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_decode.py runs end-to-end: the decode
+    bench can't rot.  Asserts the emitted JSON shape, greedy parity
+    across all three decode paths, and the serving loop's steady-state
+    contract (zero retraces after warmup) at smoke scale."""
+    out = str(tmp_path / "bench_decode.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_decode.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    assert data["parity"] is True
+    legs = data["legs"]
+    assert set(legs) == {"concat", "prealloc", "paged_engine"}
+    for leg in legs.values():
+        assert leg["tokens_per_s"] > 0 and leg["wall_s"] > 0
+    assert legs["prealloc"]["speedup_vs_concat"] > 0
+    assert legs["paged_engine"]["speedup_vs_concat"] > 0
+    tel = legs["paged_engine"]["telemetry"]
+    assert tel["retraces_after_warmup"] == 0
+    assert tel["steps"] > 0
+    assert 0 < tel["batch_occupancy"] <= 1
+    assert 0 < tel["kv_block_utilization"] <= 1
+    assert data["page_size_sweep"], "page-size sweep must record rows"
+
+
 def test_op_bench_gate_device_mismatch(tmp_path):
     """Cross-device comparisons are incommensurable (a CPU run vs a TPU
     baseline); the checker must refuse rather than mis-gate."""

@@ -1,0 +1,234 @@
+"""Decode throughput benchmark: concat-growth KV cache vs preallocated
+in-place cache vs the paged continuous-batching engine.
+
+Measures greedy decode tokens/sec for a GPT at a given prompt context,
+across the three decode paths this repo supports:
+
+* ``concat``   — the legacy concat-growth cache (`GPT.generate
+  use_cache="concat"`): O(S^2) KV reallocation over a generation AND a
+  fresh executable per step (every step's shapes differ, so nothing hits
+  the eager dispatch cache);
+* ``prealloc`` — the preallocated in-place cache (`use_cache=
+  "prealloc"`): shape-stable steps, every op a dispatch-cache hit;
+* ``paged_engine`` — `inference.serving.DecodeEngine`: the whole step
+  (page gather, ragged paged attention, sampling, cache write) is ONE
+  donated jitted executable.
+
+Emits BENCH_decode.json; greedy parity across all three legs is
+asserted, and the engine leg snapshots profiler.decode_stats (zero
+retraces after warmup is part of the acceptance contract).  On a TPU
+backend the page-size sweep winner is committed to the shared
+flash_autotune_cache.json under the ``paged:`` key namespace
+(paged_attention.cached_page_size consumes it); CPU sweeps are recorded
+in the JSON only, never committed.
+
+Usage:
+    python tools/bench_decode.py [--out BENCH_decode.json]
+                                 [--context 1024] [--new-tokens 32]
+                                 [--batch 2] [--smoke]
+
+``--smoke`` (or env BENCH_SMOKE=1) shrinks shapes so CI can assert the
+script end-to-end (tests/test_tooling.py).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models.gpt import GPT, GPTConfig  # noqa: E402
+
+
+def _build_model(args):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.context + args.new_tokens + 64,
+                    use_parallel_layers=False, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    return model
+
+
+def _bench_eager(model, ids, n_new, mode, warm):
+    if warm:
+        # prealloc keys its executables on the KV buffer shape
+        # [B,H,p_len+max_new,D], so its warm run must use the SAME
+        # horizon as the timed run or the first timed step retraces
+        # everything.  concat is warmed only through the shared prefill
+        # + first steps: its per-step retraces on fresh shapes ARE the
+        # steady-state cost being measured.
+        warm_new = n_new if mode == "prealloc" else min(n_new, 4)
+        model.generate(ids, max_new_tokens=warm_new, use_cache=mode)
+    t0 = time.perf_counter()
+    toks = model.generate(ids, max_new_tokens=n_new, use_cache=mode)
+    wall = time.perf_counter() - t0
+    toks = np.asarray(toks.numpy())
+    return wall, toks
+
+
+def _round_up(n, m):
+    return -(-n // m) * m
+
+
+def _bench_engine(model, prompts, n_new, max_len, page_size):
+    from paddle_tpu.inference.serving import (DecodeEngine, decode_stats,
+                                              reset_decode_stats)
+
+    eng = DecodeEngine(model, max_batch_size=len(prompts),
+                       max_seq_len=_round_up(max_len, page_size),
+                       page_size=page_size)
+    eng.generate(prompts, max_new_tokens=min(n_new, 4))  # warm executables
+    reset_decode_stats()
+    t0 = time.perf_counter()
+    outs = eng.generate(prompts, max_new_tokens=n_new)
+    wall = time.perf_counter() - t0
+    return wall, outs, decode_stats()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_decode.json"))
+    ap.add_argument("--context", type=int, default=1024)
+    ap.add_argument("--new-tokens", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--page-sizes", default="16,32,64")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes: CI end-to-end check")
+    args = ap.parse_args()
+    if os.environ.get("BENCH_SMOKE") == "1":
+        args.smoke = True
+    if args.smoke:
+        args.context, args.new_tokens, args.batch = 64, 6, 1
+        args.hidden, args.vocab = 64, 128
+        if args.page_sizes == ap.get_default("page_sizes"):
+            args.page_sizes = "16,32"  # respect an explicit override
+
+    import jax
+
+    model = _build_model(args)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, args.vocab,
+                         (args.batch, args.context)).astype(np.int32)
+    ids = paddle.to_tensor(prompt)
+    n_new = args.new_tokens
+    max_len = args.context + n_new
+
+    legs = {}
+    # the concat leg is warmed too: its per-step retraces are the cost
+    # being measured, but the shared prefill compile is not — leaving it
+    # cold would inflate the other legs' speedups asymmetrically
+    wall_c, toks_c = _bench_eager(model, ids, n_new, "concat", warm=True)
+    total = args.batch * toks_c.shape[1]
+    legs["concat"] = {"wall_s": round(wall_c, 4),
+                      "tokens_per_s": round(total / wall_c, 2)}
+    print(f"concat   : {total / wall_c:9.1f} tok/s  ({wall_c:.2f}s)")
+
+    wall_p, toks_p = _bench_eager(model, ids, n_new, "prealloc", warm=True)
+    legs["prealloc"] = {
+        "wall_s": round(wall_p, 4),
+        "tokens_per_s": round(total / wall_p, 2),
+        "speedup_vs_concat": round(wall_c / wall_p, 2)}
+    print(f"prealloc : {total / wall_p:9.1f} tok/s  "
+          f"({wall_c / wall_p:.1f}x vs concat)")
+
+    # page-size sweep for the engine leg (the paged analog of
+    # bench_kernels' block sweep); winner committed to the shared
+    # autotune cache on TPU backends only
+    sweep = []
+    best = None
+    candidates = [
+        ps for ps in sorted({int(p) for p in args.page_sizes.split(",")
+                             if p})
+        if ps <= max_len
+        and _round_up(max_len, ps) <= model.cfg.max_seq_len]
+    if not candidates:
+        ap.error(f"--page-sizes {args.page_sizes!r}: no entry tiles "
+                 f"context+new_tokens ({max_len}) within the model's "
+                 f"position table ({model.cfg.max_seq_len})")
+    for ps in candidates:
+        wall_e, outs_e, stats = _bench_engine(
+            model, list(prompt), n_new, max_len, ps)
+        row = {"page_size": ps, "wall_s": round(wall_e, 4),
+               "tokens_per_s": round(total / wall_e, 2)}
+        sweep.append(row)
+        print(f"engine ps={ps:3d}: {total / wall_e:9.1f} tok/s")
+        if best is None or wall_e < best[0]:
+            best = (wall_e, ps, outs_e, stats)
+    wall_e, best_ps, outs_e, stats = best
+    telemetry = {k: stats[k] for k in
+                 ("steps", "tokens", "decode_compiles", "prefill_compiles",
+                  "retraces_after_warmup", "avg_step_ms",
+                  "batch_occupancy", "kv_block_utilization")}
+    legs["paged_engine"] = {
+        "wall_s": round(wall_e, 4),
+        "tokens_per_s": round(total / wall_e, 2),
+        "speedup_vs_concat": round(wall_c / wall_e, 2),
+        "page_size": best_ps,
+        "telemetry": telemetry}
+    print(f"engine   : {total / wall_e:9.1f} tok/s  "
+          f"({wall_c / wall_e:.1f}x vs concat, page={best_ps}, "
+          f"warm retraces={telemetry['retraces_after_warmup']})")
+
+    parity = bool(
+        (toks_c == toks_p).all()
+        and all(list(toks_c[i]) == outs_e[i] for i in range(args.batch)))
+
+    out = {
+        "bench": "gpt_decode greedy tokens/sec",
+        "device": str(jax.devices()[0].device_kind)
+        if jax.devices() else "unknown",
+        "smoke": bool(args.smoke),
+        "config": {"batch": args.batch, "context": args.context,
+                   "new_tokens": n_new, "layers": args.layers,
+                   "hidden": args.hidden, "heads": args.heads,
+                   "vocab": args.vocab},
+        "legs": legs,
+        "page_size_sweep": sweep,
+        "parity": parity,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {args.out} (parity={parity})")
+
+    if jax.default_backend() == "tpu":
+        # commit the measured page size the way bench_kernels commits
+        # block sizes — merged, so other shapes/dtypes survive a re-run
+        from paddle_tpu.ops.pallas import flash_attention as fa
+        from paddle_tpu.ops.pallas import paged_attention as pa
+
+        entries = {}
+        try:
+            with open(fa._AUTOTUNE_FILE) as f:
+                entries.update(json.load(f).get("entries", {}))
+        except (OSError, ValueError):
+            pass
+        head_dim = args.hidden // args.heads
+        key = pa._paged_key(_round_up(max_len, best_ps), head_dim,
+                            np.float32)
+        entries[key] = best_ps
+        with open(fa._AUTOTUNE_FILE, "w") as f:
+            json.dump({"device": str(jax.devices()[0]),
+                       "objective": "decode tokens/sec (bench_decode)",
+                       "entries": entries}, f, indent=1)
+        print(f"committed page_size={best_ps} to {fa._AUTOTUNE_FILE}")
+
+    if not parity:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,8 @@
-"""Head-to-head kernel benchmark: Pallas flash attention vs XLA composed.
+"""Head-to-head kernel benchmark: Pallas kernels vs their XLA forms.
 
-Measures fwd+bwd (training) step time for causal self-attention at the
-BASELINE bench shapes and writes BENCH_kernels.json at the repo root.
+Measures fwd+bwd (training) step time for causal flash attention and
+forward time for the fused layer_norm kernel at the BASELINE bench
+shapes, and writes BENCH_kernels.json at the repo root.
 Run on a real TPU chip:  python tools/bench_kernels.py
 """
 import functools
@@ -16,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.ops.pallas import flash_attention as fa
+from paddle_tpu.ops.pallas import layer_norm as LN
 
 
 def timeit(attn, q, k, v, g, iters=20, reps=3):
@@ -44,6 +46,59 @@ def timeit(attn, q, k, v, g, iters=20, reps=3):
         float(bench(qr, k, v, g))
         times.append((time.perf_counter() - t0) / iters)
     return sorted(times)[len(times) // 2]
+
+
+def timeit_fwd(fn, x, w, b, iters=50, reps=3):
+    # same async-read-back discipline as the attention timeit: one
+    # compiled chain whose iterations depend on each other
+    @jax.jit
+    def bench(x, w, b):
+        def body(_, carry):
+            y = fn(carry, w, b)
+            return carry + 1e-6 * y
+
+        x = jax.lax.fori_loop(0, iters, body, x)
+        return jnp.sum(x.astype(jnp.float32))
+
+    float(bench(x + 1.0, w, b))  # compile + warm
+    times = []
+    for r in range(reps):
+        t0 = time.perf_counter()
+        float(bench(x + 1e-3 * r, w, b))
+        times.append((time.perf_counter() - t0) / iters)
+    return sorted(times)[len(times) // 2]
+
+
+def bench_layer_norm():
+    """Pallas fused layer_norm vs the XLA composed form (forward path —
+    the kernel's backward is an XLA recompute by design)."""
+    rows_d = ((8192, 1024), (16384, 4096), (32768, 8192))
+    out = []
+    for rows, d in rows_d:
+        key = jax.random.PRNGKey(rows + d)
+        x = jax.random.normal(key, (rows, d), jnp.bfloat16)
+        w = jnp.ones((d,), jnp.float32)
+        b = jnp.zeros((d,), jnp.float32)
+        row = {"shape": f"{rows}x{d}", "dtype": "bf16"}
+        try:
+            t_pl = timeit_fwd(
+                lambda a, ww, bb: LN._fwd_pallas(a, ww, bb, 1e-5),
+                x, w, b)
+            row["pallas_ms"] = round(t_pl * 1e3, 4)
+        except Exception as e:  # noqa: BLE001
+            print(f"layer_norm {rows}x{d} pallas failed: "
+                  f"{type(e).__name__}")
+            t_pl = None
+            row["pallas_ms"] = None
+        t_xla = timeit_fwd(
+            lambda a, ww, bb: LN._fwd_xla(a, ww, bb, 1e-5), x, w, b)
+        row["xla_ms"] = round(t_xla * 1e3, 4)
+        if t_pl:
+            row["pallas_speedup_vs_xla"] = round(t_xla / t_pl, 3)
+            row["winner"] = "pallas" if t_xla > t_pl else "xla"
+        out.append(row)
+        print(row)
+    return out
 
 
 def main():
@@ -106,6 +161,7 @@ def main():
         "bench": "flash_attention fwd+bwd (train step), causal",
         "device": str(jax.devices()[0]),
         "results": results,
+        "layer_norm": bench_layer_norm(),
     }
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with open(os.path.join(root, "BENCH_kernels.json"), "w") as f:
