@@ -105,6 +105,19 @@ define_flag("interp_tensor_array_capacity", 0,
             "fallback capacity for TensorArrays written inside an "
             "interpreted `while` when the loop bound cannot be inferred "
             "from the Condition (0 = raise instead)")
+define_flag("spec_decode_k", 0,
+            "speculative decoding draft length for the serving engine "
+            "(inference.serving.DecodeEngine): propose K tokens per step "
+            "and verify them in one multi-query pass (0 = off, classic "
+            "one-token-per-step decode).  Engines constructed with an "
+            "explicit spec_decode_k ignore the flag")
+define_flag("spec_drafter", "prompt_lookup",
+            "drafter the engine builds when speculative decoding is on "
+            "and no Drafter instance is passed: 'prompt_lookup' (model-"
+            "free n-gram lookup over each request's own token history; "
+            "see inference.speculative.PromptLookupDrafter).  A draft-"
+            "model drafter must be passed as an instance (it needs the "
+            "draft GPT's weights)")
 define_flag("use_rbg_rng", True,
             "on TPU, use the hardware RBG PRNG for the framework's random "
             "ops instead of threefry (measured: recovers ~60% of dropout's "

@@ -26,7 +26,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
-           "PrecisionType", "PlaceType", "serving"]
+           "PrecisionType", "PlaceType", "serving", "speculative"]
 
 
 class PrecisionType:
@@ -401,9 +401,9 @@ def __getattr__(name):
     # Pallas kernel chain) into every `import paddle_tpu`.  Must go
     # through importlib — a `from . import serving` here would re-enter
     # this __getattr__ via _handle_fromlist and recurse.
-    if name == "serving":
+    if name in ("serving", "speculative"):
         import importlib
 
-        return importlib.import_module(".serving", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

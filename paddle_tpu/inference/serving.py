@@ -17,8 +17,12 @@ Layers:
 * `Request` / `DecodeEngine` — continuous batching over a fixed slot
   grid: prefill per admitted request (bucket-padded so prompt lengths
   share executables), then batched decode steps over every active slot;
+  with ``spec_decode_k > 0`` (or FLAGS_spec_decode_k) each step becomes
+  a speculative propose->verify->accept round (`inference.speculative`)
+  emitting up to K+1 tokens per slot;
 * telemetry — step latency, batch occupancy, KV-block utilization and
-  executable (re)compilation counts, surfaced through
+  executable (re)compilation counts, plus speculative acceptance rates
+  and per-request finish reasons, surfaced through
   `paddle_tpu.profiler.decode_stats`.
 
 Numerics deliberately mirror the eager GPT path op for op (same
@@ -69,6 +73,14 @@ def decode_stats(reset=False):
     out["avg_step_ms"] = out["decode_time_s"] / steps * 1e3
     out["batch_occupancy"] = out["occupancy_sum"] / steps
     out["kv_block_utilization"] = out["kv_util_sum"] / steps
+    # speculative decoding: fraction of drafted tokens the verify pass
+    # accepted, and tokens emitted per active slot per verify step
+    # (1.0 == a classic non-speculative step, K+1 is the ceiling; this
+    # number IS the speedup lever)
+    out["acceptance_rate"] = out["spec_accepted"] / max(
+        out["spec_proposed"], 1)
+    out["mean_accepted_per_step"] = out["spec_emitted"] / max(
+        out["spec_slot_steps"], 1)
     if reset:
         reset_decode_stats()
     return out
@@ -83,6 +95,33 @@ def reset_decode_stats():
 # not depend on the serving module); re-exported here for the engine's
 # public surface.
 from ..nn.decode import sample_logits  # noqa: E402
+
+
+class _JitTracker:
+    """Retrace telemetry for one jitted step executable.  Counts ACTUAL
+    XLA compiles (the jit's own trace-cache size) — a dtype/weak_type
+    flapping in the step operands would recompile inside the same jitted
+    wrapper and must not go unnoticed.  Growth after the first call
+    lands in ``retraces_after_warmup``; the contract covers the decode
+    step AND the speculative draft/verify executables
+    (inference.speculative) identically."""
+
+    def __init__(self, fn, compile_key):
+        self.fn = fn
+        self._seen = 0
+        self._warm = False
+        _STATS[compile_key] += 1
+
+    def check_retrace(self):
+        """Call after every invocation of ``fn``."""
+        try:
+            n = self.fn._cache_size()
+        except AttributeError:  # older jax without _cache_size
+            n = 1
+        if self._warm and n > self._seen:
+            _STATS["retraces_after_warmup"] += n - self._seen
+        self._seen = n
+        self._warm = True
 
 
 # ---------------------------------------------------------------------------
@@ -121,7 +160,12 @@ class KVBlockPool:
 
 class Request:
     """One generation request moving through the engine:
-    queued -> running (bound to a slot + pages) -> done."""
+    queued -> running (bound to a slot + pages) -> done.
+
+    ``finish_reason`` records WHY a request left the engine — "eos"
+    (hit its eos token), "length" (max_new_tokens exhausted), or
+    "evicted" (cancelled via `DecodeEngine.evict`) — so callers can
+    tell a completed generation from a truncated one."""
 
     _next_id = 0
 
@@ -131,6 +175,7 @@ class Request:
         self.eos_token_id = eos_token_id
         self.output_ids: List[int] = []
         self.state = "queued"
+        self.finish_reason: Optional[str] = None
         self.slot: Optional[int] = None
         self.pages: List[int] = []
         self.request_id = Request._next_id
@@ -305,7 +350,8 @@ class DecodeEngine:
     def __init__(self, model, max_batch_size=4, max_seq_len=None,
                  page_size=None, num_pages=None, sampler="greedy",
                  temperature=1.0, top_k=0, top_p=1.0, seed=0,
-                 eos_token_id=None, dtype=None):
+                 eos_token_id=None, dtype=None, spec_decode_k=None,
+                 drafter=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -356,8 +402,27 @@ class DecodeEngine:
         self._queue: "deque[Request]" = deque()
         self._decode_fn = None  # shapes are fixed: ONE jitted step
         self._prefill_fns = {}
-        self._warm = False
-        self._decode_jit_compiles = 0  # actual XLA compiles observed
+
+        # speculative decoding (propose K / verify in one multi-query
+        # pass): explicit arg wins, else FLAGS_spec_decode_k.  The
+        # subsystem lives in inference.speculative; constructed lazily
+        # so non-speculative engines never import it.
+        from ..core import flags as _flags
+
+        if spec_decode_k is None:
+            spec_decode_k = int(_flags.flag("spec_decode_k"))
+        self._spec = None
+        if drafter is not None and not spec_decode_k:
+            # a drafter with K == 0 would be silently ignored and the
+            # engine would serve classic one-token steps — refuse loudly
+            raise ValueError(
+                "drafter passed but speculative decoding is off: set "
+                "spec_decode_k >= 1 (or FLAGS_spec_decode_k)")
+        if spec_decode_k:
+            from .speculative import SpeculativeDecoder
+
+            self._spec = SpeculativeDecoder(self, k=int(spec_decode_k),
+                                            drafter=drafter)
 
     # -- request lifecycle ---------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=32,
@@ -383,6 +448,16 @@ class DecodeEngine:
 
     def _pages_for(self, tokens: int) -> int:
         return -(-tokens // self._page)  # ceil
+
+    def _prefill_bucket(self, p_len: int) -> int:
+        """Pow-2 prompt-length bucket (floor 16, capped at the horizon)
+        so prompt lengths share prefill executables.  The draft-model
+        drafter buckets with THIS method so target and draft prefill
+        always compile the same executable set."""
+        bucket = 16
+        while bucket < p_len:
+            bucket *= 2
+        return min(bucket, self._max_seq_len)
 
     def _admit(self):
         while self._queue:
@@ -410,10 +485,7 @@ class DecodeEngine:
         row[:len(req.pages)] = req.pages
         self._bt[slot] = row
 
-        bucket = 16
-        while bucket < p_len:
-            bucket *= 2
-        bucket = min(bucket, self._max_seq_len)
+        bucket = self._prefill_bucket(p_len)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :p_len] = req.prompt_ids
 
@@ -452,20 +524,28 @@ class DecodeEngine:
         self._lens[slot] = p_len
         self._last[slot] = tok
         self._active[slot] = True
-        if self._done(req, tok):
-            self._finish(slot)
+        if self._spec is not None:
+            self._spec.on_admit(slot, req)
+        reason = self._done(req, tok)
+        if reason:
+            self._finish(slot, reason)
 
-    def _done(self, req: Request, tok: int) -> bool:
+    def _done(self, req: Request, tok: int) -> Optional[str]:
+        """Finish reason if the request is done after emitting ``tok``,
+        else None."""
         if req.eos_token_id is not None and tok == req.eos_token_id:
-            return True
-        return len(req.output_ids) >= req.max_new_tokens
+            return "eos"
+        if len(req.output_ids) >= req.max_new_tokens:
+            return "length"
+        return None
 
-    def _finish(self, slot: int):
+    def _finish(self, slot: int, reason: str):
         req = self._by_slot[slot]
         self.pool.free_pages(req.pages)
         self.pool.reserved -= max(
             self._pages_for(req.total_kv_tokens()) - len(req.pages), 0)
         req.state = "done"
+        req.finish_reason = reason
         req.slot = None
         req.pages = []
         self._by_slot[slot] = None
@@ -473,16 +553,50 @@ class DecodeEngine:
         self._lens[slot] = 0
         self._last[slot] = 0
         self._bt[slot] = 0
+        _STATS[{"eos": "finished_eos", "length": "finished_length",
+                "evicted": "evicted"}[reason]] += 1
+        if self._spec is not None:
+            self._spec.on_finish(slot, req)
 
-    def _grow_block_tables(self):
-        # the next step writes at position lens[slot]; make sure the page
-        # holding that position exists (slot reuse keeps this a pop from
-        # the free list, not an allocation)
+    def evict(self, req: Request):
+        """Cancel a request: a queued request leaves the queue, a
+        running one gives its slot and pages back between steps.  The
+        tokens generated so far stay on ``req.output_ids`` and
+        ``req.finish_reason`` reads "evicted" — callers can finally tell
+        a cancelled generation from one that hit eos."""
+        if req.state == "queued":
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                raise ValueError(
+                    "request is not queued on this engine") from None
+            req.state = "done"
+            req.finish_reason = "evicted"
+            _STATS["evicted"] += 1
+            return
+        if req.state == "running" and req.slot is not None and \
+                0 <= req.slot < self._slots and \
+                self._by_slot[req.slot] is req:
+            self._finish(req.slot, "evicted")
+            return
+        if req.state == "done":
+            return  # already finished; nothing to release
+        raise ValueError("request is not owned by this engine")
+
+    def _grow_block_tables(self, writes=None):
+        """Ensure pages exist for every KV row the next step will write:
+        positions ``lens[slot] .. lens[slot] + writes[slot] - 1``
+        (``writes`` defaults to one token per slot; the speculative
+        verify step writes up to K+1).  Slot reuse keeps this a pop from
+        the free list, not an allocation; the pages stay with the
+        request until it finishes, so a speculative rejection rolls back
+        ``seq_lens`` WITHOUT touching the pool."""
         for slot in range(self._slots):
             if not self._active[slot]:
                 continue
             req = self._by_slot[slot]
-            pidx = int(self._lens[slot]) // self._page
+            w = 1 if writes is None else int(writes[slot])
+            pidx = (int(self._lens[slot]) + max(w - 1, 0)) // self._page
             while pidx >= len(req.pages):
                 req.pages.append(self.pool.alloc_page())
                 self.pool.reserved -= 1
@@ -490,48 +604,38 @@ class DecodeEngine:
 
     # -- the serve loop ------------------------------------------------------
     def step(self) -> bool:
-        """Admit what fits, run one batched decode step.  Returns False
-        when there is nothing left to do."""
+        """Admit what fits, run one batched decode step (or one
+        speculative propose->verify->accept round when spec decoding is
+        on).  Returns False when there is nothing left to do."""
         from ..profiler import RecordEvent
 
         self._admit()
         if not self._active.any():
             return bool(self._queue)
+        if self._spec is not None:
+            return self._spec.step()
         self._grow_block_tables()
 
         fn = self._decode_fn
         if fn is None:
-            fn = self._decode_fn = jax.jit(
+            fn = self._decode_fn = _JitTracker(jax.jit(
                 functools.partial(_gpt_decode_step,
                                   num_heads=self._num_heads,
                                   head_dim=self._head_dim, eps=self._eps,
                                   **self._sampling),
-                donate_argnums=(1, 2))
-            _STATS["decode_compiles"] += 1
+                donate_argnums=(1, 2)), "decode_compiles")
 
         self._step_no += 1
         key = jax.random.fold_in(self._key, self._step_no)
         t0 = time.perf_counter()
         with RecordEvent("serving.decode_step"):
-            self._k_pages, self._v_pages, toks = fn(
+            self._k_pages, self._v_pages, toks = fn.fn(
                 self._params, self._k_pages, self._v_pages,
                 jnp.asarray(self._bt), jnp.asarray(self._lens),
                 jnp.asarray(self._last), jnp.asarray(self._active), key)
             toks = np.asarray(toks)
         dt = time.perf_counter() - t0
-
-        # retrace telemetry counts ACTUAL XLA compiles (the jit's own
-        # trace-cache size) — a dtype/weak_type flapping in the step
-        # operands would recompile inside the same jitted wrapper and
-        # must not go unnoticed
-        try:
-            n_compiled = fn._cache_size()
-        except AttributeError:  # older jax without _cache_size
-            n_compiled = 1
-        if self._warm and n_compiled > self._decode_jit_compiles:
-            _STATS["retraces_after_warmup"] += \
-                n_compiled - self._decode_jit_compiles
-        self._decode_jit_compiles = n_compiled
+        fn.check_retrace()
 
         n_active = int(self._active.sum())
         _STATS["steps"] += 1
@@ -539,7 +643,6 @@ class DecodeEngine:
         _STATS["tokens"] += n_active
         _STATS["occupancy_sum"] += n_active / self._slots
         _STATS["kv_util_sum"] += self.pool.utilization()
-        self._warm = True
 
         for slot in range(self._slots):
             if not self._active[slot]:
@@ -549,8 +652,9 @@ class DecodeEngine:
             self._lens[slot] += 1
             self._last[slot] = tok
             req.output_ids.append(tok)
-            if self._done(req, tok):
-                self._finish(slot)
+            reason = self._done(req, tok)
+            if reason:
+                self._finish(slot, reason)
         return True
 
     def run(self, max_steps=100000):
@@ -561,13 +665,18 @@ class DecodeEngine:
             steps += 1
         return steps
 
-    def generate(self, prompts, max_new_tokens=32):
+    def generate(self, prompts, max_new_tokens=32, return_meta=False):
         """Convenience batch API: submit all prompts, serve to
         completion, return one token list per prompt (in order).
         Loops run() until the queue drains — every step advances each
-        active slot by one token, so progress is guaranteed and no
-        request can be silently truncated at run()'s step cap."""
+        active slot by at least one token, so progress is guaranteed and
+        no request can be silently truncated at run()'s step cap.
+        ``return_meta=True`` additionally returns the per-request
+        ``finish_reason`` list ("eos" | "length" | "evicted")."""
         reqs = [self.add_request(p, max_new_tokens) for p in prompts]
         while self._queue or self._active.any():
             self.run()
-        return [list(r.output_ids) for r in reqs]
+        outs = [list(r.output_ids) for r in reqs]
+        if return_meta:
+            return outs, [r.finish_reason for r in reqs]
+        return outs

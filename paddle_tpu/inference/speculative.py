@@ -1,0 +1,547 @@
+"""Speculative decoding: draft-propose / multi-token-verify on the
+paged KV engine.
+
+The continuous-batching engine (`inference.serving.DecodeEngine`)
+advances every slot by exactly one token per step, so per-token latency
+is one full target-model pass.  Speculative decoding amortizes that
+pass: a cheap **drafter** proposes K tokens per slot, and one batched
+**verify step** — a single donated jitted executable, the multi-query
+sibling of the engine's decode step — scores all K+1 positions at once
+through the ragged multi-query paged-attention kernel
+(`ops.pallas.paged_attention`, per-sequence causal offsets).
+
+Accept/resample rule (Leviathan et al., specialized to this engine's
+samplers): the verify pass draws a *target token* at every position with
+the exact `sample_logits` the engine uses (argmax under greedy), then
+accepts drafted tokens while they match the targets and emits the first
+mismatching target as the correction — or, when every draft survives,
+the last target as a bonus token.  Because the emitted tokens ARE the
+target model's samples, the output distribution is the target
+distribution by construction: token-identical to the non-speculative
+engine under greedy, and distribution-preserving under temperature /
+top-k / top-p sampling.  For a point-mass drafter (prompt-lookup) this
+is exactly the Leviathan rule: accept with probability p(d), resample
+from norm(p - p(d)·δ_d) otherwise.
+
+Memory protocol: speculative K/V rows are written into pages the
+request already owns (`DecodeEngine._grow_block_tables(writes=...)`
+reserves the verify window up front, clamped to the request's token
+budget), so rejection is a pure host-side ``seq_lens`` rollback — no
+allocation, no free, no retrace.  The page pool cannot distinguish a
+speculative serve from a classic one.
+
+Drafters:
+
+* `PromptLookupDrafter` — model-free n-gram lookup over each request's
+  own token history (prompt + generated).  Zero device cost; shines on
+  repetition-friendly workloads (code, extraction, chat with quoting).
+* `DraftModelDrafter` — a small GPT (see `GPTConfig.draft_config`)
+  sharing the engine's page pool: its K/V pages are indexed by the SAME
+  block tables and page ids as the target model's, so one allocator
+  governs both and the rollback invariants transfer unchanged.
+
+Telemetry lands in `profiler.decode_stats`: ``acceptance_rate``,
+``mean_accepted_per_step``, ``draft_time_s`` / ``verify_time_s``, and
+the zero-warm-retrace contract extends to the draft and verify
+executables via the shared `_JitTracker`.
+"""
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .serving import (_JitTracker, _STATS, _extract_gpt_params,
+                      _gpt_decode_step, _gpt_prefill, _ln, _logits_of,
+                      sample_logits)
+from ..ops.pallas import paged_attention as pa
+
+__all__ = ["Drafter", "PromptLookupDrafter", "DraftModelDrafter",
+           "SpeculativeDecoder"]
+
+
+# ---------------------------------------------------------------------------
+# The multi-token verify step (pure, jit-compiled once per engine)
+# ---------------------------------------------------------------------------
+def _gpt_spec_verify(params, k_pages, v_pages, block_tables, seq_lens,
+                     tokens, write_caps, key, *, num_heads, head_dim,
+                     eps, sampler, temperature, top_k, top_p):
+    """Score Q = K+1 incoming tokens per slot in ONE pass: write their
+    K/V into the slots' already-reserved pages (write-capped per
+    sequence so rows past a request's token budget are dropped by the
+    scatter), run ragged multi-query paged attention with per-sequence
+    causal offsets, and draw a target token at every position with the
+    engine's own `sample_logits`.
+
+    tokens: [B, Q] int32 — position ``seq_lens[b] + i`` holds
+    ``tokens[b, i]`` (the last sampled token followed by the K drafts);
+    write_caps: [B] int32 in [0, Q] — rows ``i < write_caps[b]`` are
+    written and attendable (0 = inactive slot -> zero logits, target 0
+    ignored by the host); k_pages/v_pages donated: the K/V write is in
+    place, and a later rejection only shrinks the host's ``seq_lens``.
+    Returns (k_pages, v_pages, targets [B, Q] int32).
+    """
+    b, qn = tokens.shape
+    h = num_heads * head_dim
+    num_pages_total = k_pages.shape[2]
+    page = k_pages.shape[3]
+    pages_max = block_tables.shape[1]
+
+    offs = jnp.arange(qn, dtype=jnp.int32)
+    pos = seq_lens[:, None] + offs[None, :]              # [B, Q]
+    wpe_max = params["wpe"].shape[0] - 1
+    x = params["wte"][tokens] + params["wpe"][jnp.minimum(pos, wpe_max)]
+
+    writable = offs[None, :] < write_caps[:, None]       # [B, Q]
+    bt_idx = jnp.minimum(pos // page, pages_max - 1)
+    page_idx = jnp.where(
+        writable, block_tables[jnp.arange(b)[:, None], bt_idx],
+        num_pages_total)                                 # OOB -> dropped
+    slot = pos % page
+    lens_now = seq_lens + write_caps
+
+    for li, blk in enumerate(params["blocks"]):
+        y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
+        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = qkv.reshape(b, qn, 3, num_heads, head_dim)
+        q = qkv[:, :, 0]                                 # [B, Q, H, D]
+        # slice shape [B, Q, Hkv, D] (the int layer index joins the
+        # advanced group — batch dims lead); capped rows have an OOB
+        # page index and are dropped by the scatter
+        k_pages = k_pages.at[li, :, page_idx, slot, :].set(qkv[:, :, 1])
+        v_pages = v_pages.at[li, :, page_idx, slot, :].set(qkv[:, :, 2])
+        attn = pa.paged_attention(q, k_pages[li], v_pages[li],
+                                  block_tables, lens_now,
+                                  q_offsets=seq_lens)
+        x = x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"]) \
+            + blk["out_b"]
+        y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
+        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+                        approximate=True)
+        x = x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+                 ).reshape(b, qn, h)
+
+    xf = _ln(x.reshape(b * qn, h), params["lnf_w"], params["lnf_b"], eps)
+    logits = _logits_of(params, xf).astype(jnp.float32)
+    logits = logits.reshape(b, qn, -1)
+    # one target draw per position, through the exact engine sampler —
+    # the emitted tokens ARE these draws, which is what makes the accept
+    # rule distribution-preserving (greedy ignores the key)
+    targets = [
+        sample_logits(logits[:, i], sampler=sampler,
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      key=jax.random.fold_in(key, i))
+        for i in range(qn)
+    ]
+    return k_pages, v_pages, jnp.stack(targets, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Drafters
+# ---------------------------------------------------------------------------
+class Drafter:
+    """Proposes K draft tokens per active slot each speculative round.
+
+    Lifecycle: ``bind(engine, k)`` once at engine construction, then
+    per-request ``on_admit``/``on_finish`` and per-round
+    ``propose``/``on_accept``.  ``propose`` runs between engine steps
+    (host time there is drafting budget, not device idle time)."""
+
+    name = "base"
+
+    def bind(self, engine, k: int):
+        if getattr(self, "engine", None) is not None and \
+                self.engine is not engine:
+            # a drafter carries per-engine state (draft pages, lens
+            # bookkeeping); silently rebinding would cross-wire two
+            # engines' slot state
+            raise ValueError(
+                "drafter is already bound to another engine: construct "
+                "one drafter per DecodeEngine")
+        self.engine = engine
+        self.k = int(k)
+
+    def on_admit(self, slot: int, req):
+        pass
+
+    def on_finish(self, slot: int, req):
+        pass
+
+    def propose(self, write_caps) -> np.ndarray:
+        """Return [slots, K] int32 draft tokens (inactive rows ignored).
+        ``write_caps[s]`` is the verify window (K/V writes) slot ``s``
+        gets this round — at most ``write_caps[s] - 1`` drafts of it can
+        be accepted, so drafters may stop early."""
+        raise NotImplementedError
+
+    def on_accept(self, slot: int, pos_before: int, n_emitted: int):
+        """Called per slot after the verify: ``n_emitted`` tokens were
+        appended and the slot's KV length moved to
+        ``pos_before + n_emitted`` (the rollback, if any, already
+        happened on the engine's side)."""
+        pass
+
+
+class PromptLookupDrafter(Drafter):
+    """Model-free prompt-lookup (n-gram) drafter: propose the
+    continuation of the most recent earlier occurrence of the sequence's
+    current n-gram suffix, longest n first.  The LLM serving analog of
+    "assume the text repeats itself" — free to compute, surprisingly
+    strong on extraction/code/chat workloads, and the q-distribution is
+    a point mass so the accept rule is exactly Leviathan's."""
+
+    name = "prompt_lookup"
+
+    def __init__(self, ngram_max: int = 3, ngram_min: int = 1):
+        if ngram_min < 1 or ngram_max < ngram_min:
+            raise ValueError(
+                f"need 1 <= ngram_min <= ngram_max, got "
+                f"[{ngram_min}, {ngram_max}]")
+        self.ngram_max = int(ngram_max)
+        self.ngram_min = int(ngram_min)
+
+    def _lookup(self, hist: np.ndarray) -> np.ndarray:
+        k = self.k
+        ln = len(hist)
+        for n in range(min(self.ngram_max, ln - 1), self.ngram_min - 1,
+                       -1):
+            suffix = hist[ln - n:]
+            # candidate starts s <= ln-n-1: the window is strictly
+            # earlier than the suffix itself, so a continuation exists
+            wins = np.lib.stride_tricks.sliding_window_view(
+                hist, n)[:ln - n]
+            hits = np.nonzero((wins == suffix).all(axis=1))[0]
+            if hits.size:
+                s = int(hits[-1])  # most recent occurrence
+                cont = hist[s + n: s + n + k]
+                if cont.size < k:
+                    cont = np.concatenate(
+                        [cont, np.full(k - cont.size, hist[-1],
+                                       hist.dtype)])
+                return cont
+        # no n-gram recurs yet: propose a flat repeat of the last token
+        # (wrong drafts cost nothing beyond the verify row they ride in)
+        return np.full(k, hist[-1], hist.dtype)
+
+    def propose(self, write_caps) -> np.ndarray:
+        eng = self.engine
+        out = np.zeros((eng._slots, self.k), np.int32)
+        for s in range(eng._slots):
+            if not eng._active[s]:
+                continue
+            req = eng._by_slot[s]
+            hist = np.asarray(req.prompt_ids + req.output_ids, np.int32)
+            out[s] = self._lookup(hist)
+        return out
+
+
+class DraftModelDrafter(Drafter):
+    """Small-GPT drafter sharing the engine's page pool: the draft
+    model's K/V pages are indexed by the SAME block tables and page ids
+    the target uses — one allocator governs both caches, so admission,
+    growth, rollback, and eviction need no drafter-specific accounting.
+
+    The draft decodes greedily (argmax maximizes the match probability
+    against the verify targets).  Per round it runs ONE multi-query
+    catch-up pass (ingest the tokens the verify accepted last round —
+    the same `_gpt_spec_verify` executable shape, over the draft
+    weights) followed by K-1 single-token steps (the engine's own
+    `_gpt_decode_step`, over the draft weights).  All draft executables
+    ride the `_JitTracker` retrace contract."""
+
+    name = "draft_model"
+
+    def __init__(self, draft_model):
+        cfg = draft_model.cfg
+        if getattr(cfg, "dropout", 0.0) and draft_model.training:
+            raise ValueError(
+                "draft model must be in eval mode (cfg.dropout > 0)")
+        self._params = _extract_gpt_params(draft_model)
+        self._num_heads = cfg.num_heads
+        self._head_dim = cfg.hidden_size // cfg.num_heads
+        self._eps = float(getattr(draft_model.ln_f, "_epsilon", 1e-5))
+        self._vocab = cfg.vocab_size
+        self._max_pos = cfg.max_seq_len
+
+    def bind(self, engine, k: int):
+        super().bind(engine, k)
+        if self._vocab != engine._params["wte"].shape[0]:
+            raise ValueError(
+                f"draft vocab {self._vocab} != target vocab "
+                f"{engine._params['wte'].shape[0]}: the drafter must "
+                f"propose over the target's token space")
+        if self._max_pos < engine._max_seq_len:
+            raise ValueError(
+                f"draft position table ({self._max_pos}) shorter than "
+                f"the engine horizon ({engine._max_seq_len})")
+        n_layers = len(self._params["blocks"])
+        shape = (n_layers, self._num_heads, engine.pool.num_pages,
+                 engine._page, self._head_dim)
+        dtype = engine._k_pages.dtype
+        self._k_pages = jnp.zeros(shape, dtype)
+        self._v_pages = jnp.zeros(shape, dtype)
+        self._lens = np.zeros(engine._slots, np.int32)
+        greedy = dict(sampler="greedy", temperature=1.0, top_k=0,
+                      top_p=1.0)
+        self._catch_fn = _JitTracker(jax.jit(
+            functools.partial(_gpt_spec_verify,
+                              num_heads=self._num_heads,
+                              head_dim=self._head_dim, eps=self._eps,
+                              **greedy),
+            donate_argnums=(1, 2)), "draft_compiles")
+        self._step_fn = _JitTracker(jax.jit(
+            functools.partial(_gpt_decode_step,
+                              num_heads=self._num_heads,
+                              head_dim=self._head_dim, eps=self._eps,
+                              **greedy),
+            donate_argnums=(1, 2)), "draft_compiles")
+        self._prefill_fns = {}
+
+    # -- request lifecycle --------------------------------------------------
+    def on_admit(self, slot: int, req):
+        """Draft-side prefill: ingest the prompt into the draft's pages
+        through the slot's block-table row (the pages the engine just
+        allocated for the target's prompt K/V)."""
+        eng = self.engine
+        p_len = len(req.prompt_ids)
+        bucket = eng._prefill_bucket(p_len)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :p_len] = req.prompt_ids
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = _JitTracker(jax.jit(
+                functools.partial(_gpt_prefill,
+                                  num_heads=self._num_heads,
+                                  head_dim=self._head_dim, eps=self._eps,
+                                  sampler="greedy", temperature=1.0,
+                                  top_k=0, top_p=1.0),
+                donate_argnums=(4, 5)), "draft_compiles")
+            self._prefill_fns[bucket] = fn
+        t0 = time.perf_counter()
+        self._k_pages, self._v_pages, _ = fn.fn(
+            self._params, jnp.asarray(ids), jnp.int32(p_len),
+            jnp.asarray(eng._bt[slot]), self._k_pages, self._v_pages,
+            eng._key)
+        fn.check_retrace()
+        _STATS["draft_time_s"] += time.perf_counter() - t0
+        self._lens[slot] = p_len
+
+    def on_finish(self, slot: int, req):
+        self._lens[slot] = 0
+
+    # -- per-round propose ---------------------------------------------------
+    def propose(self, write_caps) -> np.ndarray:
+        eng = self.engine
+        slots = eng._slots
+        k = self.k
+        active = eng._active.copy()
+        drafts = np.zeros((slots, k), np.int32)
+
+        # catch-up: feed the tokens accepted since the draft last saw
+        # this slot (positions lens_d .. L, where L = engine seq_len is
+        # the last sampled token's position) — at most K+1 of them, in
+        # the same fixed [slots, K+1] frame the verify uses, so this is
+        # one warm executable, not a shape zoo
+        catch = np.zeros((slots, k + 1), np.int32)
+        caps = np.zeros(slots, np.int32)
+        for s in range(slots):
+            if not active[s]:
+                continue
+            req = eng._by_slot[s]
+            full = req.prompt_ids + req.output_ids
+            pend = int(eng._lens[s]) + 1 - int(self._lens[s])
+            assert 1 <= pend <= k + 1, (pend, k)
+            catch[s, :pend] = full[self._lens[s]: self._lens[s] + pend]
+            caps[s] = pend
+        bt = jnp.asarray(eng._bt)  # invariant across the round
+        self._k_pages, self._v_pages, targets = self._catch_fn.fn(
+            self._params, self._k_pages, self._v_pages,
+            bt, jnp.asarray(self._lens),
+            jnp.asarray(catch), jnp.asarray(caps), eng._key)
+        self._catch_fn.check_retrace()
+        targets = np.asarray(targets)
+        self._lens[active] += caps[active]
+        cur = np.where(
+            active,
+            np.take_along_axis(
+                targets, np.maximum(caps - 1, 0)[:, None], axis=1)[:, 0],
+            0).astype(np.int32)
+        drafts[:, 0] = cur
+
+        # K-1 greedy single-token steps; a slot only participates while
+        # its draft write position stays inside the verify window the
+        # engine reserved (write_caps), so the draft can never touch a
+        # page the request does not own
+        write_caps = np.asarray(write_caps)
+        for i in range(1, k):
+            step_active = active & (i <= write_caps - 1)
+            if not step_active.any():
+                break
+            self._k_pages, self._v_pages, nxt = self._step_fn.fn(
+                self._params, self._k_pages, self._v_pages,
+                bt, jnp.asarray(self._lens),
+                jnp.asarray(cur), jnp.asarray(step_active), eng._key)
+            self._step_fn.check_retrace()
+            nxt = np.asarray(nxt).astype(np.int32)
+            self._lens[step_active] += 1
+            cur = np.where(step_active, nxt, cur).astype(np.int32)
+            drafts[:, i] = np.where(step_active, nxt, 0)
+        return drafts
+
+    def on_accept(self, slot: int, pos_before: int, n_emitted: int):
+        # draft K/V rows for the accepted drafts (positions
+        # pos_before+1 .. pos_before+min(n_emitted, K)-? ) were computed
+        # under the accepted prefix, so they are correct and stay; the
+        # rejected tail rolls back by the same seq_lens trick as the
+        # target cache.  The bonus/correction token was never fed to the
+        # draft — next round's catch-up ingests it.
+        self._lens[slot] = pos_before + min(n_emitted, self.k)
+
+
+_DRAFTERS = {"prompt_lookup": PromptLookupDrafter}
+
+
+def make_drafter(spec) -> Drafter:
+    """Resolve a drafter: an instance passes through, a name constructs
+    (FLAGS_spec_drafter supplies the default name).  `draft_model`
+    drafters cannot be named — they need weights, pass an instance."""
+    if isinstance(spec, Drafter):
+        return spec
+    try:
+        return _DRAFTERS[spec]()
+    except KeyError:
+        raise ValueError(
+            f"unknown drafter {spec!r}: pass one of "
+            f"{sorted(_DRAFTERS)} or a Drafter instance") from None
+
+
+# ---------------------------------------------------------------------------
+# The propose -> verify -> accept loop
+# ---------------------------------------------------------------------------
+class SpeculativeDecoder:
+    """One speculative round per engine step: reserve the verify window,
+    draft K tokens per slot, score them in one donated jitted verify
+    call, accept the matching prefix + one target token, roll the rest
+    back by shrinking ``seq_lens``.  Every emitted token is a target-
+    model sample, so greedy output is bit-identical to the
+    non-speculative engine and stochastic output follows the target
+    distribution exactly."""
+
+    def __init__(self, engine, k: int, drafter=None):
+        if k < 1:
+            raise ValueError(f"spec_decode_k must be >= 1, got {k}")
+        self.engine = engine
+        self.k = int(k)
+        if drafter is None:
+            from ..core import flags as _flags
+
+            drafter = str(_flags.flag("spec_drafter"))
+        self.drafter = make_drafter(drafter)
+        self.drafter.bind(engine, self.k)
+        self._verify_fn: Optional[_JitTracker] = None
+
+    # engine lifecycle hooks (DecodeEngine._prefill_into / _finish)
+    def on_admit(self, slot: int, req):
+        self.drafter.on_admit(slot, req)
+
+    def on_finish(self, slot: int, req):
+        self.drafter.on_finish(slot, req)
+
+    def step(self) -> bool:
+        """One propose->verify->accept round over every active slot.
+        Called by `DecodeEngine.step` after admission."""
+        from ..profiler import RecordEvent
+
+        eng = self.engine
+        slots = eng._slots
+
+        # verify window per slot, clamped to the request's remaining
+        # token budget: KV rows past position prompt+max_new-2 are never
+        # needed, and writing them would outrun the pool reservation
+        caps = np.zeros(slots, np.int32)
+        for s in range(slots):
+            if not eng._active[s]:
+                continue
+            req = eng._by_slot[s]
+            need = req.max_new_tokens - len(req.output_ids)
+            caps[s] = min(self.k + 1, need)
+        eng._grow_block_tables(writes=caps)
+        pos_before = eng._lens.copy()
+
+        t0 = time.perf_counter()
+        drafts = self.drafter.propose(caps)
+        t_draft = time.perf_counter() - t0
+
+        fn = self._verify_fn
+        if fn is None:
+            fn = self._verify_fn = _JitTracker(jax.jit(
+                functools.partial(_gpt_spec_verify,
+                                  num_heads=eng._num_heads,
+                                  head_dim=eng._head_dim, eps=eng._eps,
+                                  **eng._sampling),
+                donate_argnums=(1, 2)), "verify_compiles")
+
+        tokens = np.concatenate(
+            [eng._last[:, None].astype(np.int32), drafts], axis=1)
+        eng._step_no += 1
+        key = jax.random.fold_in(eng._key, eng._step_no)
+        t0 = time.perf_counter()
+        with RecordEvent("serving.spec_verify_step"):
+            eng._k_pages, eng._v_pages, targets = fn.fn(
+                eng._params, eng._k_pages, eng._v_pages,
+                jnp.asarray(eng._bt), jnp.asarray(eng._lens),
+                jnp.asarray(tokens), jnp.asarray(caps), key)
+            targets = np.asarray(targets)
+        t_verify = time.perf_counter() - t0
+        fn.check_retrace()
+
+        n_active = int(eng._active.sum())
+        emitted_total = 0
+        for s in range(slots):
+            if not eng._active[s]:
+                continue
+            req = eng._by_slot[s]
+            w = int(caps[s])
+            usable = min(self.k, w - 1)  # drafts the window can accept
+            m = 0
+            while m < usable and int(drafts[s, m]) == int(targets[s, m]):
+                m += 1
+            emit = [int(t) for t in drafts[s, :m]] + [int(targets[s, m])]
+            if req.eos_token_id is not None:
+                for j, t in enumerate(emit):
+                    if t == req.eos_token_id:
+                        emit = emit[:j + 1]
+                        break
+            n_emit = len(emit)
+            # accounted AFTER eos truncation so acceptance_rate stays
+            # consistent with spec_emitted: drafts that matched but were
+            # cut by an earlier eos never reached the output
+            _STATS["spec_proposed"] += usable
+            _STATS["spec_accepted"] += min(m, n_emit)
+            req.output_ids.extend(emit)
+            # accepted rows keep their K/V; the rejected tail is rolled
+            # back purely by NOT advancing seq_lens over it
+            eng._lens[s] += n_emit
+            eng._last[s] = emit[-1]
+            emitted_total += n_emit
+            self.drafter.on_accept(s, int(pos_before[s]), n_emit)
+            reason = eng._done(req, emit[-1])
+            if reason:
+                eng._finish(s, reason)
+
+        _STATS["spec_steps"] += 1
+        _STATS["spec_slot_steps"] += n_active
+        _STATS["steps"] += 1
+        _STATS["spec_emitted"] += emitted_total
+        _STATS["tokens"] += emitted_total
+        _STATS["draft_time_s"] += t_draft
+        _STATS["verify_time_s"] += t_verify
+        _STATS["decode_time_s"] += t_draft + t_verify
+        _STATS["occupancy_sum"] += n_active / slots
+        _STATS["kv_util_sum"] += eng.pool.utilization()
+        return True

@@ -42,6 +42,34 @@ class GPTConfig:
         if self.intermediate_size is None:
             self.intermediate_size = 4 * self.hidden_size
 
+    def draft_config(self, num_layers=1, hidden_size=None, num_heads=None,
+                     intermediate_size=None):
+        """Shrunk config for a speculative-decoding draft model
+        (`inference.speculative.DraftModelDrafter`): vocab and position
+        table are pinned to the target's (the drafter must propose over
+        the same token space and cover the same horizon), everything
+        that buys speed shrinks.  Defaults: 1 layer, half the width
+        (rounded up to keep head_dim * num_heads == hidden_size).
+        """
+        if hidden_size is None:
+            head_dim = self.hidden_size // self.num_heads
+            hidden_size = max(head_dim, self.hidden_size // 2)
+        if num_heads is None:
+            num_heads = max(1, min(self.num_heads,
+                                   hidden_size //
+                                   (self.hidden_size // self.num_heads)))
+        if hidden_size % num_heads:
+            raise ValueError(
+                f"draft hidden_size {hidden_size} not divisible by "
+                f"num_heads {num_heads}")
+        return GPTConfig(
+            vocab_size=self.vocab_size, hidden_size=hidden_size,
+            num_layers=num_layers, num_heads=num_heads,
+            max_seq_len=self.max_seq_len,
+            intermediate_size=intermediate_size, dropout=0.0,
+            tie_embeddings=self.tie_embeddings,
+            use_parallel_layers=False)
+
 
 class GPTBlock(nn.Layer):
     def __init__(self, cfg: GPTConfig):

@@ -27,10 +27,24 @@ def sample_logits(logits, sampler="greedy", temperature=1.0, top_k=0,
                   top_p=1.0, key=None):
     """Token sampling over vocab logits [B, V] -> [B] int32: ``greedy``
     (deterministic argmax), ``top_k``, ``top_p`` (nucleus).  Shared by
-    eager `models.gpt.GPT.generate` and the serving engine
-    (`inference.serving.DecodeEngine`) so both decode paths draw from
-    the exact same distribution; stochastic samplers need ``key``."""
+    eager `models.gpt.GPT.generate`, the serving engine
+    (`inference.serving.DecodeEngine`), and the speculative-decode
+    verify step (`inference.speculative`) — all decode paths draw from
+    the exact same distribution, which is what makes the spec-decode
+    accept/resample rule distribution-preserving by construction.
+    Stochastic samplers need ``key``.
+
+    Edge cases are pinned by tests/test_spec_decode.py:
+    ``temperature <= 0`` reduces to greedy (the softmax limit);
+    ``top_k >= vocab`` is a no-op filter; a ``top_p`` small enough to
+    exclude every token still keeps the argmax (nucleus of one)."""
     logits = unwrap(logits)
+    if sampler != "greedy" and not isinstance(temperature, jax.core.Tracer) \
+            and float(temperature) <= 0.0:
+        # the T -> 0 limit of temperature sampling IS argmax; dividing by
+        # a tiny epsilon instead would overflow the logits and make the
+        # outcome an fp accident rather than the distribution's limit
+        sampler = "greedy"
     if sampler == "greedy":
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if key is None:
@@ -41,6 +55,7 @@ def sample_logits(logits, sampler="greedy", temperature=1.0, top_k=0,
             raise ValueError(
                 f"sampler 'top_k' needs top_k >= 1, got {top_k}")
         # clamp to the vocab: k > V would raise deep inside lax.top_k
+        # (and makes top_k >= vocab an exact no-op filter)
         k = min(int(top_k), logits.shape[-1])
         kth = jax.lax.top_k(logits, k)[0][..., -1:]
         logits = jnp.where(logits >= kth, logits, -1e30)
@@ -50,7 +65,11 @@ def sample_logits(logits, sampler="greedy", temperature=1.0, top_k=0,
         sorted_l = jnp.take_along_axis(logits, order, axis=-1)
         probs = jax.nn.softmax(sorted_l, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        keep = (cum - probs) < jnp.float32(top_p)  # always keeps rank 0
+        keep = (cum - probs) < jnp.float32(top_p)
+        # rank 0 stays in the nucleus unconditionally: top_p <= 0 (or a
+        # float too small to beat cum-probs==0) must degrade to greedy,
+        # never to an all-masked categorical over uniform garbage
+        keep = keep.at[..., 0].set(True)
         filt = jnp.where(keep, sorted_l, -1e30)
         pick = jax.random.categorical(key, filt)
         return jnp.take_along_axis(order, pick[..., None],

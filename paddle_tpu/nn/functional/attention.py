@@ -36,6 +36,28 @@ def _sdpa_reference(q, k, v, mask, dropout_p, scale, is_causal):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def multi_query_causal_mask(q_offsets, q_len, seq_lens, kv_len):
+    """Ragged multi-query causal visibility, shared by the paged
+    attention reference (`ops.pallas.paged_attention`) and the
+    speculative-decode verify step so the two can never disagree.
+
+    Query ``i`` of sequence ``b`` sits at absolute position
+    ``q_offsets[b] + i`` and may see KV position ``p`` iff
+    ``p < min(seq_lens[b], q_offsets[b] + i + 1)`` — bottom-right-aligned
+    causality clamped to the sequence's live KV range (``seq_lens`` may
+    be below the last query's position when trailing KV writes were
+    suppressed, e.g. past a request's token budget).
+
+    q_offsets/seq_lens: [B] int32; returns bool [B, q_len, kv_len].
+    A sequence with ``seq_lens == 0`` is fully masked (inactive slot).
+    """
+    pos = jnp.arange(kv_len, dtype=jnp.int32)
+    qi = jnp.arange(q_len, dtype=jnp.int32)
+    limit = jnp.minimum(seq_lens[:, None],
+                        q_offsets[:, None] + qi[None, :] + 1)  # [B, Q]
+    return pos[None, None, :] < limit[:, :, None]
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, scale=None,
                                  training=True, name=None):

@@ -6,6 +6,16 @@ HBM as fixed-size pages indexed by a per-sequence block table; each
 sequence in the batch has its own length (ragged batch), and query heads
 may outnumber KV heads (grouped-query attention).
 
+Queries are ragged too: ``q`` may carry **multiple query tokens per
+sequence** (``[B, Q, Hq, D]``) with a per-sequence causal offset
+(``q_offsets``) — query ``i`` of sequence ``b`` sits at absolute position
+``q_offsets[b] + i`` and attends to KV positions ``<= q_offsets[b] + i``.
+This is the capability the Ragged Paged Attention paper treats as table
+stakes: it is what the speculative-decoding verify step (score K drafted
+tokens in one pass) and chunked prefill ride on.  ``Q == 1`` with
+``q_offsets == seq_lens - 1`` reduces exactly to classic single-token
+decode.
+
 Kernel shape (the TPU paged-decode idiom):
 
 * grid ``(batch, kv_heads, pages_max)`` with the page axis fastest;
@@ -103,50 +113,68 @@ def default_page_size(max_len, d, dtype=jnp.float32):
 # XLA reference — CPU path and parity ground truth
 # ---------------------------------------------------------------------------
 def _xla_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
-                         scale=None):
-    """q: [B, Hq, D]; k_pages/v_pages: [Hkv, num_pages, page, D];
-    block_tables: [B, pages_max] int32; seq_lens: [B] int32 (valid KV
-    tokens per sequence; 0 = inactive slot -> zero output).
-    Returns [B, Hq, D].
+                         scale=None, q_offsets=None):
+    """q: [B, Hq, D] (single query token) or [B, Q, Hq, D] (multi-query
+    with per-sequence causal offset); k_pages/v_pages:
+    [Hkv, num_pages, page, D]; block_tables: [B, pages_max] int32;
+    seq_lens: [B] int32 (valid KV tokens per sequence; 0 = inactive slot
+    -> zero output); q_offsets: [B] int32 absolute position of query row
+    0 (default ``seq_lens - Q``: the queries are the newest tokens).
+    Returns the same rank as ``q``.
 
     Mirrors _sdpa_reference's numerics: logits scaled in the input dtype,
     masked + softmaxed in f32, probs cast back — a sequence's output is
-    bit-identical to dense attention over its first ``seq_len`` tokens.
+    bit-identical to dense attention over its first ``seq_len`` tokens
+    (bottom-right-aligned causal for the multi-query form).
     """
+    from ...nn.functional.attention import multi_query_causal_mask
+
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
     hkv, _, page, d = k_pages.shape
-    b, hq, _ = q.shape
+    b, qn, hq, _ = q.shape
     g = hq // hkv
+    if q_offsets is None:
+        q_offsets = seq_lens - qn
     s = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
     # gather each sequence's pages: [Hkv, B, pages_max, page, D]
     k = k_pages[:, block_tables]
     v = v_pages[:, block_tables]
     k = jnp.moveaxis(k, 1, 0).reshape(b, hkv, -1, d)
     v = jnp.moveaxis(v, 1, 0).reshape(b, hkv, -1, d)
-    qg = q.reshape(b, hkv, g, d)
-    logits = jnp.einsum("bhgd,bhsd->bhgs", qg, k) * s
+    qg = q.reshape(b, qn, hkv, g, d)
+    logits = jnp.einsum("bqhgd,bhsd->bqhgs", qg, k) * s
     logits = logits.astype(jnp.float32)
-    pos = jnp.arange(k.shape[2], dtype=jnp.int32)
-    valid = pos[None, :] < seq_lens[:, None]  # [B, S]
-    logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+    # [B, Q, S]: kv pos p visible to query i iff p < min(len, off + i + 1)
+    valid = multi_query_causal_mask(q_offsets, qn, seq_lens, k.shape[2])
+    logits = jnp.where(valid[:, :, None, None, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     # a fully-masked row (seq_len == 0) softmaxes to uniform; zero it so
     # inactive slots emit exact zeros instead of the page-pool mean
-    probs = jnp.where(valid[:, None, None, :], probs,
+    probs = jnp.where(valid[:, :, None, None, :], probs,
                       jnp.zeros((), probs.dtype))
-    out = jnp.einsum("bhgs,bhsd->bhgd", probs, v)
-    return out.reshape(b, hq, d)
+    out = jnp.einsum("bqhgs,bhsd->bqhgd", probs, v)
+    out = out.reshape(b, qn, hq, d)
+    return out[:, 0] if squeeze else out
 
 
 # ---------------------------------------------------------------------------
 # Pallas decode kernel
 # ---------------------------------------------------------------------------
-def _decode_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc, m_scr, l_scr, *, page, pages_max, scale):
+def _decode_kernel(bt_ref, sl_ref, qo_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc, m_scr, l_scr, *, page, pages_max, scale, group,
+                   q_len):
     # grid (b, h_kv, p): one KV page streams through VMEM per step while
-    # the (b, h)-pinned query tile and f32 softmax state stay resident
+    # the (b, h)-pinned query tile and f32 softmax state stay resident.
+    # Query rows are (query_token, gqa_group) pairs: row r is query
+    # token r // group, at absolute position qo + r // group, so each
+    # row carries its own causal limit (a per-row ragged mask instead of
+    # the single-token `pos < sl`).
     b = pl.program_id(0)
     p = pl.program_id(2)
     sl = sl_ref[b]
+    qo = qo_ref[b]
 
     @pl.when(p == 0)
     def _init():
@@ -156,21 +184,32 @@ def _decode_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(p * page < sl)
     def _compute():
-        q = q_ref[...].astype(jnp.float32) * scale    # [gp, d]
+        q = q_ref[...].astype(jnp.float32) * scale    # [rows, d]
         k = k_ref[...].astype(jnp.float32)            # [page, d]
         v = v_ref[...].astype(jnp.float32)
+        rows = q_ref.shape[0]
         m = m_scr[...][:, 0]
         l = l_scr[...][:, 0]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [gp, page]
+        )  # [rows, page]
+        # per-row causal limit: row r sees kv pos < min(sl, qo + qi + 1)
+        # (padded rows clamp to the last real query so their reads stay
+        # inside the live range; their output is sliced away anyway)
+        row_q = jnp.minimum(
+            jax.lax.broadcasted_iota(jnp.int32, (rows, page), 0) // group,
+            q_len - 1)
         pos = p * page + jax.lax.broadcasted_iota(
-            jnp.int32, (1, page), 1)[0]
-        logits = jnp.where((pos < sl)[None, :], logits, -1e30)
+            jnp.int32, (rows, page), 1)
+        masked = pos < jnp.minimum(sl, qo + row_q + 1)
+        logits = jnp.where(masked, logits, -1e30)
         m_blk = jnp.max(logits, axis=-1)
         m_new = jnp.maximum(m, m_blk)
         pr = jnp.exp(logits - m_new[:, None])
+        # a row fully masked on this page (early query, late page) has
+        # m_new == -1e30 and exp(0) == 1 everywhere: zero it explicitly
+        pr = jnp.where(masked, pr, 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(pr, axis=-1)
         acc[...] = acc[...] * alpha[:, None] + jax.lax.dot_general(
@@ -188,26 +227,36 @@ def _decode_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _pallas_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
-                            scale=None):
+                            scale=None, q_offsets=None):
     hkv, num_pages, page, d = k_pages.shape
-    b, hq, _ = q.shape
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    b, qn, hq, _ = q.shape
     g = hq // hkv
-    gp = max(_MIN_GROUP_ROWS, g)
+    if q_offsets is None:
+        q_offsets = seq_lens - qn
+    # rows = (query token, gqa group) pairs, padded up to the f32
+    # sublane tile so the [rows, d] blocks map onto the VPU/MXU
+    rows = qn * g
+    gp = -(-rows // _MIN_GROUP_ROWS) * _MIN_GROUP_ROWS
     s = scale if scale is not None else 1.0 / math.sqrt(d)
 
-    qg = q.reshape(b, hkv, g, d)
-    if gp != g:
-        # pad the query-group rows up to the sublane tile; padded rows
-        # compute garbage that is sliced away after the call
-        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    qg = q.reshape(b, qn, hkv, g, d).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, hkv, rows, d)
+    if gp != rows:
+        # pad the query rows up to the sublane tile; padded rows compute
+        # garbage that is sliced away after the call
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - rows), (0, 0)))
     pages_max = block_tables.shape[1]
     block_tables = block_tables.astype(jnp.int32)
     seq_lens = seq_lens.astype(jnp.int32)
+    q_offsets = q_offsets.astype(jnp.int32)
 
-    def q_map(bi, h, p, bt, sl):
+    def q_map(bi, h, p, bt, sl, qo):
         return (bi, h, 0, 0)
 
-    def kv_map(bi, h, p, bt, sl):
+    def kv_map(bi, h, p, bt, sl, qo):
         # dead pages clamp to the last live page: the repeated index
         # skips the DMA (flash_attention's dead-block clamp, paged form).
         # max(live, 1) keeps a zero-length slot pointing at a real page.
@@ -215,7 +264,7 @@ def _pallas_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
         return (h, bt[bi, jnp.minimum(p, live - 1)], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(b, hkv, pages_max),
         in_specs=[
             pl.BlockSpec((None, None, gp, d), q_map),
@@ -231,31 +280,41 @@ def _pallas_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, page=page, pages_max=pages_max,
-                          scale=s),
+                          scale=s, group=g, q_len=qn),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), q.dtype),
-    )(block_tables, seq_lens, qg, k_pages, v_pages)
-    return out[:, :, :g, :].reshape(b, hq, d)
+    )(block_tables, seq_lens, q_offsets, qg, k_pages, v_pages)
+    out = out[:, :, :rows, :].reshape(b, hkv, qn, g, d)
+    out = out.transpose(0, 2, 1, 3, 4).reshape(b, qn, hq, d)
+    return out[:, 0] if squeeze else out
 
 
 # ---------------------------------------------------------------------------
 # public entry point
 # ---------------------------------------------------------------------------
 def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
-                    scale=None):
+                    scale=None, q_offsets=None):
     """Decode-step attention over a paged KV cache.
 
-    q: [B, Hq, D] (one query token per sequence);
+    q: [B, Hq, D] (one query token per sequence) or [B, Q, Hq, D]
+    (ragged multi-query: Q query tokens per sequence, each at absolute
+    position ``q_offsets[b] + i`` — the speculative-decode verify /
+    chunked-prefill form);
     k_pages/v_pages: [Hkv, num_pages, page_size, D];
     block_tables: [B, pages_max] int32 page ids in position order;
-    seq_lens: [B] int32 valid KV tokens per sequence (0 = inactive slot).
+    seq_lens: [B] int32 valid KV tokens per sequence (0 = inactive slot);
+    q_offsets: [B] int32 position of each sequence's first query row
+    (default ``seq_lens - Q``: the queries are the newest tokens).
 
     Hq must be a multiple of Hkv (grouped-query attention).  Uses the
     Pallas kernel on TPU (FLAGS_use_pallas_attention '1'/'auto'; '0'
     forces the reference), the XLA reference elsewhere.
     """
     hkv, _, page, d = k_pages.shape
-    b, hq, dq = q.shape
+    if q.ndim not in (3, 4):
+        raise ValueError(f"q must be [B, Hq, D] or [B, Q, Hq, D], "
+                         f"got rank {q.ndim}")
+    hq, dq = q.shape[-2], q.shape[-1]
     if hq % hkv:
         raise ValueError(
             f"query heads {hq} not a multiple of kv heads {hkv}")
@@ -263,9 +322,9 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
         raise ValueError(f"head_dim mismatch: q {dq} vs pages {d}")
     if _paged_kernel_wanted():
         return _pallas_paged_attention(q, k_pages, v_pages, block_tables,
-                                       seq_lens, scale)
+                                       seq_lens, scale, q_offsets)
     return _xla_paged_attention(q, k_pages, v_pages, block_tables,
-                                seq_lens, scale)
+                                seq_lens, scale, q_offsets)
 
 
 def _paged_kernel_wanted() -> bool:

@@ -46,9 +46,16 @@ DECODE_STAT_COUNTERS = (
     "steps", "tokens", "prefills", "decode_time_s", "prefill_time_s",
     "decode_compiles", "prefill_compiles", "retraces_after_warmup",
     "occupancy_sum", "kv_util_sum",
+    # speculative decoding (inference.speculative): propose/verify loop
+    "spec_steps", "spec_slot_steps", "spec_proposed", "spec_accepted",
+    "spec_emitted",
+    "draft_time_s", "verify_time_s", "verify_compiles", "draft_compiles",
+    # request-completion accounting (Request.finish_reason)
+    "finished_eos", "finished_length", "evicted",
 )
 DECODE_STAT_DERIVED = ("avg_step_ms", "batch_occupancy",
-                       "kv_block_utilization")
+                       "kv_block_utilization",
+                       "acceptance_rate", "mean_accepted_per_step")
 
 
 def _decode_stat_zero(key):

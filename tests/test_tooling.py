@@ -118,6 +118,35 @@ def test_bench_decode_smoke(tmp_path):
     assert data["page_size_sweep"], "page-size sweep must record rows"
 
 
+def test_bench_spec_decode_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_spec_decode.py runs end-to-end: the
+    speculative-decode bench can't rot.  Asserts the emitted JSON shape,
+    greedy token parity of every speculative leg against the baseline
+    engine, acceptance-rate telemetry, and zero warm retraces on the
+    verify executable at smoke scale."""
+    out = str(tmp_path / "bench_spec.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_spec_decode.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    assert data["parity"] is True
+    assert data["drafter"] == "prompt_lookup"
+    legs = data["legs"]
+    assert "engine" in legs and legs["engine"]["tokens_per_s"] > 0
+    spec_legs = [v for k, v in legs.items() if k.startswith("spec_k")]
+    assert spec_legs, "speculative legs must record rows"
+    for leg in spec_legs:
+        assert leg["tokens_per_s"] > 0 and leg["wall_s"] > 0
+        assert 0 <= leg["acceptance_rate"] <= 1
+        assert leg["mean_accepted_per_step"] >= 1
+        assert leg["retraces_after_warmup"] == 0
+        assert leg["draft_time_s"] >= 0 and leg["verify_time_s"] > 0
+
+
 def test_op_bench_gate_device_mismatch(tmp_path):
     """Cross-device comparisons are incommensurable (a CPU run vs a TPU
     baseline); the checker must refuse rather than mis-gate."""

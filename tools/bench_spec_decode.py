@@ -1,0 +1,190 @@
+"""Speculative decoding benchmark: propose/verify vs the PR 2 engine.
+
+Measures greedy decode tokens/sec through `inference.serving.
+DecodeEngine` with speculative decoding OFF (the PR 2 baseline: one
+token per step) and ON at K in {2, 4, 8} with the prompt-lookup
+drafter, on a repetition-friendly workload (a periodic prompt, the
+regime prompt-lookup drafting is built for — extraction, code, quoting
+chat).  Reports tokens/s, speedup vs the baseline engine, acceptance
+rate, mean accepted tokens per slot-step, and the draft/verify wall
+split; greedy token parity of every speculative leg against the
+baseline is asserted, and the zero-warm-retrace contract is checked on
+the verify executable.
+
+Emits BENCH_spec.json.  The ISSUE-3 acceptance bar: >= 1.5x engine
+tokens/s at K=4 with the prompt-lookup drafter.
+
+Usage:
+    python tools/bench_spec_decode.py [--out BENCH_spec.json]
+                                      [--context 256] [--new-tokens 64]
+                                      [--batch 2] [--ks 2,4,8]
+                                      [--drafter prompt_lookup|draft_model]
+                                      [--smoke]
+
+``--smoke`` (or env BENCH_SMOKE=1) shrinks shapes so CI can assert the
+script end-to-end (tests/test_tooling.py).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models.gpt import GPT, GPTConfig  # noqa: E402
+
+
+def _build_model(args):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.context + args.new_tokens + 64,
+                    use_parallel_layers=False, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    return model
+
+
+def _repetitive_prompts(args):
+    """Periodic prompts: a random block tiled to the context length —
+    the workload shape prompt-lookup drafting exists for."""
+    rng = np.random.RandomState(0)
+    prompts = []
+    for b in range(args.batch):
+        block = rng.randint(0, args.vocab, (args.period,))
+        reps = -(-args.context // args.period)
+        prompts.append(np.tile(block, reps)[:args.context]
+                       .astype(np.int32))
+    return prompts
+
+
+def _bench_engine(model, prompts, args, spec_k, drafter):
+    from paddle_tpu.inference.serving import (DecodeEngine, decode_stats,
+                                              reset_decode_stats)
+
+    kw = {}
+    if spec_k:
+        kw = dict(spec_decode_k=spec_k, drafter=drafter())
+    eng = DecodeEngine(model, max_batch_size=len(prompts),
+                       max_seq_len=args.context + args.new_tokens,
+                       page_size=args.page_size, **kw)
+    eng.generate(prompts, max_new_tokens=min(args.new_tokens, 4))  # warm
+    reset_decode_stats()
+    t0 = time.perf_counter()
+    outs = eng.generate(prompts, max_new_tokens=args.new_tokens)
+    wall = time.perf_counter() - t0
+    return wall, outs, decode_stats()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_spec.json"))
+    ap.add_argument("--context", type=int, default=256)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--period", type=int, default=16,
+                    help="prompt repetition period (tokens)")
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--ks", default="2,4,8")
+    ap.add_argument("--drafter", default="prompt_lookup",
+                    choices=["prompt_lookup", "draft_model"])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes: CI end-to-end check")
+    args = ap.parse_args()
+    if os.environ.get("BENCH_SMOKE") == "1":
+        args.smoke = True
+    if args.smoke:
+        args.context, args.new_tokens, args.batch = 48, 8, 1
+        args.hidden, args.vocab, args.period = 64, 128, 8
+        if args.ks == ap.get_default("ks"):
+            args.ks = "2,4"  # respect an explicit override
+
+    import jax
+
+    model = _build_model(args)
+    prompts = _repetitive_prompts(args)
+    total = args.batch * args.new_tokens
+
+    def drafter():
+        if args.drafter == "draft_model":
+            from paddle_tpu.inference.speculative import DraftModelDrafter
+
+            paddle.seed(1)
+            dm = GPT(model.cfg.draft_config())
+            dm.eval()
+            return DraftModelDrafter(dm)
+        from paddle_tpu.inference.speculative import PromptLookupDrafter
+
+        return PromptLookupDrafter()
+
+    wall_b, outs_b, stats_b = _bench_engine(model, prompts, args, 0, None)
+    base_tps = total / wall_b
+    print(f"engine (PR 2 baseline): {base_tps:9.1f} tok/s "
+          f"({wall_b:.2f}s)")
+    legs = {"engine": {
+        "wall_s": round(wall_b, 4),
+        "tokens_per_s": round(base_tps, 2),
+        "retraces_after_warmup": stats_b["retraces_after_warmup"],
+    }}
+
+    parity = True
+    for k in sorted({int(x) for x in args.ks.split(",") if x}):
+        wall, outs, st = _bench_engine(model, prompts, args, k, drafter)
+        tps = total / wall
+        ok = all(a == b for a, b in zip(outs, outs_b))
+        parity = parity and ok
+        legs[f"spec_k{k}"] = {
+            "k": k,
+            "wall_s": round(wall, 4),
+            "tokens_per_s": round(tps, 2),
+            "speedup_vs_engine": round(wall_b / wall, 2),
+            "acceptance_rate": round(st["acceptance_rate"], 4),
+            "mean_accepted_per_step": round(
+                st["mean_accepted_per_step"], 3),
+            "spec_steps": st["spec_steps"],
+            "draft_time_s": round(st["draft_time_s"], 4),
+            "verify_time_s": round(st["verify_time_s"], 4),
+            "retraces_after_warmup": st["retraces_after_warmup"],
+        }
+        print(f"spec K={k}: {tps:9.1f} tok/s  "
+              f"({wall_b / wall:.2f}x vs engine, accept="
+              f"{st['acceptance_rate']:.2f}, "
+              f"{st['mean_accepted_per_step']:.2f} tok/slot-step, "
+              f"parity={ok})")
+
+    out = {
+        "bench": "speculative decode greedy tokens/sec "
+                 "(repetition-friendly workload)",
+        "device": str(jax.devices()[0].device_kind)
+        if jax.devices() else "unknown",
+        "smoke": bool(args.smoke),
+        "drafter": args.drafter,
+        "config": {"batch": args.batch, "context": args.context,
+                   "new_tokens": args.new_tokens, "period": args.period,
+                   "layers": args.layers, "hidden": args.hidden,
+                   "heads": args.heads, "vocab": args.vocab,
+                   "page_size": args.page_size},
+        "legs": legs,
+        "parity": bool(parity),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {args.out} (parity={parity})")
+    if not parity:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
