@@ -70,6 +70,7 @@ from . import quantization
 from . import sparsity
 from . import text
 from . import profiler
+from . import observability
 from . import regularizer
 from .framework.param_attr import ParamAttr
 from .framework.io import load, save

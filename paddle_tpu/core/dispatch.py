@@ -319,6 +319,25 @@ def reset_dispatch_stats():
             s.time_s = 0.0
 
 
+def telemetry_series():
+    """Dispatch telemetry in the observability registry's neutral shape:
+    ``(kind, name, label_names, rows)`` per exported series, each row a
+    ``((label_values,), value)`` pair keyed by op.  The registry's
+    dispatch *view* (paddle_tpu.observability) renders these into the
+    Prometheus/JSON exports at collection time — ``dispatch_stats``
+    stays the storage and the public API."""
+    with _STATS_LOCK:
+        items = sorted((k, v.as_dict()) for k, v in _STATS.items())
+    fields = (("counter", "paddle_dispatch_calls_total", "calls"),
+              ("counter", "paddle_dispatch_hits_total", "hits"),
+              ("counter", "paddle_dispatch_misses_total", "misses"),
+              ("counter", "paddle_dispatch_bypasses_total", "bypasses"),
+              ("counter", "paddle_dispatch_time_seconds_total", "time_s"))
+    return [(kind, name, ("op",),
+             [((op,), st[field]) for op, st in items])
+            for kind, name, field in fields]
+
+
 def dispatch_summary_string(sorted_key="time"):
     """Aggregated dispatch table (layout after the reference's
     PrintProfiler table)."""

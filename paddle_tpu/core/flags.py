@@ -118,6 +118,12 @@ define_flag("spec_drafter", "prompt_lookup",
             "see inference.speculative.PromptLookupDrafter).  A draft-"
             "model drafter must be passed as an instance (it needs the "
             "draft GPT's weights)")
+define_flag("metrics_report_interval_s", 0.0,
+            "interval of the periodic observability reporter "
+            "(paddle_tpu.observability.start_reporter): every interval a "
+            "metrics snapshot is handed to the reporter sink on a daemon "
+            "thread.  0 (default) = off.  DecodeEngine construction "
+            "auto-starts the reporter when the flag is positive")
 define_flag("use_rbg_rng", True,
             "on TPU, use the hardware RBG PRNG for the framework's random "
             "ops instead of threefry (measured: recovers ~60% of dropout's "

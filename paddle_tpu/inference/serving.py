@@ -52,11 +52,24 @@ __all__ = ["KVBlockPool", "Request", "DecodeEngine", "sample_logits",
 # ---------------------------------------------------------------------------
 # Telemetry (profiler.decode_stats).  The key schema lives in profiler
 # (DECODE_STAT_COUNTERS) so profiler's not-imported zero fallback and
-# this live dict can never diverge.
+# this live dict can never diverge.  Mutation and atomic read+reset go
+# through the observability registry's lock — the ONE telemetry lock —
+# so a stats poller thread can never tear a serve loop's
+# read-modify-write updates (or vice versa).
 # ---------------------------------------------------------------------------
 from ..profiler import (DECODE_STAT_COUNTERS, _decode_stat_zero)
+from .. import observability as _obs
+from ..observability import LOCK as _TELEMETRY_LOCK
 
 _STATS = {k: _decode_stat_zero(k) for k in DECODE_STAT_COUNTERS}
+
+
+def _stats_add(**deltas):
+    """Apply counter deltas atomically (one lock round per engine step,
+    not one per counter)."""
+    with _TELEMETRY_LOCK:
+        for k, v in deltas.items():
+            _STATS[k] += v
 
 
 def decode_stats(reset=False):
@@ -67,8 +80,13 @@ def decode_stats(reset=False):
 
     Counters are PROCESS-WIDE aggregates across every DecodeEngine (the
     same contract as ``dispatch_stats``); serving several engines
-    concurrently blends their occupancy/utilization averages."""
-    out = dict(_STATS)
+    concurrently blends their occupancy/utilization averages.
+    ``reset=True`` is atomic with the read: counts a concurrent serve
+    adds after the snapshot are never lost to the reset."""
+    with _TELEMETRY_LOCK:
+        out = dict(_STATS)
+        if reset:
+            reset_decode_stats()
     steps = max(out["steps"], 1)
     out["avg_step_ms"] = out["decode_time_s"] / steps * 1e3
     out["batch_occupancy"] = out["occupancy_sum"] / steps
@@ -81,14 +99,13 @@ def decode_stats(reset=False):
         out["spec_proposed"], 1)
     out["mean_accepted_per_step"] = out["spec_emitted"] / max(
         out["spec_slot_steps"], 1)
-    if reset:
-        reset_decode_stats()
     return out
 
 
 def reset_decode_stats():
-    for k in _STATS:
-        _STATS[k] = 0.0 if isinstance(_STATS[k], float) else 0
+    with _TELEMETRY_LOCK:
+        for k in _STATS:
+            _STATS[k] = 0.0 if isinstance(_STATS[k], float) else 0
 
 
 # Sampling lives in nn.decode (neutral layer — eager GPT.generate must
@@ -110,7 +127,7 @@ class _JitTracker:
         self.fn = fn
         self._seen = 0
         self._warm = False
-        _STATS[compile_key] += 1
+        _stats_add(**{compile_key: 1})
 
     def check_retrace(self):
         """Call after every invocation of ``fn``."""
@@ -119,7 +136,7 @@ class _JitTracker:
         except AttributeError:  # older jax without _cache_size
             n = 1
         if self._warm and n > self._seen:
-            _STATS["retraces_after_warmup"] += n - self._seen
+            _stats_add(retraces_after_warmup=n - self._seen)
         self._seen = n
         self._warm = True
 
@@ -165,7 +182,13 @@ class Request:
     ``finish_reason`` records WHY a request left the engine — "eos"
     (hit its eos token), "length" (max_new_tokens exhausted), or
     "evicted" (cancelled via `DecodeEngine.evict`) — so callers can
-    tell a completed generation from a truncated one."""
+    tell a completed generation from a truncated one.
+
+    Lifecycle timestamps (``now_ns`` clock, shared with the host
+    tracer) are stamped as the request moves enqueue -> admit -> first
+    token -> finish; they feed the observability TTFT / TPOT /
+    queue-wait / e2e histograms and the per-request chrome-trace
+    spans."""
 
     _next_id = 0
 
@@ -180,6 +203,10 @@ class Request:
         self.pages: List[int] = []
         self.request_id = Request._next_id
         Request._next_id += 1
+        self.t_enqueue_ns: Optional[int] = None
+        self.t_admit_ns: Optional[int] = None
+        self.t_first_token_ns: Optional[int] = None
+        self.t_finish_ns: Optional[int] = None
 
     def total_kv_tokens(self) -> int:
         # KV rows ever written: prompt + all generated-token writes except
@@ -347,6 +374,8 @@ class DecodeEngine:
     serve (signature-keyed: shapes never change, so it compiles once).
     """
 
+    _next_engine_id = 0
+
     def __init__(self, model, max_batch_size=4, max_seq_len=None,
                  page_size=None, num_pages=None, sampler="greedy",
                  temperature=1.0, top_k=0, top_p=1.0, seed=0,
@@ -402,6 +431,13 @@ class DecodeEngine:
         self._queue: "deque[Request]" = deque()
         self._decode_fn = None  # shapes are fixed: ONE jitted step
         self._prefill_fns = {}
+        # engine id = the chrome-trace tid of this engine's step spans
+        # (several engines in one process stay on separate lanes)
+        self._engine_id = DecodeEngine._next_engine_id
+        DecodeEngine._next_engine_id += 1
+        # FLAGS_metrics_report_interval_s > 0 -> periodic snapshot
+        # reporter, started once per process
+        _obs.maybe_start_reporter()
 
         # speculative decoding (propose K / verify in one multi-query
         # pass): explicit arg wins, else FLAGS_spec_decode_k.  The
@@ -443,6 +479,8 @@ class DecodeEngine:
         if self._pages_for(req.total_kv_tokens()) > self.pool.num_pages:
             raise ValueError(
                 "request needs more KV pages than the pool holds")
+        req.t_enqueue_ns = _obs.now_ns()
+        _obs.REQUESTS_ENQUEUED.inc()
         self._queue.append(req)
         return req
 
@@ -477,6 +515,14 @@ class DecodeEngine:
             self._prefill_into(req, slot, total_pages)
 
     def _prefill_into(self, req: Request, slot: int, total_pages: int):
+        req.t_admit_ns = _obs.now_ns()
+        if req.t_enqueue_ns is not None:
+            _obs.REQUEST_QUEUE_WAIT.observe(
+                (req.t_admit_ns - req.t_enqueue_ns) / 1e9)
+            _obs.record_span("requests", "queued", req.t_enqueue_ns,
+                             req.t_admit_ns - req.t_enqueue_ns,
+                             tid=req.request_id,
+                             args={"request": req.request_id})
         p_len = len(req.prompt_ids)
         for _ in range(self._pages_for(p_len)):
             req.pages.append(self.pool.alloc_page())
@@ -501,8 +547,9 @@ class DecodeEngine:
             # prompt-length bucket is an expected warmup event, not a
             # steady-state retrace) — only decode-step recompiles count
             # toward retraces_after_warmup
-            _STATS["prefill_compiles"] += 1
+            _stats_add(prefill_compiles=1)
         t0 = time.perf_counter()
+        t0_ns = _obs.now_ns()
         # prefill keys live in the upper fold_in domain (decode steps use
         # 1..2^30), derived from a PER-ENGINE counter so `seed` actually
         # pins the sampling stream regardless of process-global state
@@ -513,9 +560,22 @@ class DecodeEngine:
             jnp.asarray(self._bt[slot]), self._k_pages, self._v_pages,
             key)
         tok = int(tok)
-        _STATS["prefill_time_s"] += time.perf_counter() - t0
-        _STATS["prefills"] += 1
-        _STATS["tokens"] += 1
+        _stats_add(prefill_time_s=time.perf_counter() - t0,
+                   prefills=1, tokens=1)
+        req.t_first_token_ns = _obs.now_ns()
+        if req.t_enqueue_ns is not None:
+            _obs.REQUEST_TTFT.observe(
+                (req.t_first_token_ns - req.t_enqueue_ns) / 1e9)
+        _obs.record_span("requests", "prefill", req.t_admit_ns,
+                         req.t_first_token_ns - req.t_admit_ns,
+                         tid=req.request_id,
+                         args={"request": req.request_id,
+                               "prompt_len": p_len, "bucket": bucket})
+        _obs.record_span("engine", "prefill", t0_ns,
+                         req.t_first_token_ns - t0_ns,
+                         tid=self._engine_id,
+                         args={"request": req.request_id,
+                               "bucket": bucket, "slot": slot})
 
         req.state = "running"
         req.slot = slot
@@ -553,8 +613,25 @@ class DecodeEngine:
         self._lens[slot] = 0
         self._last[slot] = 0
         self._bt[slot] = 0
-        _STATS[{"eos": "finished_eos", "length": "finished_length",
-                "evicted": "evicted"}[reason]] += 1
+        _stats_add(**{{"eos": "finished_eos", "length": "finished_length",
+                       "evicted": "evicted"}[reason]: 1})
+        req.t_finish_ns = _obs.now_ns()
+        _obs.REQUESTS_FINISHED.inc(reason=reason)
+        n_out = len(req.output_ids)
+        if req.t_enqueue_ns is not None:
+            _obs.REQUEST_E2E.observe(
+                (req.t_finish_ns - req.t_enqueue_ns) / 1e9)
+        if req.t_first_token_ns is not None:
+            if n_out > 1:
+                _obs.REQUEST_TPOT.observe(
+                    (req.t_finish_ns - req.t_first_token_ns) / 1e9
+                    / (n_out - 1))
+            _obs.record_span(
+                "requests", "decode", req.t_first_token_ns,
+                req.t_finish_ns - req.t_first_token_ns,
+                tid=req.request_id,
+                args={"request": req.request_id, "tokens": n_out,
+                      "finish_reason": reason})
         if self._spec is not None:
             self._spec.on_finish(slot, req)
 
@@ -572,7 +649,17 @@ class DecodeEngine:
                     "request is not queued on this engine") from None
             req.state = "done"
             req.finish_reason = "evicted"
-            _STATS["evicted"] += 1
+            req.t_finish_ns = _obs.now_ns()
+            _stats_add(evicted=1)
+            _obs.REQUESTS_FINISHED.inc(reason="evicted")
+            if req.t_enqueue_ns is not None:
+                _obs.REQUEST_E2E.observe(
+                    (req.t_finish_ns - req.t_enqueue_ns) / 1e9)
+                _obs.record_span("requests", "queued", req.t_enqueue_ns,
+                                 req.t_finish_ns - req.t_enqueue_ns,
+                                 tid=req.request_id,
+                                 args={"request": req.request_id,
+                                       "finish_reason": "evicted"})
             return
         if req.state == "running" and req.slot is not None and \
                 0 <= req.slot < self._slots and \
@@ -602,6 +689,24 @@ class DecodeEngine:
                 self.pool.reserved -= 1
                 self._bt[slot, len(req.pages) - 1] = req.pages[-1]
 
+    def _observe_step(self, t0_ns: int, dt: float, n_active: int,
+                      name: str, extra_args=None):
+        """Per-step observability: a step span on this engine's trace
+        lane, the step-latency histogram, and the pool/occupancy
+        gauges (levels as of the step that just ran)."""
+        args = {"step": self._step_no, "active": n_active}
+        if extra_args:
+            args.update(extra_args)
+        _obs.record_span("engine", name, t0_ns, int(dt * 1e9),
+                         tid=self._engine_id, args=args)
+        _obs.STEP_SECONDS.observe(dt)
+        # level gauges are engine-labeled: several engines in one
+        # process must not clobber each other's pool/occupancy reading
+        eid = self._engine_id
+        _obs.KV_FREE_PAGES.set(self.pool.free_count, engine=eid)
+        _obs.KV_UTIL.set(self.pool.utilization(), engine=eid)
+        _obs.SLOT_OCCUPANCY.set(n_active / self._slots, engine=eid)
+
     # -- the serve loop ------------------------------------------------------
     def step(self) -> bool:
         """Admit what fits, run one batched decode step (or one
@@ -628,6 +733,7 @@ class DecodeEngine:
         self._step_no += 1
         key = jax.random.fold_in(self._key, self._step_no)
         t0 = time.perf_counter()
+        t0_ns = _obs.now_ns()
         with RecordEvent("serving.decode_step"):
             self._k_pages, self._v_pages, toks = fn.fn(
                 self._params, self._k_pages, self._v_pages,
@@ -638,11 +744,10 @@ class DecodeEngine:
         fn.check_retrace()
 
         n_active = int(self._active.sum())
-        _STATS["steps"] += 1
-        _STATS["decode_time_s"] += dt
-        _STATS["tokens"] += n_active
-        _STATS["occupancy_sum"] += n_active / self._slots
-        _STATS["kv_util_sum"] += self.pool.utilization()
+        _stats_add(steps=1, decode_time_s=dt, tokens=n_active,
+                   occupancy_sum=n_active / self._slots,
+                   kv_util_sum=self.pool.utilization())
+        self._observe_step(t0_ns, dt, n_active, "decode_step")
 
         for slot in range(self._slots):
             if not self._active[slot]:

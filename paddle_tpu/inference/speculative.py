@@ -56,9 +56,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .serving import (_JitTracker, _STATS, _extract_gpt_params,
-                      _gpt_decode_step, _gpt_prefill, _ln, _logits_of,
+from .serving import (_JitTracker, _extract_gpt_params, _gpt_decode_step,
+                      _gpt_prefill, _ln, _logits_of, _stats_add,
                       sample_logits)
+from .. import observability as _obs
 from ..ops.pallas import paged_attention as pa
 
 __all__ = ["Drafter", "PromptLookupDrafter", "DraftModelDrafter",
@@ -328,7 +329,7 @@ class DraftModelDrafter(Drafter):
             jnp.asarray(eng._bt[slot]), self._k_pages, self._v_pages,
             eng._key)
         fn.check_retrace()
-        _STATS["draft_time_s"] += time.perf_counter() - t0
+        _stats_add(draft_time_s=time.perf_counter() - t0)
         self._lens[slot] = p_len
 
     def on_finish(self, slot: int, req):
@@ -474,8 +475,12 @@ class SpeculativeDecoder:
         pos_before = eng._lens.copy()
 
         t0 = time.perf_counter()
+        t0_ns = _obs.now_ns()
         drafts = self.drafter.propose(caps)
         t_draft = time.perf_counter() - t0
+        _obs.record_span("engine", "draft", t0_ns, int(t_draft * 1e9),
+                         tid=eng._engine_id,
+                         args={"drafter": self.drafter.name, "k": self.k})
 
         fn = self._verify_fn
         if fn is None:
@@ -491,6 +496,7 @@ class SpeculativeDecoder:
         eng._step_no += 1
         key = jax.random.fold_in(eng._key, eng._step_no)
         t0 = time.perf_counter()
+        tv_ns = _obs.now_ns()
         with RecordEvent("serving.spec_verify_step"):
             eng._k_pages, eng._v_pages, targets = fn.fn(
                 eng._params, eng._k_pages, eng._v_pages,
@@ -499,9 +505,13 @@ class SpeculativeDecoder:
             targets = np.asarray(targets)
         t_verify = time.perf_counter() - t0
         fn.check_retrace()
+        _obs.record_span("engine", "verify", tv_ns, int(t_verify * 1e9),
+                         tid=eng._engine_id, args={"k": self.k})
 
         n_active = int(eng._active.sum())
         emitted_total = 0
+        proposed_total = 0
+        accepted_total = 0
         for s in range(slots):
             if not eng._active[s]:
                 continue
@@ -521,8 +531,8 @@ class SpeculativeDecoder:
             # accounted AFTER eos truncation so acceptance_rate stays
             # consistent with spec_emitted: drafts that matched but were
             # cut by an earlier eos never reached the output
-            _STATS["spec_proposed"] += usable
-            _STATS["spec_accepted"] += min(m, n_emit)
+            proposed_total += usable
+            accepted_total += min(m, n_emit)
             req.output_ids.extend(emit)
             # accepted rows keep their K/V; the rejected tail is rolled
             # back purely by NOT advancing seq_lens over it
@@ -534,14 +544,20 @@ class SpeculativeDecoder:
             if reason:
                 eng._finish(s, reason)
 
-        _STATS["spec_steps"] += 1
-        _STATS["spec_slot_steps"] += n_active
-        _STATS["steps"] += 1
-        _STATS["spec_emitted"] += emitted_total
-        _STATS["tokens"] += emitted_total
-        _STATS["draft_time_s"] += t_draft
-        _STATS["verify_time_s"] += t_verify
-        _STATS["decode_time_s"] += t_draft + t_verify
-        _STATS["occupancy_sum"] += n_active / slots
-        _STATS["kv_util_sum"] += eng.pool.utilization()
+        _stats_add(spec_steps=1, spec_slot_steps=n_active, steps=1,
+                   spec_proposed=proposed_total,
+                   spec_accepted=accepted_total,
+                   spec_emitted=emitted_total, tokens=emitted_total,
+                   draft_time_s=t_draft, verify_time_s=t_verify,
+                   decode_time_s=t_draft + t_verify,
+                   occupancy_sum=n_active / slots,
+                   kv_util_sum=eng.pool.utilization())
+        _obs.SPEC_ACCEPTED_LAST.set(emitted_total, engine=eng._engine_id)
+        # the round span runs to NOW (draft + verify + the accept loop):
+        # measured end-to-end so the draft/verify child spans nest inside
+        # it instead of overlapping its edge on the trace lane
+        eng._observe_step(t0_ns, (_obs.now_ns() - t0_ns) / 1e9, n_active,
+                          "spec_step",
+                          extra_args={"k": self.k,
+                                      "emitted": emitted_total})
         return True

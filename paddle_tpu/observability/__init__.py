@@ -1,0 +1,185 @@
+"""Unified observability: metrics registry + request tracing + exporters.
+
+The measurement substrate the serving stack (ROADMAP north star) is
+evaluated on.  Three layers:
+
+* `metrics`  — Counter/Gauge/Histogram registry, one shared lock,
+  labeled series, near-zero cost when disabled;
+* `tracing`  — named-track span buffer + the merged chrome-trace
+  exporter (host tracer events, engine step spans, request lifecycle
+  spans in one timeline);
+* `reporter` — optional periodic snapshot thread
+  (``FLAGS_metrics_report_interval_s``).
+
+The pre-existing telemetry islands are NOT migrated — ``dispatch_stats``
+(`core.dispatch`) and ``decode_stats`` (`profiler` / `inference.serving`)
+keep their storage, public APIs, and zero-import fallbacks, and are
+**re-registered as views**: collection-time callables that render their
+counters into the same Prometheus/JSON exports as the first-class
+series below.
+
+Metric catalog (all first-class series live here so the names are
+defined in exactly one place — docs/OBSERVABILITY.md mirrors this):
+
+=============================================  =========  ==========
+name                                           type       labels
+=============================================  =========  ==========
+paddle_request_ttft_seconds                    histogram  —
+paddle_request_tpot_seconds                    histogram  —
+paddle_request_queue_wait_seconds              histogram  —
+paddle_request_e2e_seconds                     histogram  —
+paddle_decode_step_seconds                     histogram  —
+paddle_kv_free_pages                           gauge      engine
+paddle_kv_pool_utilization                     gauge      engine
+paddle_slot_occupancy                          gauge      engine
+paddle_spec_last_step_accepted_tokens          gauge      engine
+paddle_requests_enqueued_total                 counter    —
+paddle_requests_finished_total                 counter    reason
+=============================================  =========  ==========
+
+plus the views: ``paddle_decode_*`` (every `decode_stats` key) and
+``paddle_dispatch_*{op=...}`` (every `dispatch_stats` op row).
+"""
+from __future__ import annotations
+
+from .metrics import (  # noqa: F401
+    DEFAULT_TIME_BUCKETS, LOCK, Counter, Gauge, Histogram,
+    MetricRegistry, Sample, default_registry, disable, enable, enabled,
+    log_buckets,
+)
+from .tracing import (  # noqa: F401
+    HOST_TRACK, clear_spans, dropped_span_count, export_chrome_trace,
+    merged_chrome_trace, now_ns, record_span, span, span_count, spans,
+)
+from .reporter import (  # noqa: F401
+    maybe_start_reporter, reporter_running, start_reporter, stop_reporter,
+)
+
+__all__ = [
+    "registry", "counter", "gauge", "histogram", "snapshot",
+    "prometheus_text", "reset", "enable", "disable", "enabled",
+    "LOCK", "Counter", "Gauge", "Histogram", "MetricRegistry",
+    "DEFAULT_TIME_BUCKETS", "log_buckets", "default_registry",
+    "record_span", "span", "spans", "clear_spans", "span_count",
+    "merged_chrome_trace", "export_chrome_trace", "now_ns", "HOST_TRACK",
+    "start_reporter", "stop_reporter", "reporter_running",
+    "maybe_start_reporter",
+]
+
+registry = default_registry()
+
+
+def counter(name, help="", labels=()) -> Counter:
+    return registry.counter(name, help, labels)
+
+
+def gauge(name, help="", labels=()) -> Gauge:
+    return registry.gauge(name, help, labels)
+
+
+def histogram(name, help="", labels=(), buckets=None) -> Histogram:
+    return registry.histogram(name, help, labels, buckets=buckets)
+
+
+def snapshot() -> dict:
+    return registry.snapshot()
+
+
+def prometheus_text() -> str:
+    return registry.prometheus_text()
+
+
+def reset():
+    """Zero every first-class series (views keep their own reset APIs;
+    the span buffer is cleared separately via `clear_spans`)."""
+    registry.reset()
+
+
+# ---------------------------------------------------------------------------
+# First-class serving metrics (instrumented by inference.serving /
+# inference.speculative; defined here so the catalog lives in one place)
+# ---------------------------------------------------------------------------
+REQUEST_TTFT = histogram(
+    "paddle_request_ttft_seconds",
+    "Time to first token: request enqueue -> first sampled token "
+    "(includes queue wait + prefill)")
+REQUEST_TPOT = histogram(
+    "paddle_request_tpot_seconds",
+    "Time per output token after the first: (finish - first token) / "
+    "(tokens - 1); requests emitting one token record nothing")
+REQUEST_QUEUE_WAIT = histogram(
+    "paddle_request_queue_wait_seconds",
+    "Time a request waited in the admission queue before its slot")
+REQUEST_E2E = histogram(
+    "paddle_request_e2e_seconds",
+    "End-to-end request latency: enqueue -> finish")
+STEP_SECONDS = histogram(
+    "paddle_decode_step_seconds",
+    "Wall time of one batched decode step (speculative: one "
+    "propose->verify->accept round)")
+KV_FREE_PAGES = gauge(
+    "paddle_kv_free_pages",
+    "KV page-pool free pages as of the engine's most recent step",
+    labels=("engine",))
+KV_UTIL = gauge(
+    "paddle_kv_pool_utilization",
+    "KV page-pool used fraction as of the engine's most recent step",
+    labels=("engine",))
+SLOT_OCCUPANCY = gauge(
+    "paddle_slot_occupancy",
+    "Active-slot fraction of the engine's most recent step",
+    labels=("engine",))
+SPEC_ACCEPTED_LAST = gauge(
+    "paddle_spec_last_step_accepted_tokens",
+    "Tokens emitted by the engine's most recent speculative verify "
+    "step (accepted drafts + bonus/correction, summed over slots)",
+    labels=("engine",))
+REQUESTS_ENQUEUED = counter(
+    "paddle_requests_enqueued_total",
+    "Requests ever accepted by DecodeEngine.add_request")
+REQUESTS_FINISHED = counter(
+    "paddle_requests_finished_total",
+    "Requests that left an engine, by finish reason",
+    labels=("reason",))
+
+
+# ---------------------------------------------------------------------------
+# Views over the pre-existing telemetry islands
+# ---------------------------------------------------------------------------
+def _decode_view():
+    """decode_stats as registry series.  Goes through
+    `profiler.decode_stats`, so an engine-less process renders zeros
+    WITHOUT importing the serving module (its contract)."""
+    from .. import profiler
+
+    st = profiler.decode_stats()
+    samples = []
+    for k in profiler.DECODE_STAT_COUNTERS:
+        v = st[k]
+        if k.endswith("_s"):
+            samples.append(Sample(f"paddle_decode_{k[:-2]}_seconds_total",
+                                  "counter", "", (), [((), v)]))
+        elif k.endswith("_sum"):
+            samples.append(Sample(f"paddle_decode_{k}", "gauge", "", (),
+                                  [((), v)]))
+        else:
+            samples.append(Sample(f"paddle_decode_{k}_total", "counter",
+                                  "", (), [((), v)]))
+    for k in profiler.DECODE_STAT_DERIVED:
+        samples.append(Sample(f"paddle_decode_{k}", "gauge", "", (),
+                              [((), st[k])]))
+    return samples
+
+
+def _dispatch_view():
+    """dispatch_stats as op-labeled registry series (the neutral-shape
+    rows come from `core.dispatch.telemetry_series`, the data owner)."""
+    from ..core import dispatch
+
+    return [Sample(name, kind, "", label_names, rows)
+            for kind, name, label_names, rows
+            in dispatch.telemetry_series()]
+
+
+registry.register_view(_decode_view)
+registry.register_view(_dispatch_view)

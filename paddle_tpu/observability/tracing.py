@@ -1,0 +1,135 @@
+"""Request/step span tracing + merged chrome-trace export.
+
+The host tracer (csrc Tracer via `core.native.RecordEvent`) answers
+"what did the host do"; it cannot answer "what happened to request 17"
+or "how long was each decode step".  This module keeps a Python-side
+span buffer on named **tracks** and merges all three sources into ONE
+chrome://tracing JSON:
+
+* track ``host``     — the native tracer's events, verbatim (pid 0);
+* track ``engine``   — decode / prefill / draft / verify step spans
+  (one tid per engine instance);
+* track ``requests`` — per-request lifecycle spans, one tid per
+  request id: ``queued`` (enqueue→admit), ``prefill`` (admit→first
+  token), ``decode`` (first token→finish).
+
+Tracks map to chrome-trace *processes* (metadata ``process_name``
+events), so the trace viewer shows them as separately-labeled lanes.
+Timestamps share the host tracer's clock (`native.now_ns`) so spans
+and host events line up on one timeline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+from ..core import native
+from .metrics import _state
+
+__all__ = ["now_ns", "record_span", "span", "spans", "clear_spans",
+           "span_count", "dropped_span_count", "merged_chrome_trace",
+           "export_chrome_trace", "HOST_TRACK"]
+
+HOST_TRACK = "host"
+
+# span buffer cap: a long-lived serving process must not grow a trace
+# without bound; beyond the cap spans are counted, not stored
+MAX_SPANS = 200_000
+
+_lock = threading.Lock()
+_spans: list = []
+_dropped = [0]
+
+now_ns = native.now_ns  # one clock for spans AND host events
+
+
+def record_span(track: str, name: str, start_ns: int, dur_ns: int,
+                tid: int = 0, args: Optional[dict] = None):
+    """Append one completed span to ``track``.  ``args`` must be
+    JSON-serializable (plain python scalars)."""
+    if not _state["enabled"]:
+        return
+    with _lock:
+        if len(_spans) >= MAX_SPANS:
+            _dropped[0] += 1
+            return
+        _spans.append((track, name, int(start_ns), int(dur_ns),
+                       int(tid), args))
+
+
+class span:
+    """RAII span (the Python-track sibling of `native.RecordEvent`)."""
+
+    def __init__(self, track: str, name: str, tid: int = 0,
+                 args: Optional[dict] = None):
+        self.track, self.name, self.tid, self.args = track, name, tid, args
+
+    def __enter__(self):
+        self._t0 = now_ns()
+        return self
+
+    def __exit__(self, *exc):
+        record_span(self.track, self.name, self._t0,
+                    now_ns() - self._t0, self.tid, self.args)
+        return False
+
+
+def spans():
+    with _lock:
+        return list(_spans)
+
+
+def clear_spans():
+    with _lock:
+        _spans.clear()
+        _dropped[0] = 0
+
+
+def span_count() -> int:
+    with _lock:
+        return len(_spans)
+
+
+def dropped_span_count() -> int:
+    with _lock:
+        return _dropped[0]
+
+
+def merged_chrome_trace() -> dict:
+    """One chrome-trace dict: host tracer events (pid 0) + every span
+    track as its own named process."""
+    try:
+        host = json.loads(native.trace_export_json()).get(
+            "traceEvents", [])
+    except ValueError:
+        host = []
+    events = [{"ph": "M", "pid": 0, "name": "process_name",
+               "args": {"name": HOST_TRACK}}]
+    events.extend(host)
+
+    pids = {HOST_TRACK: 0}
+    for track, name, t0, dur, tid, args in spans():
+        pid = pids.get(track)
+        if pid is None:
+            pid = pids[track] = len(pids)
+            events.append({"ph": "M", "pid": pid, "name": "process_name",
+                           "args": {"name": track}})
+        ev = {"name": name, "ph": "X", "pid": pid, "tid": tid,
+              "ts": t0 / 1e3, "dur": dur / 1e3}  # chrome units: us
+        if args:
+            ev["args"] = args
+        events.append(ev)
+    return {"traceEvents": events}
+
+
+def export_chrome_trace(path: str) -> dict:
+    """Write the merged timeline to ``path``; returns the trace dict."""
+    data = merged_chrome_trace()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(data, f)
+    return data

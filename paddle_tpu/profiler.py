@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import contextlib
 import json
-import os
 from collections import defaultdict
 
 from .core import native
@@ -98,13 +97,17 @@ def start_profiler(state="All", tracer_option="Default"):
     native.tracer_enable()
 
 
-def stop_profiler(sorted_key="total", profile_path=None):
+def stop_profiler(sorted_key="total", profile_path=None, print_table=True):
     """Stop collection; print the aggregated table; optionally dump a
     chrome-trace timeline json to `profile_path` (reference
-    `DisableProfiler` + timeline proto export)."""
+    `DisableProfiler` + timeline proto export).  ``print_table=False``
+    returns the table without writing stdout — for tests and the
+    periodic observability reporter, which collect rather than spam;
+    the default keeps the reference's print-on-stop behavior."""
     native.tracer_disable()
     text = summary_string(sorted_key=sorted_key)
-    print(text)
+    if print_table:
+        print(text)
     if profile_path:
         export_chrome_tracing(profile_path)
     return text
@@ -167,13 +170,16 @@ def summary_string(sorted_key="total") -> str:
 
 
 def export_chrome_tracing(path: str):
-    """Write the host timeline as chrome://tracing JSON (reference timeline
-    proto → `tools/timeline.py` equivalent, emitted directly)."""
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "w") as f:
-        f.write(native.trace_export_json())
+    """Write the MERGED chrome://tracing JSON: host tracer events plus
+    the observability span tracks (engine decode/prefill/verify step
+    spans, per-request lifecycle spans) on separately named process
+    lanes (reference timeline proto → `tools/timeline.py` equivalent,
+    now one timeline for all three telemetry sources).  A process that
+    recorded no spans gets exactly the old host-only timeline plus its
+    track label."""
+    from .observability import tracing as _tracing
+
+    _tracing.export_chrome_trace(path)
 
 
 # ---------------------------------------------------------------------------

@@ -58,3 +58,41 @@ class TestProfiler:
         with profiler.RecordEvent("ghost"):
             pass
         assert "ghost" not in profiler.summary_string()
+
+
+class TestStopProfilerPrintTable:
+    def test_print_table_false_collects_silently(self, capsys):
+        """Tests and the periodic reporter collect the table without
+        spamming stdout; the default keeps reference behavior."""
+        profiler.start_profiler()
+        text = profiler.stop_profiler(print_table=False)
+        assert "Profiling Report" in text
+        assert capsys.readouterr().out == ""
+        profiler.reset_profiler()
+
+    def test_default_still_prints(self, capsys):
+        profiler.start_profiler()
+        text = profiler.stop_profiler()
+        assert "Profiling Report" in capsys.readouterr().out
+        assert "Profiling Report" in text
+        profiler.reset_profiler()
+
+
+class TestMergedChromeExport:
+    def test_export_includes_observability_tracks(self, tmp_path):
+        """profiler.export_chrome_tracing now writes the MERGED
+        timeline: span tracks ride along with the host events."""
+        from paddle_tpu import observability as obs
+
+        obs.clear_spans()
+        obs.record_span("engine", "step", 1000, 500, tid=3)
+        path = str(tmp_path / "merged.json")
+        profiler.export_chrome_tracing(path)
+        data = json.loads(open(path).read())
+        tracks = {e["args"]["name"] for e in data["traceEvents"]
+                  if e.get("ph") == "M"}
+        assert {"host", "engine"} <= tracks
+        step = next(e for e in data["traceEvents"]
+                    if e.get("name") == "step")
+        assert step["tid"] == 3
+        obs.clear_spans()

@@ -116,6 +116,11 @@ def test_bench_decode_smoke(tmp_path):
     assert 0 < tel["batch_occupancy"] <= 1
     assert 0 < tel["kv_block_utilization"] <= 1
     assert data["page_size_sweep"], "page-size sweep must record rows"
+    # the embedded observability snapshot records latency DISTRIBUTIONS
+    snap = data["observability"]
+    ttft = snap["paddle_request_ttft_seconds"]["series"][0]
+    assert ttft["count"] > 0 and sum(ttft["counts"]) == ttft["count"]
+    assert snap["paddle_request_tpot_seconds"]["series"][0]["count"] > 0
 
 
 def test_bench_spec_decode_smoke(tmp_path):
@@ -145,6 +150,52 @@ def test_bench_spec_decode_smoke(tmp_path):
         assert leg["mean_accepted_per_step"] >= 1
         assert leg["retraces_after_warmup"] == 0
         assert leg["draft_time_s"] >= 0 and leg["verify_time_s"] > 0
+    # per-leg observability snapshots: every leg records TTFT/TPOT
+    # distributions, not just aggregate throughput
+    snaps = data["observability"]
+    assert set(snaps) == set(legs)
+    for name, snap in snaps.items():
+        assert snap["paddle_request_ttft_seconds"]["series"][0][
+            "count"] > 0, name
+        assert snap["paddle_request_tpot_seconds"]["series"][0][
+            "count"] > 0, name
+
+
+def test_telemetry_dump_smoke(tmp_path):
+    """tools/telemetry_dump.py runs a small engine workload end-to-end
+    and every export format parses: Prometheus text has the core
+    request-latency and KV-pool series, the JSON snapshot is
+    structured, and the merged chrome trace carries the host / engine /
+    requests tracks (the ISSUE-4 acceptance check)."""
+    outdir = str(tmp_path / "tel")
+    r = subprocess.run(
+        [sys.executable, "tools/telemetry_dump.py", "--outdir", outdir],
+        cwd=REPO, capture_output=True, text=True, env=ENV, timeout=600)
+    assert r.returncode == 0, r.stderr
+
+    prom = open(os.path.join(outdir, "telemetry.prom")).read()
+    for needle in ("paddle_request_ttft_seconds_bucket",
+                   "paddle_request_tpot_seconds_count",
+                   "paddle_request_queue_wait_seconds_sum",
+                   "paddle_kv_pool_utilization",
+                   "paddle_decode_steps_total",
+                   "paddle_dispatch_calls_total",
+                   "# TYPE paddle_request_ttft_seconds histogram"):
+        assert needle in prom, needle
+
+    with open(os.path.join(outdir, "telemetry.json")) as f:
+        snap = json.load(f)
+    m = snap["metrics"]
+    assert m["paddle_request_ttft_seconds"]["series"][0]["count"] == 2
+    assert m["paddle_requests_finished_total"]["series"]
+    assert snap["workload"]["tokens_out"] > 0
+
+    with open(os.path.join(outdir, "telemetry_trace.json")) as f:
+        trace = json.load(f)
+    tracks = {e["args"]["name"] for e in trace["traceEvents"]
+              if e.get("ph") == "M"}
+    assert {"host", "engine", "requests"} <= tracks
+    assert any(e.get("name") == "prefill" for e in trace["traceEvents"])
 
 
 def test_op_bench_gate_device_mismatch(tmp_path):

@@ -78,6 +78,7 @@ def _round_up(n, m):
 
 
 def _bench_engine(model, prompts, n_new, max_len, page_size):
+    from paddle_tpu import observability
     from paddle_tpu.inference.serving import (DecodeEngine, decode_stats,
                                               reset_decode_stats)
 
@@ -86,10 +87,11 @@ def _bench_engine(model, prompts, n_new, max_len, page_size):
                        page_size=page_size)
     eng.generate(prompts, max_new_tokens=min(n_new, 4))  # warm executables
     reset_decode_stats()
+    observability.reset()  # snapshot below covers the timed serve only
     t0 = time.perf_counter()
     outs = eng.generate(prompts, max_new_tokens=n_new)
     wall = time.perf_counter() - t0
-    return wall, outs, decode_stats()
+    return wall, outs, decode_stats(), observability.snapshot()
 
 
 def main():
@@ -159,15 +161,15 @@ def main():
                  f"context+new_tokens ({max_len}) within the model's "
                  f"position table ({model.cfg.max_seq_len})")
     for ps in candidates:
-        wall_e, outs_e, stats = _bench_engine(
+        wall_e, outs_e, stats, obs_snap = _bench_engine(
             model, list(prompt), n_new, max_len, ps)
         row = {"page_size": ps, "wall_s": round(wall_e, 4),
                "tokens_per_s": round(total / wall_e, 2)}
         sweep.append(row)
         print(f"engine ps={ps:3d}: {total / wall_e:9.1f} tok/s")
         if best is None or wall_e < best[0]:
-            best = (wall_e, ps, outs_e, stats)
-    wall_e, best_ps, outs_e, stats = best
+            best = (wall_e, ps, outs_e, stats, obs_snap)
+    wall_e, best_ps, outs_e, stats, obs_snap = best
     telemetry = {k: stats[k] for k in
                  ("steps", "tokens", "decode_compiles", "prefill_compiles",
                   "retraces_after_warmup", "avg_step_ms",
@@ -198,6 +200,10 @@ def main():
         "legs": legs,
         "page_size_sweep": sweep,
         "parity": parity,
+        # full observability snapshot of the winning engine leg:
+        # TTFT/TPOT/queue-wait/e2e DISTRIBUTIONS (histogram buckets),
+        # not just the aggregate throughput above
+        "observability": obs_snap,
     }
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)

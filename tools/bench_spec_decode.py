@@ -64,6 +64,7 @@ def _repetitive_prompts(args):
 
 
 def _bench_engine(model, prompts, args, spec_k, drafter):
+    from paddle_tpu import observability
     from paddle_tpu.inference.serving import (DecodeEngine, decode_stats,
                                               reset_decode_stats)
 
@@ -75,10 +76,11 @@ def _bench_engine(model, prompts, args, spec_k, drafter):
                        page_size=args.page_size, **kw)
     eng.generate(prompts, max_new_tokens=min(args.new_tokens, 4))  # warm
     reset_decode_stats()
+    observability.reset()  # snapshot below covers the timed serve only
     t0 = time.perf_counter()
     outs = eng.generate(prompts, max_new_tokens=args.new_tokens)
     wall = time.perf_counter() - t0
-    return wall, outs, decode_stats()
+    return wall, outs, decode_stats(), observability.snapshot()
 
 
 def main():
@@ -128,7 +130,8 @@ def main():
 
         return PromptLookupDrafter()
 
-    wall_b, outs_b, stats_b = _bench_engine(model, prompts, args, 0, None)
+    wall_b, outs_b, stats_b, snap_b = _bench_engine(
+        model, prompts, args, 0, None)
     base_tps = total / wall_b
     print(f"engine (PR 2 baseline): {base_tps:9.1f} tok/s "
           f"({wall_b:.2f}s)")
@@ -137,10 +140,15 @@ def main():
         "tokens_per_s": round(base_tps, 2),
         "retraces_after_warmup": stats_b["retraces_after_warmup"],
     }}
+    # per-leg observability snapshots: TTFT/TPOT/queue-wait/e2e
+    # DISTRIBUTIONS (histogram buckets), not just aggregate throughput
+    obs_snaps = {"engine": snap_b}
 
     parity = True
     for k in sorted({int(x) for x in args.ks.split(",") if x}):
-        wall, outs, st = _bench_engine(model, prompts, args, k, drafter)
+        wall, outs, st, snap = _bench_engine(model, prompts, args, k,
+                                             drafter)
+        obs_snaps[f"spec_k{k}"] = snap
         tps = total / wall
         ok = all(a == b for a, b in zip(outs, outs_b))
         parity = parity and ok
@@ -177,6 +185,7 @@ def main():
                    "page_size": args.page_size},
         "legs": legs,
         "parity": bool(parity),
+        "observability": obs_snaps,
     }
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)

@@ -1,0 +1,108 @@
+"""Telemetry dump: run a small DecodeEngine workload, export every
+observability format.
+
+The one-command answer to "what does the measurement layer see": build
+a tiny GPT, serve a couple of requests through the paged decode engine
+(optionally speculative), and write
+
+* ``telemetry.prom``        — Prometheus text exposition
+  (`observability.prometheus_text()`);
+* ``telemetry.json``        — structured snapshot
+  (`observability.snapshot()`);
+* ``telemetry_trace.json``  — merged chrome-trace timeline (host
+  tracer + engine step spans + request spans, one named track each).
+
+CI smokes this end-to-end (tests/test_tooling.py): both export formats
+must parse and the core request-latency series must be present after a
+single CPU `generate()` run — the ISSUE-4 acceptance check.
+
+Usage:
+    python tools/telemetry_dump.py [--outdir DIR] [--batch 2]
+                                   [--context 24] [--new-tokens 8]
+                                   [--spec-k 0] [--seed 0]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import observability, profiler  # noqa: E402
+from paddle_tpu.models.gpt import GPT, GPTConfig  # noqa: E402
+from paddle_tpu.inference.serving import DecodeEngine  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "telemetry_out"))
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--context", type=int, default=24)
+    ap.add_argument("--new-tokens", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=1)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative draft length (0 = classic decode)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    paddle.seed(args.seed)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.context + args.new_tokens + 32,
+                    use_parallel_layers=False, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+
+    rng = np.random.RandomState(args.seed)
+    prompts = [rng.randint(0, args.vocab, (args.context,)).astype(np.int32)
+               for _ in range(args.batch)]
+
+    # fresh slate so the dump describes exactly this workload
+    observability.reset()
+    observability.clear_spans()
+    profiler.reset_decode_stats()
+    profiler.start_profiler()  # host tracer -> the merged trace's host track
+
+    kw = {"spec_decode_k": args.spec_k} if args.spec_k else {}
+    eng = DecodeEngine(model, max_batch_size=args.batch,
+                       max_seq_len=args.context + args.new_tokens,
+                       page_size=args.page_size, seed=args.seed, **kw)
+    outs = eng.generate(prompts, max_new_tokens=args.new_tokens)
+    profiler.stop_profiler(print_table=False)
+
+    os.makedirs(args.outdir, exist_ok=True)
+    prom_path = os.path.join(args.outdir, "telemetry.prom")
+    json_path = os.path.join(args.outdir, "telemetry.json")
+    trace_path = os.path.join(args.outdir, "telemetry_trace.json")
+
+    with open(prom_path, "w") as f:
+        f.write(observability.prometheus_text())
+    with open(json_path, "w") as f:
+        json.dump({"workload": {"batch": args.batch,
+                                "context": args.context,
+                                "new_tokens": args.new_tokens,
+                                "spec_k": args.spec_k,
+                                "tokens_out": sum(len(o) for o in outs)},
+                   "metrics": observability.snapshot()}, f, indent=2)
+    trace = observability.export_chrome_trace(trace_path)
+
+    tracks = sorted(e["args"]["name"] for e in trace["traceEvents"]
+                    if e.get("ph") == "M" and e.get("name") == "process_name")
+    print(f"wrote {prom_path}")
+    print(f"wrote {json_path}")
+    print(f"wrote {trace_path} (tracks: {', '.join(tracks)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
