@@ -105,6 +105,22 @@ define_flag("interp_tensor_array_capacity", 0,
             "fallback capacity for TensorArrays written inside an "
             "interpreted `while` when the loop bound cannot be inferred "
             "from the Condition (0 = raise instead)")
+define_flag("chunked_prefill", True,
+            "serving engine prefill policy: 1 (default) fuses prompt "
+            "ingestion into the decode step — each step feeds every "
+            "prefilling slot a prompt chunk and every decoding slot its "
+            "usual token through ONE mixed-batch executable, so an "
+            "admission never stalls running decodes for a full prompt "
+            "pass.  0 restores the legacy one-shot bucket-padded prefill "
+            "(the greedy-parity oracle; see docs/DECODE_PERF.md)")
+define_flag("prefill_chunk_tokens", 64,
+            "per-step prompt-token budget of the chunked-prefill "
+            "scheduler (FLAGS_chunked_prefill): each engine step consumes "
+            "at most this many prompt tokens across all prefilling slots "
+            "(a single slot's chunk is also capped here — it is the Q_max "
+            "of the fixed-shape mixed-step executable).  Smaller values "
+            "bound per-step latency (TPOT of running requests) tighter at "
+            "the cost of more steps to finish a prompt")
 define_flag("spec_decode_k", 0,
             "speculative decoding draft length for the serving engine "
             "(inference.serving.DecodeEngine): propose K tokens per step "

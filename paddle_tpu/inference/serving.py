@@ -15,11 +15,18 @@ Layers:
 * `KVBlockPool` — host-side page allocator over the device-resident
   K/V page pools (`[layers, kv_heads, num_pages, page_size, head_dim]`);
 * `Request` / `DecodeEngine` — continuous batching over a fixed slot
-  grid: prefill per admitted request (bucket-padded so prompt lengths
-  share executables), then batched decode steps over every active slot;
-  with ``spec_decode_k > 0`` (or FLAGS_spec_decode_k) each step becomes
-  a speculative propose->verify->accept round (`inference.speculative`)
-  emitting up to K+1 tokens per slot;
+  grid.  With chunked prefill (FLAGS_chunked_prefill, the default)
+  admission binds a request to a slot immediately and its prompt is
+  consumed chunk by chunk INSIDE the decode step: each step runs one
+  fixed-shape ``[slots, Q_max]`` mixed batch (prefilling slots carry a
+  prompt chunk as Q>1 ragged rows, decoding slots their usual Q=1 row)
+  through a single donated executable, so an admission never stalls
+  running decodes and TTFT lands when the last chunk does.  The legacy
+  one-shot bucket-padded prefill stays behind ``chunked_prefill=0`` as
+  the greedy-parity oracle.  With ``spec_decode_k > 0`` (or
+  FLAGS_spec_decode_k) each step becomes a speculative
+  propose->verify->accept round (`inference.speculative`) emitting up
+  to K+1 tokens per slot;
 * telemetry — step latency, batch occupancy, KV-block utilization and
   executable (re)compilation counts, plus speculative acceptance rates
   and per-request finish reasons, surfaced through
@@ -33,6 +40,7 @@ parity contract tests/test_paged_decode.py pins.
 from __future__ import annotations
 
 import functools
+import heapq
 import time
 from collections import deque
 from typing import List, Optional
@@ -112,6 +120,32 @@ def reset_decode_stats():
 # not depend on the serving module); re-exported here for the engine's
 # public surface.
 from ..nn.decode import sample_logits  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# PRNG stream domains.  Every sampling key is
+# ``fold_in(engine_key, _fold_counter(counter, domain))``: decode /
+# mixed steps fold values in (0, 2^30], legacy one-shot prefill in
+# (2^30, 2^31].  The counters themselves are unbounded — after ~2^30
+# steps a naive ``fold_in(key, step_no)`` would walk into the prefill
+# window and alias its stream, so the fold value WRAPS inside its own
+# window (and asserts it stayed there).  Regression-pinned by
+# tests/test_chunked_prefill.py::TestRngDomains.
+# ---------------------------------------------------------------------------
+_RNG_DOMAIN = 1 << 30
+RNG_DECODE_DOMAIN = 0   # decode / mixed steps (and speculative rounds)
+RNG_PREFILL_DOMAIN = 1  # legacy one-shot prefill
+
+
+def _fold_counter(counter: int, domain: int) -> int:
+    """Map an unbounded 1-based counter into its domain's fold_in
+    window ``(domain * 2^30, (domain + 1) * 2^30]``."""
+    if counter < 1:
+        raise ValueError(f"stream counter must be >= 1, got {counter}")
+    v = domain * _RNG_DOMAIN + 1 + (counter - 1) % _RNG_DOMAIN
+    assert domain * _RNG_DOMAIN < v <= (domain + 1) * _RNG_DOMAIN, \
+        (counter, domain, v)
+    return v
 
 
 class _JitTracker:
@@ -207,11 +241,29 @@ class Request:
         self.t_admit_ns: Optional[int] = None
         self.t_first_token_ns: Optional[int] = None
         self.t_finish_ns: Optional[int] = None
+        # chunked prefill: mixed steps that carried one of this
+        # request's prompt chunks (1 on the legacy one-shot path)
+        self.prefill_chunks = 0
+        self._engine = None  # set by DecodeEngine.add_request
 
     def total_kv_tokens(self) -> int:
         # KV rows ever written: prompt + all generated-token writes except
         # the final sampled token (its KV is never needed)
         return len(self.prompt_ids) + max(self.max_new_tokens - 1, 0)
+
+    def cancel(self):
+        """Cancel this request while it is still QUEUED: it leaves the
+        engine's admission queue without ever taking a slot, and
+        ``finish_reason`` reads "cancelled" (the
+        ``finished{reason="cancelled"}`` counter distinguishes it from a
+        running request's eviction).  Cancelling an already-finished
+        request is a no-op; a RUNNING request holds device state and
+        must go through `DecodeEngine.evict` instead."""
+        if self.state == "done":
+            return
+        if self._engine is None:
+            raise ValueError("request was never enqueued on an engine")
+        self._engine._cancel_queued(self)
 
 
 # ---------------------------------------------------------------------------
@@ -361,6 +413,76 @@ def _gpt_decode_step(params, k_pages, v_pages, block_tables, seq_lens,
     return k_pages, v_pages, jnp.where(active, nxt, 0)
 
 
+def _gpt_mixed_step(params, k_pages, v_pages, block_tables, seq_lens,
+                    tokens, write_caps, sample_idx, sample_mask, key, *,
+                    num_heads, head_dim, eps, sampler, temperature,
+                    top_k, top_p):
+    """ONE mixed prefill+decode step over every slot: prefilling slots
+    contribute a prompt chunk (rows 0..cap-1 of their ``tokens`` row),
+    decoding slots contribute their last sampled token (cap 1), stalled
+    or inactive slots contribute nothing (cap 0).  K/V for every
+    contributed row is scattered into the slot's already-reserved pages
+    (write-capped, so padding rows are dropped), attention runs through
+    the ragged multi-query paged kernel with per-sequence causal
+    offsets (``q_offsets = seq_lens``: each chunk starts at the slot's
+    current KV length), and ONE token per slot is sampled from the row
+    ``sample_idx`` picks — the last prompt row for a slot finishing its
+    prefill this step, row 0 for a decoding slot.  ``sample_mask``
+    zeroes the draw for slots still mid-prefill.
+
+    tokens: [B, Q_max] int32; write_caps/sample_idx: [B] int32;
+    sample_mask: [B] bool; k_pages/v_pages donated (in-place update).
+    Returns (k_pages, v_pages, sampled [B] int32).
+
+    The shapes are fixed per engine, so this compiles ONCE — the pow-2
+    bucket zoo of legacy prefill executables collapses into this single
+    program, and the `_JitTracker` retrace contract covers it.
+    """
+    b, qn = tokens.shape
+    h = num_heads * head_dim
+    num_pages_total = k_pages.shape[2]
+    page = k_pages.shape[3]
+
+    offs = jnp.arange(qn, dtype=jnp.int32)
+    pos = seq_lens[:, None] + offs[None, :]              # [B, Q]
+    wpe_max = params["wpe"].shape[0] - 1
+    x = params["wte"][tokens] + params["wpe"][jnp.minimum(pos, wpe_max)]
+    page_idx, slot = pa.paged_write_indices(
+        block_tables, seq_lens, write_caps, qn, num_pages_total, page)
+    lens_now = seq_lens + write_caps
+
+    for li, blk in enumerate(params["blocks"]):
+        y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
+        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = qkv.reshape(b, qn, 3, num_heads, head_dim)
+        q = qkv[:, :, 0]                                 # [B, Q, H, D]
+        # slice shape [B, Q, Hkv, D] (the int layer index joins the
+        # advanced group — batch dims lead); capped rows have an OOB
+        # page index and are dropped by the scatter
+        k_pages = k_pages.at[li, :, page_idx, slot, :].set(qkv[:, :, 1])
+        v_pages = v_pages.at[li, :, page_idx, slot, :].set(qkv[:, :, 2])
+        attn = pa.paged_attention(q, k_pages[li], v_pages[li],
+                                  block_tables, lens_now,
+                                  q_offsets=seq_lens)
+        x = x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"]) \
+            + blk["out_b"]
+        y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
+        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+                        approximate=True)
+        x = x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+                 ).reshape(b, qn, h)
+
+    # sample ONE row per slot (not all Q like the verify step): the
+    # lm-head matmul runs over [B, h], so mixed-step sampling costs the
+    # same as a classic decode step's
+    sel = x[jnp.arange(b), sample_idx]                   # [B, h]
+    sel = _ln(sel, params["lnf_w"], params["lnf_b"], eps)
+    logits = _logits_of(params, sel).astype(jnp.float32)
+    nxt = sample_logits(logits, sampler=sampler, temperature=temperature,
+                        top_k=top_k, top_p=top_p, key=key)
+    return k_pages, v_pages, jnp.where(sample_mask, nxt, 0)
+
+
 # ---------------------------------------------------------------------------
 # The engine
 # ---------------------------------------------------------------------------
@@ -380,7 +502,8 @@ class DecodeEngine:
                  page_size=None, num_pages=None, sampler="greedy",
                  temperature=1.0, top_k=0, top_p=1.0, seed=0,
                  eos_token_id=None, dtype=None, spec_decode_k=None,
-                 drafter=None):
+                 drafter=None, chunked_prefill=None,
+                 prefill_chunk_tokens=None, prefill_q_max=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -420,6 +543,15 @@ class DecodeEngine:
         self._active = np.zeros(self._slots, bool)
         self._last = np.zeros(self._slots, np.int32)
         self._by_slot: List[Optional[Request]] = [None] * self._slots
+        # prompt tokens already consumed per slot (chunked prefill
+        # cursor); a slot is mid-prefill while the cursor trails its
+        # request's prompt length
+        self._prefill_pos = np.zeros(self._slots, np.int32)
+        # min-heap of free slot indices: admission pops the lowest slot,
+        # _finish pushes it back — O(log slots) per event instead of the
+        # old scan over every slot per admitted request
+        self._free_slots = list(range(self._slots))
+        heapq.heapify(self._free_slots)
 
         self._sampling = dict(sampler=sampler,
                               temperature=float(temperature),
@@ -430,6 +562,7 @@ class DecodeEngine:
         self._prefill_no = 0
         self._queue: "deque[Request]" = deque()
         self._decode_fn = None  # shapes are fixed: ONE jitted step
+        self._mixed_fn = None   # ONE mixed prefill+decode executable
         self._prefill_fns = {}
         # engine id = the chrome-trace tid of this engine's step spans
         # (several engines in one process stay on separate lanes)
@@ -439,12 +572,42 @@ class DecodeEngine:
         # reporter, started once per process
         _obs.maybe_start_reporter()
 
+        from ..core import flags as _flags
+
+        # chunked prefill (explicit args win, else the flags): prompt
+        # ingestion rides the decode step as fixed-shape [slots, Q_max]
+        # mixed batches instead of one-shot bucket-padded prefills, so
+        # an admission never stalls running decodes.  The legacy path
+        # (chunked_prefill=0) stays as the greedy-parity oracle.
+        if chunked_prefill is None:
+            chunked_prefill = bool(_flags.flag("chunked_prefill"))
+        self._chunked = bool(chunked_prefill)
+        if prefill_chunk_tokens is None:
+            prefill_chunk_tokens = int(_flags.flag("prefill_chunk_tokens"))
+        if prefill_chunk_tokens < 1:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 1, got "
+                f"{prefill_chunk_tokens}")
+        # per-step prompt-token budget (never wider than the horizon: a
+        # chunk cannot outsize a prompt)
+        self._chunk_budget = min(int(prefill_chunk_tokens),
+                                 self._max_seq_len)
+        # Q_max: the mixed executable's per-slot row width.  Defaults to
+        # the budget; setting it SMALLER caps the step's compute (the
+        # executable always pays slots x Q_max rows) while the budget
+        # still spreads across several prefilling slots per step —
+        # decoupling per-step latency from aggregate prefill throughput
+        if prefill_q_max is None:
+            prefill_q_max = self._chunk_budget
+        if prefill_q_max < 1:
+            raise ValueError(
+                f"prefill_q_max must be >= 1, got {prefill_q_max}")
+        self._q_max = min(int(prefill_q_max), self._chunk_budget)
+
         # speculative decoding (propose K / verify in one multi-query
         # pass): explicit arg wins, else FLAGS_spec_decode_k.  The
         # subsystem lives in inference.speculative; constructed lazily
         # so non-speculative engines never import it.
-        from ..core import flags as _flags
-
         if spec_decode_k is None:
             spec_decode_k = int(_flags.flag("spec_decode_k"))
         self._spec = None
@@ -479,6 +642,7 @@ class DecodeEngine:
         if self._pages_for(req.total_kv_tokens()) > self.pool.num_pages:
             raise ValueError(
                 "request needs more KV pages than the pool holds")
+        req._engine = self
         req.t_enqueue_ns = _obs.now_ns()
         _obs.REQUESTS_ENQUEUED.inc()
         self._queue.append(req)
@@ -498,11 +662,7 @@ class DecodeEngine:
         return min(bucket, self._max_seq_len)
 
     def _admit(self):
-        while self._queue:
-            free_slots = [i for i in range(self._slots)
-                          if not self._active[i]]
-            if not free_slots:
-                return
+        while self._queue and self._free_slots:
             req = self._queue[0]
             total_pages = self._pages_for(req.total_kv_tokens())
             # conservative admission: never admit a request the pool
@@ -511,10 +671,13 @@ class DecodeEngine:
             if self.pool.free_count - self.pool.reserved < total_pages:
                 return
             self._queue.popleft()
-            slot = free_slots[0]
-            self._prefill_into(req, slot, total_pages)
+            slot = heapq.heappop(self._free_slots)
+            if self._chunked:
+                self._bind_slot(req, slot, total_pages)
+            else:
+                self._prefill_into(req, slot, total_pages)
 
-    def _prefill_into(self, req: Request, slot: int, total_pages: int):
+    def _stamp_admit(self, req: Request):
         req.t_admit_ns = _obs.now_ns()
         if req.t_enqueue_ns is not None:
             _obs.REQUEST_QUEUE_WAIT.observe(
@@ -523,6 +686,12 @@ class DecodeEngine:
                              req.t_admit_ns - req.t_enqueue_ns,
                              tid=req.request_id,
                              args={"request": req.request_id})
+
+    def _alloc_prompt_pages(self, req: Request, slot: int,
+                            total_pages: int):
+        """Allocate the prompt's pages up front (chunks scatter into
+        already-owned pages), reserve the decode tail, and point the
+        slot's block-table row at them."""
         p_len = len(req.prompt_ids)
         for _ in range(self._pages_for(p_len)):
             req.pages.append(self.pool.alloc_page())
@@ -530,6 +699,42 @@ class DecodeEngine:
         row = np.zeros(self._pages_per_seq, np.int32)
         row[:len(req.pages)] = req.pages
         self._bt[slot] = row
+
+    def _bind_slot(self, req: Request, slot: int, total_pages: int):
+        """Chunked admission: bind the request to a slot WITHOUT running
+        any prompt pass — the next mixed steps feed its prompt chunk by
+        chunk under the FLAGS_prefill_chunk_tokens budget (admit-on-
+        first-chunk), so running decodes never stall."""
+        self._stamp_admit(req)
+        self._alloc_prompt_pages(req, slot, total_pages)
+        req.state = "running"
+        req.slot = slot
+        self._by_slot[slot] = req
+        self._lens[slot] = 0
+        self._last[slot] = 0
+        self._prefill_pos[slot] = 0
+        self._active[slot] = True
+        if self._spec is not None:
+            self._spec.on_admit(slot, req)
+
+    def _is_prefilling(self, slot: int) -> bool:
+        req = self._by_slot[slot]
+        return req is not None and \
+            int(self._prefill_pos[slot]) < len(req.prompt_ids)
+
+    def _prefilling_any(self) -> bool:
+        return any(self._is_prefilling(s) for s in range(self._slots)
+                   if self._active[s])
+
+    def _prefill_into(self, req: Request, slot: int, total_pages: int):
+        if self._active.any():
+            # legacy one-shot prefill runs BETWEEN decode steps: every
+            # already-running slot stalls for this whole prompt pass —
+            # the cost chunked prefill exists to remove
+            _stats_add(stalled_decode_steps=1)
+        self._stamp_admit(req)
+        self._alloc_prompt_pages(req, slot, total_pages)
+        p_len = len(req.prompt_ids)
 
         bucket = self._prefill_bucket(p_len)
         ids = np.zeros((1, bucket), np.int32)
@@ -550,11 +755,15 @@ class DecodeEngine:
             _stats_add(prefill_compiles=1)
         t0 = time.perf_counter()
         t0_ns = _obs.now_ns()
-        # prefill keys live in the upper fold_in domain (decode steps use
-        # 1..2^30), derived from a PER-ENGINE counter so `seed` actually
-        # pins the sampling stream regardless of process-global state
+        # prefill keys live in the upper fold_in window (decode steps
+        # use (0, 2^30]), derived from a PER-ENGINE counter so `seed`
+        # actually pins the sampling stream regardless of process-global
+        # state; _fold_counter wraps inside the window so the streams
+        # can never alias, no matter the uptime
         self._prefill_no += 1
-        key = jax.random.fold_in(self._key, (1 << 30) + self._prefill_no)
+        key = jax.random.fold_in(
+            self._key, _fold_counter(self._prefill_no,
+                                     RNG_PREFILL_DOMAIN))
         self._k_pages, self._v_pages, tok = fn(
             self._params, jnp.asarray(ids), jnp.int32(p_len),
             jnp.asarray(self._bt[slot]), self._k_pages, self._v_pages,
@@ -582,6 +791,7 @@ class DecodeEngine:
         req.output_ids = [tok]
         self._by_slot[slot] = req
         self._lens[slot] = p_len
+        self._prefill_pos[slot] = p_len  # legacy: prompt consumed whole
         self._last[slot] = tok
         self._active[slot] = True
         if self._spec is not None:
@@ -613,6 +823,8 @@ class DecodeEngine:
         self._lens[slot] = 0
         self._last[slot] = 0
         self._bt[slot] = 0
+        self._prefill_pos[slot] = 0
+        heapq.heappush(self._free_slots, slot)
         _stats_add(**{{"eos": "finished_eos", "length": "finished_length",
                        "evicted": "evicted"}[reason]: 1})
         req.t_finish_ns = _obs.now_ns()
@@ -642,24 +854,7 @@ class DecodeEngine:
         ``req.finish_reason`` reads "evicted" — callers can finally tell
         a cancelled generation from one that hit eos."""
         if req.state == "queued":
-            try:
-                self._queue.remove(req)
-            except ValueError:
-                raise ValueError(
-                    "request is not queued on this engine") from None
-            req.state = "done"
-            req.finish_reason = "evicted"
-            req.t_finish_ns = _obs.now_ns()
-            _stats_add(evicted=1)
-            _obs.REQUESTS_FINISHED.inc(reason="evicted")
-            if req.t_enqueue_ns is not None:
-                _obs.REQUEST_E2E.observe(
-                    (req.t_finish_ns - req.t_enqueue_ns) / 1e9)
-                _obs.record_span("requests", "queued", req.t_enqueue_ns,
-                                 req.t_finish_ns - req.t_enqueue_ns,
-                                 tid=req.request_id,
-                                 args={"request": req.request_id,
-                                       "finish_reason": "evicted"})
+            self._retire_queued(req, "evicted")
             return
         if req.state == "running" and req.slot is not None and \
                 0 <= req.slot < self._slots and \
@@ -669,6 +864,38 @@ class DecodeEngine:
         if req.state == "done":
             return  # already finished; nothing to release
         raise ValueError("request is not owned by this engine")
+
+    def _retire_queued(self, req: Request, reason: str):
+        """Take a still-queued request out of the admission queue
+        (``reason``: "evicted" via `evict`, "cancelled" via
+        `Request.cancel`) — it never held a slot or pages, so this is
+        pure queue + telemetry bookkeeping."""
+        try:
+            self._queue.remove(req)
+        except ValueError:
+            raise ValueError(
+                "request is not queued on this engine") from None
+        req.state = "done"
+        req.finish_reason = reason
+        req.t_finish_ns = _obs.now_ns()
+        _stats_add(**{reason: 1})
+        _obs.REQUESTS_FINISHED.inc(reason=reason)
+        if req.t_enqueue_ns is not None:
+            _obs.REQUEST_E2E.observe(
+                (req.t_finish_ns - req.t_enqueue_ns) / 1e9)
+            _obs.record_span("requests", "queued", req.t_enqueue_ns,
+                             req.t_finish_ns - req.t_enqueue_ns,
+                             tid=req.request_id,
+                             args={"request": req.request_id,
+                                   "finish_reason": reason})
+
+    def _cancel_queued(self, req: Request):
+        if req.state != "queued":
+            raise ValueError(
+                f"cancel() is for still-queued requests; this one is "
+                f"{req.state!r} — use DecodeEngine.evict to cancel a "
+                f"running request")
+        self._retire_queued(req, "cancelled")
 
     def _grow_block_tables(self, writes=None):
         """Ensure pages exist for every KV row the next step will write:
@@ -683,23 +910,32 @@ class DecodeEngine:
                 continue
             req = self._by_slot[slot]
             w = 1 if writes is None else int(writes[slot])
-            pidx = (int(self._lens[slot]) + max(w - 1, 0)) // self._page
+            if w == 0:
+                continue  # nothing written this step (stalled/skipped)
+            pidx = (int(self._lens[slot]) + w - 1) // self._page
             while pidx >= len(req.pages):
                 req.pages.append(self.pool.alloc_page())
                 self.pool.reserved -= 1
                 self._bt[slot, len(req.pages) - 1] = req.pages[-1]
 
     def _observe_step(self, t0_ns: int, dt: float, n_active: int,
-                      name: str, extra_args=None):
+                      name: str, extra_args=None, observe_hist=True):
         """Per-step observability: a step span on this engine's trace
         lane, the step-latency histogram, and the pool/occupancy
-        gauges (levels as of the step that just ran)."""
+        gauges (levels as of the step that just ran).
+        ``observe_hist=False`` skips the step-latency histogram — used
+        by the chunk-only mixed step inside a speculative round: the
+        round observes a window that OPENS before the chunk step (or,
+        when every slot is still prefilling, the chunk step's wall is
+        observed directly), so each engine step lands in
+        paddle_decode_step_seconds exactly once, chunk time included."""
         args = {"step": self._step_no, "active": n_active}
         if extra_args:
             args.update(extra_args)
         _obs.record_span("engine", name, t0_ns, int(dt * 1e9),
                          tid=self._engine_id, args=args)
-        _obs.STEP_SECONDS.observe(dt)
+        if observe_hist:
+            _obs.STEP_SECONDS.observe(dt)
         # level gauges are engine-labeled: several engines in one
         # process must not clobber each other's pool/occupancy reading
         eid = self._engine_id
@@ -707,11 +943,161 @@ class DecodeEngine:
         _obs.KV_UTIL.set(self.pool.utilization(), engine=eid)
         _obs.SLOT_OCCUPANCY.set(n_active / self._slots, engine=eid)
 
+    # -- the mixed prefill+decode step ---------------------------------------
+    def _mixed_fn_tracker(self) -> _JitTracker:
+        fn = self._mixed_fn
+        if fn is None:
+            fn = self._mixed_fn = _JitTracker(jax.jit(
+                functools.partial(_gpt_mixed_step,
+                                  num_heads=self._num_heads,
+                                  head_dim=self._head_dim, eps=self._eps,
+                                  **self._sampling),
+                donate_argnums=(1, 2)), "mixed_compiles")
+        return fn
+
+    def _mixed_step(self, decode_rows=True) -> bool:
+        """One fused prefill+decode step: assemble the fixed-shape
+        [slots, Q_max] mixed batch under the chunk-token budget, run the
+        single donated mixed executable, land chunks / tokens on the
+        host side.  ``decode_rows=False`` (the speculative path) feeds
+        ONLY prompt chunks — decoding slots advance through the spec
+        round that follows in the same engine step."""
+        from ..profiler import RecordEvent
+
+        slots, qmax = self._slots, self._q_max
+        tokens = np.zeros((slots, qmax), np.int32)
+        caps = np.zeros(slots, np.int32)
+        sample_idx = np.zeros(slots, np.int32)
+        sample_mask = np.zeros(slots, bool)
+        prefilling = [s for s in range(slots)
+                      if self._active[s] and self._is_prefilling(s)]
+        # fair-share chunking: the step's token budget splits evenly
+        # across prefilling slots (remainder to the lower slots), so a
+        # short prompt admitted next to a long one finishes its prefill
+        # in one step instead of queueing behind the long prompt's whole
+        # stream — bounded TTFT for everyone, not just slot 0
+        budget = self._chunk_budget
+        chunk_of = {}
+        for i, s in enumerate(prefilling):
+            req = self._by_slot[s]
+            cur = int(self._prefill_pos[s])
+            share = -(-budget // (len(prefilling) - i))  # ceil
+            c = min(len(req.prompt_ids) - cur, share, qmax)
+            if c == 0:
+                continue  # budget spent: the slot waits one step
+            budget -= c
+            tokens[s, :c] = req.prompt_ids[cur:cur + c]
+            caps[s] = c
+            chunk_of[s] = c
+            if cur + c == len(req.prompt_ids):
+                # last chunk: this step produces the first token
+                sample_idx[s] = c - 1
+                sample_mask[s] = True
+        if decode_rows:
+            for s in range(slots):
+                if self._active[s] and s not in chunk_of and \
+                        not self._is_prefilling(s):
+                    tokens[s, 0] = self._last[s]
+                    caps[s] = 1
+                    sample_idx[s] = 0
+                    sample_mask[s] = True
+        self._grow_block_tables(writes=caps)
+
+        fn = self._mixed_fn_tracker()
+        self._step_no += 1
+        key = jax.random.fold_in(
+            self._key, _fold_counter(self._step_no, RNG_DECODE_DOMAIN))
+        t0 = time.perf_counter()
+        t0_ns = _obs.now_ns()
+        with RecordEvent("serving.mixed_step"):
+            self._k_pages, self._v_pages, toks = fn.fn(
+                self._params, self._k_pages, self._v_pages,
+                jnp.asarray(self._bt), jnp.asarray(self._lens),
+                jnp.asarray(tokens), jnp.asarray(caps),
+                jnp.asarray(sample_idx), jnp.asarray(sample_mask), key)
+            toks = np.asarray(toks)
+        dt = time.perf_counter() - t0
+        fn.check_retrace()
+
+        # the drafter sees the SAME chunks through the same executable
+        # shape (speculative path: caps carry only prompt chunks there)
+        if self._spec is not None and chunk_of:
+            self._spec.drafter.ingest_chunks(tokens, caps)
+
+        n_active = int(self._active.sum())
+        chunk_tokens = sum(chunk_of.values())
+        if decode_rows:
+            # a full mixed step IS this engine-step's decode step
+            _stats_add(mixed_steps=1, prefill_chunks=len(chunk_of),
+                       steps=1, decode_time_s=dt,
+                       occupancy_sum=n_active / slots,
+                       kv_util_sum=self.pool.utilization())
+        else:
+            # chunk-only (speculative path): the spec round that follows
+            # accounts the engine step; this wall is prefill work
+            _stats_add(mixed_steps=1, prefill_chunks=len(chunk_of),
+                       prefill_time_s=dt)
+        for c in chunk_of.values():
+            _obs.PREFILL_CHUNK_TOKENS.observe(c)
+        self._observe_step(t0_ns, dt, n_active, "mixed_step",
+                           extra_args={"prefilling": len(chunk_of),
+                                       "chunk_tokens": chunk_tokens},
+                           observe_hist=decode_rows)
+
+        emitted = 0
+        for s in range(slots):
+            if not self._active[s]:
+                continue
+            req = self._by_slot[s]
+            c = chunk_of.get(s)
+            if c is not None:
+                self._prefill_pos[s] += c
+                self._lens[s] += c
+                req.prefill_chunks += 1
+                if int(self._prefill_pos[s]) == len(req.prompt_ids):
+                    self._on_first_token(s, req, int(toks[s]))
+                    emitted += 1
+            elif caps[s] == 1:
+                tok = int(toks[s])
+                self._lens[s] += 1
+                self._last[s] = tok
+                req.output_ids.append(tok)
+                emitted += 1
+                reason = self._done(req, tok)
+                if reason:
+                    self._finish(s, reason)
+        _stats_add(tokens=emitted)
+        return True
+
+    def _on_first_token(self, slot: int, req: Request, tok: int):
+        """A slot's LAST prompt chunk landed: the mixed step sampled its
+        first token — stamp TTFT now (not at admission, not at the first
+        chunk) and flip the slot into plain decoding."""
+        req.output_ids = [tok]
+        self._last[slot] = tok
+        req.t_first_token_ns = _obs.now_ns()
+        _stats_add(prefills=1)
+        if req.t_enqueue_ns is not None:
+            _obs.REQUEST_TTFT.observe(
+                (req.t_first_token_ns - req.t_enqueue_ns) / 1e9)
+        if req.t_admit_ns is not None:
+            _obs.record_span("requests", "prefill", req.t_admit_ns,
+                             req.t_first_token_ns - req.t_admit_ns,
+                             tid=req.request_id,
+                             args={"request": req.request_id,
+                                   "prompt_len": len(req.prompt_ids),
+                                   "chunks": req.prefill_chunks})
+        reason = self._done(req, tok)
+        if reason:
+            self._finish(slot, reason)
+
     # -- the serve loop ------------------------------------------------------
     def step(self) -> bool:
-        """Admit what fits, run one batched decode step (or one
-        speculative propose->verify->accept round when spec decoding is
-        on).  Returns False when there is nothing left to do."""
+        """Admit what fits, run one batched step — a fused mixed
+        prefill+decode step while any slot is mid-prefill (chunked
+        mode), a classic decode step otherwise, or one speculative
+        propose->verify->accept round when spec decoding is on.
+        Returns False when there is nothing left to do."""
         from ..profiler import RecordEvent
 
         self._admit()
@@ -719,6 +1105,8 @@ class DecodeEngine:
             return bool(self._queue)
         if self._spec is not None:
             return self._spec.step()
+        if self._chunked and self._prefilling_any():
+            return self._mixed_step()
         self._grow_block_tables()
 
         fn = self._decode_fn
@@ -731,7 +1119,8 @@ class DecodeEngine:
                 donate_argnums=(1, 2)), "decode_compiles")
 
         self._step_no += 1
-        key = jax.random.fold_in(self._key, self._step_no)
+        key = jax.random.fold_in(
+            self._key, _fold_counter(self._step_no, RNG_DECODE_DOMAIN))
         t0 = time.perf_counter()
         t0_ns = _obs.now_ns()
         with RecordEvent("serving.decode_step"):

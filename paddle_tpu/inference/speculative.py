@@ -56,7 +56,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .serving import (_JitTracker, _extract_gpt_params, _gpt_decode_step,
+from .serving import (RNG_DECODE_DOMAIN, _JitTracker, _extract_gpt_params,
+                      _fold_counter, _gpt_decode_step, _gpt_mixed_step,
                       _gpt_prefill, _ln, _logits_of, _stats_add,
                       sample_logits)
 from .. import observability as _obs
@@ -91,19 +92,12 @@ def _gpt_spec_verify(params, k_pages, v_pages, block_tables, seq_lens,
     h = num_heads * head_dim
     num_pages_total = k_pages.shape[2]
     page = k_pages.shape[3]
-    pages_max = block_tables.shape[1]
 
-    offs = jnp.arange(qn, dtype=jnp.int32)
-    pos = seq_lens[:, None] + offs[None, :]              # [B, Q]
+    pos = seq_lens[:, None] + jnp.arange(qn, dtype=jnp.int32)[None, :]
     wpe_max = params["wpe"].shape[0] - 1
     x = params["wte"][tokens] + params["wpe"][jnp.minimum(pos, wpe_max)]
-
-    writable = offs[None, :] < write_caps[:, None]       # [B, Q]
-    bt_idx = jnp.minimum(pos // page, pages_max - 1)
-    page_idx = jnp.where(
-        writable, block_tables[jnp.arange(b)[:, None], bt_idx],
-        num_pages_total)                                 # OOB -> dropped
-    slot = pos % page
+    page_idx, slot = pa.paged_write_indices(
+        block_tables, seq_lens, write_caps, qn, num_pages_total, page)
     lens_now = seq_lens + write_caps
 
     for li, blk in enumerate(params["blocks"]):
@@ -173,11 +167,22 @@ class Drafter:
     def on_finish(self, slot: int, req):
         pass
 
+    def ingest_chunks(self, tokens, caps):
+        """Chunked prefill (FLAGS_chunked_prefill): the engine just fed
+        these prompt chunks to the target model — ``tokens`` is the
+        [slots, Q_max] mixed batch, ``caps[s]`` the chunk length slot
+        ``s`` consumed (0 = not prefilling this step).  Model-backed
+        drafters ingest the same chunks into their own K/V here; host
+        drafters need nothing."""
+        pass
+
     def propose(self, write_caps) -> np.ndarray:
         """Return [slots, K] int32 draft tokens (inactive rows ignored).
         ``write_caps[s]`` is the verify window (K/V writes) slot ``s``
         gets this round — at most ``write_caps[s] - 1`` drafts of it can
-        be accepted, so drafters may stop early."""
+        be accepted, so drafters may stop early.  ``write_caps[s] == 0``
+        means the slot sits this round out (still prefilling its prompt
+        chunks): its row is ignored and must not be advanced."""
         raise NotImplementedError
 
     def on_accept(self, slot: int, pos_before: int, n_emitted: int):
@@ -231,10 +236,11 @@ class PromptLookupDrafter(Drafter):
 
     def propose(self, write_caps) -> np.ndarray:
         eng = self.engine
+        write_caps = np.asarray(write_caps)
         out = np.zeros((eng._slots, self.k), np.int32)
         for s in range(eng._slots):
-            if not eng._active[s]:
-                continue
+            if not eng._active[s] or write_caps[s] == 0:
+                continue  # cap 0: still prefilling — skip the slot
             req = eng._by_slot[s]
             hist = np.asarray(req.prompt_ids + req.output_ids, np.int32)
             out[s] = self._lookup(hist)
@@ -289,6 +295,8 @@ class DraftModelDrafter(Drafter):
         self._lens = np.zeros(engine._slots, np.int32)
         greedy = dict(sampler="greedy", temperature=1.0, top_k=0,
                       top_p=1.0)
+        self._greedy = greedy
+        self._chunk_fn = None  # chunked prefill ingest (lazy)
         self._catch_fn = _JitTracker(jax.jit(
             functools.partial(_gpt_spec_verify,
                               num_heads=self._num_heads,
@@ -307,8 +315,13 @@ class DraftModelDrafter(Drafter):
     def on_admit(self, slot: int, req):
         """Draft-side prefill: ingest the prompt into the draft's pages
         through the slot's block-table row (the pages the engine just
-        allocated for the target's prompt K/V)."""
+        allocated for the target's prompt K/V).  Under chunked prefill
+        the prompt arrives chunk by chunk via `ingest_chunks` instead —
+        admission only zeroes the slot's draft cursor."""
         eng = self.engine
+        if eng._chunked:
+            self._lens[slot] = 0
+            return
         p_len = len(req.prompt_ids)
         bucket = eng._prefill_bucket(p_len)
         ids = np.zeros((1, bucket), np.int32)
@@ -335,12 +348,41 @@ class DraftModelDrafter(Drafter):
     def on_finish(self, slot: int, req):
         self._lens[slot] = 0
 
+    def ingest_chunks(self, tokens, caps):
+        """Chunked prefill: run the SAME mixed-step program shape the
+        target just ran, over the draft weights — the chunk K/V lands in
+        the draft's pages through the shared block tables, no sampling
+        (mask all-false), and the draft cursor tracks the engine's
+        prefill cursor chunk for chunk."""
+        eng = self.engine
+        fn = self._chunk_fn
+        if fn is None:
+            fn = self._chunk_fn = _JitTracker(jax.jit(
+                functools.partial(_gpt_mixed_step,
+                                  num_heads=self._num_heads,
+                                  head_dim=self._head_dim, eps=self._eps,
+                                  **self._greedy),
+                donate_argnums=(1, 2)), "draft_compiles")
+        caps = np.asarray(caps, np.int32)
+        t0 = time.perf_counter()
+        self._k_pages, self._v_pages, _ = fn.fn(
+            self._params, self._k_pages, self._v_pages,
+            jnp.asarray(eng._bt), jnp.asarray(self._lens),
+            jnp.asarray(tokens), jnp.asarray(caps),
+            jnp.zeros(eng._slots, jnp.int32),
+            jnp.zeros(eng._slots, bool), eng._key)
+        fn.check_retrace()
+        _stats_add(draft_time_s=time.perf_counter() - t0)
+        self._lens = self._lens + caps
+
     # -- per-round propose ---------------------------------------------------
     def propose(self, write_caps) -> np.ndarray:
         eng = self.engine
         slots = eng._slots
         k = self.k
-        active = eng._active.copy()
+        # cap 0 = the slot is still prefilling (its chunks flow through
+        # ingest_chunks): it must not be caught up or stepped this round
+        active = eng._active & (np.asarray(write_caps) > 0)
         drafts = np.zeros((slots, k), np.int32)
 
         # catch-up: feed the tokens accepted since the draft last saw
@@ -461,16 +503,36 @@ class SpeculativeDecoder:
         eng = self.engine
         slots = eng._slots
 
+        # the round's observation window opens BEFORE any chunk step:
+        # paddle_decode_step_seconds must account every engine step's
+        # full wall time, chunk ingestion included
+        t_round0 = time.perf_counter()
+        t_round0_ns = _obs.now_ns()
+        if eng._chunked and eng._prefilling_any():
+            # feed prompt chunks through the engine's mixed executable
+            # first (decoding slots sit that call out — their tokens
+            # come from the verify round below); the drafter ingests
+            # the same chunks inside _mixed_step.  A slot whose LAST
+            # chunk lands there flips to decoding and joins this very
+            # round.
+            eng._mixed_step(decode_rows=False)
+
         # verify window per slot, clamped to the request's remaining
         # token budget: KV rows past position prompt+max_new-2 are never
-        # needed, and writing them would outrun the pool reservation
+        # needed, and writing them would outrun the pool reservation.
+        # Slots still mid-prefill keep cap 0 and skip the round.
         caps = np.zeros(slots, np.int32)
         for s in range(slots):
-            if not eng._active[s]:
+            if not eng._active[s] or eng._is_prefilling(s):
                 continue
             req = eng._by_slot[s]
             need = req.max_new_tokens - len(req.output_ids)
             caps[s] = min(self.k + 1, need)
+        if not caps.any():
+            # every live slot is still prefilling: the chunk step above
+            # WAS this engine step — it owns the latency observation
+            _obs.STEP_SECONDS.observe(time.perf_counter() - t_round0)
+            return True
         eng._grow_block_tables(writes=caps)
         pos_before = eng._lens.copy()
 
@@ -494,7 +556,8 @@ class SpeculativeDecoder:
         tokens = np.concatenate(
             [eng._last[:, None].astype(np.int32), drafts], axis=1)
         eng._step_no += 1
-        key = jax.random.fold_in(eng._key, eng._step_no)
+        key = jax.random.fold_in(
+            eng._key, _fold_counter(eng._step_no, RNG_DECODE_DOMAIN))
         t0 = time.perf_counter()
         tv_ns = _obs.now_ns()
         with RecordEvent("serving.spec_verify_step"):
@@ -509,11 +572,12 @@ class SpeculativeDecoder:
                          tid=eng._engine_id, args={"k": self.k})
 
         n_active = int(eng._active.sum())
+        n_verify = int((caps > 0).sum())  # slots this round advanced
         emitted_total = 0
         proposed_total = 0
         accepted_total = 0
         for s in range(slots):
-            if not eng._active[s]:
+            if not eng._active[s] or caps[s] == 0:
                 continue
             req = eng._by_slot[s]
             w = int(caps[s])
@@ -544,7 +608,7 @@ class SpeculativeDecoder:
             if reason:
                 eng._finish(s, reason)
 
-        _stats_add(spec_steps=1, spec_slot_steps=n_active, steps=1,
+        _stats_add(spec_steps=1, spec_slot_steps=n_verify, steps=1,
                    spec_proposed=proposed_total,
                    spec_accepted=accepted_total,
                    spec_emitted=emitted_total, tokens=emitted_total,
@@ -553,10 +617,12 @@ class SpeculativeDecoder:
                    occupancy_sum=n_active / slots,
                    kv_util_sum=eng.pool.utilization())
         _obs.SPEC_ACCEPTED_LAST.set(emitted_total, engine=eng._engine_id)
-        # the round span runs to NOW (draft + verify + the accept loop):
-        # measured end-to-end so the draft/verify child spans nest inside
-        # it instead of overlapping its edge on the trace lane
-        eng._observe_step(t0_ns, (_obs.now_ns() - t0_ns) / 1e9, n_active,
+        # the round span opens at t_round0 (before any chunk-ingest
+        # mixed step) and runs to NOW (draft + verify + the accept
+        # loop): measured end-to-end so the chunk/draft/verify child
+        # spans nest inside it and STEP_SECONDS sees the whole step
+        eng._observe_step(t_round0_ns,
+                          (_obs.now_ns() - t_round0_ns) / 1e9, n_active,
                           "spec_step",
                           extra_args={"k": self.k,
                                       "emitted": emitted_total})

@@ -29,6 +29,7 @@ paddle_request_tpot_seconds                    histogram  —
 paddle_request_queue_wait_seconds              histogram  —
 paddle_request_e2e_seconds                     histogram  —
 paddle_decode_step_seconds                     histogram  —
+paddle_prefill_chunk_tokens                    histogram  —
 paddle_kv_free_pages                           gauge      engine
 paddle_kv_pool_utilization                     gauge      engine
 paddle_slot_occupancy                          gauge      engine
@@ -116,7 +117,14 @@ REQUEST_E2E = histogram(
 STEP_SECONDS = histogram(
     "paddle_decode_step_seconds",
     "Wall time of one batched decode step (speculative: one "
-    "propose->verify->accept round)")
+    "propose->verify->accept round; chunked prefill: one mixed "
+    "prefill+decode step)")
+PREFILL_CHUNK_TOKENS = histogram(
+    "paddle_prefill_chunk_tokens",
+    "Prompt tokens a prefilling slot consumed in one mixed step "
+    "(FLAGS_chunked_prefill / FLAGS_prefill_chunk_tokens); one "
+    "observation per slot per chunk",
+    buckets=log_buckets(1, 2.0, 13))  # 1 .. 4096 tokens
 KV_FREE_PAGES = gauge(
     "paddle_kv_free_pages",
     "KV page-pool free pages as of the engine's most recent step",

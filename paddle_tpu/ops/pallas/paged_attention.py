@@ -110,6 +110,39 @@ def default_page_size(max_len, d, dtype=jnp.float32):
 
 
 # ---------------------------------------------------------------------------
+# Write-capped K/V scatter coordinates (shared by the serving engine's
+# mixed prefill+decode step and the speculative verify step)
+# ---------------------------------------------------------------------------
+def paged_write_indices(block_tables, seq_lens, write_caps, qn,
+                        num_pages_total, page):
+    """Batched scatter coordinates for writing up to ``qn`` new K/V rows
+    per sequence into its pages: row ``i`` of sequence ``b`` lands at
+    absolute position ``seq_lens[b] + i``.
+
+    block_tables: [B, pages_max] int32; seq_lens: [B] int32 (KV rows
+    already valid); write_caps: [B] int32 in [0, qn] — rows
+    ``i >= write_caps[b]`` (padding past a prompt chunk / verify window,
+    or an inactive slot with cap 0) get page index ``num_pages_total``,
+    one past the pool, so an ``.at[...].set`` scatter drops them.
+
+    Returns ``(page_idx, slot)``, both [B, qn] int32: the page id and
+    the within-page offset of every row.
+    """
+    b = block_tables.shape[0]
+    pages_max = block_tables.shape[1]
+    offs = jnp.arange(qn, dtype=jnp.int32)
+    pos = seq_lens[:, None] + offs[None, :]              # [B, qn]
+    writable = offs[None, :] < write_caps[:, None]
+    # capped rows may sit past the block table's horizon: clamp the
+    # LOOKUP index (the row is dropped via the OOB page id anyway)
+    bt_idx = jnp.minimum(pos // page, pages_max - 1)
+    page_idx = jnp.where(
+        writable, block_tables[jnp.arange(b)[:, None], bt_idx],
+        num_pages_total)
+    return page_idx, pos % page
+
+
+# ---------------------------------------------------------------------------
 # XLA reference — CPU path and parity ground truth
 # ---------------------------------------------------------------------------
 def _xla_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,

@@ -45,12 +45,20 @@ DECODE_STAT_COUNTERS = (
     "steps", "tokens", "prefills", "decode_time_s", "prefill_time_s",
     "decode_compiles", "prefill_compiles", "retraces_after_warmup",
     "occupancy_sum", "kv_util_sum",
+    # chunked prefill (FLAGS_chunked_prefill): prompt chunks fused into
+    # the decode step through the mixed-batch executable.
+    # ``stalled_decode_steps`` counts legacy one-shot prefills that ran
+    # while other slots were decoding (the stall chunking removes) —
+    # it must stay 0 on the chunked path.
+    "mixed_steps", "mixed_compiles", "prefill_chunks",
+    "stalled_decode_steps",
     # speculative decoding (inference.speculative): propose/verify loop
     "spec_steps", "spec_slot_steps", "spec_proposed", "spec_accepted",
     "spec_emitted",
     "draft_time_s", "verify_time_s", "verify_compiles", "draft_compiles",
-    # request-completion accounting (Request.finish_reason)
-    "finished_eos", "finished_length", "evicted",
+    # request-completion accounting (Request.finish_reason; "cancelled"
+    # counts still-queued requests removed via Request.cancel())
+    "finished_eos", "finished_length", "evicted", "cancelled",
 )
 DECODE_STAT_DERIVED = ("avg_step_ms", "batch_occupancy",
                        "kv_block_utilization",

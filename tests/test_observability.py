@@ -287,7 +287,10 @@ class TestEngineInstrumentation:
         # TTFT includes queue wait; e2e includes everything
         assert obs.REQUEST_E2E.series_state()["sum"] >= \
             obs.REQUEST_TTFT.series_state()["sum"]
-        assert obs.STEP_SECONDS.series_state()["count"] == 5
+        # chunked prefill fuses prompt ingestion into the step stream:
+        # step 1 is the mixed step that consumes both prompts and emits
+        # each request's first token, steps 2..6 are pure decode
+        assert obs.STEP_SECONDS.series_state()["count"] == 6
         assert obs.REQUESTS_ENQUEUED.value() == 2
         assert obs.REQUESTS_FINISHED.value(reason="length") == 2
         # pool/occupancy gauges are engine-labeled so several engines

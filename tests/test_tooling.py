@@ -161,6 +161,56 @@ def test_bench_spec_decode_smoke(tmp_path):
             "count"] > 0, name
 
 
+def test_bench_prefill_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_prefill.py runs end-to-end: the
+    chunked-prefill bench can't rot.  Asserts the emitted JSON shape,
+    greedy parity between the legacy and chunked legs, the one-mixed-
+    executable contract (no prefill bucket zoo, zero warm retraces),
+    and that the chunked leg never stalls decodes while legacy does —
+    all at smoke scale (latency RATIOS are asserted only at full
+    scale; smoke shapes are too noise-dominated to pin them)."""
+    out = str(tmp_path / "bench_prefill.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_prefill.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    assert data["parity"] is True
+    legs = data["legs"]
+    assert set(legs) == {"legacy", "chunked"}
+    for leg in legs.values():
+        inter = leg["interference"]
+        assert inter["baseline_step_ms_p50"] > 0
+        assert inter["max_step_ms_during_admission"] > 0
+        st = leg["staggered"]
+        assert st["ttft_mean_s"] > 0 and st["serve_steps"] > 0
+        assert st["retraces_after_warmup"] == 0
+    # the whole point: chunked admission never stalls running decodes,
+    # and one mixed executable replaces the pow-2 prefill bucket zoo
+    assert legs["legacy"]["interference"]["stalled_decode_steps"] > 0
+    assert legs["chunked"]["interference"]["stalled_decode_steps"] == 0
+    assert legs["chunked"]["staggered"]["mixed_compiles"] == 1
+    assert legs["chunked"]["staggered"]["prefill_compiles"] == 0
+    assert legs["chunked"]["staggered"]["prefill_chunks"] > 0
+    assert legs["legacy"]["staggered"]["prefill_compiles"] > 0
+    assert data["summary"]["zero_warm_retraces"] is True
+    assert data["summary"]["one_mixed_executable"] is True
+    # per-leg observability snapshots embed latency distributions,
+    # including the chunk-size histogram on the chunked leg
+    snaps = data["observability"]
+    assert set(snaps) == {"legacy", "chunked"}
+    for name, snap in snaps.items():
+        assert snap["paddle_request_ttft_seconds"]["series"][0][
+            "count"] > 0, name
+    chunk_hist = snaps["chunked"]["paddle_prefill_chunk_tokens"]
+    assert chunk_hist["series"][0]["count"] > 0
+    # legacy never feeds chunks: its histogram stays empty
+    assert snaps["legacy"]["paddle_prefill_chunk_tokens"]["series"] == []
+
+
 def test_telemetry_dump_smoke(tmp_path):
     """tools/telemetry_dump.py runs a small engine workload end-to-end
     and every export format parses: Prometheus text has the core

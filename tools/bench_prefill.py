@@ -1,0 +1,364 @@
+"""Chunked-prefill benchmark: admission interference + TTFT, legacy vs
+chunked (FLAGS_chunked_prefill).
+
+Two phases per leg, greedy, on the CPU-sized GPT the other decode
+benches use:
+
+* **interference** — a batch of short-prompt requests decodes in steady
+  state; a LONG prompt is then admitted mid-serve.  Per-step wall times
+  are sampled on the host: the legacy leg pays the whole prompt pass in
+  one step (the spike the ISSUE-5 acceptance bar bounds), the chunked
+  leg spreads it over `prefill_chunk_tokens`-sized mixed steps.
+  Reported: steady decode step p50/max, max step during the
+  admission window (min over trials: noise only adds), and ratios.
+* **staggered TTFT** — a long prompt lands at t=0 and short prompts
+  arrive every ``--stagger-ms`` wall-clock milliseconds, i.e. INTO the
+  long prefill.  TTFT is measured from each request's scheduled
+  arrival on one clock: in the legacy leg the host is stuck inside the
+  monolithic pass, so every arrival eats its remainder before it can
+  even be admitted; fair-share chunking admits within a step and
+  finishes short prompts immediately.  The stall victims' mean and the
+  population median must be no worse than legacy; the long request's
+  own TTFT (the knob's price) is reported, not hidden.
+
+Greedy token parity between the two legs is asserted, the chunked leg
+must report ``mixed_compiles == 1`` / ``prefill_compiles == 0`` and zero
+warm retraces, and each leg's observability snapshot (TTFT/TPOT/
+step-latency histograms + the chunk-size histogram) is embedded in the
+emitted JSON.
+
+Emits BENCH_prefill.json.
+
+Usage:
+    python tools/bench_prefill.py [--out BENCH_prefill.json]
+                                  [--long-prompt 320] [--chunk 16]
+                                  [--q-max 4] [--batch 4] [--shorts 3]
+                                  [--stagger-ms 2.0] [--smoke]
+
+``--smoke`` (or env BENCH_SMOKE=1) shrinks shapes so CI can assert the
+script end-to-end (tests/test_tooling.py).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models.gpt import GPT, GPTConfig  # noqa: E402
+
+
+def _build_model(args):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.long_prompt + args.bg_tokens + 64,
+                    use_parallel_layers=False, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    return model
+
+
+def _engine(model, args, chunked):
+    from paddle_tpu.inference.serving import DecodeEngine
+
+    return DecodeEngine(model, max_batch_size=args.batch,
+                        max_seq_len=args.long_prompt + args.bg_tokens,
+                        page_size=args.page_size,
+                        chunked_prefill=chunked,
+                        prefill_chunk_tokens=args.chunk,
+                        prefill_q_max=args.q_max)
+
+
+def _prompts(args, rng):
+    short = [rng.randint(0, args.vocab, (args.short_prompt,))
+             .astype(np.int32) for _ in range(args.batch - 1)]
+    long_p = rng.randint(0, args.vocab,
+                         (args.long_prompt,)).astype(np.int32)
+    return short, long_p
+
+
+def _warm(model, args, eng, long_p):
+    """Compile every executable either leg will touch (incl. the legacy
+    long-prompt bucket) so the measurement window times execution, not
+    tracing."""
+    eng.generate([long_p[:args.short_prompt], long_p],
+                 max_new_tokens=2)
+
+
+def _timed_step(eng):
+    t0 = time.perf_counter()
+    eng.step()
+    return (time.perf_counter() - t0) * 1e3  # ms
+
+
+def _interference(model, args, chunked, long_p, short):
+    import gc
+
+    from paddle_tpu.inference.serving import (decode_stats,
+                                              reset_decode_stats)
+
+    eng = _engine(model, args, chunked)
+    _warm(model, args, eng, long_p)
+    reset_decode_stats()
+    bg = [eng.add_request(p, max_new_tokens=args.bg_tokens)
+          for p in short]
+    for _ in range(3):  # land the background prompts
+        eng.step()
+    # pure-decode window: p50 is the steady cost, max is the host-noise
+    # ceiling of an equally long step sequence (GC off in both windows;
+    # residual outliers are OS jitter, present in BOTH distributions —
+    # so the spike bound compares max to max, like with like)
+    gc.collect()
+    gc.disable()
+    try:
+        baseline = [_timed_step(eng) for _ in range(args.probe_steps)]
+        p50 = sorted(baseline)[len(baseline) // 2]
+        # admit a long prompt mid-serve and watch the step stream until
+        # its first token lands; repeat, and take the MINIMUM of the
+        # per-trial maxima: noise (OS jitter) only ever ADDS wall time,
+        # so the cleanest trial's max is the best estimate of the true
+        # worst step
+        trial_max = []
+        steps_per_trial = 0
+        for t in range(args.trials):
+            req = eng.add_request(long_p, max_new_tokens=2)
+            window = []
+            while req.t_first_token_ns is None:
+                window.append(_timed_step(eng))
+            steps_per_trial = len(window)
+            while req.state != "done":
+                eng.step()
+            trial_max.append(max(window))
+    finally:
+        gc.enable()
+    spike = min(trial_max)
+    for r in bg:
+        eng.evict(r)
+    eng.run()
+    st = decode_stats()
+    return {
+        "baseline_step_ms_p50": round(p50, 3),
+        "baseline_step_ms_max": round(max(baseline), 3),
+        "max_step_ms_during_admission": round(spike, 3),
+        "max_step_ms_per_trial": [round(t, 3) for t in trial_max],
+        "spike_ratio": round(spike / p50, 2),
+        "spike_vs_decode_max": round(spike / max(baseline), 2),
+        "admission_window_steps": steps_per_trial,
+        "stalled_decode_steps": st["stalled_decode_steps"],
+    }
+
+
+def _staggered_ttft(model, args, chunked, long_p, rng):
+    """Wall-clock staggered arrivals INTO a long prefill: a long prompt
+    lands at t=0, then short prompts arrive every ``--stagger-ms``
+    milliseconds — exactly the window where the legacy engine is stuck
+    inside the long prompt's monolithic pass, so every short request's
+    TTFT eats the remainder of that pass.  Chunked steps stay bounded:
+    arrivals are admitted within a step or two and fair-share chunking
+    finishes their short prompts immediately.
+
+    The long request's own TTFT is also reported: chunking trades some
+    prefiller TTFT (more, cheaper steps) for everyone else's — the
+    `prefill_chunk_tokens` knob sets the exchange rate."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.serving import (decode_stats,
+                                              reset_decode_stats)
+
+    eng = _engine(model, args, chunked)
+    reset_decode_stats()
+    _warm(model, args, eng, long_p)
+    warm_st = decode_stats(reset=True)  # executable census
+    obs.reset()  # snapshot below covers the timed serve only
+    shorts = [rng.randint(0, args.vocab, (args.short_prompt,))
+              .astype(np.int32) for _ in range(args.shorts)]
+    sched = [(0.0, "long",
+              rng.randint(0, args.vocab, (args.long_prompt,))
+              .astype(np.int32))]
+    sched += [((i + 1) * args.stagger_ms, "short", p)
+              for i, p in enumerate(shorts)]
+    reqs, kinds = [], []
+    nxt = 0
+    steps = 0
+    t0_ns = obs.now_ns()
+    while nxt < len(sched) or eng._queue or eng._active.any():
+        now_ms = (obs.now_ns() - t0_ns) / 1e6
+        while nxt < len(sched) and sched[nxt][0] <= now_ms:
+            reqs.append(eng.add_request(sched[nxt][2],
+                                        max_new_tokens=args.new_tokens))
+            kinds.append(sched[nxt][1])
+            nxt += 1
+        if not eng.step() and nxt < len(sched):
+            # idle but arrivals pending: wait out the schedule
+            time.sleep(min(args.stagger_ms, 1.0) / 1e3)
+        steps += 1
+    # TTFT measured from the SCHEDULED arrival, one clock for both legs:
+    # a request that "arrives" while the host is stuck inside a
+    # monolithic prefill pass waits before it can even be enqueued —
+    # that wait IS the stall being measured and must not be dropped
+    ttfts = np.asarray(
+        [(r.t_first_token_ns - t0_ns) / 1e9 - sched[i][0] / 1e3
+         for i, r in enumerate(reqs)])
+    is_short = np.asarray([k == "short" for k in kinds])
+    st = decode_stats()
+    return {
+        "ttft_mean_s": round(float(ttfts.mean()), 4),
+        "ttft_median_s": round(float(np.median(ttfts)), 4),
+        "ttft_max_s": round(float(ttfts.max()), 4),
+        # the stall victims: requests that arrived while the long
+        # prompt was being ingested
+        "ttft_short_mean_s": round(float(ttfts[is_short].mean()), 4),
+        "ttft_long_s": round(float(ttfts[~is_short].mean()), 4),
+        "ttft_per_request_s": [round(float(t), 4) for t in ttfts],
+        "serve_steps": steps,
+        "retraces_after_warmup": st["retraces_after_warmup"],
+        # executables compile during warmup; the serve itself must add
+        # none (warm + serve == the engine's whole executable census)
+        "mixed_compiles": warm_st["mixed_compiles"]
+        + st["mixed_compiles"],
+        "prefill_compiles": warm_st["prefill_compiles"]
+        + st["prefill_compiles"],
+        "prefill_chunks": st["prefill_chunks"],
+    }, [list(r.output_ids) for r in reqs], obs.snapshot()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_prefill.json"))
+    ap.add_argument("--long-prompt", type=int, default=320)
+    ap.add_argument("--short-prompt", type=int, default=8)
+    ap.add_argument("--bg-tokens", type=int, default=280,
+                    help="background requests' generation budget")
+    ap.add_argument("--new-tokens", type=int, default=8,
+                    help="long requests' generation budget")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--chunk", type=int, default=16,
+                    help="prefill_chunk_tokens (per-step prompt-token "
+                         "budget) for the chunked leg")
+    ap.add_argument("--q-max", type=int, default=4,
+                    help="prefill_q_max: mixed-step per-slot row width "
+                         "(caps step compute; budget spreads across "
+                         "slots)")
+    ap.add_argument("--shorts", type=int, default=3,
+                    help="short requests arriving into the long prefill")
+    ap.add_argument("--stagger-ms", type=float, default=2.0,
+                    help="wall-clock gap between staggered arrivals")
+    ap.add_argument("--probe-steps", type=int, default=60)
+    ap.add_argument("--trials", type=int, default=3,
+                    help="admission-window repetitions (min of per-trial "
+                         "maxima: host noise only adds wall time)")
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes: CI end-to-end check")
+    args = ap.parse_args()
+    if os.environ.get("BENCH_SMOKE") == "1":
+        args.smoke = True
+    if args.smoke:
+        args.long_prompt, args.short_prompt = 24, 4
+        args.bg_tokens, args.new_tokens = 16, 4
+        args.hidden, args.vocab = 64, 128
+        args.chunk, args.q_max, args.probe_steps = 8, 8, 3
+        args.shorts, args.trials, args.stagger_ms = 2, 2, 1.0
+
+    import jax
+
+    model = _build_model(args)
+    rng = np.random.RandomState(0)
+    short, long_p = _prompts(args, rng)
+
+    legs = {}
+    outs = {}
+    obs_snaps = {}
+    for name, chunked in (("legacy", False), ("chunked", True)):
+        inter = _interference(model, args, chunked, long_p, short)
+        ttft, toks, snap = _staggered_ttft(
+            model, args, chunked, long_p, np.random.RandomState(1))
+        legs[name] = {"interference": inter, "staggered": ttft}
+        outs[name] = toks
+        obs_snaps[name] = snap
+        print(f"{name:8s}: decode p50 {inter['baseline_step_ms_p50']:7.2f} ms | "
+              f"max step @admission {inter['max_step_ms_during_admission']:7.2f} ms "
+              f"({inter['spike_ratio']:.2f}x) | ttft "
+              f"victims {ttft['ttft_short_mean_s'] * 1e3:6.1f} ms "
+              f"median {ttft['ttft_median_s'] * 1e3:6.1f} ms "
+              f"prefiller {ttft['ttft_long_s'] * 1e3:6.1f} ms")
+
+    parity = outs["legacy"] == outs["chunked"]
+    ch, lg = legs["chunked"], legs["legacy"]
+
+    def ratio(a, b):
+        return round(a / max(b, 1e-9), 3)
+
+    summary = {
+        # (a) per-step latency under concurrent admission: the legacy
+        # leg spikes by the whole prompt pass, the chunked leg stays
+        # within ~2x of a pure decode step
+        "spike_ratio_legacy": lg["interference"]["spike_ratio"],
+        "spike_ratio_chunked": ch["interference"]["spike_ratio"],
+        "chunked_spike_bounded": bool(
+            ch["interference"]["spike_vs_decode_max"] <= 2.0),
+        # (b) TTFT under staggered arrivals: the requests that arrive
+        # while a long prompt streams in (and the population median)
+        # must be no worse than legacy; the long request's own TTFT is
+        # the knob's price and is reported, not hidden
+        "ttft_stall_victims_ratio_chunked_vs_legacy": ratio(
+            ch["staggered"]["ttft_short_mean_s"],
+            lg["staggered"]["ttft_short_mean_s"]),
+        "ttft_median_ratio_chunked_vs_legacy": ratio(
+            ch["staggered"]["ttft_median_s"],
+            lg["staggered"]["ttft_median_s"]),
+        "ttft_prefiller_ratio_chunked_vs_legacy": ratio(
+            ch["staggered"]["ttft_long_s"],
+            lg["staggered"]["ttft_long_s"]),
+        "ttft_no_worse_than_legacy": bool(
+            ch["staggered"]["ttft_median_s"]
+            <= lg["staggered"]["ttft_median_s"] * 1.05),
+        # (c) executable hygiene
+        "zero_warm_retraces": ch["staggered"]
+        ["retraces_after_warmup"] == 0,
+        "one_mixed_executable": ch["staggered"]["mixed_compiles"] == 1
+        and ch["staggered"]["prefill_compiles"] == 0,
+    }
+    out = {
+        "bench": "chunked prefill: admission interference + staggered "
+                 "TTFT, legacy one-shot vs mixed-batch chunked",
+        "device": str(jax.devices()[0].device_kind)
+        if jax.devices() else "unknown",
+        "smoke": bool(args.smoke),
+        "config": {"batch": args.batch, "long_prompt": args.long_prompt,
+                   "short_prompt": args.short_prompt,
+                   "bg_tokens": args.bg_tokens,
+                   "new_tokens": args.new_tokens, "chunk": args.chunk,
+                   "q_max": args.q_max,
+                   "shorts": args.shorts, "stagger_ms": args.stagger_ms,
+                   "trials": args.trials, "layers": args.layers,
+                   "hidden": args.hidden, "heads": args.heads,
+                   "vocab": args.vocab, "page_size": args.page_size},
+        "legs": legs,
+        "summary": summary,
+        "parity": bool(parity),
+        "observability": obs_snaps,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {args.out} (parity={parity}, "
+          f"chunked spike {summary['spike_ratio_chunked']}x vs legacy "
+          f"{summary['spike_ratio_legacy']}x)")
+    if not parity:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
