@@ -121,6 +121,25 @@ define_flag("prefill_chunk_tokens", 64,
             "of the fixed-shape mixed-step executable).  Smaller values "
             "bound per-step latency (TPOT of running requests) tighter at "
             "the cost of more steps to finish a prompt")
+define_flag("prefix_cache", True,
+            "serving-engine prefix caching (chunked prefill only): full "
+            "prompt KV pages are content-addressed by a chain hash "
+            "(rolling per-page digest keyed by a sampling-invariant "
+            "model fingerprint) and reused across requests at "
+            "refcount+1 — admission maps the longest page-aligned "
+            "cached prefix into the request's block table and chunked "
+            "prefill starts at the first novel token; a mid-page "
+            "divergence recomputes into a fresh copy-on-write page "
+            "(cached pages are never written in place), and refcount-"
+            "zero cached pages are retained on an LRU and evicted "
+            "least-recently-released-first under pool pressure.  0 "
+            "restores prefill-from-scratch bit-exactly (the parity "
+            "oracle; see docs/DECODE_PERF.md)")
+define_flag("kv_pool_debug", False,
+            "audit KVBlockPool consistency (free/private/cached page "
+            "partition, refcounts vs live request holds, eviction-LRU "
+            "membership) at every DecodeEngine step boundary — debug "
+            "only, adds host-side cost per step")
 define_flag("spec_decode_k", 0,
             "speculative decoding draft length for the serving engine "
             "(inference.serving.DecodeEngine): propose K tokens per step "

@@ -13,7 +13,16 @@ the executable cache warm.
 Layers:
 
 * `KVBlockPool` — host-side page allocator over the device-resident
-  K/V page pools (`[layers, kv_heads, num_pages, page_size, head_dim]`);
+  K/V page pools (`[layers, kv_heads, num_pages, page_size, head_dim]`),
+  doubling as a content-addressed prefix cache (FLAGS_prefix_cache):
+  full prompt pages are registered under a chain hash, shared across
+  requests at refcount+1, retained on an LRU after their last ref
+  drops, and recycled least-recently-released-first under pressure.
+  Admission maps the longest page-aligned cached prefix into a new
+  request's block table and chunked prefill starts at the first novel
+  token; a mid-page divergence is copy-on-write — the partial page is
+  recomputed into a fresh private page, cached pages are never
+  written;
 * `Request` / `DecodeEngine` — continuous batching over a fixed slot
   grid.  With chunked prefill (FLAGS_chunked_prefill, the default)
   admission binds a request to a slot immediately and its prompt is
@@ -40,10 +49,12 @@ parity contract tests/test_paged_decode.py pins.
 from __future__ import annotations
 
 import functools
+import hashlib
 import heapq
+import itertools
 import time
-from collections import deque
-from typing import List, Optional
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -179,14 +190,40 @@ class _JitTracker:
 # KV page pool (host-side allocator; device arrays live on the engine)
 # ---------------------------------------------------------------------------
 class KVBlockPool:
-    """Free-list allocator over ``num_pages`` KV pages.  Allocation and
-    reservation accounting are host-side bookkeeping; the page payloads
-    are the engine's donated device arrays."""
+    """Free-list allocator over ``num_pages`` KV pages, extended with a
+    content-addressed prefix cache.  Allocation and reservation
+    accounting are host-side bookkeeping; the page payloads are the
+    engine's donated device arrays.
+
+    A page is in exactly one of four states:
+
+    * **free** — on the free list, payload meaningless;
+    * **private** — allocated to exactly one request, writable;
+    * **cached, referenced** — registered under a chain-hash key
+      (`register_page`), refcount >= 1 requests map it READ-ONLY;
+    * **cached, unreferenced** — refcount 0: the payload is retained
+      for future prefix hits and the page sits on the eviction LRU.
+
+    `alloc_page` serves from the free list first and falls back to
+    evicting the least-recently-released unreferenced cached page; a
+    page with a live reference is never evicted and never returns to
+    the free list.  Cached pages are immutable by contract: a request
+    done with its pages goes through `release_pages` (cached -> unref,
+    private -> free), and `free_pages` raises on a cached or already-
+    free page — the double-free guard."""
 
     def __init__(self, num_pages: int):
         self.num_pages = int(num_pages)
         self._free = list(range(self.num_pages - 1, -1, -1))
+        self._free_set = set(self._free)
         self.reserved = 0  # pages promised to running requests
+        # prefix cache: chain hash <-> page, per-page refcounts, and the
+        # LRU of refcount-zero cached pages (OrderedDict, oldest first)
+        self._hash_to_page: Dict[bytes, int] = {}
+        self._page_hash: Dict[int, bytes] = {}
+        self._refs: Dict[int, int] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self.evictions = 0  # cached pages recycled under pressure
 
     @property
     def free_count(self) -> int:
@@ -196,17 +233,189 @@ class KVBlockPool:
     def used_count(self) -> int:
         return self.num_pages - len(self._free)
 
+    @property
+    def cached_count(self) -> int:
+        """Pages currently content-addressed (referenced or not)."""
+        return len(self._page_hash)
+
+    @property
+    def cached_unreferenced_count(self) -> int:
+        """Cached pages with no live reference — reclaimable via the
+        eviction LRU."""
+        return len(self._lru)
+
+    @property
+    def available_count(self) -> int:
+        """Pages `alloc_page` can hand out right now: the free list
+        plus the evictable (unreferenced cached) LRU."""
+        return len(self._free) + len(self._lru)
+
     def utilization(self) -> float:
-        return self.used_count / max(self.num_pages, 1)
+        """Fraction of the pool a new request CANNOT claim: private +
+        cached-referenced pages.  Unreferenced cached pages are
+        reclaimable on demand (LRU eviction), so a warm-but-idle cache
+        reads 0.0 — an operator alerting on pool pressure sees real
+        pressure, not retained prefixes.  With the prefix cache off
+        this is exactly used/num_pages, as before."""
+        return (self.num_pages - self.available_count) \
+            / max(self.num_pages, 1)
 
     def alloc_page(self) -> int:
-        if not self._free:
-            raise RuntimeError("KV page pool exhausted")
-        return self._free.pop()
+        if self._free:
+            p = self._free.pop()
+            self._free_set.discard(p)
+            return p
+        if self._lru:
+            # eviction under pressure: recycle the least-recently
+            # released unreferenced cached page.  Pages with live refs
+            # are not in the LRU by invariant, so they can never be
+            # handed out from under a running request.
+            p, _ = self._lru.popitem(last=False)
+            del self._hash_to_page[self._page_hash.pop(p)]
+            del self._refs[p]
+            self.evictions += 1
+            return p
+        raise RuntimeError("KV page pool exhausted")
 
     def free_pages(self, pages):
+        """Return PRIVATE pages to the free list.  Raises on a page
+        that is not currently allocated-private: a double free would
+        put the same page on the free list twice (handed to two
+        requests -> cache corruption), and a cached page must be
+        released via `release_pages` (unref) instead."""
         for p in pages:
-            self._free.append(int(p))
+            p = int(p)
+            if not 0 <= p < self.num_pages:
+                raise ValueError(
+                    f"page {p} outside pool [0, {self.num_pages})")
+            if p in self._free_set:
+                raise ValueError(f"double free of KV page {p}")
+            if p in self._page_hash:
+                raise ValueError(
+                    f"page {p} is cached (refcount {self._refs[p]}); "
+                    f"release_pages unrefs cached pages")
+            self._free.append(p)
+            self._free_set.add(p)
+
+    def release_pages(self, pages):
+        """A request is done with ``pages``: cached pages are unreffed
+        (payload retained; refcount 0 parks them on the eviction LRU),
+        private pages go back to the free list."""
+        for p in pages:
+            p = int(p)
+            if p in self._page_hash:
+                self.unref_page(p)
+            else:
+                self.free_pages([p])
+
+    # -- content addressing --------------------------------------------------
+    def lookup(self, key: bytes) -> Optional[int]:
+        """Page registered under chain-hash ``key``, or None."""
+        return self._hash_to_page.get(key)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(int(page), 0)
+
+    def register_page(self, page: int, key: bytes) -> bool:
+        """Content-address a full, finally-written PRIVATE page under
+        ``key``; the owner's hold becomes refcount 1 (released through
+        `release_pages` -> unref, like any other cached ref).  Returns
+        False without registering when the key is already taken (a
+        concurrent identical prefill computed the same content — the
+        duplicate page stays private) or the page is already cached."""
+        p = int(page)
+        if p in self._free_set:
+            raise ValueError(f"cannot register free page {p}")
+        if key in self._hash_to_page or p in self._page_hash:
+            return False
+        self._hash_to_page[key] = p
+        self._page_hash[p] = key
+        self._refs[p] = 1
+        return True
+
+    def ref_page(self, page: int):
+        """Map a cached page into one more request (refcount + 1); a
+        referenced page leaves the eviction LRU."""
+        p = int(page)
+        if p not in self._refs:
+            raise ValueError(f"page {p} is not cached")
+        self._refs[p] += 1
+        self._lru.pop(p, None)
+
+    def unref_page(self, page: int):
+        """Drop one reference; at zero the page parks on the eviction
+        LRU (most-recently released = evicted last), payload intact."""
+        p = int(page)
+        r = self._refs.get(p)
+        if r is None or r <= 0:
+            raise ValueError(f"unref of page {p} without a live ref")
+        self._refs[p] = r - 1
+        if r == 1:
+            self._lru[p] = None
+
+    def assert_consistent(self, live_pages=None):
+        """Audit the allocator invariants (tests / FLAGS_kv_pool_debug):
+        the page universe partitions exactly into free + private +
+        cached-referenced + cached-unreferenced, the hash maps are
+        mutual inverses, and the LRU is exactly the refcount-zero
+        cached set.  With ``live_pages`` — every live request's page
+        list, concatenated, WITH multiplicity — additionally checks
+        that refcounts equal the number of requests actually holding
+        each cached page and every private used page has exactly one
+        owner (the ``free + used + cached-unreferenced == num_pages``
+        identity made real)."""
+        assert len(self._free) == len(self._free_set) == \
+            len(set(self._free)), "free list / free set diverged"
+        assert len(self._hash_to_page) == len(self._page_hash), \
+            "hash->page / page->hash maps diverged"
+        for h, p in self._hash_to_page.items():
+            assert self._page_hash.get(p) == h, \
+                (p, "hash maps are not mutual inverses")
+        assert set(self._refs) == set(self._page_hash), \
+            "refcounts must exist exactly for cached pages"
+        assert not (self._free_set & set(self._page_hash)), \
+            "cached page on the free list"
+        for p, r in self._refs.items():
+            assert r >= 0, (p, r, "negative refcount")
+        unref = {p for p, r in self._refs.items() if r == 0}
+        assert set(self._lru) == unref, \
+            "LRU is not exactly the refcount-zero cached set"
+        referenced = len(self._refs) - len(unref)
+        private = self.num_pages - self.free_count - self.cached_count
+        assert private >= 0, "more free+cached pages than the pool holds"
+        assert self.free_count + private + referenced + \
+            self.cached_unreferenced_count == self.num_pages
+        if live_pages is None:
+            return
+        from collections import Counter as _Counter
+
+        counts = _Counter(int(p) for p in live_pages)
+        for p, c in counts.items():
+            assert 0 <= p < self.num_pages, p
+            assert p not in self._free_set, (p, "live page is free")
+            if p in self._refs:
+                assert self._refs[p] == c, \
+                    (p, self._refs[p], c, "refcount != live holders")
+            else:
+                assert c == 1, (p, c, "private page held twice")
+        for p, r in self._refs.items():
+            if r > 0:
+                assert counts.get(p, 0) == r, \
+                    (p, r, "referenced page with no live holder")
+        live_private = {p for p in counts if p not in self._refs}
+        assert len(live_private) == private, \
+            (live_private, private, "private page with no owner")
+
+
+def _chain_hash(prev: bytes, tokens) -> bytes:
+    """One link of a prompt's page chain hash: fold the previous page's
+    digest with this page's token run.  Page i's key therefore commits
+    to tokens 0 .. (i+1)*page-1, so a lookup hit at page i implies the
+    whole prefix matched — KV content is a pure function of (model,
+    token prefix), which is what makes the cached page bit-reusable."""
+    h = hashlib.blake2b(prev, digest_size=16)
+    h.update(np.asarray(tokens, np.int32).tobytes())
+    return h.digest()
 
 
 class Request:
@@ -224,7 +433,10 @@ class Request:
     queue-wait / e2e histograms and the per-request chrome-trace
     spans."""
 
-    _next_id = 0
+    # itertools.count: id draws are atomic under the GIL, so concurrent
+    # enqueues from several threads can never collide (the old
+    # read-increment-write raced)
+    _next_id = itertools.count()
 
     def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None):
         self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
@@ -235,8 +447,18 @@ class Request:
         self.finish_reason: Optional[str] = None
         self.slot: Optional[int] = None
         self.pages: List[int] = []
-        self.request_id = Request._next_id
-        Request._next_id += 1
+        # prefix cache (FLAGS_prefix_cache): the leading
+        # ``cached_page_count`` entries of ``pages`` are shared cached
+        # pages (held at refcount+1, never written); chunked prefill
+        # starts at token ``cached_prefix_len`` instead of 0
+        self.cached_prefix_len = 0
+        self.cached_page_count = 0
+        # chain hashes of the prompt's full pages, computed lazily at
+        # the FIRST admission probe and memoized: a request waiting at
+        # the queue head is re-probed every step, and re-hashing a long
+        # prompt each time would put O(prompt) host work in the loop
+        self._page_hashes: Optional[List[bytes]] = None
+        self.request_id = next(Request._next_id)
         self.t_enqueue_ns: Optional[int] = None
         self.t_admit_ns: Optional[int] = None
         self.t_first_token_ns: Optional[int] = None
@@ -496,14 +718,18 @@ class DecodeEngine:
     serve (signature-keyed: shapes never change, so it compiles once).
     """
 
-    _next_engine_id = 0
+    # itertools.count for the same reason as Request._next_id: ids
+    # label per-engine gauges and trace lanes, and a concurrent
+    # construction race would merge two engines onto one lane
+    _next_engine_id = itertools.count()
 
     def __init__(self, model, max_batch_size=4, max_seq_len=None,
                  page_size=None, num_pages=None, sampler="greedy",
                  temperature=1.0, top_k=0, top_p=1.0, seed=0,
                  eos_token_id=None, dtype=None, spec_decode_k=None,
                  drafter=None, chunked_prefill=None,
-                 prefill_chunk_tokens=None, prefill_q_max=None):
+                 prefill_chunk_tokens=None, prefill_q_max=None,
+                 prefix_cache=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -566,8 +792,7 @@ class DecodeEngine:
         self._prefill_fns = {}
         # engine id = the chrome-trace tid of this engine's step spans
         # (several engines in one process stay on separate lanes)
-        self._engine_id = DecodeEngine._next_engine_id
-        DecodeEngine._next_engine_id += 1
+        self._engine_id = next(DecodeEngine._next_engine_id)
         # FLAGS_metrics_report_interval_s > 0 -> periodic snapshot
         # reporter, started once per process
         _obs.maybe_start_reporter()
@@ -604,6 +829,29 @@ class DecodeEngine:
                 f"prefill_q_max must be >= 1, got {prefill_q_max}")
         self._q_max = min(int(prefill_q_max), self._chunk_budget)
 
+        # prefix caching (explicit arg wins, else FLAGS_prefix_cache):
+        # full prompt KV pages are content-addressed by a chain hash and
+        # shared across requests at refcount+1; admission maps the
+        # longest page-aligned cached prefix and chunked prefill starts
+        # at the first novel token.  Requires chunked prefill — the
+        # legacy one-shot executable cannot start at a nonzero offset
+        # (it is the prefix_cache=0 parity oracle's other half).
+        if prefix_cache is None:
+            prefix_cache = bool(_flags.flag("prefix_cache")) and \
+                self._chunked
+        elif prefix_cache and not self._chunked:
+            raise ValueError(
+                "prefix_cache needs chunked prefill: the legacy one-"
+                "shot prefill executable cannot start mid-prompt (set "
+                "chunked_prefill=1, or drop prefix_cache)")
+        self._prefix_cache = bool(prefix_cache)
+        self._model_salt = self._model_fingerprint() \
+            if self._prefix_cache else b""
+        self._evictions_seen = 0
+        # FLAGS_kv_pool_debug: audit the pool partition + refcounts at
+        # every step boundary (engine idle point — host-only cost)
+        self._pool_debug = bool(_flags.flag("kv_pool_debug"))
+
         # speculative decoding (propose K / verify in one multi-query
         # pass): explicit arg wins, else FLAGS_spec_decode_k.  The
         # subsystem lives in inference.speculative; constructed lazily
@@ -622,6 +870,32 @@ class DecodeEngine:
 
             self._spec = SpeculativeDecoder(self, k=int(spec_decode_k),
                                             drafter=drafter)
+
+    def _model_fingerprint(self) -> bytes:
+        """Sampling-invariant model identity — the chain-hash root.
+        Cached KV is a function of the weights and the token prefix
+        ONLY, so sampler/temperature/top-k/top-p are deliberately NOT
+        keyed: engines serving different sampling configs over the same
+        weights would share prefixes soundly (the pool is per-engine
+        today; the key keeps the scheme honest if pools are ever
+        shared).  Weight content is represented by the embedding
+        table's first row plus one row of EVERY block's qkv projection
+        and the architecture dims — a few small host transfers at
+        construction.  Two fine-tunes sharing frozen embeddings still
+        key differently (their attention weights diverge); this is a
+        fingerprint, not a proof — a full-weights digest belongs in
+        any future cross-process cache tier."""
+        h = hashlib.blake2b(digest_size=16)
+        p = self._params
+        h.update(np.asarray(jax.device_get(p["wte"][0]),
+                            np.float32).tobytes())
+        for blk in p["blocks"]:
+            h.update(np.asarray(jax.device_get(blk["qkv_w"][0]),
+                                np.float32).tobytes())
+        h.update(str((tuple(p["wte"].shape), len(p["blocks"]),
+                      self._num_heads, self._head_dim,
+                      self._page)).encode())
+        return h.digest()
 
     # -- request lifecycle ---------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=32,
@@ -661,19 +935,63 @@ class DecodeEngine:
             bucket *= 2
         return min(bucket, self._max_seq_len)
 
+    def _prefix_hashes(self, prompt_ids) -> List[bytes]:
+        """Chain hashes for every FULL page of the prompt (page i's key
+        folds page i-1's digest, so a hit at page i implies the whole
+        page-aligned prefix 0..i matched)."""
+        page = self._page
+        hashes = []
+        h = self._model_salt
+        for i in range(len(prompt_ids) // page):
+            h = _chain_hash(h, prompt_ids[i * page:(i + 1) * page])
+            hashes.append(h)
+        return hashes
+
+    def _probe_prefix(self, req: Request):
+        """Longest page-aligned cached prefix for ``req`` — read-only:
+        nothing is referenced until `_bind_slot` commits, so a failed
+        admission (capacity) leaves the cache untouched.  At least one
+        prompt token is always recomputed (the first sampled token
+        needs the last position's logits), so a whole-prompt match is
+        capped one page short.  The chain hashes are memoized on the
+        request (``req._page_hashes``) for registration and for the
+        re-probes a capacity-blocked admission retries every step."""
+        if not self._prefix_cache:
+            return []
+        hashes = req._page_hashes
+        if hashes is None:
+            hashes = req._page_hashes = \
+                self._prefix_hashes(req.prompt_ids)
+        limit = (len(req.prompt_ids) - 1) // self._page
+        hit_pages = []
+        for h in hashes[:limit]:
+            p = self.pool.lookup(h)
+            if p is None:
+                break
+            hit_pages.append(p)
+        return hit_pages
+
     def _admit(self):
         while self._queue and self._free_slots:
             req = self._queue[0]
             total_pages = self._pages_for(req.total_kv_tokens())
             # conservative admission: never admit a request the pool
             # cannot see through to completion (running requests' not-yet
-            # -allocated pages are reserved)
-            if self.pool.free_count - self.pool.reserved < total_pages:
+            # -allocated pages are reserved).  Cached-prefix hits need no
+            # allocation, and unreferenced cached pages are reclaimable
+            # via the eviction LRU — but the hit pages themselves must
+            # not double-count as evictable capacity.
+            hit_pages = self._probe_prefix(req)
+            need = total_pages - len(hit_pages)
+            avail = self.pool.free_count + \
+                self.pool.cached_unreferenced_count - \
+                sum(1 for p in hit_pages if self.pool.refcount(p) == 0)
+            if avail - self.pool.reserved < need:
                 return
             self._queue.popleft()
             slot = heapq.heappop(self._free_slots)
             if self._chunked:
-                self._bind_slot(req, slot, total_pages)
+                self._bind_slot(req, slot, total_pages, hit_pages)
             else:
                 self._prefill_into(req, slot, total_pages)
 
@@ -688,32 +1006,56 @@ class DecodeEngine:
                              args={"request": req.request_id})
 
     def _alloc_prompt_pages(self, req: Request, slot: int,
-                            total_pages: int):
-        """Allocate the prompt's pages up front (chunks scatter into
+                            total_pages: int, hit_pages=()):
+        """Map the cached prefix (refcount+1, read-only) and allocate
+        fresh pages for the rest of the prompt (chunks scatter into
         already-owned pages), reserve the decode tail, and point the
-        slot's block-table row at them."""
+        slot's block-table row at all of them."""
+        for p in hit_pages:
+            self.pool.ref_page(p)
+            req.pages.append(p)
+        req.cached_page_count = len(req.pages)
+        req.cached_prefix_len = len(req.pages) * self._page
         p_len = len(req.prompt_ids)
-        for _ in range(self._pages_for(p_len)):
+        for _ in range(len(req.pages), self._pages_for(p_len)):
             req.pages.append(self.pool.alloc_page())
         self.pool.reserved += total_pages - len(req.pages)
         row = np.zeros(self._pages_per_seq, np.int32)
         row[:len(req.pages)] = req.pages
         self._bt[slot] = row
 
-    def _bind_slot(self, req: Request, slot: int, total_pages: int):
+    def _bind_slot(self, req: Request, slot: int, total_pages: int,
+                   hit_pages=()):
         """Chunked admission: bind the request to a slot WITHOUT running
         any prompt pass — the next mixed steps feed its prompt chunk by
         chunk under the FLAGS_prefill_chunk_tokens budget (admit-on-
-        first-chunk), so running decodes never stall."""
+        first-chunk), so running decodes never stall.  With a cached
+        prefix mapped, the prefill cursor and KV length start at the
+        first NOVEL token: the cached pages' KV is already bit-identical
+        to what the chunks would have recomputed.  A divergence that
+        lands mid-page is copy-on-write by construction — the partially
+        matching page is never mapped, its tokens are recomputed into a
+        fresh private page, and the cached page is never written."""
         self._stamp_admit(req)
-        self._alloc_prompt_pages(req, slot, total_pages)
+        self._alloc_prompt_pages(req, slot, total_pages, hit_pages)
         req.state = "running"
         req.slot = slot
         self._by_slot[slot] = req
-        self._lens[slot] = 0
+        start = req.cached_prefix_len
+        self._lens[slot] = start
         self._last[slot] = 0
-        self._prefill_pos[slot] = 0
+        self._prefill_pos[slot] = start
         self._active[slot] = True
+        if self._prefix_cache:
+            n_probe = (len(req.prompt_ids) - 1) // self._page
+            _stats_add(prefix_hits=len(hit_pages),
+                       prefix_misses=n_probe - len(hit_pages),
+                       prefix_cached_tokens=start)
+            if hit_pages:
+                _obs.PREFIX_HITS.inc(len(hit_pages))
+            if n_probe > len(hit_pages):
+                _obs.PREFIX_MISSES.inc(n_probe - len(hit_pages))
+            _obs.PREFIX_CACHED_TOKENS.observe(start)
         if self._spec is not None:
             self._spec.on_admit(slot, req)
 
@@ -809,9 +1151,22 @@ class DecodeEngine:
             return "length"
         return None
 
+    def _register_prompt_pages(self, req: Request):
+        """Prefill complete: content-address every freshly computed
+        FULL prompt page (beyond the mapped cached prefix) so later
+        requests can map it.  The payload is final — all subsequent
+        writes for this slot land at positions past the prompt — so
+        registering freezes it safely.  First writer wins a hash: a
+        concurrent identical prefill keeps its duplicate page private
+        (freed normally at finish)."""
+        if not self._prefix_cache:
+            return
+        for i in range(req.cached_page_count, len(req._page_hashes)):
+            self.pool.register_page(req.pages[i], req._page_hashes[i])
+
     def _finish(self, slot: int, reason: str):
         req = self._by_slot[slot]
-        self.pool.free_pages(req.pages)
+        self.pool.release_pages(req.pages)
         self.pool.reserved -= max(
             self._pages_for(req.total_kv_tokens()) - len(req.pages), 0)
         req.state = "done"
@@ -942,6 +1297,14 @@ class DecodeEngine:
         _obs.KV_FREE_PAGES.set(self.pool.free_count, engine=eid)
         _obs.KV_UTIL.set(self.pool.utilization(), engine=eid)
         _obs.SLOT_OCCUPANCY.set(n_active / self._slots, engine=eid)
+        if self._prefix_cache:
+            _obs.PREFIX_CACHED_PAGES.set(self.pool.cached_count,
+                                         engine=eid)
+            d = self.pool.evictions - self._evictions_seen
+            if d:
+                self._evictions_seen = self.pool.evictions
+                _stats_add(prefix_evictions=d)
+                _obs.PREFIX_EVICTIONS.inc(d)
 
     # -- the mixed prefill+decode step ---------------------------------------
     def _mixed_fn_tracker(self) -> _JitTracker:
@@ -1072,7 +1435,10 @@ class DecodeEngine:
     def _on_first_token(self, slot: int, req: Request, tok: int):
         """A slot's LAST prompt chunk landed: the mixed step sampled its
         first token — stamp TTFT now (not at admission, not at the first
-        chunk) and flip the slot into plain decoding."""
+        chunk) and flip the slot into plain decoding.  The prompt's full
+        pages are content-final from here on, so they enter the prefix
+        cache before any finish-path release can park them."""
+        self._register_prompt_pages(req)
         req.output_ids = [tok]
         self._last[slot] = tok
         req.t_first_token_ns = _obs.now_ns()
@@ -1091,6 +1457,15 @@ class DecodeEngine:
         if reason:
             self._finish(slot, reason)
 
+    def _debug_check_pool(self):
+        """FLAGS_kv_pool_debug: full pool-consistency audit at an
+        engine idle point (between steps, no device call in flight) —
+        every live request's page list cross-checked against the pool's
+        free/private/cached partition and refcounts."""
+        self.pool.assert_consistent(
+            live_pages=[p for r in self._by_slot if r is not None
+                        for p in r.pages])
+
     # -- the serve loop ------------------------------------------------------
     def step(self) -> bool:
         """Admit what fits, run one batched step — a fused mixed
@@ -1100,6 +1475,8 @@ class DecodeEngine:
         Returns False when there is nothing left to do."""
         from ..profiler import RecordEvent
 
+        if self._pool_debug:
+            self._debug_check_pool()
         self._admit()
         if not self._active.any():
             return bool(self._queue)

@@ -28,7 +28,11 @@ request already owns (`DecodeEngine._grow_block_tables(writes=...)`
 reserves the verify window up front, clamped to the request's token
 budget), so rejection is a pure host-side ``seq_lens`` rollback — no
 allocation, no free, no retrace.  The page pool cannot distinguish a
-speculative serve from a classic one.
+speculative serve from a classic one.  Prefix caching
+(FLAGS_prefix_cache) carries over for free: a cached prompt page holds
+BOTH models' K/V (same page ids, same block tables), so a prefix hit
+skips the draft-side prompt ingestion too — `DraftModelDrafter`'s
+chunk cursor simply starts at the cached length.
 
 Drafters:
 
@@ -317,10 +321,17 @@ class DraftModelDrafter(Drafter):
         through the slot's block-table row (the pages the engine just
         allocated for the target's prompt K/V).  Under chunked prefill
         the prompt arrives chunk by chunk via `ingest_chunks` instead —
-        admission only zeroes the slot's draft cursor."""
+        admission only resets the slot's draft cursor — to the cached
+        prefix length on a prefix-cache hit: the shared pages' DRAFT
+        K/V was written when the original request streamed those very
+        chunks through `ingest_chunks` (same block-table page ids, and
+        greedy draft ingestion is deterministic in the token prefix),
+        so the draft cache skips the cached prefix exactly like the
+        target does and `ingest_chunks` only ever sees the novel
+        tail."""
         eng = self.engine
         if eng._chunked:
-            self._lens[slot] = 0
+            self._lens[slot] = req.cached_prefix_len
             return
         p_len = len(req.prompt_ids)
         bucket = eng._prefill_bucket(p_len)

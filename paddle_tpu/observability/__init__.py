@@ -30,6 +30,11 @@ paddle_request_queue_wait_seconds              histogram  —
 paddle_request_e2e_seconds                     histogram  —
 paddle_decode_step_seconds                     histogram  —
 paddle_prefill_chunk_tokens                    histogram  —
+paddle_prefix_cached_tokens                    histogram  —
+paddle_prefix_cache_page_hits_total            counter    —
+paddle_prefix_cache_page_misses_total          counter    —
+paddle_prefix_cache_evictions_total            counter    —
+paddle_prefix_cached_pages                     gauge      engine
 paddle_kv_free_pages                           gauge      engine
 paddle_kv_pool_utilization                     gauge      engine
 paddle_slot_occupancy                          gauge      engine
@@ -125,6 +130,30 @@ PREFILL_CHUNK_TOKENS = histogram(
     "(FLAGS_chunked_prefill / FLAGS_prefill_chunk_tokens); one "
     "observation per slot per chunk",
     buckets=log_buckets(1, 2.0, 13))  # 1 .. 4096 tokens
+PREFIX_CACHED_TOKENS = histogram(
+    "paddle_prefix_cached_tokens",
+    "Prompt tokens a request skipped prefilling because its page-"
+    "aligned prefix was served from the content-addressed KV cache "
+    "(FLAGS_prefix_cache); one observation per chunked admission, "
+    "0 on a full miss",
+    buckets=log_buckets(1, 2.0, 13))  # 1 .. 4096 tokens
+PREFIX_HITS = counter(
+    "paddle_prefix_cache_page_hits_total",
+    "KV pages mapped from the prefix cache at admission "
+    "(refcount+1, no prefill compute)")
+PREFIX_MISSES = counter(
+    "paddle_prefix_cache_page_misses_total",
+    "Probe-eligible full prompt pages NOT served from the prefix "
+    "cache (computed fresh, then registered)")
+PREFIX_EVICTIONS = counter(
+    "paddle_prefix_cache_evictions_total",
+    "Unreferenced cached pages recycled (LRU order) because the "
+    "free list ran dry")
+PREFIX_CACHED_PAGES = gauge(
+    "paddle_prefix_cached_pages",
+    "Content-addressed pages resident in the KV pool (referenced + "
+    "retained) as of the engine's most recent step",
+    labels=("engine",))
 KV_FREE_PAGES = gauge(
     "paddle_kv_free_pages",
     "KV page-pool free pages as of the engine's most recent step",

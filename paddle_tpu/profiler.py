@@ -52,6 +52,12 @@ DECODE_STAT_COUNTERS = (
     # it must stay 0 on the chunked path.
     "mixed_steps", "mixed_compiles", "prefill_chunks",
     "stalled_decode_steps",
+    # prefix caching (FLAGS_prefix_cache): pages mapped from /
+    # missing in the content-addressed cache at admission, prompt
+    # tokens skipped, and unreferenced cached pages recycled under
+    # pool pressure
+    "prefix_hits", "prefix_misses", "prefix_cached_tokens",
+    "prefix_evictions",
     # speculative decoding (inference.speculative): propose/verify loop
     "spec_steps", "spec_slot_steps", "spec_proposed", "spec_accepted",
     "spec_emitted",

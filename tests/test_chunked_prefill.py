@@ -178,7 +178,7 @@ class TestChunkedParity:
         # warm after the first use
         assert st["draft_compiles"] == 3
         assert st["retraces_after_warmup"] == 0
-        assert eng.pool.free_count == eng.pool.num_pages
+        assert eng.pool.available_count == eng.pool.num_pages
 
 
 # ---------------------------------------------------------------------------
@@ -338,7 +338,7 @@ class TestEvictMidPrefill:
         assert req.output_ids == [] and eng.pool.reserved == 1
         eng.evict(req)
         assert req.finish_reason == "evicted"
-        assert eng.pool.free_count == eng.pool.num_pages
+        assert eng.pool.available_count == eng.pool.num_pages
         assert eng.pool.reserved == 0
         assert not eng._active.any()
         assert int(eng._prefill_pos[0]) == 0
@@ -424,4 +424,4 @@ class TestFreeSlotHeap:
                        for n in (4, 11, 7)]
             eng.generate(prompts, max_new_tokens=3)
             assert sorted(eng._free_slots) == [0, 1]
-            assert eng.pool.free_count == eng.pool.num_pages
+            assert eng.pool.available_count == eng.pool.num_pages

@@ -279,8 +279,8 @@ class TestGreedyParity:
         st = decode_stats()
         assert st["acceptance_rate"] < 0.2, st["acceptance_rate"]
         assert st["mean_accepted_per_step"] < 1.5
-        # rollback left the pool clean
-        assert eng.pool.free_count == eng.pool.num_pages
+        # rollback left the pool clean (prefix-cached pages stay parked)
+        assert eng.pool.available_count == eng.pool.num_pages
         assert eng.pool.reserved == 0
 
     def test_zero_warm_retraces_for_draft_and_verify(self):
@@ -322,7 +322,7 @@ class TestGreedyParity:
                                      return_meta=True)
         assert toks[0] == list(want), (toks, want)
         assert reasons == ["eos"]
-        assert eng.pool.free_count == eng.pool.num_pages
+        assert eng.pool.available_count == eng.pool.num_pages
 
 
 class TestStochasticAcceptance:
@@ -382,7 +382,7 @@ class TestRollbackInvariants:
                 prompts = [rng.randint(0, 64, (n,)).astype(np.int32)
                            for n in (4, 9, 6)]
                 eng.generate(prompts, max_new_tokens=6)
-                assert eng.pool.free_count == eng.pool.num_pages, \
+                assert eng.pool.available_count == eng.pool.num_pages, \
                     (drafter.name, wave)
                 assert eng.pool.reserved == 0
                 assert not eng._active.any()
@@ -402,7 +402,7 @@ class TestRollbackInvariants:
                       spec_decode_k=6)
         out = eng.generate([p], max_new_tokens=3)[0]
         assert out == ref
-        assert eng.pool.free_count == eng.pool.num_pages
+        assert eng.pool.available_count == eng.pool.num_pages
         assert eng.pool.reserved == 0
 
     def test_lens_rollback_exact(self):

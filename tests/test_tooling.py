@@ -211,6 +211,54 @@ def test_bench_prefill_smoke(tmp_path):
     assert snaps["legacy"]["paddle_prefill_chunk_tokens"]["series"] == []
 
 
+def test_bench_prefix_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_prefix.py runs end-to-end: the
+    prefix-cache bench can't rot.  Asserts the emitted JSON shape,
+    greedy parity between the cache-off and cache-on legs (including
+    the eviction/reuse cycle), at least one prefix hit and one LRU
+    eviction under pressure, zero warm retraces, and that hit requests
+    prefilled strictly fewer tokens than the cache-off baseline —
+    latency RATIOS are asserted only at full scale (smoke shapes are
+    too noise-dominated to pin them)."""
+    out = str(tmp_path / "bench_prefix.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_prefix.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    assert data["parity"] is True
+    legs = data["legs"]
+    assert set(legs) == {"off", "on"}
+    for leg in legs.values():
+        sh = leg["shared"]
+        assert sh["ttft_cold_s"] > 0 and sh["ttft_hit_mean_s"] > 0
+        assert sh["retraces_after_warmup"] == 0
+        assert leg["eviction"]["retraces_after_warmup"] == 0
+    # the whole point: cache-hit requests skip the shared prefix...
+    on, off = legs["on"], legs["off"]
+    assert on["shared"]["prefix_hits"] >= 1
+    assert on["shared"]["tokens_prefilled_hit_mean"] < \
+        off["shared"]["tokens_prefilled_hit_mean"]
+    # ...the off leg never probes, and pressure really evicted (LRU)
+    assert off["shared"]["prefix_hits"] == 0
+    assert off["shared"]["prefix_misses"] == 0
+    assert on["eviction"]["prefix_evictions"] >= 1
+    assert data["summary"]["zero_warm_retraces"] is True
+    # per-leg observability snapshots embed the prefix series on the
+    # cache leg (hit counter + cached-tokens histogram)
+    snaps = data["observability"]
+    assert set(snaps) == {"off", "on"}
+    hits = snaps["on"]["paddle_prefix_cache_page_hits_total"]["series"]
+    assert hits and hits[0]["value"] >= 1
+    hist = snaps["on"]["paddle_prefix_cached_tokens"]["series"][0]
+    assert hist["count"] >= 1
+    assert snaps["off"]["paddle_prefix_cache_page_hits_total"][
+        "series"] == []
+
+
 def test_telemetry_dump_smoke(tmp_path):
     """tools/telemetry_dump.py runs a small engine workload end-to-end
     and every export format parses: Prometheus text has the core

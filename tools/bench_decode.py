@@ -84,7 +84,11 @@ def _bench_engine(model, prompts, n_new, max_len, page_size):
 
     eng = DecodeEngine(model, max_batch_size=len(prompts),
                        max_seq_len=_round_up(max_len, page_size),
-                       page_size=page_size)
+                       page_size=page_size,
+                       # the warm pass reuses the measured prompts:
+                       # prefix-cache hits (tools/bench_prefix.py's
+                       # subject) would skip the measured prefill
+                       prefix_cache=False)
     eng.generate(prompts, max_new_tokens=min(n_new, 4))  # warm executables
     reset_decode_stats()
     observability.reset()  # snapshot below covers the timed serve only

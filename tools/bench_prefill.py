@@ -72,7 +72,13 @@ def _engine(model, args, chunked):
                         page_size=args.page_size,
                         chunked_prefill=chunked,
                         prefill_chunk_tokens=args.chunk,
-                        prefill_q_max=args.q_max)
+                        prefill_q_max=args.q_max,
+                        # this bench measures PREFILL cost: the same
+                        # long prompt is re-admitted across trials, and
+                        # prefix-cache hits (tools/bench_prefix.py's
+                        # subject) would hollow out the admission
+                        # window being measured
+                        prefix_cache=False)
 
 
 def _prompts(args, rng):

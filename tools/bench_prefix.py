@@ -1,0 +1,270 @@
+"""Prefix-cache benchmark: shared-prefix serving, cache off vs on
+(FLAGS_prefix_cache).
+
+Two phases per leg, greedy, on the CPU-sized GPT the other decode
+benches use (both legs run chunked prefill — the cache maps pages INTO
+the chunked scheduler, so the off leg isolates exactly the prefill
+compute the cache removes):
+
+* **shared** — ``--requests`` requests sharing a ``--shared``-token
+  system prompt with unique ``--tail`` suffixes, served sequentially
+  through one engine.  Request 1 is the cold miss that populates the
+  cache; requests 2..N map the shared pages at refcount+1 and prefill
+  only their tails.  Reported per request: TTFT (enqueue -> first
+  token, one engine per leg so the clocks match) and tokens prefilled
+  (prompt length minus the cached prefix) — the work the cache removed.
+* **eviction** — a small-pool engine serves several DISTINCT prefix
+  families back to back, forcing LRU evictions of unreferenced cached
+  pages, then re-serves the first (now evicted) family.  The hit/miss/
+  evict counters are embedded and greedy parity vs the cache-off leg
+  is asserted across the whole eviction/reuse cycle.
+
+Greedy token parity between the legs is asserted, the cache leg must
+report zero warm retraces (prefix admission changes array CONTENTS,
+never executable shapes), and each leg's observability snapshot
+(including the ``paddle_prefix_*`` series) is embedded in the emitted
+JSON.
+
+Emits BENCH_prefix.json.
+
+Usage:
+    python tools/bench_prefix.py [--out BENCH_prefix.json]
+                                 [--shared 64] [--tail 8]
+                                 [--requests 16] [--chunk 16] [--smoke]
+
+``--smoke`` (or env BENCH_SMOKE=1) shrinks shapes so CI can assert the
+script end-to-end (tests/test_tooling.py).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models.gpt import GPT, GPTConfig  # noqa: E402
+
+
+def _build_model(args):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.shared + args.tail + args.new_tokens
+                    + 64,
+                    use_parallel_layers=False, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    return model
+
+
+def _engine(model, args, cache_on, num_pages=None):
+    from paddle_tpu.inference.serving import DecodeEngine
+
+    return DecodeEngine(model, max_batch_size=2,
+                        max_seq_len=args.shared + args.tail
+                        + args.new_tokens,
+                        page_size=args.page_size,
+                        num_pages=num_pages,
+                        prefix_cache=cache_on,
+                        prefill_chunk_tokens=args.chunk)
+
+
+def _prompts(args, rng):
+    shared = rng.randint(0, args.vocab, (args.shared,)).astype(np.int32)
+    return [np.concatenate(
+        [shared, rng.randint(0, args.vocab, (args.tail,))
+         .astype(np.int32)]) for _ in range(args.requests)]
+
+
+def _shared_phase(model, args, cache_on, prompts):
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.serving import (decode_stats,
+                                              reset_decode_stats)
+
+    eng = _engine(model, args, cache_on)
+    # compile every executable (mixed step + decode step) on a DISJOINT
+    # prompt so the measurement window times execution, not tracing —
+    # and so the cache leg's first measured request is a true cold miss
+    warm_rng = np.random.RandomState(999)
+    eng.generate([warm_rng.randint(0, args.vocab,
+                                   (args.tail + 1,)).astype(np.int32)],
+                 max_new_tokens=2)
+    reset_decode_stats()
+    obs.reset()
+
+    ttfts, prefilled, outs = [], [], []
+    for p in prompts:
+        req = eng.add_request(p, max_new_tokens=args.new_tokens)
+        eng.run()
+        ttfts.append((req.t_first_token_ns - req.t_enqueue_ns) / 1e9)
+        prefilled.append(len(req.prompt_ids) - req.cached_prefix_len)
+        outs.append(list(req.output_ids))
+    st = decode_stats()
+    ttfts = np.asarray(ttfts)
+    hit = ttfts[1:]  # requests 2..N: cache-hit candidates
+    return {
+        "ttft_cold_s": round(float(ttfts[0]), 4),
+        "ttft_hit_mean_s": round(float(hit.mean()), 4),
+        "ttft_hit_median_s": round(float(np.median(hit)), 4),
+        "ttft_per_request_s": [round(float(t), 4) for t in ttfts],
+        "tokens_prefilled_mean": round(float(np.mean(prefilled)), 2),
+        "tokens_prefilled_hit_mean": round(
+            float(np.mean(prefilled[1:])), 2),
+        "tokens_prefilled_per_request": prefilled,
+        "prompt_tokens_per_request": len(prompts[0]),
+        "prefix_hits": st["prefix_hits"],
+        "prefix_misses": st["prefix_misses"],
+        "prefix_evictions": st["prefix_evictions"],
+        "prefix_cached_tokens": st["prefix_cached_tokens"],
+        "prefill_chunks": st["prefill_chunks"],
+        "retraces_after_warmup": st["retraces_after_warmup"],
+    }, outs, obs.snapshot()
+
+
+def _eviction_phase(model, args, cache_on):
+    from paddle_tpu.inference.serving import (decode_stats,
+                                              reset_decode_stats)
+
+    def family(seed):
+        rng = np.random.RandomState(seed)
+        sh = rng.randint(0, args.vocab, (args.shared,)).astype(np.int32)
+        return [np.concatenate(
+            [sh, rng.randint(0, args.vocab, (args.tail,))
+             .astype(np.int32)]) for _ in range(2)]
+
+    # pool sized for ~one request beyond a single cached family: each
+    # new family must recycle the previous one's pages (LRU first)
+    pages_per_req = -(-(args.shared + args.tail + args.new_tokens - 1)
+                      // args.page_size)
+    eng = _engine(model, args, cache_on,
+                  num_pages=pages_per_req + 2)
+    reset_decode_stats()
+    outs = []
+    # distinct families 0..2, then family 0 again (its pages were
+    # evicted meanwhile: the reuse cycle must still be bit-exact)
+    for seed in (40, 41, 42, 40):
+        for p in family(seed):
+            req = eng.add_request(p, max_new_tokens=args.new_tokens)
+            eng.run()
+            outs.append(list(req.output_ids))
+    st = decode_stats()
+    return {
+        "pool_pages": eng.pool.num_pages,
+        "prefix_hits": st["prefix_hits"],
+        "prefix_misses": st["prefix_misses"],
+        "prefix_evictions": st["prefix_evictions"],
+        "retraces_after_warmup": st["retraces_after_warmup"],
+    }, outs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_prefix.json"))
+    ap.add_argument("--shared", type=int, default=64,
+                    help="common system-prompt length (tokens)")
+    ap.add_argument("--tail", type=int, default=8,
+                    help="unique per-request suffix length")
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--new-tokens", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=16,
+                    help="prefill_chunk_tokens for both legs")
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes: CI end-to-end check")
+    args = ap.parse_args()
+    if os.environ.get("BENCH_SMOKE") == "1":
+        args.smoke = True
+    if args.smoke:
+        args.shared, args.tail, args.requests = 16, 4, 4
+        args.new_tokens, args.chunk, args.page_size = 4, 8, 8
+        args.hidden, args.vocab = 64, 128
+
+    import jax
+
+    model = _build_model(args)
+    prompts = _prompts(args, np.random.RandomState(0))
+
+    legs, outs, ev_outs, obs_snaps = {}, {}, {}, {}
+    for name, cache_on in (("off", False), ("on", True)):
+        shared, toks, snap = _shared_phase(model, args, cache_on,
+                                           prompts)
+        evict, ev_toks = _eviction_phase(model, args, cache_on)
+        legs[name] = {"shared": shared, "eviction": evict}
+        outs[name], ev_outs[name] = toks, ev_toks
+        obs_snaps[name] = snap
+        print(f"cache {name:3s}: ttft cold {shared['ttft_cold_s'] * 1e3:7.1f} ms | "
+              f"hit mean {shared['ttft_hit_mean_s'] * 1e3:7.1f} ms | "
+              f"prefilled/req {shared['tokens_prefilled_mean']:6.1f} | "
+              f"hits {shared['prefix_hits']} "
+              f"evictions(evict phase) {evict['prefix_evictions']}")
+
+    parity = outs["off"] == outs["on"] and ev_outs["off"] == ev_outs["on"]
+    on, off = legs["on"], legs["off"]
+
+    def ratio(a, b):
+        return round(a / max(b, 1e-9), 3)
+
+    summary = {
+        # (a) the work removed: hit requests prefill only their tails
+        "tokens_prefilled_hit_ratio_on_vs_off": ratio(
+            on["shared"]["tokens_prefilled_hit_mean"],
+            off["shared"]["tokens_prefilled_hit_mean"]),
+        "tokens_prefilled_hit_mean_on": on["shared"]
+        ["tokens_prefilled_hit_mean"],
+        "tokens_prefilled_hit_mean_off": off["shared"]
+        ["tokens_prefilled_hit_mean"],
+        # (b) and the latency it buys: TTFT of cache-hit requests
+        "ttft_hit_ratio_on_vs_off": ratio(
+            on["shared"]["ttft_hit_mean_s"],
+            off["shared"]["ttft_hit_mean_s"]),
+        # (c) cache behavior under pressure
+        "prefix_hits": on["shared"]["prefix_hits"],
+        "prefix_misses": on["shared"]["prefix_misses"],
+        "prefix_evictions_under_pressure": on["eviction"]
+        ["prefix_evictions"],
+        # (d) executable hygiene: prefix admission changes array
+        # contents, never shapes
+        "zero_warm_retraces":
+            on["shared"]["retraces_after_warmup"] == 0
+            and on["eviction"]["retraces_after_warmup"] == 0,
+    }
+    out = {
+        "bench": "prefix caching: shared-prefix TTFT + tokens-prefilled"
+                 ", cache off vs on, plus LRU eviction/reuse cycle",
+        "device": str(jax.devices()[0].device_kind)
+        if jax.devices() else "unknown",
+        "smoke": bool(args.smoke),
+        "config": {"shared": args.shared, "tail": args.tail,
+                   "requests": args.requests,
+                   "new_tokens": args.new_tokens, "chunk": args.chunk,
+                   "layers": args.layers, "hidden": args.hidden,
+                   "heads": args.heads, "vocab": args.vocab,
+                   "page_size": args.page_size},
+        "legs": legs,
+        "summary": summary,
+        "parity": bool(parity),
+        "observability": obs_snaps,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {args.out} (parity={parity}, hit requests prefill "
+          f"{summary['tokens_prefilled_hit_mean_on']} vs "
+          f"{summary['tokens_prefilled_hit_mean_off']} tokens, ttft "
+          f"{summary['ttft_hit_ratio_on_vs_off']}x)")
+    if not parity:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,7 +73,11 @@ def _bench_engine(model, prompts, args, spec_k, drafter):
         kw = dict(spec_decode_k=spec_k, drafter=drafter())
     eng = DecodeEngine(model, max_batch_size=len(prompts),
                        max_seq_len=args.context + args.new_tokens,
-                       page_size=args.page_size, **kw)
+                       page_size=args.page_size,
+                       # the warm pass reuses the measured prompts:
+                       # prefix-cache hits (tools/bench_prefix.py's
+                       # subject) would skip the measured prefill
+                       prefix_cache=False, **kw)
     eng.generate(prompts, max_new_tokens=min(args.new_tokens, 4))  # warm
     reset_decode_stats()
     observability.reset()  # snapshot below covers the timed serve only
