@@ -140,6 +140,18 @@ define_flag("kv_pool_debug", False,
             "partition, refcounts vs live request holds, eviction-LRU "
             "membership) at every DecodeEngine step boundary — debug "
             "only, adds host-side cost per step")
+define_flag("sched_policy", "fifo",
+            "serving-engine admission scheduler "
+            "(inference.frontend.make_scheduler): 'fifo' (default) "
+            "admits in strict arrival order — bit-exact with the "
+            "historical behavior, never preempts; 'slo' orders by "
+            "priority class then earliest-deadline-first, expires "
+            "still-queued requests past their deadline_ms, skips a "
+            "head-of-line blocker when a smaller request behind it "
+            "fits (bounded by an anti-starvation fence), and under "
+            "slot/pool pressure preempts the lowest-priority running "
+            "request for resume via the prefix cache.  Engines "
+            "constructed with an explicit scheduler ignore the flag")
 define_flag("spec_decode_k", 0,
             "speculative decoding draft length for the serving engine "
             "(inference.serving.DecodeEngine): propose K tokens per step "

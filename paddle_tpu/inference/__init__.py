@@ -26,7 +26,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
-           "PrecisionType", "PlaceType", "serving", "speculative"]
+           "PrecisionType", "PlaceType", "serving", "speculative",
+           "frontend"]
 
 
 class PrecisionType:
@@ -401,7 +402,7 @@ def __getattr__(name):
     # Pallas kernel chain) into every `import paddle_tpu`.  Must go
     # through importlib — a `from . import serving` here would re-enter
     # this __getattr__ via _handle_fromlist and recurse.
-    if name in ("serving", "speculative"):
+    if name in ("serving", "speculative", "frontend"):
         import importlib
 
         return importlib.import_module("." + name, __name__)

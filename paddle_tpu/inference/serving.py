@@ -41,6 +41,21 @@ Layers:
   and per-request finish reasons, surfaced through
   `paddle_tpu.profiler.decode_stats`.
 
+Admission ordering is pluggable (`inference.frontend`): the engine
+delegates its between-steps admission decision to a `Scheduler` —
+`FIFOScheduler` (the default, bit-exact with the historical strict-
+arrival-order behavior) or `SLOScheduler` (priority classes + earliest-
+deadline-first + deadline expiry + preempt/resume).  Requests carry
+``priority`` / ``deadline_ms`` / TTFT/TPOT SLO targets and an optional
+per-token ``on_token`` callback (the streaming hook
+`inference.frontend.ServingFrontend` rides).  Preemption
+(`DecodeEngine.preempt`) releases a running request's slot and pages
+between steps and re-enqueues it with ``prompt_ids + output_ids`` as
+the replay prompt — with the prefix cache on, every full page of that
+replay was registered at preemption, so resume costs at most one page
+of recompute.  All of it is host-side bookkeeping: executable shapes
+never change and the zero-warm-retrace contract is untouched.
+
 Numerics deliberately mirror the eager GPT path op for op (same
 layer_norm kernel, same sdpa reference, same sampling), so greedy decode
 through the engine reproduces `GPT.generate`'s tokens exactly — the
@@ -65,7 +80,8 @@ from ..core.tensor import unwrap
 from ..ops.pallas import paged_attention as pa
 
 __all__ = ["KVBlockPool", "Request", "DecodeEngine", "sample_logits",
-           "decode_stats", "reset_decode_stats"]
+           "decode_stats", "reset_decode_stats",
+           "PRIORITY_INTERACTIVE", "PRIORITY_BATCH"]
 
 
 # ---------------------------------------------------------------------------
@@ -418,14 +434,40 @@ def _chain_hash(prev: bytes, tokens) -> bytes:
     return h.digest()
 
 
+# Priority classes (lower value = more urgent; any int works — these
+# two name the ends the SLO scheduler is designed around).  The default
+# is BATCH so that plain `add_request` calls sort behind explicitly
+# interactive traffic under the SLO scheduler while staying pure
+# arrival-order under FIFO.
+PRIORITY_INTERACTIVE = 0
+PRIORITY_BATCH = 10
+
+
 class Request:
     """One generation request moving through the engine:
     queued -> running (bound to a slot + pages) -> done.
 
     ``finish_reason`` records WHY a request left the engine — "eos"
-    (hit its eos token), "length" (max_new_tokens exhausted), or
-    "evicted" (cancelled via `DecodeEngine.evict`) — so callers can
-    tell a completed generation from a truncated one.
+    (hit its eos token), "length" (max_new_tokens exhausted),
+    "evicted" (`DecodeEngine.evict`), "cancelled" (`Request.cancel`,
+    queued or running), or "deadline" (its ``deadline_ms`` expired
+    while still queued; the SLO scheduler retires it without ever
+    taking a slot) — so callers can tell a completed generation from a
+    truncated one.
+
+    Scheduling metadata: ``priority`` (lower = more urgent;
+    `PRIORITY_INTERACTIVE` / `PRIORITY_BATCH` name the classes),
+    ``deadline_ms`` (budget from enqueue for the WHOLE request),
+    ``slo_ttft_ms`` / ``slo_tpot_ms`` (latency targets — missing one
+    increments the SLO-violation counters and flips ``slo_violations``,
+    it never aborts the request).  ``on_token`` is the streaming hook:
+    called with each generated token id the moment the engine lands it
+    (from inside the serve loop — it must be cheap and MUST NOT raise).
+
+    A preempted request (`DecodeEngine.preempt`) goes back to
+    "queued" with its generated tokens folded into ``prompt_ids`` for
+    replay; ``generated_ids`` always reads the full generation
+    regardless of how many times the request was preempted.
 
     Lifecycle timestamps (``now_ns`` clock, shared with the host
     tracer) are stamped as the request moves enqueue -> admit -> first
@@ -438,10 +480,38 @@ class Request:
     # read-increment-write raced)
     _next_id = itertools.count()
 
-    def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None):
+    def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
+                 priority=None, deadline_ms=None, slo_ttft_ms=None,
+                 slo_tpot_ms=None, on_token=None):
         self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
+        self.priority = PRIORITY_BATCH if priority is None else \
+            int(priority)
+        if deadline_ms is not None and float(deadline_ms) <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {deadline_ms}")
+        self.deadline_ms = None if deadline_ms is None else \
+            float(deadline_ms)
+        self.slo_ttft_ms = None if slo_ttft_ms is None else \
+            float(slo_ttft_ms)
+        self.slo_tpot_ms = None if slo_tpot_ms is None else \
+            float(slo_tpot_ms)
+        self.on_token = on_token
+        # stamped at enqueue (t_enqueue_ns + deadline): the instant the
+        # request stops being worth admitting
+        self._deadline_ns: Optional[int] = None
+        # preempt/resume bookkeeping: original prompt length (before
+        # any replay folding), generated tokens absorbed into the
+        # prompt by preemptions, preemption count, and the scheduler's
+        # head-of-line skip counter (anti-starvation fence)
+        self.orig_prompt_len = len(self.prompt_ids)
+        self._absorbed = 0
+        self.preemptions = 0
+        self._hol_skips = 0
+        # SLO accounting: violation kinds recorded for this request
+        # ("ttft" | "tpot" | "deadline")
+        self.slo_violations: List[str] = []
         self.output_ids: List[int] = []
         self.state = "queued"
         self.finish_reason: Optional[str] = None
@@ -470,22 +540,47 @@ class Request:
 
     def total_kv_tokens(self) -> int:
         # KV rows ever written: prompt + all generated-token writes except
-        # the final sampled token (its KV is never needed)
+        # the final sampled token (its KV is never needed).  Invariant
+        # under preemption: the replay fold moves tokens from max_new
+        # into the prompt one for one.
         return len(self.prompt_ids) + max(self.max_new_tokens - 1, 0)
 
+    @property
+    def generated_ids(self) -> List[int]:
+        """Every token this request generated, in order — stable across
+        preemptions (``output_ids`` only holds the tokens generated
+        since the last resume; the earlier ones live in the replay
+        prompt)."""
+        return self.prompt_ids[self.orig_prompt_len:] + self.output_ids
+
+    @property
+    def slo_met(self) -> bool:
+        """Did this request complete its generation within every SLO it
+        declared?  False while unfinished, for any truncating finish
+        (evicted/cancelled/deadline), or when a declared TTFT / TPOT /
+        deadline target was missed — the per-request bit behind the
+        goodput number `tools/bench_slo.py` reports."""
+        return self.state == "done" and \
+            self.finish_reason in ("eos", "length") and \
+            not self.slo_violations
+
     def cancel(self):
-        """Cancel this request while it is still QUEUED: it leaves the
-        engine's admission queue without ever taking a slot, and
-        ``finish_reason`` reads "cancelled" (the
-        ``finished{reason="cancelled"}`` counter distinguishes it from a
-        running request's eviction).  Cancelling an already-finished
-        request is a no-op; a RUNNING request holds device state and
-        must go through `DecodeEngine.evict` instead."""
+        """Cancel this request: a still-QUEUED request leaves the
+        admission queue without ever taking a slot; a RUNNING request
+        gives its slot and pages back between steps (routed through the
+        same teardown as `DecodeEngine.evict`).  Either way
+        ``finish_reason`` reads "cancelled" — the
+        ``finished{reason="cancelled"}`` counter stays distinct from
+        "evicted", which is reserved for engine-initiated eviction.
+        Cancelling an already-finished request is a no-op."""
         if self.state == "done":
             return
         if self._engine is None:
             raise ValueError("request was never enqueued on an engine")
-        self._engine._cancel_queued(self)
+        if self.state == "queued":
+            self._engine._cancel_queued(self)
+        else:
+            self._engine._cancel_running(self)
 
 
 # ---------------------------------------------------------------------------
@@ -729,7 +824,7 @@ class DecodeEngine:
                  eos_token_id=None, dtype=None, spec_decode_k=None,
                  drafter=None, chunked_prefill=None,
                  prefill_chunk_tokens=None, prefill_q_max=None,
-                 prefix_cache=None):
+                 prefix_cache=None, scheduler=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -871,6 +966,18 @@ class DecodeEngine:
             self._spec = SpeculativeDecoder(self, k=int(spec_decode_k),
                                             drafter=drafter)
 
+        # admission scheduler (explicit arg wins, else FLAGS_sched_policy):
+        # owns the between-steps admission ORDER and the preemption /
+        # deadline-expiry decisions.  "fifo" reproduces the historical
+        # strict-arrival-order admission bit for bit; "slo" adds priority
+        # + earliest-deadline-first + preempt/resume (inference.frontend).
+        from .frontend import make_scheduler
+
+        if scheduler is None:
+            scheduler = str(_flags.flag("sched_policy"))
+        self._scheduler = make_scheduler(scheduler)
+        self._scheduler.bind(self)
+
     def _model_fingerprint(self) -> bytes:
         """Sampling-invariant model identity — the chain-hash root.
         Cached KV is a function of the weights and the token prefix
@@ -899,11 +1006,16 @@ class DecodeEngine:
 
     # -- request lifecycle ---------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=32,
-                    eos_token_id=...) -> Request:
+                    eos_token_id=..., priority=None, deadline_ms=None,
+                    slo_ttft_ms=None, slo_tpot_ms=None,
+                    on_token=None) -> Request:
         # sentinel default: eos_token_id=None is a real per-request
         # opt-out of the engine-level eos, not "use the default"
         req = Request(prompt_ids, max_new_tokens,
-                      self._eos if eos_token_id is ... else eos_token_id)
+                      self._eos if eos_token_id is ... else eos_token_id,
+                      priority=priority, deadline_ms=deadline_ms,
+                      slo_ttft_ms=slo_ttft_ms, slo_tpot_ms=slo_tpot_ms,
+                      on_token=on_token)
         if not req.prompt_ids:
             raise ValueError("empty prompt")
         if req.max_new_tokens < 1:
@@ -918,6 +1030,9 @@ class DecodeEngine:
                 "request needs more KV pages than the pool holds")
         req._engine = self
         req.t_enqueue_ns = _obs.now_ns()
+        if req.deadline_ms is not None:
+            req._deadline_ns = req.t_enqueue_ns + \
+                int(req.deadline_ms * 1e6)
         _obs.REQUESTS_ENQUEUED.inc()
         self._queue.append(req)
         return req
@@ -972,31 +1087,66 @@ class DecodeEngine:
         return hit_pages
 
     def _admit(self):
-        while self._queue and self._free_slots:
-            req = self._queue[0]
-            total_pages = self._pages_for(req.total_kv_tokens())
-            # conservative admission: never admit a request the pool
-            # cannot see through to completion (running requests' not-yet
-            # -allocated pages are reserved).  Cached-prefix hits need no
-            # allocation, and unreferenced cached pages are reclaimable
-            # via the eviction LRU — but the hit pages themselves must
-            # not double-count as evictable capacity.
-            hit_pages = self._probe_prefix(req)
-            need = total_pages - len(hit_pages)
-            avail = self.pool.free_count + \
-                self.pool.cached_unreferenced_count - \
-                sum(1 for p in hit_pages if self.pool.refcount(p) == 0)
-            if avail - self.pool.reserved < need:
-                return
-            self._queue.popleft()
-            slot = heapq.heappop(self._free_slots)
-            if self._chunked:
-                self._bind_slot(req, slot, total_pages, hit_pages)
-            else:
-                self._prefill_into(req, slot, total_pages)
+        """Between-steps admission: delegated to the pluggable
+        scheduler (`inference.frontend.Scheduler`).  The default FIFO
+        scheduler reproduces the historical strict-arrival-order loop
+        exactly; the SLO scheduler re-orders, expires, and preempts.
+        Either way the actual bind goes through `_admit_one`, so the
+        capacity arithmetic lives in exactly one place."""
+        self._scheduler.schedule()
+
+    def _capacity_ok(self, req: Request, extra_pages: int = 0) -> bool:
+        """Would the pool see ``req`` through to completion if
+        ``extra_pages`` more pages were reclaimable?  ``extra_pages=0``
+        is exactly `_admit_one`'s capacity test; a scheduler weighing a
+        preemption passes the pages its victims would free to ask
+        whether evicting them can possibly admit ``req`` — if not,
+        preemption is pure waste.  Read-only (the prefix probe is
+        memoized and references nothing)."""
+        total_pages = self._pages_for(req.total_kv_tokens())
+        hit_pages = self._probe_prefix(req)
+        need = total_pages - len(hit_pages)
+        avail = self.pool.free_count + \
+            self.pool.cached_unreferenced_count + extra_pages - \
+            sum(1 for p in hit_pages if self.pool.refcount(p) == 0)
+        return avail - self.pool.reserved >= need
+
+    def _admit_one(self, req: Request) -> bool:
+        """Admit ONE specific queued request if a slot is free and the
+        pool can see it through to completion; returns False (request
+        stays queued, cache untouched) otherwise.
+
+        Conservative admission: never admit a request the pool cannot
+        see through (running requests' not-yet-allocated pages are
+        reserved).  Cached-prefix hits need no allocation, and
+        unreferenced cached pages are reclaimable via the eviction LRU
+        — but the hit pages themselves must not double-count as
+        evictable capacity (`_capacity_ok` carries that arithmetic)."""
+        if not self._free_slots:
+            return False
+        if not self._capacity_ok(req):
+            return False
+        total_pages = self._pages_for(req.total_kv_tokens())
+        hit_pages = self._probe_prefix(req)  # memoized: re-probe is cheap
+        if self._queue and self._queue[0] is req:
+            self._queue.popleft()  # FIFO fast path (O(1), not a scan)
+        else:
+            self._queue.remove(req)
+        slot = heapq.heappop(self._free_slots)
+        if self._chunked:
+            self._bind_slot(req, slot, total_pages, hit_pages)
+        else:
+            self._prefill_into(req, slot, total_pages)
+        return True
 
     def _stamp_admit(self, req: Request):
+        first = req.t_admit_ns is None
         req.t_admit_ns = _obs.now_ns()
+        if not first:
+            # re-admission after a preemption: the request already
+            # recorded its queue wait — count the resume instead
+            _stats_add(resumes=1)
+            return
         if req.t_enqueue_ns is not None:
             _obs.REQUEST_QUEUE_WAIT.observe(
                 (req.t_admit_ns - req.t_enqueue_ns) / 1e9)
@@ -1113,24 +1263,16 @@ class DecodeEngine:
         tok = int(tok)
         _stats_add(prefill_time_s=time.perf_counter() - t0,
                    prefills=1, tokens=1)
-        req.t_first_token_ns = _obs.now_ns()
-        if req.t_enqueue_ns is not None:
-            _obs.REQUEST_TTFT.observe(
-                (req.t_first_token_ns - req.t_enqueue_ns) / 1e9)
-        _obs.record_span("requests", "prefill", req.t_admit_ns,
-                         req.t_first_token_ns - req.t_admit_ns,
-                         tid=req.request_id,
-                         args={"request": req.request_id,
-                               "prompt_len": p_len, "bucket": bucket})
+        self._stamp_first_token(req, prompt_len=p_len, bucket=bucket)
         _obs.record_span("engine", "prefill", t0_ns,
-                         req.t_first_token_ns - t0_ns,
+                         _obs.now_ns() - t0_ns,
                          tid=self._engine_id,
                          args={"request": req.request_id,
                                "bucket": bucket, "slot": slot})
 
         req.state = "running"
         req.slot = slot
-        req.output_ids = [tok]
+        self._emit(req, [tok])
         self._by_slot[slot] = req
         self._lens[slot] = p_len
         self._prefill_pos[slot] = p_len  # legacy: prompt consumed whole
@@ -1150,6 +1292,50 @@ class DecodeEngine:
         if len(req.output_ids) >= req.max_new_tokens:
             return "length"
         return None
+
+    def _emit(self, req: Request, toks):
+        """Land generated tokens on the request and fire its streaming
+        callback — the ONE place output_ids grows, so every emission
+        path (prefill first token, mixed step, classic decode,
+        speculative accept) streams identically.  The callback runs
+        inside the serve loop: it must be cheap and must not raise (an
+        exception here would unwind the engine mid-step)."""
+        req.output_ids.extend(toks)
+        cb = req.on_token
+        if cb is not None:
+            for t in toks:
+                cb(int(t))
+
+    def _slo_violation(self, req: Request, kind: str):
+        """Record one SLO miss ("ttft" | "tpot" | "deadline") — pure
+        accounting, the request itself is never aborted for missing a
+        latency target."""
+        req.slo_violations.append(kind)
+        _stats_add(slo_violations=1)
+        _obs.SCHED_SLO_VIOLATIONS.inc(kind=kind)
+
+    def _stamp_first_token(self, req: Request, **span_args):
+        """Stamp TTFT exactly ONCE per request — shared by the legacy
+        one-shot prefill and the chunked first-token path.  A RESUMED
+        request (preempted earlier) keeps its original stamp: its
+        replay token is mid-generation, not a first token.  Also runs
+        the declared-TTFT SLO check and records the per-request
+        prefill span."""
+        if req.t_first_token_ns is not None:
+            return
+        req.t_first_token_ns = _obs.now_ns()
+        if req.t_enqueue_ns is not None:
+            ttft_s = (req.t_first_token_ns - req.t_enqueue_ns) / 1e9
+            _obs.REQUEST_TTFT.observe(ttft_s)
+            if req.slo_ttft_ms is not None and \
+                    ttft_s * 1e3 > req.slo_ttft_ms:
+                self._slo_violation(req, "ttft")
+        if req.t_admit_ns is not None:
+            _obs.record_span("requests", "prefill", req.t_admit_ns,
+                             req.t_first_token_ns - req.t_admit_ns,
+                             tid=req.request_id,
+                             args={"request": req.request_id,
+                                   **span_args})
 
     def _register_prompt_pages(self, req: Request):
         """Prefill complete: content-address every freshly computed
@@ -1181,24 +1367,36 @@ class DecodeEngine:
         self._prefill_pos[slot] = 0
         heapq.heappush(self._free_slots, slot)
         _stats_add(**{{"eos": "finished_eos", "length": "finished_length",
-                       "evicted": "evicted"}[reason]: 1})
+                       "evicted": "evicted",
+                       "cancelled": "cancelled"}[reason]: 1})
         req.t_finish_ns = _obs.now_ns()
         _obs.REQUESTS_FINISHED.inc(reason=reason)
-        n_out = len(req.output_ids)
+        # generated-token count is preemption-stable: tokens folded
+        # into the replay prompt still count toward TPOT
+        n_out = len(req.output_ids) + req._absorbed
         if req.t_enqueue_ns is not None:
             _obs.REQUEST_E2E.observe(
                 (req.t_finish_ns - req.t_enqueue_ns) / 1e9)
         if req.t_first_token_ns is not None:
             if n_out > 1:
-                _obs.REQUEST_TPOT.observe(
-                    (req.t_finish_ns - req.t_first_token_ns) / 1e9
-                    / (n_out - 1))
+                tpot_s = (req.t_finish_ns - req.t_first_token_ns) / 1e9 \
+                    / (n_out - 1)
+                _obs.REQUEST_TPOT.observe(tpot_s)
+                if reason in ("eos", "length") and \
+                        req.slo_tpot_ms is not None and \
+                        tpot_s * 1e3 > req.slo_tpot_ms:
+                    self._slo_violation(req, "tpot")
             _obs.record_span(
                 "requests", "decode", req.t_first_token_ns,
                 req.t_finish_ns - req.t_first_token_ns,
                 tid=req.request_id,
                 args={"request": req.request_id, "tokens": n_out,
                       "finish_reason": reason})
+        if reason in ("eos", "length") and req._deadline_ns is not None \
+                and req.t_finish_ns > req._deadline_ns:
+            # it ran to completion, but past its deadline: a violation,
+            # distinct from queued-expiry (which never takes a slot)
+            self._slo_violation(req, "deadline")
         if self._spec is not None:
             self._spec.on_finish(slot, req)
 
@@ -1220,11 +1418,98 @@ class DecodeEngine:
             return  # already finished; nothing to release
         raise ValueError("request is not owned by this engine")
 
+    def preempt(self, req: Request):
+        """Preempt a RUNNING request: release its slot and pages
+        between steps and re-enqueue it for resume.  The generated
+        tokens fold into ``prompt_ids`` (``max_new_tokens`` shrinks one
+        for one, so the KV budget is invariant) and the next admission
+        replays them as a prompt — with the prefix cache on, every FULL
+        page of (prompt + generated) KV is registered here first, so
+        the replay maps those pages at refcount+1 and recomputes at
+        most one partial page plus the last token.  Streaming is
+        seamless: the already-emitted tokens became prompt, so
+        ``on_token`` only ever fires for novel tokens, and
+        ``generated_ids`` reads the full generation throughout.
+
+        Host-side only — no device transfer, no shape change; the
+        preempted KV pages either enter the prefix cache (retained
+        payloads) or return to the free list."""
+        if req.state != "running" or req.slot is None or \
+                self._by_slot[req.slot] is not req:
+            raise ValueError(
+                f"preempt() is for running requests; this one is "
+                f"{req.state!r}")
+        slot = req.slot
+        total_pages = self._pages_for(req.total_kv_tokens())
+        n_gen = len(req.output_ids)
+        kv_len = int(self._lens[slot])
+        replay_hashes = None
+        if self._prefix_cache and req.t_first_token_ns is not None:
+            # content-address every fully written page of the replay
+            # prompt (prompt pages registered at first token stay; this
+            # adds the GENERATED region's full pages).  KV rows
+            # < kv_len are final — speculative rollback only ever
+            # shrinks lens — so the payloads are safe to freeze.
+            replay_hashes = self._prefix_hashes(
+                req.prompt_ids + req.output_ids)
+            for i in range(req.cached_page_count,
+                           min(kv_len // self._page, len(replay_hashes))):
+                self.pool.register_page(req.pages[i], replay_hashes[i])
+        # fold the generation into the prompt for replay; the KV-budget
+        # identity (total_kv_tokens) is preserved exactly
+        req.prompt_ids = req.prompt_ids + req.output_ids
+        req.max_new_tokens -= n_gen
+        req._absorbed += n_gen
+        req.output_ids = []
+        # the hashes just computed ARE the replay prompt's hashes —
+        # keep them memoized so the resume probe (and every re-probe
+        # while capacity-blocked) skips the O(prompt+generated) re-hash
+        req._page_hashes = replay_hashes
+        req.preemptions += 1
+        # release the device-side claim (pages + outstanding
+        # reservation) and the slot — the same teardown as _finish,
+        # minus the finished bookkeeping
+        self.pool.release_pages(req.pages)
+        self.pool.reserved -= max(total_pages - len(req.pages), 0)
+        req.pages = []
+        req.cached_page_count = 0
+        req.cached_prefix_len = 0
+        req.slot = None
+        req.state = "queued"
+        self._by_slot[slot] = None
+        self._active[slot] = False
+        self._lens[slot] = 0
+        self._last[slot] = 0
+        self._bt[slot] = 0
+        self._prefill_pos[slot] = 0
+        heapq.heappush(self._free_slots, slot)
+        if self._spec is not None:
+            self._spec.on_finish(slot, req)
+        # back of the line position-wise, but schedulers order by
+        # (priority, deadline, id) anyway and the id is the original
+        # (oldest-first within its class); FIFO resumes it first
+        self._queue.appendleft(req)
+        _stats_add(preemptions=1)
+        _obs.SCHED_PREEMPTIONS.inc()
+        if req.t_admit_ns is not None:
+            _obs.record_span("requests", "preempted", req.t_admit_ns,
+                             _obs.now_ns() - req.t_admit_ns,
+                             tid=req.request_id,
+                             args={"request": req.request_id,
+                                   "generated": n_gen})
+
+    def _cancel_running(self, req: Request):
+        if req.state != "running" or req.slot is None or \
+                self._by_slot[req.slot] is not req:
+            raise ValueError("request is not running on this engine")
+        self._finish(req.slot, "cancelled")
+
     def _retire_queued(self, req: Request, reason: str):
         """Take a still-queued request out of the admission queue
         (``reason``: "evicted" via `evict`, "cancelled" via
-        `Request.cancel`) — it never held a slot or pages, so this is
-        pure queue + telemetry bookkeeping."""
+        `Request.cancel`, "deadline" via the SLO scheduler's expiry
+        sweep) — it never held a slot or pages, so this is pure queue +
+        telemetry bookkeeping."""
         try:
             self._queue.remove(req)
         except ValueError:
@@ -1233,8 +1518,11 @@ class DecodeEngine:
         req.state = "done"
         req.finish_reason = reason
         req.t_finish_ns = _obs.now_ns()
-        _stats_add(**{reason: 1})
+        _stats_add(**{{"evicted": "evicted", "cancelled": "cancelled",
+                       "deadline": "deadline_expired"}[reason]: 1})
         _obs.REQUESTS_FINISHED.inc(reason=reason)
+        if reason == "deadline":
+            _obs.SCHED_DEADLINE_EXPIRED.inc()
         if req.t_enqueue_ns is not None:
             _obs.REQUEST_E2E.observe(
                 (req.t_finish_ns - req.t_enqueue_ns) / 1e9)
@@ -1424,7 +1712,7 @@ class DecodeEngine:
                 tok = int(toks[s])
                 self._lens[s] += 1
                 self._last[s] = tok
-                req.output_ids.append(tok)
+                self._emit(req, [tok])
                 emitted += 1
                 reason = self._done(req, tok)
                 if reason:
@@ -1437,22 +1725,15 @@ class DecodeEngine:
         first token — stamp TTFT now (not at admission, not at the first
         chunk) and flip the slot into plain decoding.  The prompt's full
         pages are content-final from here on, so they enter the prefix
-        cache before any finish-path release can park them."""
+        cache before any finish-path release can park them.  A RESUMED
+        request (preempted earlier) keeps its original TTFT — the token
+        sampled here is mid-generation, not its first."""
         self._register_prompt_pages(req)
-        req.output_ids = [tok]
+        self._emit(req, [tok])
         self._last[slot] = tok
-        req.t_first_token_ns = _obs.now_ns()
         _stats_add(prefills=1)
-        if req.t_enqueue_ns is not None:
-            _obs.REQUEST_TTFT.observe(
-                (req.t_first_token_ns - req.t_enqueue_ns) / 1e9)
-        if req.t_admit_ns is not None:
-            _obs.record_span("requests", "prefill", req.t_admit_ns,
-                             req.t_first_token_ns - req.t_admit_ns,
-                             tid=req.request_id,
-                             args={"request": req.request_id,
-                                   "prompt_len": len(req.prompt_ids),
-                                   "chunks": req.prefill_chunks})
+        self._stamp_first_token(req, prompt_len=len(req.prompt_ids),
+                                chunks=req.prefill_chunks)
         reason = self._done(req, tok)
         if reason:
             self._finish(slot, reason)
@@ -1478,6 +1759,14 @@ class DecodeEngine:
         if self._pool_debug:
             self._debug_check_pool()
         self._admit()
+        # admission-pressure gauges, sampled every step AFTER admission
+        # (what is left queued is the backlog the pool/slots could not
+        # absorb) — previously only derivable from queued spans
+        eid = self._engine_id
+        _obs.QUEUE_DEPTH.set(len(self._queue), engine=eid)
+        _obs.QUEUE_OLDEST_AGE.set(
+            (_obs.now_ns() - min(r.t_enqueue_ns for r in self._queue))
+            / 1e9 if self._queue else 0.0, engine=eid)
         if not self._active.any():
             return bool(self._queue)
         if self._spec is not None:
@@ -1522,16 +1811,27 @@ class DecodeEngine:
             req = self._by_slot[slot]
             self._lens[slot] += 1
             self._last[slot] = tok
-            req.output_ids.append(tok)
+            self._emit(req, [tok])
             reason = self._done(req, tok)
             if reason:
                 self._finish(slot, reason)
         return True
 
     def run(self, max_steps=100000):
-        """Drive the loop until every queued/running request finishes."""
+        """Drive the loop until every queued/running request finishes.
+        ``max_steps`` is a runaway backstop, not a truncation knob:
+        exhausting it with work still pending raises instead of
+        silently returning half-served requests (every step advances
+        each active slot by at least one token, so a healthy serve
+        always terminates on its own)."""
         steps = 0
-        while (self._queue or self._active.any()) and steps < max_steps:
+        while self._queue or self._active.any():
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"run(max_steps={max_steps}) exhausted with "
+                    f"{len(self._queue)} queued and "
+                    f"{int(self._active.sum())} running requests — "
+                    f"raise the cap (or find the scheduling livelock)")
             self.step()
             steps += 1
         return steps
@@ -1539,15 +1839,15 @@ class DecodeEngine:
     def generate(self, prompts, max_new_tokens=32, return_meta=False):
         """Convenience batch API: submit all prompts, serve to
         completion, return one token list per prompt (in order).
-        Loops run() until the queue drains — every step advances each
-        active slot by at least one token, so progress is guaranteed and
-        no request can be silently truncated at run()'s step cap.
+        ``run()`` already drains the queue (and raises at its step cap
+        rather than truncating), so one call is the whole serve.
+        Outputs read ``generated_ids`` — stable even if the scheduler
+        preempted and resumed a request mid-generation.
         ``return_meta=True`` additionally returns the per-request
-        ``finish_reason`` list ("eos" | "length" | "evicted")."""
+        ``finish_reason`` list ("eos" | "length" | "evicted" | ...)."""
         reqs = [self.add_request(p, max_new_tokens) for p in prompts]
-        while self._queue or self._active.any():
-            self.run()
-        outs = [list(r.output_ids) for r in reqs]
+        self.run()
+        outs = [list(r.generated_ids) for r in reqs]
         if return_meta:
             return outs, [r.finish_reason for r in reqs]
         return outs

@@ -44,6 +44,16 @@ Drafters:
   block tables and page ids as the target model's, so one allocator
   governs both and the rollback invariants transfer unchanged.
 
+Preemption (`DecodeEngine.preempt`, SLO scheduler) composes for free:
+it fires between steps, so a speculative round never sees a half-torn
+slot — the preempted slot goes inactive (``on_finish`` resets the
+drafter's cursor) and a resume re-enters through ``on_admit`` exactly
+like a fresh admission.  Cached replay pages may hold draft K/V the
+draft model never wrote (the bonus token of the round before the
+preemption, say): drafts over such a page can only be WRONG, never
+unsound — the verify pass still emits target-model samples only, so
+acceptance may dip after a resume but correctness cannot.
+
 Telemetry lands in `profiler.decode_stats`: ``acceptance_rate``,
 ``mean_accepted_per_step``, ``draft_time_s`` / ``verify_time_s``, and
 the zero-warm-retrace contract extends to the draft and verify
@@ -608,7 +618,10 @@ class SpeculativeDecoder:
             # cut by an earlier eos never reached the output
             proposed_total += usable
             accepted_total += min(m, n_emit)
-            req.output_ids.extend(emit)
+            # through the engine's single emission point: the streaming
+            # on_token hook fires per accepted token exactly like on
+            # the classic decode path
+            eng._emit(req, emit)
             # accepted rows keep their K/V; the rejected tail is rolled
             # back purely by NOT advancing seq_lens over it
             eng._lens[s] += n_emit

@@ -41,6 +41,11 @@ paddle_slot_occupancy                          gauge      engine
 paddle_spec_last_step_accepted_tokens          gauge      engine
 paddle_requests_enqueued_total                 counter    —
 paddle_requests_finished_total                 counter    reason
+paddle_queue_depth                             gauge      engine
+paddle_queue_oldest_age_seconds                gauge      engine
+paddle_sched_preemptions_total                 counter    —
+paddle_sched_deadline_expired_total            counter    —
+paddle_sched_slo_violations_total              counter    kind
 =============================================  =========  ==========
 
 plus the views: ``paddle_decode_*`` (every `decode_stats` key) and
@@ -178,6 +183,32 @@ REQUESTS_FINISHED = counter(
     "paddle_requests_finished_total",
     "Requests that left an engine, by finish reason",
     labels=("reason",))
+QUEUE_DEPTH = gauge(
+    "paddle_queue_depth",
+    "Requests waiting in the admission queue after the engine's most "
+    "recent between-steps admission pass — the direct admission-"
+    "pressure reading (previously only derivable from queued spans)",
+    labels=("engine",))
+QUEUE_OLDEST_AGE = gauge(
+    "paddle_queue_oldest_age_seconds",
+    "Age of the oldest still-queued request (now - enqueue) as of the "
+    "engine's most recent step; 0 when the queue is empty",
+    labels=("engine",))
+SCHED_PREEMPTIONS = counter(
+    "paddle_sched_preemptions_total",
+    "Running requests preempted by the scheduler (slot and pages "
+    "released between steps, re-enqueued for resume via the prefix "
+    "cache)")
+SCHED_DEADLINE_EXPIRED = counter(
+    "paddle_sched_deadline_expired_total",
+    "Still-queued requests retired because their deadline_ms passed "
+    "before admission (finish_reason=\"deadline\"; no slot ever taken)")
+SCHED_SLO_VIOLATIONS = counter(
+    "paddle_sched_slo_violations_total",
+    "Declared per-request latency targets missed, by kind (ttft | "
+    "tpot | deadline); accounting only — a violating request is never "
+    "aborted",
+    labels=("kind",))
 
 
 # ---------------------------------------------------------------------------

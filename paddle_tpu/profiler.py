@@ -63,8 +63,12 @@ DECODE_STAT_COUNTERS = (
     "spec_emitted",
     "draft_time_s", "verify_time_s", "verify_compiles", "draft_compiles",
     # request-completion accounting (Request.finish_reason; "cancelled"
-    # counts still-queued requests removed via Request.cancel())
+    # counts queued AND running requests removed via Request.cancel())
     "finished_eos", "finished_length", "evicted", "cancelled",
+    # SLO-aware scheduling (inference.frontend.SLOScheduler):
+    # preempt/resume cycles, still-queued requests retired at their
+    # deadline, and declared TTFT/TPOT/deadline targets missed
+    "preemptions", "resumes", "deadline_expired", "slo_violations",
 )
 DECODE_STAT_DERIVED = ("avg_step_ms", "batch_occupancy",
                        "kv_block_utilization",

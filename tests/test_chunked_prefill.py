@@ -374,17 +374,23 @@ class TestCancel:
         eng.run()
         assert r1.finish_reason == "length"
 
-    def test_cancel_running_refused(self):
+    def test_cancel_running_routes_through_teardown(self):
+        # cancel() is uniform across queued/running (PR 7): a RUNNING
+        # request gives its slot and pages back through the same
+        # teardown as evict, but keeps the distinct "cancelled" reason
         m = _tiny_gpt(seed=14)
         eng = _engine(m, max_batch_size=1)
         req = eng.add_request(np.arange(4).astype(np.int32),
                               max_new_tokens=8)
         eng.step()
-        with pytest.raises(ValueError, match="still-queued"):
-            req.cancel()
-        eng.evict(req)
+        assert req.state == "running"
+        req.cancel()
+        assert req.state == "done"
+        assert req.finish_reason == "cancelled"
+        assert decode_stats()["cancelled"] == 1
+        assert eng.pool.available_count == eng.pool.num_pages
         req.cancel()  # done: no-op
-        assert req.finish_reason == "evicted"
+        assert req.finish_reason == "cancelled"
 
     def test_cancel_never_enqueued_refused(self):
         with pytest.raises(ValueError, match="never enqueued"):

@@ -259,6 +259,49 @@ def test_bench_prefix_smoke(tmp_path):
         "series"] == []
 
 
+def test_bench_slo_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_slo.py runs end-to-end: the SLO
+    scheduling bench can't rot.  Asserts the emitted JSON shape,
+    cross-leg greedy token parity (scheduling changes WHEN a request
+    runs, never WHAT it emits), at least one preempt->resume cycle
+    whose resumed request matched the never-preempted reference, at
+    least one queued-deadline expiry, and zero warm retraces —
+    goodput/latency RATIOS are asserted only at full scale (smoke
+    shapes are too noise-dominated to pin them)."""
+    out = str(tmp_path / "bench_slo.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_slo.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    assert data["parity"] is True
+    legs = data["legs"]
+    assert set(legs) == {"fifo", "slo"}
+    # FIFO is the no-op oracle: strict arrival order, no preemption,
+    # no expiry — and host-side scheduling never retraces either leg
+    assert legs["fifo"]["preemptions"] == 0
+    assert legs["fifo"]["deadline_expired"] == 0
+    for leg in legs.values():
+        assert leg["retraces_after_warmup"] == 0
+        assert leg["offered"] == len(leg["finish_reasons"])
+        assert 0 <= leg["met"] <= leg["offered"]
+    # the point of the scheduler: pressure actually exercised it
+    assert legs["slo"]["preemptions"] >= 1
+    assert legs["slo"]["resumes"] >= 1
+    assert legs["slo"]["deadline_expired"] >= 1
+    assert data["summary"]["preempt_resume_parity"] is True
+    assert data["summary"]["zero_warm_retraces"] is True
+    assert legs["slo"]["finish_reasons"]["doomed"] == "deadline"
+    # queue-pressure gauges surfaced in the embedded snapshot
+    snap = data["observability"]["slo"]
+    assert snap["paddle_sched_preemptions_total"]["series"][0][
+        "value"] >= 1
+    assert "paddle_queue_depth" in snap
+
+
 def test_telemetry_dump_smoke(tmp_path):
     """tools/telemetry_dump.py runs a small engine workload end-to-end
     and every export format parses: Prometheus text has the core

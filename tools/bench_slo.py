@@ -1,0 +1,307 @@
+"""SLO scheduling benchmark: goodput under overload, FIFO vs the SLO
+scheduler (inference.frontend).
+
+Offered load ~2x capacity on a 2-slot CPU-sized engine: a wave of
+long batch generations fills every slot, then a wave of interactive
+requests (tight TTFT SLOs, `PRIORITY_INTERACTIVE`) arrives mid-serve,
+plus one batch request whose deadline expires while it queues.  Both
+legs replay the SAME step-indexed arrival plan through identical
+engines — only the scheduler differs:
+
+* **fifo** — strict arrival order: the interactive wave waits for a
+  batch slot to free, so every interactive request blows its TTFT
+  target (and the doomed request runs anyway, finishing past its
+  deadline);
+* **slo**  — priority + EDF admission preempts the lowest-priority
+  batch runner (resume rides the prefix cache), the interactive wave
+  meets its targets, and the doomed request is expired from the queue
+  without ever taking a slot.
+
+**Goodput** = fraction of offered requests that finished their
+generation AND met every latency target they declared
+(`Request.slo_met`; requests declaring no target just need to finish).
+That is the number a serving stack is judged on under overload — raw
+throughput is nearly identical across the legs, the difference is
+WHICH requests the capacity was spent on.
+
+The interactive TTFT SLO is calibrated from a solo warm-up request
+(--slo-scale x its TTFT), so the bench measures scheduling, not
+machine speed.  Also asserted/recorded: greedy token parity for every
+request that completed in both legs (scheduling must change WHEN, not
+WHAT), a preempt->resume cycle whose resumed request matches a
+never-preempted reference run, >=1 queued-deadline expiry, and zero
+warm retraces (scheduling is host-side; no new executables).
+
+Emits BENCH_slo.json.
+
+Usage:
+    python tools/bench_slo.py [--out BENCH_slo.json] [--batch 4]
+                              [--interactive 4] [--batch-new 48]
+                              [--inter-new 8] [--slo-scale 4.0]
+                              [--smoke]
+
+``--smoke`` (or env BENCH_SMOKE=1) shrinks shapes so CI can assert the
+script end-to-end (tests/test_tooling.py).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models.gpt import GPT, GPTConfig  # noqa: E402
+
+
+def _build_model(args):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.prompt + args.batch_new + 64,
+                    use_parallel_layers=False, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    return model
+
+
+def _engine(model, args, scheduler):
+    from paddle_tpu.inference.serving import DecodeEngine
+
+    return DecodeEngine(model, max_batch_size=args.slots,
+                        max_seq_len=args.prompt + args.batch_new,
+                        page_size=args.page_size,
+                        prefill_chunk_tokens=args.chunk,
+                        scheduler=scheduler)
+
+
+def _workload(args, rng):
+    """The offered load, as (arrival_step, kind, prompt, kwargs) —
+    identical for both legs.  Batch wave at step 0 saturates the
+    slots; the interactive wave and the doomed request arrive once the
+    batch generations are mid-flight (~2x the 2-slot capacity in
+    flight from then on)."""
+    plan = []
+    for i in range(args.batch):
+        p = rng.randint(0, args.vocab, (args.prompt,)).astype(np.int32)
+        plan.append((0, f"batch{i}", p,
+                     dict(max_new_tokens=args.batch_new)))
+    arrive = args.inter_arrival_step
+    from paddle_tpu.inference.serving import PRIORITY_INTERACTIVE
+
+    for i in range(args.interactive):
+        p = rng.randint(0, args.vocab, (args.prompt,)).astype(np.int32)
+        plan.append((arrive + i, f"inter{i}", p,
+                     dict(max_new_tokens=args.inter_new,
+                          priority=PRIORITY_INTERACTIVE)))
+    p = rng.randint(0, args.vocab, (args.prompt,)).astype(np.int32)
+    plan.append((arrive, "doomed", p,
+                 dict(max_new_tokens=args.batch_new,
+                      deadline_ms=args.doomed_deadline_ms)))
+    return plan
+
+
+def _calibrate_slo(model, args):
+    """TTFT of one solo interactive request on a WARM engine — the
+    'machine speed' unit the interactive SLO scales from."""
+    eng = _engine(model, args, "fifo")
+    rng = np.random.RandomState(123)
+    eng.generate([rng.randint(0, args.vocab, (args.prompt,))
+                  .astype(np.int32)], max_new_tokens=2)  # compile
+    req = eng.add_request(rng.randint(0, args.vocab, (args.prompt,))
+                          .astype(np.int32),
+                          max_new_tokens=args.inter_new)
+    eng.run()
+    return (req.t_first_token_ns - req.t_enqueue_ns) / 1e6  # ms
+
+
+def _serve_leg(model, args, scheduler, plan, slo_ttft_ms):
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.serving import (decode_stats,
+                                              reset_decode_stats)
+
+    eng = _engine(model, args, scheduler)
+    # warm every executable out of the measurement window
+    warm_rng = np.random.RandomState(999)
+    eng.generate([warm_rng.randint(0, args.vocab, (args.prompt,))
+                  .astype(np.int32)], max_new_tokens=2)
+    reset_decode_stats()
+    obs.reset()
+
+    reqs = {}
+    step_no = 0
+    pending = sorted(plan, key=lambda e: e[0])
+    while pending or eng._queue or eng._active.any():
+        while pending and pending[0][0] <= step_no:
+            _, name, prompt, kw = pending.pop(0)
+            kw = dict(kw)
+            if name.startswith("inter"):
+                kw["slo_ttft_ms"] = slo_ttft_ms
+            reqs[name] = eng.add_request(prompt, **kw)
+        eng.step()
+        step_no += 1
+    st = decode_stats()
+    snap = obs.snapshot()
+
+    met = sum(1 for r in reqs.values() if r.slo_met)
+    ttfts = {n: (r.t_first_token_ns - r.t_enqueue_ns) / 1e6
+             for n, r in reqs.items() if r.t_first_token_ns is not None}
+    inter_ttft = [round(ttfts[n], 2) for n in sorted(ttfts)
+                  if n.startswith("inter")]
+    leg = {
+        "goodput": round(met / len(reqs), 4),
+        "met": met,
+        "offered": len(reqs),
+        "steps": step_no,
+        "interactive_ttft_ms": inter_ttft,
+        "interactive_ttft_mean_ms": round(
+            float(np.mean(inter_ttft)), 2) if inter_ttft else None,
+        "finish_reasons": {n: r.finish_reason
+                           for n, r in sorted(reqs.items())},
+        "preemptions": st["preemptions"],
+        "resumes": st["resumes"],
+        "deadline_expired": st["deadline_expired"],
+        "slo_violations": st["slo_violations"],
+        "retraces_after_warmup": st["retraces_after_warmup"],
+    }
+    return leg, reqs, snap, eng
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_slo.json"))
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=4,
+                    help="batch-priority requests in the first wave")
+    ap.add_argument("--interactive", type=int, default=4,
+                    help="interactive requests arriving mid-serve")
+    ap.add_argument("--prompt", type=int, default=24)
+    ap.add_argument("--batch-new", type=int, default=48)
+    ap.add_argument("--inter-new", type=int, default=8)
+    ap.add_argument("--inter-arrival-step", type=int, default=12,
+                    help="step the interactive wave starts arriving")
+    ap.add_argument("--slo-scale", type=float, default=4.0,
+                    help="interactive TTFT SLO = scale x solo TTFT")
+    ap.add_argument("--doomed-deadline-ms", type=float, default=0.5,
+                    help="deadline of the request that must expire "
+                         "while queued (well under one engine step)")
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes: CI end-to-end check")
+    args = ap.parse_args()
+    if os.environ.get("BENCH_SMOKE") == "1":
+        args.smoke = True
+    if args.smoke:
+        args.batch, args.interactive = 2, 2
+        args.prompt, args.batch_new, args.inter_new = 12, 24, 4
+        args.inter_arrival_step = 6
+        args.chunk, args.page_size = 8, 8
+        args.hidden, args.vocab = 64, 128
+
+    import jax
+
+    model = _build_model(args)
+    plan = _workload(args, np.random.RandomState(0))
+    solo_ttft_ms = _calibrate_slo(model, args)
+    slo_ttft_ms = args.slo_scale * solo_ttft_ms
+
+    legs, all_reqs, snaps = {}, {}, {}
+    for name in ("fifo", "slo"):
+        leg, reqs, snap, eng = _serve_leg(model, args, name, plan,
+                                          slo_ttft_ms)
+        legs[name], all_reqs[name], snaps[name] = leg, reqs, snap
+        print(f"{name:4s}: goodput {leg['goodput']:.2f} "
+              f"({leg['met']}/{leg['offered']}) | interactive ttft "
+              f"{leg['interactive_ttft_mean_ms']} ms | preemptions "
+              f"{leg['preemptions']} | expired "
+              f"{leg['deadline_expired']}")
+
+    # cross-leg token parity: scheduling may change WHEN a request ran,
+    # never WHAT it generated (greedy tokens are a function of weights
+    # + prompt only).  Compare every request that completed in both.
+    parity = True
+    for n, rf in all_reqs["fifo"].items():
+        rs = all_reqs["slo"][n]
+        if rf.finish_reason in ("eos", "length") and \
+                rs.finish_reason in ("eos", "length"):
+            parity = parity and rf.generated_ids == rs.generated_ids
+
+    # preempt->resume correctness: a preempted request's final tokens
+    # must match a never-preempted reference run of its ORIGINAL prompt
+    preempted = [r for r in all_reqs["slo"].values() if r.preemptions]
+    resume_parity = None
+    if preempted:
+        victim = preempted[0]
+        ref_eng = _engine(model, args, "fifo")
+        ref = ref_eng.generate(
+            [np.asarray(victim.prompt_ids[:victim.orig_prompt_len],
+                        np.int32)],
+            max_new_tokens=victim.max_new_tokens + victim._absorbed)[0]
+        resume_parity = victim.generated_ids == ref
+
+    fifo, slo = legs["fifo"], legs["slo"]
+    summary = {
+        "goodput_fifo": fifo["goodput"],
+        "goodput_slo": slo["goodput"],
+        "goodput_ratio_slo_vs_fifo": round(
+            slo["goodput"] / max(fifo["goodput"], 1e-9), 3),
+        # None when a leg had no interactive first tokens
+        # (e.g. --interactive 0)
+        "interactive_ttft_ratio_slo_vs_fifo": round(
+            slo["interactive_ttft_mean_ms"]
+            / max(fifo["interactive_ttft_mean_ms"], 1e-9), 3)
+        if slo["interactive_ttft_mean_ms"] is not None
+        and fifo["interactive_ttft_mean_ms"] is not None else None,
+        "solo_ttft_ms": round(solo_ttft_ms, 2),
+        "interactive_slo_ttft_ms": round(slo_ttft_ms, 2),
+        "preemptions": slo["preemptions"],
+        "resumes": slo["resumes"],
+        "deadline_expired": slo["deadline_expired"],
+        "preempt_resume_parity": resume_parity,
+        "zero_warm_retraces": fifo["retraces_after_warmup"] == 0
+        and slo["retraces_after_warmup"] == 0,
+    }
+    out = {
+        "bench": "SLO scheduling: goodput under ~2x overload, FIFO vs "
+                 "priority+EDF+preempt/resume (mixed interactive/batch)",
+        "device": str(jax.devices()[0].device_kind)
+        if jax.devices() else "unknown",
+        "smoke": bool(args.smoke),
+        "config": {k: getattr(args, k) for k in
+                   ("slots", "batch", "interactive", "prompt",
+                    "batch_new", "inter_new", "inter_arrival_step",
+                    "slo_scale", "doomed_deadline_ms", "chunk",
+                    "layers", "hidden", "heads", "vocab", "page_size")},
+        "legs": legs,
+        "summary": summary,
+        "parity": bool(parity),
+        "observability": snaps,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {args.out} (parity={parity}, goodput "
+          f"{summary['goodput_slo']} vs {summary['goodput_fifo']} = "
+          f"{summary['goodput_ratio_slo_vs_fifo']}x, preempt-resume "
+          f"parity {resume_parity})")
+    ok = parity and resume_parity is not False and \
+        summary["zero_warm_retraces"] and \
+        slo["preemptions"] >= 1 and slo["deadline_expired"] >= 1
+    if not args.smoke:
+        # the acceptance bar (full scale only: smoke shapes are too
+        # noise-dominated to pin latency-derived ratios)
+        ok = ok and summary["goodput_ratio_slo_vs_fifo"] >= 1.3
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
