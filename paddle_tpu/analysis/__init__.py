@@ -1,0 +1,179 @@
+"""Static trace-safety / donation / lock-discipline analysis + the
+runtime sanitizer for the serving stack.
+
+Two halves:
+
+* `passes` — AST lint passes over the repo's own source (no code is
+  executed, no jax import).  `tools/tracecheck.py` is the CLI;
+  `run_tracecheck()` is the library entry.  The **repo spec** below
+  names the designated locks, guarded registries, engine-mutation
+  sanction sites, and default scan targets — the invariants the
+  serving stack's docstrings promise, made machine-checkable.
+* `sanitizer` — runtime mode (``FLAGS_sanitize``): donated-buffer
+  tombstones with use-after-donate errors naming the donation site,
+  lock-order cycle detection over the designated locks, warm retraces
+  raising instead of counting, a host-sync sentinel, and the
+  `KVBlockPool.assert_consistent` audit every engine step.
+
+Baseline workflow: ``tracecheck --write-baseline`` grandfathers the
+current findings into a JSON file keyed by content fingerprint (pass +
+file + source-line text), so pre-existing debt never blocks CI while
+any TOUCHED line resurfaces immediately.  The shipped baseline
+(`tools/tracecheck_baseline.json`) is empty: every finding the passes
+surfaced was fixed, not grandfathered.
+
+See docs/STATIC_ANALYSIS.md for the pass catalog and workflow.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .passes import (  # noqa: F401
+    DonationPass, EngineMutationPass, EngineRule, Finding, LockRule,
+    LockDisciplinePass, SourceModule, TraceHazardPass, run_passes,
+    scan_paths,
+)
+from . import sanitizer  # noqa: F401
+
+__all__ = [
+    "Finding", "LockRule", "EngineRule", "SourceModule",
+    "TraceHazardPass", "LockDisciplinePass", "EngineMutationPass",
+    "DonationPass", "run_passes", "scan_paths", "run_tracecheck",
+    "REPO_LOCK_RULES", "REPO_ENGINE_RULE", "DEFAULT_TARGETS",
+    "load_baseline", "write_baseline", "split_baselined", "sanitizer",
+]
+
+
+# ---------------------------------------------------------------------------
+# The repo spec: designated locks, guarded registries, sanction sites.
+# This is the machine-readable form of the serving stack's concurrency
+# contracts — keep it in sync with the module docstrings it encodes.
+# ---------------------------------------------------------------------------
+REPO_LOCK_RULES: Dict[str, LockRule] = {
+    # ONE telemetry lock: every registry series mutation and every
+    # serving._STATS read-modify-write happens under observability.LOCK
+    "observability/metrics.py": LockRule(
+        locks=("LOCK",),
+        roots=("_state",),
+        self_attrs=("_series", "_metrics", "_views"),
+    ),
+    "observability/tracing.py": LockRule(
+        locks=("_lock",),
+        roots=("_spans", "_dropped"),
+    ),
+    "observability/reporter.py": LockRule(
+        locks=("_lock",),
+        roots=("_thread", "_stop"),
+    ),
+    "inference/serving.py": LockRule(
+        locks=("_TELEMETRY_LOCK", "LOCK"),
+        roots=("_STATS",),
+    ),
+    "inference/speculative.py": LockRule(
+        locks=("_TELEMETRY_LOCK", "LOCK"),
+        roots=("_STATS",),
+    ),
+    # dispatch keeps its own two locks: per-op stats under _STATS_LOCK
+    # (including the _OpStats objects aliased out of the registry), the
+    # executable cache under _CACHE_LOCK
+    "core/dispatch.py": LockRule(
+        locks=("_STATS_LOCK", "_CACHE_LOCK"),
+        roots=("_STATS", "_CACHE"),
+        alias_fns=("_stats_for",),
+        alias_attrs=("stats",),
+        guarded_classes=("_OpStats",),
+    ),
+}
+
+# DecodeEngine is single-threaded by contract: every mutation happens
+# between steps on the driver.  serving.py / speculative.py ARE the
+# engine; in frontend.py only the schedulers (engine-called, between
+# steps) and the driver's control-application points may mutate.
+REPO_ENGINE_RULE = EngineRule(
+    mutators=(
+        "add_request", "evict", "preempt", "step", "run", "generate",
+        "_admit", "_admit_one", "_finish", "_emit", "_bind_slot",
+        "_prefill_into", "_cancel_queued", "_cancel_running",
+        "_retire_queued", "_grow_block_tables", "_mixed_step",
+        "_stamp_admit", "_stamp_first_token", "_on_first_token",
+        "_register_prompt_pages", "_debug_check_pool",
+    ),
+    receivers=("eng", "engine", "self.engine", "self._engine"),
+    sanctioned={
+        "inference/serving.py": ("*",),
+        "inference/speculative.py": ("*",),
+        "inference/frontend.py": (
+            "Scheduler.", "FIFOScheduler.", "SLOScheduler.",
+            "ServingFrontend._apply_control", "ServingFrontend._drive",
+        ),
+    },
+)
+
+# What `tools/tracecheck.py` scans by default (repo-root relative):
+# the serving stack plus the dispatch cache — the modules whose
+# invariants the passes encode.
+DEFAULT_TARGETS: Tuple[str, ...] = (
+    "paddle_tpu/inference",
+    "paddle_tpu/observability",
+    "paddle_tpu/core/dispatch.py",
+)
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_tracecheck(paths: Optional[Sequence[str]] = None,
+                   root: Optional[str] = None,
+                   lock_rules: Optional[Dict[str, LockRule]] = None,
+                   engine_rule: Optional[EngineRule] = None
+                   ) -> List[Finding]:
+    """Run every static pass over ``paths`` (default: the repo's
+    serving-stack targets) and return the sorted findings."""
+    root = root or repo_root()
+    modules = scan_paths(paths or DEFAULT_TARGETS, root)
+    return run_passes(
+        modules,
+        lock_rules=REPO_LOCK_RULES if lock_rules is None else lock_rules,
+        engine_rule=REPO_ENGINE_RULE if engine_rule is None
+        else engine_rule)
+
+
+# ---------------------------------------------------------------------------
+# Baseline (grandfather) file
+# ---------------------------------------------------------------------------
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry dict.  A missing file is an empty
+    baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("entries", [])}
+
+
+def write_baseline(path: str, findings: Sequence[Finding]):
+    entries = [{
+        "fingerprint": f.fingerprint,
+        "pass": f.pass_id,
+        "path": f.path,
+        "line": f.line,       # informational; the fingerprint is the key
+        "message": f.message,
+    } for f in findings]
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")
+
+
+def split_baselined(findings: Sequence[Finding],
+                    baseline: Dict[str, dict]
+                    ) -> Tuple[List[Finding], List[Finding]]:
+    """(new, grandfathered) split by content fingerprint."""
+    new, old = [], []
+    for f in findings:
+        (old if f.fingerprint in baseline else new).append(f)
+    return new, old

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import flags, framework
+from ..analysis.sanitizer import TrackedLock as _TrackedLock
 from .tape import TapeNode, default_tape
 from .tensor import Tensor
 
@@ -279,6 +280,26 @@ class _OpStats:
         self.bypasses = 0
         self.time_s = 0.0
 
+    def bump(self, calls=0, hits=0, misses=0, bypasses=0, time_s=0.0):
+        """Apply one call's counter deltas atomically — the ONLY
+        mutation path besides `_zero`, so a concurrent
+        reset_dispatch_stats can never tear (or lose) an update.  One
+        lock round per dispatch: callers batch their deltas."""
+        with _STATS_LOCK:
+            self.calls += calls
+            self.hits += hits
+            self.misses += misses
+            self.bypasses += bypasses
+            self.time_s += time_s
+
+    def _zero(self):
+        # _STATS_LOCK is an RLock so both reset paths (standalone and
+        # under dispatch_stats' atomic read+reset hold) share this one
+        # zeroing definition
+        with _STATS_LOCK:
+            self.calls = self.hits = self.misses = self.bypasses = 0
+            self.time_s = 0.0
+
     def as_dict(self):
         return {"calls": self.calls, "hits": self.hits,
                 "misses": self.misses, "retraces": self.misses,
@@ -286,7 +307,7 @@ class _OpStats:
 
 
 _STATS: dict = {}
-_STATS_LOCK = threading.Lock()
+_STATS_LOCK = _TrackedLock(threading.RLock(), "dispatch._STATS_LOCK")
 
 
 def _stats_for(name) -> _OpStats:
@@ -302,10 +323,14 @@ def dispatch_stats(reset=False):
     bypasses, time_s}}``.  A 'retrace' is a miss that traced + compiled a
     new executable pair; 'bypasses' count calls that took the legacy
     per-call path (uncacheable closure, jit trace in progress, or cache
-    disabled)."""
-    out = {k: v.as_dict() for k, v in list(_STATS.items())}
-    if reset:
-        reset_dispatch_stats()
+    disabled).  ``reset=True`` is atomic with the read: a concurrent
+    ``bump`` lands either in the returned snapshot or in the post-reset
+    counters, never in neither."""
+    with _STATS_LOCK:
+        out = {k: v.as_dict() for k, v in _STATS.items()}
+        if reset:
+            for s in _STATS.values():
+                s._zero()
     return out
 
 
@@ -315,8 +340,7 @@ def reset_dispatch_stats():
     # post-reset hits would never be visible again
     with _STATS_LOCK:
         for s in _STATS.values():
-            s.calls = s.hits = s.misses = s.bypasses = 0
-            s.time_s = 0.0
+            s._zero()
 
 
 def telemetry_series():
@@ -402,7 +426,7 @@ class _Entry:
 
 
 _CACHE: "OrderedDict[tuple, _Entry]" = OrderedDict()
-_CACHE_LOCK = threading.Lock()
+_CACHE_LOCK = _TrackedLock(threading.Lock(), "dispatch._CACHE_LOCK")
 
 
 def clear_dispatch_cache():
@@ -631,7 +655,7 @@ def dispatch(jfn, *inputs, amp_policy=None, nondiff=(), **static_kwargs):
             entry = _build_entry(jfn, static_kwargs, input_proto,
                                  diff_pos, amp, pins, stats)
             _cache_put(key, entry)
-            stats.misses += 1
+            hit = 0
         else:
             with _CACHE_LOCK:  # LRU touch races _cache_put's eviction
                 try:
@@ -639,44 +663,47 @@ def dispatch(jfn, *inputs, amp_policy=None, nondiff=(), **static_kwargs):
                 except KeyError:  # concurrent clear
                     pass
             stats = entry.stats
-            stats.hits += 1
-        stats.calls += 1
-        out = entry.fwd(*arr_vals)
+            hit = 1
+        try:
+            out = entry.fwd(*arr_vals)
 
-        if not diff_pos:
-            wrapped = _wrap_out(out, stop_gradient=True)
+            if not diff_pos:
+                wrapped = _wrap_out(out, stop_gradient=True)
+                if flags.flag("check_nan_inf"):
+                    _check_nan_inf(wrapped if isinstance(wrapped, tuple)
+                                   else (wrapped,))
+                return wrapped
+
+            wrapped = _wrap_out(out, stop_gradient=False)
+            outs = wrapped if isinstance(wrapped, tuple) else (wrapped,)
+            entry.ensure_bwd(outs, isinstance(wrapped, tuple))
+            node = TapeNode(
+                _CachedVjp(entry, tuple(arr_vals)),
+                [inputs[p] for p in diff_pos],
+                list(outs),
+                out_is_tuple=isinstance(wrapped, tuple),
+                primal_fn=_make_primal(jfn, static_kwargs, arrays,
+                                       diff_pos, amp),
+            )
+            default_tape().record(node)
             if flags.flag("check_nan_inf"):
-                _check_nan_inf(wrapped if isinstance(wrapped, tuple)
-                               else (wrapped,))
-            stats.time_s += time.perf_counter() - t0
+                _check_nan_inf(outs)
             return wrapped
-
-        wrapped = _wrap_out(out, stop_gradient=False)
-        outs = wrapped if isinstance(wrapped, tuple) else (wrapped,)
-        entry.ensure_bwd(outs, isinstance(wrapped, tuple))
-        node = TapeNode(
-            _CachedVjp(entry, tuple(arr_vals)),
-            [inputs[p] for p in diff_pos],
-            list(outs),
-            out_is_tuple=isinstance(wrapped, tuple),
-            primal_fn=_make_primal(jfn, static_kwargs, arrays, diff_pos,
-                                   amp),
-        )
-        default_tape().record(node)
-        if flags.flag("check_nan_inf"):
-            _check_nan_inf(outs)
-        stats.time_s += time.perf_counter() - t0
-        return wrapped
+        finally:
+            # in a finally so an op that RAISES (NaN check, trace
+            # error) still shows up in the table — the bypass path
+            # counts its failures the same way
+            stats.bump(calls=1, hits=hit, misses=1 - hit,
+                       time_s=time.perf_counter() - t0)
 
     # ---- legacy per-call path (uncacheable / trace mode / disabled) -----
     stats = _stats_for(_op_name(jfn))
-    stats.calls += 1
-    stats.bypasses += 1
     try:
         return _dispatch_uncached(jfn, inputs, arrays, amp_policy,
                                   bool(diff_pos), diff_pos, static_kwargs)
     finally:
-        stats.time_s += time.perf_counter() - t0
+        stats.bump(calls=1, bypasses=1,
+                   time_s=time.perf_counter() - t0)
 
 
 def _dispatch_uncached(jfn, inputs, arrays, amp_policy, needs_grad,

@@ -171,6 +171,17 @@ define_flag("metrics_report_interval_s", 0.0,
             "metrics snapshot is handed to the reporter sink on a daemon "
             "thread.  0 (default) = off.  DecodeEngine construction "
             "auto-starts the reporter when the flag is positive")
+define_flag("sanitize", False,
+            "serving sanitizer mode (paddle_tpu.analysis.sanitizer): "
+            "warm retraces RAISE instead of counting, donated step "
+            "buffers are tombstoned after every jitted call and any "
+            "later host access raises naming the donation site, the "
+            "designated telemetry locks record acquisition order (a "
+            "lock-order cycle fails at the acquisition that would have "
+            "deadlocked), KVBlockPool.assert_consistent runs at every "
+            "DecodeEngine step boundary, and blocking device syncs "
+            "inside the step span are counted.  Debug/CI only — adds "
+            "host-side cost per step and per lock acquisition")
 define_flag("use_rbg_rng", True,
             "on TPU, use the hardware RBG PRNG for the framework's random "
             "ops instead of threefry (measured: recovers ~60% of dropout's "

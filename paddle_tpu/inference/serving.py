@@ -94,6 +94,7 @@ __all__ = ["KVBlockPool", "Request", "DecodeEngine", "sample_logits",
 # ---------------------------------------------------------------------------
 from ..profiler import (DECODE_STAT_COUNTERS, _decode_stat_zero)
 from .. import observability as _obs
+from ..analysis import sanitizer as _san
 from ..observability import LOCK as _TELEMETRY_LOCK
 
 _STATS = {k: _decode_stat_zero(k) for k in DECODE_STAT_COUNTERS}
@@ -176,30 +177,78 @@ def _fold_counter(counter: int, domain: int) -> int:
 
 
 class _JitTracker:
-    """Retrace telemetry for one jitted step executable.  Counts ACTUAL
-    XLA compiles (the jit's own trace-cache size) — a dtype/weak_type
-    flapping in the step operands would recompile inside the same jitted
-    wrapper and must not go unnoticed.  Growth after the first call
-    lands in ``retraces_after_warmup``; the contract covers the decode
-    step AND the speculative draft/verify executables
-    (inference.speculative) identically."""
+    """Retrace telemetry + donation tracking for one jitted step
+    executable.  Counts ACTUAL XLA compiles (the jit's own trace-cache
+    size) — a dtype/weak_type flapping in the step operands would
+    recompile inside the same jitted wrapper and must not go unnoticed.
+    Growth after the first call lands in ``retraces_after_warmup``; the
+    contract covers the decode step AND the speculative draft/verify
+    executables (inference.speculative) identically.
 
-    def __init__(self, fn, compile_key):
-        self.fn = fn
+    Invoke the tracker itself (``tracker(*args)``) rather than
+    ``tracker.fn``: the call path runs the retrace check after every
+    invocation, and under FLAGS_sanitize additionally (a) rejects any
+    argument that was DONATED to an earlier tracked call (use-after-
+    donate, the error names the donation site), (b) tombstones this
+    call's ``donate_argnums`` arguments afterwards — on backends that
+    silently ignore donation only the sanitizer makes the "donated
+    buffers are dead" contract observable before TPU does — and (c)
+    raises `WarmRetraceError` instead of counting a warm retrace."""
+
+    def __init__(self, fn, compile_key, donate_argnums=(), site=None):
+        """``fn`` is the PYTHON step callable: the tracker owns the
+        ``jax.jit`` wrapping so ``donate_argnums`` has exactly ONE
+        source of truth — the tuple XLA donates and the tuple the
+        sanitizer tombstones can never drift apart.  (A pre-jitted
+        callable is accepted for tests; it must carry no donation or
+        the tombstones would not match.)"""
+        self.donate_argnums = tuple(donate_argnums)
+        is_jitted = hasattr(fn, "lower")  # PjitFunction duck-type
+        self.fn = fn if is_jitted else \
+            jax.jit(fn, donate_argnums=self.donate_argnums)
+        if is_jitted and self.donate_argnums:
+            raise ValueError(
+                "pass the un-jitted callable when donate_argnums is "
+                "set: _JitTracker owns the jax.jit so the donated and "
+                "tombstoned argument sets cannot drift")
+        self.site = site or compile_key
         self._seen = 0
         self._warm = False
         _stats_add(**{compile_key: 1})
 
+    def __call__(self, *args):
+        san = _san.active()
+        if san is not None:
+            for a in args:
+                san.check_live(a, context=f"argument of {self.site}")
+        out = self.fn(*args)
+        self.check_retrace()
+        if san is not None:
+            for i in self.donate_argnums:
+                if i < len(args):
+                    san.tombstone(args[i], self.site)
+        return out
+
     def check_retrace(self):
-        """Call after every invocation of ``fn``."""
+        """Runs after every invocation (``__call__`` does it)."""
         try:
             n = self.fn._cache_size()
         except AttributeError:  # older jax without _cache_size
             n = 1
-        if self._warm and n > self._seen:
-            _stats_add(retraces_after_warmup=n - self._seen)
+        grew = n - self._seen if self._warm else 0
+        was = self._seen
         self._seen = n
         self._warm = True
+        if grew > 0:
+            san = _san.active()
+            if san is not None:
+                san.count_warm_retrace(grew)
+                raise _san.WarmRetraceError(
+                    f"warm retrace of {self.site}: the executable "
+                    f"cache grew {was} -> {n} after warmup — a step "
+                    f"operand's shape/dtype/weak_type changed "
+                    f"mid-serve")
+            _stats_add(retraces_after_warmup=grew)
 
 
 # ---------------------------------------------------------------------------
@@ -1234,17 +1283,18 @@ class DecodeEngine:
 
         fn = self._prefill_fns.get(bucket)
         if fn is None:
-            fn = jax.jit(
+            # prefill buckets compile on first use by design (a new
+            # prompt-length bucket is an expected warmup event, not a
+            # steady-state retrace) — only per-bucket recompiles count
+            # toward retraces_after_warmup
+            fn = _JitTracker(
                 functools.partial(_gpt_prefill, num_heads=self._num_heads,
                                   head_dim=self._head_dim, eps=self._eps,
                                   **self._sampling),
-                donate_argnums=(4, 5))
+                "prefill_compiles", donate_argnums=(4, 5),
+                site=f"DecodeEngine prefill bucket {bucket} "
+                     f"(_gpt_prefill)")
             self._prefill_fns[bucket] = fn
-            # prefill buckets compile on first use by design (a new
-            # prompt-length bucket is an expected warmup event, not a
-            # steady-state retrace) — only decode-step recompiles count
-            # toward retraces_after_warmup
-            _stats_add(prefill_compiles=1)
         t0 = time.perf_counter()
         t0_ns = _obs.now_ns()
         # prefill keys live in the upper fold_in window (decode steps
@@ -1260,7 +1310,7 @@ class DecodeEngine:
             self._params, jnp.asarray(ids), jnp.int32(p_len),
             jnp.asarray(self._bt[slot]), self._k_pages, self._v_pages,
             key)
-        tok = int(tok)
+        tok = int(self._host_fetch(tok))
         _stats_add(prefill_time_s=time.perf_counter() - t0,
                    prefills=1, tokens=1)
         self._stamp_first_token(req, prompt_len=p_len, bucket=bucket)
@@ -1598,12 +1648,13 @@ class DecodeEngine:
     def _mixed_fn_tracker(self) -> _JitTracker:
         fn = self._mixed_fn
         if fn is None:
-            fn = self._mixed_fn = _JitTracker(jax.jit(
+            fn = self._mixed_fn = _JitTracker(
                 functools.partial(_gpt_mixed_step,
                                   num_heads=self._num_heads,
                                   head_dim=self._head_dim, eps=self._eps,
                                   **self._sampling),
-                donate_argnums=(1, 2)), "mixed_compiles")
+                "mixed_compiles", donate_argnums=(1, 2),
+                site="DecodeEngine mixed step (_gpt_mixed_step)")
         return fn
 
     def _mixed_step(self, decode_rows=True) -> bool:
@@ -1661,14 +1712,13 @@ class DecodeEngine:
         t0 = time.perf_counter()
         t0_ns = _obs.now_ns()
         with RecordEvent("serving.mixed_step"):
-            self._k_pages, self._v_pages, toks = fn.fn(
+            self._k_pages, self._v_pages, toks = fn(
                 self._params, self._k_pages, self._v_pages,
                 jnp.asarray(self._bt), jnp.asarray(self._lens),
                 jnp.asarray(tokens), jnp.asarray(caps),
                 jnp.asarray(sample_idx), jnp.asarray(sample_mask), key)
-            toks = np.asarray(toks)
+            toks = self._host_fetch(toks)
         dt = time.perf_counter() - t0
-        fn.check_retrace()
 
         # the drafter sees the SAME chunks through the same executable
         # shape (speculative path: caps carry only prompt chunks there)
@@ -1739,13 +1789,25 @@ class DecodeEngine:
             self._finish(slot, reason)
 
     def _debug_check_pool(self):
-        """FLAGS_kv_pool_debug: full pool-consistency audit at an
-        engine idle point (between steps, no device call in flight) —
-        every live request's page list cross-checked against the pool's
-        free/private/cached partition and refcounts."""
+        """FLAGS_kv_pool_debug / FLAGS_sanitize: full pool-consistency
+        audit at an engine idle point (between steps, no device call in
+        flight) — every live request's page list cross-checked against
+        the pool's free/private/cached partition and refcounts."""
         self.pool.assert_consistent(
             live_pages=[p for r in self._by_slot if r is not None
                         for p in r.pages])
+
+    def _host_fetch(self, x):
+        """THE engine's blocking device->host read.  Every place the
+        serve loop materializes device data (sampled tokens, verify
+        targets) routes through here so the sanitizer's host-sync
+        sentinel (FLAGS_sanitize) can count blocking syncs inside the
+        step span — a step that silently grew a second sync shows up as
+        ``host_syncs > steps`` in `analysis.sanitizer.get().report()`."""
+        san = _san.active()
+        if san is not None:
+            san.count_host_sync()
+        return np.asarray(x)
 
     # -- the serve loop ------------------------------------------------------
     def step(self) -> bool:
@@ -1756,7 +1818,13 @@ class DecodeEngine:
         Returns False when there is nothing left to do."""
         from ..profiler import RecordEvent
 
-        if self._pool_debug:
+        san = _san.active()
+        if san is not None:
+            # sanitizer mode: audit the pool partition every step and
+            # open the step's host-sync accounting window
+            san.count_step()
+            self._debug_check_pool()
+        elif self._pool_debug:
             self._debug_check_pool()
         self._admit()
         # admission-pressure gauges, sampled every step AFTER admission
@@ -1777,12 +1845,13 @@ class DecodeEngine:
 
         fn = self._decode_fn
         if fn is None:
-            fn = self._decode_fn = _JitTracker(jax.jit(
+            fn = self._decode_fn = _JitTracker(
                 functools.partial(_gpt_decode_step,
                                   num_heads=self._num_heads,
                                   head_dim=self._head_dim, eps=self._eps,
                                   **self._sampling),
-                donate_argnums=(1, 2)), "decode_compiles")
+                "decode_compiles", donate_argnums=(1, 2),
+                site="DecodeEngine decode step (_gpt_decode_step)")
 
         self._step_no += 1
         key = jax.random.fold_in(
@@ -1790,13 +1859,12 @@ class DecodeEngine:
         t0 = time.perf_counter()
         t0_ns = _obs.now_ns()
         with RecordEvent("serving.decode_step"):
-            self._k_pages, self._v_pages, toks = fn.fn(
+            self._k_pages, self._v_pages, toks = fn(
                 self._params, self._k_pages, self._v_pages,
                 jnp.asarray(self._bt), jnp.asarray(self._lens),
                 jnp.asarray(self._last), jnp.asarray(self._active), key)
-            toks = np.asarray(toks)
+            toks = self._host_fetch(toks)
         dt = time.perf_counter() - t0
-        fn.check_retrace()
 
         n_active = int(self._active.sum())
         _stats_add(steps=1, decode_time_s=dt, tokens=n_active,

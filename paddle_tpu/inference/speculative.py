@@ -311,18 +311,20 @@ class DraftModelDrafter(Drafter):
                       top_p=1.0)
         self._greedy = greedy
         self._chunk_fn = None  # chunked prefill ingest (lazy)
-        self._catch_fn = _JitTracker(jax.jit(
+        self._catch_fn = _JitTracker(
             functools.partial(_gpt_spec_verify,
                               num_heads=self._num_heads,
                               head_dim=self._head_dim, eps=self._eps,
                               **greedy),
-            donate_argnums=(1, 2)), "draft_compiles")
-        self._step_fn = _JitTracker(jax.jit(
+            "draft_compiles", donate_argnums=(1, 2),
+            site="DraftModelDrafter catch-up (_gpt_spec_verify)")
+        self._step_fn = _JitTracker(
             functools.partial(_gpt_decode_step,
                               num_heads=self._num_heads,
                               head_dim=self._head_dim, eps=self._eps,
                               **greedy),
-            donate_argnums=(1, 2)), "draft_compiles")
+            "draft_compiles", donate_argnums=(1, 2),
+            site="DraftModelDrafter step (_gpt_decode_step)")
         self._prefill_fns = {}
 
     # -- request lifecycle --------------------------------------------------
@@ -349,20 +351,21 @@ class DraftModelDrafter(Drafter):
         ids[0, :p_len] = req.prompt_ids
         fn = self._prefill_fns.get(bucket)
         if fn is None:
-            fn = _JitTracker(jax.jit(
+            fn = _JitTracker(
                 functools.partial(_gpt_prefill,
                                   num_heads=self._num_heads,
                                   head_dim=self._head_dim, eps=self._eps,
                                   sampler="greedy", temperature=1.0,
                                   top_k=0, top_p=1.0),
-                donate_argnums=(4, 5)), "draft_compiles")
+                "draft_compiles", donate_argnums=(4, 5),
+                site=f"DraftModelDrafter prefill bucket {bucket} "
+                     f"(_gpt_prefill)")
             self._prefill_fns[bucket] = fn
         t0 = time.perf_counter()
-        self._k_pages, self._v_pages, _ = fn.fn(
+        self._k_pages, self._v_pages, _ = fn(
             self._params, jnp.asarray(ids), jnp.int32(p_len),
             jnp.asarray(eng._bt[slot]), self._k_pages, self._v_pages,
             eng._key)
-        fn.check_retrace()
         _stats_add(draft_time_s=time.perf_counter() - t0)
         self._lens[slot] = p_len
 
@@ -378,21 +381,21 @@ class DraftModelDrafter(Drafter):
         eng = self.engine
         fn = self._chunk_fn
         if fn is None:
-            fn = self._chunk_fn = _JitTracker(jax.jit(
+            fn = self._chunk_fn = _JitTracker(
                 functools.partial(_gpt_mixed_step,
                                   num_heads=self._num_heads,
                                   head_dim=self._head_dim, eps=self._eps,
                                   **self._greedy),
-                donate_argnums=(1, 2)), "draft_compiles")
+                "draft_compiles", donate_argnums=(1, 2),
+                site="DraftModelDrafter chunk ingest (_gpt_mixed_step)")
         caps = np.asarray(caps, np.int32)
         t0 = time.perf_counter()
-        self._k_pages, self._v_pages, _ = fn.fn(
+        self._k_pages, self._v_pages, _ = fn(
             self._params, self._k_pages, self._v_pages,
             jnp.asarray(eng._bt), jnp.asarray(self._lens),
             jnp.asarray(tokens), jnp.asarray(caps),
             jnp.zeros(eng._slots, jnp.int32),
             jnp.zeros(eng._slots, bool), eng._key)
-        fn.check_retrace()
         _stats_add(draft_time_s=time.perf_counter() - t0)
         self._lens = self._lens + caps
 
@@ -423,12 +426,11 @@ class DraftModelDrafter(Drafter):
             catch[s, :pend] = full[self._lens[s]: self._lens[s] + pend]
             caps[s] = pend
         bt = jnp.asarray(eng._bt)  # invariant across the round
-        self._k_pages, self._v_pages, targets = self._catch_fn.fn(
+        self._k_pages, self._v_pages, targets = self._catch_fn(
             self._params, self._k_pages, self._v_pages,
             bt, jnp.asarray(self._lens),
             jnp.asarray(catch), jnp.asarray(caps), eng._key)
-        self._catch_fn.check_retrace()
-        targets = np.asarray(targets)
+        targets = eng._host_fetch(targets)
         self._lens[active] += caps[active]
         cur = np.where(
             active,
@@ -446,12 +448,11 @@ class DraftModelDrafter(Drafter):
             step_active = active & (i <= write_caps - 1)
             if not step_active.any():
                 break
-            self._k_pages, self._v_pages, nxt = self._step_fn.fn(
+            self._k_pages, self._v_pages, nxt = self._step_fn(
                 self._params, self._k_pages, self._v_pages,
                 bt, jnp.asarray(self._lens),
                 jnp.asarray(cur), jnp.asarray(step_active), eng._key)
-            self._step_fn.check_retrace()
-            nxt = np.asarray(nxt).astype(np.int32)
+            nxt = eng._host_fetch(nxt).astype(np.int32)
             self._lens[step_active] += 1
             cur = np.where(step_active, nxt, cur).astype(np.int32)
             drafts[:, i] = np.where(step_active, nxt, 0)
@@ -567,12 +568,13 @@ class SpeculativeDecoder:
 
         fn = self._verify_fn
         if fn is None:
-            fn = self._verify_fn = _JitTracker(jax.jit(
+            fn = self._verify_fn = _JitTracker(
                 functools.partial(_gpt_spec_verify,
                                   num_heads=eng._num_heads,
                                   head_dim=eng._head_dim, eps=eng._eps,
                                   **eng._sampling),
-                donate_argnums=(1, 2)), "verify_compiles")
+                "verify_compiles", donate_argnums=(1, 2),
+                site="SpeculativeDecoder verify (_gpt_spec_verify)")
 
         tokens = np.concatenate(
             [eng._last[:, None].astype(np.int32), drafts], axis=1)
@@ -582,13 +584,12 @@ class SpeculativeDecoder:
         t0 = time.perf_counter()
         tv_ns = _obs.now_ns()
         with RecordEvent("serving.spec_verify_step"):
-            eng._k_pages, eng._v_pages, targets = fn.fn(
+            eng._k_pages, eng._v_pages, targets = fn(
                 eng._params, eng._k_pages, eng._v_pages,
                 jnp.asarray(eng._bt), jnp.asarray(eng._lens),
                 jnp.asarray(tokens), jnp.asarray(caps), key)
-            targets = np.asarray(targets)
+            targets = eng._host_fetch(targets)
         t_verify = time.perf_counter() - t0
-        fn.check_retrace()
         _obs.record_span("engine", "verify", tv_ns, int(t_verify * 1e9),
                          tid=eng._engine_id, args={"k": self.k})
 

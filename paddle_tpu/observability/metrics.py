@@ -44,8 +44,13 @@ __all__ = [
 
 # THE telemetry lock: every registry series mutation, every
 # serving._STATS read-modify-write, and every atomic read+reset
-# (decode_stats(reset=True)) happens under this one RLock.
-LOCK = threading.RLock()
+# (decode_stats(reset=True)) happens under this one RLock.  Wrapped in
+# the sanitizer's TrackedLock so FLAGS_sanitize can record acquisition
+# order (and fail lock-order cycles) without a second lock type; when
+# the sanitizer is off the wrapper costs one dict lookup.
+from ..analysis.sanitizer import TrackedLock as _TrackedLock
+
+LOCK = _TrackedLock(threading.RLock(), "observability.LOCK")
 
 # enabled is a module-level switch (not per-registry) so the hot-path
 # check is one dict lookup shared by metrics and span tracing
@@ -53,11 +58,13 @@ _state = {"enabled": True}
 
 
 def enable():
-    _state["enabled"] = True
+    with LOCK:
+        _state["enabled"] = True
 
 
 def disable():
-    _state["enabled"] = False
+    with LOCK:
+        _state["enabled"] = False
 
 
 def enabled() -> bool:
@@ -150,8 +157,11 @@ class Counter(_Metric):
             return self._series.get(self._labels_key(labels), 0)
 
     def _reset(self):
-        for k in self._series:
-            self._series[k] = 0
+        # LOCK is an RLock: safe both standalone and under
+        # MetricRegistry.reset's own hold
+        with LOCK:
+            for k in self._series:
+                self._series[k] = 0
 
     def _collect(self):
         return Sample(self.name, self.kind, self.help, self.label_names,
@@ -179,8 +189,9 @@ class Gauge(_Metric):
             return self._series.get(self._labels_key(labels), 0.0)
 
     def _reset(self):
-        for k in self._series:
-            self._series[k] = 0.0
+        with LOCK:
+            for k in self._series:
+                self._series[k] = 0.0
 
     _collect = Counter._collect
 
@@ -233,10 +244,11 @@ class Histogram(_Metric):
                     "sum": s.sum, "count": s.count}
 
     def _reset(self):
-        for s in self._series.values():
-            s.counts = [0] * (len(self.buckets) + 1)
-            s.sum = 0.0
-            s.count = 0
+        with LOCK:
+            for s in self._series.values():
+                s.counts = [0] * (len(self.buckets) + 1)
+                s.sum = 0.0
+                s.count = 0
 
     def _collect(self):
         series = [(k, {"buckets": self.buckets, "counts": list(s.counts),

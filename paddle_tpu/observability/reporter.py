@@ -14,13 +14,14 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from ..analysis.sanitizer import TrackedLock as _TrackedLock
 from ..core import flags as _flags
 from . import metrics as _metrics
 
 __all__ = ["start_reporter", "stop_reporter", "reporter_running",
            "maybe_start_reporter"]
 
-_lock = threading.Lock()
+_lock = _TrackedLock(threading.Lock(), "reporter._lock")
 _thread: Optional[threading.Thread] = None
 _stop: Optional[threading.Event] = None
 

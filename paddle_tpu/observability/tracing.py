@@ -25,6 +25,7 @@ import os
 import threading
 from typing import Optional
 
+from ..analysis.sanitizer import TrackedLock as _TrackedLock
 from ..core import native
 from .metrics import _state
 
@@ -38,7 +39,7 @@ HOST_TRACK = "host"
 # without bound; beyond the cap spans are counted, not stored
 MAX_SPANS = 200_000
 
-_lock = threading.Lock()
+_lock = _TrackedLock(threading.Lock(), "tracing._lock")
 _spans: list = []
 _dropped = [0]
 

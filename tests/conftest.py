@@ -36,3 +36,33 @@ def _seed():
     paddle_tpu.seed(42)
     np.random.seed(42)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "sanitize: run under FLAGS_sanitize=1 (paddle_tpu.analysis."
+        "sanitizer): warm retraces raise, donated buffers tombstone, "
+        "lock order is recorded, the KV pool is audited every step")
+
+
+@pytest.fixture(autouse=True)
+def _sanitize_marker(request):
+    """Tests marked @pytest.mark.sanitize run with the runtime
+    sanitizer armed; its state is reset on both sides so one test's
+    tombstones/lock edges can never fail another."""
+    if request.node.get_closest_marker("sanitize") is None:
+        yield
+        return
+    import paddle_tpu
+    from paddle_tpu.analysis import sanitizer
+    from paddle_tpu.core import flags as _flags
+
+    prior = bool(_flags.flag("sanitize"))  # honor a suite-wide opt-in
+    paddle_tpu.set_flags({"sanitize": True})
+    sanitizer.reset()
+    try:
+        yield
+    finally:
+        paddle_tpu.set_flags({"sanitize": prior})
+        sanitizer.reset()

@@ -339,6 +339,49 @@ def test_telemetry_dump_smoke(tmp_path):
     assert any(e.get("name") == "prefill" for e in trace["traceEvents"])
 
 
+def test_tracecheck_smoke(tmp_path):
+    """tools/tracecheck.py end-to-end: the serving-stack targets scan
+    CLEAN against the shipped (empty) baseline — the ISSUE-8
+    acceptance gate — a seeded-bad fixture exits 1 with the finding
+    printed, and the --write-baseline grandfather workflow
+    round-trips."""
+    r = subprocess.run(
+        [sys.executable, "tools/tracecheck.py"], cwd=REPO,
+        capture_output=True, text=True, env=ENV, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+    # a seeded trace hazard + missing donation must be caught...
+    bad = tmp_path / "bad_mod.py"
+    bad.write_text(
+        "import jax\n\n"
+        "def step(params, k_pages, v_pages, x):\n"
+        "    if x > 0:\n"
+        "        return k_pages, v_pages, int(x)\n"
+        "    return k_pages, v_pages, 0\n\n"
+        "fn = jax.jit(step)\n")
+    r = subprocess.run(
+        [sys.executable, "tools/tracecheck.py", str(bad),
+         "--no-baseline"], cwd=REPO, capture_output=True, text=True,
+        env=ENV, timeout=300)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "[trace-hazard]" in r.stdout and "[donation]" in r.stdout
+
+    # ...and --write-baseline grandfathers exactly those findings
+    bl = str(tmp_path / "bl.json")
+    w = subprocess.run(
+        [sys.executable, "tools/tracecheck.py", str(bad),
+         "--baseline", bl, "--write-baseline"],
+        cwd=REPO, capture_output=True, text=True, env=ENV, timeout=300)
+    assert w.returncode == 0, w.stdout + w.stderr
+    clean = subprocess.run(
+        [sys.executable, "tools/tracecheck.py", str(bad),
+         "--baseline", bl], cwd=REPO, capture_output=True, text=True,
+        env=ENV, timeout=300)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert "baselined" in clean.stdout
+
+
 def test_op_bench_gate_device_mismatch(tmp_path):
     """Cross-device comparisons are incommensurable (a CPU run vs a TPU
     baseline); the checker must refuse rather than mis-gate."""
