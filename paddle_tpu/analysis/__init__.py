@@ -89,8 +89,13 @@ REPO_LOCK_RULES: Dict[str, LockRule] = {
 
 # DecodeEngine is single-threaded by contract: every mutation happens
 # between steps on the driver.  serving.py / speculative.py ARE the
-# engine; in frontend.py only the schedulers (engine-called, between
-# steps) and the driver's control-application points may mutate.
+# engine; resilience.py is the containment ladder + crash recovery
+# (engine-called between steps, and recovery mutates the engine
+# between steps BY DESIGN — the fold/re-admit in `recover` and the
+# bisect-quarantine preempt/retire in `ResilienceManager` are
+# sanctioned recovery sites); in frontend.py only the schedulers
+# (engine-called, between steps), the driver's control-application
+# points, and the driver's recovery supervision may mutate.
 REPO_ENGINE_RULE = EngineRule(
     mutators=(
         "add_request", "evict", "preempt", "step", "run", "generate",
@@ -99,14 +104,21 @@ REPO_ENGINE_RULE = EngineRule(
         "_retire_queued", "_grow_block_tables", "_mixed_step",
         "_stamp_admit", "_stamp_first_token", "_on_first_token",
         "_register_prompt_pages", "_debug_check_pool",
+        # fault containment / recovery (inference.resilience): the
+        # ladder's retry unit, slot quarantine, and admission unwind
+        # mutate the engine — callable only from sanctioned sites
+        "_step_inner", "_quarantine_slot", "_unwind_failed_admit",
+        "_release_slot",
     ),
     receivers=("eng", "engine", "self.engine", "self._engine"),
     sanctioned={
         "inference/serving.py": ("*",),
         "inference/speculative.py": ("*",),
+        "inference/resilience.py": ("*",),
         "inference/frontend.py": (
             "Scheduler.", "FIFOScheduler.", "SLOScheduler.",
             "ServingFrontend._apply_control", "ServingFrontend._drive",
+            "ServingFrontend._recover_engine",
         ),
     },
 )

@@ -182,6 +182,47 @@ define_flag("sanitize", False,
             "DecodeEngine step boundary, and blocking device syncs "
             "inside the step span are counted.  Debug/CI only — adds "
             "host-side cost per step and per lock acquisition")
+define_flag("fault_inject", "",
+            "arm the serving fault-injection harness "
+            "(inference.resilience.FaultPlan.parse): a "
+            "';'-separated list of site@occurrences entries — e.g. "
+            "'step@3,5;pool@2-4;drafter@1' injects a step-executable "
+            "raise at the 3rd and 5th consult of the step site, pool "
+            "exhaustion at alloc consults 2..4, and a drafter raise at "
+            "its 1st consult — plus 'poison@TOKEN' (every step fails "
+            "while a request whose prompt contains TOKEN is in the "
+            "batch; the bisect containment must find it).  "
+            "Deterministic: occurrence counters, never wall-clock.  "
+            "Empty (default) = off, zero hooks on the hot path.  "
+            "Engines constructed with an explicit fault_plan= ignore "
+            "the flag")
+define_flag("step_retries", 2,
+            "same-step retries of a failed step executable before the "
+            "containment ladder escalates (degrade the failing "
+            "subsystem, then bisect-quarantine the suspect request; "
+            "see docs/RELIABILITY.md).  Each retry backs off "
+            "exponentially in deterministic ticks (1, 2, 4, ... capped "
+            "at 8) and sleeps tick * FLAGS_step_backoff_ms")
+define_flag("step_backoff_ms", 0.0,
+            "wall-clock milliseconds per backoff tick between step "
+            "retries (0 = count ticks but never sleep — the "
+            "deterministic default tier-1 tests rely on)")
+define_flag("degrade_after", 3,
+            "consecutive failures of one subsystem (speculative "
+            "drafter/verify, mixed prefill+decode executable) before "
+            "the engine degrades it away — speculation disables, "
+            "chunked prefill falls back to the legacy one-shot "
+            "prefill oracle path (paddle_degraded_mode gauge flips)")
+define_flag("degraded_probe_steps", 16,
+            "clean engine steps in degraded mode before the engine "
+            "probes re-enabling the degraded subsystem (speculation / "
+            "chunked prefill); a fresh failure degrades it again")
+define_flag("engine_recoveries", 2,
+            "engine rebuilds (inference.resilience.recover: fresh "
+            "engine, every in-flight request re-admitted with its "
+            "generated tokens folded into the prompt for replay) the "
+            "frontend driver / serve_with_recovery may spend before "
+            "declaring the fault unrecoverable (DegradedMode)")
 define_flag("use_rbg_rng", True,
             "on TPU, use the hardware RBG PRNG for the framework's random "
             "ops instead of threefry (measured: recovers ~60% of dropout's "

@@ -1,0 +1,107 @@
+"""Typed error taxonomy for the serving stack.
+
+Before this module, serving failures were bare ``RuntimeError``s — a
+caller (or the scheduler) could not tell "the KV pool is momentarily
+full" (back off, stay queued) from "the step executable is broken"
+(contain, quarantine, recover) without string-matching messages.  The
+taxonomy makes the distinction typed:
+
+* `ServingError` — base class of every serving-stack failure;
+* `PoolExhausted` — `KVBlockPool.alloc_page` found neither a free nor
+  an evictable page.  During ADMISSION the scheduler treats this as
+  "stay queued" (the request waits for capacity, nothing crashes);
+  mid-step it enters the containment ladder (`inference.resilience`)
+  where quarantining a request frees pages;
+* `StepFault` — a step executable (decode / mixed / verify / drafter)
+  raised.  Carries the fault ``site`` and attempt count; raised as
+  FATAL only after the whole containment ladder (retry -> degrade ->
+  bisect-quarantine) is exhausted;
+* `InjectedFault` — a `FaultPlan` fired (FLAGS_fault_inject); subclass
+  of `StepFault` so every recovery path handles injected and organic
+  faults identically — which is the point of the harness;
+* `DegradedMode` — an operation needed a subsystem the engine has
+  degraded away (e.g. crash recovery exhausted its rebuild budget).
+
+All of them subclass ``RuntimeError`` so pre-taxonomy callers that
+caught ``RuntimeError`` keep working unchanged.
+
+`FaultInfo` is the structured terminal record a faulted request
+carries (`Request.fault_info`, surfaced on
+`inference.frontend.TokenStream.fault_info`): the fault site, how many
+containment attempts were spent, and whether the engine recovered —
+instead of a bare exception unwinding through a token iterator.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["ServingError", "PoolExhausted", "StepFault", "InjectedFault",
+           "DegradedMode", "FaultInfo"]
+
+
+class ServingError(RuntimeError):
+    """Base class of every typed serving-stack failure."""
+
+
+class PoolExhausted(ServingError):
+    """The KV page pool has neither a free nor an evictable page.
+
+    Admission treats this as backpressure (the request stays queued);
+    inside a step it is containable — quarantining or preempting a
+    request frees its pages."""
+
+
+class StepFault(ServingError):
+    """A step executable failed.  ``site`` names the failing
+    executable/hook (see `inference.resilience.FAULT_SITES`);
+    ``attempts`` counts containment attempts already spent when the
+    fault was (re-)raised; ``fatal`` marks a fault that survived the
+    whole containment ladder — the engine itself is suspect and only
+    crash recovery (`inference.resilience.recover`) can continue."""
+
+    def __init__(self, message: str, site: str = "step",
+                 attempts: int = 0, fatal: bool = False):
+        super().__init__(message)
+        self.site = site
+        self.attempts = int(attempts)
+        self.fatal = bool(fatal)
+
+
+class InjectedFault(StepFault):
+    """A `FaultPlan` fired at a named site (FLAGS_fault_inject).
+    Subclasses `StepFault` so containment cannot special-case injected
+    faults — the harness proves the real recovery paths."""
+
+
+class DegradedMode(ServingError):
+    """An operation required a subsystem the engine has degraded away,
+    or a degradation budget (e.g. FLAGS_engine_recoveries) ran out."""
+
+
+@dataclass
+class FaultInfo:
+    """Structured terminal state of a faulted (or fault-recovered)
+    request — `Request.fault_info` / `TokenStream.fault_info`.
+
+    ``site``: where the fault hit (containment ladder site name);
+    ``attempts``: containment attempts spent on this request's behalf;
+    ``step``: the engine step number the verdict landed on;
+    ``recovered``: True when the request SURVIVED (e.g. it rode an
+    engine rebuild and finished normally), False when it was
+    quarantined (``finish_reason == "fault"``);
+    ``message``: human-readable detail (the triggering exception)."""
+
+    site: str
+    attempts: int = 0
+    step: int = 0
+    recovered: bool = False
+    message: str = ""
+    # fault sites this request saw before the verdict (a request can
+    # ride several recoveries before finishing)
+    history: List[str] = field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return {"site": self.site, "attempts": self.attempts,
+                "step": self.step, "recovered": self.recovered,
+                "message": self.message, "history": list(self.history)}

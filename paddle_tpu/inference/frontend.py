@@ -396,6 +396,17 @@ class TokenStream:
         return self.request.finish_reason
 
     @property
+    def fault_info(self):
+        """Structured fault record (`inference.errors.FaultInfo`) when
+        the request was quarantined (``finish_reason == "fault"``),
+        rode an engine recovery (``recovered=True`` — it still
+        finished normally), or had its callback dropped; None for a
+        fault-free request.  The stream itself never raises
+        mid-iteration for an engine fault: it ends, and the terminal
+        state is read here."""
+        return self.request.fault_info
+
+    @property
     def generated_ids(self) -> List[int]:
         return self.request.generated_ids
 
@@ -431,7 +442,8 @@ class ServingFrontend:
     """
 
     def __init__(self, engine, max_queue_depth: int = 64,
-                 stream_buffer: int = 256, step_in_thread: bool = True):
+                 stream_buffer: int = 256, step_in_thread: bool = True,
+                 max_recoveries: Optional[int] = None):
         if max_queue_depth < 1:
             raise ValueError(
                 f"max_queue_depth must be >= 1, got {max_queue_depth}")
@@ -442,6 +454,12 @@ class ServingFrontend:
         self.max_queue_depth = int(max_queue_depth)
         self.stream_buffer = int(stream_buffer)
         self._step_in_thread = bool(step_in_thread)
+        # crash recovery budget (None = FLAGS_engine_recoveries): how
+        # many times the driver may rebuild a fatally faulted engine
+        # (inference.resilience.recover) before giving up and failing
+        # the open streams
+        self.max_recoveries = max_recoveries
+        self._recoveries = 0
         self._streams: dict = {}  # request -> TokenStream (open only)
         self._control: list = []  # (action, payload, future)
         self._wake: Optional[asyncio.Event] = None
@@ -630,8 +648,33 @@ class ServingFrontend:
         eng = self.engine
         return bool(eng._queue) or bool(eng._active.any())
 
+    def _recover_engine(self, fault) -> bool:
+        """Supervision: a step fault survived the engine's whole
+        containment ladder — rebuild the engine
+        (`inference.resilience.recover`, which snapshots the dead
+        engine's host state: the fatal raise happens at a between-
+        steps-consistent boundary, with every emitted token already
+        recorded on its request) and keep every open stream alive:
+        the same `Request` objects re-admit with their generated
+        tokens folded into the replay prompt, so the ``on_token``
+        hooks keep feeding the same `TokenStream`s and no already-
+        emitted token is ever re-emitted.  False once the recovery
+        budget (``max_recoveries`` / FLAGS_engine_recoveries) is
+        spent — the caller lets the fault fail the frontend."""
+        from ..core import flags as _flags
+        from . import resilience
+
+        limit = int(_flags.flag("engine_recoveries")) \
+            if self.max_recoveries is None else int(self.max_recoveries)
+        if self._recoveries >= limit:
+            return False
+        self._recoveries += 1
+        self.engine = resilience.recover(self.engine, fault=fault)
+        return True
+
     async def _drive(self):
-        eng = self.engine
+        from .errors import StepFault
+
         try:
             while True:
                 self._apply_control()
@@ -654,14 +697,51 @@ class ServingFrontend:
                     if not self._stream_space():
                         await self._drained.wait()
                     continue
-                if self._step_in_thread:
-                    await asyncio.get_running_loop().run_in_executor(
-                        None, eng.step)
-                else:
-                    eng.step()
+                try:
+                    if self._step_in_thread:
+                        await asyncio.get_running_loop() \
+                            .run_in_executor(None, self.engine.step)
+                    else:
+                        self.engine.step()
+                except StepFault as e:
+                    if self._recover_engine(e):
+                        continue
+                    raise
                 self._flush_finished()
                 self._notify_drained()  # queue may have drained: wake
                 # submitters
+        except StepFault as e:
+            # an UNRECOVERED fatal step fault (the recovery budget is
+            # spent — a recovered one was contained above): mark the
+            # terminal state BEFORE the finally ends the streams, so a
+            # consumer reads finish_reason="fault" + a structured
+            # FaultInfo instead of a silently truncated stream (the
+            # exception itself re-raises on close()).  Requests that
+            # ever held a slot (running, or preempted back to the
+            # queue by the containment ladder) died with the engine; a
+            # NEVER-admitted queued request keeps its state — it never
+            # entered the engine, only its stream ends — but records
+            # the fault context too.  Other exception classes
+            # (cancellation, sanitizer invariants, host bugs) fall
+            # straight to the finally: fabricating a fault verdict for
+            # them would misreport what happened.
+            from .errors import FaultInfo
+
+            for req in list(self._streams):
+                if req.state == "done":
+                    continue  # finished normally before the crash
+                if req.fault_info is None:
+                    req.fault_info = FaultInfo(
+                        site=getattr(e, "site", "engine"),
+                        recovered=False,
+                        message="serving driver died; engine recovery "
+                                "budget exhausted")
+                else:
+                    req.fault_info.recovered = False
+                if req.t_admit_ns is not None:
+                    req.state = "done"
+                    req.finish_reason = "fault"
+            raise
         finally:
             # shutdown — clean (drain mode served everything above;
             # cancel mode already retired them) OR an exception out of

@@ -78,6 +78,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import unwrap
 from ..ops.pallas import paged_attention as pa
+from .errors import FaultInfo, PoolExhausted
 
 __all__ = ["KVBlockPool", "Request", "DecodeEngine", "sample_logits",
            "decode_stats", "reset_decode_stats",
@@ -340,7 +341,9 @@ class KVBlockPool:
             del self._refs[p]
             self.evictions += 1
             return p
-        raise RuntimeError("KV page pool exhausted")
+        raise PoolExhausted(
+            "KV page pool exhausted: no free page and every cached "
+            "page is referenced by a live request")
 
     def free_pages(self, pages):
         """Return PRIVATE pages to the free list.  Raises on a page
@@ -499,10 +502,13 @@ class Request:
     ``finish_reason`` records WHY a request left the engine — "eos"
     (hit its eos token), "length" (max_new_tokens exhausted),
     "evicted" (`DecodeEngine.evict`), "cancelled" (`Request.cancel`,
-    queued or running), or "deadline" (its ``deadline_ms`` expired
+    queued or running), "deadline" (its ``deadline_ms`` expired
     while still queued; the SLO scheduler retires it without ever
-    taking a slot) — so callers can tell a completed generation from a
-    truncated one.
+    taking a slot), or "fault" (the containment ladder quarantined it
+    — non-finite logits on its slot, or the batch bisection isolated
+    it as the suspect of a persistent step fault; ``fault_info``
+    carries the structured record) — so callers can tell a completed
+    generation from a truncated one.
 
     Scheduling metadata: ``priority`` (lower = more urgent;
     `PRIORITY_INTERACTIVE` / `PRIORITY_BATCH` name the classes),
@@ -564,6 +570,12 @@ class Request:
         self.output_ids: List[int] = []
         self.state = "queued"
         self.finish_reason: Optional[str] = None
+        # structured fault record (inference.errors.FaultInfo): set when
+        # containment quarantined this request (finish_reason="fault"),
+        # when it rode an engine recovery (recovered=True), or when its
+        # on_token callback raised and was dropped — instead of a bare
+        # exception unwinding through a stream iterator
+        self.fault_info: Optional[FaultInfo] = None
         self.slot: Optional[int] = None
         self.pages: List[int] = []
         # prefix cache (FLAGS_prefix_cache): the leading
@@ -681,6 +693,24 @@ def _logits_of(params, h):
     return jnp.matmul(h, params["wte"].T)
 
 
+# NaN/inf containment sentinel: a sampled-token value no real vocab can
+# produce.  The in-graph guard below replaces the sample of any row
+# whose logits went non-finite with it; the host side quarantines
+# exactly that slot (finish_reason="fault") instead of streaming
+# garbage or killing the batch (inference.resilience).
+NAN_TOKEN = -1
+
+
+def _guard_tokens(logits, tokens):
+    """In-graph NaN/inf detection: rows whose logits are not all
+    finite sample `NAN_TOKEN` instead of whatever argmax-of-NaN
+    returns.  Healthy rows pass through bit-identically, so the guard
+    never perturbs parity; the reduce is one pass over logits the
+    sampler already read."""
+    ok = jnp.isfinite(logits).all(axis=-1)
+    return jnp.where(ok, tokens, NAN_TOKEN)
+
+
 def _gpt_prefill(params, ids, true_len, bt_row, k_pages, v_pages, key, *,
                  num_heads, head_dim, eps, sampler, temperature, top_k,
                  top_p):
@@ -730,7 +760,8 @@ def _gpt_prefill(params, ids, true_len, bt_row, k_pages, v_pages, key, *,
     h_last = _ln(h_last, params["lnf_w"], params["lnf_b"], eps)
     logits = _logits_of(params, h_last).astype(jnp.float32)
     token = sample_logits(logits, sampler=sampler, temperature=temperature,
-                          top_k=top_k, top_p=top_p, key=key)[0]
+                          top_k=top_k, top_p=top_p, key=key)
+    token = _guard_tokens(logits, token)[0]
     return k_pages, v_pages, token
 
 
@@ -776,6 +807,7 @@ def _gpt_decode_step(params, k_pages, v_pages, block_tables, seq_lens,
     logits = _logits_of(params, x).astype(jnp.float32)
     nxt = sample_logits(logits, sampler=sampler, temperature=temperature,
                         top_k=top_k, top_p=top_p, key=key)
+    nxt = _guard_tokens(logits, nxt)
     return k_pages, v_pages, jnp.where(active, nxt, 0)
 
 
@@ -846,6 +878,7 @@ def _gpt_mixed_step(params, k_pages, v_pages, block_tables, seq_lens,
     logits = _logits_of(params, sel).astype(jnp.float32)
     nxt = sample_logits(logits, sampler=sampler, temperature=temperature,
                         top_k=top_k, top_p=top_p, key=key)
+    nxt = _guard_tokens(logits, nxt)
     return k_pages, v_pages, jnp.where(sample_mask, nxt, 0)
 
 
@@ -873,7 +906,7 @@ class DecodeEngine:
                  eos_token_id=None, dtype=None, spec_decode_k=None,
                  drafter=None, chunked_prefill=None,
                  prefill_chunk_tokens=None, prefill_q_max=None,
-                 prefix_cache=None, scheduler=None):
+                 prefix_cache=None, scheduler=None, fault_plan=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -1026,6 +1059,49 @@ class DecodeEngine:
             scheduler = str(_flags.flag("sched_policy"))
         self._scheduler = make_scheduler(scheduler)
         self._scheduler.bind(self)
+
+        # fault injection + containment (inference.resilience):
+        # explicit arg wins (a FaultPlan or a spec string), else
+        # FLAGS_fault_inject.  The manager owns the containment ladder
+        # `step()` runs under (retry -> degrade -> bisect-quarantine)
+        # and the degraded-mode state; with no plan armed every hook is
+        # a single `is None` check.
+        from .resilience import FaultPlan, ResilienceManager
+
+        if fault_plan is None:
+            fault_plan = FaultPlan.parse(str(_flags.flag("fault_inject")))
+        elif isinstance(fault_plan, str):
+            fault_plan = FaultPlan.parse(fault_plan)
+        self._fault = fault_plan
+        self._resilience = ResilienceManager(self)
+        # construction-time config the degradation ladder may flip at
+        # runtime (legacy fallback) and the re-enable probe restores
+        self._chunked_cfg = self._chunked
+        self._prefix_cache_cfg = self._prefix_cache
+
+        # everything `resilience.recover` needs to rebuild THIS engine
+        # after a fatal fault: the resolved construction config (flag
+        # lookups already applied, so a flag flip mid-serve cannot
+        # change the rebuilt engine).  Scheduler/drafter instances are
+        # reused — recover() unbinds them first and retires the old
+        # engine; the fault plan keeps its occurrence counters so an
+        # injected schedule never re-fires after the rebuild.
+        self._ctor = dict(
+            model=model, max_batch_size=self._slots,
+            max_seq_len=self._max_seq_len, page_size=self._page,
+            num_pages=self.pool.num_pages,
+            sampler=self._sampling["sampler"],
+            temperature=self._sampling["temperature"],
+            top_k=self._sampling["top_k"],
+            top_p=self._sampling["top_p"],
+            seed=seed, eos_token_id=self._eos, dtype=kv_dtype,
+            spec_decode_k=(self._spec.k if self._spec else 0),
+            drafter=(self._spec.drafter if self._spec else None),
+            chunked_prefill=self._chunked,
+            prefill_chunk_tokens=self._chunk_budget,
+            prefill_q_max=self._q_max,
+            prefix_cache=self._prefix_cache,
+            scheduler=self._scheduler, fault_plan=self._fault)
 
     def _model_fingerprint(self) -> bytes:
         """Sampling-invariant model identity — the chain-hash root.
@@ -1182,11 +1258,49 @@ class DecodeEngine:
         else:
             self._queue.remove(req)
         slot = heapq.heappop(self._free_slots)
-        if self._chunked:
-            self._bind_slot(req, slot, total_pages, hit_pages)
-        else:
-            self._prefill_into(req, slot, total_pages)
+        try:
+            if self._chunked:
+                self._bind_slot(req, slot, total_pages, hit_pages)
+            else:
+                self._prefill_into(req, slot, total_pages)
+        except PoolExhausted:
+            # typed containment: the pool could not actually deliver
+            # what the (conservative) capacity probe promised — or the
+            # "pool" fault site fired.  Admission backpressure, never a
+            # crash: unwind the partial claim and keep the request
+            # QUEUED at the head; it re-probes next step.
+            self._unwind_failed_admit(req, slot)
+            return False
         return True
+
+    def _unwind_failed_admit(self, req: Request, slot: int):
+        """Roll back a bind that raised `PoolExhausted` mid-way: give
+        back every page the partial `_alloc_prompt_pages` claimed
+        (cached hits unref, fresh allocs free — the reservation is
+        only taken after the loop completes, so it was never touched),
+        clear the slot, and put the request back at the queue head
+        still in state "queued"."""
+        self.pool.release_pages(req.pages)
+        req.pages = []
+        req.cached_page_count = 0
+        req.cached_prefix_len = 0
+        req.slot = None
+        req.state = "queued"
+        self._release_slot(slot)
+        self._queue.appendleft(req)
+
+    def _release_slot(self, slot: int):
+        """Clear every per-slot array for ``slot`` and push it back on
+        the free heap — the ONE slot teardown, shared by `_finish`,
+        `preempt`, and the admission unwind, so a new per-slot array
+        only ever needs resetting here."""
+        self._by_slot[slot] = None
+        self._active[slot] = False
+        self._lens[slot] = 0
+        self._last[slot] = 0
+        self._bt[slot] = 0
+        self._prefill_pos[slot] = 0
+        heapq.heappush(self._free_slots, slot)
 
     def _stamp_admit(self, req: Request):
         first = req.t_admit_ns is None
@@ -1209,7 +1323,13 @@ class DecodeEngine:
         """Map the cached prefix (refcount+1, read-only) and allocate
         fresh pages for the rest of the prompt (chunks scatter into
         already-owned pages), reserve the decode tail, and point the
-        slot's block-table row at all of them."""
+        slot's block-table row at all of them.
+
+        May raise `PoolExhausted` (organically, or via the "pool"
+        fault site) — `_admit_one` contains it: the partial claim is
+        unwound and the request stays queued."""
+        if self._fault is not None:
+            self._resilience.fault_point("pool")
         for p in hit_pages:
             self.pool.ref_page(p)
             req.pages.append(p)
@@ -1235,8 +1355,11 @@ class DecodeEngine:
         lands mid-page is copy-on-write by construction — the partially
         matching page is never mapped, its tokens are recomputed into a
         fresh private page, and the cached page is never written."""
-        self._stamp_admit(req)
+        # alloc BEFORE the admit stamp: a PoolExhausted unwind must
+        # leave the request looking never-admitted (a stamped t_admit
+        # would make its real admission later count as a resume)
         self._alloc_prompt_pages(req, slot, total_pages, hit_pages)
+        self._stamp_admit(req)
         req.state = "running"
         req.slot = slot
         self._by_slot[slot] = req
@@ -1268,13 +1391,15 @@ class DecodeEngine:
                    if self._active[s])
 
     def _prefill_into(self, req: Request, slot: int, total_pages: int):
+        # alloc first: a PoolExhausted unwind must see no admit stamp
+        # and no stall accounting for an admission that never happened
+        self._alloc_prompt_pages(req, slot, total_pages)
         if self._active.any():
             # legacy one-shot prefill runs BETWEEN decode steps: every
             # already-running slot stalls for this whole prompt pass —
             # the cost chunked prefill exists to remove
             _stats_add(stalled_decode_steps=1)
         self._stamp_admit(req)
-        self._alloc_prompt_pages(req, slot, total_pages)
         p_len = len(req.prompt_ids)
 
         bucket = self._prefill_bucket(p_len)
@@ -1311,9 +1436,11 @@ class DecodeEngine:
             jnp.asarray(self._bt[slot]), self._k_pages, self._v_pages,
             key)
         tok = int(self._host_fetch(tok))
-        _stats_add(prefill_time_s=time.perf_counter() - t0,
-                   prefills=1, tokens=1)
-        self._stamp_first_token(req, prompt_len=p_len, bucket=bucket)
+        # the pass's wall time is real either way; the token count,
+        # prefill count and TTFT stamp wait for the NaN-sentinel check
+        # below — a quarantined prefill emitted nothing (mirrors the
+        # chunked path, where _on_first_token checks before stamping)
+        _stats_add(prefill_time_s=time.perf_counter() - t0)
         _obs.record_span("engine", "prefill", t0_ns,
                          _obs.now_ns() - t0_ns,
                          tid=self._engine_id,
@@ -1322,12 +1449,19 @@ class DecodeEngine:
 
         req.state = "running"
         req.slot = slot
-        self._emit(req, [tok])
         self._by_slot[slot] = req
         self._lens[slot] = p_len
         self._prefill_pos[slot] = p_len  # legacy: prompt consumed whole
-        self._last[slot] = tok
+        self._last[slot] = max(tok, 0)
         self._active[slot] = True
+        if tok < 0:
+            # non-finite logits in the prompt pass: quarantine this
+            # request only — nothing was emitted, the batch lives on
+            self._quarantine_slot(slot, "nan_logits")
+            return
+        _stats_add(prefills=1, tokens=1)
+        self._stamp_first_token(req, prompt_len=p_len, bucket=bucket)
+        self._emit(req, [tok])
         if self._spec is not None:
             self._spec.on_admit(slot, req)
         reason = self._done(req, tok)
@@ -1348,13 +1482,28 @@ class DecodeEngine:
         callback — the ONE place output_ids grows, so every emission
         path (prefill first token, mixed step, classic decode,
         speculative accept) streams identically.  The callback runs
-        inside the serve loop: it must be cheap and must not raise (an
-        exception here would unwind the engine mid-step)."""
+        inside the serve loop: it must be cheap, and a callback that
+        RAISES is contained here (the "host_callback" fault site) —
+        the exception is recorded on ``req.fault_info``, the callback
+        is dropped for the rest of the request, and the serve loop
+        never unwinds mid-step.  Generation continues; only the
+        streaming side goes quiet (``output_ids`` stays complete)."""
         req.output_ids.extend(toks)
         cb = req.on_token
-        if cb is not None:
-            for t in toks:
+        if cb is None:
+            return
+        for t in toks:
+            try:
+                if self._fault is not None:
+                    self._resilience.fault_point("host_callback")
                 cb(int(t))
+            except Exception as e:  # containment, not policy: see above
+                req.on_token = None
+                if req.fault_info is None:
+                    req.fault_info = FaultInfo(
+                        site="host_callback", step=self._step_no,
+                        recovered=True, message=str(e))
+                break
 
     def _slo_violation(self, req: Request, kind: str):
         """Record one SLO miss ("ttft" | "tpot" | "deadline") — pure
@@ -1409,16 +1558,10 @@ class DecodeEngine:
         req.finish_reason = reason
         req.slot = None
         req.pages = []
-        self._by_slot[slot] = None
-        self._active[slot] = False
-        self._lens[slot] = 0
-        self._last[slot] = 0
-        self._bt[slot] = 0
-        self._prefill_pos[slot] = 0
-        heapq.heappush(self._free_slots, slot)
+        self._release_slot(slot)
         _stats_add(**{{"eos": "finished_eos", "length": "finished_length",
-                       "evicted": "evicted",
-                       "cancelled": "cancelled"}[reason]: 1})
+                       "evicted": "evicted", "cancelled": "cancelled",
+                       "fault": "finished_fault"}[reason]: 1})
         req.t_finish_ns = _obs.now_ns()
         _obs.REQUESTS_FINISHED.inc(reason=reason)
         # generated-token count is preemption-stable: tokens folded
@@ -1526,13 +1669,7 @@ class DecodeEngine:
         req.cached_prefix_len = 0
         req.slot = None
         req.state = "queued"
-        self._by_slot[slot] = None
-        self._active[slot] = False
-        self._lens[slot] = 0
-        self._last[slot] = 0
-        self._bt[slot] = 0
-        self._prefill_pos[slot] = 0
-        heapq.heappush(self._free_slots, slot)
+        self._release_slot(slot)
         if self._spec is not None:
             self._spec.on_finish(slot, req)
         # back of the line position-wise, but schedulers order by
@@ -1558,8 +1695,10 @@ class DecodeEngine:
         """Take a still-queued request out of the admission queue
         (``reason``: "evicted" via `evict`, "cancelled" via
         `Request.cancel`, "deadline" via the SLO scheduler's expiry
-        sweep) — it never held a slot or pages, so this is pure queue +
-        telemetry bookkeeping."""
+        sweep, "fault" via the containment ladder's bisect-quarantine
+        — the suspect is preempted back to the queue first, then
+        retired here) — it never held a slot or pages at retire time,
+        so this is pure queue + telemetry bookkeeping."""
         try:
             self._queue.remove(req)
         except ValueError:
@@ -1569,7 +1708,8 @@ class DecodeEngine:
         req.finish_reason = reason
         req.t_finish_ns = _obs.now_ns()
         _stats_add(**{{"evicted": "evicted", "cancelled": "cancelled",
-                       "deadline": "deadline_expired"}[reason]: 1})
+                       "deadline": "deadline_expired",
+                       "fault": "finished_fault"}[reason]: 1})
         _obs.REQUESTS_FINISHED.inc(reason=reason)
         if reason == "deadline":
             _obs.SCHED_DEADLINE_EXPIRED.inc()
@@ -1597,7 +1737,15 @@ class DecodeEngine:
         verify step writes up to K+1).  Slot reuse keeps this a pop from
         the free list, not an allocation; the pages stay with the
         request until it finishes, so a speculative rejection rolls back
-        ``seq_lens`` WITHOUT touching the pool."""
+        ``seq_lens`` WITHOUT touching the pool.
+
+        May raise `PoolExhausted` ("pool" fault site, or a genuinely
+        dry pool): the containment ladder retries and, if pressure
+        persists, quarantines a request — which frees pages.  Partial
+        growth is consistent state (grown pages belong to their
+        requests), so the retry re-enters here idempotently."""
+        if self._fault is not None:
+            self._resilience.fault_point("pool")
         for slot in range(self._slots):
             if not self._active[slot]:
                 continue
@@ -1706,6 +1854,11 @@ class DecodeEngine:
         self._grow_block_tables(writes=caps)
 
         fn = self._mixed_fn_tracker()
+        if self._fault is not None:
+            # fault site BEFORE the invocation (and the step counter):
+            # an injected raise leaves no half-donated state, so the
+            # containment ladder's retry re-enters cleanly
+            self._resilience.step_fault_point("mixed_step")
         self._step_no += 1
         key = jax.random.fold_in(
             self._key, _fold_counter(self._step_no, RNG_DECODE_DOMAIN))
@@ -1719,6 +1872,9 @@ class DecodeEngine:
                 jnp.asarray(sample_idx), jnp.asarray(sample_mask), key)
             toks = self._host_fetch(toks)
         dt = time.perf_counter() - t0
+        if self._fault is not None:
+            toks = self._resilience.corrupt_tokens(
+                toks, [s for s in range(slots) if sample_mask[s]])
 
         # the drafter sees the SAME chunks through the same executable
         # shape (speculative path: caps carry only prompt chunks there)
@@ -1756,10 +1912,16 @@ class DecodeEngine:
                 self._lens[s] += c
                 req.prefill_chunks += 1
                 if int(self._prefill_pos[s]) == len(req.prompt_ids):
-                    self._on_first_token(s, req, int(toks[s]))
-                    emitted += 1
+                    if self._on_first_token(s, req, int(toks[s])):
+                        emitted += 1
             elif caps[s] == 1:
                 tok = int(toks[s])
+                if tok < 0:
+                    # non-finite logits on this row only: quarantine
+                    # the slot, never the batch (lens stays — the
+                    # garbage K/V row is released with the pages)
+                    self._quarantine_slot(s, "nan_logits")
+                    continue
                 self._lens[s] += 1
                 self._last[s] = tok
                 self._emit(req, [tok])
@@ -1770,14 +1932,21 @@ class DecodeEngine:
         _stats_add(tokens=emitted)
         return True
 
-    def _on_first_token(self, slot: int, req: Request, tok: int):
+    def _on_first_token(self, slot: int, req: Request, tok: int) -> bool:
         """A slot's LAST prompt chunk landed: the mixed step sampled its
         first token — stamp TTFT now (not at admission, not at the first
         chunk) and flip the slot into plain decoding.  The prompt's full
         pages are content-final from here on, so they enter the prefix
         cache before any finish-path release can park them.  A RESUMED
         request (preempted earlier) keeps its original TTFT — the token
-        sampled here is mid-generation, not its first."""
+        sampled here is mid-generation, not its first.  Returns False
+        when the token was the NaN sentinel: the slot is quarantined
+        and — crucially — its pages are NOT registered (K/V computed
+        under non-finite activations must never enter the prefix
+        cache)."""
+        if tok < 0:
+            self._quarantine_slot(slot, "nan_logits")
+            return False
         self._register_prompt_pages(req)
         self._emit(req, [tok])
         self._last[slot] = tok
@@ -1787,6 +1956,31 @@ class DecodeEngine:
         reason = self._done(req, tok)
         if reason:
             self._finish(slot, reason)
+        return True
+
+    def _quarantine_slot(self, slot: int, site: str, message: str = ""):
+        """Containment verdict for ONE slot: its request leaves the
+        engine with ``finish_reason="fault"`` and a structured
+        `FaultInfo`, its pages and slot are released through the
+        normal `_finish` teardown, and every other slot keeps serving.
+        Used by the NaN/inf logit guard (only the offending row is
+        poisoned — evicting the batch for one sick request would be
+        the availability bug this PR exists to remove)."""
+        req = self._by_slot[slot]
+        if req.fault_info is None:
+            req.fault_info = FaultInfo(
+                site=site, step=self._step_no, recovered=False,
+                message=message or
+                "non-finite logits: slot quarantined")
+        else:
+            req.fault_info.history.append(req.fault_info.site)
+            req.fault_info.site = site
+            req.fault_info.recovered = False
+        _obs.record_span("engine", "quarantine", _obs.now_ns(), 0,
+                         tid=self._engine_id,
+                         args={"request": req.request_id, "slot": slot,
+                               "site": site})
+        self._finish(slot, "fault")
 
     def _debug_check_pool(self):
         """FLAGS_kv_pool_debug / FLAGS_sanitize: full pool-consistency
@@ -1815,9 +2009,18 @@ class DecodeEngine:
         prefill+decode step while any slot is mid-prefill (chunked
         mode), a classic decode step otherwise, or one speculative
         propose->verify->accept round when spec decoding is on.
-        Returns False when there is nothing left to do."""
-        from ..profiler import RecordEvent
+        Returns False when there is nothing left to do.
 
+        The device step runs under the containment ladder
+        (`inference.resilience.ResilienceManager.run_step`): a raising
+        step executable is retried with capped exponential backoff,
+        then the failing subsystem degrades (speculation off / legacy
+        prefill), then the batch is bisected and the suspect request
+        quarantined with ``finish_reason="fault"`` — one sick request
+        never kills the batch.  A fault that survives the whole ladder
+        re-raises as a FATAL `errors.StepFault`; only
+        `resilience.recover` (engine rebuild + replay re-admission)
+        continues from there."""
         san = _san.active()
         if san is not None:
             # sanitizer mode: audit the pool partition every step and
@@ -1837,7 +2040,21 @@ class DecodeEngine:
             / 1e9 if self._queue else 0.0, engine=eid)
         if not self._active.any():
             return bool(self._queue)
-        if self._spec is not None:
+        return self._resilience.run_step()
+
+    def _step_inner(self) -> bool:
+        """ONE batched device step over the already-admitted batch —
+        the containment ladder's unit of retry (`step` wraps it; never
+        call it from outside the ladder).  Dispatches to the
+        speculative round, the mixed prefill+decode step, or the
+        classic decode step exactly as `step` historically did."""
+        from ..profiler import RecordEvent
+
+        if self._fault is not None:
+            # "slow_step" site: a deterministic injected stall (the
+            # latency-fault class — SLO metrics see it, nothing raises)
+            self._resilience.fault_point("slow_step")
+        if self._spec is not None and self._resilience.spec_active():
             return self._spec.step()
         if self._chunked and self._prefilling_any():
             return self._mixed_step()
@@ -1853,6 +2070,8 @@ class DecodeEngine:
                 "decode_compiles", donate_argnums=(1, 2),
                 site="DecodeEngine decode step (_gpt_decode_step)")
 
+        if self._fault is not None:
+            self._resilience.step_fault_point("decode_step")
         self._step_no += 1
         key = jax.random.fold_in(
             self._key, _fold_counter(self._step_no, RNG_DECODE_DOMAIN))
@@ -1865,11 +2084,13 @@ class DecodeEngine:
                 jnp.asarray(self._last), jnp.asarray(self._active), key)
             toks = self._host_fetch(toks)
         dt = time.perf_counter() - t0
+        if self._fault is not None:
+            toks = self._resilience.corrupt_tokens(
+                toks, [s for s in range(self._slots) if self._active[s]])
 
         n_active = int(self._active.sum())
-        _stats_add(steps=1, decode_time_s=dt, tokens=n_active,
-                   occupancy_sum=n_active / self._slots,
-                   kv_util_sum=self.pool.utilization())
+        kv_util = self.pool.utilization()  # pre-finish, as historically
+        emitted = 0
         self._observe_step(t0_ns, dt, n_active, "decode_step")
 
         for slot in range(self._slots):
@@ -1877,12 +2098,21 @@ class DecodeEngine:
                 continue
             tok = int(toks[slot])
             req = self._by_slot[slot]
+            if tok < 0:
+                # non-finite logits on this row: quarantine the slot
+                # only — the rest of the batch emitted healthy tokens
+                self._quarantine_slot(slot, "nan_logits")
+                continue
             self._lens[slot] += 1
             self._last[slot] = tok
             self._emit(req, [tok])
+            emitted += 1
             reason = self._done(req, tok)
             if reason:
                 self._finish(slot, reason)
+        _stats_add(steps=1, decode_time_s=dt, tokens=emitted,
+                   occupancy_sum=n_active / self._slots,
+                   kv_util_sum=kv_util)
         return True
 
     def run(self, max_steps=100000):

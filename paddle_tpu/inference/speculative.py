@@ -72,8 +72,8 @@ import jax.numpy as jnp
 
 from .serving import (RNG_DECODE_DOMAIN, _JitTracker, _extract_gpt_params,
                       _fold_counter, _gpt_decode_step, _gpt_mixed_step,
-                      _gpt_prefill, _ln, _logits_of, _stats_add,
-                      sample_logits)
+                      _gpt_prefill, _guard_tokens, _ln, _logits_of,
+                      _stats_add, sample_logits)
 from .. import observability as _obs
 from ..ops.pallas import paged_attention as pa
 
@@ -142,9 +142,11 @@ def _gpt_spec_verify(params, k_pages, v_pages, block_tables, seq_lens,
     # the emitted tokens ARE these draws, which is what makes the accept
     # rule distribution-preserving (greedy ignores the key)
     targets = [
-        sample_logits(logits[:, i], sampler=sampler,
-                      temperature=temperature, top_k=top_k, top_p=top_p,
-                      key=jax.random.fold_in(key, i))
+        _guard_tokens(
+            logits[:, i],
+            sample_logits(logits[:, i], sampler=sampler,
+                          temperature=temperature, top_k=top_k,
+                          top_p=top_p, key=jax.random.fold_in(key, i)))
         for i in range(qn)
     ]
     return k_pages, v_pages, jnp.stack(targets, axis=1)
@@ -162,6 +164,12 @@ class Drafter:
     (host time there is drafting budget, not device idle time)."""
 
     name = "base"
+    # stateful drafters carry per-slot device state (draft K/V lens);
+    # after speculation degrades off (inference.resilience) only a
+    # STATELESS drafter can be probed back on mid-serve — a stateful
+    # one would need a full per-slot resync its fixed-frame catch-up
+    # cannot express, so it stays degraded until recovery/restart
+    stateful = False
 
     def bind(self, engine, k: int):
         if getattr(self, "engine", None) is not None and \
@@ -276,6 +284,7 @@ class DraftModelDrafter(Drafter):
     ride the `_JitTracker` retrace contract."""
 
     name = "draft_model"
+    stateful = True  # per-slot draft K/V cursors: see Drafter.stateful
 
     def __init__(self, draft_model):
         cfg = draft_model.cfg
@@ -560,7 +569,22 @@ class SpeculativeDecoder:
 
         t0 = time.perf_counter()
         t0_ns = _obs.now_ns()
-        drafts = self.drafter.propose(caps)
+        try:
+            if eng._fault is not None:
+                eng._resilience.fault_point("drafter")
+            drafts = self.drafter.propose(caps)
+        except eng._resilience.NONRETRYABLE:
+            raise
+        except Exception as e:
+            # drafter containment: a raising drafter costs this round
+            # its speculation, never the step — the verify below runs
+            # over zero drafts (all rejected, one genuine target token
+            # per slot emitted: exactly a decode step through the
+            # verify executable, no new shapes).  Repeated faults
+            # degrade speculation off entirely (re-enable probe after
+            # FLAGS_degraded_probe_steps clean steps).
+            drafts = np.zeros((slots, self.k), np.int32)
+            eng._resilience.on_drafter_fault(e)
         t_draft = time.perf_counter() - t0
         _obs.record_span("engine", "draft", t0_ns, int(t_draft * 1e9),
                          tid=eng._engine_id,
@@ -578,6 +602,8 @@ class SpeculativeDecoder:
 
         tokens = np.concatenate(
             [eng._last[:, None].astype(np.int32), drafts], axis=1)
+        if eng._fault is not None:
+            eng._resilience.step_fault_point("verify")
         eng._step_no += 1
         key = jax.random.fold_in(
             eng._key, _fold_counter(eng._step_no, RNG_DECODE_DOMAIN))
@@ -590,6 +616,9 @@ class SpeculativeDecoder:
                 jnp.asarray(tokens), jnp.asarray(caps), key)
             targets = eng._host_fetch(targets)
         t_verify = time.perf_counter() - t0
+        if eng._fault is not None:
+            targets = eng._resilience.corrupt_tokens(
+                targets, [s for s in range(slots) if caps[s] > 0])
         _obs.record_span("engine", "verify", tv_ns, int(t_verify * 1e9),
                          tid=eng._engine_id, args={"k": self.k})
 
@@ -608,6 +637,14 @@ class SpeculativeDecoder:
             while m < usable and int(drafts[s, m]) == int(targets[s, m]):
                 m += 1
             emit = [int(t) for t in drafts[s, :m]] + [int(targets[s, m])]
+            if any(t < 0 for t in emit):
+                # non-finite logits somewhere in this slot's verify
+                # window: quarantine the slot without emitting (lens
+                # never advances over the poisoned rows, the drafter's
+                # on_finish resets its cursor) — the other slots'
+                # rounds are untouched
+                eng._quarantine_slot(s, "nan_logits")
+                continue
             if req.eos_token_id is not None:
                 for j, t in enumerate(emit):
                     if t == req.eos_token_id:

@@ -46,6 +46,10 @@ paddle_queue_oldest_age_seconds                gauge      engine
 paddle_sched_preemptions_total                 counter    —
 paddle_sched_deadline_expired_total            counter    —
 paddle_sched_slo_violations_total              counter    kind
+paddle_faults_injected_total                   counter    site
+paddle_step_retries_total                      counter    —
+paddle_recoveries_total                        counter    —
+paddle_degraded_mode                           gauge      engine, mode
 =============================================  =========  ==========
 
 plus the views: ``paddle_decode_*`` (every `decode_stats` key) and
@@ -209,6 +213,32 @@ SCHED_SLO_VIOLATIONS = counter(
     "tpot | deadline); accounting only — a violating request is never "
     "aborted",
     labels=("kind",))
+FAULTS_INJECTED = counter(
+    "paddle_faults_injected_total",
+    "Faults the FLAGS_fault_inject harness fired, by site (step | "
+    "mixed_step | decode_step | verify | drafter | pool | nan_logits "
+    "| slow_step | host_callback) — deterministic occurrence-count "
+    "schedules, see docs/RELIABILITY.md",
+    labels=("site",))
+STEP_RETRIES = counter(
+    "paddle_step_retries_total",
+    "Same-step retries of a failed step executable "
+    "(FLAGS_step_retries; capped exponential backoff in "
+    "deterministic ticks) before containment escalates")
+RECOVERIES = counter(
+    "paddle_recoveries_total",
+    "Engine rebuilds after a fatal step fault "
+    "(inference.resilience.recover): every in-flight request "
+    "re-admitted with its generated tokens folded into the replay "
+    "prompt — already-emitted tokens are never re-emitted")
+DEGRADED_MODE = gauge(
+    "paddle_degraded_mode",
+    "1 while the engine serves with a subsystem degraded away, by "
+    "mode (spec_off: speculation disabled after repeated "
+    "drafter/verify faults; legacy_prefill: mixed-step faults forced "
+    "the fall back to the one-shot prefill oracle path); 0 after the "
+    "re-enable probe (FLAGS_degraded_probe_steps) restores it",
+    labels=("engine", "mode"))
 
 
 # ---------------------------------------------------------------------------

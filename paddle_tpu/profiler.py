@@ -69,6 +69,13 @@ DECODE_STAT_COUNTERS = (
     # preempt/resume cycles, still-queued requests retired at their
     # deadline, and declared TTFT/TPOT/deadline targets missed
     "preemptions", "resumes", "deadline_expired", "slo_violations",
+    # fault containment + crash recovery (inference.resilience):
+    # injected faults fired, same-step retries spent, requests
+    # quarantined with finish_reason="fault", engine rebuilds, and
+    # degraded-mode transitions (speculation disabled / chunked
+    # prefill fallen back to the legacy oracle path)
+    "faults_injected", "step_retries", "finished_fault", "recoveries",
+    "spec_disables", "legacy_fallbacks",
 )
 DECODE_STAT_DERIVED = ("avg_step_ms", "batch_occupancy",
                        "kv_block_utilization",

@@ -389,6 +389,43 @@ class TestEngineMutationLint:
         """, name="sanctioned_mod.py")
         assert EngineMutationPass(_ENGINE_RULE).run(mods) == []
 
+    def test_unsanctioned_recovery_mutation_flags(self, tmp_path):
+        """The REPO rule sanctions recovery's engine mutation ONLY in
+        inference/resilience.py (and the frontend's supervision
+        sites): a rogue module replaying the recovery moves —
+        `_step_inner` retries, quarantine, counter restores — must
+        still flag."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        mods = _scan_snippet(tmp_path, """
+            class RogueRecovery:
+                def heal(self, engine):
+                    engine._step_no = 0
+                    engine._quarantine_slot(0, "step")
+                    self.engine._step_inner()
+        """, name="rogue_recovery.py")
+        found = EngineMutationPass(REPO_ENGINE_RULE).run(mods)
+        msgs = sorted(f.message for f in found)
+        assert len(found) == 3, msgs
+        assert any("._quarantine_slot()" in m for m in msgs)
+        assert any("._step_inner()" in m for m in msgs)
+        assert any("attribute store" in m for m in msgs)
+        assert all("RogueRecovery.heal" in m for m in msgs)
+
+    def test_repo_rule_sanctions_resilience_module(self, tmp_path):
+        """The same recovery-style mutation inside a module named like
+        the sanctioned recovery site scans clean — the spec encodes
+        'recovery mutates the engine between steps by design'."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        (tmp_path / "inference").mkdir()
+        mods = _scan_snippet(tmp_path, """
+            def recover_step(engine):
+                engine._step_no = 0
+                return engine._step_inner()
+        """, name="inference/resilience.py")
+        assert EngineMutationPass(REPO_ENGINE_RULE).run(mods) == []
+
 
 # ---------------------------------------------------------------------------
 # donation analysis

@@ -302,6 +302,47 @@ def test_bench_slo_smoke(tmp_path):
     assert "paddle_queue_depth" in snap
 
 
+def test_bench_chaos_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_chaos.py runs end-to-end: the
+    fault-injection bench can't rot.  Asserts the emitted JSON shape
+    and the robustness acceptance bar at smoke scale: zero request
+    loss under the chaos schedule, greedy parity of every normally-
+    finished request vs the clean leg, >=1 same-step retry, >=1
+    quarantine (finish_reason="fault"), >=1 full engine recovery, a
+    leak-free pool in both legs, and an injection-free clean leg with
+    zero warm retraces (latency RATIOS are asserted only at full
+    scale)."""
+    out = str(tmp_path / "bench_chaos.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_chaos.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    s = data["summary"]
+    assert s["zero_request_loss"] is True
+    assert s["parity"] is True
+    assert s["step_retries"] >= 1
+    assert s["quarantined"] >= 1
+    assert s["recoveries"] >= 1
+    assert s["pool_clean_both_legs"] is True
+    assert s["clean_leg_injection_free"] is True
+    legs = data["legs"]
+    assert set(legs) == {"clean", "chaos"}
+    # the poisoned request is the quarantine the bisect must find
+    assert legs["chaos"]["finish_reasons"]["poisoned"] == "fault"
+    assert legs["clean"]["finish_reasons"]["poisoned"] in ("eos",
+                                                          "length")
+    info = legs["chaos"]["fault_info"]["poisoned"]
+    assert info["recovered"] is False and info["attempts"] >= 1
+    # recovered requests carry the structured record too
+    assert any(v["recovered"] for v in legs["chaos"]["fault_info"]
+               .values())
+    assert legs["chaos"]["faults_injected"] >= 3
+
+
 def test_telemetry_dump_smoke(tmp_path):
     """tools/telemetry_dump.py runs a small engine workload end-to-end
     and every export format parses: Prometheus text has the core

@@ -1,0 +1,331 @@
+"""Chaos benchmark: fault-injected serving under overload
+(inference.resilience).
+
+An overload workload (more requests than slots, staggered arrivals,
+shared prompt prefixes so recovery can ride the prefix cache, one
+poisoned request) is served twice through identical engines:
+
+* **clean** — no fault plan: the parity oracle and the latency
+  baseline;
+* **chaos** — a deterministic fault schedule arms every containment
+  rung at least once: a transient step fault (same-step retry), a
+  poisoned request (bisect-quarantine), a NaN-logit row (slot
+  quarantine), pool-exhaustion pressure (stay-queued admission +
+  mid-step containment), drafter faults (speculation degradation),
+  and a persistent step-fault burst that exhausts the ladder and
+  forces a full engine recovery (`resilience.recover`) mid-serve.
+
+Asserted (the robustness acceptance bar):
+
+* **zero request loss** — every offered request reaches eos/length or
+  an explicit "fault" verdict with a structured `FaultInfo`; the KV
+  pool leaks nothing;
+* **greedy parity** — every request that finished normally in BOTH
+  legs emitted bit-identical tokens, recovered requests included
+  (replay folds generated tokens into the prompt, so recompute is
+  deterministic);
+* **>=1 step retry, >=1 quarantine, >=1 engine recovery** actually
+  happened (the schedule exercised the ladder, not just the happy
+  path);
+* **bounded latency degradation** — chaos-leg mean TTFT/TPOT within
+  ``--bound``x of the clean leg.  On CPU the bound is dominated by
+  the recovery's executable recompile (a rebuilt engine re-traces its
+  step programs); on TPU a persistent compilation cache would shrink
+  it — the number is reported either way.
+
+Emits BENCH_chaos.json.
+
+Usage:
+    python tools/bench_chaos.py [--out BENCH_chaos.json] [--smoke]
+                                [--requests 8] [--new 24] [--bound 200]
+
+``--smoke`` (or env BENCH_SMOKE=1) shrinks shapes so CI can assert the
+script end-to-end (tests/test_tooling.py).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models.gpt import GPT, GPTConfig  # noqa: E402
+
+POISON = 3  # the poisoned request's marker token (inside vocab)
+
+
+def _build_model(args):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=2 * (args.prompt + args.new) + 64,
+                    use_parallel_layers=False, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    return model
+
+
+def _engine(model, args, plan=None):
+    from paddle_tpu.inference.serving import DecodeEngine
+
+    return DecodeEngine(model, max_batch_size=args.slots,
+                        max_seq_len=args.prompt + args.new + 8,
+                        page_size=args.page_size,
+                        prefill_chunk_tokens=args.chunk,
+                        spec_decode_k=args.spec_k,
+                        fault_plan=plan)
+
+
+def _workload(args, rng):
+    """(arrival_step, name, prompt) — overload with a shared prefix
+    block (recovery + prefix-cache interplay) and one poisoned
+    request the bisect containment must isolate."""
+    shared = rng.randint(4, args.vocab, (args.prompt // 2,)).astype(
+        np.int32)
+    plan = []
+    for i in range(args.requests):
+        tail = rng.randint(4, args.vocab,
+                           (args.prompt - len(shared),)).astype(np.int32)
+        prompt = np.concatenate([shared, tail])
+        plan.append((2 * i, f"req{i}", prompt))
+    # the poisoned request arrives mid-serve; token POISON never occurs
+    # elsewhere (other prompts draw from [4, vocab))
+    poison = np.concatenate(
+        [[POISON], rng.randint(4, args.vocab,
+                               (args.prompt - 1,)).astype(np.int32)])
+    plan.append((3, "poisoned", poison))
+    return plan
+
+
+def _chaos_spec(args):
+    """The deterministic schedule, tuned so every rung fires at least
+    once (occurrence counters, no wall clock — identical replay every
+    run): an early transient step fault (retry), drafter faults
+    (degradation when speculating), pool pressure, one NaN row, and a
+    persistent step burst late enough to be mid-serve that exhausts
+    retries + bisection into a fatal fault -> engine recovery."""
+    burst_at = args.burst_at
+    parts = [
+        "step@4",                                  # transient -> retry
+        f"step@{burst_at}-{burst_at + args.burst_len - 1}",  # -> recovery
+        "pool@2-3",                                # admission backpressure
+        f"nan_logits@{args.nan_at}",               # slot quarantine
+        f"poison@{POISON}",                        # bisect quarantine
+        "slow_ms=0.5",
+    ]
+    if args.spec_k:
+        parts.append("drafter@6-8")                # spec degradation
+    return ";".join(parts)
+
+
+def _serve(model, args, plan_spec, workload):
+    """Drive the arrival plan to completion under recovery
+    supervision (the frontend's _drive embeds the same loop)."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import resilience
+    from paddle_tpu.inference.errors import StepFault
+    from paddle_tpu.inference.serving import (decode_stats,
+                                              reset_decode_stats)
+
+    eng = _engine(model, args)
+    # warm every executable out of the measurement window
+    warm_rng = np.random.RandomState(999)
+    eng.generate([warm_rng.randint(4, args.vocab, (args.prompt,))
+                  .astype(np.int32)], max_new_tokens=2)
+    reset_decode_stats()
+    obs.reset()
+    if plan_spec:
+        eng = _engine(model, args,
+                      plan=resilience.FaultPlan.parse(plan_spec))
+
+    reqs = {}
+    recoveries = 0
+    step_no = 0
+    pending = sorted(workload, key=lambda e: e[0])
+    while pending or eng._queue or eng._active.any():
+        while pending and pending[0][0] <= step_no:
+            _, name, prompt = pending.pop(0)
+            reqs[name] = eng.add_request(prompt, max_new_tokens=args.new)
+        try:
+            eng.step()
+        except StepFault as e:
+            if recoveries >= args.max_recoveries:
+                raise
+            eng = resilience.recover(eng, fault=e)
+            recoveries += 1
+        step_no += 1
+        if step_no > 100000:
+            raise RuntimeError("chaos serve livelocked")
+    st = decode_stats()
+    snap = obs.snapshot()
+
+    def _hist_mean(name):
+        series = snap[name]["series"]
+        if not series or series[0]["count"] == 0:
+            return None
+        return series[0]["sum"] / series[0]["count"]
+
+    leg = {
+        "offered": len(reqs),
+        "steps": step_no,
+        "recoveries": recoveries,
+        "finish_reasons": {n: r.finish_reason
+                           for n, r in sorted(reqs.items())},
+        "faulted": sorted(n for n, r in reqs.items()
+                          if r.finish_reason == "fault"),
+        "fault_info": {n: r.fault_info.as_dict()
+                       for n, r in sorted(reqs.items())
+                       if r.fault_info is not None},
+        "ttft_mean_s": _hist_mean("paddle_request_ttft_seconds"),
+        "tpot_mean_s": _hist_mean("paddle_request_tpot_seconds"),
+        "step_retries": st["step_retries"],
+        "faults_injected": st["faults_injected"],
+        "quarantined": st["finished_fault"],
+        "spec_disables": st["spec_disables"],
+        "legacy_fallbacks": st["legacy_fallbacks"],
+        "preemptions": st["preemptions"],
+        "prefix_hits": st["prefix_hits"],
+        "retraces_after_warmup": st["retraces_after_warmup"],
+        "pool_clean": eng.pool.available_count == eng.pool.num_pages,
+    }
+    return leg, reqs, snap
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_chaos.json"))
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--prompt", type=int, default=24)
+    ap.add_argument("--new", type=int, default=24)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--spec-k", type=int, default=2)
+    ap.add_argument("--burst-at", type=int, default=24,
+                    help="first occurrence of the persistent step-"
+                         "fault burst (mid-serve)")
+    ap.add_argument("--burst-len", type=int, default=9,
+                    help="occurrences in the burst (must outlast "
+                         "retries + bisection so recovery fires)")
+    ap.add_argument("--nan-at", type=int, default=12)
+    ap.add_argument("--max-recoveries", type=int, default=4)
+    ap.add_argument("--bound", type=float, default=200.0,
+                    help="chaos/clean latency ratio bound (CPU: "
+                         "dominated by the rebuilt engine's "
+                         "recompiles)")
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes: CI end-to-end check")
+    args = ap.parse_args()
+    if os.environ.get("BENCH_SMOKE") == "1":
+        args.smoke = True
+    if args.smoke:
+        args.requests, args.prompt, args.new = 4, 12, 12
+        args.chunk, args.page_size = 8, 8
+        args.hidden, args.vocab = 64, 128
+        args.burst_at, args.burst_len, args.nan_at = 16, 9, 10
+
+    import jax
+
+    model = _build_model(args)
+    workload = _workload(args, np.random.RandomState(0))
+
+    legs, reqs_by_leg = {}, {}
+    for name, spec in (("clean", ""), ("chaos", _chaos_spec(args))):
+        leg, reqs, snap = _serve(model, args, spec, workload)
+        legs[name], reqs_by_leg[name] = leg, reqs
+        print(f"{name:5s}: reasons "
+              f"{sorted(set(leg['finish_reasons'].values()))} | "
+              f"retries {leg['step_retries']} | quarantined "
+              f"{leg['quarantined']} | recoveries {leg['recoveries']} "
+              f"| ttft {leg['ttft_mean_s']}")
+
+    clean, chaos = legs["clean"], legs["chaos"]
+    # zero request loss: every offered request reached an explicit
+    # terminal state in BOTH legs, and the pool leaked nothing
+    lost = [n for leg in legs.values()
+            for n, reason in leg["finish_reasons"].items()
+            if reason not in ("eos", "length", "fault")]
+    # greedy parity of every request that finished normally in both
+    parity = True
+    recovered_compared = 0
+    for n, rc in reqs_by_leg["clean"].items():
+        rx = reqs_by_leg["chaos"][n]
+        if rc.finish_reason in ("eos", "length") and \
+                rx.finish_reason in ("eos", "length"):
+            same = list(rc.generated_ids) == list(rx.generated_ids)
+            parity = parity and same
+            if rx.fault_info is not None and rx.fault_info.recovered:
+                recovered_compared += 1
+
+    ttft_ratio = (chaos["ttft_mean_s"] / clean["ttft_mean_s"]) \
+        if clean["ttft_mean_s"] and chaos["ttft_mean_s"] else None
+    tpot_ratio = (chaos["tpot_mean_s"] / clean["tpot_mean_s"]) \
+        if clean["tpot_mean_s"] and chaos["tpot_mean_s"] else None
+    summary = {
+        "zero_request_loss": not lost,
+        "parity": bool(parity),
+        "recovered_requests_compared": recovered_compared,
+        "step_retries": chaos["step_retries"],
+        "quarantined": chaos["quarantined"],
+        "recoveries": chaos["recoveries"],
+        "faults_injected": chaos["faults_injected"],
+        "ttft_ratio_chaos_vs_clean": round(ttft_ratio, 3)
+        if ttft_ratio else None,
+        "tpot_ratio_chaos_vs_clean": round(tpot_ratio, 3)
+        if tpot_ratio else None,
+        "latency_bound": args.bound,
+        "pool_clean_both_legs": clean["pool_clean"]
+        and chaos["pool_clean"],
+        "clean_leg_injection_free": clean["faults_injected"] == 0
+        and clean["retraces_after_warmup"] == 0,
+    }
+    out = {
+        "bench": "fault-injected serving: containment ladder + crash "
+                 "recovery under a deterministic chaos schedule",
+        "device": str(jax.devices()[0].device_kind)
+        if jax.devices() else "unknown",
+        "smoke": bool(args.smoke),
+        "config": {k: getattr(args, k) for k in
+                   ("slots", "requests", "prompt", "new", "chunk",
+                    "spec_k", "burst_at", "burst_len", "nan_at",
+                    "max_recoveries", "bound", "layers", "hidden",
+                    "heads", "vocab", "page_size")},
+        "chaos_schedule": _chaos_spec(args),
+        "legs": legs,
+        "summary": summary,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {args.out} (loss-free={summary['zero_request_loss']}, "
+          f"parity={summary['parity']}, retries="
+          f"{summary['step_retries']}, quarantined="
+          f"{summary['quarantined']}, recoveries="
+          f"{summary['recoveries']}, ttft x"
+          f"{summary['ttft_ratio_chaos_vs_clean']})")
+    ok = summary["zero_request_loss"] and summary["parity"] and \
+        summary["clean_leg_injection_free"] and \
+        summary["pool_clean_both_legs"] and \
+        summary["step_retries"] >= 1 and \
+        summary["quarantined"] >= 1 and summary["recoveries"] >= 1
+    if not args.smoke:
+        # the latency bound is asserted at full scale only (smoke
+        # shapes are recompile-dominated and too noise-prone to pin)
+        if ttft_ratio is not None:
+            ok = ok and ttft_ratio <= args.bound
+        if tpot_ratio is not None:
+            ok = ok and tpot_ratio <= args.bound
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
