@@ -93,9 +93,13 @@ REPO_LOCK_RULES: Dict[str, LockRule] = {
 # (engine-called between steps, and recovery mutates the engine
 # between steps BY DESIGN — the fold/re-admit in `recover` and the
 # bisect-quarantine preempt/retire in `ResilienceManager` are
-# sanctioned recovery sites); in frontend.py only the schedulers
-# (engine-called, between steps), the driver's control-application
-# points, and the driver's recovery supervision may mutate.
+# sanctioned recovery sites); durability.py is the write-ahead
+# journal + fresh-process restore + hung-step watchdog (restore
+# re-admits into a just-built idle engine, the watchdog abandons and
+# neutralizes a hung one — both sanctioned recovery-class mutation);
+# in frontend.py only the schedulers (engine-called, between steps),
+# the driver's control-application points, and the driver's recovery
+# supervision may mutate.
 REPO_ENGINE_RULE = EngineRule(
     mutators=(
         "add_request", "evict", "preempt", "step", "run", "generate",
@@ -109,12 +113,16 @@ REPO_ENGINE_RULE = EngineRule(
         # mutate the engine — callable only from sanctioned sites
         "_step_inner", "_quarantine_slot", "_unwind_failed_admit",
         "_release_slot",
+        # durable serving (inference.durability): executable handoff
+        # to a rebuilt engine and watchdog abandonment of a hung one
+        "adopt_executables", "_abandon_inflight",
     ),
     receivers=("eng", "engine", "self.engine", "self._engine"),
     sanctioned={
         "inference/serving.py": ("*",),
         "inference/speculative.py": ("*",),
         "inference/resilience.py": ("*",),
+        "inference/durability.py": ("*",),
         "inference/frontend.py": (
             "Scheduler.", "FIFOScheduler.", "SLOScheduler.",
             "ServingFrontend._apply_control", "ServingFrontend._drive",

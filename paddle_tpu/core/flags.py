@@ -223,6 +223,44 @@ define_flag("engine_recoveries", 2,
             "generated tokens folded into the prompt for replay) the "
             "frontend driver / serve_with_recovery may spend before "
             "declaring the fault unrecoverable (DegradedMode)")
+define_flag("journal_dir", "",
+            "arm durable serving (inference.durability): directory for "
+            "the append-only write-ahead request journal (one record "
+            "per admission / emitted-token watermark / finish) plus "
+            "periodic on-disk engine snapshots — "
+            "durability.restore_from_dir rebuilds the engine in a "
+            "FRESH process after a SIGKILL/OOM with zero request loss "
+            "and no re-emitted stream tokens.  Empty (default) = off; "
+            "every hook on the serve path is then one `is None` check")
+define_flag("journal_fsync", "step",
+            "journal durability policy: 'always' fsyncs after every "
+            "record (strongest no-re-emission guarantee, one fsync per "
+            "emit), 'step' (default) buffers and fsyncs once per "
+            "engine step, 'never' flushes to the OS without fsync "
+            "(survives process death, not power loss).  See "
+            "docs/RELIABILITY.md for the trade-offs")
+define_flag("snapshot_interval_steps", 32,
+            "engine steps between on-disk EngineSnapshot serializations "
+            "when FLAGS_journal_dir is armed; the snapshot bounds how "
+            "much of the journal a restore must replay (and how many "
+            "tokens it must recompute).  <= 0 disables periodic "
+            "snapshots — restore then replays the whole journal")
+define_flag("compile_cache_dir", "",
+            "directory for JAX's persistent compilation cache: a "
+            "rebuilt engine in a FRESH process (durability."
+            "restore_from_dir) warm-starts its executables from disk "
+            "instead of recompiling.  Process-global (jax config), "
+            "applied at the first engine construction that sees it")
+define_flag("step_timeout_ms", 0.0,
+            "hung-step watchdog (inference.durability.StepWatchdog): a "
+            "DecodeEngine.step exceeding this wall-clock budget is "
+            "classified hung — paddle_engine_health flips to 'hung' "
+            "and a fatal HungStep routes the supervisor "
+            "(serve_with_recovery / ServingFrontend._drive) through "
+            "engine recovery; the frontend additionally abandons a "
+            "worker thread still stuck past the budget.  Steps that "
+            "compiled an executable are exempt (compiles are expected "
+            "warmup stalls, not hangs).  0 (default) = disarmed")
 define_flag("use_rbg_rng", True,
             "on TPU, use the hardware RBG PRNG for the framework's random "
             "ops instead of threefry (measured: recovers ~60% of dropout's "

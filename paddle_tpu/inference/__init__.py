@@ -27,7 +27,7 @@ import numpy as np
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "PrecisionType", "PlaceType", "serving", "speculative",
-           "frontend", "resilience", "errors"]
+           "frontend", "resilience", "errors", "durability"]
 
 
 class PrecisionType:

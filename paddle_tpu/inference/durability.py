@@ -1,0 +1,698 @@
+"""Durable serving: a write-ahead request journal + on-disk engine
+snapshots that survive PROCESS death, executable handoff for fast
+in-process rebuilds, and a hung-step watchdog.
+
+PR 9 (`inference.resilience`) made the engine survive raising steps:
+the containment ladder retries/degrades/quarantines, and a fatal fault
+rebuilds the engine in-process with every request replayed.  Two holes
+remained, and this module closes both plus a third failure class:
+
+* **Process death** — an `EngineSnapshot` lived only in the dying
+  process's memory, so a SIGKILL/OOM lost every in-flight request.
+  With ``FLAGS_journal_dir`` armed, every admission, emitted-token
+  watermark and finish is appended to a crc-framed write-ahead journal
+  (``journal.wal``; fsync policy ``FLAGS_journal_fsync``), and every
+  ``FLAGS_snapshot_interval_steps`` steps the engine's host state is
+  serialized atomically to ``snapshot.json``.  `restore_from_dir`
+  rebuilds an engine in a FRESH process: the snapshot supplies each
+  in-flight request's generated-token values, the journal replays what
+  came after, and every request re-admits through the PR 9 replay fold
+  (generated tokens folded into the prompt) — greedy outputs are
+  bit-identical to the uninterrupted run, and the journal's streamed
+  watermark gates `DecodeEngine._emit` so a token a previous life
+  already streamed is recomputed but NEVER re-fired at the stream.
+
+* **Recompile-dominated recovery** — an in-process `recover` rebuilt
+  every executable from scratch (recompile dominated recovery latency:
+  BENCH_chaos hit TTFT x72 on CPU).  `DecodeEngine.adopt_executables`
+  hands the dead engine's live compiled executables to the rebuilt
+  engine when the config fingerprints match (identical shapes by
+  construction, so the jit caches stay warm — no recompile, no warm
+  retrace), falling back to recompile on any mismatch.  Cross-process
+  restarts warm-start through JAX's persistent compilation cache
+  (``FLAGS_compile_cache_dir``, `enable_compile_cache`).
+
+* **Hung steps** — a step that RAISES rides the containment ladder; a
+  step that simply never returns (device wedge, runtime deadlock) used
+  to hang the serve forever.  `StepWatchdog` (``FLAGS_step_timeout_ms``)
+  classifies a step that outran its wall-clock budget without
+  compiling anything as hung, flips the ``paddle_engine_health`` gauge
+  (live|degraded|recovering|hung) and raises a fatal `errors.HungStep`
+  so the existing recovery supervision rebuilds the engine;
+  `frontend.ServingFrontend._drive` additionally ABANDONS a worker
+  thread still stuck past the budget and rebuilds from the pre-step
+  snapshot with streams intact (tested deterministically through the
+  PR 9 ``slow_step`` fault site).
+
+With ``FLAGS_journal_dir`` unset and ``FLAGS_step_timeout_ms`` zero,
+every hook on the serve path is a single ``is None`` check — serving
+is bit-exact with the PR 9 engine (pinned by tests/test_durability.py).
+
+See docs/RELIABILITY.md for the operator-facing walk-through.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import zlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .. import observability as _obs
+from .errors import FaultInfo, HungStep
+
+__all__ = ["RequestWire", "SnapshotWire", "DurabilityManager",
+           "StepWatchdog", "read_journal", "load_snapshot",
+           "restore_from_dir", "enable_compile_cache", "set_health",
+           "clear_health", "HEALTH_STATES", "JOURNAL_NAME",
+           "SNAPSHOT_NAME"]
+
+JOURNAL_NAME = "journal.wal"
+SNAPSHOT_NAME = "snapshot.json"
+
+
+# ---------------------------------------------------------------------------
+# Record framing: every journal record (and the snapshot file) is
+# "<crc32 hex8> <compact json>\n" — a torn write fails the crc (or has
+# no terminator) and the reader stops at the last consistent record
+# instead of crashing or trusting garbage.
+# ---------------------------------------------------------------------------
+def _frame(obj: dict) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    return b"%08x %s\n" % (zlib.crc32(payload), payload)
+
+
+def _parse_frames(data: bytes) -> Tuple[List[dict], int]:
+    """(records, valid_byte_length): decode crc-framed lines, stopping
+    at the first torn/corrupt one — everything before it is the last
+    consistent state, everything after it is untrusted."""
+    events: List[dict] = []
+    pos = 0
+    while pos < len(data):
+        nl = data.find(b"\n", pos)
+        if nl < 0:
+            break  # unterminated tail record: torn write
+        line = data[pos:nl]
+        try:
+            crc_hex, payload = line.split(b" ", 1)
+            if int(crc_hex, 16) != zlib.crc32(payload):
+                break
+            events.append(json.loads(payload))
+        except Exception:
+            break
+        pos = nl + 1
+    return events, pos
+
+
+def read_journal(path: str) -> Tuple[List[dict], int]:
+    """All consistent records of a journal file plus the byte offset
+    the last one ends at (a reopening writer truncates to it).  A
+    missing file is an empty journal."""
+    if not os.path.exists(path):
+        return [], 0
+    with open(path, "rb") as f:
+        return _parse_frames(f.read())
+
+
+# ---------------------------------------------------------------------------
+# Wire forms.  `resilience.EngineSnapshot` holds live `Request` objects
+# BY REFERENCE — correct in-process (streams/hooks survive a rebuild),
+# wrong on disk (callbacks, engine backrefs and ns timestamps are not
+# serializable state).  The wire form is the picklable/JSON-able split:
+# original prompt, full generated values, original budget, and the
+# streamed watermark — everything a fresh process needs to re-admit the
+# request through the replay fold.
+# ---------------------------------------------------------------------------
+@dataclass
+class RequestWire:
+    """Serialization-safe form of one in-flight request.
+
+    ``prompt`` is the ORIGINAL prompt (pre any preemption fold) and
+    ``max_new`` the ORIGINAL budget, so the wire form is stable no
+    matter how many times the live request was preempted or recovered.
+    ``streamed`` is the emitted-token watermark: how many generated
+    tokens a consumer has already seen — `materialize` turns the
+    excess over ``len(generated)`` into an ``_emit_gate`` so replay
+    recomputes those tokens without ever re-firing ``on_token``."""
+
+    request_id: int
+    prompt: List[int]
+    generated: List[int]
+    max_new: int
+    streamed: int
+    eos: Optional[int] = None
+    priority: Optional[int] = None
+    deadline_ms: Optional[float] = None
+    slo_ttft_ms: Optional[float] = None
+    slo_tpot_ms: Optional[float] = None
+
+    @classmethod
+    def from_request(cls, req) -> "RequestWire":
+        gen = list(req.generated_ids)
+        return cls(
+            request_id=req.request_id,
+            prompt=list(req.prompt_ids[:req.orig_prompt_len]),
+            generated=gen,
+            max_new=req.max_new_tokens + req._absorbed,
+            streamed=len(gen) + req._emit_gate,
+            eos=req.eos_token_id, priority=req.priority,
+            deadline_ms=req.deadline_ms, slo_ttft_ms=req.slo_ttft_ms,
+            slo_tpot_ms=req.slo_tpot_ms)
+
+    @classmethod
+    def from_record(cls, rec) -> "RequestWire":
+        """From a `resilience._ReqRecord` (state AT CAPTURE, not the
+        live request, which may have advanced since)."""
+        req = rec.request
+        gen = list(rec.prompt_ids[rec.orig_len:]) + list(rec.output_ids)
+        return cls(
+            request_id=req.request_id,
+            prompt=list(rec.prompt_ids[:rec.orig_len]),
+            generated=gen,
+            max_new=rec.max_new + rec.absorbed,
+            streamed=rec.streamed,
+            eos=req.eos_token_id, priority=req.priority,
+            deadline_ms=req.deadline_ms, slo_ttft_ms=req.slo_ttft_ms,
+            slo_tpot_ms=req.slo_tpot_ms)
+
+    def to_obj(self) -> dict:
+        return {"id": self.request_id, "p": self.prompt,
+                "g": self.generated, "mn": self.max_new,
+                "sm": self.streamed, "eos": self.eos,
+                "pr": self.priority, "dl": self.deadline_ms,
+                "tt": self.slo_ttft_ms, "tp": self.slo_tpot_ms}
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "RequestWire":
+        return cls(request_id=int(obj["id"]), prompt=list(obj["p"]),
+                   generated=list(obj["g"]), max_new=int(obj["mn"]),
+                   streamed=int(obj["sm"]), eos=obj.get("eos"),
+                   priority=obj.get("pr"), deadline_ms=obj.get("dl"),
+                   slo_ttft_ms=obj.get("tt"), slo_tpot_ms=obj.get("tp"))
+
+    def materialize(self):
+        """A fresh `Request` carrying this wire state, re-admittable
+        through the replay fold: generated tokens folded into the
+        prompt (budget shrinks one for one), the streamed watermark
+        turned into an emit gate, the original request id restored."""
+        from .serving import Request
+
+        req = Request(
+            list(self.prompt) + list(self.generated),
+            max_new_tokens=self.max_new - len(self.generated),
+            eos_token_id=self.eos, priority=self.priority,
+            deadline_ms=self.deadline_ms, slo_ttft_ms=self.slo_ttft_ms,
+            slo_tpot_ms=self.slo_tpot_ms)
+        req.orig_prompt_len = len(self.prompt)
+        req._absorbed = len(self.generated)
+        req._emit_gate = max(0, self.streamed - len(self.generated))
+        req.request_id = self.request_id
+        return req
+
+
+@dataclass
+class SnapshotWire:
+    """Serialization-safe form of a whole `EngineSnapshot`:
+    ``journal_pos`` anchors it in the journal (replay resumes at that
+    record index), the RNG fold counters carry the sampling streams,
+    and ``records`` hold every in-flight request in admission order."""
+
+    engine_id: int
+    step_no: int
+    prefill_no: int
+    journal_pos: int
+    records: List[RequestWire] = field(default_factory=list)
+
+    def to_obj(self) -> dict:
+        return {"v": 1, "engine_id": self.engine_id,
+                "step_no": self.step_no, "prefill_no": self.prefill_no,
+                "journal_pos": self.journal_pos,
+                "records": [r.to_obj() for r in self.records]}
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "SnapshotWire":
+        return cls(engine_id=int(obj["engine_id"]),
+                   step_no=int(obj["step_no"]),
+                   prefill_no=int(obj["prefill_no"]),
+                   journal_pos=int(obj["journal_pos"]),
+                   records=[RequestWire.from_obj(r)
+                            for r in obj["records"]])
+
+
+def load_snapshot(journal_dir: str) -> Optional[SnapshotWire]:
+    """The on-disk snapshot, or None when absent OR torn/corrupt — a
+    restore then falls back to replaying the whole journal (the last
+    consistent state is never worse than no snapshot)."""
+    path = os.path.join(journal_dir, SNAPSHOT_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        records, _ = _parse_frames(f.read())
+    if len(records) != 1:
+        return None  # torn/corrupt snapshot: journal-only restore
+    try:
+        return SnapshotWire.from_obj(records[0])
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Engine health (the watchdog's gauge).  One-hot per engine so a
+# dashboard can alert on `paddle_engine_health{state="hung"} == 1`;
+# every transition also lands as a `health:*` engine span so the
+# sequence (live -> hung -> recovering -> live) is reconstructable.
+# ---------------------------------------------------------------------------
+HEALTH_STATES = ("live", "degraded", "recovering", "hung")
+
+# current state per engine id: set_health only touches the series a
+# transition actually involves (a healthy engine is ONE series, not
+# four — engine ids are unbounded and the registry caps cardinality)
+_health_state: Dict[int, str] = {}
+
+
+def set_health(engine_id: int, state: str, span: bool = True):
+    """Flip one engine's ``paddle_engine_health`` gauge.  ``span=False``
+    records the INITIAL state at construction without a transition
+    span, so the span stream reads as the actual transition sequence
+    (live -> hung -> recovering -> live) with no construction blips."""
+    if state not in HEALTH_STATES:
+        raise ValueError(f"unknown health state {state!r}")
+    prev = _health_state.get(engine_id)
+    if prev == state:
+        return
+    _health_state[engine_id] = state
+    if prev is not None:
+        _obs.ENGINE_HEALTH.set(0, engine=engine_id, state=prev)
+    _obs.ENGINE_HEALTH.set(1, engine=engine_id, state=state)
+    if span:
+        _obs.record_span("engine", f"health:{state}", _obs.now_ns(), 0,
+                         tid=engine_id)
+
+
+def clear_health(engine_id: int):
+    """Retire an engine from the health gauge: its last state series
+    drops to 0 and no state reads 1.  Recovery calls this for the DEAD
+    engine — without it a successfully recovered hang would leave
+    ``paddle_engine_health{state="hung"} == 1`` (the documented alert
+    condition) latched forever on the retired id."""
+    prev = _health_state.pop(engine_id, None)
+    if prev is not None:
+        _obs.ENGINE_HEALTH.set(0, engine=engine_id, state=prev)
+
+
+# ---------------------------------------------------------------------------
+# The write-ahead journal + periodic snapshots
+# ---------------------------------------------------------------------------
+class DurabilityManager:
+    """Owns one engine's journal file and snapshot cadence.
+
+    Record types (crc-framed JSON lines):
+
+    * ``cfg`` — written once when the journal is created: the engine's
+      serializable constructor config + config fingerprint (restore
+      validates the rebuilding model against it);
+    * ``a`` — admission: the request's identity + prompt + budget;
+    * ``e`` — emitted-token watermark: total generated tokens the
+      stream has consumed for one request.  WRITE-AHEAD: appended (and,
+      under ``journal_fsync=always``, fsynced) BEFORE the ``on_token``
+      callback fires, so a token the consumer saw is always covered by
+      a durable watermark — restore can suppress it, never re-emit it;
+    * ``f`` — finish: request id + finish reason.
+
+    Thread discipline: every hook runs on the thread driving the
+    engine (the engine is single-threaded by contract; the frontend
+    applies control between steps), so the buffer needs no lock.
+    Reopening an existing journal truncates a torn tail record first —
+    appends after a crash stay parseable."""
+
+    def __init__(self, engine, journal_dir: str, fsync=None,
+                 snapshot_interval=None):
+        from ..core import flags as _flags
+
+        self.engine = engine
+        self.journal_dir = str(journal_dir)
+        os.makedirs(self.journal_dir, exist_ok=True)
+        self.fsync = str(fsync if fsync is not None
+                         else _flags.flag("journal_fsync"))
+        if self.fsync not in ("always", "step", "never"):
+            raise ValueError(
+                f"journal_fsync must be one of always|step|never, got "
+                f"{self.fsync!r}")
+        self.snapshot_interval = int(
+            snapshot_interval if snapshot_interval is not None
+            else _flags.flag("snapshot_interval_steps"))
+        self.path = os.path.join(self.journal_dir, JOURNAL_NAME)
+        events, valid_len = read_journal(self.path)
+        self.seq = len(events)
+        if os.path.exists(self.path) and \
+                os.path.getsize(self.path) > valid_len:
+            with open(self.path, "r+b") as f:
+                f.truncate(valid_len)
+        self._fh = open(self.path, "ab")
+        self._buf: List[bytes] = []
+        self._steps_since_snapshot = 0
+        if self.seq == 0:
+            self.append({"t": "cfg", "v": 1,
+                         "fp": engine.config_fingerprint().hex(),
+                         "cfg": engine.wire_config()})
+
+    # -- record appends ------------------------------------------------------
+    def append(self, obj: dict):
+        from .serving import _stats_add
+
+        line = _frame(obj)
+        self.seq += 1
+        _stats_add(journal_records=1)
+        if self.fsync == "always":
+            self._fh.write(line)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        else:
+            self._buf.append(line)
+
+    def flush(self):
+        if not self._buf:
+            return
+        self._fh.write(b"".join(self._buf))
+        self._buf = []
+        self._fh.flush()
+        if self.fsync == "step":
+            os.fsync(self._fh.fileno())
+
+    # -- engine hooks --------------------------------------------------------
+    def on_admit(self, req):
+        eos = req.eos_token_id
+        self.append({"t": "a", "id": req.request_id,
+                     "p": list(req.prompt_ids),
+                     "mn": int(req.max_new_tokens),
+                     "eos": None if eos is None else int(eos),
+                     "pr": req.priority, "dl": req.deadline_ms,
+                     "tt": req.slo_ttft_ms, "tp": req.slo_tpot_ms})
+
+    def on_emit(self, req):
+        # streamed watermark = generated + still-gated (a gated token
+        # was streamed by a previous life): monotonic across restores
+        self.append({"t": "e", "id": req.request_id,
+                     "n": req._absorbed + len(req.output_ids) +
+                     req._emit_gate})
+
+    def on_finish(self, req):
+        self.append({"t": "f", "id": req.request_id,
+                     "r": req.finish_reason})
+
+    def on_step_boundary(self):
+        """Between-steps housekeeping (engine idle): flush per the
+        fsync policy, write the periodic snapshot."""
+        self.flush()
+        if self.snapshot_interval > 0:
+            self._steps_since_snapshot += 1
+            if self._steps_since_snapshot >= self.snapshot_interval:
+                self._steps_since_snapshot = 0
+                self.write_snapshot()
+
+    def write_snapshot(self):
+        """Serialize the engine's between-steps host state atomically:
+        write to a temp file, fsync, `os.replace` — a crash mid-write
+        leaves the PREVIOUS snapshot intact, never a torn current one."""
+        from .resilience import EngineSnapshot
+        from .serving import _stats_add
+
+        wire = EngineSnapshot(self.engine).to_wire(journal_pos=self.seq)
+        data = _frame(wire.to_obj())
+        path = os.path.join(self.journal_dir, SNAPSHOT_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _stats_add(journal_snapshots=1)
+
+    def close(self):
+        self.flush()
+        self._fh.close()
+
+
+# ---------------------------------------------------------------------------
+# Fresh-process restore
+# ---------------------------------------------------------------------------
+def restore_from_dir(journal_dir: str, model, scheduler=None,
+                     drafter=None, journal: bool = True, **overrides):
+    """Rebuild an engine in a FRESH process from ``journal_dir`` and
+    re-admit every request that was in flight when the previous process
+    died.  Returns ``(engine, requests)`` — ``requests`` maps each
+    journaled request id to its rebuilt `Request` (re-attach
+    ``on_token`` hooks there before driving the engine).
+
+    The caller supplies the ``model`` (weights are not journaled); the
+    journal's config record supplies every other constructor argument
+    and a config fingerprint the rebuilt engine is validated against —
+    a different model or config raises instead of silently serving
+    garbage.  State resolution: the newest VALID snapshot supplies
+    generated-token values and RNG fold counters; journal records after
+    its ``journal_pos`` replay admissions / watermarks / finishes on
+    top.  A torn tail record or torn snapshot simply falls back to the
+    last consistent state — never a crash, and the emitted-token
+    watermarks guarantee a previously streamed token is never re-fired
+    at a stream (it is recomputed behind the `_emit` gate; greedy
+    recompute is bit-identical, which is what the acceptance bench
+    pins).
+
+    ``journal=True`` (default) keeps journaling into the same
+    directory, so the restored serve survives a SECOND death.
+    ``overrides`` replace individual engine kwargs (tests/benches)."""
+    from .serving import DecodeEngine, Request, _stats_add
+
+    path = os.path.join(journal_dir, JOURNAL_NAME)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no serve journal at {path}")
+    events, _ = read_journal(path)
+    if not events or events[0].get("t") != "cfg":
+        raise ValueError(
+            f"{path} has no config record — not a serve journal")
+    cfg_rec = events[0]
+    snap = load_snapshot(journal_dir)
+
+    state: "OrderedDict[int, RequestWire]" = OrderedDict()
+    finished: Dict[int, str] = {}
+    start = 1  # past the cfg record
+    if snap is not None:
+        for w in snap.records:
+            state[w.request_id] = w
+        # a snapshot can never be AHEAD of the consistent journal
+        # prefix unless the journal lost a torn tail — the snapshot is
+        # still authoritative for everything it saw
+        start = min(max(snap.journal_pos, 1), len(events))
+    for ev in events[start:]:
+        t = ev.get("t")
+        if t == "a":
+            state.setdefault(int(ev["id"]), RequestWire(
+                request_id=int(ev["id"]), prompt=list(ev["p"]),
+                generated=[], max_new=int(ev["mn"]), streamed=0,
+                eos=ev.get("eos"), priority=ev.get("pr"),
+                deadline_ms=ev.get("dl"), slo_ttft_ms=ev.get("tt"),
+                slo_tpot_ms=ev.get("tp")))
+        elif t == "e":
+            w = state.get(int(ev["id"]))
+            if w is not None:
+                w.streamed = max(w.streamed, int(ev["n"]))
+        elif t == "f":
+            state.pop(int(ev["id"]), None)
+            finished[int(ev["id"])] = ev.get("r", "")
+
+    kw = dict(cfg_rec["cfg"])
+    if kw.get("dtype") is not None:
+        import jax.numpy as jnp
+
+        kw["dtype"] = jnp.dtype(kw["dtype"])
+    kw.update(overrides)
+    if scheduler is not None:
+        kw["scheduler"] = scheduler
+    if drafter is not None:
+        kw["drafter"] = drafter
+    eng = DecodeEngine(model,
+                       journal_dir=(journal_dir if journal else None),
+                       **kw)
+    fp = cfg_rec.get("fp")
+    if fp and eng.config_fingerprint().hex() != fp:
+        raise ValueError(
+            "journal config fingerprint does not match the rebuilt "
+            "engine — wrong model weights or construction config")
+    if snap is not None:
+        # RNG fold counters continue where the dead engine's stopped
+        # (greedy ignores them; stochastic streams must not restart)
+        eng._step_no = snap.step_no
+        eng._prefill_no = snap.prefill_no
+
+    # journaled ids key the watermarks: new requests in this process
+    # must never collide with them
+    max_id = max([*state, *finished], default=-1)
+    Request._next_id = itertools.count(
+        max(max_id + 1, next(Request._next_id)))
+
+    t0 = _obs.now_ns()
+    reqs: Dict[int, "object"] = {}
+    for rid, w in state.items():
+        req = w.materialize()
+        if w.max_new - len(w.generated) <= 0:
+            # fully generated but the finish record was lost with the
+            # torn tail: terminal, nothing to recompute or re-emit
+            req.state = "done"
+            req.finish_reason = "length"
+        else:
+            req._engine = eng
+            req.t_enqueue_ns = _obs.now_ns()
+            if req.deadline_ms is not None:
+                req._deadline_ns = req.t_enqueue_ns + \
+                    int(req.deadline_ms * 1e6)
+            req.fault_info = FaultInfo(
+                site="restore", step=snap.step_no if snap else 0,
+                recovered=True,
+                message="restored from the on-disk journal after "
+                        "process death")
+            eng._queue.append(req)
+        reqs[rid] = req
+    _stats_add(restores=1)
+    _obs.record_span(
+        "engine", "restore", t0, _obs.now_ns() - t0,
+        tid=eng._engine_id,
+        args={"requests": len(reqs), "journal_events": len(events),
+              "snapshot": snap is not None})
+    return eng, reqs
+
+
+# ---------------------------------------------------------------------------
+# JAX persistent compilation cache (cross-process executable warm start)
+# ---------------------------------------------------------------------------
+_compile_cache_applied: Optional[str] = None
+
+
+def enable_compile_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir`` so a
+    fresh process's executables deserialize from disk instead of
+    recompiling (the cross-process half of fast recovery; in-process
+    recovery uses `DecodeEngine.adopt_executables`).  Process-global
+    and idempotent; returns False when this jax build does not expose
+    the cache config."""
+    global _compile_cache_applied
+
+    cache_dir = str(cache_dir)
+    if _compile_cache_applied == cache_dir:
+        return True
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:
+        return False
+    # CPU compiles are small and fast — without these thresholds the
+    # cache would skip exactly the executables a CPU test bed needs
+    for opt, val in (
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+            ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+        try:
+            jax.config.update(opt, val)
+        except Exception:
+            pass
+    # jax latches its cache decision at the FIRST compile; anything
+    # jitted before this call (model construction, eager dispatch)
+    # already concluded "no cache" — reset so the next compile
+    # re-initializes against the directory
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+    _compile_cache_applied = cache_dir
+    return True
+
+
+# ---------------------------------------------------------------------------
+# The hung-step watchdog
+# ---------------------------------------------------------------------------
+class StepWatchdog:
+    """Monitor armed around `DecodeEngine.step` when
+    ``FLAGS_step_timeout_ms`` (or the engine's ``step_timeout_ms``
+    argument) is positive.
+
+    Classification: a step is HUNG when it outran the budget AND
+    compiled nothing — executable compiles are expected warmup stalls,
+    detected by the engine's `_JitTracker` signatures (tracker count /
+    trace-cache sizes) changing across the step, so a first-step
+    compile never false-positives.  A hung step flips
+    ``paddle_engine_health`` to "hung" and raises a fatal
+    `errors.HungStep`; the supervisors (`serve_with_recovery`, the
+    frontend driver) route it through the existing engine-recovery
+    path.  `engine_warm` is the gate the frontend uses before arming
+    its harder measure — abandoning a worker thread that never
+    returns."""
+
+    def __init__(self, engine, timeout_ms: float):
+        self.engine = engine
+        self.timeout_ms = float(timeout_ms)
+        if self.timeout_ms <= 0:
+            raise ValueError(
+                f"step_timeout_ms must be > 0 to arm the watchdog, "
+                f"got {self.timeout_ms}")
+        self._sig = None
+
+    @property
+    def timeout_s(self) -> float:
+        return self.timeout_ms / 1e3
+
+    def _tracker_sig(self):
+        ts = self.engine._trackers()
+        return (len(ts), sum(t._seen for t in ts))
+
+    def engine_warm(self) -> bool:
+        """Every executable built so far is warm and at least one step
+        completed — arming the frontend's abandon timeout any earlier
+        would classify a warmup compile as a hang.  (An executable the
+        engine builds LAZILY after this reads True is still safe: the
+        frontend re-checks `compiled_since` at timeout before
+        abandoning.)"""
+        ts = self.engine._trackers()
+        return self.engine._step_no > 0 and bool(ts) and \
+            all(t._warm for t in ts)
+
+    def sig(self):
+        """Opaque compile signature for `compiled_since` (the
+        frontend takes it before scheduling a step on the worker)."""
+        return self._tracker_sig()
+
+    def compiled_since(self, sig) -> bool:
+        """Did an executable compile start or land since ``sig`` was
+        taken?  A `_JitTracker` is constructed BEFORE its first jit
+        invocation, so a compile still in flight on another thread is
+        already visible as a new tracker — the frontend uses this at
+        abandon-timeout time to tell a warmup stall from a hang."""
+        return self._tracker_sig() != sig
+
+    def arm(self):
+        """Called by the engine just before its device step."""
+        self._sig = self._tracker_sig()
+
+    def classify(self, dt_s: float) -> bool:
+        """True iff the step that just completed was hung: over budget
+        with no compile to excuse it."""
+        if dt_s <= self.timeout_s:
+            return False
+        return self._tracker_sig() == self._sig
+
+    def on_hung(self, dt_s: float):
+        """Record the verdict and raise the fatal `HungStep` the
+        recovery supervision consumes."""
+        from .serving import _stats_add
+
+        _stats_add(hung_steps=1)
+        set_health(self.engine._engine_id, "hung")
+        raise HungStep(
+            f"step stalled: {dt_s * 1e3:.1f}ms against a "
+            f"step_timeout_ms budget of {self.timeout_ms:.1f}ms with "
+            f"no executable compile in flight — classifying the "
+            f"engine as hung")

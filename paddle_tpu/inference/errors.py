@@ -19,6 +19,12 @@ taxonomy makes the distinction typed:
 * `InjectedFault` — a `FaultPlan` fired (FLAGS_fault_inject); subclass
   of `StepFault` so every recovery path handles injected and organic
   faults identically — which is the point of the harness;
+* `HungStep` — the watchdog (FLAGS_step_timeout_ms,
+  `inference.durability.StepWatchdog`) classified a step as hung: it
+  outran its wall-clock budget without compiling anything.  Subclass
+  of `StepFault` with ``fatal=True`` so the existing recovery
+  supervision (`serve_with_recovery`, `ServingFrontend._drive`)
+  rebuilds the engine without a dedicated code path;
 * `DegradedMode` — an operation needed a subsystem the engine has
   degraded away (e.g. crash recovery exhausted its rebuild budget).
 
@@ -37,7 +43,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 __all__ = ["ServingError", "PoolExhausted", "StepFault", "InjectedFault",
-           "DegradedMode", "FaultInfo"]
+           "HungStep", "DegradedMode", "FaultInfo"]
 
 
 class ServingError(RuntimeError):
@@ -72,6 +78,21 @@ class InjectedFault(StepFault):
     """A `FaultPlan` fired at a named site (FLAGS_fault_inject).
     Subclasses `StepFault` so containment cannot special-case injected
     faults — the harness proves the real recovery paths."""
+
+
+class HungStep(StepFault):
+    """The hung-step watchdog (`inference.durability.StepWatchdog`,
+    FLAGS_step_timeout_ms) classified a step as stalled: it outran its
+    wall-clock budget without compiling an executable.  Always
+    ``fatal`` — a hang means the device/runtime is suspect, so the
+    supervisor abandons the engine and rebuilds through the same
+    recovery path a fatal `StepFault` takes (streams stay alive,
+    already-emitted tokens are never re-emitted)."""
+
+    def __init__(self, message: str, site: str = "hung",
+                 attempts: int = 0):
+        super().__init__(message, site=site, attempts=attempts,
+                         fatal=True)
 
 
 class DegradedMode(ServingError):

@@ -648,7 +648,7 @@ class ServingFrontend:
         eng = self.engine
         return bool(eng._queue) or bool(eng._active.any())
 
-    def _recover_engine(self, fault) -> bool:
+    def _recover_engine(self, fault, snapshot=None) -> bool:
         """Supervision: a step fault survived the engine's whole
         containment ladder — rebuild the engine
         (`inference.resilience.recover`, which snapshots the dead
@@ -658,9 +658,14 @@ class ServingFrontend:
         the same `Request` objects re-admit with their generated
         tokens folded into the replay prompt, so the ``on_token``
         hooks keep feeding the same `TokenStream`s and no already-
-        emitted token is ever re-emitted.  False once the recovery
-        budget (``max_recoveries`` / FLAGS_engine_recoveries) is
-        spent — the caller lets the fault fail the frontend."""
+        emitted token is ever re-emitted.  The watchdog's abandon path
+        passes its PRE-STEP ``snapshot`` instead (the hung worker may
+        still hold the engine mid-step, so its live state cannot be
+        trusted); tokens emitted past that snapshot are recomputed
+        behind the `_emit` gate — streamed once, never twice.  False
+        once the recovery budget (``max_recoveries`` /
+        FLAGS_engine_recoveries) is spent — the caller lets the fault
+        fail the frontend."""
         from ..core import flags as _flags
         from . import resilience
 
@@ -669,7 +674,8 @@ class ServingFrontend:
         if self._recoveries >= limit:
             return False
         self._recoveries += 1
-        self.engine = resilience.recover(self.engine, fault=fault)
+        self.engine = resilience.recover(self.engine, snapshot=snapshot,
+                                         fault=fault)
         return True
 
     async def _drive(self):
@@ -697,8 +703,63 @@ class ServingFrontend:
                     if not self._stream_space():
                         await self._drained.wait()
                     continue
+                # hung-step watchdog (FLAGS_step_timeout_ms): once the
+                # engine is warm, steps run under an abandon timeout —
+                # a worker thread still stuck past the budget is
+                # ABANDONED (it may never return; awaiting it would
+                # hang the whole frontend) and the engine rebuilds from
+                # the pre-step snapshot, streams intact.  The snapshot
+                # costs one host-state copy per step and exists only
+                # while the watchdog is armed.
+                wd = self.engine._watchdog
+                arm_abandon = wd is not None and self._step_in_thread \
+                    and wd.engine_warm()
+                pre = None
+                if arm_abandon:
+                    from .resilience import EngineSnapshot
+
+                    pre = EngineSnapshot(self.engine)
                 try:
-                    if self._step_in_thread:
+                    if arm_abandon:
+                        pre_sig = wd.sig()
+                        loop = asyncio.get_running_loop()
+                        fut = loop.run_in_executor(None, self.engine.step)
+                        # the abandoned thread's late raise must not
+                        # surface as "exception never retrieved"
+                        fut.add_done_callback(
+                            lambda f: f.cancelled() or f.exception())
+                        try:
+                            # shield: wait_for must NOT await the
+                            # worker's cancellation — an executor job
+                            # cannot be interrupted, so awaiting it
+                            # would re-introduce the very hang the
+                            # watchdog exists to bound
+                            await asyncio.wait_for(asyncio.shield(fut),
+                                                   wd.timeout_s)
+                        except asyncio.TimeoutError:
+                            from . import durability
+                            from .errors import HungStep
+
+                            if wd.compiled_since(pre_sig):
+                                # a lazily-built executable is
+                                # compiling on the worker — an expected
+                                # warmup stall, not a hang: wait it out
+                                await asyncio.shield(fut)
+                            else:
+                                from .serving import _stats_add
+
+                                e = HungStep(
+                                    f"step still running after "
+                                    f"{wd.timeout_ms:.1f}ms — "
+                                    f"abandoning the hung worker")
+                                _stats_add(hung_steps=1)
+                                self.engine._abandon_inflight()
+                                durability.set_health(
+                                    self.engine._engine_id, "hung")
+                                if self._recover_engine(e, snapshot=pre):
+                                    continue
+                                raise e
+                    elif self._step_in_thread:
                         await asyncio.get_running_loop() \
                             .run_in_executor(None, self.engine.step)
                     else:

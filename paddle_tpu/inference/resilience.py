@@ -264,6 +264,13 @@ class ResilienceManager:
         self._count_injection(site)
         if site == "slow_step":
             time.sleep(plan.slow_ms / 1e3)
+            if self.engine._abandoned:
+                # the frontend watchdog abandoned this engine while we
+                # stalled: die here instead of running a step whose
+                # requests already belong to the rebuilt engine
+                raise StepFault(
+                    "engine abandoned by the hung-step watchdog "
+                    "mid-stall", site="hung", fatal=True)
             return
         if site == "pool":
             raise PoolExhausted(
@@ -334,6 +341,9 @@ class ResilienceManager:
         self._drafter_fail = 0
         _stats_add(spec_disables=1)
         _obs.DEGRADED_MODE.set(1, engine=eng._engine_id, mode="spec_off")
+        from .durability import set_health
+
+        set_health(eng._engine_id, "degraded")
         _obs.record_span("engine", "degrade:spec_off", _obs.now_ns(), 0,
                          tid=eng._engine_id,
                          args={"error": str(err)[:200]})
@@ -364,6 +374,9 @@ class ResilienceManager:
         _stats_add(legacy_fallbacks=1)
         _obs.DEGRADED_MODE.set(1, engine=eng._engine_id,
                                mode="legacy_prefill")
+        from .durability import set_health
+
+        set_health(eng._engine_id, "degraded")
         _obs.record_span("engine", "degrade:legacy_prefill",
                          _obs.now_ns(), 0, tid=eng._engine_id,
                          args={"error": str(err)[:200]})
@@ -396,6 +409,10 @@ class ResilienceManager:
             self.legacy_mode = False
             _obs.DEGRADED_MODE.set(0, engine=eng._engine_id,
                                    mode="legacy_prefill")
+        if not (self.spec_disabled or self.legacy_mode):
+            from .durability import set_health
+
+            set_health(eng._engine_id, "live")
 
     # -- the ladder ----------------------------------------------------------
     def _mode_kind(self) -> str:
@@ -441,6 +458,11 @@ class ResilienceManager:
             except self.NONRETRYABLE:
                 raise
             except Exception as e:
+                if eng._abandoned:
+                    # the watchdog abandoned this engine: its requests
+                    # live on the rebuilt one — containment here would
+                    # mutate state nobody owns anymore
+                    raise
                 last = e
                 self._fail[kind] = self._fail.get(kind, 0) + 1
                 if attempt < retries:
@@ -525,7 +547,7 @@ class ResilienceManager:
 # ---------------------------------------------------------------------------
 class _ReqRecord:
     __slots__ = ("request", "prompt_ids", "output_ids", "max_new",
-                 "absorbed")
+                 "absorbed", "orig_len", "streamed")
 
     def __init__(self, request):
         self.request = request
@@ -533,6 +555,12 @@ class _ReqRecord:
         self.output_ids = list(request.output_ids)
         self.max_new = int(request.max_new_tokens)
         self.absorbed = int(request._absorbed)
+        self.orig_len = int(request.orig_prompt_len)
+        # emitted-token watermark at capture: generated tokens the
+        # stream has consumed, plus any still-pending emit gate (a
+        # gated token was streamed by an earlier life)
+        self.streamed = self.absorbed + len(self.output_ids) + \
+            int(request._emit_gate)
 
 
 class EngineSnapshot:
@@ -561,9 +589,25 @@ class EngineSnapshot:
     def __len__(self):
         return len(self.records)
 
+    def to_wire(self, journal_pos: int = 0):
+        """The serialization-safe split (`durability.SnapshotWire`):
+        the in-process form keeps `Request` objects BY REFERENCE so
+        streams/hooks survive a rebuild, which is exactly wrong on
+        disk — the wire form carries only picklable/JSON-able state
+        (original prompt, generated values, budgets, the emitted-token
+        watermark) a fresh process can re-admit from."""
+        from .durability import RequestWire, SnapshotWire
+
+        return SnapshotWire(
+            engine_id=self.engine_id, step_no=self.step_no,
+            prefill_no=self.prefill_no, journal_pos=int(journal_pos),
+            records=[RequestWire.from_record(rec)
+                     for rec in self.records])
+
 
 def recover(engine, snapshot: Optional[EngineSnapshot] = None,
-            fault: Optional[BaseException] = None):
+            fault: Optional[BaseException] = None,
+            handoff: bool = True):
     """Rebuild a fresh engine after a fatal fault and re-admit every
     in-flight request.  The dead engine's resolved constructor config
     (`engine._ctor`) rebuilds an identical engine — same weights, same
@@ -572,6 +616,15 @@ def recover(engine, snapshot: Optional[EngineSnapshot] = None,
     plan object carries its occurrence counters over so an injected
     schedule cannot re-fire after the rebuild.
 
+    ``handoff=True`` (default) additionally hands the dead engine's
+    live compiled executables to the rebuilt engine
+    (`DecodeEngine.adopt_executables`): the config fingerprints match
+    by construction (same `_ctor`), so the signature keys are
+    identical and the rebuilt engine's first step reuses the warm jit
+    caches instead of recompiling — recompile DOMINATED recovery
+    latency before this (tools/bench_recovery.py pins the ratio).
+    Any fingerprint mismatch falls back to recompile silently.
+
     Each request's generated tokens fold into its prompt (exactly the
     `DecodeEngine.preempt` fold: ``max_new_tokens`` shrinks one for
     one, ``generated_ids`` stays complete), so replay is an ordinary
@@ -579,13 +632,31 @@ def recover(engine, snapshot: Optional[EngineSnapshot] = None,
     outputs are bit-identical to a fault-free serve, recovered
     requests sharing prefixes hit the rebuilt prefix cache against
     each other, and already-emitted tokens are never re-emitted (the
-    streaming hook only ever sees novel tokens).
+    streaming hook only ever sees novel tokens).  When recovering from
+    an OLDER snapshot than the live request state (the watchdog's
+    abandon path hands the pre-step snapshot), tokens the live request
+    emitted past the snapshot are recomputed behind the `_emit` gate —
+    streamed once, never twice.
 
     The OLD engine is retired: its scheduler/drafter now belong to the
     new engine and its device buffers are garbage."""
+    from .durability import clear_health, set_health
     from .serving import DecodeEngine, _stats_add
 
     snap = snapshot if snapshot is not None else EngineSnapshot(engine)
+    dead_dur, engine._durability = engine._durability, None
+    if dead_dur is not None:
+        # a fatal fault escaped step() BEFORE its boundary flush:
+        # records buffered during the failing step (e.g. a bisect
+        # quarantine's finish) must reach disk, or a later process
+        # death would restore a request this recovery already retired.
+        # close() retires the handle too — the SUCCESSOR engine owns
+        # the journal from here, never two live writers
+        try:
+            dead_dur.close()
+        except Exception:
+            pass  # best effort — the old handle may already be dead
+    t0 = time.perf_counter()
     t0_ns = _obs.now_ns()
     kw = dict(engine._ctor)
     for key in ("scheduler", "drafter"):
@@ -593,6 +664,9 @@ def recover(engine, snapshot: Optional[EngineSnapshot] = None,
         if obj is not None and hasattr(obj, "engine"):
             obj.engine = None  # unbind: bind() rebuilds per-engine state
     new = DecodeEngine(**kw)
+    set_health(new._engine_id, "recovering")
+    if handoff:
+        new.adopt_executables(engine)
     # RNG fold counters carry over so the rebuilt engine's sampling
     # streams continue where the dead engine's stopped (greedy ignores
     # them; stochastic streams must not restart from fold 1)
@@ -604,10 +678,17 @@ def recover(engine, snapshot: Optional[EngineSnapshot] = None,
         req = rec.request
         if req.state == "done":
             continue  # quarantined/finished between capture and recover
+        # tokens the live request streamed PAST the captured record
+        # (the watchdog abandoned a step that had already emitted):
+        # replay recomputes them deterministically, the gate keeps
+        # them from re-firing at the stream
+        live_streamed = req._absorbed + len(req.output_ids) + \
+            req._emit_gate
         n_gen = len(rec.output_ids)
         req.prompt_ids = list(rec.prompt_ids) + list(rec.output_ids)
         req.max_new_tokens = rec.max_new - n_gen
         req._absorbed = rec.absorbed + n_gen
+        req._emit_gate = max(0, live_streamed - rec.absorbed - n_gen)
         req.output_ids = []
         req.pages = []
         req.slot = None
@@ -629,10 +710,15 @@ def recover(engine, snapshot: Optional[EngineSnapshot] = None,
         n_readmitted += 1
     _stats_add(recoveries=1)
     _obs.RECOVERIES.inc()
+    _obs.RECOVERY_SECONDS.observe(time.perf_counter() - t0)
     _obs.record_span("engine", "recovery", t0_ns,
                      _obs.now_ns() - t0_ns, tid=new._engine_id,
                      args={"from_engine": snap.engine_id,
                            "requests": n_readmitted, "site": site})
+    set_health(new._engine_id, "live")
+    # retire the dead engine from the health gauge: a recovered hang
+    # must not leave its {state="hung"} series latched at 1 forever
+    clear_health(engine._engine_id)
     return new
 
 

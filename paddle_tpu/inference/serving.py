@@ -564,6 +564,11 @@ class Request:
         self._absorbed = 0
         self.preemptions = 0
         self._hol_skips = 0
+        # emitted-token gate (inference.durability): > 0 while replay
+        # is recomputing tokens an earlier life (pre-crash process, or
+        # a watchdog-abandoned step) already streamed — `_emit` lands
+        # them on output_ids but never re-fires on_token for them
+        self._emit_gate = 0
         # SLO accounting: violation kinds recorded for this request
         # ("ttft" | "tpot" | "deadline")
         self.slo_violations: List[str] = []
@@ -906,7 +911,8 @@ class DecodeEngine:
                  eos_token_id=None, dtype=None, spec_decode_k=None,
                  drafter=None, chunked_prefill=None,
                  prefill_chunk_tokens=None, prefill_q_max=None,
-                 prefix_cache=None, scheduler=None, fault_plan=None):
+                 prefix_cache=None, scheduler=None, fault_plan=None,
+                 journal_dir=None, step_timeout_ms=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -1079,6 +1085,27 @@ class DecodeEngine:
         self._chunked_cfg = self._chunked
         self._prefix_cache_cfg = self._prefix_cache
 
+        # durable serving + hung-step watchdog (inference.durability):
+        # explicit args win, else the flags.  Disarmed, both are None
+        # and every hook on the serve path is a single `is None` check.
+        if journal_dir is None:
+            journal_dir = str(_flags.flag("journal_dir")) or None
+        if step_timeout_ms is None:
+            step_timeout_ms = float(_flags.flag("step_timeout_ms"))
+        self._journal_dir = journal_dir
+        self._step_timeout_ms = float(step_timeout_ms)
+        # set True by the watchdog's abandon path: a step still blocked
+        # in a worker thread must mutate nothing when it returns
+        self._abandoned = False
+        self._config_fp: Optional[bytes] = None
+        self._durability = None
+        self._watchdog = None
+        compile_cache = str(_flags.flag("compile_cache_dir"))
+        if compile_cache:
+            from .durability import enable_compile_cache
+
+            enable_compile_cache(compile_cache)
+
         # everything `resilience.recover` needs to rebuild THIS engine
         # after a fatal fault: the resolved construction config (flag
         # lookups already applied, so a flag flip mid-serve cannot
@@ -1101,7 +1128,21 @@ class DecodeEngine:
             prefill_chunk_tokens=self._chunk_budget,
             prefill_q_max=self._q_max,
             prefix_cache=self._prefix_cache,
-            scheduler=self._scheduler, fault_plan=self._fault)
+            scheduler=self._scheduler, fault_plan=self._fault,
+            journal_dir=self._journal_dir,
+            step_timeout_ms=self._step_timeout_ms)
+
+        if self._journal_dir:
+            from .durability import DurabilityManager
+
+            self._durability = DurabilityManager(self, self._journal_dir)
+        if self._step_timeout_ms > 0:
+            from .durability import StepWatchdog
+
+            self._watchdog = StepWatchdog(self, self._step_timeout_ms)
+        from .durability import set_health
+
+        set_health(self._engine_id, "live", span=False)
 
     def _model_fingerprint(self) -> bytes:
         """Sampling-invariant model identity — the chain-hash root.
@@ -1128,6 +1169,129 @@ class DecodeEngine:
                       self._num_heads, self._head_dim,
                       self._page)).encode())
         return h.digest()
+
+    def config_fingerprint(self) -> bytes:
+        """Digest of everything that determines this engine's
+        executable SIGNATURES and numerics: a weight-content sample
+        (the `_model_fingerprint` scheme — wte row 0 + one qkv row per
+        block), the architecture dims, every shape-determining
+        constructor knob, and the sampling config.  Two engines with
+        equal fingerprints compile byte-identical step programs, which
+        is the gate for `adopt_executables` handoff and for
+        `durability.restore_from_dir` validating a rebuilt engine
+        against its journal.  Memoized (a few small host transfers on
+        first call)."""
+        if self._config_fp is None:
+            h = hashlib.blake2b(digest_size=16)
+            p = self._params
+            h.update(np.asarray(jax.device_get(p["wte"][0]),
+                                np.float32).tobytes())
+            for blk in p["blocks"]:
+                h.update(np.asarray(jax.device_get(blk["qkv_w"][0]),
+                                    np.float32).tobytes())
+            h.update(str((
+                tuple(p["wte"].shape), len(p["blocks"]),
+                self._num_heads, self._head_dim, self._eps,
+                self._slots, self._max_seq_len, self._page,
+                self.pool.num_pages, self._q_max,
+                int(self._ctor["prefill_chunk_tokens"]),
+                str(self._k_pages.dtype),
+                tuple(sorted(self._sampling.items())),
+                self._spec.k if self._spec else 0,
+                self._chunked_cfg)).encode())
+            self._config_fp = h.digest()
+        return self._config_fp
+
+    def wire_config(self) -> dict:
+        """The serializable subset of the resolved constructor config —
+        what the journal's config record carries so
+        `durability.restore_from_dir` can rebuild this engine in a
+        fresh process (the caller supplies the model; scheduler /
+        drafter / fault-plan objects are process-local and excluded)."""
+        kw = {k: v for k, v in self._ctor.items()
+              if k not in ("model", "scheduler", "drafter",
+                           "fault_plan", "journal_dir")}
+        if kw.get("dtype") is not None:
+            kw["dtype"] = str(jnp.dtype(kw["dtype"]))
+        if kw.get("eos_token_id") is not None:
+            kw["eos_token_id"] = int(kw["eos_token_id"])
+        return kw
+
+    def _trackers(self) -> List[_JitTracker]:
+        """Every live `_JitTracker` this engine (and its speculative
+        subsystem) currently holds — the watchdog's compile detector
+        and the handoff's donor surface."""
+        ts = [self._decode_fn, self._mixed_fn,
+              *self._prefill_fns.values()]
+        if self._spec is not None:
+            ts.append(self._spec._verify_fn)
+            d = self._spec.drafter
+            for name in ("_catch_fn", "_step_fn", "_chunk_fn"):
+                ts.append(getattr(d, name, None))
+            ts.extend(getattr(d, "_prefill_fns", {}).values())
+        return [t for t in ts if t is not None]
+
+    def adopt_executables(self, donor) -> int:
+        """Executable handoff: take a retired engine's live compiled
+        step executables instead of recompiling them.  Safe ONLY when
+        the config fingerprints match — identical fingerprints mean
+        identical executable signatures, so the donor's warm jit
+        caches serve this engine's shapes without a retrace; on any
+        mismatch nothing is adopted and the executables compile lazily
+        as usual (the cold fallback).  Returns the number adopted.
+        The drafter instance is REUSED across a recovery (not
+        reconstructed), so its executables carry over without passing
+        through here."""
+        if donor is self or \
+                donor.config_fingerprint() != self.config_fingerprint():
+            return 0
+        n = 0
+        if self._decode_fn is None and donor._decode_fn is not None:
+            self._decode_fn = donor._decode_fn
+            n += 1
+        if self._mixed_fn is None and donor._mixed_fn is not None:
+            self._mixed_fn = donor._mixed_fn
+            n += 1
+        for bucket, fn in donor._prefill_fns.items():
+            if bucket not in self._prefill_fns:
+                self._prefill_fns[bucket] = fn
+                n += 1
+        if self._spec is not None and donor._spec is not None and \
+                self._spec._verify_fn is None and \
+                donor._spec._verify_fn is not None:
+            self._spec._verify_fn = donor._spec._verify_fn
+            n += 1
+        if n:
+            _stats_add(exec_handoffs=n)
+        return n
+
+    def _abandon_inflight(self):
+        """Watchdog abandonment: neutralize this engine so a step
+        still blocked in a worker thread mutates nothing visible when
+        it finally returns — its requests now belong to the rebuilt
+        engine.  The host loop after a late-returning executable sees
+        no active slot and emits nothing; the slow_step fault site
+        (and the containment ladder) re-raise instead of containing.
+        Device buffers and pool state are garbage from here on.
+
+        The durability manager detaches FIRST: the successor engine
+        owns the journal directory from here, and a late-returning
+        step on this engine must neither flush stale records nor
+        overwrite the successor's snapshot with this engine's (now
+        empty) state."""
+        self._abandoned = True
+        dur, self._durability = self._durability, None
+        if dur is not None:
+            try:
+                dur.close()
+            except Exception:
+                pass  # best effort: the hung worker may hold the handle
+        self._watchdog = None
+        self._by_slot = [None] * self._slots
+        self._active = np.zeros(self._slots, bool)
+        self._queue.clear()
+        self._free_slots = list(range(self._slots))
+        heapq.heapify(self._free_slots)
 
     # -- request lifecycle ---------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=32,
@@ -1160,6 +1324,8 @@ class DecodeEngine:
                 int(req.deadline_ms * 1e6)
         _obs.REQUESTS_ENQUEUED.inc()
         self._queue.append(req)
+        if self._durability is not None:
+            self._durability.on_admit(req)
         return req
 
     def _pages_for(self, tokens: int) -> int:
@@ -1487,8 +1653,21 @@ class DecodeEngine:
         the exception is recorded on ``req.fault_info``, the callback
         is dropped for the rest of the request, and the serve loop
         never unwinds mid-step.  Generation continues; only the
-        streaming side goes quiet (``output_ids`` stays complete)."""
+        streaming side goes quiet (``output_ids`` stays complete).
+
+        Durable serving rides this chokepoint too: the journal's
+        emitted-token watermark is appended (write-ahead — durable
+        before the stream sees the token under ``journal_fsync=
+        always``), and ``req._emit_gate`` suppresses the callback for
+        replay tokens an earlier life already streamed."""
         req.output_ids.extend(toks)
+        gate = req._emit_gate
+        if gate:
+            skip = min(gate, len(toks))
+            req._emit_gate = gate - skip
+            toks = toks[skip:]
+        if self._durability is not None:
+            self._durability.on_emit(req)
         cb = req.on_token
         if cb is None:
             return
@@ -1564,6 +1743,8 @@ class DecodeEngine:
                        "fault": "finished_fault"}[reason]: 1})
         req.t_finish_ns = _obs.now_ns()
         _obs.REQUESTS_FINISHED.inc(reason=reason)
+        if self._durability is not None:
+            self._durability.on_finish(req)
         # generated-token count is preemption-stable: tokens folded
         # into the replay prompt still count toward TPOT
         n_out = len(req.output_ids) + req._absorbed
@@ -1711,6 +1892,8 @@ class DecodeEngine:
                        "deadline": "deadline_expired",
                        "fault": "finished_fault"}[reason]: 1})
         _obs.REQUESTS_FINISHED.inc(reason=reason)
+        if self._durability is not None:
+            self._durability.on_finish(req)
         if reason == "deadline":
             _obs.SCHED_DEADLINE_EXPIRED.inc()
         if req.t_enqueue_ns is not None:
@@ -2039,8 +2222,26 @@ class DecodeEngine:
             (_obs.now_ns() - min(r.t_enqueue_ns for r in self._queue))
             / 1e9 if self._queue else 0.0, engine=eid)
         if not self._active.any():
+            if self._durability is not None:
+                self._durability.on_step_boundary()
             return bool(self._queue)
-        return self._resilience.run_step()
+        wd = self._watchdog
+        if wd is not None:
+            wd.arm()
+            t0_wd = time.perf_counter()
+        out = self._resilience.run_step()
+        if self._durability is not None:
+            self._durability.on_step_boundary()
+        if wd is not None:
+            dt_wd = time.perf_counter() - t0_wd
+            if wd.classify(dt_wd):
+                # post-hoc hang verdict: the step DID complete (its
+                # tokens are emitted and journaled — recovery folds
+                # them, nothing re-emits), but an engine this slow is
+                # suspect: flip health to hung and hand the fatal
+                # HungStep to the recovery supervision
+                wd.on_hung(dt_wd)
+        return out
 
     def _step_inner(self) -> bool:
         """ONE batched device step over the already-admitted batch —

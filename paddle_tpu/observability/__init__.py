@@ -239,6 +239,22 @@ DEGRADED_MODE = gauge(
     "the fall back to the one-shot prefill oracle path); 0 after the "
     "re-enable probe (FLAGS_degraded_probe_steps) restores it",
     labels=("engine", "mode"))
+ENGINE_HEALTH = gauge(
+    "paddle_engine_health",
+    "One-hot engine health state (exactly one state label reads 1 per "
+    "engine): live (serving normally), degraded (a subsystem is "
+    "degraded away — mirrors paddle_degraded_mode), recovering (an "
+    "engine rebuild is re-admitting this engine's requests), hung "
+    "(the step watchdog classified a stalled step; the supervisor is "
+    "expected to abandon and rebuild).  Transitions also land as "
+    "health:* engine spans so the sequence is reconstructable",
+    labels=("engine", "state"))
+RECOVERY_SECONDS = histogram(
+    "paddle_recovery_seconds",
+    "Wall time of one engine recovery (inference.resilience.recover): "
+    "rebuild + re-admission, executable handoff included when the "
+    "config fingerprints matched — the latency a fatal fault adds "
+    "before the engine serves again")
 
 
 # ---------------------------------------------------------------------------

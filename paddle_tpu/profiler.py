@@ -76,6 +76,13 @@ DECODE_STAT_COUNTERS = (
     # prefill fallen back to the legacy oracle path)
     "faults_injected", "step_retries", "finished_fault", "recoveries",
     "spec_disables", "legacy_fallbacks",
+    # durable serving (inference.durability): write-ahead journal
+    # records appended, on-disk snapshots written, fresh-process
+    # restores performed, executables handed from a dead engine to its
+    # rebuilt successor (recompiles avoided), and steps the watchdog
+    # classified as hung (FLAGS_step_timeout_ms)
+    "journal_records", "journal_snapshots", "restores", "exec_handoffs",
+    "hung_steps",
 )
 DECODE_STAT_DERIVED = ("avg_step_ms", "batch_occupancy",
                        "kv_block_utilization",

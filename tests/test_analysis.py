@@ -426,6 +426,43 @@ class TestEngineMutationLint:
         """, name="inference/resilience.py")
         assert EngineMutationPass(REPO_ENGINE_RULE).run(mods) == []
 
+    def test_unsanctioned_restore_mutation_flags(self, tmp_path):
+        """The REPO rule sanctions durable-restore / watchdog engine
+        mutation ONLY in inference/durability.py (and the frontend's
+        supervision sites): a rogue module replaying the restore moves
+        — executable handoff, watchdog abandonment, counter restores —
+        must still flag."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        mods = _scan_snippet(tmp_path, """
+            class RogueRestore:
+                def resurrect(self, engine):
+                    engine.adopt_executables(self.donor)
+                    engine._abandon_inflight()
+                    self.engine._step_no = 3
+        """, name="rogue_restore.py")
+        found = EngineMutationPass(REPO_ENGINE_RULE).run(mods)
+        msgs = sorted(f.message for f in found)
+        assert len(found) == 3, msgs
+        assert any(".adopt_executables()" in m for m in msgs)
+        assert any("._abandon_inflight()" in m for m in msgs)
+        assert any("attribute store" in m for m in msgs)
+        assert all("RogueRestore.resurrect" in m for m in msgs)
+
+    def test_repo_rule_sanctions_durability_module(self, tmp_path):
+        """The identical restore-style mutation inside the sanctioned
+        durability module scans clean."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        (tmp_path / "inference").mkdir()
+        mods = _scan_snippet(tmp_path, """
+            def restore(engine, donor):
+                engine.adopt_executables(donor)
+                engine._step_no = 3
+                engine._abandon_inflight()
+        """, name="inference/durability.py")
+        assert EngineMutationPass(REPO_ENGINE_RULE).run(mods) == []
+
 
 # ---------------------------------------------------------------------------
 # donation analysis

@@ -343,6 +343,44 @@ def test_bench_chaos_smoke(tmp_path):
     assert legs["chaos"]["faults_injected"] >= 3
 
 
+def test_bench_recovery_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_recovery.py runs end-to-end: the
+    durable-serving bench can't rot.  Asserts the acceptance bar at
+    smoke scale: in-process recovery with executable handoff >= 5x
+    faster than cold recompile recovery with greedy parity in both
+    legs, and a kill -9'd serve resumed in a FRESH process from
+    journal+snapshot with zero request loss, no re-emitted stream
+    tokens, and bit-identical greedy outputs vs the uninterrupted
+    reference."""
+    out = str(tmp_path / "bench_recovery.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_recovery.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    s = data["summary"]
+    assert s["handoff_speedup"] >= 5.0
+    assert s["in_process_parity"] is True
+    assert s["killed_by_sigkill"] is True
+    assert s["zero_request_loss"] is True
+    assert s["no_reemitted_tokens"] is True
+    assert s["bit_identical"] is True
+    legs = data["legs"]
+    # handoff really did skip the recompiles the cold leg paid
+    assert legs["in_process"]["exec_handoffs"] >= 1
+    assert legs["in_process"]["handoff_leg_recompiles"] == 0
+    assert legs["in_process"]["cold_leg_recompiles"] >= 1
+    assert legs["in_process"]["retraces_after_warmup"] == 0
+    cross = legs["cross_process"]
+    assert cross["serve_exit"] == -9  # SIGKILL, not a clean exit
+    assert cross["tokens_streamed_before_kill"] >= 1
+    assert cross["snapshot_present"] is True
+    assert cross["journal_events"] >= 3
+
+
 def test_telemetry_dump_smoke(tmp_path):
     """tools/telemetry_dump.py runs a small engine workload end-to-end
     and every export format parses: Prometheus text has the core

@@ -28,10 +28,12 @@ Asserted (the robustness acceptance bar):
   happened (the schedule exercised the ladder, not just the happy
   path);
 * **bounded latency degradation** — chaos-leg mean TTFT/TPOT within
-  ``--bound``x of the clean leg.  On CPU the bound is dominated by
-  the recovery's executable recompile (a rebuilt engine re-traces its
-  step programs); on TPU a persistent compilation cache would shrink
-  it — the number is reported either way.
+  ``--bound``x of the clean leg.  Recovery hands the dead engine's
+  compiled executables to the rebuilt one (inference.durability), so
+  the bound is no longer recompile-dominated: what remains is the
+  fault burst itself (failed steps, bisection retries, queue wait
+  during containment).  Measured x22.7 on CPU with handoff vs x72
+  when recovery recompiled — the default bound is 50 (was 200).
 
 Emits BENCH_chaos.json.
 
@@ -214,10 +216,11 @@ def main():
                          "retries + bisection so recovery fires)")
     ap.add_argument("--nan-at", type=int, default=12)
     ap.add_argument("--max-recoveries", type=int, default=4)
-    ap.add_argument("--bound", type=float, default=200.0,
-                    help="chaos/clean latency ratio bound (CPU: "
-                         "dominated by the rebuilt engine's "
-                         "recompiles)")
+    ap.add_argument("--bound", type=float, default=50.0,
+                    help="chaos/clean latency ratio bound (recovery "
+                         "reuses the dead engine's executables via "
+                         "handoff, so the fault burst itself — not "
+                         "recompiles — sets the ratio)")
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--hidden", type=int, default=128)
     ap.add_argument("--heads", type=int, default=4)
