@@ -63,6 +63,17 @@ REPO_LOCK_RULES: Dict[str, LockRule] = {
         locks=("_lock",),
         roots=("_spans", "_dropped"),
     ),
+    # flight recorder: every CROSS-THREAD surface — the sealed-record
+    # ring, the window totals, the goodput counters — mutates under
+    # the module's designated lock (statusz and dump read from
+    # arbitrary threads while the engine thread appends).  The OPEN
+    # record (`_cur`'s contents) is deliberately engine-thread-private
+    # and lock-free, so it is not listed here.
+    "observability/flight.py": LockRule(
+        locks=("_lock",),
+        self_attrs=("_ring", "_win_tokens", "_win_time",
+                    "_fin_total", "_fin_met", "dumps"),
+    ),
     "observability/reporter.py": LockRule(
         locks=("_lock",),
         roots=("_thread", "_stop"),
@@ -128,6 +139,12 @@ REPO_ENGINE_RULE = EngineRule(
             "ServingFrontend._apply_control", "ServingFrontend._drive",
             "ServingFrontend._recover_engine",
         ),
+        # the flight recorder READS engine state (batch composition,
+        # pool occupancy, SLO burn) from inside the step — sanctioned
+        # for exactly the recorder class so a rogue recorder that
+        # MUTATES the engine (the tempting bug: "just retire the slow
+        # request from here") still flags
+        "observability/flight.py": ("FlightRecorder.",),
     },
 )
 

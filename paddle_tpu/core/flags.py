@@ -261,6 +261,24 @@ define_flag("step_timeout_ms", 0.0,
             "worker thread still stuck past the budget.  Steps that "
             "compiled an executable are exempt (compiles are expected "
             "warmup stalls, not hangs).  0 (default) = disarmed")
+define_flag("flight_window", 64,
+            "serving flight recorder (observability.flight): number of "
+            "per-step records the bounded ring buffer retains — one "
+            "structured record per DecodeEngine.step (batch "
+            "composition, phase-time breakdown, ladder events, pool "
+            "occupancy, SLO burn).  Always-on and always-cheap by "
+            "design; 0 disables the recorder entirely (statusz then "
+            "serves engine state without flight history)")
+define_flag("flight_dir", "",
+            "directory for crash-safe flight-window auto-dumps (tmp+"
+            "rename, same discipline as durability snapshots): every "
+            "fatal StepFault, hung-step classification and watchdog "
+            "abandonment leaves a black-box JSON the "
+            "tools/explain_request.py timeline reconstructor reads.  "
+            "Empty (default) = beside the journal "
+            "(<journal_dir>/flight) when FLAGS_journal_dir is armed, "
+            "else auto-dump is off (the in-memory ring and statusz "
+            "still work)")
 define_flag("use_rbg_rng", True,
             "on TPU, use the hardware RBG PRNG for the framework's random "
             "ops instead of threefry (measured: recovers ~60% of dropout's "

@@ -66,8 +66,8 @@ from .errors import FaultInfo, HungStep
 __all__ = ["RequestWire", "SnapshotWire", "DurabilityManager",
            "StepWatchdog", "read_journal", "load_snapshot",
            "restore_from_dir", "enable_compile_cache", "set_health",
-           "clear_health", "HEALTH_STATES", "JOURNAL_NAME",
-           "SNAPSHOT_NAME"]
+           "clear_health", "retire_engine_series", "HEALTH_STATES",
+           "JOURNAL_NAME", "SNAPSHOT_NAME"]
 
 JOURNAL_NAME = "journal.wal"
 SNAPSHOT_NAME = "snapshot.json"
@@ -300,6 +300,21 @@ def clear_health(engine_id: int):
     prev = _health_state.pop(engine_id, None)
     if prev is not None:
         _obs.ENGINE_HEALTH.set(0, engine=engine_id, state=prev)
+
+
+def retire_engine_series(engine_id: int) -> int:
+    """Retire a DEAD engine's ENTIRE per-engine gauge catalog — the
+    whole-catalog generalization of `clear_health`: pool/occupancy/
+    queue gauges, degraded-mode and health one-hots, flight
+    throughput/goodput/burn gauges.  `resilience.recover` calls this
+    for the engine it replaced and `DecodeEngine._abandon_inflight`
+    for the engine the watchdog abandoned, so a retired engine id
+    leaves the scrape surface (and `statusz` output) instead of
+    reading stale levels forever.  Engine ids are never reused
+    (`DecodeEngine._next_engine_id` is monotonic), so nothing can race
+    a retirement back to life.  Returns the series count removed."""
+    clear_health(engine_id)
+    return _obs.registry.retire_label("engine", engine_id)
 
 
 # ---------------------------------------------------------------------------
@@ -560,6 +575,10 @@ def restore_from_dir(journal_dir: str, model, scheduler=None,
         tid=eng._engine_id,
         args={"requests": len(reqs), "journal_events": len(events),
               "snapshot": snap is not None})
+    if eng._flight is not None:
+        eng._flight.event("restore", requests=len(reqs),
+                          journal_events=len(events),
+                          snapshot=snap is not None)
     return eng, reqs
 
 

@@ -590,6 +590,44 @@ class ServingFrontend:
         if self._drained is not None:
             self._drained.set()
 
+    # -- live introspection ---------------------------------------------------
+    def debug_dump(self, flight_records: int = 8) -> dict:
+        """Consistent live JSON snapshot of the whole serving stack:
+        the frontend's own state (queue bound, open streams with their
+        buffered-token counts, pending control actions, recovery
+        budget spent) wrapping `DecodeEngine.statusz` — queue, slots,
+        degraded modes, health, cache occupancy, SLO burn, and the
+        last ``flight_records`` flight records.  Synchronous and
+        read-only: callable MID-SERVE from any thread (an operator
+        shell, a health endpoint) without perturbing the driver or the
+        outputs."""
+        streams = {}
+        for _ in range(8):
+            try:
+                for req, s in self._streams.items():
+                    streams[req.request_id] = {
+                        "state": req.state,
+                        "pending_tokens": s.pending,
+                    }
+                break
+            except RuntimeError:  # resized mid-iteration: retry
+                streams = {}
+        return {
+            "frontend": {
+                "closing": self._closing,
+                "closed": self._closed,
+                "driver_alive": self._driver is not None
+                and not self._driver.done(),
+                "max_queue_depth": self.max_queue_depth,
+                "stream_buffer": self.stream_buffer,
+                "open_streams": streams,
+                "pending_control": len(self._control),
+                "recoveries": self._recoveries,
+            },
+            "engine": self.engine.statusz(
+                flight_records=flight_records),
+        }
+
     # -- driver --------------------------------------------------------------
     def _apply_control(self):
         """Apply queued submissions/cancellations — engine idle here

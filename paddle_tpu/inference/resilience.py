@@ -347,6 +347,9 @@ class ResilienceManager:
         _obs.record_span("engine", "degrade:spec_off", _obs.now_ns(), 0,
                          tid=eng._engine_id,
                          args={"error": str(err)[:200]})
+        if eng._flight is not None:
+            eng._flight.event("degrade", mode="spec_off",
+                              error=str(err)[:120])
         return True
 
     def _maybe_degrade_legacy(self, err: Exception) -> bool:
@@ -380,6 +383,9 @@ class ResilienceManager:
         _obs.record_span("engine", "degrade:legacy_prefill",
                          _obs.now_ns(), 0, tid=eng._engine_id,
                          args={"error": str(err)[:200]})
+        if eng._flight is not None:
+            eng._flight.event("degrade", mode="legacy_prefill",
+                              error=str(err)[:120])
         return True
 
     def _note_success(self):
@@ -403,12 +409,16 @@ class ResilienceManager:
             self.spec_disabled = False
             _obs.DEGRADED_MODE.set(0, engine=eng._engine_id,
                                    mode="spec_off")
+            if eng._flight is not None:
+                eng._flight.event("degrade_end", mode="spec_off")
         if self.legacy_mode:
             eng._chunked = eng._chunked_cfg
             eng._prefix_cache = eng._prefix_cache_cfg
             self.legacy_mode = False
             _obs.DEGRADED_MODE.set(0, engine=eng._engine_id,
                                    mode="legacy_prefill")
+            if eng._flight is not None:
+                eng._flight.event("degrade_end", mode="legacy_prefill")
         if not (self.spec_disabled or self.legacy_mode):
             from .durability import set_health
 
@@ -436,6 +446,9 @@ class ResilienceManager:
         self.backoff_ticks += ticks
         _stats_add(step_retries=1)
         _obs.STEP_RETRIES.inc()
+        fl = self.engine._flight
+        if fl is not None:
+            fl.event("retry", attempt=attempt, ticks=ticks)
         base_ms = float(_flags.flag("step_backoff_ms"))
         if base_ms > 0:
             time.sleep(ticks * base_ms / 1e3)
@@ -538,6 +551,11 @@ class ResilienceManager:
                 args={"request": suspect.request_id,
                       "site": suspect.fault_info.site,
                       "bisected": len(removed)})
+            if eng._flight is not None:
+                eng._flight.event("quarantine",
+                                  request=suspect.request_id,
+                                  site=suspect.fault_info.site,
+                                  bisected=len(removed))
             self._note_success()
             return out
 
@@ -640,7 +658,7 @@ def recover(engine, snapshot: Optional[EngineSnapshot] = None,
 
     The OLD engine is retired: its scheduler/drafter now belong to the
     new engine and its device buffers are garbage."""
-    from .durability import clear_health, set_health
+    from .durability import retire_engine_series, set_health
     from .serving import DecodeEngine, _stats_add
 
     snap = snapshot if snapshot is not None else EngineSnapshot(engine)
@@ -715,10 +733,15 @@ def recover(engine, snapshot: Optional[EngineSnapshot] = None,
                      _obs.now_ns() - t0_ns, tid=new._engine_id,
                      args={"from_engine": snap.engine_id,
                            "requests": n_readmitted, "site": site})
+    if new._flight is not None:
+        new._flight.event("recovery", from_engine=snap.engine_id,
+                          requests=n_readmitted, site=site)
     set_health(new._engine_id, "live")
-    # retire the dead engine from the health gauge: a recovered hang
-    # must not leave its {state="hung"} series latched at 1 forever
-    clear_health(engine._engine_id)
+    # retire the dead engine from the WHOLE gauge catalog, not just
+    # health: a recovered hang must not leave {state="hung"} latched
+    # at 1 forever, and the dead id's pool/occupancy/queue/burn gauges
+    # must stop reading stale levels on every scrape after it
+    retire_engine_series(engine._engine_id)
     return new
 
 

@@ -63,10 +63,12 @@ parity contract tests/test_paged_decode.py pins.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import hashlib
 import heapq
 import itertools
+import os
 import time
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional
@@ -78,7 +80,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import unwrap
 from ..ops.pallas import paged_attention as pa
-from .errors import FaultInfo, PoolExhausted
+from .errors import FaultInfo, PoolExhausted, StepFault
 
 __all__ = ["KVBlockPool", "Request", "DecodeEngine", "sample_logits",
            "decode_stats", "reset_decode_stats",
@@ -99,6 +101,11 @@ from ..analysis import sanitizer as _san
 from ..observability import LOCK as _TELEMETRY_LOCK
 
 _STATS = {k: _decode_stat_zero(k) for k in DECODE_STAT_COUNTERS}
+
+# reusable no-op context for the flight recorder's phase timers when
+# the recorder is off (nullcontext is stateless, so ONE instance
+# serves every engine and thread)
+_NULL_CTX = contextlib.nullcontext()
 
 
 def _stats_add(**deltas):
@@ -572,6 +579,10 @@ class Request:
         # SLO accounting: violation kinds recorded for this request
         # ("ttft" | "tpot" | "deadline")
         self.slo_violations: List[str] = []
+        # SLO burn accounting (observability.flight): kinds whose
+        # budget burn already crossed 1.0 while live, so the
+        # paddle_slo_burn_exceeded counter fires once per kind
+        self._burn_noted: set = set()
         self.output_ids: List[int] = []
         self.state = "queued"
         self.finish_reason: Optional[str] = None
@@ -618,6 +629,42 @@ class Request:
         since the last resume; the earlier ones live in the replay
         prompt)."""
         return self.prompt_ids[self.orig_prompt_len:] + self.output_ids
+
+    def slo_burn(self, now_ns: int) -> Dict[str, float]:
+        """Fraction of each declared latency budget this request has
+        consumed as of ``now_ns`` — the live SLO burn the flight
+        recorder samples every step and `paddle_slo_burn` reports:
+
+        * ``ttft``     — elapsed since enqueue / ``slo_ttft_ms``,
+          while the first token is still pending (once it lands the
+          budget is settled — met or violated — and stops burning);
+        * ``tpot``     — observed per-output-token latency /
+          ``slo_tpot_ms``, once at least two tokens exist;
+        * ``deadline`` — elapsed since enqueue / the ``deadline_ms``
+          budget, while unfinished.
+
+        1.0 means the budget is exactly spent; > 1.0 means the target
+        is already missed (the violation counters confirm at finish).
+        Empty for a request that declared no targets."""
+        out: Dict[str, float] = {}
+        if self.t_enqueue_ns is None:
+            return out
+        if self.slo_ttft_ms is not None and \
+                self.t_first_token_ns is None:
+            out["ttft"] = ((now_ns - self.t_enqueue_ns) / 1e6) \
+                / self.slo_ttft_ms
+        if self.slo_tpot_ms is not None and \
+                self.t_first_token_ns is not None:
+            n_out = len(self.output_ids) + self._absorbed
+            if n_out > 1:
+                tpot_ms = (now_ns - self.t_first_token_ns) / 1e6 \
+                    / (n_out - 1)
+                out["tpot"] = tpot_ms / self.slo_tpot_ms
+        if self._deadline_ns is not None and self.state != "done":
+            budget = self._deadline_ns - self.t_enqueue_ns
+            if budget > 0:
+                out["deadline"] = (now_ns - self.t_enqueue_ns) / budget
+        return out
 
     @property
     def slo_met(self) -> bool:
@@ -912,7 +959,8 @@ class DecodeEngine:
                  drafter=None, chunked_prefill=None,
                  prefill_chunk_tokens=None, prefill_q_max=None,
                  prefix_cache=None, scheduler=None, fault_plan=None,
-                 journal_dir=None, step_timeout_ms=None):
+                 journal_dir=None, step_timeout_ms=None,
+                 flight_window=None, flight_dir=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -1132,6 +1180,27 @@ class DecodeEngine:
             journal_dir=self._journal_dir,
             step_timeout_ms=self._step_timeout_ms)
 
+        # flight recorder (observability.flight): always-cheap bounded
+        # ring of per-step records — batch composition, phase
+        # breakdown, ladder events, SLO burn.  flight_window=0 turns
+        # it off entirely (the parity/overhead oracle); the dump
+        # directory defaults beside the journal.
+        if flight_window is None:
+            flight_window = int(_flags.flag("flight_window"))
+        if flight_dir is None:
+            flight_dir = str(_flags.flag("flight_dir")) or None
+        self._flight = None
+        if int(flight_window) > 0:
+            from ..observability.flight import FlightRecorder
+
+            fdir = flight_dir or (
+                os.path.join(self._journal_dir, "flight")
+                if self._journal_dir else None)
+            self._flight = FlightRecorder(self, window=int(flight_window),
+                                          flight_dir=fdir)
+        self._ctor["flight_window"] = int(flight_window)
+        self._ctor["flight_dir"] = flight_dir
+
         if self._journal_dir:
             from .durability import DurabilityManager
 
@@ -1143,6 +1212,20 @@ class DecodeEngine:
         from .durability import set_health
 
         set_health(self._engine_id, "live", span=False)
+
+    def _phase(self, name: str):
+        """Context manager timing a LEAF flight-recorder phase (device
+        dispatch, fetch, cache ops) — a reusable no-op when the
+        recorder is off, so call sites read `with self._phase("x"):`
+        without repeating the None check."""
+        fr = self._flight
+        return fr.phase(name) if fr is not None else _NULL_CTX
+
+    def _excl_phase(self, name: str):
+        """Like `_phase` for COMPOSITE host phases (admit/draft/emit):
+        recorded exclusive of the leaf phases nested inside them."""
+        fr = self._flight
+        return fr.exclusive_phase(name) if fr is not None else _NULL_CTX
 
     def _model_fingerprint(self) -> bytes:
         """Sampling-invariant model identity — the chain-hash root.
@@ -1287,6 +1370,34 @@ class DecodeEngine:
             except Exception:
                 pass  # best effort: the hung worker may hold the handle
         self._watchdog = None
+        # black box first: the hung worker may never return, so this is
+        # the last consistent look at what the engine was doing.  Best
+        # effort on BOTH sides: a full disk must not block recovery,
+        # and a merely-SLOW (not dead) worker still holding a
+        # reference to the open record can mutate it lock-free while
+        # the dump serializes — a torn dump is acceptable, a dead
+        # driver is not
+        fl = self._flight
+        if fl is not None:
+            fl.event("abandon", step=int(self._step_no))
+            fl.end_step()
+            try:
+                fl.dump("abandoned")
+            except Exception:
+                pass
+        # close the dead lane: a terminal marker span on this engine's
+        # trace track, then retire EVERY engine-labeled series from the
+        # scrape surface (the whole-catalog mirror of PR 10's
+        # clear_health fix — a dead engine's gauges otherwise read
+        # stale levels forever).  The frontend re-flips health to
+        # "hung" right after, so an unrecovered abandonment still
+        # alerts; a successful recovery retires that too.
+        _obs.record_span("engine", "abandoned", _obs.now_ns(), 0,
+                         tid=self._engine_id,
+                         args={"step": int(self._step_no)})
+        from .durability import retire_engine_series
+
+        retire_engine_series(self._engine_id)
         self._by_slot = [None] * self._slots
         self._active = np.zeros(self._slots, bool)
         self._queue.clear()
@@ -1475,6 +1586,8 @@ class DecodeEngine:
             # re-admission after a preemption: the request already
             # recorded its queue wait — count the resume instead
             _stats_add(resumes=1)
+            if self._flight is not None:
+                self._flight.event("resume", request=req.request_id)
             return
         if req.t_enqueue_ns is not None:
             _obs.REQUEST_QUEUE_WAIT.observe(
@@ -1597,10 +1710,12 @@ class DecodeEngine:
         key = jax.random.fold_in(
             self._key, _fold_counter(self._prefill_no,
                                      RNG_PREFILL_DOMAIN))
-        self._k_pages, self._v_pages, tok = fn(
-            self._params, jnp.asarray(ids), jnp.int32(p_len),
-            jnp.asarray(self._bt[slot]), self._k_pages, self._v_pages,
-            key)
+        fr = self._flight
+        with self._phase("prefill"):
+            self._k_pages, self._v_pages, tok = fn(
+                self._params, jnp.asarray(ids), jnp.int32(p_len),
+                jnp.asarray(self._bt[slot]), self._k_pages,
+                self._v_pages, key)
         tok = int(self._host_fetch(tok))
         # the pass's wall time is real either way; the token count,
         # prefill count and TTFT stamp wait for the NaN-sentinel check
@@ -1661,6 +1776,8 @@ class DecodeEngine:
         always``), and ``req._emit_gate`` suppresses the callback for
         replay tokens an earlier life already streamed."""
         req.output_ids.extend(toks)
+        if self._flight is not None and toks:
+            self._flight.note_emit(req.request_id, len(toks))
         gate = req._emit_gate
         if gate:
             skip = min(gate, len(toks))
@@ -1725,8 +1842,11 @@ class DecodeEngine:
         (freed normally at finish)."""
         if not self._prefix_cache:
             return
-        for i in range(req.cached_page_count, len(req._page_hashes)):
-            self.pool.register_page(req.pages[i], req._page_hashes[i])
+        fr = self._flight
+        with self._phase("cache"):
+            for i in range(req.cached_page_count, len(req._page_hashes)):
+                self.pool.register_page(req.pages[i],
+                                        req._page_hashes[i])
 
     def _finish(self, slot: int, reason: str):
         req = self._by_slot[slot]
@@ -1773,6 +1893,9 @@ class DecodeEngine:
             self._slo_violation(req, "deadline")
         if self._spec is not None:
             self._spec.on_finish(slot, req)
+        if self._flight is not None:
+            # after the SLO checks above: slo_met is final here
+            self._flight.note_finish(req)
 
     def evict(self, req: Request):
         """Cancel a request: a queued request leaves the queue, a
@@ -1859,6 +1982,9 @@ class DecodeEngine:
         self._queue.appendleft(req)
         _stats_add(preemptions=1)
         _obs.SCHED_PREEMPTIONS.inc()
+        if self._flight is not None:
+            self._flight.event("preempt", request=req.request_id,
+                               slot=slot, generated=n_gen)
         if req.t_admit_ns is not None:
             _obs.record_span("requests", "preempted", req.t_admit_ns,
                              _obs.now_ns() - req.t_admit_ns,
@@ -1904,6 +2030,8 @@ class DecodeEngine:
                              tid=req.request_id,
                              args={"request": req.request_id,
                                    "finish_reason": reason})
+        if self._flight is not None:
+            self._flight.note_finish(req)
 
     def _cancel_queued(self, req: Request):
         if req.state != "queued":
@@ -1927,20 +2055,22 @@ class DecodeEngine:
         persists, quarantines a request — which frees pages.  Partial
         growth is consistent state (grown pages belong to their
         requests), so the retry re-enters here idempotently."""
-        if self._fault is not None:
-            self._resilience.fault_point("pool")
-        for slot in range(self._slots):
-            if not self._active[slot]:
-                continue
-            req = self._by_slot[slot]
-            w = 1 if writes is None else int(writes[slot])
-            if w == 0:
-                continue  # nothing written this step (stalled/skipped)
-            pidx = (int(self._lens[slot]) + w - 1) // self._page
-            while pidx >= len(req.pages):
-                req.pages.append(self.pool.alloc_page())
-                self.pool.reserved -= 1
-                self._bt[slot, len(req.pages) - 1] = req.pages[-1]
+        fr = self._flight
+        with self._phase("cache"):
+            if self._fault is not None:
+                self._resilience.fault_point("pool")
+            for slot in range(self._slots):
+                if not self._active[slot]:
+                    continue
+                req = self._by_slot[slot]
+                w = 1 if writes is None else int(writes[slot])
+                if w == 0:
+                    continue  # nothing written this step
+                pidx = (int(self._lens[slot]) + w - 1) // self._page
+                while pidx >= len(req.pages):
+                    req.pages.append(self.pool.alloc_page())
+                    self.pool.reserved -= 1
+                    self._bt[slot, len(req.pages) - 1] = req.pages[-1]
 
     def _observe_step(self, t0_ns: int, dt: float, n_active: int,
                       name: str, extra_args=None, observe_hist=True):
@@ -1953,6 +2083,10 @@ class DecodeEngine:
         when every slot is still prefilling, the chunk step's wall is
         observed directly), so each engine step lands in
         paddle_decode_step_seconds exactly once, chunk time included."""
+        if self._abandoned:
+            # a late-returning step on a watchdog-abandoned engine must
+            # not repopulate the retired gauges or extend the dead lane
+            return
         args = {"step": self._step_no, "active": n_active}
         if extra_args:
             args.update(extra_args)
@@ -2045,14 +2179,23 @@ class DecodeEngine:
         self._step_no += 1
         key = jax.random.fold_in(
             self._key, _fold_counter(self._step_no, RNG_DECODE_DOMAIN))
+        fr = self._flight
+        # phase attribution: chunk-only mixed steps are prompt work
+        # ("prefill"), chunk-carrying full steps are fused ("mixed"),
+        # chunkless full steps are plain decode through the mixed
+        # executable ("decode")
+        phase_name = "prefill" if not decode_rows else \
+            ("mixed" if chunk_of else "decode")
         t0 = time.perf_counter()
         t0_ns = _obs.now_ns()
         with RecordEvent("serving.mixed_step"):
-            self._k_pages, self._v_pages, toks = fn(
-                self._params, self._k_pages, self._v_pages,
-                jnp.asarray(self._bt), jnp.asarray(self._lens),
-                jnp.asarray(tokens), jnp.asarray(caps),
-                jnp.asarray(sample_idx), jnp.asarray(sample_mask), key)
+            with self._phase(phase_name):
+                self._k_pages, self._v_pages, toks = fn(
+                    self._params, self._k_pages, self._v_pages,
+                    jnp.asarray(self._bt), jnp.asarray(self._lens),
+                    jnp.asarray(tokens), jnp.asarray(caps),
+                    jnp.asarray(sample_idx), jnp.asarray(sample_mask),
+                    key)
             toks = self._host_fetch(toks)
         dt = time.perf_counter() - t0
         if self._fault is not None:
@@ -2085,33 +2228,35 @@ class DecodeEngine:
                            observe_hist=decode_rows)
 
         emitted = 0
-        for s in range(slots):
-            if not self._active[s]:
-                continue
-            req = self._by_slot[s]
-            c = chunk_of.get(s)
-            if c is not None:
-                self._prefill_pos[s] += c
-                self._lens[s] += c
-                req.prefill_chunks += 1
-                if int(self._prefill_pos[s]) == len(req.prompt_ids):
-                    if self._on_first_token(s, req, int(toks[s])):
-                        emitted += 1
-            elif caps[s] == 1:
-                tok = int(toks[s])
-                if tok < 0:
-                    # non-finite logits on this row only: quarantine
-                    # the slot, never the batch (lens stays — the
-                    # garbage K/V row is released with the pages)
-                    self._quarantine_slot(s, "nan_logits")
+        with self._excl_phase("emit"):
+            for s in range(slots):
+                if not self._active[s]:
                     continue
-                self._lens[s] += 1
-                self._last[s] = tok
-                self._emit(req, [tok])
-                emitted += 1
-                reason = self._done(req, tok)
-                if reason:
-                    self._finish(s, reason)
+                req = self._by_slot[s]
+                c = chunk_of.get(s)
+                if c is not None:
+                    self._prefill_pos[s] += c
+                    self._lens[s] += c
+                    req.prefill_chunks += 1
+                    if int(self._prefill_pos[s]) == len(req.prompt_ids):
+                        if self._on_first_token(s, req, int(toks[s])):
+                            emitted += 1
+                elif caps[s] == 1:
+                    tok = int(toks[s])
+                    if tok < 0:
+                        # non-finite logits on this row only:
+                        # quarantine the slot, never the batch (lens
+                        # stays — the garbage K/V row is released with
+                        # the pages)
+                        self._quarantine_slot(s, "nan_logits")
+                        continue
+                    self._lens[s] += 1
+                    self._last[s] = tok
+                    self._emit(req, [tok])
+                    emitted += 1
+                    reason = self._done(req, tok)
+                    if reason:
+                        self._finish(s, reason)
         _stats_add(tokens=emitted)
         return True
 
@@ -2163,6 +2308,9 @@ class DecodeEngine:
                          tid=self._engine_id,
                          args={"request": req.request_id, "slot": slot,
                                "site": site})
+        if self._flight is not None:
+            self._flight.event("quarantine", request=req.request_id,
+                               slot=slot, site=site)
         self._finish(slot, "fault")
 
     def _debug_check_pool(self):
@@ -2184,7 +2332,174 @@ class DecodeEngine:
         san = _san.active()
         if san is not None:
             san.count_host_sync()
-        return np.asarray(x)
+        fr = self._flight
+        if fr is None:
+            return np.asarray(x)
+        t0 = time.perf_counter()
+        out = np.asarray(x)
+        fr.add_phase("fetch", time.perf_counter() - t0)
+        return out
+
+    # -- live introspection ---------------------------------------------------
+    def _snapshot_queue(self) -> List[Request]:
+        """Best-effort copy of the admission queue, safe from a
+        non-engine thread (a deque mutated mid-iteration raises; the
+        retry makes statusz robust instead of crashy)."""
+        for _ in range(8):
+            try:
+                return list(self._queue)
+            except RuntimeError:
+                continue
+        return []
+
+    def statusz(self, flight_records: int = 8) -> dict:
+        """Live JSON-serializable state snapshot: queue, slots,
+        degraded modes, health, pool/cache occupancy, SLO burn, and
+        the last ``flight_records`` flight records.  Callable
+        MID-SERVE from any thread — it only reads (per-field reads are
+        atomic under the GIL, the queue copy retries around concurrent
+        mutation, and the flight ring is read under its lock), so a
+        statusz poller can never perturb outputs.  The fields are the
+        machine-readable form of `statusz_text`; `ServingFrontend
+        .debug_dump` wraps both with the frontend's own state."""
+        from .durability import _health_state
+
+        now = _obs.now_ns()
+
+        def _req(r: Request, slot=None) -> dict:
+            d = {
+                "request": r.request_id,
+                "state": r.state,
+                "priority": r.priority,
+                "prompt_len": len(r.prompt_ids),
+                "out_tokens": len(r.output_ids) + r._absorbed,
+                "max_new": r.max_new_tokens,
+                # total generation cap, stable across preemption folds
+                # (the fold moves budget into _absorbed one for one)
+                "out_cap": r._absorbed + r.max_new_tokens,
+                "preemptions": r.preemptions,
+            }
+            if r.t_enqueue_ns is not None:
+                d["age_s"] = round((now - r.t_enqueue_ns) / 1e9, 6)
+            if slot is not None:
+                d["slot"] = slot
+                d["phase"] = "prefill" \
+                    if int(self._prefill_pos[slot]) < len(r.prompt_ids) \
+                    else "decode"
+                d["kv_len"] = int(self._lens[slot])
+            burn = r.slo_burn(now)
+            if burn:
+                d["slo_burn"] = {k: round(v, 4)
+                                 for k, v in burn.items()}
+            if r.finish_reason is not None:
+                d["finish_reason"] = r.finish_reason
+            return d
+
+        by_slot = list(self._by_slot)
+        res = self._resilience
+        pool = self.pool
+        out = {
+            "engine": self._engine_id,
+            "step": int(self._step_no),
+            "time_ns": now,
+            "health": _health_state.get(self._engine_id, "live"),
+            "abandoned": bool(self._abandoned),
+            "scheduler": self._scheduler.name,
+            "degraded": {"spec_off": bool(res.spec_disabled),
+                         "legacy_prefill": bool(res.legacy_mode)},
+            "config": {
+                "slots": self._slots,
+                "max_seq_len": self._max_seq_len,
+                "page_size": self._page,
+                "chunked_prefill": bool(self._chunked),
+                "prefix_cache": bool(self._prefix_cache),
+                "chunk_budget": int(self._chunk_budget),
+                "spec_k": self._spec.k if self._spec is not None else 0,
+                "sampling": dict(self._sampling),
+            },
+            "queue": [_req(r) for r in self._snapshot_queue()],
+            "slots": [_req(r, slot=s) for s, r in enumerate(by_slot)
+                      if r is not None],
+            "pool": {
+                "num_pages": pool.num_pages,
+                "free": pool.free_count,
+                "reserved": pool.reserved,
+                "cached": pool.cached_count,
+                "cached_unreferenced": pool.cached_unreferenced_count,
+                "utilization": round(pool.utilization(), 4),
+                "evictions": pool.evictions,
+            },
+            "durability": {
+                "journal_dir": self._journal_dir,
+                "armed": self._durability is not None,
+            },
+            "watchdog": {
+                "armed": self._watchdog is not None,
+                "timeout_ms": self._step_timeout_ms,
+            },
+        }
+        fl = self._flight
+        if fl is not None:
+            out["flight"] = {
+                "totals": fl.window_stats(),
+                "records": fl.records(flight_records),
+            }
+        return out
+
+    def statusz_text(self, flight_records: int = 4) -> str:
+        """Human-readable rendering of `statusz` — the text half of
+        the JSON+text introspection surface."""
+        z = self.statusz(flight_records=flight_records)
+        lines = [
+            f"engine {z['engine']} — step {z['step']} — "
+            f"health {z['health']}"
+            + (" (ABANDONED)" if z["abandoned"] else ""),
+            f"scheduler {z['scheduler']} | chunked="
+            f"{int(z['config']['chunked_prefill'])} prefix_cache="
+            f"{int(z['config']['prefix_cache'])} spec_k="
+            f"{z['config']['spec_k']} | degraded: spec_off="
+            f"{int(z['degraded']['spec_off'])} legacy="
+            f"{int(z['degraded']['legacy_prefill'])}",
+            f"pool: {z['pool']['free']}/{z['pool']['num_pages']} free, "
+            f"{z['pool']['cached']} cached "
+            f"({z['pool']['cached_unreferenced']} reclaimable), "
+            f"util {z['pool']['utilization']}, "
+            f"{z['pool']['evictions']} evictions",
+            f"queue ({len(z['queue'])}):",
+        ]
+        for q in z["queue"]:
+            lines.append(
+                f"  req {q['request']} prio {q['priority']} "
+                f"age {q.get('age_s', 0):.3f}s "
+                f"out {q['out_tokens']}"
+                + (f" burn {q['slo_burn']}" if "slo_burn" in q else ""))
+        lines.append(f"slots ({len(z['slots'])}/"
+                     f"{z['config']['slots']}):")
+        for s in z["slots"]:
+            lines.append(
+                f"  slot {s['slot']} req {s['request']} {s['phase']} "
+                f"kv {s['kv_len']} out {s['out_tokens']}/"
+                f"{s['out_cap']}"
+                + (f" burn {s['slo_burn']}" if "slo_burn" in s else ""))
+        fl = z.get("flight")
+        if fl:
+            t = fl["totals"]
+            lines.append(
+                f"flight: {t['records']}/{t['window']} records, "
+                f"{t['tokens_per_second']:.1f} tok/s over window, "
+                f"goodput {t['goodput']}, {t['dumps']} dumps")
+            for rec in fl["records"]:
+                phases = " ".join(
+                    f"{k}={v * 1e3:.2f}ms"
+                    for k, v in sorted(rec.get("phases", {}).items()))
+                evs = "".join(f" [{e['kind']}]"
+                              for e in rec.get("events", []))
+                lines.append(
+                    f"  step {rec.get('step')} {rec.get('kind')} "
+                    f"{rec.get('dur_s', 0) * 1e3:.2f}ms "
+                    f"emitted {sum(rec.get('emitted', {}).values())} "
+                    f"{phases}{evs}")
+        return "\n".join(lines)
 
     # -- the serve loop ------------------------------------------------------
     def step(self) -> bool:
@@ -2212,35 +2527,62 @@ class DecodeEngine:
             self._debug_check_pool()
         elif self._pool_debug:
             self._debug_check_pool()
-        self._admit()
-        # admission-pressure gauges, sampled every step AFTER admission
-        # (what is left queued is the backlog the pool/slots could not
-        # absorb) — previously only derivable from queued spans
-        eid = self._engine_id
-        _obs.QUEUE_DEPTH.set(len(self._queue), engine=eid)
-        _obs.QUEUE_OLDEST_AGE.set(
-            (_obs.now_ns() - min(r.t_enqueue_ns for r in self._queue))
-            / 1e9 if self._queue else 0.0, engine=eid)
-        if not self._active.any():
+        fr = self._flight
+        if fr is not None:
+            fr.begin_step()
+        try:
+            # "admit" phase is EXCLUSIVE of nested leaf phases: a
+            # legacy one-shot prefill runs INSIDE admission, and its
+            # device/fetch time must not double-count
+            with self._excl_phase("admit"):
+                self._admit()
+            # admission-pressure gauges, sampled every step AFTER
+            # admission (what is left queued is the backlog the
+            # pool/slots could not absorb).  Not on an ABANDONED
+            # engine: a late-returning worker calling step() must not
+            # repopulate gauges its retirement just removed.
+            if not self._abandoned:
+                eid = self._engine_id
+                _obs.QUEUE_DEPTH.set(len(self._queue), engine=eid)
+                _obs.QUEUE_OLDEST_AGE.set(
+                    (_obs.now_ns() - min(r.t_enqueue_ns
+                                         for r in self._queue))
+                    / 1e9 if self._queue else 0.0, engine=eid)
+            if fr is not None:
+                fr.note_batch()
+            if not self._active.any():
+                if self._durability is not None:
+                    self._durability.on_step_boundary()
+                if fr is not None:
+                    fr.end_step(idle=True)
+                return bool(self._queue)
+            wd = self._watchdog
+            if wd is not None:
+                wd.arm()
+                t0_wd = time.perf_counter()
+            out = self._resilience.run_step()
             if self._durability is not None:
                 self._durability.on_step_boundary()
-            return bool(self._queue)
-        wd = self._watchdog
-        if wd is not None:
-            wd.arm()
-            t0_wd = time.perf_counter()
-        out = self._resilience.run_step()
-        if self._durability is not None:
-            self._durability.on_step_boundary()
-        if wd is not None:
-            dt_wd = time.perf_counter() - t0_wd
-            if wd.classify(dt_wd):
-                # post-hoc hang verdict: the step DID complete (its
-                # tokens are emitted and journaled — recovery folds
-                # them, nothing re-emits), but an engine this slow is
-                # suspect: flip health to hung and hand the fatal
-                # HungStep to the recovery supervision
-                wd.on_hung(dt_wd)
+            if wd is not None:
+                dt_wd = time.perf_counter() - t0_wd
+                if wd.classify(dt_wd):
+                    # post-hoc hang verdict: the step DID complete (its
+                    # tokens are emitted and journaled — recovery folds
+                    # them, nothing re-emits), but an engine this slow
+                    # is suspect: flip health to hung and hand the
+                    # fatal HungStep to the recovery supervision
+                    wd.on_hung(dt_wd)
+        except StepFault as e:
+            # a fault that survived the whole containment ladder is
+            # escaping: leave the black box BEFORE the supervisor
+            # tears this engine down.  A watchdog-ABANDONED engine
+            # skips this — its recorder already dumped at abandonment
+            # and its requests belong to the successor.
+            if fr is not None and not self._abandoned:
+                fr.note_fault(e)
+            raise
+        if fr is not None:
+            fr.end_step()
         return out
 
     def _step_inner(self) -> bool:
@@ -2276,13 +2618,16 @@ class DecodeEngine:
         self._step_no += 1
         key = jax.random.fold_in(
             self._key, _fold_counter(self._step_no, RNG_DECODE_DOMAIN))
+        fr = self._flight
         t0 = time.perf_counter()
         t0_ns = _obs.now_ns()
         with RecordEvent("serving.decode_step"):
-            self._k_pages, self._v_pages, toks = fn(
-                self._params, self._k_pages, self._v_pages,
-                jnp.asarray(self._bt), jnp.asarray(self._lens),
-                jnp.asarray(self._last), jnp.asarray(self._active), key)
+            with self._phase("decode"):
+                self._k_pages, self._v_pages, toks = fn(
+                    self._params, self._k_pages, self._v_pages,
+                    jnp.asarray(self._bt), jnp.asarray(self._lens),
+                    jnp.asarray(self._last), jnp.asarray(self._active),
+                    key)
             toks = self._host_fetch(toks)
         dt = time.perf_counter() - t0
         if self._fault is not None:
@@ -2294,23 +2639,25 @@ class DecodeEngine:
         emitted = 0
         self._observe_step(t0_ns, dt, n_active, "decode_step")
 
-        for slot in range(self._slots):
-            if not self._active[slot]:
-                continue
-            tok = int(toks[slot])
-            req = self._by_slot[slot]
-            if tok < 0:
-                # non-finite logits on this row: quarantine the slot
-                # only — the rest of the batch emitted healthy tokens
-                self._quarantine_slot(slot, "nan_logits")
-                continue
-            self._lens[slot] += 1
-            self._last[slot] = tok
-            self._emit(req, [tok])
-            emitted += 1
-            reason = self._done(req, tok)
-            if reason:
-                self._finish(slot, reason)
+        with self._excl_phase("emit"):
+            for slot in range(self._slots):
+                if not self._active[slot]:
+                    continue
+                tok = int(toks[slot])
+                req = self._by_slot[slot]
+                if tok < 0:
+                    # non-finite logits on this row: quarantine the
+                    # slot only — the rest of the batch emitted
+                    # healthy tokens
+                    self._quarantine_slot(slot, "nan_logits")
+                    continue
+                self._lens[slot] += 1
+                self._last[slot] = tok
+                self._emit(req, [tok])
+                emitted += 1
+                reason = self._done(req, tok)
+                if reason:
+                    self._finish(slot, reason)
         _stats_add(steps=1, decode_time_s=dt, tokens=emitted,
                    occupancy_sum=n_active / self._slots,
                    kv_util_sum=kv_util)

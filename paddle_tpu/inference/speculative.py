@@ -70,10 +70,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .serving import (RNG_DECODE_DOMAIN, _JitTracker, _extract_gpt_params,
-                      _fold_counter, _gpt_decode_step, _gpt_mixed_step,
-                      _gpt_prefill, _guard_tokens, _ln, _logits_of,
-                      _stats_add, sample_logits)
+from .serving import (RNG_DECODE_DOMAIN, _JitTracker,
+                      _extract_gpt_params, _fold_counter,
+                      _gpt_decode_step, _gpt_mixed_step, _gpt_prefill,
+                      _guard_tokens, _ln, _logits_of, _stats_add,
+                      sample_logits)
 from .. import observability as _obs
 from ..ops.pallas import paged_attention as pa
 
@@ -567,12 +568,16 @@ class SpeculativeDecoder:
         eng._grow_block_tables(writes=caps)
         pos_before = eng._lens.copy()
 
+        fr = eng._flight
         t0 = time.perf_counter()
         t0_ns = _obs.now_ns()
         try:
             if eng._fault is not None:
                 eng._resilience.fault_point("drafter")
-            drafts = self.drafter.propose(caps)
+            # "draft" is EXCLUSIVE of the blocking fetches the drafter
+            # pays inside propose (those land on the "fetch" phase)
+            with eng._excl_phase("draft"):
+                drafts = self.drafter.propose(caps)
         except eng._resilience.NONRETRYABLE:
             raise
         except Exception as e:
@@ -610,10 +615,11 @@ class SpeculativeDecoder:
         t0 = time.perf_counter()
         tv_ns = _obs.now_ns()
         with RecordEvent("serving.spec_verify_step"):
-            eng._k_pages, eng._v_pages, targets = fn(
-                eng._params, eng._k_pages, eng._v_pages,
-                jnp.asarray(eng._bt), jnp.asarray(eng._lens),
-                jnp.asarray(tokens), jnp.asarray(caps), key)
+            with eng._phase("verify"):
+                eng._k_pages, eng._v_pages, targets = fn(
+                    eng._params, eng._k_pages, eng._v_pages,
+                    jnp.asarray(eng._bt), jnp.asarray(eng._lens),
+                    jnp.asarray(tokens), jnp.asarray(caps), key)
             targets = eng._host_fetch(targets)
         t_verify = time.perf_counter() - t0
         if eng._fault is not None:
@@ -627,48 +633,52 @@ class SpeculativeDecoder:
         emitted_total = 0
         proposed_total = 0
         accepted_total = 0
-        for s in range(slots):
-            if not eng._active[s] or caps[s] == 0:
-                continue
-            req = eng._by_slot[s]
-            w = int(caps[s])
-            usable = min(self.k, w - 1)  # drafts the window can accept
-            m = 0
-            while m < usable and int(drafts[s, m]) == int(targets[s, m]):
-                m += 1
-            emit = [int(t) for t in drafts[s, :m]] + [int(targets[s, m])]
-            if any(t < 0 for t in emit):
-                # non-finite logits somewhere in this slot's verify
-                # window: quarantine the slot without emitting (lens
-                # never advances over the poisoned rows, the drafter's
-                # on_finish resets its cursor) — the other slots'
-                # rounds are untouched
-                eng._quarantine_slot(s, "nan_logits")
-                continue
-            if req.eos_token_id is not None:
-                for j, t in enumerate(emit):
-                    if t == req.eos_token_id:
-                        emit = emit[:j + 1]
-                        break
-            n_emit = len(emit)
-            # accounted AFTER eos truncation so acceptance_rate stays
-            # consistent with spec_emitted: drafts that matched but were
-            # cut by an earlier eos never reached the output
-            proposed_total += usable
-            accepted_total += min(m, n_emit)
-            # through the engine's single emission point: the streaming
-            # on_token hook fires per accepted token exactly like on
-            # the classic decode path
-            eng._emit(req, emit)
-            # accepted rows keep their K/V; the rejected tail is rolled
-            # back purely by NOT advancing seq_lens over it
-            eng._lens[s] += n_emit
-            eng._last[s] = emit[-1]
-            emitted_total += n_emit
-            self.drafter.on_accept(s, int(pos_before[s]), n_emit)
-            reason = eng._done(req, emit[-1])
-            if reason:
-                eng._finish(s, reason)
+        with eng._excl_phase("emit"):
+            for s in range(slots):
+                if not eng._active[s] or caps[s] == 0:
+                    continue
+                req = eng._by_slot[s]
+                w = int(caps[s])
+                usable = min(self.k, w - 1)  # drafts acceptable
+                m = 0
+                while m < usable and \
+                        int(drafts[s, m]) == int(targets[s, m]):
+                    m += 1
+                emit = [int(t) for t in drafts[s, :m]] + \
+                    [int(targets[s, m])]
+                if any(t < 0 for t in emit):
+                    # non-finite logits somewhere in this slot's verify
+                    # window: quarantine the slot without emitting
+                    # (lens never advances over the poisoned rows, the
+                    # drafter's on_finish resets its cursor) — the
+                    # other slots' rounds are untouched
+                    eng._quarantine_slot(s, "nan_logits")
+                    continue
+                if req.eos_token_id is not None:
+                    for j, t in enumerate(emit):
+                        if t == req.eos_token_id:
+                            emit = emit[:j + 1]
+                            break
+                n_emit = len(emit)
+                # accounted AFTER eos truncation so acceptance_rate
+                # stays consistent with spec_emitted: drafts that
+                # matched but were cut by an earlier eos never reached
+                # the output
+                proposed_total += usable
+                accepted_total += min(m, n_emit)
+                # through the engine's single emission point: the
+                # streaming on_token hook fires per accepted token
+                # exactly like on the classic decode path
+                eng._emit(req, emit)
+                # accepted rows keep their K/V; the rejected tail is
+                # rolled back purely by NOT advancing seq_lens over it
+                eng._lens[s] += n_emit
+                eng._last[s] = emit[-1]
+                emitted_total += n_emit
+                self.drafter.on_accept(s, int(pos_before[s]), n_emit)
+                reason = eng._done(req, emit[-1])
+                if reason:
+                    eng._finish(s, reason)
 
         _stats_add(spec_steps=1, spec_slot_steps=n_verify, steps=1,
                    spec_proposed=proposed_total,

@@ -50,6 +50,12 @@ paddle_faults_injected_total                   counter    site
 paddle_step_retries_total                      counter    —
 paddle_recoveries_total                        counter    —
 paddle_degraded_mode                           gauge      engine, mode
+paddle_step_phase_seconds                      histogram  phase
+paddle_engine_tokens_per_second                gauge      engine
+paddle_engine_goodput                          gauge      engine
+paddle_slo_burn                                gauge      engine, kind
+paddle_slo_burn_exceeded_total                 counter    kind
+paddle_flight_dumps_total                      counter    reason
 =============================================  =========  ==========
 
 plus the views: ``paddle_decode_*`` (every `decode_stats` key) and
@@ -255,6 +261,54 @@ RECOVERY_SECONDS = histogram(
     "rebuild + re-admission, executable handoff included when the "
     "config fingerprints matched — the latency a fatal fault adds "
     "before the engine serves again")
+STEP_PHASE_SECONDS = histogram(
+    "paddle_step_phase_seconds",
+    "Per-step wall time attributed to one serve-loop phase "
+    "(observability.flight.PHASES: admit | prefill | mixed | decode | "
+    "draft | verify | fetch | emit | cache) — host timers around the "
+    "existing sites, one observation per phase per engine step; "
+    "composite host phases (admit/draft/emit) are EXCLUSIVE of the "
+    "leaf phases nested inside them, so the phases of a step sum to "
+    "~its paddle_decode_step_seconds wall",
+    labels=("phase",))
+ENGINE_TOKENS_PER_SECOND = gauge(
+    "paddle_engine_tokens_per_second",
+    "Generated tokens per second over the engine's flight-recorder "
+    "window (FLAGS_flight_window recent steps) — the live throughput "
+    "reading a fleet router load-balances on",
+    labels=("engine",))
+ENGINE_GOODPUT = gauge(
+    "paddle_engine_goodput",
+    "Fraction of this engine's finished requests that completed "
+    "normally (eos|length) with every declared SLO met "
+    "(Request.slo_met), cumulative over the engine's life — the "
+    "per-engine version of the goodput number tools/bench_slo.py "
+    "reports",
+    labels=("engine",))
+SLO_BURN = gauge(
+    "paddle_slo_burn",
+    "Worst per-request SLO budget burn among this engine's live "
+    "(queued + running) requests, by kind (ttft: elapsed since "
+    "enqueue / slo_ttft_ms while the first token is pending; tpot: "
+    "observed per-token latency / slo_tpot_ms; deadline: elapsed / "
+    "deadline budget).  1.0 = the budget is spent; a router admitting "
+    "against latency budgets reads this before routing more work here",
+    labels=("engine", "kind"))
+SLO_BURN_EXCEEDED = counter(
+    "paddle_slo_burn_exceeded_total",
+    "Requests whose SLO budget burn crossed 1.0 while still live, by "
+    "kind (counted once per request per kind, BEFORE finish — the "
+    "leading indicator paddle_sched_slo_violations_total confirms at "
+    "finish time)",
+    labels=("kind",))
+FLIGHT_DUMPS = counter(
+    "paddle_flight_dumps_total",
+    "Flight-recorder windows auto-dumped to FLAGS_flight_dir, by "
+    "reason (fault: a fatal StepFault/HungStep escaped the step; "
+    "abandoned: the frontend watchdog abandoned a hung worker; "
+    "manual: FlightRecorder.dump called directly) — every chaos/"
+    "recovery event leaves a black box tools/explain_request.py reads",
+    labels=("reason",))
 
 
 # ---------------------------------------------------------------------------

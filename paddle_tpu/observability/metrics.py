@@ -121,12 +121,19 @@ class _Metric:
         self._series: Dict[tuple, object] = {}
 
     def _labels_key(self, labels: dict) -> tuple:
-        if len(labels) != len(self.label_names) or \
-                any(k not in labels for k in self.label_names):
+        names = self.label_names
+        if len(labels) != len(names):
             raise ValueError(
-                f"{self.name}: expected labels {self.label_names}, "
+                f"{self.name}: expected labels {names}, "
                 f"got {tuple(sorted(labels))}")
-        key = tuple(str(labels[k]) for k in self.label_names)
+        try:
+            # one pass: a wrong label name KeyErrors here instead of
+            # paying a separate membership scan on every hot-path bump
+            key = tuple(str(labels[k]) for k in names)
+        except KeyError:
+            raise ValueError(
+                f"{self.name}: expected labels {names}, "
+                f"got {tuple(sorted(labels))}") from None
         if key not in self._series and \
                 len(self._series) >= MAX_SERIES_PER_METRIC:
             raise ValueError(
@@ -231,6 +238,26 @@ class Histogram(_Metric):
             s.sum += v
             s.count += 1
 
+    def observe_batch(self, items):
+        """Observe several labeled values in ONE lock round —
+        ``items`` is an iterable of (labels_dict, value).  The per-step
+        phase breakdown (observability.flight) lands 5-8 observations
+        per engine step; paying the lock + sanitizer bookkeeping once
+        instead of per phase keeps the recorder inside its
+        always-cheap budget."""
+        if not _state["enabled"]:
+            return
+        with LOCK:
+            for labels, value in items:
+                v = float(value)
+                key = self._labels_key(labels)
+                s = self._series.get(key)
+                if s is None:
+                    s = self._series[key] = _HistSeries(len(self.buckets))
+                s.counts[bisect_left(self.buckets, v)] += 1
+                s.sum += v
+                s.count += 1
+
     def series_state(self, **labels) -> dict:
         """Snapshot one labeled series: per-bucket (non-cumulative)
         counts, sum, count."""
@@ -323,6 +350,27 @@ class MetricRegistry:
         with LOCK:
             for m in self._metrics.values():
                 m._reset()
+
+    def retire_label(self, label: str, value) -> int:
+        """DELETE every labeled series whose ``label`` equals ``value``
+        across all first-class metrics (views own their storage and are
+        untouched).  This is how a retired engine id leaves the scrape
+        surface entirely — `reset` keeps label sets alive by contract,
+        so a dead engine's gauges would otherwise read stale levels
+        forever (and grow the series set one abandoned engine at a
+        time).  Returns the number of series retired."""
+        value = str(value)
+        retired = 0
+        with LOCK:
+            for m in self._metrics.values():
+                if label not in m.label_names:
+                    continue
+                i = m.label_names.index(label)
+                dead = [k for k in m._series if k[i] == value]
+                for k in dead:
+                    del m._series[k]
+                retired += len(dead)
+        return retired
 
     # -- collection / export -------------------------------------------------
     def collect(self) -> List[Sample]:

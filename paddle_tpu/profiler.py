@@ -83,6 +83,10 @@ DECODE_STAT_COUNTERS = (
     # classified as hung (FLAGS_step_timeout_ms)
     "journal_records", "journal_snapshots", "restores", "exec_handoffs",
     "hung_steps",
+    # flight recorder (observability.flight): sealed per-step records
+    # pushed into the bounded ring, and crash-safe window auto-dumps
+    # (fatal fault / hung step / watchdog abandonment black boxes)
+    "flight_records", "flight_dumps",
 )
 DECODE_STAT_DERIVED = ("avg_step_ms", "batch_occupancy",
                        "kv_block_utilization",

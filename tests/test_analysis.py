@@ -463,6 +463,69 @@ class TestEngineMutationLint:
         """, name="inference/durability.py")
         assert EngineMutationPass(REPO_ENGINE_RULE).run(mods) == []
 
+    def test_rogue_flight_recorder_mutation_flags(self, tmp_path):
+        """The REPO rule sanctions the flight recorder's engine READS
+        only inside `FlightRecorder` in observability/flight.py: a
+        rogue recorder that mutates the engine from its step hooks —
+        the tempting bug being 'just retire the slow request from
+        inside end_step' — must flag."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        mods = _scan_snippet(tmp_path, """
+            class RogueRecorder:
+                def end_step(self):
+                    self.engine._finish(0, "evicted")
+                    self.engine._step_no = 9
+
+                def seal(self, engine):
+                    engine.preempt(self.victim)
+        """, name="rogue_recorder.py")
+        found = EngineMutationPass(REPO_ENGINE_RULE).run(mods)
+        msgs = sorted(f.message for f in found)
+        assert len(found) == 3, msgs
+        assert any("._finish()" in m for m in msgs)
+        assert any(".preempt()" in m for m in msgs)
+        assert any("attribute store" in m for m in msgs)
+        assert all("RogueRecorder" in m for m in msgs)
+
+    def test_repo_rule_sanctions_flight_recorder_reads(self, tmp_path):
+        """The sanctioned twin: the same shapes of code inside
+        `FlightRecorder` in observability/flight.py scan clean — the
+        spec encodes 'the recorder may read (and is trusted) from
+        inside the step'."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        (tmp_path / "observability").mkdir()
+        mods = _scan_snippet(tmp_path, """
+            class FlightRecorder:
+                def end_step(self):
+                    self.engine._finish(0, "evicted")
+                    self.engine._step_no = 9
+        """, name="observability/flight.py")
+        assert EngineMutationPass(REPO_ENGINE_RULE).run(mods) == []
+
+    def test_flight_lock_discipline_enforced(self, tmp_path):
+        """The flight-recorder ring is in the lock-discipline spec: an
+        unguarded ring mutation in a module named like flight.py
+        flags, the locked form scans clean."""
+        from paddle_tpu.analysis import REPO_LOCK_RULES
+        from paddle_tpu.analysis.passes import LockDisciplinePass
+
+        (tmp_path / "observability").mkdir()
+        mods = _scan_snippet(tmp_path, """
+            class FlightRecorder:
+                def bad_push(self, rec):
+                    self._ring.append(rec)
+
+                def good_push(self, rec):
+                    with _lock:
+                        self._ring.append(rec)
+        """, name="observability/flight.py")
+        found = LockDisciplinePass(REPO_LOCK_RULES).run(mods)
+        assert len(found) == 1, [f.message for f in found]
+        assert "bad_push" in found[0].message
+        assert ".append()" in found[0].message
+
 
 # ---------------------------------------------------------------------------
 # donation analysis

@@ -490,12 +490,18 @@ class TestWatchdog:
         states = {(x["labels"]["engine"], x["labels"]["state"]):
                   x["value"]
                   for x in snap["paddle_engine_health"]["series"]}
-        # recovery RETIRES the dead engine from the gauge: the hung
-        # alert condition must not stay latched after serving resumed
-        assert states[(str(eng._engine_id), "hung")] == 0
-        assert not any(v for (e, _), v in states.items()
-                       if e == str(eng._engine_id))
+        # recovery RETIRES the dead engine from the WHOLE gauge
+        # catalog (ISSUE 11 strengthened PR 10's health-only clear):
+        # no series of ANY metric still carries the dead id — the hung
+        # alert cannot stay latched and nothing scrapes stale levels
+        assert not any(e == str(eng._engine_id) for e, _ in states)
         assert states[(str(eng2._engine_id), "live")] == 1
+        dead = str(eng._engine_id)
+        for name, m in snap.items():
+            if "engine" not in m["labels"]:
+                continue
+            assert not any(s["labels"]["engine"] == dead
+                           for s in m["series"]), name
 
     def test_hung_step_is_fatal_step_fault(self):
         e = HungStep("boom")

@@ -1,0 +1,478 @@
+"""Serving flight recorder (ISSUE 11): per-step records with phase
+attribution, SLO burn accounting, crash-safe auto-dumps, per-engine
+gauge retirement, and live statusz/debug_dump introspection.
+
+Contracts pinned here:
+
+* the ring is bounded (FLAGS_flight_window), each record carries the
+  batch composition, a phase breakdown whose phases sum to ~the step
+  wall, the tokens emitted per request, and pool/queue occupancy;
+* ``paddle_step_phase_seconds{phase}`` observes every phase,
+  ``paddle_engine_tokens_per_second`` / ``paddle_engine_goodput``
+  track the window;
+* SLO burn: `Request.slo_burn` reports budget consumed per kind, the
+  ``paddle_slo_burn_exceeded_total`` counter fires once per request
+  per kind, and burns land in flight records;
+* a fatal `StepFault` auto-dumps the window crash-safely (tmp+rename,
+  no torn/tmp files), containing the faulting step's record and the
+  ladder events; `tools/explain_request.explain` renders a request's
+  timeline from the dump;
+* `recover` / `_abandon_inflight` retire the dead engine's ENTIRE
+  per-engine gauge catalog (the whole-catalog mirror of PR 10's
+  clear_health fix);
+* `DecodeEngine.statusz` / `ServingFrontend.debug_dump` return
+  consistent JSON(+text) snapshots callable mid-serve from a second
+  thread without perturbing outputs;
+* with ``flight_window=0`` the recorder is fully off and serving is
+  bit-exact with zero flight counters.
+"""
+import asyncio
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.inference import resilience
+from paddle_tpu.inference.errors import StepFault
+from paddle_tpu.inference.frontend import ServingFrontend
+from paddle_tpu.inference.resilience import serve_with_recovery
+from paddle_tpu.inference.serving import (DecodeEngine, Request,
+                                          decode_stats,
+                                          reset_decode_stats)
+from paddle_tpu.observability.flight import BURN_KINDS, PHASES
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+from explain_request import explain, request_ids  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    reset_decode_stats()
+    obs.reset()
+    obs.clear_spans()
+    yield
+    reset_decode_stats()
+    obs.reset()
+    obs.clear_spans()
+
+
+TINY = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                 num_heads=4, max_seq_len=256,
+                 use_parallel_layers=False, dropout=0.0)
+
+PROMPTS = [[1, 2, 3, 4, 5, 1, 2, 3, 4, 5, 1, 2],
+           [7, 8, 9, 7, 8, 9, 7, 8]]
+NEW = 16
+
+
+def _tiny_gpt(seed=0):
+    paddle.seed(seed)
+    m = GPT(TINY)
+    m.eval()
+    return m
+
+
+def _engine(m, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("page_size", 4)
+    return DecodeEngine(m, **kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_gpt()
+
+
+@pytest.fixture(scope="module")
+def reference(model):
+    return _engine(model).generate(PROMPTS, max_new_tokens=NEW)
+
+
+# ---------------------------------------------------------------------------
+# the ring and its records
+# ---------------------------------------------------------------------------
+class TestRecorder:
+    def test_ring_is_bounded(self, model):
+        eng = _engine(model, flight_window=4)
+        eng.generate(PROMPTS, max_new_tokens=NEW)
+        recs = eng._flight.records()
+        assert len(recs) == 4  # far more steps ran than the window
+
+    def test_record_shape_and_phase_vocabulary(self, model):
+        eng = _engine(model)
+        eng.generate(PROMPTS, max_new_tokens=NEW)
+        recs = eng._flight.records()
+        assert recs
+        for rec in recs:
+            assert rec["kind"] in ("step", "idle", "event")
+            if rec["kind"] != "step":
+                continue
+            assert set(rec["phases"]) <= set(PHASES)
+            assert rec["dur_s"] > 0
+            assert "pool" in rec and "queued" in rec
+            # disjoint phases: the breakdown never exceeds the wall
+            assert sum(rec["phases"].values()) <= rec["dur_s"] * 1.02
+        assert json.dumps(recs)  # every record is JSON-serializable
+
+    def test_batch_composition_tracks_prefill_to_decode(self, model):
+        eng = _engine(model, prefill_chunk_tokens=4)
+        eng.generate([PROMPTS[0]], max_new_tokens=4)
+        recs = [r for r in eng._flight.records()
+                if r["kind"] == "step" and r["slots"]]
+        assert recs[0]["slots"][0]["phase"] == "prefill"
+        assert recs[-1]["slots"][0]["phase"] == "decode"
+        # the prefill cursor advances chunk by chunk in the records
+        cursors = [r["slots"][0]["prefill_pos"] for r in recs]
+        assert cursors == sorted(cursors)
+
+    def test_emitted_counts_match_outputs(self, model):
+        eng = _engine(model)
+        reqs = [eng.add_request(p, max_new_tokens=NEW) for p in PROMPTS]
+        eng.run()
+        emitted = {}
+        for rec in eng._flight.records():
+            for rid, n in rec.get("emitted", {}).items():
+                emitted[int(rid)] = emitted.get(int(rid), 0) + n
+        for r in reqs:
+            assert emitted[r.request_id] == len(r.generated_ids)
+
+    def test_phase_histogram_and_window_gauges(self, model):
+        eng = _engine(model)
+        eng.generate(PROMPTS, max_new_tokens=NEW)
+        snap = obs.snapshot()
+        phases = {s["labels"]["phase"]: s
+                  for s in snap["paddle_step_phase_seconds"]["series"]}
+        # chunked serve: admit + mixed/decode + fetch + emit + cache
+        for p in ("admit", "decode", "fetch", "emit", "cache"):
+            assert p in phases, sorted(phases)
+            assert phases[p]["count"] >= 1
+            assert phases[p]["sum"] >= 0
+        assert obs.ENGINE_TOKENS_PER_SECOND.value(
+            engine=eng._engine_id) > 0
+        assert obs.ENGINE_GOODPUT.value(
+            engine=eng._engine_id) == 1.0  # no SLOs declared
+
+    def test_recorder_off_is_bit_exact_with_zero_counters(
+            self, model, reference):
+        eng = _engine(model, flight_window=0)
+        assert eng._flight is None
+        outs = eng.generate(PROMPTS, max_new_tokens=NEW)
+        assert outs == reference
+        st = decode_stats()
+        assert st["flight_records"] == 0
+        assert st["flight_dumps"] == 0
+        z = eng.statusz()  # statusz works without a recorder
+        assert "flight" not in z
+        on = _engine(model).generate(PROMPTS, max_new_tokens=NEW)
+        assert on == reference  # and the recorder never perturbs
+
+    def test_flight_window_flag_arms_engine(self, model):
+        paddle.set_flags({"flight_window": 7})
+        try:
+            eng = _engine(model)
+            assert eng._flight is not None and eng._flight.window == 7
+        finally:
+            paddle.set_flags({"flight_window": 64})
+        assert _engine(model, flight_window=0)._flight is None
+
+
+# ---------------------------------------------------------------------------
+# SLO burn accounting
+# ---------------------------------------------------------------------------
+class TestSloBurn:
+    def test_slo_burn_method(self):
+        req = Request([1, 2, 3], max_new_tokens=4, slo_ttft_ms=10.0,
+                      slo_tpot_ms=5.0, deadline_ms=100.0)
+        req.t_enqueue_ns = 1_000_000_000
+        req._deadline_ns = req.t_enqueue_ns + int(100.0 * 1e6)
+        now = req.t_enqueue_ns + int(5e6)  # 5ms in
+        b = req.slo_burn(now)
+        assert b["ttft"] == pytest.approx(0.5)
+        assert b["deadline"] == pytest.approx(0.05)
+        assert "tpot" not in b  # no first token yet
+        req.t_first_token_ns = now
+        req.output_ids = [1, 2, 3]
+        later = now + int(30e6)  # 30ms for 2 inter-token gaps
+        b = req.slo_burn(later)
+        assert "ttft" not in b  # settled at first token
+        assert b["tpot"] == pytest.approx(3.0)  # 15ms/token vs 5ms
+        assert set(b) <= set(BURN_KINDS)
+
+    def test_burn_recorded_and_exceeded_counter_fires(self, model):
+        eng = _engine(model)
+        # an impossible TPOT target: burn crosses 1.0 immediately
+        eng.add_request(PROMPTS[0], max_new_tokens=NEW,
+                        slo_tpot_ms=1e-6)
+        eng.add_request(PROMPTS[1], max_new_tokens=NEW)
+        eng.run()
+        assert obs.SLO_BURN_EXCEEDED.value(kind="tpot") == 1
+        burns = [rec["burn"] for rec in eng._flight.records()
+                 if "burn" in rec]
+        assert burns and any("tpot" in b for rec in burns
+                             for b in rec.values())
+        # the declared-and-missed target shows in goodput too
+        assert obs.ENGINE_GOODPUT.value(
+            engine=eng._engine_id) == pytest.approx(0.5)
+
+    def test_burn_gauge_zeroes_after_requests_leave(self, model):
+        eng = _engine(model)
+        eng.add_request(PROMPTS[0], max_new_tokens=NEW,
+                        slo_tpot_ms=1e-6)
+        eng.run()
+        for k in BURN_KINDS:
+            assert obs.SLO_BURN.value(
+                engine=eng._engine_id, kind=k) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# ladder events + crash-safe auto-dumps
+# ---------------------------------------------------------------------------
+class TestDumps:
+    def test_fatal_fault_auto_dumps_black_box(self, model, tmp_path):
+        d = str(tmp_path / "flight")
+        eng = _engine(model, fault_plan="step@3;step@6-16",
+                      flight_dir=d)
+        reqs = [eng.add_request(p, max_new_tokens=NEW)
+                for p in PROMPTS]
+        serve_with_recovery(eng, max_recoveries=8)
+        dumps = [f for f in os.listdir(d) if f.endswith("_fault.json")]
+        assert dumps
+        assert not any(f.endswith(".tmp") for f in os.listdir(d))
+        with open(os.path.join(d, sorted(dumps)[0])) as f:
+            window = json.load(f)
+        assert window["reason"] == "fault"
+        kinds = {ev["kind"] for rec in window["records"]
+                 for ev in rec.get("events", [])}
+        assert "fault" in kinds   # the faulting step's record
+        assert "retry" in kinds   # the ladder ran first
+        assert request_ids(window)  # request timelines present
+        assert decode_stats()["flight_dumps"] >= 1
+        assert obs.FLIGHT_DUMPS.value(reason="fault") >= 1
+        for r in reqs:
+            assert r.state == "done"
+
+    def test_quarantine_event_recorded(self, model):
+        eng = _engine(model, fault_plan="nan_logits@2")
+        reqs = [eng.add_request(p, max_new_tokens=NEW)
+                for p in PROMPTS]
+        eng.run()
+        evs = [ev for rec in eng._flight.records()
+               for ev in rec.get("events", [])]
+        q = [ev for ev in evs if ev["kind"] == "quarantine"]
+        assert len(q) == 1 and q[0]["site"] == "nan_logits"
+        assert any(r.finish_reason == "fault" for r in reqs)
+        assert q[0]["request"] in {r.request_id for r in reqs}
+
+    def test_recovery_event_lands_on_successor(self, model):
+        eng = _engine(model, fault_plan="step@2-20")
+        eng.add_request(PROMPTS[0], max_new_tokens=4)
+        eng2, n = serve_with_recovery(eng, max_recoveries=4)
+        assert n >= 1
+        evs = [ev for rec in eng2._flight.records()
+               for ev in rec.get("events", [])]
+        assert any(ev["kind"] == "recovery" for ev in evs)
+
+    def test_explain_renders_request_timeline(self, model, tmp_path):
+        eng = _engine(model, fault_plan="step@3;nan_logits@4",
+                      flight_dir=str(tmp_path))
+        reqs = [eng.add_request(p, max_new_tokens=NEW)
+                for p in PROMPTS]
+        eng.run()
+        path = eng._flight.dump("manual")
+        with open(path) as f:
+            window = json.load(f)
+        suspect = next(r for r in reqs if r.finish_reason == "fault")
+        lines = explain(window, suspect.request_id)
+        text = "\n".join(lines)
+        assert f"request {suspect.request_id}" in text
+        assert "quarantine" in text
+        assert "finished: fault" in text
+        survivor = next(r for r in reqs if r.finish_reason != "fault")
+        lines = explain(window, survivor.request_id)
+        text = "\n".join(lines)
+        assert "+1 tok" in text or "tok" in text
+        assert "decode" in text
+
+    def test_dump_without_dir_is_noop(self, model):
+        eng = _engine(model)
+        eng.generate([PROMPTS[0]], max_new_tokens=2)
+        assert eng._flight.dump("manual") is None
+        assert decode_stats()["flight_dumps"] == 0
+
+    def test_flight_dir_defaults_beside_journal(self, model, tmp_path):
+        d = str(tmp_path / "j")
+        eng = _engine(model, journal_dir=d)
+        assert eng._flight.flight_dir == os.path.join(d, "flight")
+
+
+# ---------------------------------------------------------------------------
+# per-engine gauge retirement (satellite 1)
+# ---------------------------------------------------------------------------
+def _engine_label_values(snap):
+    out = set()
+    for m in snap.values():
+        if "engine" not in m["labels"]:
+            continue
+        for s in m["series"]:
+            out.add(s["labels"]["engine"])
+    return out
+
+
+class TestRetirement:
+    def test_recover_retires_whole_gauge_catalog(self, model):
+        # the burst is exhausted before the recovered engine's first
+        # retry, so the successor serves clean
+        eng = _engine(model, fault_plan="step@2-6")
+        eng.add_request(PROMPTS[0], max_new_tokens=4)
+        fault = None
+        while fault is None:
+            try:
+                eng.step()
+            except StepFault as e:
+                fault = e
+        assert str(eng._engine_id) in _engine_label_values(
+            obs.snapshot())
+        new = resilience.recover(eng, fault=fault)
+        labels = _engine_label_values(obs.snapshot())
+        assert str(eng._engine_id) not in labels
+        assert str(new._engine_id) in labels
+        assert f'engine="{eng._engine_id}"' not in \
+            obs.prometheus_text()
+        new.run()
+
+    def test_abandon_retires_dumps_and_marks_span(self, model,
+                                                  tmp_path):
+        eng = _engine(model, flight_dir=str(tmp_path))
+        eng.add_request(PROMPTS[0], max_new_tokens=4)
+        eng.step()
+        eng._abandon_inflight()
+        assert str(eng._engine_id) not in _engine_label_values(
+            obs.snapshot())
+        dumps = [f for f in os.listdir(str(tmp_path))
+                 if f.endswith("_abandoned.json")]
+        assert len(dumps) == 1
+        with open(os.path.join(str(tmp_path), dumps[0])) as f:
+            window = json.load(f)
+        evs = [ev for rec in window["records"]
+               for ev in rec.get("events", [])]
+        assert any(ev["kind"] == "abandon" for ev in evs)
+        assert any(s[1] == "abandoned" for s in obs.spans())
+        # a late-returning step must not repopulate the retired gauges
+        eng.step()
+        assert str(eng._engine_id) not in _engine_label_values(
+            obs.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# statusz / debug_dump
+# ---------------------------------------------------------------------------
+class TestStatusz:
+    def test_statusz_json_and_text(self, model):
+        eng = _engine(model)
+        eng.add_request(PROMPTS[0], max_new_tokens=NEW,
+                        slo_ttft_ms=1000.0)
+        eng.add_request(PROMPTS[1], max_new_tokens=NEW)
+        eng.step()
+        z = eng.statusz()
+        json.dumps(z)
+        assert z["engine"] == eng._engine_id
+        assert z["health"] == "live"
+        assert z["scheduler"] == "fifo"
+        assert len(z["slots"]) == 2
+        assert z["pool"]["num_pages"] == eng.pool.num_pages
+        assert z["flight"]["records"]
+        txt = eng.statusz_text()
+        assert f"engine {eng._engine_id}" in txt
+        assert "slots (2/2):" in txt
+        eng.run()
+        z = eng.statusz()
+        assert not z["slots"] and not z["queue"]
+
+    def test_statusz_reports_degraded_and_health(self, model):
+        eng = _engine(model, spec_decode_k=2, fault_plan="drafter@1-3")
+        eng.generate(PROMPTS, max_new_tokens=NEW)
+        z = eng.statusz()
+        assert z["degraded"]["spec_off"] is True
+        assert z["health"] == "degraded"
+        assert z["config"]["spec_k"] == 2
+
+    def test_statusz_midserve_thread_never_perturbs(self, model,
+                                                    reference):
+        eng = _engine(model)
+        reqs = [eng.add_request(p, max_new_tokens=NEW)
+                for p in PROMPTS]
+        polls = [0]
+        errors = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    z = eng.statusz()
+                    json.dumps(z)
+                    assert z["engine"] == eng._engine_id
+                    polls[0] += 1
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            eng.run()
+        finally:
+            stop.set()
+            t.join()
+        assert not errors
+        assert polls[0] >= 1
+        assert [list(r.generated_ids) for r in reqs] == reference
+
+    def test_frontend_debug_dump(self, model):
+        async def go():
+            eng = _engine(model)
+            async with ServingFrontend(eng) as fe:
+                s = await fe.submit(PROMPTS[0], max_new_tokens=NEW)
+                dump = fe.debug_dump()
+                toks = await s.collect()
+            return fe, dump, toks
+
+        fe, dump, toks = asyncio.run(asyncio.wait_for(go(), 120))
+        json.dumps(dump)
+        assert dump["frontend"]["driver_alive"] is True
+        assert dump["frontend"]["recoveries"] == 0
+        assert dump["engine"]["engine"] == fe.engine._engine_id
+        assert len(toks) == NEW
+        post = fe.debug_dump()
+        assert post["frontend"]["driver_alive"] is False
+        assert post["frontend"]["open_streams"] == {}
+
+
+# ---------------------------------------------------------------------------
+# restore integration
+# ---------------------------------------------------------------------------
+class TestRestore:
+    def test_restore_records_event_and_keeps_flight_dir(
+            self, model, tmp_path):
+        from paddle_tpu.inference.durability import restore_from_dir
+
+        d = str(tmp_path / "j")
+        eng = _engine(model, journal_dir=d)
+        eng.add_request(PROMPTS[0], max_new_tokens=NEW)
+        for _ in range(3):
+            eng.step()
+        eng._durability.flush()
+        eng2, reqs = restore_from_dir(d, model)
+        assert eng2._flight is not None
+        evs = [ev for rec in eng2._flight.records()
+               for ev in rec.get("events", [])]
+        assert any(ev["kind"] == "restore" for ev in evs)
+        assert eng2._flight.flight_dir == os.path.join(d, "flight")
+        eng2.run()

@@ -176,6 +176,66 @@ class TestPrometheusGolden:
             'app_requests_total{reason="length"} 1\n'
         )
 
+    def test_phase_and_burn_series_render(self):
+        """ISSUE-11 golden refresh: the flight recorder's phase
+        histogram (label `phase`, incl. the batch-observe path) and
+        the SLO-burn gauge/counter render as ordinary labeled
+        Prometheus series."""
+        obs.STEP_PHASE_SECONDS.observe(0.002, phase="decode")
+        obs.STEP_PHASE_SECONDS.observe_batch(
+            [({"phase": "decode"}, 0.004),
+             ({"phase": "emit"}, 0.00005)])
+        obs.SLO_BURN.set(1.25, engine=3, kind="tpot")
+        obs.SLO_BURN_EXCEEDED.inc(kind="tpot")
+        obs.ENGINE_TOKENS_PER_SECOND.set(123.5, engine=3)
+        txt = obs.prometheus_text()
+        assert ('paddle_step_phase_seconds_bucket{phase="decode",'
+                'le="+Inf"} 2') in txt
+        assert 'paddle_step_phase_seconds_count{phase="emit"} 1' in txt
+        assert 'paddle_step_phase_seconds_sum{phase="decode"} 0.006' \
+            in txt
+        assert 'paddle_slo_burn{engine="3",kind="tpot"} 1.25' in txt
+        assert 'paddle_slo_burn_exceeded_total{kind="tpot"} 1' in txt
+        assert ('paddle_engine_tokens_per_second{engine="3"} 123.5'
+                ) in txt
+        # observe() and observe_batch() agree on bucket math
+        st = obs.STEP_PHASE_SECONDS.series_state(phase="decode")
+        assert st["count"] == 2
+        assert st["sum"] == pytest.approx(0.006)
+
+
+# ---------------------------------------------------------------------------
+# doc drift: the registry catalog and docs/OBSERVABILITY.md move together
+# ---------------------------------------------------------------------------
+def test_metric_catalog_matches_docs():
+    """Every first-class metric registered in observability/__init__.py
+    has a row in docs/OBSERVABILITY.md's catalog table and vice versa —
+    a PR adding a series without documenting it (or documenting a
+    series that no longer exists) fails here, not in review."""
+    import os
+    import re
+
+    doc_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "OBSERVABILITY.md")
+    with open(doc_path) as f:
+        docs = f.read()
+    # catalog rows look like: | `paddle_foo` | counter | ... — the view
+    # table's patterned names (`paddle_decode_<counter>_total`, brace
+    # expansions) deliberately do not match
+    doc_names = set(re.findall(r"^\| `(paddle_[a-z0-9_]+)` \|", docs,
+                               re.M))
+    reg_names = {n for n in obs.registry._metrics
+                 if n.startswith("paddle_")}
+    undocumented = sorted(reg_names - doc_names)
+    assert not undocumented, (
+        f"metrics registered but missing from docs/OBSERVABILITY.md's "
+        f"catalog table: {undocumented}")
+    stale = sorted(doc_names - reg_names)
+    assert not stale, (
+        f"docs/OBSERVABILITY.md documents metrics that are not "
+        f"registered: {stale}")
+
 
 # ---------------------------------------------------------------------------
 # snapshot / reset invariants
@@ -501,6 +561,48 @@ class TestThreadSafety:
         assert h.series_state()["count"] == 4000
         assert h.series_state()["counts"] == [4000, 0]
         assert c.value() == 4000
+
+    def test_histogram_sum_count_consistent_across_reset(self):
+        """ISSUE-11 regression: a histogram's _sum/_count (and bucket
+        totals) must stay mutually consistent across `reset()` under
+        concurrent bumps — every snapshot a scraper takes satisfies
+        count == sum(bucket counts) and sum == count * v (constant-
+        value observations), whether a reset landed before, after, or
+        not at all.  A torn reset (zero counts, stale sum) would show
+        up as a fractional mean out of thin air."""
+        reg = MetricRegistry()
+        h = reg.histogram("h", buckets=(0.5, 2.0))
+        V = 1.0
+        stop = threading.Event()
+        bad = []
+
+        def write():
+            while not stop.is_set():
+                h.observe(V)
+                h.observe_batch([({}, V)])
+
+        def churn():
+            while not stop.is_set():
+                reg.reset()
+
+        def scrape():
+            while not stop.is_set():
+                st = h.series_state()
+                if sum(st["counts"]) != st["count"]:
+                    bad.append(("bucket/count tear", st))
+                if abs(st["sum"] - st["count"] * V) > 1e-9:
+                    bad.append(("sum/count tear", st))
+
+        threads = [threading.Thread(target=write) for _ in range(2)] \
+            + [threading.Thread(target=churn),
+               threading.Thread(target=scrape)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not bad, bad[:3]
 
 
 # ---------------------------------------------------------------------------

@@ -381,6 +381,48 @@ def test_bench_recovery_smoke(tmp_path):
     assert cross["journal_events"] >= 3
 
 
+def test_bench_flight_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_flight.py runs end-to-end: the
+    flight-recorder bench can't rot.  Asserts the ISSUE-11 acceptance
+    bar at smoke scale: under the injected chaos schedule the
+    auto-dumped window holds the faulting step's record, the ladder
+    events (retry -> quarantine), and the suspect request's timeline
+    which explain_request renders; the recorder-on leg is bit-exact
+    with recorder-off; and statusz hammered from a second thread
+    mid-serve stays consistent without perturbing outputs (the
+    overhead RATIO is gated at full scale only — smoke steps are
+    sub-millisecond and timer-noise dominated)."""
+    out = str(tmp_path / "bench_flight.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_flight.py", "--out", out,
+         "--flight-dir", str(tmp_path / "flight")],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    s = data["summary"]
+    assert s["dump_written"] is True
+    assert s["fault_step_recorded"] is True
+    assert s["ladder_events_in_dump"] is True
+    assert s["suspect_timeline_in_dump"] is True
+    assert s["explain_renders"] is True
+    assert s["recorder_parity"] is True
+    assert s["statusz_parity"] is True
+    assert s["statusz_consistent"] is True
+    assert s["recorder_us_per_step"] > 0
+    legs = data["legs"]
+    assert legs["chaos"]["quarantined"] >= 1
+    assert legs["chaos"]["step_retries"] >= 1
+    assert legs["chaos"]["recoveries"] >= 1
+    assert legs["chaos"]["flight_dumps"] >= 1
+    assert legs["statusz"]["polls"] >= 1
+    # the dumped window renders a real timeline for the suspect
+    assert any("quarantine" in ln or "fault" in ln
+               for ln in legs["chaos"]["explain_rendering"])
+
+
 def test_telemetry_dump_smoke(tmp_path):
     """tools/telemetry_dump.py runs a small engine workload end-to-end
     and every export format parses: Prometheus text has the core
@@ -416,6 +458,34 @@ def test_telemetry_dump_smoke(tmp_path):
               if e.get("ph") == "M"}
     assert {"host", "engine", "requests"} <= tracks
     assert any(e.get("name") == "prefill" for e in trace["traceEvents"])
+
+    # ISSUE-11 artifacts: the flight window parses and carries the
+    # serve's step records, and statusz ships in both JSON and text
+    with open(os.path.join(outdir, "telemetry_flight.json")) as f:
+        flight = json.load(f)
+    assert flight["records"]
+    steps = [r for r in flight["records"] if r["kind"] == "step"]
+    assert steps and all("phases" in r and "slots" in r for r in steps)
+    assert flight["totals"]["tokens"] > 0
+    with open(os.path.join(outdir, "telemetry_statusz.json")) as f:
+        statusz = json.load(f)
+    for key in ("engine", "step", "health", "queue", "slots", "pool",
+                "flight"):
+        assert key in statusz, key
+    assert statusz["health"] == "live"
+    txt = open(os.path.join(outdir, "telemetry_statusz.txt")).read()
+    assert "engine 0" in txt and "flight:" in txt
+    # and explain_request renders a timeline from the flight artifact
+    rid = statusz["flight"]["records"][-1]["slots"][0]["request"] \
+        if statusz["flight"]["records"][-1].get("slots") else 0
+    r2 = subprocess.run(
+        [sys.executable, "tools/explain_request.py",
+         os.path.join(outdir, "telemetry_flight.json"),
+         "--request", str(rid),
+         "--trace", os.path.join(outdir, "telemetry_trace.json")],
+        cwd=REPO, capture_output=True, text=True, env=ENV, timeout=120)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert f"request {rid}" in r2.stdout
 
 
 def test_tracecheck_smoke(tmp_path):

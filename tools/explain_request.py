@@ -1,0 +1,171 @@
+"""Explain one request's life from flight records (+ optional spans).
+
+The operator-facing answer to "why was this request slow" AFTER the
+fact: given a flight-recorder window — a live `FlightRecorder
+.snapshot()`, a crash auto-dump from ``FLAGS_flight_dir``, or the
+``telemetry_flight.json`` that `tools/telemetry_dump.py` emits — this
+reconstructs a single request's timeline step by step:
+
+* which steps carried it, in which phase (prefill chunks vs decode),
+  and how many tokens each step emitted for it;
+* the step's phase-time breakdown (where the wall actually went:
+  admit / prefill / mixed / decode / draft / verify / fetch / emit /
+  cache);
+* its SLO burn as it evolved (budget consumed vs slo_ttft_ms /
+  slo_tpot_ms / deadline_ms);
+* every ladder event that touched it or its engine — retry, degrade,
+  preempt/resume, quarantine, recovery, restore, fault, abandon;
+* its terminal state (finish reason).
+
+With ``--trace`` (a merged chrome-trace JSON) the request's lifecycle
+spans (queued / prefill / decode) are appended so the flight window's
+step-level view and the span-level view line up on one report.
+
+Usage:
+    python tools/explain_request.py FLIGHT.json --request ID
+                                    [--trace TRACE.json] [--all]
+
+``--all`` lists every request id seen in the window (discovery mode).
+`explain(window, request_id)` is the library entry the benches and
+tests call in-process.
+"""
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def request_ids(window: dict) -> List[int]:
+    """Every request id the window saw (slots, emissions, events,
+    finishes)."""
+    ids = set()
+    for rec in window.get("records", []):
+        for s in rec.get("slots", []):
+            ids.add(int(s["request"]))
+        for rid in rec.get("emitted", {}):
+            ids.add(int(rid))
+        for rid, _reason in rec.get("finished", []):
+            ids.add(int(rid))
+        for ev in rec.get("events", []):
+            if "request" in ev:
+                ids.add(int(ev["request"]))
+    return sorted(ids)
+
+
+def _fmt_phases(phases: dict) -> str:
+    if not phases:
+        return ""
+    return " | " + " ".join(
+        f"{k}={v * 1e3:.2f}ms" for k, v in
+        sorted(phases.items(), key=lambda kv: -kv[1]))
+
+
+def explain(window: dict, request_id: int,
+            spans: Optional[list] = None) -> List[str]:
+    """Render one request's timeline from a flight window dict;
+    returns the report lines (empty `records` yields a header only)."""
+    rid = int(request_id)
+    lines = [
+        f"request {rid} — engine {window.get('engine')}"
+        + (f" — dump reason: {window['reason']}"
+           if window.get("reason") else "")
+    ]
+    seen = False
+    for rec in window.get("records", []):
+        step = rec.get("step")
+        slot_entry = next((s for s in rec.get("slots", [])
+                           if int(s["request"]) == rid), None)
+        emitted = int(rec.get("emitted", {}).get(str(rid),
+                      rec.get("emitted", {}).get(rid, 0)))
+        finish = next((reason for r, reason in rec.get("finished", [])
+                       if int(r) == rid), None)
+        events = [ev for ev in rec.get("events", [])
+                  if ev.get("request") is None
+                  or int(ev.get("request")) == rid]
+        burn = (rec.get("burn") or {}).get(str(rid),
+                                           (rec.get("burn") or {})
+                                           .get(rid))
+        touches = slot_entry is not None or emitted or finish or \
+            any("request" in ev for ev in events)
+        if not touches and not (seen and events):
+            continue
+        seen = seen or touches
+        parts = [f"  step {step}"]
+        if rec.get("kind") == "event":
+            parts.append("(between steps)")
+        else:
+            parts.append(f"{rec.get('dur_s', 0) * 1e3:8.2f}ms")
+        if slot_entry is not None:
+            if slot_entry["phase"] == "prefill":
+                parts.append(
+                    f"prefill slot {slot_entry['slot']} "
+                    f"{slot_entry['prefill_pos']}/"
+                    f"{slot_entry['prompt_len']} prompt tokens")
+            else:
+                parts.append(
+                    f"decode  slot {slot_entry['slot']} "
+                    f"out {slot_entry['out']}")
+        if emitted:
+            parts.append(f"+{emitted} tok")
+        if burn:
+            parts.append("burn " + ",".join(
+                f"{k}={v:.2f}" for k, v in sorted(burn.items())))
+        line = " ".join(parts)
+        if slot_entry is not None or emitted:
+            line += _fmt_phases(rec.get("phases", {}))
+        lines.append(line)
+        for ev in events:
+            tag = " ".join(f"{k}={v}" for k, v in ev.items()
+                           if k != "kind")
+            lines.append(f"    !! {ev['kind']}" + (f" ({tag})"
+                                                   if tag else ""))
+        if finish:
+            lines.append(f"    -> finished: {finish}")
+    if not seen:
+        lines.append("  (not seen in this flight window)")
+    if spans:
+        lines.append("  spans:")
+        for ev in spans:
+            if ev.get("ph") != "X" or ev.get("tid") != rid:
+                continue
+            if ev.get("name") not in ("queued", "prefill", "decode",
+                                      "preempted"):
+                continue
+            args = ev.get("args") or {}
+            if args.get("request") not in (None, rid):
+                continue
+            lines.append(
+                f"    {ev['name']:<10} {ev.get('dur', 0) / 1e3:9.3f}ms"
+                + (f"  {args}" if args else ""))
+    return lines
+
+
+def _load_spans(trace_path: str) -> list:
+    with open(trace_path) as f:
+        return json.load(f).get("traceEvents", [])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("flight", help="flight window JSON (auto-dump or "
+                                   "telemetry_flight.json)")
+    ap.add_argument("--request", type=int, default=None)
+    ap.add_argument("--trace", default=None,
+                    help="merged chrome-trace JSON for span alignment")
+    ap.add_argument("--all", action="store_true",
+                    help="list every request id in the window")
+    args = ap.parse_args()
+    with open(args.flight) as f:
+        window = json.load(f)
+    if args.all or args.request is None:
+        ids = request_ids(window)
+        print(f"requests in window: {ids}")
+        if args.request is None:
+            return 0 if args.all else 2
+    spans = _load_spans(args.trace) if args.trace else None
+    print("\n".join(explain(window, args.request, spans=spans)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

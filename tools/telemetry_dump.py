@@ -10,11 +10,17 @@ a tiny GPT, serve a couple of requests through the paged decode engine
 * ``telemetry.json``        — structured snapshot
   (`observability.snapshot()`);
 * ``telemetry_trace.json``  — merged chrome-trace timeline (host
-  tracer + engine step spans + request spans, one named track each).
+  tracer + engine step spans + request spans, one named track each);
+* ``telemetry_flight.json`` — the flight-recorder window
+  (`FlightRecorder.snapshot()`: per-step batch composition, phase
+  breakdown, ladder events — what `tools/explain_request.py` reads);
+* ``telemetry_statusz.json`` / ``telemetry_statusz.txt`` — the live
+  `DecodeEngine.statusz()` snapshot in both its JSON and text forms.
 
-CI smokes this end-to-end (tests/test_tooling.py): both export formats
+CI smokes this end-to-end (tests/test_tooling.py): every export format
 must parse and the core request-latency series must be present after a
-single CPU `generate()` run — the ISSUE-4 acceptance check.
+single CPU `generate()` run — the ISSUE-4 acceptance check, widened by
+ISSUE-11 with the flight/statusz artifacts.
 
 Usage:
     python tools/telemetry_dump.py [--outdir DIR] [--batch 2]
@@ -84,6 +90,9 @@ def main():
     prom_path = os.path.join(args.outdir, "telemetry.prom")
     json_path = os.path.join(args.outdir, "telemetry.json")
     trace_path = os.path.join(args.outdir, "telemetry_trace.json")
+    flight_path = os.path.join(args.outdir, "telemetry_flight.json")
+    statusz_path = os.path.join(args.outdir, "telemetry_statusz.json")
+    statusz_txt = os.path.join(args.outdir, "telemetry_statusz.txt")
 
     with open(prom_path, "w") as f:
         f.write(observability.prometheus_text())
@@ -95,12 +104,25 @@ def main():
                                 "tokens_out": sum(len(o) for o in outs)},
                    "metrics": observability.snapshot()}, f, indent=2)
     trace = observability.export_chrome_trace(trace_path)
+    # the flight window + statusz: the black-box and live-state halves
+    # of the same serve (explain_request.py reads the flight file)
+    if eng._flight is not None:
+        eng._flight.dump(reason="manual", path=flight_path)
+    with open(statusz_path, "w") as f:
+        json.dump(eng.statusz(), f, indent=2)
+    with open(statusz_txt, "w") as f:
+        f.write(eng.statusz_text() + "\n")
 
     tracks = sorted(e["args"]["name"] for e in trace["traceEvents"]
                     if e.get("ph") == "M" and e.get("name") == "process_name")
     print(f"wrote {prom_path}")
     print(f"wrote {json_path}")
     print(f"wrote {trace_path} (tracks: {', '.join(tracks)})")
+    if eng._flight is not None:
+        print(f"wrote {flight_path} "
+              f"({len(eng._flight.records())} records)")
+    print(f"wrote {statusz_path}")
+    print(f"wrote {statusz_txt}")
     return 0
 
 
