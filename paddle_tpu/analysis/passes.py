@@ -34,8 +34,10 @@ otherwise only prose in a docstring:
   are findings.
 * **donation** (`DonationPass`) — every ``jax.jit`` site is
   cross-checked: all ``*_pages`` pool parameters of the jitted
-  function must appear in ``donate_argnums`` (a missed donation means
-  a full extra copy of the KV pool per step).
+  function — and the ``*_scales`` quant-scale arrays that count as
+  pool state under FLAGS_kv_quant — must appear in ``donate_argnums``
+  (a missed donation means a full extra copy of the KV pool, or a
+  silently copied scale buffer, per step).
 
 Findings carry a content-addressed ``fingerprint`` (pass id + file +
 source line text, no line number) so the baseline grandfather file
@@ -711,8 +713,13 @@ class EngineMutationPass:
 # donation coverage
 # ---------------------------------------------------------------------------
 class DonationPass:
-    """Every jax.jit site whose function carries ``*_pages`` pool
-    parameters must donate ALL of them."""
+    """Every jax.jit site whose function carries KV-pool parameters —
+    ``*_pages`` page pools AND the ``*_scales`` quant-scale arrays
+    that live beside them (FLAGS_kv_quant) — must donate ALL of them.
+    The scale arrays are pool state: a jit site donating the pages but
+    copying the scales would silently pay (and leak) a per-step scale
+    buffer, and under FLAGS_sanitize the tombstoned and live sets
+    would diverge."""
 
     def run(self, modules: Sequence[SourceModule],
             sites: Optional[List[JitSite]] = None) -> List[Finding]:
@@ -726,7 +733,7 @@ class DonationPass:
             params = [a.arg for a in getattr(args, "posonlyargs", [])] + \
                 [a.arg for a in args.args]
             pages = [(i, n) for i, n in enumerate(params)
-                     if n.endswith("_pages")]
+                     if n.endswith("_pages") or n.endswith("_scales")]
             if not pages:
                 continue
             donated = set(site.donate_argnums or ())

@@ -135,6 +135,36 @@ define_flag("prefix_cache", True,
             "least-recently-released-first under pool pressure.  0 "
             "restores prefill-from-scratch bit-exactly (the parity "
             "oracle; see docs/DECODE_PERF.md)")
+define_flag("kv_quant", "off",
+            "serving KV-page storage quantization "
+            "(inference.serving.DecodeEngine): 'int8' stores K/V pages "
+            "as int8 with per-page, per-head symmetric scales in "
+            "parallel donated f32 arrays — half/quarter the bytes per "
+            "page means proportionally more concurrent slots at fixed "
+            "pool memory; dequantization fuses into the paged-"
+            "attention K/V loads (Pallas kernel: in-register after the "
+            "page DMA, scale rows scalar-prefetched with the block "
+            "tables) and the write path quantizes each scattered "
+            "chunk in-graph, folding its per-head absmax into the "
+            "running page scale (existing rows re-quantize when the "
+            "scale grows — the 'refold').  'off' (default) is the "
+            "bit-exact full-precision path and constructs the exact "
+            "same executables as before the feature existed.  Output "
+            "quality is gated by measurement, not just plumbing: see "
+            "tools/bench_kv_quant.py / docs/DECODE_PERF.md.  Engines "
+            "constructed with an explicit kv_quant= ignore the flag")
+define_flag("snapshot_kv", True,
+            "serialize the content-addressed (prefix-cached) KV page "
+            "payloads — int8 + scales under FLAGS_kv_quant — into a "
+            "crc-validated sidecar (kv_pages.npz) beside each "
+            "durability snapshot: durability.restore_from_dir installs "
+            "them into the fresh pool and registers their chain "
+            "hashes, so replay re-admission prefix-hits the installed "
+            "pages instead of recomputing the whole prompt (and a "
+            "quantized snapshot is a fraction of the fp32 bytes).  A "
+            "missing/torn sidecar falls back to full recompute — "
+            "restores stay bit-identical either way.  0 = snapshot "
+            "host state only, as before")
 define_flag("kv_pool_debug", False,
             "audit KVBlockPool consistency (free/private/cached page "
             "partition, refcounts vs live request holds, eviction-LRU "

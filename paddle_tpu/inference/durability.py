@@ -67,10 +67,15 @@ __all__ = ["RequestWire", "SnapshotWire", "DurabilityManager",
            "StepWatchdog", "read_journal", "load_snapshot",
            "restore_from_dir", "enable_compile_cache", "set_health",
            "clear_health", "retire_engine_series", "HEALTH_STATES",
-           "JOURNAL_NAME", "SNAPSHOT_NAME"]
+           "JOURNAL_NAME", "SNAPSHOT_NAME", "KV_PAGES_NAME"]
 
 JOURNAL_NAME = "journal.wal"
 SNAPSHOT_NAME = "snapshot.json"
+# FLAGS_snapshot_kv sidecar: the content-addressed (prefix-cached) KV
+# page payloads — int8 + scales under FLAGS_kv_quant — serialized
+# beside the snapshot so a restore installs them instead of
+# recomputing the whole prompt history (see DurabilityManager)
+KV_PAGES_NAME = "kv_pages.npz"
 
 
 # ---------------------------------------------------------------------------
@@ -224,12 +229,20 @@ class SnapshotWire:
     prefill_no: int
     journal_pos: int
     records: List[RequestWire] = field(default_factory=list)
+    # FLAGS_snapshot_kv: metadata anchoring the kv_pages sidecar —
+    # file name, crc of its bytes, chain hashes (hex) in array order,
+    # and the storage dtype.  None = no sidecar (flag off, no cached
+    # pages, or a pre-sidecar snapshot); restore then recomputes
+    kv: Optional[dict] = None
 
     def to_obj(self) -> dict:
-        return {"v": 1, "engine_id": self.engine_id,
-                "step_no": self.step_no, "prefill_no": self.prefill_no,
-                "journal_pos": self.journal_pos,
-                "records": [r.to_obj() for r in self.records]}
+        obj = {"v": 1, "engine_id": self.engine_id,
+               "step_no": self.step_no, "prefill_no": self.prefill_no,
+               "journal_pos": self.journal_pos,
+               "records": [r.to_obj() for r in self.records]}
+        if self.kv is not None:
+            obj["kv"] = self.kv
+        return obj
 
     @classmethod
     def from_obj(cls, obj: dict) -> "SnapshotWire":
@@ -238,7 +251,8 @@ class SnapshotWire:
                    prefill_no=int(obj["prefill_no"]),
                    journal_pos=int(obj["journal_pos"]),
                    records=[RequestWire.from_obj(r)
-                            for r in obj["records"]])
+                            for r in obj["records"]],
+                   kv=obj.get("kv"))
 
 
 def load_snapshot(journal_dir: str) -> Optional[SnapshotWire]:
@@ -343,12 +357,15 @@ class DurabilityManager:
     appends after a crash stay parseable."""
 
     def __init__(self, engine, journal_dir: str, fsync=None,
-                 snapshot_interval=None):
+                 snapshot_interval=None, snapshot_kv=None):
         from ..core import flags as _flags
 
         self.engine = engine
         self.journal_dir = str(journal_dir)
         os.makedirs(self.journal_dir, exist_ok=True)
+        self.snapshot_kv = bool(
+            _flags.flag("snapshot_kv") if snapshot_kv is None
+            else snapshot_kv)
         self.fsync = str(fsync if fsync is not None
                          else _flags.flag("journal_fsync"))
         if self.fsync not in ("always", "step", "never"):
@@ -430,11 +447,21 @@ class DurabilityManager:
     def write_snapshot(self):
         """Serialize the engine's between-steps host state atomically:
         write to a temp file, fsync, `os.replace` — a crash mid-write
-        leaves the PREVIOUS snapshot intact, never a torn current one."""
+        leaves the PREVIOUS snapshot intact, never a torn current one.
+
+        With ``FLAGS_snapshot_kv`` (default on) the content-addressed
+        KV page payloads write FIRST into their own atomically-replaced
+        sidecar; the snapshot record then anchors the sidecar by crc,
+        so a crash between the two writes (stale sidecar, new
+        snapshot? impossible — snapshot references the NEW crc; new
+        sidecar, old snapshot? the old snapshot's crc no longer
+        matches) degrades to recompute, never to serving stale KV."""
         from .resilience import EngineSnapshot
         from .serving import _stats_add
 
         wire = EngineSnapshot(self.engine).to_wire(journal_pos=self.seq)
+        if self.snapshot_kv:
+            wire.kv = self._write_kv_sidecar()
         data = _frame(wire.to_obj())
         path = os.path.join(self.journal_dir, SNAPSHOT_NAME)
         tmp = path + ".tmp"
@@ -445,6 +472,59 @@ class DurabilityManager:
         os.replace(tmp, path)
         _stats_add(journal_snapshots=1)
 
+    def _write_kv_sidecar(self) -> Optional[dict]:
+        """Gather every content-addressed (prefix-cached) page's K/V
+        payload — and its quant scales when the pool is int8
+        (FLAGS_kv_quant) — off the device and write them crash-safely
+        beside the snapshot.  Returns the anchor metadata the snapshot
+        record carries, or None when there is nothing to serialize
+        (prefix cache off / no cached pages yet).  Quantized pools
+        serialize int8 bytes + f32 scales: roughly a quarter of the
+        fp32 sidecar for the same pages — the snapshot-byte and
+        restore-I/O halving tools/bench_kv_quant.py pins."""
+        import io
+
+        import numpy as np
+
+        eng = self.engine
+        if not eng._prefix_cache or not eng.pool._page_hash:
+            return None
+        if eng._spec is not None and \
+                getattr(eng._spec.drafter, "stateful", False):
+            # mirror of _install_kv_sidecar's guard: the restore side
+            # always refuses a target-pool-only sidecar when a stateful
+            # draft-model drafter needs the recompute to repopulate its
+            # own cache — don't pay the device fetch + fsync for bytes
+            # that can never install
+            return None
+        import jax
+
+        items = sorted(eng.pool._page_hash.items())  # (page, hash)
+        ids = np.asarray([p for p, _ in items], np.int32)
+        arrays = {
+            "k": np.asarray(jax.device_get(eng._k_pages[:, :, ids])),
+            "v": np.asarray(jax.device_get(eng._v_pages[:, :, ids])),
+        }
+        if eng._kv_quant:
+            arrays["ks"] = np.asarray(
+                jax.device_get(eng._k_scales[:, :, ids]))
+            arrays["vs"] = np.asarray(
+                jax.device_get(eng._v_scales[:, :, ids]))
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        payload = buf.getvalue()
+        path = os.path.join(self.journal_dir, KV_PAGES_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return {"file": KV_PAGES_NAME, "crc": zlib.crc32(payload),
+                "hashes": [h.hex() for _, h in items],
+                "dtype": str(eng._k_pages.dtype),
+                "page": int(eng._page), "bytes": len(payload)}
+
     def close(self):
         self.flush()
         self._fh.close()
@@ -453,6 +533,99 @@ class DurabilityManager:
 # ---------------------------------------------------------------------------
 # Fresh-process restore
 # ---------------------------------------------------------------------------
+def _install_kv_sidecar(journal_dir: str, snap: SnapshotWire,
+                        eng) -> int:
+    """Load the snapshot's KV sidecar (FLAGS_snapshot_kv) into the
+    rebuilt engine's pool: allocate a page per serialized payload,
+    scatter the payloads (and quant scales) into the device arrays,
+    and register each page under its chain hash at refcount 0 (parked
+    on the eviction LRU, exactly as a warm-but-idle cache would hold
+    it).  Replay re-admission then prefix-hits these pages instead of
+    recomputing the token history they encode — the payloads ARE the
+    dead engine's bytes, so quantized pools restore their int8 values
+    and scales exactly.
+
+    Defensive by construction: any anchor mismatch (missing/torn file,
+    crc fail, dtype or geometry drift) skips the install and restore
+    recomputes everything — never worse than the pre-sidecar behavior.
+    Returns the number of pages installed."""
+    import numpy as np
+
+    meta = snap.kv
+    if not meta or not eng._prefix_cache:
+        return 0
+    if eng._spec is not None and \
+            getattr(eng._spec.drafter, "stateful", False):
+        # a draft-MODEL drafter keeps its own K/V for the same page
+        # ids, and the sidecar only carries the target pool: installing
+        # would let replay prefix-hit pages whose DRAFT cache is still
+        # zeros — outputs stay correct (verify is authoritative) but
+        # acceptance would silently collapse after every restore.  Full
+        # recompute feeds the drafter through ingest_chunks exactly as
+        # the pre-sidecar path did; serializing the draft pool too is
+        # the future upgrade.
+        return 0
+    path = os.path.join(journal_dir, os.path.basename(
+        str(meta.get("file", KV_PAGES_NAME))))
+    if not os.path.exists(path):
+        return 0
+    with open(path, "rb") as f:
+        payload = f.read()
+    if zlib.crc32(payload) != int(meta.get("crc", -1)):
+        return 0  # torn/stale sidecar: recompute instead
+    if str(meta.get("dtype")) != str(eng._k_pages.dtype) or \
+            int(meta.get("page", -1)) != int(eng._page):
+        return 0  # config drift (should be impossible past the
+        #         # fingerprint check, but never install wrong bytes)
+    import io
+
+    try:
+        data = np.load(io.BytesIO(payload))
+        k, v = data["k"], data["v"]
+    except Exception:
+        return 0
+    if eng._kv_quant and not ("ks" in data.files and
+                              "vs" in data.files):
+        # an int8 sidecar without BOTH scale arrays is inconsistent
+        # (crc proves the bytes, not the key set): installing would
+        # either crash on the missing key or dequantize cached KV
+        # with zero scales — fall back to recompute instead
+        return 0
+    hashes = [bytes.fromhex(h) for h in meta.get("hashes", [])]
+    if k.shape[2] != len(hashes) or \
+            k.shape[:2] + k.shape[3:] != (eng._num_layers,
+                                          eng._num_heads, eng._page,
+                                          eng._head_dim):
+        return 0
+    n = min(len(hashes), eng.pool.free_count)
+    if n == 0:
+        return 0
+    import jax.numpy as jnp
+
+    # raw pool allocs (not the engine's fresh-marking wrapper): the
+    # installed pages carry LIVE scales that the between-steps scale
+    # reset must not zero
+    ids = [eng.pool.alloc_page() for _ in range(n)]
+    idx = jnp.asarray(np.asarray(ids, np.int32))
+    eng._k_pages = eng._k_pages.at[:, :, idx].set(
+        jnp.asarray(k[:, :, :n]))
+    eng._v_pages = eng._v_pages.at[:, :, idx].set(
+        jnp.asarray(v[:, :, :n]))
+    if eng._kv_quant:
+        eng._k_scales = eng._k_scales.at[:, :, idx].set(
+            jnp.asarray(data["ks"][:, :, :n]))
+        eng._v_scales = eng._v_scales.at[:, :, idx].set(
+            jnp.asarray(data["vs"][:, :, :n]))
+    installed = 0
+    for pid, key in zip(ids, hashes[:n]):
+        if eng.pool.register_page(pid, key):
+            eng.pool.unref_page(pid)  # refcount 0: retained, evictable
+            installed += 1
+        else:  # duplicate hash (cannot happen from one pool) — drop
+            eng.pool.free_pages([pid])
+    return installed
+
+
 def restore_from_dir(journal_dir: str, model, scheduler=None,
                      drafter=None, journal: bool = True, **overrides):
     """Rebuild an engine in a FRESH process from ``journal_dir`` and
@@ -540,6 +713,12 @@ def restore_from_dir(journal_dir: str, model, scheduler=None,
         # (greedy ignores them; stochastic streams must not restart)
         eng._step_no = snap.step_no
         eng._prefill_no = snap.prefill_no
+    # install the serialized prefix-cache payloads (FLAGS_snapshot_kv)
+    # BEFORE re-admission queues anything: the replay fold's admission
+    # probe then maps the installed pages at refcount+1 and recomputes
+    # only the uncached tail — same outputs, a fraction of the compute
+    installed_pages = _install_kv_sidecar(journal_dir, snap, eng) \
+        if snap is not None else 0
 
     # journaled ids key the watermarks: new requests in this process
     # must never collide with them
@@ -574,7 +753,8 @@ def restore_from_dir(journal_dir: str, model, scheduler=None,
         "engine", "restore", t0, _obs.now_ns() - t0,
         tid=eng._engine_id,
         args={"requests": len(reqs), "journal_events": len(events),
-              "snapshot": snap is not None})
+              "snapshot": snap is not None,
+              "kv_pages_installed": installed_pages})
     if eng._flight is not None:
         eng._flight.event("restore", requests=len(reqs),
                           journal_events=len(events),

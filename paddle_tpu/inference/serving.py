@@ -935,6 +935,220 @@ def _gpt_mixed_step(params, k_pages, v_pages, block_tables, seq_lens,
 
 
 # ---------------------------------------------------------------------------
+# Quantized-KV twins of the step functions (FLAGS_kv_quant=int8).
+#
+# Pages store int8 with per-page, per-head symmetric scales in parallel
+# ``k_scales``/``v_scales`` arrays ([L, Hkv, P] f32) that are donated
+# and threaded through every executable exactly like the page pools.
+# The write path quantizes the scattered chunk in-graph
+# (`pa.paged_quant_write`: per-head absmax folded into the running page
+# scale, existing rows re-quantized when the scale grows), and the read
+# path fuses dequant into the paged-attention K/V loads — no separate
+# materialization pass ever exists.  The sampled-token output is PACKED
+# with the step's refold count (one extra int32 row/element) so the
+# host learns both from the single blocking fetch the step already
+# pays — the sanitizer's one-sync-per-step contract holds in quantized
+# mode too.
+#
+# The unquantized functions above stay byte-identical — they are the
+# FLAGS_kv_quant=off path and the bit-exactness oracle; keeping the
+# twins separate (rather than a mode flag inside one body) is what
+# lets the off path compile the exact same executables as before this
+# feature existed (zero new executables in off mode, pinned by
+# tools/bench_kv_quant.py).
+# ---------------------------------------------------------------------------
+def _gpt_prefill_q(params, ids, true_len, bt_row, k_pages, v_pages,
+                   k_scales, v_scales, key, *, num_heads, head_dim, eps,
+                   sampler, temperature, top_k, top_p):
+    """Quantized-storage `_gpt_prefill`: the prompt pass itself attends
+    over the in-flight full-precision K/V (same `_sdpa_reference`), but
+    every K/V row scattered into the request's pages is quantized via
+    the running page scales — later chunked/decode steps read this
+    prompt's KV through the fused dequant exactly as if the chunked
+    path had written it.  Returns ``(k_pages, v_pages, k_scales,
+    v_scales, [token, refolds])``."""
+    from ..nn.functional.attention import _sdpa_reference
+
+    s_pad = ids.shape[1]
+    h = num_heads * head_dim
+    num_pages_total = k_pages.shape[2]
+    page = k_pages.shape[3]
+    pos = jnp.arange(s_pad, dtype=jnp.int32)
+    x = params["wte"][ids[0]] + params["wpe"][pos]  # [S, h]
+
+    valid = pos < true_len
+    page_idx = jnp.where(valid, bt_row[pos // page], num_pages_total)
+    slot = pos % page
+    spans = pa.paged_write_spans(
+        bt_row[None], jnp.zeros((1,), jnp.int32),
+        jnp.reshape(true_len, (1,)), s_pad, num_pages_total, page)
+    refolds = jnp.int32(0)
+
+    for li, blk in enumerate(params["blocks"]):
+        y = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
+        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = qkv.reshape(s_pad, 3, num_heads, head_dim)
+        q = qkv[:, 0].transpose(1, 0, 2)[None]  # [1, H, S, D]
+        k = qkv[:, 1].transpose(1, 0, 2)[None]
+        v = qkv[:, 2].transpose(1, 0, 2)[None]
+        k_pages, k_scales, rk = pa.paged_quant_write(
+            k_pages, k_scales, li, k[0].transpose(1, 0, 2), page_idx,
+            slot, spans)
+        v_pages, v_scales, rv = pa.paged_quant_write(
+            v_pages, v_scales, li, v[0].transpose(1, 0, 2), page_idx,
+            slot, spans)
+        refolds = refolds + rk + rv
+        attn = _sdpa_reference(q, k, v, None, 0.0, None, True)[0]
+        attn = attn.transpose(1, 0, 2).reshape(s_pad, h)
+        x = x + jnp.matmul(attn, blk["out_w"]) + blk["out_b"]
+        y = _ln(x, blk["ln2_w"], blk["ln2_b"], eps)
+        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+                        approximate=True)
+        x = x + jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+
+    h_last = jnp.take(x, true_len - 1, axis=0)[None]  # [1, h]
+    h_last = _ln(h_last, params["lnf_w"], params["lnf_b"], eps)
+    logits = _logits_of(params, h_last).astype(jnp.float32)
+    token = sample_logits(logits, sampler=sampler, temperature=temperature,
+                          top_k=top_k, top_p=top_p, key=key)
+    token = _guard_tokens(logits, token)[0]
+    out = jnp.stack([token.astype(jnp.int32), refolds])
+    return k_pages, v_pages, k_scales, v_scales, out
+
+
+def _gpt_decode_step_q(params, k_pages, v_pages, k_scales, v_scales,
+                       block_tables, seq_lens, tokens, active, key, *,
+                       num_heads, head_dim, eps, sampler, temperature,
+                       top_k, top_p):
+    """Quantized-storage `_gpt_decode_step`: the incoming token's K/V
+    quantizes into its page (scale fold + refold), attention reads the
+    pool through the fused dequant.  Returns ``(k_pages, v_pages,
+    k_scales, v_scales, out)`` with ``out`` = sampled tokens packed
+    with the refold count as its last element ([B+1] int32)."""
+    b = tokens.shape[0]
+    h = num_heads * head_dim
+    num_pages_total = k_pages.shape[2]
+    page = k_pages.shape[3]
+
+    pos = seq_lens  # the incoming token's position
+    x = params["wte"][tokens] + params["wpe"][pos]  # [B, h]
+    page_idx = jnp.where(
+        active, block_tables[jnp.arange(b), pos // page], num_pages_total)
+    slot = pos % page
+    lens_now = seq_lens + active.astype(jnp.int32)
+    refolds = jnp.int32(0)
+
+    for li, blk in enumerate(params["blocks"]):
+        y = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
+        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = qkv.reshape(b, 3, num_heads, head_dim)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, H, D]
+        k_pages, k_scales, rk = pa.paged_quant_write(
+            k_pages, k_scales, li, k, page_idx, slot)
+        v_pages, v_scales, rv = pa.paged_quant_write(
+            v_pages, v_scales, li, v, page_idx, slot)
+        refolds = refolds + rk + rv
+        attn = pa.paged_attention(q, k_pages[li], v_pages[li],
+                                  block_tables, lens_now,
+                                  k_scales=k_scales[li],
+                                  v_scales=v_scales[li])
+        x = x + jnp.matmul(attn.reshape(b, h), blk["out_w"]) + blk["out_b"]
+        y = _ln(x, blk["ln2_w"], blk["ln2_b"], eps)
+        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+                        approximate=True)
+        x = x + jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+
+    x = _ln(x, params["lnf_w"], params["lnf_b"], eps)
+    logits = _logits_of(params, x).astype(jnp.float32)
+    nxt = sample_logits(logits, sampler=sampler, temperature=temperature,
+                        top_k=top_k, top_p=top_p, key=key)
+    nxt = _guard_tokens(logits, nxt)
+    out = jnp.concatenate([jnp.where(active, nxt, 0).astype(jnp.int32),
+                           refolds[None]])
+    return k_pages, v_pages, k_scales, v_scales, out
+
+
+def _gpt_mixed_step_q(params, k_pages, v_pages, k_scales, v_scales,
+                      block_tables, seq_lens, tokens, write_caps,
+                      sample_idx, sample_mask, key, *, num_heads,
+                      head_dim, eps, sampler, temperature, top_k, top_p):
+    """Quantized-storage `_gpt_mixed_step`: every contributed prompt/
+    decode row quantizes into its slot's pages, the ragged multi-query
+    attention reads through the fused dequant.  Returns ``(k_pages,
+    v_pages, k_scales, v_scales, out)`` with ``out`` [B+1] int32 (the
+    sampled token per slot + the refold count)."""
+    b, qn = tokens.shape
+    h = num_heads * head_dim
+    num_pages_total = k_pages.shape[2]
+    page = k_pages.shape[3]
+
+    offs = jnp.arange(qn, dtype=jnp.int32)
+    pos = seq_lens[:, None] + offs[None, :]              # [B, Q]
+    wpe_max = params["wpe"].shape[0] - 1
+    x = params["wte"][tokens] + params["wpe"][jnp.minimum(pos, wpe_max)]
+    page_idx, slot = pa.paged_write_indices(
+        block_tables, seq_lens, write_caps, qn, num_pages_total, page)
+    flat_idx = page_idx.reshape(-1)                      # [B*Q]
+    flat_slot = slot.reshape(-1)
+    spans = pa.paged_write_spans(
+        block_tables, seq_lens, write_caps, qn, num_pages_total, page)
+    lens_now = seq_lens + write_caps
+    refolds = jnp.int32(0)
+
+    for li, blk in enumerate(params["blocks"]):
+        y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
+        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = qkv.reshape(b, qn, 3, num_heads, head_dim)
+        q = qkv[:, :, 0]                                 # [B, Q, H, D]
+        k_pages, k_scales, rk = pa.paged_quant_write(
+            k_pages, k_scales, li,
+            qkv[:, :, 1].reshape(b * qn, num_heads, head_dim),
+            flat_idx, flat_slot, spans)
+        v_pages, v_scales, rv = pa.paged_quant_write(
+            v_pages, v_scales, li,
+            qkv[:, :, 2].reshape(b * qn, num_heads, head_dim),
+            flat_idx, flat_slot, spans)
+        refolds = refolds + rk + rv
+        attn = pa.paged_attention(q, k_pages[li], v_pages[li],
+                                  block_tables, lens_now,
+                                  q_offsets=seq_lens,
+                                  k_scales=k_scales[li],
+                                  v_scales=v_scales[li])
+        x = x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"]) \
+            + blk["out_b"]
+        y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
+        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+                        approximate=True)
+        x = x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+                 ).reshape(b, qn, h)
+
+    sel = x[jnp.arange(b), sample_idx]                   # [B, h]
+    sel = _ln(sel, params["lnf_w"], params["lnf_b"], eps)
+    logits = _logits_of(params, sel).astype(jnp.float32)
+    nxt = sample_logits(logits, sampler=sampler, temperature=temperature,
+                        top_k=top_k, top_p=top_p, key=key)
+    nxt = _guard_tokens(logits, nxt)
+    out = jnp.concatenate(
+        [jnp.where(sample_mask, nxt, 0).astype(jnp.int32),
+         refolds[None]])
+    return k_pages, v_pages, k_scales, v_scales, out
+
+
+def _reset_kv_scales(k_scales, v_scales, fresh_idx):
+    """Zero the quant-scale entries of freshly (re)allocated pages —
+    one small donated executable the engine runs between steps whenever
+    the allocator handed out pages since the last device call, so a
+    recycled page's stale scale can never leak into its new owner's
+    quantization (the determinism contract `pa.paged_quant_write`
+    documents).  ``fresh_idx`` is a fixed-size [num_pages] int32
+    buffer padded with ``num_pages`` (out-of-bounds: dropped by the
+    scatter)."""
+    k_scales = k_scales.at[:, :, fresh_idx].set(0.0)
+    v_scales = v_scales.at[:, :, fresh_idx].set(0.0)
+    return k_scales, v_scales
+
+
+# ---------------------------------------------------------------------------
 # The engine
 # ---------------------------------------------------------------------------
 class DecodeEngine:
@@ -960,7 +1174,7 @@ class DecodeEngine:
                  prefill_chunk_tokens=None, prefill_q_max=None,
                  prefix_cache=None, scheduler=None, fault_plan=None,
                  journal_dir=None, step_timeout_ms=None,
-                 flight_window=None, flight_dir=None):
+                 flight_window=None, flight_dir=None, kv_quant=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -983,8 +1197,28 @@ class DecodeEngine:
                 f"position table ({cfg.max_seq_len})")
         kv_dtype = jnp.dtype(dtype) if dtype is not None else \
             self._params["wte"].dtype
+        # quantized KV pages (explicit arg wins, else FLAGS_kv_quant):
+        # "int8" stores pages as int8 with per-page, per-head symmetric
+        # scales in parallel donated arrays; "off" (default) is the
+        # bit-exact full-precision path — it constructs the exact same
+        # executables as before the feature existed.
+        from ..core import flags as _early_flags
+
+        if kv_quant is None:
+            kv_quant = str(_early_flags.flag("kv_quant"))
+        kv_quant = str(kv_quant)
+        if kv_quant not in ("off", "int8"):
+            raise ValueError(
+                f"kv_quant must be 'off' or 'int8', got {kv_quant!r}")
+        self._kv_quant = kv_quant == "int8"
+        self._kv_quant_mode = kv_quant
+        # the page-size autotune cache keys on the STORAGE dtype of the
+        # pages — an int8 pool must never reuse an fp32-picked page
+        # size (a quarter the bytes per page changes the VMEM-fit
+        # winner), so the quantized storage dtype drives the pick
+        storage_dtype = jnp.dtype(jnp.int8) if self._kv_quant else kv_dtype
         self._page = int(page_size or pa.default_page_size(
-            self._max_seq_len, self._head_dim, kv_dtype))
+            self._max_seq_len, self._head_dim, storage_dtype))
         # block tables round UP: a horizon that doesn't tile just leaves
         # the last page partially used (ragged lengths mask the rest)
         self._pages_per_seq = -(-self._max_seq_len // self._page)
@@ -992,8 +1226,22 @@ class DecodeEngine:
         self.pool = KVBlockPool(n_pages)
         shape = (self._num_layers, self._num_heads, n_pages, self._page,
                  self._head_dim)
-        self._k_pages = jnp.zeros(shape, kv_dtype)
-        self._v_pages = jnp.zeros(shape, kv_dtype)
+        self._k_pages = jnp.zeros(shape, storage_dtype)
+        self._v_pages = jnp.zeros(shape, storage_dtype)
+        # per-page, per-head dequant scales (quantized mode only):
+        # donated pool state threaded through every step executable
+        # beside the pages — tracecheck's donation pass counts
+        # ``*_scales`` params as pool state
+        self._k_scales = self._v_scales = None
+        self._scale_reset_fn = None
+        # pages the allocator handed out since the last scale reset —
+        # their (possibly stale) scale entries zero on the next
+        # between-steps flush, BEFORE any quantized write sees them
+        self._fresh_pages: List[int] = []
+        if self._kv_quant:
+            sshape = (self._num_layers, self._num_heads, n_pages)
+            self._k_scales = jnp.zeros(sshape, jnp.float32)
+            self._v_scales = jnp.zeros(sshape, jnp.float32)
 
         self._bt = np.zeros((self._slots, self._pages_per_seq), np.int32)
         self._lens = np.zeros(self._slots, np.int32)
@@ -1178,7 +1426,8 @@ class DecodeEngine:
             prefix_cache=self._prefix_cache,
             scheduler=self._scheduler, fault_plan=self._fault,
             journal_dir=self._journal_dir,
-            step_timeout_ms=self._step_timeout_ms)
+            step_timeout_ms=self._step_timeout_ms,
+            kv_quant=self._kv_quant_mode)
 
         # flight recorder (observability.flight): always-cheap bounded
         # ring of per-step records — batch composition, phase
@@ -1278,6 +1527,11 @@ class DecodeEngine:
                 self._slots, self._max_seq_len, self._page,
                 self.pool.num_pages, self._q_max,
                 int(self._ctor["prefill_chunk_tokens"]),
+                # the page STORAGE dtype already separates quantized
+                # from full-precision engines (int8 <-> kv_quant is
+                # one-to-one); adding the mode string would break
+                # fingerprint compatibility with pre-quant journals
+                # for off-mode engines whose executables ARE identical
                 str(self._k_pages.dtype),
                 tuple(sorted(self._sampling.items())),
                 self._spec.k if self._spec else 0,
@@ -1304,12 +1558,13 @@ class DecodeEngine:
         """Every live `_JitTracker` this engine (and its speculative
         subsystem) currently holds — the watchdog's compile detector
         and the handoff's donor surface."""
-        ts = [self._decode_fn, self._mixed_fn,
+        ts = [self._decode_fn, self._mixed_fn, self._scale_reset_fn,
               *self._prefill_fns.values()]
         if self._spec is not None:
             ts.append(self._spec._verify_fn)
             d = self._spec.drafter
-            for name in ("_catch_fn", "_step_fn", "_chunk_fn"):
+            for name in ("_catch_fn", "_step_fn", "_chunk_fn",
+                         "_scale_reset_fn"):
                 ts.append(getattr(d, name, None))
             ts.extend(getattr(d, "_prefill_fns", {}).values())
         return [t for t in ts if t is not None]
@@ -1334,6 +1589,10 @@ class DecodeEngine:
             n += 1
         if self._mixed_fn is None and donor._mixed_fn is not None:
             self._mixed_fn = donor._mixed_fn
+            n += 1
+        if self._scale_reset_fn is None and \
+                donor._scale_reset_fn is not None:
+            self._scale_reset_fn = donor._scale_reset_fn
             n += 1
         for bucket, fn in donor._prefill_fns.items():
             if bucket not in self._prefill_fns:
@@ -1441,6 +1700,86 @@ class DecodeEngine:
 
     def _pages_for(self, tokens: int) -> int:
         return -(-tokens // self._page)  # ceil
+
+    def _alloc_page(self) -> int:
+        """THE engine's page-allocation chokepoint: every page the
+        engine claims (admission prompt pages, between-steps growth)
+        comes through here so quantized mode can mark it fresh — its
+        quant-scale entry zeroes on the next `_flush_fresh_scales`
+        BEFORE any quantized write folds into it.  A recycled page's
+        stale scale leaking into a new owner would silently change the
+        quantization (history-dependent outputs: the restore/recovery
+        bit-exactness contract breaks)."""
+        p = self.pool.alloc_page()
+        if self._kv_quant:
+            self._fresh_pages.append(p)
+        return p
+
+    def _scale_reset_tracker(self) -> _JitTracker:
+        fn = self._scale_reset_fn
+        if fn is None:
+            fn = self._scale_reset_fn = _JitTracker(
+                _reset_kv_scales, "kv_quant_compiles",
+                donate_argnums=(0, 1),
+                site="DecodeEngine scale reset (_reset_kv_scales)")
+        return fn
+
+    def _flush_fresh_scales(self):
+        """Zero the quant-scale entries of pages allocated since the
+        last device call (one fixed-shape donated scatter; the fresh
+        buffer pads with an out-of-bounds id so the executable never
+        retraces).  Runs between steps, right before the quantized
+        step executable — a no-op dict check on every step that
+        allocated nothing, and never on the off path."""
+        if not self._kv_quant or not self._fresh_pages:
+            return
+        # churn inside one window (alloc -> unwind -> realloc) can
+        # repeat an id; the reset is idempotent but dedupe keeps the
+        # fixed-size buffer sufficient by construction
+        ids = list(dict.fromkeys(self._fresh_pages))
+        self._fresh_pages = []
+        buf = np.full(self.pool.num_pages, self.pool.num_pages,
+                      np.int32)
+        buf[:len(ids)] = ids
+        fn = self._scale_reset_tracker()
+        with self._phase("cache"):
+            self._k_scales, self._v_scales = fn(
+                self._k_scales, self._v_scales, jnp.asarray(buf))
+            if self._spec is not None and \
+                    getattr(self._spec.drafter, "_k_scales", None) \
+                    is not None:
+                d = self._spec.drafter
+                dfn = d._scale_reset_tracker()
+                d._k_scales, d._v_scales = dfn(
+                    d._k_scales, d._v_scales, jnp.asarray(buf))
+        _stats_add(kv_quant_pages=len(ids))
+        _obs.KV_QUANT_PAGES.inc(len(ids))
+
+    def _note_refolds(self, n: int):
+        """Account one quantized step's scale refolds (the packed
+        count the step executable returned with its tokens)."""
+        if n:
+            _stats_add(kv_quant_refolds=int(n))
+            _obs.KV_QUANT_REFOLDS.inc(int(n))
+
+    def _kv_byte_occupancy(self) -> dict:
+        """Device bytes the KV pool currently holds in non-free pages
+        (payload + quant scales), plus the per-token storage cost —
+        the density numbers the flight recorder stamps per step and
+        tools/bench_kv_quant.py gates on."""
+        per_page_payload = 2 * self._num_layers * self._num_heads * \
+            self._page * self._head_dim * self._k_pages.dtype.itemsize
+        per_page_scales = 0
+        if self._kv_quant:
+            per_page_scales = 2 * self._num_layers * self._num_heads * 4
+        used = self.pool.used_count
+        return {
+            "dtype": str(self._k_pages.dtype),
+            "payload_bytes": used * per_page_payload,
+            "scale_bytes": used * per_page_scales,
+            "bytes_per_token": (per_page_payload + per_page_scales)
+            / self._page,
+        }
 
     def _prefill_bucket(self, p_len: int) -> int:
         """Pow-2 prompt-length bucket (floor 16, capped at the horizon)
@@ -1616,7 +1955,7 @@ class DecodeEngine:
         req.cached_prefix_len = len(req.pages) * self._page
         p_len = len(req.prompt_ids)
         for _ in range(len(req.pages), self._pages_for(p_len)):
-            req.pages.append(self.pool.alloc_page())
+            req.pages.append(self._alloc_page())
         self.pool.reserved += total_pages - len(req.pages)
         row = np.zeros(self._pages_per_seq, np.int32)
         row[:len(req.pages)] = req.pages
@@ -1691,13 +2030,24 @@ class DecodeEngine:
             # prompt-length bucket is an expected warmup event, not a
             # steady-state retrace) — only per-bucket recompiles count
             # toward retraces_after_warmup
-            fn = _JitTracker(
-                functools.partial(_gpt_prefill, num_heads=self._num_heads,
-                                  head_dim=self._head_dim, eps=self._eps,
-                                  **self._sampling),
-                "prefill_compiles", donate_argnums=(4, 5),
-                site=f"DecodeEngine prefill bucket {bucket} "
-                     f"(_gpt_prefill)")
+            if self._kv_quant:
+                fn = _JitTracker(
+                    functools.partial(_gpt_prefill_q,
+                                      num_heads=self._num_heads,
+                                      head_dim=self._head_dim,
+                                      eps=self._eps, **self._sampling),
+                    "prefill_compiles", donate_argnums=(4, 5, 6, 7),
+                    site=f"DecodeEngine prefill bucket {bucket} "
+                         f"(_gpt_prefill_q)")
+            else:
+                fn = _JitTracker(
+                    functools.partial(_gpt_prefill,
+                                      num_heads=self._num_heads,
+                                      head_dim=self._head_dim,
+                                      eps=self._eps, **self._sampling),
+                    "prefill_compiles", donate_argnums=(4, 5),
+                    site=f"DecodeEngine prefill bucket {bucket} "
+                         f"(_gpt_prefill)")
             self._prefill_fns[bucket] = fn
         t0 = time.perf_counter()
         t0_ns = _obs.now_ns()
@@ -1711,12 +2061,25 @@ class DecodeEngine:
             self._key, _fold_counter(self._prefill_no,
                                      RNG_PREFILL_DOMAIN))
         fr = self._flight
+        self._flush_fresh_scales()
         with self._phase("prefill"):
-            self._k_pages, self._v_pages, tok = fn(
-                self._params, jnp.asarray(ids), jnp.int32(p_len),
-                jnp.asarray(self._bt[slot]), self._k_pages,
-                self._v_pages, key)
-        tok = int(self._host_fetch(tok))
+            if self._kv_quant:
+                (self._k_pages, self._v_pages, self._k_scales,
+                 self._v_scales, tok) = fn(
+                    self._params, jnp.asarray(ids), jnp.int32(p_len),
+                    jnp.asarray(self._bt[slot]), self._k_pages,
+                    self._v_pages, self._k_scales, self._v_scales, key)
+            else:
+                self._k_pages, self._v_pages, tok = fn(
+                    self._params, jnp.asarray(ids), jnp.int32(p_len),
+                    jnp.asarray(self._bt[slot]), self._k_pages,
+                    self._v_pages, key)
+        tok = self._host_fetch(tok)
+        if self._kv_quant:
+            self._note_refolds(int(tok[1]))
+            tok = int(tok[0])
+        else:
+            tok = int(tok)
         # the pass's wall time is real either way; the token count,
         # prefill count and TTFT stamp wait for the NaN-sentinel check
         # below — a quarantined prefill emitted nothing (mirrors the
@@ -2068,7 +2431,7 @@ class DecodeEngine:
                     continue  # nothing written this step
                 pidx = (int(self._lens[slot]) + w - 1) // self._page
                 while pidx >= len(req.pages):
-                    req.pages.append(self.pool.alloc_page())
+                    req.pages.append(self._alloc_page())
                     self.pool.reserved -= 1
                     self._bt[slot, len(req.pages) - 1] = req.pages[-1]
 
@@ -2100,6 +2463,8 @@ class DecodeEngine:
         _obs.KV_FREE_PAGES.set(self.pool.free_count, engine=eid)
         _obs.KV_UTIL.set(self.pool.utilization(), engine=eid)
         _obs.SLOT_OCCUPANCY.set(n_active / self._slots, engine=eid)
+        _obs.KV_QUANT_BYTES_PER_TOKEN.set(
+            self._kv_byte_occupancy()["bytes_per_token"], engine=eid)
         if self._prefix_cache:
             _obs.PREFIX_CACHED_PAGES.set(self.pool.cached_count,
                                          engine=eid)
@@ -2113,13 +2478,22 @@ class DecodeEngine:
     def _mixed_fn_tracker(self) -> _JitTracker:
         fn = self._mixed_fn
         if fn is None:
-            fn = self._mixed_fn = _JitTracker(
-                functools.partial(_gpt_mixed_step,
-                                  num_heads=self._num_heads,
-                                  head_dim=self._head_dim, eps=self._eps,
-                                  **self._sampling),
-                "mixed_compiles", donate_argnums=(1, 2),
-                site="DecodeEngine mixed step (_gpt_mixed_step)")
+            if self._kv_quant:
+                fn = self._mixed_fn = _JitTracker(
+                    functools.partial(_gpt_mixed_step_q,
+                                      num_heads=self._num_heads,
+                                      head_dim=self._head_dim,
+                                      eps=self._eps, **self._sampling),
+                    "mixed_compiles", donate_argnums=(1, 2, 3, 4),
+                    site="DecodeEngine mixed step (_gpt_mixed_step_q)")
+            else:
+                fn = self._mixed_fn = _JitTracker(
+                    functools.partial(_gpt_mixed_step,
+                                      num_heads=self._num_heads,
+                                      head_dim=self._head_dim,
+                                      eps=self._eps, **self._sampling),
+                    "mixed_compiles", donate_argnums=(1, 2),
+                    site="DecodeEngine mixed step (_gpt_mixed_step)")
         return fn
 
     def _mixed_step(self, decode_rows=True) -> bool:
@@ -2186,17 +2560,31 @@ class DecodeEngine:
         # executable ("decode")
         phase_name = "prefill" if not decode_rows else \
             ("mixed" if chunk_of else "decode")
+        self._flush_fresh_scales()
         t0 = time.perf_counter()
         t0_ns = _obs.now_ns()
         with RecordEvent("serving.mixed_step"):
             with self._phase(phase_name):
-                self._k_pages, self._v_pages, toks = fn(
-                    self._params, self._k_pages, self._v_pages,
-                    jnp.asarray(self._bt), jnp.asarray(self._lens),
-                    jnp.asarray(tokens), jnp.asarray(caps),
-                    jnp.asarray(sample_idx), jnp.asarray(sample_mask),
-                    key)
+                if self._kv_quant:
+                    (self._k_pages, self._v_pages, self._k_scales,
+                     self._v_scales, toks) = fn(
+                        self._params, self._k_pages, self._v_pages,
+                        self._k_scales, self._v_scales,
+                        jnp.asarray(self._bt), jnp.asarray(self._lens),
+                        jnp.asarray(tokens), jnp.asarray(caps),
+                        jnp.asarray(sample_idx),
+                        jnp.asarray(sample_mask), key)
+                else:
+                    self._k_pages, self._v_pages, toks = fn(
+                        self._params, self._k_pages, self._v_pages,
+                        jnp.asarray(self._bt), jnp.asarray(self._lens),
+                        jnp.asarray(tokens), jnp.asarray(caps),
+                        jnp.asarray(sample_idx),
+                        jnp.asarray(sample_mask), key)
             toks = self._host_fetch(toks)
+        if self._kv_quant:
+            self._note_refolds(int(toks[-1]))
+            toks = toks[:-1]
         dt = time.perf_counter() - t0
         if self._fault is not None:
             toks = self._resilience.corrupt_tokens(
@@ -2413,6 +2801,7 @@ class DecodeEngine:
                 "page_size": self._page,
                 "chunked_prefill": bool(self._chunked),
                 "prefix_cache": bool(self._prefix_cache),
+                "kv_quant": self._kv_quant_mode,
                 "chunk_budget": int(self._chunk_budget),
                 "spec_k": self._spec.k if self._spec is not None else 0,
                 "sampling": dict(self._sampling),
@@ -2605,13 +2994,22 @@ class DecodeEngine:
 
         fn = self._decode_fn
         if fn is None:
-            fn = self._decode_fn = _JitTracker(
-                functools.partial(_gpt_decode_step,
-                                  num_heads=self._num_heads,
-                                  head_dim=self._head_dim, eps=self._eps,
-                                  **self._sampling),
-                "decode_compiles", donate_argnums=(1, 2),
-                site="DecodeEngine decode step (_gpt_decode_step)")
+            if self._kv_quant:
+                fn = self._decode_fn = _JitTracker(
+                    functools.partial(_gpt_decode_step_q,
+                                      num_heads=self._num_heads,
+                                      head_dim=self._head_dim,
+                                      eps=self._eps, **self._sampling),
+                    "decode_compiles", donate_argnums=(1, 2, 3, 4),
+                    site="DecodeEngine decode step (_gpt_decode_step_q)")
+            else:
+                fn = self._decode_fn = _JitTracker(
+                    functools.partial(_gpt_decode_step,
+                                      num_heads=self._num_heads,
+                                      head_dim=self._head_dim,
+                                      eps=self._eps, **self._sampling),
+                    "decode_compiles", donate_argnums=(1, 2),
+                    site="DecodeEngine decode step (_gpt_decode_step)")
 
         if self._fault is not None:
             self._resilience.step_fault_point("decode_step")
@@ -2619,16 +3017,29 @@ class DecodeEngine:
         key = jax.random.fold_in(
             self._key, _fold_counter(self._step_no, RNG_DECODE_DOMAIN))
         fr = self._flight
+        self._flush_fresh_scales()
         t0 = time.perf_counter()
         t0_ns = _obs.now_ns()
         with RecordEvent("serving.decode_step"):
             with self._phase("decode"):
-                self._k_pages, self._v_pages, toks = fn(
-                    self._params, self._k_pages, self._v_pages,
-                    jnp.asarray(self._bt), jnp.asarray(self._lens),
-                    jnp.asarray(self._last), jnp.asarray(self._active),
-                    key)
+                if self._kv_quant:
+                    (self._k_pages, self._v_pages, self._k_scales,
+                     self._v_scales, toks) = fn(
+                        self._params, self._k_pages, self._v_pages,
+                        self._k_scales, self._v_scales,
+                        jnp.asarray(self._bt), jnp.asarray(self._lens),
+                        jnp.asarray(self._last),
+                        jnp.asarray(self._active), key)
+                else:
+                    self._k_pages, self._v_pages, toks = fn(
+                        self._params, self._k_pages, self._v_pages,
+                        jnp.asarray(self._bt), jnp.asarray(self._lens),
+                        jnp.asarray(self._last),
+                        jnp.asarray(self._active), key)
             toks = self._host_fetch(toks)
+        if self._kv_quant:
+            self._note_refolds(int(toks[-1]))
+            toks = toks[:-1]
         dt = time.perf_counter() - t0
         if self._fault is not None:
             toks = self._resilience.corrupt_tokens(

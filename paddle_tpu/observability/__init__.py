@@ -56,6 +56,9 @@ paddle_engine_goodput                          gauge      engine
 paddle_slo_burn                                gauge      engine, kind
 paddle_slo_burn_exceeded_total                 counter    kind
 paddle_flight_dumps_total                      counter    reason
+paddle_kv_quant_pages_total                    counter    —
+paddle_kv_quant_refolds_total                  counter    —
+paddle_kv_quant_bytes_per_token                gauge      engine
 =============================================  =========  ==========
 
 plus the views: ``paddle_decode_*`` (every `decode_stats` key) and
@@ -301,6 +304,28 @@ SLO_BURN_EXCEEDED = counter(
     "leading indicator paddle_sched_slo_violations_total confirms at "
     "finish time)",
     labels=("kind",))
+KV_QUANT_PAGES = counter(
+    "paddle_kv_quant_pages_total",
+    "KV pages that entered quantized int8 service (FLAGS_kv_quant): "
+    "their per-page, per-head quant scales were (re)initialized when "
+    "the allocator handed them out — counts target-pool and shared "
+    "draft-pool entry together (the allocation is shared)")
+KV_QUANT_REFOLDS = counter(
+    "paddle_kv_quant_refolds_total",
+    "Quant-scale refolds on the write path (FLAGS_kv_quant=int8): "
+    "(page, head, K-or-V) scale entries whose running absmax grew "
+    "past an established value, re-quantizing that page's existing "
+    "rows in-graph.  A refold-heavy serve is quantizing "
+    "high-dynamic-range activations — the signal to revisit scale "
+    "granularity before trusting the quality gate")
+KV_QUANT_BYTES_PER_TOKEN = gauge(
+    "paddle_kv_quant_bytes_per_token",
+    "KV-pool storage bytes per cached token (payload + quant-scale "
+    "overhead, both K and V, summed over layers/heads) as of the "
+    "engine's most recent step — the density lever FLAGS_kv_quant "
+    "halves/quarters; int8 and fp32 engines serving side by side "
+    "read their true relative footprint here",
+    labels=("engine",))
 FLIGHT_DUMPS = counter(
     "paddle_flight_dumps_total",
     "Flight-recorder windows auto-dumped to FLAGS_flight_dir, by "

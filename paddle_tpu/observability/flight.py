@@ -327,6 +327,10 @@ class FlightRecorder:
             "cached": pool.cached_count,
             "reserved": pool.reserved,
             "utilization": round(pool.utilization(), 4),
+            # storage-dtype-aware byte occupancy (FLAGS_kv_quant): a
+            # quantized and an fp32 engine at the same page counts
+            # show their real device-byte difference per record
+            "kv_bytes": eng._kv_byte_occupancy(),
         }
         with _lock:
             rec, self._cur = self._cur, None

@@ -35,6 +35,21 @@ The XLA reference (`_xla_paged_attention`) is the numerics ground truth
 and the CPU path; it mirrors `nn.functional.attention._sdpa_reference`'s
 cast discipline exactly (scale in input dtype, f32 softmax) so the paged
 engine bit-matches the eager concat-cache decode path.
+
+Quantized KV pages (FLAGS_kv_quant=int8): pages may be stored as int8
+with per-page, per-head symmetric scales (``scale = absmax / 127``,
+the `quantization.int8` convention) in a parallel ``[Hkv, num_pages]``
+f32 array per pool.  Dequantization is FUSED into the K/V loads — the
+Pallas kernel scalar-prefetches the scale rows with the block tables
+and multiplies each streamed page tile by its page scale in-register
+after the DMA (the Tensix/TPP in-kernel-fusion framing: no separate
+dequant materialization pass ever exists), and the XLA reference
+dequantizes the gathered pages before the identical attention math so
+the two backends stay bit-identical to each other.  The write side is
+`paged_quant_write`: the serving step executables quantize every
+scattered K/V chunk in-graph (per-head absmax folded into the running
+page scale, existing page rows re-quantized when the scale grows —
+the "refold").
 """
 from __future__ import annotations
 
@@ -84,6 +99,11 @@ def pick_page_size(max_len: int, page_size: int = _DEFAULT_PAGE_SIZE):
 
 
 def _paged_key(max_len, d, dtype):
+    # keyed on the STORAGE dtype of the pages (int8 for a quantized
+    # pool, FLAGS_kv_quant) — an int8 pool reusing an fp32-picked page
+    # size would silently lose the VMEM-fit reasoning the measured
+    # entry encoded (a quarter the bytes per page changes the winner),
+    # so each storage dtype autotunes and validates independently
     return f"paged:{max_len}x{d}:{jnp.dtype(dtype).name}"
 
 
@@ -143,16 +163,129 @@ def paged_write_indices(block_tables, seq_lens, write_caps, qn,
 
 
 # ---------------------------------------------------------------------------
+# Quantized KV pages (FLAGS_kv_quant=int8): symmetric per-page,
+# per-head int8 with the quantization.int8 convention (q_max 127,
+# scale = absmax / 127, dequant = q * scale).
+# ---------------------------------------------------------------------------
+Q_MAX = 127.0  # quantization.int8.Q_MAX (kept local: no layer imports)
+
+
+def paged_write_spans(block_tables, seq_lens, write_caps, qn,
+                      num_pages_total, page):
+    """The DISTINCT pages a write of up to ``qn`` rows per sequence
+    (rows ``i < write_caps[b]`` at positions ``seq_lens[b] + i``)
+    touches — the deduplicated refold set for `paged_quant_write`.
+    Rows within one page share a scale, so refolding once per (seq,
+    span page) instead of once per row cuts the refold gather traffic
+    by up to ``page``x (the difference between the quantized mixed
+    step paying ~the attention gather's bandwidth and paying 8x it).
+
+    Returns [B * n_span] int32 page ids with ``num_pages_total`` (one
+    past the pool — dropped by scatters, clamped by gathers) for
+    inactive sequences and span slots past the write's last page;
+    ``n_span = (qn + page - 2) // page + 1`` is the static worst case
+    (an unaligned ``qn``-row run)."""
+    b = block_tables.shape[0]
+    pages_max = block_tables.shape[1]
+    n_span = (qn + page - 2) // page + 1
+    j = jnp.arange(n_span, dtype=jnp.int32)
+    first = seq_lens // page                                  # [B]
+    last = (seq_lens + jnp.maximum(write_caps, 1) - 1) // page
+    valid = (write_caps[:, None] > 0) & \
+        (first[:, None] + j[None, :] <= last[:, None])
+    bt_idx = jnp.minimum(first[:, None] + j[None, :], pages_max - 1)
+    span = jnp.where(
+        valid, block_tables[jnp.arange(b)[:, None], bt_idx],
+        num_pages_total)
+    return span.reshape(-1)
+
+
+def paged_quant_write(pages, scales, li, vals, page_idx, slot,
+                      span_idx=None):
+    """Quantized in-place write of new K/V rows into layer ``li``'s int8
+    pages, folding the rows' per-head absmax into the running page
+    scales and RE-QUANTIZING a written page's existing rows when its
+    scale grows (the "refold" — pages stay self-consistent under one
+    scale no matter how incrementally decode/prefill filled them).
+
+    pages: [L, Hkv, P, page, D] int8 (donated by the caller's jit);
+    scales: [L, Hkv, P] f32 running page scales (absmax / Q_MAX; 0 =
+    never written since (re)allocation — the engine zeroes a page's
+    scale entry when the allocator hands it out, so a recycled page's
+    stale scale can never leak into a new owner's quantization);
+    vals: [R, Hkv, D] new K or V rows (float); page_idx / slot: [R]
+    int32 write coordinates — page index P (one past the pool) drops
+    the row, exactly like the unquantized scatter sites; span_idx:
+    the DEDUPLICATED page set of this write (`paged_write_spans`) the
+    refold gathers/scatters over — defaults to ``page_idx`` (per-row:
+    bit-identical result, up to ``page``x redundant page traffic).
+    Multi-row writers (prefill/mixed/verify) pass the span form;
+    single-row-per-sequence decode keeps the default, where per-row
+    IS the deduplicated set.
+
+    Returns ``(pages, scales, refolds)`` where ``refolds`` is the
+    number of (page, head) scale entries that grew past a previously
+    established value (each one re-quantized that page's rows).
+
+    Determinism: the scale fold is a scatter-``max`` (order-free under
+    duplicate rows), refold multiplies by ``s_old / s_new`` (exactly
+    1.0 for untouched entries, so ``round`` returns the stored int8
+    unchanged), and a fresh page (scale 0) deterministically zeroes
+    whatever stale rows the recycled buffer held."""
+    num_pages = pages.shape[2]
+    if span_idx is None:
+        span_idx = page_idx
+    s_old = scales[li]                                   # [H, P]
+    amax = jnp.max(jnp.abs(vals.astype(jnp.float32)), axis=-1)  # [R, H]
+    # scatter-max the new rows' absmax/Q_MAX into a P+1 buffer whose
+    # extra column absorbs dropped rows (page index P)
+    ext = jnp.pad(s_old, ((0, 0), (0, 1)))               # [H, P+1]
+    ext = ext.at[:, page_idx].max(amax.T / Q_MAX)
+    s_new = ext[:, :num_pages]
+    # refold: requantize the written pages' existing rows at the grown
+    # scale.  ratio == s_old/s_new <= 1 (scales only grow within an
+    # allocation), == 0 for a fresh page (wipes recycled garbage to
+    # deterministic zeros), == 1.0 where nothing grew (bit no-op).
+    gidx = jnp.minimum(span_idx, num_pages - 1)          # in-bounds gather
+    so_g = s_old[:, gidx]                                # [H, S]
+    sn_g = s_new[:, gidx]
+    ratio = jnp.where(so_g > 0, so_g / jnp.where(sn_g > 0, sn_g, 1.0),
+                      0.0)
+    old = pages[li][:, gidx].astype(jnp.float32)         # [H, S, page, D]
+    requant = jnp.round(old * ratio[..., None, None]).astype(jnp.int8)
+    # advanced group (li, span_idx) leads: update shape [S, H, page, D];
+    # OOB page index P drops the row, duplicates write identical bytes
+    pages = pages.at[li, :, span_idx].set(
+        requant.transpose(1, 0, 2, 3))
+    # quantize the new rows at their page's (possibly grown) scale and
+    # scatter them over the refolded content
+    sn_rows = s_new[:, jnp.minimum(page_idx, num_pages - 1)]  # [H, R]
+    qrows = jnp.clip(
+        jnp.round(vals.astype(jnp.float32)
+                  / jnp.maximum(sn_rows.T[..., None], 1e-30)),
+        -Q_MAX, Q_MAX).astype(jnp.int8)                  # [R, H, D]
+    pages = pages.at[li, :, page_idx, slot, :].set(qrows)
+    refolds = jnp.sum((s_new > s_old) & (s_old > 0)).astype(jnp.int32)
+    scales = scales.at[li].set(s_new)
+    return pages, scales, refolds
+
+
+# ---------------------------------------------------------------------------
 # XLA reference — CPU path and parity ground truth
 # ---------------------------------------------------------------------------
 def _xla_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
-                         scale=None, q_offsets=None):
+                         scale=None, q_offsets=None, k_scales=None,
+                         v_scales=None):
     """q: [B, Hq, D] (single query token) or [B, Q, Hq, D] (multi-query
     with per-sequence causal offset); k_pages/v_pages:
     [Hkv, num_pages, page, D]; block_tables: [B, pages_max] int32;
     seq_lens: [B] int32 (valid KV tokens per sequence; 0 = inactive slot
     -> zero output); q_offsets: [B] int32 absolute position of query row
-    0 (default ``seq_lens - Q``: the queries are the newest tokens).
+    0 (default ``seq_lens - Q``: the queries are the newest tokens);
+    k_scales/v_scales: [Hkv, num_pages] f32 per-page, per-head dequant
+    scales when the pages are int8 (FLAGS_kv_quant) — the gathered
+    pages dequantize (``q8 * scale``) before the identical attention
+    math, mirroring the Pallas kernel's in-register dequant exactly.
     Returns the same rank as ``q``.
 
     Mirrors _sdpa_reference's numerics: logits scaled in the input dtype,
@@ -174,6 +307,14 @@ def _xla_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
     # gather each sequence's pages: [Hkv, B, pages_max, page, D]
     k = k_pages[:, block_tables]
     v = v_pages[:, block_tables]
+    if k_scales is not None:
+        # fused dequant: one multiply per gathered page element, in f32
+        # (same product order as the Pallas kernel's per-tile dequant),
+        # cast back to the query dtype for the shared cast discipline
+        ks = k_scales[:, block_tables][..., None, None]
+        vs = v_scales[:, block_tables][..., None, None]
+        k = (k.astype(jnp.float32) * ks).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vs).astype(q.dtype)
     k = jnp.moveaxis(k, 1, 0).reshape(b, hkv, -1, d)
     v = jnp.moveaxis(v, 1, 0).reshape(b, hkv, -1, d)
     qg = q.reshape(b, qn, hkv, g, d)
@@ -259,8 +400,80 @@ def _decode_kernel(bt_ref, sl_ref, qo_ref, q_ref, k_ref, v_ref, o_ref,
                       ).astype(o_ref.dtype)
 
 
+def _decode_kernel_q(bt_ref, sl_ref, qo_ref, ks_ref, vs_ref, q_ref,
+                     k_ref, v_ref, o_ref, acc, m_scr, l_scr, *, page,
+                     pages_max, scale, group, q_len):
+    # Quantized twin of `_decode_kernel`: the K/V page tiles stream in
+    # as int8 and dequantize IN-REGISTER right after the DMA — the
+    # scale rows ride the scalar-prefetch channel with the block
+    # tables, so the per-page scale lookup is an SMEM read, never a
+    # second HBM stream.  Everything after the dequant multiply is the
+    # unquantized kernel verbatim (the f32 online-softmax state and
+    # masking are identical), which is what keeps the two paths'
+    # numerics aligned with the XLA reference's dequant-then-attend.
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    p = pl.program_id(2)
+    sl = sl_ref[b]
+    qo = qo_ref[b]
+    live = jnp.maximum((sl + page - 1) // page, 1)
+    pid = bt_ref[b, jnp.minimum(p, live - 1)]  # the streamed page's id
+
+    @pl.when(p == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    @pl.when(p * page < sl)
+    def _compute():
+        q = q_ref[...].astype(jnp.float32) * scale    # [rows, d]
+        # dequant in-register, then round-trip through the QUERY dtype
+        # exactly like the XLA reference's `.astype(q.dtype)` — for
+        # sub-f32 models (bf16) the cast is lossy, and skipping it here
+        # would make the two backends attend over different K/V values
+        # (a no-op for f32, where the tests pin bit-identical operands)
+        k = (k_ref[...].astype(jnp.float32) * ks_ref[h, pid]
+             ).astype(q_ref.dtype).astype(jnp.float32)
+        v = (v_ref[...].astype(jnp.float32) * vs_ref[h, pid]
+             ).astype(q_ref.dtype).astype(jnp.float32)
+        rows = q_ref.shape[0]
+        m = m_scr[...][:, 0]
+        l = l_scr[...][:, 0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [rows, page]
+        row_q = jnp.minimum(
+            jax.lax.broadcasted_iota(jnp.int32, (rows, page), 0) // group,
+            q_len - 1)
+        pos = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, page), 1)
+        masked = pos < jnp.minimum(sl, qo + row_q + 1)
+        logits = jnp.where(masked, logits, -1e30)
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        pr = jnp.exp(logits - m_new[:, None])
+        pr = jnp.where(masked, pr, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(pr, axis=-1)
+        acc[...] = acc[...] * alpha[:, None] + jax.lax.dot_general(
+            pr, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(p == pages_max - 1)
+    def _flush():
+        l = l_scr[...][:, 0]
+        o_ref[...] = (acc[...] / jnp.maximum(l, 1e-30)[:, None]
+                      ).astype(o_ref.dtype)
+
+
 def _pallas_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
-                            scale=None, q_offsets=None):
+                            scale=None, q_offsets=None, k_scales=None,
+                            v_scales=None):
     hkv, num_pages, page, d = k_pages.shape
     squeeze = q.ndim == 3
     if squeeze:
@@ -285,11 +498,13 @@ def _pallas_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
     block_tables = block_tables.astype(jnp.int32)
     seq_lens = seq_lens.astype(jnp.int32)
     q_offsets = q_offsets.astype(jnp.int32)
+    quant = k_scales is not None
+    n_prefetch = 5 if quant else 3
 
-    def q_map(bi, h, p, bt, sl, qo):
+    def q_map(bi, h, p, *pref):
         return (bi, h, 0, 0)
 
-    def kv_map(bi, h, p, bt, sl, qo):
+    def kv_map(bi, h, p, bt, sl, *pref):
         # dead pages clamp to the last live page: the repeated index
         # skips the DMA (flash_attention's dead-block clamp, paged form).
         # max(live, 1) keeps a zero-length slot pointing at a real page.
@@ -297,7 +512,7 @@ def _pallas_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
         return (h, bt[bi, jnp.minimum(p, live - 1)], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=n_prefetch,
         grid=(b, hkv, pages_max),
         in_specs=[
             pl.BlockSpec((None, None, gp, d), q_map),
@@ -311,12 +526,20 @@ def _pallas_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
             pltpu.VMEM((gp, _LANES), jnp.float32),
         ],
     )
+    kernel = _decode_kernel_q if quant else _decode_kernel
+    operands = (block_tables, seq_lens, q_offsets)
+    if quant:
+        # the scale rows ride the scalar-prefetch channel (SMEM) with
+        # the block tables: the kernel's per-page dequant lookup is
+        # ks[h, bt[b, p]], the same indirection the DMA maps use
+        operands = operands + (k_scales.astype(jnp.float32),
+                               v_scales.astype(jnp.float32))
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, page=page, pages_max=pages_max,
+        functools.partial(kernel, page=page, pages_max=pages_max,
                           scale=s, group=g, q_len=qn),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), q.dtype),
-    )(block_tables, seq_lens, q_offsets, qg, k_pages, v_pages)
+    )(*operands, qg, k_pages, v_pages)
     out = out[:, :, :rows, :].reshape(b, hkv, qn, g, d)
     out = out.transpose(0, 2, 1, 3, 4).reshape(b, qn, hq, d)
     return out[:, 0] if squeeze else out
@@ -326,7 +549,8 @@ def _pallas_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
 # public entry point
 # ---------------------------------------------------------------------------
 def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
-                    scale=None, q_offsets=None):
+                    scale=None, q_offsets=None, k_scales=None,
+                    v_scales=None):
     """Decode-step attention over a paged KV cache.
 
     q: [B, Hq, D] (one query token per sequence) or [B, Q, Hq, D]
@@ -337,13 +561,16 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
     block_tables: [B, pages_max] int32 page ids in position order;
     seq_lens: [B] int32 valid KV tokens per sequence (0 = inactive slot);
     q_offsets: [B] int32 position of each sequence's first query row
-    (default ``seq_lens - Q``: the queries are the newest tokens).
+    (default ``seq_lens - Q``: the queries are the newest tokens);
+    k_scales/v_scales: [Hkv, num_pages] f32 per-page, per-head dequant
+    scales, REQUIRED when the pages are int8 (FLAGS_kv_quant) —
+    dequantization fuses into the K/V loads of whichever backend runs.
 
     Hq must be a multiple of Hkv (grouped-query attention).  Uses the
     Pallas kernel on TPU (FLAGS_use_pallas_attention '1'/'auto'; '0'
     forces the reference), the XLA reference elsewhere.
     """
-    hkv, _, page, d = k_pages.shape
+    hkv, num_pages, page, d = k_pages.shape
     if q.ndim not in (3, 4):
         raise ValueError(f"q must be [B, Hq, D] or [B, Q, Hq, D], "
                          f"got rank {q.ndim}")
@@ -353,11 +580,32 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
             f"query heads {hq} not a multiple of kv heads {hkv}")
     if dq != d:
         raise ValueError(f"head_dim mismatch: q {dq} vs pages {d}")
+    if jnp.dtype(k_pages.dtype) == jnp.int8:
+        if k_scales is None or v_scales is None:
+            raise ValueError(
+                "int8 KV pages need k_scales/v_scales ([Hkv, num_pages]"
+                " f32 per-page dequant scales)")
+        if tuple(k_scales.shape) != (hkv, num_pages):
+            raise ValueError(
+                f"k_scales shape {tuple(k_scales.shape)} != "
+                f"(Hkv, num_pages) = {(hkv, num_pages)}")
+        if tuple(v_scales.shape) != (hkv, num_pages):
+            # same check for V: a stale/mis-sized scale array would
+            # otherwise mis-dequantize silently via clamped gathers
+            raise ValueError(
+                f"v_scales shape {tuple(v_scales.shape)} != "
+                f"(Hkv, num_pages) = {(hkv, num_pages)}")
+    elif k_scales is not None or v_scales is not None:
+        raise ValueError(
+            "k_scales/v_scales passed for non-int8 KV pages "
+            f"(dtype {k_pages.dtype})")
     if _paged_kernel_wanted():
         return _pallas_paged_attention(q, k_pages, v_pages, block_tables,
-                                       seq_lens, scale, q_offsets)
+                                       seq_lens, scale, q_offsets,
+                                       k_scales, v_scales)
     return _xla_paged_attention(q, k_pages, v_pages, block_tables,
-                                seq_lens, scale, q_offsets)
+                                seq_lens, scale, q_offsets,
+                                k_scales, v_scales)
 
 
 def _paged_kernel_wanted() -> bool:

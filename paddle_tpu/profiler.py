@@ -87,6 +87,12 @@ DECODE_STAT_COUNTERS = (
     # pushed into the bounded ring, and crash-safe window auto-dumps
     # (fatal fault / hung step / watchdog abandonment black boxes)
     "flight_records", "flight_dumps",
+    # quantized KV pages (FLAGS_kv_quant=int8): pages whose quant
+    # scale was (re)initialized on allocation ("pages quantized"),
+    # (page, head) scale entries re-quantized after an absmax growth
+    # (the write-path "refold"), and the tiny scale-reset executable's
+    # compiles (target pool + draft pool, one signature each)
+    "kv_quant_pages", "kv_quant_refolds", "kv_quant_compiles",
 )
 DECODE_STAT_DERIVED = ("avg_step_ms", "batch_occupancy",
                        "kv_block_utilization",

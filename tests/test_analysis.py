@@ -551,6 +551,36 @@ class TestDonationLint:
         assert sum("`k_pages`" in m for m in msgs) == 1
         assert any("no donate_argnums at all" in m for m in msgs)
 
+    def test_missing_scales_donation_flagged(self, tmp_path):
+        """Quantized KV pools (FLAGS_kv_quant) thread per-page scale
+        arrays beside the pages; the donation pass counts ``*_scales``
+        params as pool state — a site donating the pages but copying
+        the scales is the known-bad fixture here."""
+        mods = _scan_snippet(tmp_path, """
+            import functools
+            import jax
+
+            def step_q(params, k_pages, v_pages, k_scales, v_scales,
+                       tokens):
+                return k_pages, v_pages, k_scales, v_scales, tokens
+
+            bad = jax.jit(functools.partial(step_q),
+                          donate_argnums=(1, 2))
+            good = jax.jit(step_q, donate_argnums=(1, 2, 3, 4))
+
+            def reset(k_scales, v_scales, idx):
+                return k_scales, v_scales
+
+            bad_reset = jax.jit(reset, donate_argnums=(0,))
+            good_reset = jax.jit(reset, donate_argnums=(0, 1))
+        """)
+        found = DonationPass().run(mods)
+        msgs = sorted(f.message for f in found)
+        # bad misses both scale params; bad_reset misses v_scales
+        assert len(found) == 3, msgs
+        assert sum("`k_scales`" in m for m in msgs) == 1
+        assert sum("`v_scales`" in m for m in msgs) == 2
+
     def test_tracker_owned_jit_site(self, tmp_path):
         """The serving pattern after the single-source-of-truth
         refactor: _JitTracker(callable, key, donate_argnums=...) IS

@@ -423,6 +423,42 @@ def test_bench_flight_smoke(tmp_path):
                for ln in legs["chaos"]["explain_rendering"])
 
 
+def test_bench_kv_quant_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_kv_quant.py runs end-to-end: the
+    quantized-KV bench can't rot.  Asserts the ISSUE-12 acceptance bar
+    at smoke scale: >=1.8x concurrent slots at fixed pool bytes,
+    teacher-forced greedy token match >= 99% with the logit-drift
+    probe self-checked against the engine, the kv_quant=off leg
+    bit-exact with ZERO new executables and zero quant counters, and
+    0 warm retraces in every leg (the tokens/s ratio is gated at full
+    scale only — smoke batches are too small to pin it)."""
+    out = str(tmp_path / "bench_kvquant.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_kv_quant.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    s = data["summary"]
+    assert s["slot_density_ratio"] >= 1.8
+    assert s["token_match_rate"] >= 0.99
+    assert s["probe_self_check"] is True
+    assert s["max_logit_drift"] <= s["drift_bound"]
+    assert s["parity_off_bit_exact"] is True
+    assert s["zero_new_executables_off"] is True
+    assert s["zero_warm_retraces"] is True
+    legs = data["legs"]
+    # the density leg really ran quantized: pages entered int8 service
+    # at a fraction of the fp32 bytes per token
+    assert legs["density"]["int8"]["kv_quant_pages"] > 0
+    assert legs["density"]["int8"]["bytes_per_token"] < \
+        0.3 * legs["density"]["off"]["bytes_per_token"]
+    assert legs["parity_off"]["quant_counters_zero"] is True
+    assert legs["quality"]["total"] > 0
+
+
 def test_telemetry_dump_smoke(tmp_path):
     """tools/telemetry_dump.py runs a small engine workload end-to-end
     and every export format parses: Prometheus text has the core
