@@ -78,6 +78,16 @@ REPO_LOCK_RULES: Dict[str, LockRule] = {
         locks=("_lock",),
         roots=("_thread", "_stop"),
     ),
+    # cost observatory: the process-global profile table and every
+    # CostModel's calibration/error tables mutate under the module's
+    # designated lock (statusz renders them from arbitrary threads).
+    # The per-step `_pending` prediction is engine-thread-private like
+    # the flight recorder's open record and deliberately unlisted.
+    "observability/costmodel.py": LockRule(
+        locks=("_lock",),
+        roots=("_PROFILES", "_forced_engines"),
+        self_attrs=("_calib", "_err"),
+    ),
     "inference/serving.py": LockRule(
         locks=("_TELEMETRY_LOCK", "LOCK"),
         roots=("_STATS",),
@@ -145,6 +155,13 @@ REPO_ENGINE_RULE = EngineRule(
         # MUTATES the engine (the tempting bug: "just retire the slow
         # request from here") still flags
         "observability/flight.py": ("FlightRecorder.",),
+        # the cost observatory likewise READS the engine (batch
+        # composition for prediction, pool/params for the ledger,
+        # the calibration update site scoring sealed records) —
+        # sanctioned for exactly the CostModel class, so a rogue cost
+        # model that mutates the engine ("just preempt the slot my
+        # prediction says is over budget") still flags
+        "observability/costmodel.py": ("CostModel.",),
     },
 )
 

@@ -309,6 +309,55 @@ define_flag("flight_dir", "",
             "(<journal_dir>/flight) when FLAGS_journal_dir is armed, "
             "else auto-dump is off (the in-memory ring and statusz "
             "still work)")
+define_flag("cost_model", True,
+            "serving cost observatory (observability.costmodel): "
+            "extract a static FLOP/byte profile per compiled step "
+            "executable at compile time (HLO cost analysis over the "
+            "lowered computation — tracing only, never a second "
+            "compile), predict step cost from the profiles with a "
+            "per-executable EWMA calibration learned from the flight "
+            "recorder's measured step times, account live device "
+            "bytes in the HBM ledger, and compute per-phase MFU / "
+            "HBM-bandwidth roofline gauges.  0 = fully disarmed: one "
+            "`is None` check per step, no profiles extracted, "
+            "bit-exact serving.  Engines constructed with an explicit "
+            "cost_model= ignore the flag")
+define_flag("sched_cost_admission", False,
+            "cost-model admission gate (observability.costmodel."
+            "CostModel.admission_ok): DecodeEngine._admit_one "
+            "additionally refuses a bind while the predicted step "
+            "cost exceeds the tightest declared slo_tpot_ms among the "
+            "candidate and the running set — admit against a latency "
+            "budget instead of a slot count.  Default 0 = bit-exact "
+            "historical admission; requires FLAGS_cost_model")
+define_flag("peak_flops", 0.0,
+            "roofline compute ceiling in FLOP/s for the cost "
+            "observatory's MFU gauges (paddle_phase_mfu) and step-"
+            "cost predictor; 0 (default) = autodetect from the device "
+            "kind (datasheet table in observability.costmodel; CPU "
+            "pins fixed test values so CI gauges are deterministic)")
+define_flag("peak_hbm_gbps", 0.0,
+            "roofline memory-bandwidth ceiling in GB/s for the cost "
+            "observatory's paddle_phase_hbm_util gauges and step-cost "
+            "predictor; 0 (default) = autodetect from the device kind "
+            "(CPU pins fixed test values)")
+define_flag("cost_memory_analysis", False,
+            "additionally compile the lowered computation AOT and "
+            "record each executable's peak temp-buffer allocation "
+            "(Compiled.memory_analysis) into its cost profile and the "
+            "HBM ledger's temp_scratch category — one EXTRA XLA "
+            "compile per unique executable, so default off")
+define_flag("cost_ledger_interval_steps", 128,
+            "engine steps between HBM-ledger audits "
+            "(observability.costmodel.CostModel.hbm_ledger: attribute "
+            "every live device byte to weights / kv_pages / kv_scales "
+            "/ draft_pool / misc and surface the unattributed residue "
+            "as paddle_hbm_ledger_unattributed_bytes); the audit "
+            "walks jax.live_arrays() — cost scales with the process's "
+            "live-array count — so it is periodic rather than "
+            "per-step (128 steps is still sub-second against any "
+            "scrape interval).  <= 0 = audit only on demand "
+            "(statusz / telemetry dump)")
 define_flag("use_rbg_rng", True,
             "on TPU, use the hardware RBG PRNG for the framework's random "
             "ops instead of threefry (measured: recovers ~60% of dropout's "

@@ -234,6 +234,11 @@ class SnapshotWire:
     # and the storage dtype.  None = no sidecar (flag off, no cached
     # pages, or a pre-sidecar snapshot); restore then recomputes
     kv: Optional[dict] = None
+    # cost-observatory calibration (observability.costmodel): the
+    # per-executable EWMA factors as of the snapshot, so a restored
+    # engine predicts step cost warm instead of re-learning from 1.0.
+    # None = pre-observatory snapshot or cost model off
+    cost: Optional[dict] = None
 
     def to_obj(self) -> dict:
         obj = {"v": 1, "engine_id": self.engine_id,
@@ -242,6 +247,8 @@ class SnapshotWire:
                "records": [r.to_obj() for r in self.records]}
         if self.kv is not None:
             obj["kv"] = self.kv
+        if self.cost is not None:
+            obj["cost"] = self.cost
         return obj
 
     @classmethod
@@ -252,7 +259,7 @@ class SnapshotWire:
                    journal_pos=int(obj["journal_pos"]),
                    records=[RequestWire.from_obj(r)
                             for r in obj["records"]],
-                   kv=obj.get("kv"))
+                   kv=obj.get("kv"), cost=obj.get("cost"))
 
 
 def load_snapshot(journal_dir: str) -> Optional[SnapshotWire]:
@@ -462,6 +469,8 @@ class DurabilityManager:
         wire = EngineSnapshot(self.engine).to_wire(journal_pos=self.seq)
         if self.snapshot_kv:
             wire.kv = self._write_kv_sidecar()
+        if self.engine._cost is not None:
+            wire.cost = self.engine._cost.calibration_wire()
         data = _frame(wire.to_obj())
         path = os.path.join(self.journal_dir, SNAPSHOT_NAME)
         tmp = path + ".tmp"
@@ -713,6 +722,11 @@ def restore_from_dir(journal_dir: str, model, scheduler=None,
         # (greedy ignores them; stochastic streams must not restart)
         eng._step_no = snap.step_no
         eng._prefill_no = snap.prefill_no
+        if snap.cost and eng._cost is not None:
+            # snapshot calibration is NEWER than the cfg record's
+            # (written once at journal creation): the restored
+            # predictor starts from the dead engine's learned factors
+            eng._cost.load_calibration(snap.cost)
     # install the serialized prefix-cache payloads (FLAGS_snapshot_kv)
     # BEFORE re-admission queues anything: the replay fold's admission
     # probe then maps the installed pages at refcount+1 and recomputes

@@ -681,6 +681,11 @@ def recover(engine, snapshot: Optional[EngineSnapshot] = None,
         obj = kw.get(key)
         if obj is not None and hasattr(obj, "engine"):
             obj.engine = None  # unbind: bind() rebuilds per-engine state
+    if engine._cost is not None:
+        # carry the dead engine's LIVE cost calibration (the _ctor
+        # holds only the construction-time seed): the rebuilt engine's
+        # step-cost predictor starts warm, like its executables
+        kw["cost_calibration"] = engine._cost.calibration_wire()
     new = DecodeEngine(**kw)
     set_health(new._engine_id, "recovering")
     if handoff:

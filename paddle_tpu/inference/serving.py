@@ -99,6 +99,7 @@ from ..profiler import (DECODE_STAT_COUNTERS, _decode_stat_zero)
 from .. import observability as _obs
 from ..analysis import sanitizer as _san
 from ..observability import LOCK as _TELEMETRY_LOCK
+from ..observability import costmodel as _costmodel
 
 _STATS = {k: _decode_stat_zero(k) for k in DECODE_STAT_COUNTERS}
 
@@ -222,6 +223,10 @@ class _JitTracker:
         self.site = site or compile_key
         self._seen = 0
         self._warm = False
+        # cost observatory (observability.costmodel): the profile key
+        # of this executable's static FLOP/byte profile, stamped at
+        # compile time (first invocation) when FLAGS_cost_model is on
+        self.cost_sig = None
         _stats_add(**{compile_key: 1})
 
     def __call__(self, *args):
@@ -229,6 +234,17 @@ class _JitTracker:
         if san is not None:
             for a in args:
                 san.check_live(a, context=f"argument of {self.site}")
+        if not self._warm and _costmodel.enabled():
+            # compile-time profile extraction, once per executable:
+            # lower the same traced call and read the HLO cost
+            # analysis (tracing only — no second compile, no new
+            # executable, _cache_size untouched).  BEFORE the call:
+            # donated operands are still live here, deleted after.
+            try:
+                self.cost_sig = _costmodel.note_executable(
+                    self.site, self.fn, args)
+            except Exception:
+                self.cost_sig = None  # analytical fallback covers it
         out = self.fn(*args)
         self.check_retrace()
         if san is not None:
@@ -1174,7 +1190,8 @@ class DecodeEngine:
                  prefill_chunk_tokens=None, prefill_q_max=None,
                  prefix_cache=None, scheduler=None, fault_plan=None,
                  journal_dir=None, step_timeout_ms=None,
-                 flight_window=None, flight_dir=None, kv_quant=None):
+                 flight_window=None, flight_dir=None, kv_quant=None,
+                 cost_model=None, cost_calibration=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -1450,6 +1467,36 @@ class DecodeEngine:
         self._ctor["flight_window"] = int(flight_window)
         self._ctor["flight_dir"] = flight_dir
 
+        # cost observatory (observability.costmodel): static profiles
+        # + calibrated step-cost prediction + HBM ledger + roofline.
+        # Explicit arg wins, else FLAGS_cost_model; disarmed = one
+        # `is None` check per step and bit-exact serving.
+        # ``cost_calibration`` seeds the per-executable calibration
+        # from a prior life (recover / restore_from_dir), so a rebuilt
+        # engine predicts accurately from its first step.
+        if cost_model is not None and bool(cost_model) and \
+                not bool(_flags.flag("cost_model")):
+            # explicit opt-in AGAINST a disabled flag: arm profile
+            # extraction too (the process-global table serves this
+            # engine's predictor).  Not latched when the flag is on —
+            # recover()/restore pass the resolved cost_model=True of a
+            # flag-defaulted engine explicitly, and that must not pin
+            # extraction past a later FLAGS_cost_model=0
+            _costmodel._force_enable()
+        if cost_model is None:
+            cost_model = bool(_flags.flag("cost_model"))
+        self._cost = None
+        if bool(cost_model):
+            self._cost = _costmodel.CostModel(
+                self, calibration=cost_calibration)
+        # cost-gated admission (FLAGS_sched_cost_admission): resolved
+        # at construction like every other serving flag — default off
+        # keeps _admit_one's decision sequence bit-exact
+        self._cost_admission = self._cost is not None and \
+            bool(_flags.flag("sched_cost_admission"))
+        self._ctor["cost_model"] = bool(cost_model)
+        self._ctor["cost_calibration"] = None
+
         if self._journal_dir:
             from .durability import DurabilityManager
 
@@ -1552,6 +1599,11 @@ class DecodeEngine:
             kw["dtype"] = str(jnp.dtype(kw["dtype"]))
         if kw.get("eos_token_id") is not None:
             kw["eos_token_id"] = int(kw["eos_token_id"])
+        if self._cost is not None:
+            # LIVE calibration state, not the construction-time seed:
+            # recover() and the durability snapshot carry the learned
+            # factors across rebuilds so the successor predicts warm
+            kw["cost_calibration"] = self._cost.calibration_wire()
         return kw
 
     def _trackers(self) -> List[_JitTracker]:
@@ -1866,6 +1918,14 @@ class DecodeEngine:
         if not self._free_slots:
             return False
         if not self._capacity_ok(req):
+            return False
+        if self._cost_admission and \
+                not self._cost.admission_ok(req):
+            # cost-model admission (FLAGS_sched_cost_admission):
+            # predicted step cost would blow the tightest declared
+            # per-token SLO — the request stays queued and re-probes
+            # next step, exactly like a capacity refusal.  Default
+            # off: the decision sequence above is bit-exact historical.
             return False
         total_pages = self._pages_for(req.total_kv_tokens())
         hit_pages = self._probe_prefix(req)  # memoized: re-probe is cheap
@@ -2833,6 +2893,11 @@ class DecodeEngine:
                 "totals": fl.window_stats(),
                 "records": fl.records(flight_records),
             }
+        if self._cost is not None:
+            # the cost observatory: static profiles, calibration +
+            # error tables, roofline peaks, the HBM ledger, and the
+            # capacity-headroom estimate a fleet router admits on
+            out["cost"] = self._cost.statusz()
         return out
 
     def statusz_text(self, flight_records: int = 4) -> str:
@@ -2888,6 +2953,16 @@ class DecodeEngine:
                     f"{rec.get('dur_s', 0) * 1e3:.2f}ms "
                     f"emitted {sum(rec.get('emitted', {}).values())} "
                     f"{phases}{evs}")
+        cost = z.get("cost")
+        if cost:
+            hr = cost["headroom"]
+            led = cost["ledger"]
+            lines.append(
+                f"cost: predicted "
+                f"{hr['predicted_step_s'] * 1e3:.2f}ms/step, "
+                f"headroom {hr['admissible_slots']} slots, ledger "
+                f"{led['attributed_bytes']}B attributed + "
+                f"{led['unattributed_bytes']}B unattributed")
         return "\n".join(lines)
 
     # -- the serve loop ------------------------------------------------------
@@ -2939,6 +3014,12 @@ class DecodeEngine:
                     / 1e9 if self._queue else 0.0, engine=eid)
             if fr is not None:
                 fr.note_batch()
+            if self._cost is not None and fr is not None and \
+                    self._active.any():
+                # pre-dispatch cost prediction: stamped onto the open
+                # flight record BEFORE the device step runs, so the
+                # record's predicted/actual pair is an honest forecast
+                self._cost.note_step_begin(fr)
             if not self._active.any():
                 if self._durability is not None:
                     self._durability.on_step_boundary()
@@ -2971,7 +3052,13 @@ class DecodeEngine:
                 fr.note_fault(e)
             raise
         if fr is not None:
-            fr.end_step()
+            rec = fr.end_step()
+            if self._cost is not None and rec is not None:
+                # score the sealed record's prediction against its
+                # measured wall: EWMA calibration + error gauge +
+                # roofline / periodic ledger gauges (the calibration
+                # update site — engine thread, reads the record)
+                self._cost.observe(rec)
         return out
 
     def _step_inner(self) -> bool:

@@ -59,6 +59,12 @@ paddle_flight_dumps_total                      counter    reason
 paddle_kv_quant_pages_total                    counter    —
 paddle_kv_quant_refolds_total                  counter    —
 paddle_kv_quant_bytes_per_token                gauge      engine
+paddle_step_cost_error_ratio                   gauge      fn
+paddle_phase_mfu                               gauge      phase
+paddle_phase_hbm_util                          gauge      phase
+paddle_hbm_ledger_bytes                        gauge      engine, category
+paddle_hbm_ledger_unattributed_bytes           gauge      engine
+paddle_capacity_headroom_slots                 gauge      engine
 =============================================  =========  ==========
 
 plus the views: ``paddle_decode_*`` (every `decode_stats` key) and
@@ -325,6 +331,62 @@ KV_QUANT_BYTES_PER_TOKEN = gauge(
     "engine's most recent step — the density lever FLAGS_kv_quant "
     "halves/quarters; int8 and fp32 engines serving side by side "
     "read their true relative footprint here",
+    labels=("engine",))
+STEP_COST_ERROR = gauge(
+    "paddle_step_cost_error_ratio",
+    "EWMA of |predicted - actual| / actual step wall time, per step-"
+    "executable kind (fn: decode | mixed | spec) — the cost "
+    "observatory's (observability.costmodel) calibration-drift "
+    "signal.  After warmup this should sit well under 0.25 (the "
+    "bench gate); a sustained rise means the static profiles or the "
+    "roofline peaks no longer describe the hardware the engine is "
+    "actually running on",
+    labels=("fn",))
+PHASE_MFU = gauge(
+    "paddle_phase_mfu",
+    "Model FLOP utilization of the engine's most recent step, per "
+    "device phase (decode | mixed | verify): the phase executable's "
+    "static FLOP count / measured phase wall / peak FLOP/s "
+    "(FLAGS_peak_flops, autodetected by default).  The roofline's "
+    "compute axis — compare against paddle_phase_hbm_util to see "
+    "which ceiling binds",
+    labels=("phase",))
+PHASE_HBM_UTIL = gauge(
+    "paddle_phase_hbm_util",
+    "HBM bandwidth utilization of the engine's most recent step, per "
+    "device phase (decode | mixed | verify): static bytes accessed / "
+    "measured phase wall / peak bytes-per-second "
+    "(FLAGS_peak_hbm_gbps, autodetected by default).  Serving decode "
+    "is expected to be bandwidth-bound: this axis near its ceiling "
+    "with paddle_phase_mfu low is the healthy signature",
+    labels=("phase",))
+HBM_LEDGER = gauge(
+    "paddle_hbm_ledger_bytes",
+    "Live device bytes attributed to one ledger category (weights | "
+    "kv_pages | kv_scales | draft_pool | temp_scratch | misc) as of "
+    "the engine's most recent audit (FLAGS_cost_ledger_interval_"
+    "steps).  temp_scratch is the executables' peak XLA scratch from "
+    "the cost profiles (FLAGS_cost_memory_analysis), reported beside "
+    "— not inside — the live-array reconciliation",
+    labels=("engine", "category"))
+HBM_UNATTRIBUTED = gauge(
+    "paddle_hbm_ledger_unattributed_bytes",
+    "Live device bytes NO ledger category claims as of the engine's "
+    "most recent audit — another engine's arrays, leaked "
+    "temporaries, or a category the ledger forgot.  Reconciled "
+    "against jax.live_arrays() every audit so untracked bytes are an "
+    "alertable gauge instead of silent drift (the bench gates this "
+    "at <= 5% of total live bytes)",
+    labels=("engine",))
+CAPACITY_HEADROOM = gauge(
+    "paddle_capacity_headroom_slots",
+    "Admissible EXTRA slots right now given predicted step cost and "
+    "the pool's reclaimable bytes (observability.costmodel."
+    "CostModel.headroom): min of free slots, pool-page capacity at "
+    "the running requests' mean page need, and the SLO ceiling "
+    "(0 while the predicted step cost exceeds the tightest declared "
+    "slo_tpot_ms) — the admission number a fleet router reads before "
+    "routing more work here",
     labels=("engine",))
 FLIGHT_DUMPS = counter(
     "paddle_flight_dumps_total",

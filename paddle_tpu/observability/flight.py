@@ -239,6 +239,16 @@ class FlightRecorder:
             return 0.0
         return sum(cur["phases"].values())
 
+    def note_cost(self, info: dict):
+        """Cost-observatory stamp (observability.costmodel): the
+        step's PRE-DISPATCH cost prediction lands on the open record;
+        `end_step` completes the pair with the measured wall so every
+        record carries predicted vs actual."""
+        cur = self._cur  # open record: engine-thread-private, no lock
+        if cur is None:
+            return
+        cur["cost"] = dict(info)
+
     def note_emit(self, request_id: int, n: int):
         """`DecodeEngine._emit` chokepoint: ``n`` tokens landed on one
         request this step."""
@@ -294,10 +304,13 @@ class FlightRecorder:
                 self._win_tokens -= sum(old.get("emitted", {}).values())
                 self._win_time -= old.get("dur_s", 0.0)
 
-    def end_step(self, idle: bool = False):
+    def end_step(self, idle: bool = False) -> Optional[dict]:
         """Seal the open record: stamp duration, pool/queue occupancy
         and per-request SLO burn, push it into the ring, then observe
-        the phase histogram and the throughput/burn gauges."""
+        the phase histogram and the throughput/burn gauges.  Returns
+        the sealed record (None when no record was open) — the engine
+        hands it to the cost observatory, which reads it and never
+        mutates it (sealed records are immutable by contract)."""
         eng = self.engine
         now_ns = _obs().now_ns()
         # SLO burn over the live set — computed on the engine thread,
@@ -335,11 +348,16 @@ class FlightRecorder:
         with _lock:
             rec, self._cur = self._cur, None
             if rec is None:
-                return
+                return None
             rec["step"] = int(eng._step_no)
             rec["dur_s"] = time.perf_counter() - rec.pop("_t0")
             if idle:
                 rec["kind"] = "idle"
+            if "cost" in rec:
+                # complete the cost observatory's predicted/actual
+                # pair BEFORE the record seals (after the push the
+                # record is immutable and may serialize concurrently)
+                rec["cost"]["actual_s"] = rec["dur_s"]
             rec["queued"] = len(eng._queue)
             rec["pool"] = pool_stats
             if burns:
@@ -354,7 +372,7 @@ class FlightRecorder:
         if not _state["enabled"] or eng._abandoned:
             # an abandoned engine must not repopulate its retired
             # gauges from a late-returning worker thread
-            return
+            return rec
         obs = _obs()
         obs.STEP_PHASE_SECONDS.observe_batch(
             [({"phase": name}, dt)
@@ -369,6 +387,7 @@ class FlightRecorder:
         self._burn_gauged = bool(maxes)
         for k in crossed:
             obs.SLO_BURN_EXCEEDED.inc(kind=k)
+        return rec
 
     def note_fault(self, exc: BaseException):
         """A fatal fault is escaping `DecodeEngine.step`: record it,

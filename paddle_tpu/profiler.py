@@ -93,6 +93,10 @@ DECODE_STAT_COUNTERS = (
     # (the write-path "refold"), and the tiny scale-reset executable's
     # compiles (target pool + draft pool, one signature each)
     "kv_quant_pages", "kv_quant_refolds", "kv_quant_compiles",
+    # cost observatory (observability.costmodel): static FLOP/byte
+    # profiles extracted at executable compile time, and calibration
+    # updates scored against the flight recorder's measured steps
+    "cost_profiles", "cost_updates",
 )
 DECODE_STAT_DERIVED = ("avg_step_ms", "batch_occupancy",
                        "kv_block_utilization",

@@ -44,6 +44,12 @@ def pytest_configure(config):
         "sanitize: run under FLAGS_sanitize=1 (paddle_tpu.analysis."
         "sanitizer): warm retraces raise, donated buffers tombstone, "
         "lock order is recorded, the KV pool is audited every step")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running, non-tier-1 tests (full-scale bench legs, "
+        "redundant compile-heavy subprocess smokes) — excluded by the "
+        "tier-1 `-m 'not slow'` run so the suite fits its time "
+        "budget; run them with `-m slow` (or no marker filter)")
 
 
 @pytest.fixture(autouse=True)

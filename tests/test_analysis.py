@@ -504,6 +504,67 @@ class TestEngineMutationLint:
         """, name="observability/flight.py")
         assert EngineMutationPass(REPO_ENGINE_RULE).run(mods) == []
 
+    def test_rogue_costmodel_mutation_flags(self, tmp_path):
+        """The REPO rule sanctions the cost observatory's engine READS
+        only inside `CostModel` in observability/costmodel.py: a rogue
+        cost model that mutates the engine from its hooks — the
+        tempting bug being 'just preempt the slot my prediction says
+        is over budget from inside observe()' — must flag."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        mods = _scan_snippet(tmp_path, """
+            class RogueCostModel:
+                def observe(self, rec):
+                    self.engine.preempt(self.victim)
+                    self.engine._chunk_budget = 1
+
+                def admission_ok(self, engine, req):
+                    return engine._admit_one(req)
+        """, name="rogue_costmodel.py")
+        found = EngineMutationPass(REPO_ENGINE_RULE).run(mods)
+        msgs = sorted(f.message for f in found)
+        assert len(found) == 3, msgs
+        assert any(".preempt()" in m for m in msgs)
+        assert any("._admit_one()" in m for m in msgs)
+        assert any("attribute store" in m for m in msgs)
+        assert all("RogueCostModel" in m for m in msgs)
+
+    def test_repo_rule_sanctions_costmodel_reads(self, tmp_path):
+        """The sanctioned twin: the same shapes inside `CostModel` in
+        observability/costmodel.py scan clean — the spec encodes 'the
+        cost model may read (and is trusted) from inside the step'."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        (tmp_path / "observability").mkdir()
+        mods = _scan_snippet(tmp_path, """
+            class CostModel:
+                def observe(self, rec):
+                    self.engine.preempt(self.victim)
+                    self.engine._chunk_budget = 1
+        """, name="observability/costmodel.py")
+        assert EngineMutationPass(REPO_ENGINE_RULE).run(mods) == []
+
+    def test_costmodel_lock_discipline_enforced(self, tmp_path):
+        """The cost observatory's calibration table is in the lock-
+        discipline spec: an unguarded `_calib` mutation in a module
+        named like costmodel.py flags, the locked form scans clean."""
+        from paddle_tpu.analysis import REPO_LOCK_RULES
+        from paddle_tpu.analysis.passes import LockDisciplinePass
+
+        (tmp_path / "observability").mkdir()
+        mods = _scan_snippet(tmp_path, """
+            class CostModel:
+                def bad_update(self, fn, v):
+                    self._calib[fn] = v
+
+                def good_update(self, fn, v):
+                    with _lock:
+                        self._calib[fn] = v
+        """, name="observability/costmodel.py")
+        found = LockDisciplinePass(REPO_LOCK_RULES).run(mods)
+        assert len(found) == 1, [f.message for f in found]
+        assert "bad_update" in found[0].message
+
     def test_flight_lock_discipline_enforced(self, tmp_path):
         """The flight-recorder ring is in the lock-discipline spec: an
         unguarded ring mutation in a module named like flight.py

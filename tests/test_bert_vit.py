@@ -64,6 +64,7 @@ class TestBert:
         np.testing.assert_allclose(sa.numpy()[:, :8], sb.numpy()[:, :8],
                                    atol=1e-5)
 
+    @pytest.mark.slow
     def test_pretrain_learns(self):
         paddle.seed(0)
         model = BertForPretraining(tiny_cfg())

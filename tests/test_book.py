@@ -42,6 +42,7 @@ class TestFitALine:
 class TestRecognizeDigits:
     """book/test_recognize_digits: conv net memorizes a small batch."""
 
+    @pytest.mark.slow
     def test_converges(self):
         from paddle_tpu.vision.datasets import FakeData
 

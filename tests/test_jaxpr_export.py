@@ -264,6 +264,7 @@ class TestModelZooExport:
     with value parity — the reference's `jit.save(model)` capability for
     the model zoo (`dygraph/jit.py` / TranslatedLayer)."""
 
+    @pytest.mark.slow
     def test_resnet18(self, tmp_path):
         from paddle_tpu.vision.models import resnet18
 

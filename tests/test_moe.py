@@ -61,6 +61,7 @@ class TestMoELayer:
         assert layer.aux_loss is not None
         assert float(layer.aux_loss.numpy()) > 0
 
+    @pytest.mark.slow
     def test_learns(self):
         paddle.seed(0)
         from paddle_tpu.optimizer import Adam

@@ -1,9 +1,20 @@
 """Build-system / CI tooling (reference: paddle_build.sh + tools/):
-packaging metadata, op micro-bench harness, and the perf regression gate."""
+packaging metadata, op micro-bench harness, and the perf regression gate.
+
+Bench smokes each spawn a fresh process and compile a full engine
+stack (~10-30s apiece); the tier-1 `-m 'not slow'` run keeps the cheap
+representatives (eager, decode, cost, telemetry, tracecheck) and marks
+the rest ``slow`` — their machinery is pinned by dedicated tier-1
+suites (test_spec_decode, test_chunked_prefill, test_prefix_cache,
+test_frontend, test_resilience, test_durability, test_flight,
+test_kv_quant), so the smokes' marginal tier-1 value is the bench
+SCRIPT not rotting, which the slow lane still covers."""
 import json
 import os
 import subprocess
 import sys
+
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
@@ -123,6 +134,7 @@ def test_bench_decode_smoke(tmp_path):
     assert snap["paddle_request_tpot_seconds"]["series"][0]["count"] > 0
 
 
+@pytest.mark.slow
 def test_bench_spec_decode_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_spec_decode.py runs end-to-end: the
     speculative-decode bench can't rot.  Asserts the emitted JSON shape,
@@ -161,6 +173,7 @@ def test_bench_spec_decode_smoke(tmp_path):
             "count"] > 0, name
 
 
+@pytest.mark.slow
 def test_bench_prefill_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_prefill.py runs end-to-end: the
     chunked-prefill bench can't rot.  Asserts the emitted JSON shape,
@@ -211,6 +224,7 @@ def test_bench_prefill_smoke(tmp_path):
     assert snaps["legacy"]["paddle_prefill_chunk_tokens"]["series"] == []
 
 
+@pytest.mark.slow
 def test_bench_prefix_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_prefix.py runs end-to-end: the
     prefix-cache bench can't rot.  Asserts the emitted JSON shape,
@@ -259,6 +273,7 @@ def test_bench_prefix_smoke(tmp_path):
         "series"] == []
 
 
+@pytest.mark.slow
 def test_bench_slo_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_slo.py runs end-to-end: the SLO
     scheduling bench can't rot.  Asserts the emitted JSON shape,
@@ -302,6 +317,7 @@ def test_bench_slo_smoke(tmp_path):
     assert "paddle_queue_depth" in snap
 
 
+@pytest.mark.slow
 def test_bench_chaos_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_chaos.py runs end-to-end: the
     fault-injection bench can't rot.  Asserts the emitted JSON shape
@@ -343,6 +359,7 @@ def test_bench_chaos_smoke(tmp_path):
     assert legs["chaos"]["faults_injected"] >= 3
 
 
+@pytest.mark.slow
 def test_bench_recovery_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_recovery.py runs end-to-end: the
     durable-serving bench can't rot.  Asserts the acceptance bar at
@@ -381,6 +398,7 @@ def test_bench_recovery_smoke(tmp_path):
     assert cross["journal_events"] >= 3
 
 
+@pytest.mark.slow
 def test_bench_flight_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_flight.py runs end-to-end: the
     flight-recorder bench can't rot.  Asserts the ISSUE-11 acceptance
@@ -423,6 +441,7 @@ def test_bench_flight_smoke(tmp_path):
                for ln in legs["chaos"]["explain_rendering"])
 
 
+@pytest.mark.slow
 def test_bench_kv_quant_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_kv_quant.py runs end-to-end: the
     quantized-KV bench can't rot.  Asserts the ISSUE-12 acceptance bar
@@ -511,6 +530,18 @@ def test_telemetry_dump_smoke(tmp_path):
     assert statusz["health"] == "live"
     txt = open(os.path.join(outdir, "telemetry_statusz.txt")).read()
     assert "engine 0" in txt and "flight:" in txt
+    # ISSUE-13 artifact: the cost-observatory export parses and its
+    # keys match the statusz cost section (same dict, two surfaces)
+    with open(os.path.join(outdir, "telemetry_cost.json")) as f:
+        cost = json.load(f)
+    for key in ("peaks", "profiles", "calibration", "error_ratio",
+                "ledger", "headroom"):
+        assert key in cost, key
+    assert set(cost) == set(statusz["cost"]), (
+        set(cost) ^ set(statusz["cost"]))
+    assert cost["profiles"], "no executable profiles extracted"
+    assert cost["ledger"]["categories"]["weights"] > 0
+    assert "admissible_slots" in cost["headroom"]
     # and explain_request renders a timeline from the flight artifact
     rid = statusz["flight"]["records"][-1]["slots"][0]["request"] \
         if statusz["flight"]["records"][-1].get("slots") else 0
@@ -522,6 +553,44 @@ def test_telemetry_dump_smoke(tmp_path):
         cwd=REPO, capture_output=True, text=True, env=ENV, timeout=120)
     assert r2.returncode == 0, r2.stdout + r2.stderr
     assert f"request {rid}" in r2.stdout
+
+
+def test_bench_cost_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_cost.py runs end-to-end: the cost-
+    observatory bench can't rot.  Asserts the ISSUE-13 acceptance bar
+    at smoke scale: profiles extracted for every executable kind
+    (decode + mixed + spec all calibrated), flight records carrying
+    predicted/actual pairs, the HBM ledger reconciling against
+    jax.live_arrays() with <= 5% unattributed, and the cost_model=off
+    leg bit-exact with identical compile counters and 0 warm retraces
+    (the accuracy and overhead RATIOS are gated at full scale only —
+    smoke steps are sub-millisecond and timer-noise dominated)."""
+    out = str(tmp_path / "bench_cost.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_cost.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    s = data["summary"]
+    assert s["profiles_extracted"] is True
+    assert s["mixed_and_spec_calibrated"] is True
+    assert s["ledger_within_bound"] is True
+    assert s["unattributed_frac"] <= 0.05
+    assert s["ledger_categories_found"] is True
+    assert s["parity_cost_off"] is True
+    assert s["zero_new_executables"] is True
+    assert s["zero_warm_retraces"] is True
+    cal = data["legs"]["calibration"]
+    assert cal["calibrated_records"] >= 1
+    assert cal["median_error"] is not None
+    assert cal["profile_sources"] == ["hlo"]
+    led = data["legs"]["ledger"]
+    assert led["categories"]["weights"] > 0
+    assert led["categories"]["kv_pages"] > 0
+    assert led["gauge_series"] >= len(led["categories"])
 
 
 def test_tracecheck_smoke(tmp_path):

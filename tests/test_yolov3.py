@@ -1,10 +1,15 @@
 """YOLOv3/DarkNet53 model family (reference PaddleDetection-era YOLOv3
 over `yolov3_loss`/`yolo_box`/`multiclass_nms`)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import optimizer
 from paddle_tpu.vision.models import DarkNet53, yolov3_darknet53
+
+# full-model conv training/inference: ~60s of tier-1 budget for
+# coverage the vision bench files already pin — run via -m slow
+pytestmark = pytest.mark.slow
 
 
 class TestDarkNet53:

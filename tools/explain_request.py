@@ -11,6 +11,10 @@ reconstructs a single request's timeline step by step:
 * the step's phase-time breakdown (where the wall actually went:
   admit / prefill / mixed / decode / draft / verify / fetch / emit /
   cache);
+* the cost observatory's predicted-vs-actual step cost
+  (``pred=X/act=Yms``) when the window carries cost records
+  (FLAGS_cost_model — a step whose actual ran far past its prediction
+  is where to start digging);
 * its SLO burn as it evolved (budget consumed vs slo_ttft_ms /
   slo_tpot_ms / deadline_ms);
 * every ladder event that touched it or its engine — retry, degrade,
@@ -110,6 +114,12 @@ def explain(window: dict, request_id: int,
         if burn:
             parts.append("burn " + ",".join(
                 f"{k}={v:.2f}" for k, v in sorted(burn.items())))
+        cost = rec.get("cost")
+        if cost and cost.get("actual_s") is not None and \
+                (slot_entry is not None or emitted):
+            parts.append(
+                f"pred={cost.get('predicted_s', 0) * 1e3:.2f}"
+                f"/act={cost['actual_s'] * 1e3:.2f}ms")
         line = " ".join(parts)
         if slot_entry is not None or emitted:
             line += _fmt_phases(rec.get("phases", {}))

@@ -15,7 +15,12 @@ a tiny GPT, serve a couple of requests through the paged decode engine
   (`FlightRecorder.snapshot()`: per-step batch composition, phase
   breakdown, ladder events — what `tools/explain_request.py` reads);
 * ``telemetry_statusz.json`` / ``telemetry_statusz.txt`` — the live
-  `DecodeEngine.statusz()` snapshot in both its JSON and text forms.
+  `DecodeEngine.statusz()` snapshot in both its JSON and text forms;
+* ``telemetry_cost.json``    — the cost observatory
+  (`observability.costmodel`): static FLOP/byte profiles per
+  executable, the calibrated step-cost predictor's factors and error,
+  the HBM ledger breakdown, and the roofline peaks/headroom — the
+  same dict `DecodeEngine.statusz()["cost"]` serves live.
 
 CI smokes this end-to-end (tests/test_tooling.py): every export format
 must parse and the core request-latency series must be present after a
@@ -93,6 +98,7 @@ def main():
     flight_path = os.path.join(args.outdir, "telemetry_flight.json")
     statusz_path = os.path.join(args.outdir, "telemetry_statusz.json")
     statusz_txt = os.path.join(args.outdir, "telemetry_statusz.txt")
+    cost_path = os.path.join(args.outdir, "telemetry_cost.json")
 
     with open(prom_path, "w") as f:
         f.write(observability.prometheus_text())
@@ -112,6 +118,9 @@ def main():
         json.dump(eng.statusz(), f, indent=2)
     with open(statusz_txt, "w") as f:
         f.write(eng.statusz_text() + "\n")
+    if eng._cost is not None:
+        with open(cost_path, "w") as f:
+            json.dump(eng._cost.statusz(), f, indent=2)
 
     tracks = sorted(e["args"]["name"] for e in trace["traceEvents"]
                     if e.get("ph") == "M" and e.get("name") == "process_name")
@@ -123,6 +132,8 @@ def main():
               f"({len(eng._flight.records())} records)")
     print(f"wrote {statusz_path}")
     print(f"wrote {statusz_txt}")
+    if eng._cost is not None:
+        print(f"wrote {cost_path}")
     return 0
 
 
