@@ -88,6 +88,23 @@ REPO_LOCK_RULES: Dict[str, LockRule] = {
         roots=("_PROFILES", "_forced_engines"),
         self_attrs=("_calib", "_err"),
     ),
+    # alert engine: the per-rule state table and the transitions list
+    # (/alertz reads them from the ops server's handler threads while
+    # the engine thread evaluates) mutate under the module's
+    # designated lock.  Per-rule evaluation HISTORIES are engine-
+    # thread-private like the flight recorder's open record and
+    # deliberately unlisted.
+    "observability/alerts.py": LockRule(
+        locks=("_lock",),
+        self_attrs=("_state", "_transitions"),
+    ),
+    # ops-plane registry: engine/frontend registration and the server
+    # handle swap mutate under the module lock (handlers snapshot
+    # under it and render outside it)
+    "observability/opsserver.py": LockRule(
+        locks=("_lock",),
+        roots=("_ENGINES", "_FRONTENDS", "_SERVER"),
+    ),
     "inference/serving.py": LockRule(
         locks=("_TELEMETRY_LOCK", "LOCK"),
         roots=("_STATS",),
@@ -162,6 +179,15 @@ REPO_ENGINE_RULE = EngineRule(
         # model that mutates the engine ("just preempt the slot my
         # prediction says is over budget") still flags
         "observability/costmodel.py": ("CostModel.",),
+        # the alert evaluator READS the engine between steps (pool
+        # pressure, health, burn gauges for its signals) — sanctioned
+        # for exactly the AlertEngine class, so a rogue evaluator that
+        # mutates the engine ("just preempt the request burning the
+        # budget from inside evaluate()") still flags.  The ops
+        # server's handlers are NOT sanctioned at all: every endpoint
+        # is read-only by contract, and an endpoint that grows a
+        # mutating call flags the moment it is written.
+        "observability/alerts.py": ("AlertEngine.",),
     },
 )
 

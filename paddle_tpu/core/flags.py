@@ -358,6 +358,31 @@ define_flag("cost_ledger_interval_steps", 128,
             "per-step (128 steps is still sub-second against any "
             "scrape interval).  <= 0 = audit only on demand "
             "(statusz / telemetry dump)")
+define_flag("ops_port", 0,
+            "ops-plane HTTP endpoint (observability.opsserver): a "
+            "stdlib ThreadingHTTPServer daemon thread serving "
+            "/metrics (Prometheus text), /statusz (JSON, ?format="
+            "text), /flightz (flight window, ?request=<id> timeline), "
+            "/healthz + /readyz (the fleet router's routing key: "
+            "live AND capacity headroom > 0 AND no page-severity "
+            "alert firing AND no watchdog-overdue step), and /alertz "
+            "(declarative alert states + transitions).  Arms the "
+            "between-steps alert engine (observability.alerts) on "
+            "every DecodeEngine constructed while set.  0 (default) "
+            "= fully off: zero listening sockets, zero alert "
+            "counters, bit-exact serving; -1 = alert engine armed "
+            "WITHOUT the HTTP listener (in-process /alertz state "
+            "only).  Ports bind all interfaces — the endpoint is "
+            "read-only introspection")
+define_flag("alert_interval_steps", 32,
+            "engine steps between alert-engine evaluations "
+            "(observability.alerts.AlertEngine): each evaluation "
+            "samples ~a dozen gauges and walks the rule table on the "
+            "engine thread BETWEEN steps — no new hot-path locks, so "
+            "the cadence is the only cost knob.  Evaluation also "
+            "fires unconditionally on a fatal step fault and at "
+            "watchdog abandonment so the crash dump records the "
+            "alerts firing at death.  <= 0 falls back to 32")
 define_flag("use_rbg_rng", True,
             "on TPU, use the hardware RBG PRNG for the framework's random "
             "ops instead of threefry (measured: recovers ~60% of dropout's "

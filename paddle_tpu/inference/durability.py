@@ -55,6 +55,7 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import time
 import zlib
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -335,6 +336,13 @@ def retire_engine_series(engine_id: int) -> int:
     (`DecodeEngine._next_engine_id` is monotonic), so nothing can race
     a retirement back to life.  Returns the series count removed."""
     clear_health(engine_id)
+    # the ops plane's registry retires with the gauges: a dead
+    # generation must leave /statusz, /healthz and /readyz the same
+    # moment it leaves the scrape surface (recover / restore / abandon
+    # all funnel through here)
+    from ..observability import opsserver
+
+    opsserver.deregister_engine(engine_id)
     return _obs.registry.retire_label("engine", engine_id)
 
 
@@ -853,6 +861,7 @@ class StepWatchdog:
                 f"step_timeout_ms must be > 0 to arm the watchdog, "
                 f"got {self.timeout_ms}")
         self._sig = None
+        self._armed_t = None
 
     @property
     def timeout_s(self) -> float:
@@ -889,6 +898,41 @@ class StepWatchdog:
     def arm(self):
         """Called by the engine just before its device step."""
         self._sig = self._tracker_sig()
+        self._armed_t = time.perf_counter()
+
+    def disarm(self):
+        """Called by the engine after the step returned (either
+        verdict) — `overdue` must only ever see an armed window."""
+        self._armed_t = None
+
+    # readiness flips at HALF the hang budget: /readyz is a cheap,
+    # instantly-reversible routing signal, so it goes early — the
+    # router stops sending work while the abandon/rebuild machinery
+    # (which pays a snapshot restore) still waits for the full budget.
+    # Guarantees the flip PRECEDES abandonment instead of racing it.
+    OVERDUE_FRACTION = 0.5
+
+    def overdue(self) -> bool:
+        """Is a step CURRENTLY blocked suspiciously long?  Readable
+        from any thread while the engine thread is stuck inside its
+        device dispatch — the ops plane's `/readyz` consults this so a
+        soon-to-be-abandoned engine flips NOT-ready while the step is
+        still hanging, not after the post-mortem.  Compiles excuse the
+        stall exactly like `classify` (a warmup compile is slow, not
+        hung)."""
+        t0 = self._armed_t
+        if t0 is None or time.perf_counter() - t0 <= \
+                self.timeout_s * self.OVERDUE_FRACTION:
+            return False
+        if not self.engine_warm():
+            # a compile IN FLIGHT inside an existing tracker changes
+            # nothing observable until it returns (`_seen` bumps after
+            # the call) — `classify` excuses it post-hoc, but a LIVE
+            # probe must not read a cold engine's warmup compile as a
+            # stall, so readiness only trusts the overdue verdict once
+            # every built executable is warm
+            return False
+        return self._tracker_sig() == self._sig
 
     def classify(self, dt_s: float) -> bool:
         """True iff the step that just completed was hung: over budget

@@ -468,6 +468,11 @@ class ServingFrontend:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._closing = False
         self._closed = False
+        # ops plane: a frontend-wrapped engine serves the stream-aware
+        # debug_dump from /statusz instead of the bare engine statusz
+        from ..observability import opsserver as _opsserver
+
+        _opsserver.register_frontend(self)
 
     # -- lifecycle -----------------------------------------------------------
     async def start(self):
@@ -498,6 +503,9 @@ class ServingFrontend:
             return
         if self._driver is None:
             self._closed = True
+            from ..observability import opsserver as _opsserver
+
+            _opsserver.deregister_frontend(self)
             return
         self._closing = True  # reject new submissions from here on
         if not drain:
@@ -520,6 +528,9 @@ class ServingFrontend:
         self._kick()
         await self._driver
         self._closed = True
+        from ..observability import opsserver as _opsserver
+
+        _opsserver.deregister_frontend(self)
 
     # -- submission / cancellation -------------------------------------------
     async def submit(self, prompt_ids, max_new_tokens: int = 32,
@@ -714,6 +725,12 @@ class ServingFrontend:
         self._recoveries += 1
         self.engine = resilience.recover(self.engine, snapshot=snapshot,
                                          fault=fault)
+        # follow the engine generation in the ops registry: /statusz
+        # must serve the SUCCESSOR's debug_dump (the dead id is
+        # already deregistered by retire_engine_series)
+        from ..observability import opsserver as _opsserver
+
+        _opsserver.register_frontend(self)
         return True
 
     async def _drive(self):

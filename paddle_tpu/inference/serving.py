@@ -1191,7 +1191,7 @@ class DecodeEngine:
                  prefix_cache=None, scheduler=None, fault_plan=None,
                  journal_dir=None, step_timeout_ms=None,
                  flight_window=None, flight_dir=None, kv_quant=None,
-                 cost_model=None, cost_calibration=None):
+                 cost_model=None, cost_calibration=None, alerts=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -1497,6 +1497,30 @@ class DecodeEngine:
         self._ctor["cost_model"] = bool(cost_model)
         self._ctor["cost_calibration"] = None
 
+        # ops plane (observability.opsserver + observability.alerts):
+        # the engine always registers with the process-global ops
+        # registry (one locked dict insert; retirement deregisters),
+        # while the HTTP listener and the between-steps alert engine
+        # arm only when FLAGS_ops_port is set — or when ``alerts=``
+        # opts in explicitly (True = the shipped default catalog, a
+        # rule sequence = a custom table).  Disarmed, the serve loop
+        # pays one `is None` check per step and zero alert counters.
+        # Resolved BEFORE the durability manager below: the journal's
+        # cfg record snapshots wire_config at construction, and a
+        # restored engine must rebuild with the same alert table.
+        from ..observability import alerts as _alerts_mod
+        from ..observability import opsserver as _opsserver
+
+        if alerts is None:
+            alerts = int(_flags.flag("ops_port")) != 0
+        self._alerts = None
+        if alerts is not False and alerts != 0:
+            rules = None if alerts is True else alerts
+            self._alerts = _alerts_mod.AlertEngine(self, rules=rules)
+            self._ctor["alerts"] = tuple(self._alerts.rules)
+        else:
+            self._ctor["alerts"] = False
+
         if self._journal_dir:
             from .durability import DurabilityManager
 
@@ -1508,6 +1532,8 @@ class DecodeEngine:
         from .durability import set_health
 
         set_health(self._engine_id, "live", span=False)
+        _opsserver.register_engine(self)
+        _opsserver.maybe_start_ops_server()
 
     def _phase(self, name: str):
         """Context manager timing a LEAF flight-recorder phase (device
@@ -1599,6 +1625,11 @@ class DecodeEngine:
             kw["dtype"] = str(jnp.dtype(kw["dtype"]))
         if kw.get("eos_token_id") is not None:
             kw["eos_token_id"] = int(kw["eos_token_id"])
+        if kw.get("alerts"):
+            # AlertRule dataclasses -> wire dicts (the ctor accepts
+            # either form back); False stays False — a restored engine
+            # keeps the resolved arming decision, not the flag's
+            kw["alerts"] = [r.to_wire() for r in kw["alerts"]]
         if self._cost is not None:
             # LIVE calibration state, not the construction-time seed:
             # recover() and the durability snapshot carry the learned
@@ -1688,6 +1719,17 @@ class DecodeEngine:
         # reference to the open record can mutate it lock-free while
         # the dump serializes — a torn dump is acceptable, a dead
         # driver is not
+        if self._alerts is not None:
+            # last alert evaluation before the black box dumps: the
+            # overload/pressure that preceded the hang should read as
+            # FIRING rules in the post-mortem, not raw gauges the
+            # reader must re-derive.  (The engine is already marked
+            # abandoned, so transitions update the /alertz rule states
+            # the dump snapshots but repopulate no retired gauges.)
+            try:
+                self._alerts.evaluate()
+            except Exception:
+                pass
         fl = self._flight
         if fl is not None:
             fl.event("abandon", step=int(self._step_no))
@@ -2893,6 +2935,10 @@ class DecodeEngine:
                 "totals": fl.window_stats(),
                 "records": fl.records(flight_records),
             }
+        if self._alerts is not None:
+            # the alert engine: rule states, firing set, recent
+            # transitions — the same dict /alertz serves
+            out["alerts"] = self._alerts.snapshot()
         if self._cost is not None:
             # the cost observatory: static profiles, calibration +
             # error tables, roofline peaks, the HBM ledger, and the
@@ -3025,16 +3071,28 @@ class DecodeEngine:
                     self._durability.on_step_boundary()
                 if fr is not None:
                     fr.end_step(idle=True)
+                if self._alerts is not None:
+                    # idle steps keep the cadence: a pool wedged so
+                    # badly nothing admits must still reach an
+                    # evaluation round
+                    self._alerts.maybe_step()
                 return bool(self._queue)
             wd = self._watchdog
             if wd is not None:
                 wd.arm()
                 t0_wd = time.perf_counter()
-            out = self._resilience.run_step()
-            if self._durability is not None:
-                self._durability.on_step_boundary()
+            try:
+                out = self._resilience.run_step()
+                if self._durability is not None:
+                    self._durability.on_step_boundary()
+            finally:
+                # the armed window closes on EVERY exit — /readyz's
+                # overdue probe must never read a completed (or
+                # journal-fault-aborted) step as a live stall
+                if wd is not None:
+                    dt_wd = time.perf_counter() - t0_wd
+                    wd.disarm()
             if wd is not None:
-                dt_wd = time.perf_counter() - t0_wd
                 if wd.classify(dt_wd):
                     # post-hoc hang verdict: the step DID complete (its
                     # tokens are emitted and journaled — recovery folds
@@ -3048,6 +3106,18 @@ class DecodeEngine:
             # tears this engine down.  A watchdog-ABANDONED engine
             # skips this — its recorder already dumped at abandonment
             # and its requests belong to the successor.
+            if self._alerts is not None and not self._abandoned:
+                # forced evaluation on the way out: health already
+                # reads hung/the burn gauges already read the overload
+                # that killed this step, so the fire transitions land
+                # in the ring BEFORE note_fault seals and dumps it —
+                # the post-mortem window then SHOWS the alerts firing
+                # at death.  Best-effort: an alert bug must never
+                # replace the StepFault the supervision is waiting for.
+                try:
+                    self._alerts.evaluate()
+                except Exception:
+                    pass
             if fr is not None and not self._abandoned:
                 fr.note_fault(e)
             raise
@@ -3059,6 +3129,12 @@ class DecodeEngine:
                 # roofline / periodic ledger gauges (the calibration
                 # update site — engine thread, reads the record)
                 self._cost.observe(rec)
+        if self._alerts is not None:
+            # between-steps alert cadence (FLAGS_alert_interval_steps):
+            # the engine thread walks the rule table AFTER the step's
+            # record sealed, so every signal it reads is step-boundary
+            # consistent and the hot path gained no locks
+            self._alerts.maybe_step()
         return out
 
     def _step_inner(self) -> bool:

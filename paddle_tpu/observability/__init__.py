@@ -65,6 +65,8 @@ paddle_phase_hbm_util                          gauge      phase
 paddle_hbm_ledger_bytes                        gauge      engine, category
 paddle_hbm_ledger_unattributed_bytes           gauge      engine
 paddle_capacity_headroom_slots                 gauge      engine
+paddle_alerts_firing                           gauge      engine, rule, severity
+paddle_alert_transitions_total                 counter    rule, state
 =============================================  =========  ==========
 
 plus the views: ``paddle_decode_*`` (every `decode_stats` key) and
@@ -388,6 +390,25 @@ CAPACITY_HEADROOM = gauge(
     "slo_tpot_ms) — the admission number a fleet router reads before "
     "routing more work here",
     labels=("engine",))
+ALERTS_FIRING = gauge(
+    "paddle_alerts_firing",
+    "1 while the named alert rule (observability.alerts; the shipped "
+    "catalog is in docs/OBSERVABILITY.md) is FIRING on this engine, "
+    "0 after it resolves — transitions require the rule's for-"
+    "duration to fire and clean windows to resolve, so this gauge is "
+    "the debounced, actionable form of the raw signal it watches.  "
+    "/readyz (observability.opsserver) flips an engine NOT-ready "
+    "while any severity=page rule fires",
+    labels=("engine", "rule", "severity"))
+ALERT_TRANSITIONS = counter(
+    "paddle_alert_transitions_total",
+    "Alert state edges, by rule and edge (firing: the rule's "
+    "condition held past its for-duration; resolved: the shortest "
+    "window read clean past the rule's resolve duration).  Every "
+    "transition also lands as an alert_fire/alert_resolve event in "
+    "the engine's flight ring and in /alertz's recent-transitions "
+    "list",
+    labels=("rule", "state"))
 FLIGHT_DUMPS = counter(
     "paddle_flight_dumps_total",
     "Flight-recorder windows auto-dumped to FLAGS_flight_dir, by "
@@ -438,3 +459,22 @@ def _dispatch_view():
 
 registry.register_view(_decode_view)
 registry.register_view(_dispatch_view)
+
+
+# ---------------------------------------------------------------------------
+# The ops plane (imported LAST: both modules resolve this catalog
+# lazily, so the import is cycle-free and costs only stdlib imports)
+# ---------------------------------------------------------------------------
+from . import alerts  # noqa: E402,F401
+from . import opsserver  # noqa: E402,F401
+from .alerts import AlertEngine, AlertRule, default_rules  # noqa: E402,F401
+from .opsserver import (  # noqa: E402,F401
+    maybe_start_ops_server, ops_server_port, start_ops_server,
+    stop_ops_server,
+)
+
+__all__ += [
+    "alerts", "opsserver", "AlertEngine", "AlertRule", "default_rules",
+    "start_ops_server", "stop_ops_server", "ops_server_port",
+    "maybe_start_ops_server",
+]

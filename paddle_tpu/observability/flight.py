@@ -432,12 +432,20 @@ class FlightRecorder:
     def snapshot(self, n: Optional[int] = None) -> dict:
         """JSON-serializable window snapshot (what `dump` writes and
         telemetry_dump exports)."""
-        return {
+        out = {
             "flight": 1,  # format version
             "engine": self.engine._engine_id,
             "totals": self.window_stats(),
             "records": self.records(n),
         }
+        al = getattr(self.engine, "_alerts", None)
+        if al is not None:
+            # the alert engine's live state rides every window
+            # snapshot, so a crash auto-dump is a post-mortem that
+            # SHOWS which alerts were firing at death — not just the
+            # raw gauges they were watching
+            out["alerts"] = al.snapshot()
+        return out
 
     def dump(self, reason: str = "manual",
              path: Optional[str] = None) -> Optional[str]:

@@ -258,6 +258,44 @@ class Histogram(_Metric):
                 s.sum += v
                 s.count += 1
 
+    def quantile(self, q: float, **labels) -> float:
+        """Estimate the ``q``-quantile (0..1) of one labeled series
+        from its bucket counts — the Prometheus `histogram_quantile`
+        estimator, in-process, so latency-threshold alert rules
+        (observability.alerts) can gate on e.g. p99 step time without
+        a scrape round-trip.
+
+        Linear interpolation WITHIN the winning bucket (observations
+        are assumed uniform across it, the standard estimator error);
+        the first bucket interpolates from 0; a quantile landing in
+        the +Inf overflow bucket CLAMPS to the largest finite bound —
+        the estimator cannot know how far past it the tail really
+        goes, and a clamped answer keeps thresholds monotone.  An
+        empty series returns 0.0 (no evidence, no alert)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"{self.name}: quantile must be in "
+                             f"[0, 1], got {q}")
+        with LOCK:
+            s = self._series.get(self._labels_key(labels))
+            if s is None or s.count == 0:
+                return 0.0
+            counts = list(s.counts)
+            total = s.count
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                if i >= len(self.buckets):
+                    return self.buckets[-1]  # overflow: clamp
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                frac = (rank - cum) / c
+                return lo + min(max(frac, 0.0), 1.0) * (hi - lo)
+            cum += c
+        return self.buckets[-1]  # pragma: no cover - defensive
+
     def series_state(self, **labels) -> dict:
         """Snapshot one labeled series: per-bucket (non-cumulative)
         counts, sum, count."""
@@ -286,9 +324,21 @@ class Histogram(_Metric):
 
 
 def _fmt(v) -> str:
-    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
-        return str(int(v))
-    return format(v, ".10g") if isinstance(v, float) else str(v)
+    if isinstance(v, float):
+        # exposition-format spellings for non-finite values FIRST:
+        # int(inf) raises, and Prometheus wants +Inf/-Inf/NaN — a
+        # gauge legitimately set to inf (a ratio with a zero
+        # denominator) must not crash the whole scrape
+        if v != v:
+            return "NaN"
+        if v == float("inf"):
+            return "+Inf"
+        if v == float("-inf"):
+            return "-Inf"
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return format(v, ".10g")
+    return str(v)
 
 
 def _escape_label(v: str) -> str:

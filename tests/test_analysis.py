@@ -587,6 +587,90 @@ class TestEngineMutationLint:
         assert "bad_push" in found[0].message
         assert ".append()" in found[0].message
 
+    def test_rogue_alert_evaluator_mutation_flags(self, tmp_path):
+        """The REPO rule sanctions the alert evaluator's engine READS
+        only inside `AlertEngine` in observability/alerts.py: a rogue
+        evaluator that mutates the engine from evaluate() — the
+        tempting bug being 'just preempt the request burning the
+        budget' — must flag."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        mods = _scan_snippet(tmp_path, """
+            class RogueAlerts:
+                def evaluate(self):
+                    self.engine.preempt(self.worst)
+                    self.engine._chunk_budget = 1
+
+                def shed(self, engine):
+                    engine.evict(0)
+        """, name="rogue_alerts.py")
+        found = EngineMutationPass(REPO_ENGINE_RULE).run(mods)
+        msgs = sorted(f.message for f in found)
+        assert len(found) == 3, msgs
+        assert any(".preempt()" in m for m in msgs)
+        assert any(".evict()" in m for m in msgs)
+        assert any("attribute store" in m for m in msgs)
+        assert all("RogueAlerts" in m for m in msgs)
+
+    def test_repo_rule_sanctions_alert_engine_reads(self, tmp_path):
+        """The sanctioned twin: the same shapes inside `AlertEngine`
+        in observability/alerts.py scan clean — the spec encodes 'the
+        evaluator may read (and is trusted) between steps'."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        (tmp_path / "observability").mkdir()
+        mods = _scan_snippet(tmp_path, """
+            class AlertEngine:
+                def evaluate(self):
+                    self.engine.preempt(self.worst)
+                    self.engine._chunk_budget = 1
+        """, name="observability/alerts.py")
+        assert EngineMutationPass(REPO_ENGINE_RULE).run(mods) == []
+
+    def test_alerts_lock_discipline_enforced(self, tmp_path):
+        """The alert engine's cross-thread state table and transitions
+        list are in the lock-discipline spec: unguarded mutations in a
+        module named like alerts.py flag, the locked forms scan
+        clean."""
+        from paddle_tpu.analysis import REPO_LOCK_RULES
+        from paddle_tpu.analysis.passes import LockDisciplinePass
+
+        (tmp_path / "observability").mkdir()
+        mods = _scan_snippet(tmp_path, """
+            class AlertEngine:
+                def bad_transition(self, e):
+                    self._transitions.append(e)
+                    self._state["r"] = e
+
+                def good_transition(self, e):
+                    with _lock:
+                        self._transitions.append(e)
+                        self._state["r"] = e
+        """, name="observability/alerts.py")
+        found = LockDisciplinePass(REPO_LOCK_RULES).run(mods)
+        assert len(found) == 2, [f.message for f in found]
+        assert all("bad_transition" in f.message for f in found)
+
+    def test_opsserver_lock_discipline_enforced(self, tmp_path):
+        """The ops registry (engines/frontends/server handle) is in
+        the lock-discipline spec: unguarded registration in a module
+        named like opsserver.py flags, the locked form scans clean."""
+        from paddle_tpu.analysis import REPO_LOCK_RULES
+        from paddle_tpu.analysis.passes import LockDisciplinePass
+
+        (tmp_path / "observability").mkdir()
+        mods = _scan_snippet(tmp_path, """
+            def bad_register(engine):
+                _ENGINES[engine._engine_id] = engine
+
+            def good_register(engine):
+                with _lock:
+                    _ENGINES[engine._engine_id] = engine
+        """, name="observability/opsserver.py")
+        found = LockDisciplinePass(REPO_LOCK_RULES).run(mods)
+        assert len(found) == 1, [f.message for f in found]
+        assert "bad_register" in found[0].message
+
 
 # ---------------------------------------------------------------------------
 # donation analysis

@@ -80,6 +80,50 @@ class TestHistogramMath:
         assert 'h_bucket{le="+Inf"} 3' in txt
         assert "h_count 3" in txt
 
+    def test_quantile_estimator(self):
+        """ISSUE-14 satellite: `Histogram.quantile` — linear
+        interpolation within the winning bucket; the overflow bucket
+        clamps to the largest finite bound; empty series answer 0."""
+        reg = MetricRegistry()
+        h = reg.histogram("h", buckets=(1.0, 2.0, 4.0))
+        # empty: no evidence, no estimate
+        assert h.quantile(0.5) == 0.0
+        # single bucket: 10 observations land in (1, 2]; the median
+        # interpolates to the bucket midpoint-ish (rank 5 of 10)
+        for _ in range(10):
+            h.observe(1.5)
+        assert h.quantile(0.5) == pytest.approx(1.5)
+        assert h.quantile(1.0) == pytest.approx(2.0)
+        # first bucket interpolates from 0
+        h2 = reg.histogram("h2", buckets=(1.0, 2.0))
+        h2.observe(0.5)
+        h2.observe(0.6)
+        assert h2.quantile(0.5) == pytest.approx(0.5)
+        # all in overflow: clamp to the largest finite bound
+        h3 = reg.histogram("h3", buckets=(1.0, 2.0))
+        for _ in range(5):
+            h3.observe(100.0)
+        assert h3.quantile(0.5) == 2.0
+        assert h3.quantile(0.99) == 2.0
+        # mixed: quantiles walk the cumulative counts (rank q*N lands
+        # at the END of its observation, the histogram_quantile rule:
+        # rank 1 of the 1-observation first bucket reads its bound)
+        h4 = reg.histogram("h4", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 1.5, 3.0):
+            h4.observe(v)
+        assert h4.quantile(0.25) == pytest.approx(1.0)
+        assert h4.quantile(0.5) == pytest.approx(1.5)
+        assert h4.quantile(1.0) == pytest.approx(4.0)
+        with pytest.raises(ValueError):
+            h4.quantile(1.5)
+
+    def test_quantile_labeled_series(self):
+        reg = MetricRegistry()
+        h = reg.histogram("h", labels=("k",), buckets=(1.0, 2.0))
+        h.observe(1.5, k="a")
+        assert h.quantile(0.9, k="a") > 1.0
+        assert h.quantile(0.9, k="missing") == 0.0
+
     def test_unsorted_buckets_rejected(self):
         reg = MetricRegistry()
         with pytest.raises(ValueError):
@@ -176,6 +220,35 @@ class TestPrometheusGolden:
             'app_requests_total{reason="length"} 1\n'
         )
 
+    def test_hostile_labels_and_nonfinite_values(self):
+        """ISSUE-14 satellite golden refresh: label values carrying
+        every escape-worthy character (backslash, double quote,
+        newline) render per the exposition format, and non-finite
+        gauge values spell +Inf/-Inf/NaN instead of crashing the
+        scrape."""
+        reg = MetricRegistry()
+        g = reg.gauge("hostile", help='line1\nline2 \\ "q"',
+                      labels=("p",))
+        g.set(1, p='a\\b"c\nd')
+        g.set(float("inf"), p="hi")
+        g.set(float("-inf"), p="lo")
+        g.set(float("nan"), p="nn")
+        txt = reg.prometheus_text()
+        # HELP escapes backslash + newline (quotes stay raw there)
+        assert '# HELP hostile line1\\nline2 \\\\ "q"' in txt
+        assert "# TYPE hostile gauge" in txt
+        # label value: backslash, quote and newline all escaped
+        assert 'hostile{p="a\\\\b\\"c\\nd"} 1' in txt
+        assert 'hostile{p="hi"} +Inf' in txt
+        assert 'hostile{p="lo"} -Inf' in txt
+        assert 'hostile{p="nn"} NaN' in txt
+        # every value line still splits cleanly on the last space
+        for line in txt.splitlines():
+            if line.startswith("#") or not line:
+                continue
+            name_part, value = line.rsplit(" ", 1)
+            assert value  # parseable exposition shape
+
     def test_phase_and_burn_series_render(self):
         """ISSUE-11 golden refresh: the flight recorder's phase
         histogram (label `phase`, incl. the batch-observe path) and
@@ -235,6 +308,38 @@ def test_metric_catalog_matches_docs():
     assert not stale, (
         f"docs/OBSERVABILITY.md documents metrics that are not "
         f"registered: {stale}")
+
+
+def test_alert_catalog_matches_docs():
+    """Every shipped `AlertRule` (observability.alerts.default_rules)
+    has a row in docs/OBSERVABILITY.md's alert-rule table and vice
+    versa — the same both-directions contract as the metric catalog
+    test, so the catalog and its documentation can never drift."""
+    import os
+    import re
+
+    from paddle_tpu.observability.alerts import default_rules
+
+    doc_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "OBSERVABILITY.md")
+    with open(doc_path) as f:
+        docs = f.read()
+    # alert rows look like: | `slo_burn_rate` | page | ... — ONLY
+    # inside the table whose second column is a severity
+    doc_rules = {
+        m.group(1)
+        for m in re.finditer(
+            r"^\| `([a-z0-9_]+)` \| (?:page|ticket) \|", docs, re.M)}
+    shipped = {r.name for r in default_rules()}
+    undocumented = sorted(shipped - doc_rules)
+    assert not undocumented, (
+        f"alert rules shipped but missing from docs/OBSERVABILITY.md's "
+        f"alert-rule table: {undocumented}")
+    stale_rules = sorted(doc_rules - shipped)
+    assert not stale_rules, (
+        f"docs/OBSERVABILITY.md documents alert rules that are not "
+        f"shipped: {stale_rules}")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,588 @@
+"""Ops plane (ISSUE 14): declarative multi-window burn-rate alerting
++ live HTTP telemetry endpoints.
+
+Contracts pinned here:
+
+* `AlertRule` validates its shape (severity/signal/op/window order)
+  and round-trips through its wire form (what `wire_config` carries);
+* the `AlertEngine` state machine: threshold rules debounce through
+  ``for_s`` and resolve with ``resolve_after_s`` hysteresis;
+  multi-window burn-rate rules fire only when EVERY window's average
+  exceeds its factor and resolve only after the shortest window reads
+  clean; disarmed-subsystem signals are "no evidence" and never
+  fire/resolve;
+* transitions land everywhere at once: the
+  ``paddle_alerts_firing{engine,rule,severity}`` gauge,
+  ``paddle_alert_transitions_total{rule,state}``, an
+  ``alert_fire``/``alert_resolve`` flight-ring event, and the bounded
+  transitions list;
+* engine integration: ``alerts=`` off by default (bit-exact, zero
+  counters), evaluation rides the step loop at
+  ``FLAGS_alert_interval_steps``, `statusz` embeds the alert state,
+  a fatal fault's crash dump records the firing set at death;
+* the ops HTTP server: all five endpoints answer mid-serve from an
+  external thread with bit-exact outputs; `/statusz` is key-identical
+  to the in-process dict; `/readyz` consults health + headroom +
+  page alerts + watchdog overdue; engine retirement (recover /
+  abandon) keeps the registry truthful across generations;
+* with everything at defaults: no listening socket, no alert engine,
+  zero alert counters.
+"""
+import gc
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.inference import resilience
+from paddle_tpu.inference.errors import StepFault
+from paddle_tpu.inference.serving import (DecodeEngine, decode_stats,
+                                          reset_decode_stats)
+from paddle_tpu.observability import opsserver
+from paddle_tpu.observability.alerts import (AlertEngine, AlertRule,
+                                             default_rules)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    # engines hold reference cycles (scheduler/resilience/recorder
+    # point back), so a previous test's engine stays in the weakref
+    # ops registry until a gc pass — collect so each test starts with
+    # an empty registry
+    gc.collect()
+    reset_decode_stats()
+    obs.reset()
+    obs.clear_spans()
+    yield
+    obs.stop_ops_server()
+    reset_decode_stats()
+    obs.reset()
+    obs.clear_spans()
+
+
+TINY = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                 num_heads=4, max_seq_len=256,
+                 use_parallel_layers=False, dropout=0.0)
+
+PROMPTS = [[1, 2, 3, 4, 5, 1, 2, 3, 4, 5, 1, 2],
+           [7, 8, 9, 7, 8, 9, 7, 8]]
+NEW = 12
+
+
+def _tiny_gpt(seed=0):
+    paddle.seed(seed)
+    m = GPT(TINY)
+    m.eval()
+    return m
+
+
+def _engine(m, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("page_size", 4)
+    return DecodeEngine(m, **kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_gpt()
+
+
+@pytest.fixture(scope="module")
+def reference(model):
+    eng = _engine(model)
+    return [list(o) for o in
+            eng.generate([np.array(p, np.int32) for p in PROMPTS],
+                         max_new_tokens=NEW)]
+
+
+def _serve(eng):
+    reqs = [eng.add_request(np.array(p, np.int32),
+                            max_new_tokens=NEW) for p in PROMPTS]
+    eng.run()
+    return [list(r.generated_ids) for r in reqs]
+
+
+def _get(base, path, timeout=5.0):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# AlertRule shape + wire
+# ---------------------------------------------------------------------------
+class TestAlertRule:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="severity"):
+            AlertRule("r", signal="slo_burn", severity="sev1")
+        with pytest.raises(ValueError, match="unknown signal"):
+            AlertRule("r", signal="nope")
+        with pytest.raises(ValueError, match="op"):
+            AlertRule("r", signal="slo_burn", op="==")
+        with pytest.raises(ValueError, match="shortest first"):
+            AlertRule("r", signal="slo_burn",
+                      windows=((60.0, 2.0), (5.0, 10.0)))
+
+    def test_wire_roundtrip(self):
+        for r in default_rules():
+            assert AlertRule.from_wire(r.to_wire()) == r
+        json.dumps([r.to_wire() for r in default_rules()])
+
+    def test_default_catalog_names_unique_and_severities(self):
+        rules = default_rules()
+        names = [r.name for r in rules]
+        assert len(set(names)) == len(names)
+        assert {"slo_burn_rate", "engine_hung", "pool_pressure"} <= {
+            r.name for r in rules if r.severity == "page"}
+
+    def test_window_scale_touches_only_the_clock(self):
+        full, scaled = default_rules(), default_rules(0.01)
+        for a, b in zip(full, scaled):
+            assert a.name == b.name
+            assert a.severity == b.severity
+            assert a.threshold == b.threshold
+            assert [f for _, f in a.windows] == [f for _, f in b.windows]
+            for (wa, _), (wb, _) in zip(a.windows, b.windows):
+                assert wb == pytest.approx(wa * 0.01)
+
+
+# ---------------------------------------------------------------------------
+# the state machine (driven with injectable clocks — no sleeping)
+# ---------------------------------------------------------------------------
+class TestAlertStateMachine:
+    def _alert_engine(self, model, rules):
+        eng = _engine(model, alerts=rules)
+        return eng, eng._alerts
+
+    def test_threshold_for_duration_debounce(self, model):
+        rule = AlertRule("pp", signal="pool_reclaimable_frac",
+                         severity="page", threshold=0.5, op="<",
+                         for_s=10.0, resolve_after_s=5.0)
+        # a pool barely bigger than the two requests' page need, so
+        # binding them drops the reclaimable fraction below 50%
+        eng = _engine(model, num_pages=16, alerts=[rule])
+        al = eng._alerts
+        for p in PROMPTS:
+            eng.add_request(np.array(p, np.int32), max_new_tokens=NEW)
+        for _ in range(8):
+            if eng.pool.free_count + \
+                    eng.pool.cached_unreferenced_count < \
+                    0.5 * eng.pool.num_pages:
+                break
+            eng.step()
+        assert eng.pool.free_count + \
+            eng.pool.cached_unreferenced_count < \
+            0.5 * eng.pool.num_pages
+        al.evaluate(now=100.0)
+        assert al.snapshot()["rules"]["pp"]["state"] == "pending"
+        al.evaluate(now=105.0)  # held 5s < for_s
+        assert al.firing() == []
+        al.evaluate(now=111.0)  # held 11s >= for_s
+        assert al.firing() == ["pp"]
+        assert obs.ALERTS_FIRING.value(
+            engine=eng._engine_id, rule="pp", severity="page") == 1
+        # drain the engine: reclaimable recovers -> clean, but only
+        # resolve_after_s of continuous clean resolves
+        eng.run()
+        al.evaluate(now=120.0)
+        assert al.firing() == ["pp"]  # clean but not long enough
+        al.evaluate(now=126.0)
+        assert al.firing() == []
+        trs = [(t["rule"], t["state"])
+               for t in al.snapshot()["transitions"]]
+        assert trs == [("pp", "firing"), ("pp", "resolved")]
+        assert obs.ALERT_TRANSITIONS.value(rule="pp",
+                                           state="firing") == 1
+        assert obs.ALERT_TRANSITIONS.value(rule="pp",
+                                           state="resolved") == 1
+
+    def test_pending_clears_without_firing_on_a_blip(self, model):
+        rule = AlertRule("pp", signal="pool_reclaimable_frac",
+                         severity="page", threshold=0.5, op="<",
+                         for_s=10.0)
+        eng = _engine(model, num_pages=16, alerts=[rule])
+        al = eng._alerts
+        for p in PROMPTS:
+            eng.add_request(np.array(p, np.int32), max_new_tokens=NEW)
+        for _ in range(8):
+            if eng.pool.free_count + \
+                    eng.pool.cached_unreferenced_count < \
+                    0.5 * eng.pool.num_pages:
+                break
+            eng.step()
+        al.evaluate(now=100.0)
+        assert al.snapshot()["rules"]["pp"]["state"] == "pending"
+        eng.run()  # blip over before for_s
+        al.evaluate(now=105.0)
+        assert al.snapshot()["rules"]["pp"]["state"] == "ok"
+        assert al.snapshot()["transitions"] == []
+
+    def test_multi_window_needs_every_window(self, model):
+        rule = AlertRule("burn", signal="slo_burn", severity="page",
+                         windows=((10.0, 10.0), (100.0, 5.0)),
+                         resolve_after_s=20.0)
+        eng, al = self._alert_engine(model, [rule])
+        eid = eng._engine_id
+        # long window poisoned low: 100s of burn 1.0 samples
+        obs.SLO_BURN.set(1.0, engine=eid, kind="tpot")
+        for i in range(100):
+            al.evaluate(now=1000.0 + i)
+        # short window spikes to 40: short avg breaches, long avg
+        # (mostly 1.0) does not -> no fire (the blip-deafness the
+        # multi-window pair exists for)
+        obs.SLO_BURN.set(40.0, engine=eid, kind="tpot")
+        for i in range(10):
+            al.evaluate(now=1100.0 + i)
+        assert al.firing() == []
+        # sustain it: the long window average climbs past 5 -> fires
+        for i in range(15):
+            al.evaluate(now=1110.0 + i)
+        assert al.firing() == ["burn"]
+        # resolve: gauge clean; the SHORT window is the resolve probe
+        obs.SLO_BURN.set(0.0, engine=eid, kind="tpot")
+        for i in range(12):
+            al.evaluate(now=1125.0 + i)  # short window still has 40s
+        assert al.firing() == ["burn"]
+        for i in range(25):
+            al.evaluate(now=1137.0 + i)
+        assert al.firing() == []
+
+    def test_disarmed_signal_is_no_evidence(self, model):
+        # cost model off -> cost_error_max returns None -> the rule
+        # never leaves ok, even with a (stale) nonzero gauge
+        rule = AlertRule("drift", signal="cost_error_max",
+                         threshold=0.25, op=">")
+        eng = _engine(model, alerts=[rule], cost_model=False)
+        obs.STEP_COST_ERROR.set(9.0, fn="decode")
+        eng._alerts.evaluate(now=1.0)
+        st = eng._alerts.snapshot()["rules"]["drift"]
+        assert st["state"] == "ok" and st["value"] is None
+
+    def test_engine_hung_signal_follows_health(self, model):
+        from paddle_tpu.inference.durability import clear_health, \
+            set_health
+
+        rule = AlertRule("hung", signal="engine_hung", severity="page",
+                         threshold=1.0, op=">=")
+        eng, al = self._alert_engine(model, [rule])
+        al.evaluate(now=1.0)
+        assert al.firing() == []
+        set_health(eng._engine_id, "hung")
+        al.evaluate(now=2.0)
+        assert al.firing() == ["hung"]
+        set_health(eng._engine_id, "live")
+        al.evaluate(now=3.0)
+        assert al.firing() == []
+        clear_health(eng._engine_id)
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+class TestEngineIntegration:
+    def test_off_by_default_and_bit_exact(self, model, reference):
+        eng = _engine(model)
+        assert eng._alerts is None
+        assert _serve(eng) == reference
+        snap = obs.snapshot()
+        assert all(s["value"] == 0 for s in
+                   snap["paddle_alert_transitions_total"]["series"])
+        assert opsserver.ops_server_port() is None
+
+    def test_armed_engine_bit_exact_and_evaluates(self, model,
+                                                  reference):
+        paddle.set_flags({"alert_interval_steps": 4})
+        try:
+            eng = _engine(model, alerts=True)
+            assert _serve(eng) == reference
+        finally:
+            paddle.set_flags({"alert_interval_steps": 32})
+        assert eng._alerts.evals >= 2  # cadence rode the step loop
+        z = eng.statusz()
+        assert z["alerts"]["firing"] == []
+        assert set(z["alerts"]["rules"]) == {r.name
+                                             for r in default_rules()}
+        json.dumps(z)
+
+    def test_alert_interval_nonpositive_falls_back(self, model):
+        """The flag documents '<= 0 falls back to 32' — an accidental
+        zero must not buy every-step evaluation on the serve loop."""
+        paddle.set_flags({"alert_interval_steps": 0})
+        try:
+            eng = _engine(model, alerts=True)
+        finally:
+            paddle.set_flags({"alert_interval_steps": 32})
+        assert eng._alerts.interval_steps == 32
+
+    def test_flag_arms_alerts_without_listener(self, model):
+        paddle.set_flags({"ops_port": -1})
+        try:
+            eng = _engine(model)
+        finally:
+            paddle.set_flags({"ops_port": 0})
+        assert eng._alerts is not None
+        assert opsserver.ops_server_port() is None
+
+    def test_wire_config_carries_rules(self, model):
+        eng = _engine(model, alerts=True)
+        wire = eng.wire_config()
+        json.dumps(wire["alerts"])
+        rebuilt = _engine(model, **{k: v for k, v in wire.items()})
+        assert rebuilt._alerts is not None
+        assert tuple(r.name for r in rebuilt._alerts.rules) == \
+            tuple(r.name for r in eng._alerts.rules)
+        # and an off engine's wire keeps it off
+        off = _engine(model)
+        assert off.wire_config()["alerts"] is False
+
+    def test_fatal_fault_dump_records_firing_alerts(self, model,
+                                                    tmp_path):
+        """Crash-dump inclusion: the forced evaluation on the fatal
+        path lands the hung/fault-time alert state in the black box —
+        the post-mortem shows WHICH alerts were firing at death."""
+        rules = [AlertRule("hung", signal="engine_hung",
+                           severity="page", threshold=1.0, op=">=")]
+        eng = _engine(model, alerts=rules,
+                      fault_plan="slow_step@4;slow_ms=120",
+                      step_timeout_ms=40.0,
+                      flight_dir=str(tmp_path))
+        eng.add_request(np.array(PROMPTS[0], np.int32),
+                        max_new_tokens=NEW)
+        with pytest.raises(StepFault):
+            eng.run()
+        dumps = list(tmp_path.glob("flight_*_fault.json"))
+        assert len(dumps) == 1
+        data = json.loads(dumps[0].read_text())
+        assert data["alerts"]["rules"]["hung"]["state"] == "firing"
+        assert "hung" in data["alerts"]["firing"]
+
+    def test_restore_from_dir_carries_alerts(self, model, tmp_path):
+        """The journal's cfg record snapshots the resolved alert
+        table: an engine restored in a fresh process rebuilds with
+        the same rules armed and registers with the ops registry."""
+        from paddle_tpu.inference import durability
+
+        d = str(tmp_path / "j")
+        eng = _engine(model, journal_dir=d, alerts=True)
+        eng.generate([np.array(PROMPTS[0], np.int32)],
+                     max_new_tokens=4)
+        names = tuple(r.name for r in eng._alerts.rules)
+        del eng
+        gc.collect()
+        new, _reqs = durability.restore_from_dir(d, model)
+        assert new._alerts is not None
+        assert tuple(r.name for r in new._alerts.rules) == names
+        assert new._engine_id in {
+            e._engine_id for e in opsserver.live_engines()}
+        new.run()
+
+    def test_recover_carries_rules_and_retires_registry(self, model):
+        eng = _engine(model, alerts=True, fault_plan="step@3-9")
+        eng.add_request(np.array(PROMPTS[0], np.int32),
+                        max_new_tokens=NEW)
+        fault = None
+        while fault is None:
+            try:
+                eng.step()
+            except StepFault as e:
+                fault = e
+        new = resilience.recover(eng, fault=fault)
+        assert new._alerts is not None
+        assert tuple(r.name for r in new._alerts.rules) == \
+            tuple(r.name for r in eng._alerts.rules)
+        live_ids = {e._engine_id for e in opsserver.live_engines()}
+        assert eng._engine_id not in live_ids
+        assert new._engine_id in live_ids
+        new.run()
+
+
+# ---------------------------------------------------------------------------
+# readiness probes (in-process: the same function /readyz serves)
+# ---------------------------------------------------------------------------
+class TestReadiness:
+    def test_ready_criteria(self, model):
+        eng = _engine(model, max_batch_size=2)
+        crit = opsserver.engine_ready(eng)
+        assert crit["ready"] and crit["serving"]
+        assert crit["headroom_slots"] > 0
+        # degraded still serves (slower, not stopped): stays routable
+        from paddle_tpu.inference.durability import clear_health, \
+            set_health
+
+        set_health(eng._engine_id, "degraded")
+        assert opsserver.engine_ready(eng)["ready"]
+        set_health(eng._engine_id, "hung")
+        assert not opsserver.engine_ready(eng)["ready"]
+        set_health(eng._engine_id, "live")
+        clear_health(eng._engine_id)
+
+    def test_page_alert_blocks_readiness(self, model):
+        rule = AlertRule("pp", signal="pool_reclaimable_frac",
+                         severity="page", threshold=2.0, op="<")
+        eng = _engine(model, alerts=[rule])
+        eng._alerts.evaluate(now=1.0)  # frac < 2.0 always: fires
+        crit = opsserver.engine_ready(eng)
+        assert crit["page_alerts"] == ["pp"]
+        assert not crit["ready"]
+        # a ticket-severity rule must NOT block readiness
+        rule2 = AlertRule("pp2", signal="pool_reclaimable_frac",
+                          severity="ticket", threshold=2.0, op="<")
+        eng2 = _engine(model, alerts=[rule2])
+        eng2._alerts.evaluate(now=1.0)
+        assert opsserver.engine_ready(eng2)["ready"]
+
+    def test_watchdog_overdue_blocks_readiness(self, model):
+        eng = _engine(model, step_timeout_ms=20.0)
+        # warm so tracker signatures are stable (compiles excuse)
+        eng.generate([np.array(PROMPTS[0], np.int32)],
+                     max_new_tokens=4)
+        wd = eng._watchdog
+        assert not wd.overdue()
+        wd.arm()
+        time.sleep(0.05)  # past OVERDUE_FRACTION * 20ms, no compile
+        assert wd.overdue()
+        assert not opsserver.engine_ready(eng)["ready"]
+        wd.disarm()
+        assert wd.overdue() is False
+        assert opsserver.engine_ready(eng)["ready"]
+
+    def test_abandoned_engine_leaves_registry(self, model):
+        eng = _engine(model, step_timeout_ms=500.0)
+        eng.add_request(np.array(PROMPTS[0], np.int32),
+                        max_new_tokens=4)
+        eng.step()
+        assert eng._engine_id in {
+            e._engine_id for e in opsserver.live_engines()}
+        eng._abandon_inflight()
+        assert eng._engine_id not in {
+            e._engine_id for e in opsserver.live_engines()}
+
+
+# ---------------------------------------------------------------------------
+# the HTTP endpoints
+# ---------------------------------------------------------------------------
+class TestOpsServer:
+    def test_all_endpoints_mid_serve_bit_exact(self, model, reference,
+                                               monkeypatch):
+        """A hammering external poller hits every endpoint WHILE the
+        engine serves; outputs stay bit-exact and every response
+        parses."""
+        monkeypatch.setattr(opsserver, "_ENGINES", {})
+        port = obs.start_ops_server(port=0, host="127.0.0.1")
+        base = f"http://127.0.0.1:{port}"
+        eng = _engine(model, alerts=True)
+        seen = {}
+        stop = threading.Event()
+
+        def poll():
+            paths = ("/metrics", "/statusz", "/statusz?format=text",
+                     "/flightz", "/healthz", "/readyz", "/alertz")
+            i = 0
+            while not stop.is_set():
+                p = paths[i % len(paths)]
+                code, body = _get(base, p)
+                seen[p] = (code, body)
+                i += 1
+
+        t = threading.Thread(target=poll, daemon=True)
+        t.start()
+        try:
+            outs = _serve(eng)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert outs == reference
+        assert len(seen) == 7
+        assert seen["/metrics"][0] == 200
+        assert "paddle_decode_step_seconds" in seen["/metrics"][1]
+        z = json.loads(_get(base, "/statusz")[1])
+        assert z["engine"] == eng._engine_id
+        assert set(z) == set(eng.statusz())  # key-identical
+        w = json.loads(_get(base, "/flightz?n=4")[1])
+        assert len(w["records"]) <= 4 and "alerts" in w
+        code, body = _get(base, "/flightz?request=0")
+        assert code == 200 and json.loads(body)["explain"]
+        code, body = _get(base, "/readyz")
+        assert code == 200 and json.loads(body)["ready"]
+        a = json.loads(_get(base, "/alertz")[1])
+        assert str(eng._engine_id) in a["engines"]
+        assert _get(base, "/bogus")[0] == 404
+
+    def test_statusz_engine_param_and_multi_engine_map(self, model):
+        port = obs.start_ops_server(port=0, host="127.0.0.1")
+        base = f"http://127.0.0.1:{port}"
+        eng1 = _engine(model)
+        eng2 = _engine(model)
+        code, body = _get(base, "/statusz")
+        assert code == 200
+        m = json.loads(body)["engines"]
+        assert {str(eng1._engine_id), str(eng2._engine_id)} <= set(m)
+        code, body = _get(base,
+                          f"/statusz?engine={eng2._engine_id}")
+        assert json.loads(body)["engine"] == eng2._engine_id
+        assert _get(base, "/statusz?engine=99999")[0] == 404
+
+    def test_readyz_follows_recovery_generations(self, model,
+                                                 monkeypatch):
+        """/readyz and /statusz stay truthful across an engine
+        rebuild: the dead generation vanishes, the successor serves."""
+        monkeypatch.setattr(opsserver, "_ENGINES", {})
+        port = obs.start_ops_server(port=0, host="127.0.0.1")
+        base = f"http://127.0.0.1:{port}"
+        eng = _engine(model, fault_plan="step@3-9")
+        eng.add_request(np.array(PROMPTS[0], np.int32),
+                        max_new_tokens=NEW)
+        fault = None
+        while fault is None:
+            try:
+                eng.step()
+            except StepFault as e:
+                fault = e
+        new = resilience.recover(eng, fault=fault)
+        new.run()
+        r = json.loads(_get(base, "/readyz")[1])
+        assert r["ready"]
+        assert str(eng._engine_id) not in r["engines"]
+        assert str(new._engine_id) in r["engines"]
+        z = json.loads(_get(
+            base, f"/statusz?engine={new._engine_id}")[1])
+        assert z["engine"] == new._engine_id
+        assert _get(base,
+                    f"/statusz?engine={eng._engine_id}")[0] == 404
+
+    def test_healthz_503_with_no_live_engine(self, model,
+                                             monkeypatch):
+        # isolate the process-global registry: another test's engine
+        # lingering in a pytest traceback frame must not read as
+        # serving capacity here
+        monkeypatch.setattr(opsserver, "_ENGINES", {})
+        monkeypatch.setattr(opsserver, "_FRONTENDS", {})
+        port = obs.start_ops_server(port=0, host="127.0.0.1")
+        base = f"http://127.0.0.1:{port}"
+        code, body = _get(base, "/healthz")
+        assert code == 503  # no engines: nothing can serve
+        eng = _engine(model, step_timeout_ms=500.0)
+        assert _get(base, "/healthz")[0] == 200
+        eng._abandon_inflight()
+        code, body = _get(base, "/healthz")
+        assert code == 503
+        assert json.loads(body)["ok"] is False
+
+    def test_stop_is_idempotent_and_port_reports_none(self):
+        assert opsserver.ops_server_port() is None
+        obs.stop_ops_server()  # no server: no-op
+        port = obs.start_ops_server(port=0, host="127.0.0.1")
+        assert opsserver.ops_server_port() == port
+        assert obs.start_ops_server(port=0) == port  # idempotent
+        obs.stop_ops_server()
+        assert opsserver.ops_server_port() is None

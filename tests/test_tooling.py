@@ -72,6 +72,7 @@ def test_op_bench_and_gate(tmp_path):
     assert e.returncode == 2
 
 
+@pytest.mark.slow
 def test_bench_eager_smoke(tmp_path):
     """tools/bench_eager.py --smoke runs end-to-end: the eager dispatch
     bench can't rot.  Asserts the emitted JSON shape and that the cached
@@ -100,6 +101,7 @@ def test_bench_eager_smoke(tmp_path):
         assert cfg["per_op_speedup"] > 0
 
 
+@pytest.mark.slow
 def test_bench_decode_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_decode.py runs end-to-end: the decode
     bench can't rot.  Asserts the emitted JSON shape, greedy parity
@@ -555,6 +557,107 @@ def test_telemetry_dump_smoke(tmp_path):
     assert f"request {rid}" in r2.stdout
 
 
+def test_telemetry_dump_url_mode(tmp_path):
+    """ISSUE-14 satellite: telemetry_dump --url pulls /metrics,
+    /statusz and /flightz from a LIVE ops server (started in this
+    process, polled by the subprocess over real HTTP) and writes the
+    same artifact files as the in-process path — and the statusz JSON
+    the two paths produce is key-identical."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.inference.serving import DecodeEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=2, max_seq_len=64,
+                    use_parallel_layers=False, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    eng = DecodeEngine(model, max_batch_size=2, max_seq_len=40,
+                       page_size=8, alerts=True)
+    eng.generate([np.arange(1, 13, dtype=np.int32)],
+                 max_new_tokens=6)
+    port = obs.start_ops_server(port=0, host="127.0.0.1")
+    outdir = str(tmp_path / "tel_url")
+    try:
+        # --engine pins the pull to OUR engine: other suites' module-
+        # scoped engines may still be registered in this process, and
+        # a multi-engine /statusz answers the map form
+        r = subprocess.run(
+            [sys.executable, "tools/telemetry_dump.py",
+             "--url", f"http://127.0.0.1:{port}",
+             "--engine", str(eng._engine_id),
+             "--outdir", outdir],
+            cwd=REPO, capture_output=True, text=True, env=ENV,
+            timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+    finally:
+        obs.stop_ops_server()
+    prom = open(os.path.join(outdir, "telemetry.prom")).read()
+    assert "paddle_decode_step_seconds" in prom
+    assert "# TYPE paddle_alerts_firing gauge" in prom
+    with open(os.path.join(outdir, "telemetry_statusz.json")) as f:
+        pulled = json.load(f)
+    local = eng.statusz()
+    # the key-identity contract: a dump taken over the wire describes
+    # the same surface as one taken in-process
+    assert set(pulled) == set(local), set(pulled) ^ set(local)
+    assert pulled["engine"] == eng._engine_id
+    assert pulled["alerts"]["firing"] == []
+    txt = open(os.path.join(outdir, "telemetry_statusz.txt")).read()
+    assert f"engine {eng._engine_id}" in txt
+    with open(os.path.join(outdir, "telemetry_flight.json")) as f:
+        flight = json.load(f)
+    assert flight["records"] and "alerts" in flight
+
+
+@pytest.mark.slow
+def test_bench_opsplane_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_opsplane.py runs end-to-end: the
+    ops-plane bench can't rot.  Slow lane like the other chaos-bench
+    smokes (its wall is dominated by the seeded hang + resolve-window
+    waits); the ops-plane machinery itself is pinned by the tier-1
+    tests/test_opsplane.py suite.  Asserts the ISSUE-14 acceptance bar at
+    smoke scale: the burn-rate alert fires BEFORE the first deadline
+    miss and resolves after clean windows, /readyz (polled over real
+    HTTP) flips non-ready before the hung worker is abandoned and
+    reads ready again after recovery, ops-plane-on output parity, and
+    the off leg's zero-sockets/zero-counters contract (the overhead
+    RATIO is gated at full scale only)."""
+    out = str(tmp_path / "bench_opsplane.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_opsplane.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    s = data["summary"]
+    assert s["burn_alert_fired"] is True
+    assert s["fire_before_first_deadline_miss"] is True
+    assert s["resolved_after_clean_windows"] is True
+    assert s["readyz_flipped_before_abandon"] is True
+    assert s["ready_after_recovery"] is True
+    assert s["hung_recovered"] is True
+    assert s["parity_ops_on"] is True
+    assert s["zero_new_executables"] is True
+    assert s["off_alert_engine_absent"] is True
+    assert s["off_zero_listening_sockets"] is True
+    assert s["off_zero_alert_series"] is True
+    burn = data["legs"]["chaos"]["burn"]
+    assert ("slo_burn_rate", "firing") in [
+        tuple(t) for t in burn["transitions"]]
+    assert ("slo_burn_rate", "resolved") in [
+        tuple(t) for t in burn["transitions"]]
+    hang = data["legs"]["chaos"]["hang"]
+    assert hang["polls"] > 0 and hang["flip_lead_ms"] > 0
+
+
+@pytest.mark.slow
 def test_bench_cost_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_cost.py runs end-to-end: the cost-
     observatory bench can't rot.  Asserts the ISSUE-13 acceptance bar

@@ -27,10 +27,21 @@ must parse and the core request-latency series must be present after a
 single CPU `generate()` run — the ISSUE-4 acceptance check, widened by
 ISSUE-11 with the flight/statusz artifacts.
 
+With ``--url http://host:port`` the dump PULLS from a live ops-plane
+endpoint (observability.opsserver, ``FLAGS_ops_port``) instead of
+serving a local workload: ``/metrics`` -> ``telemetry.prom``,
+``/statusz`` (JSON + ``?format=text``) -> ``telemetry_statusz.{json,
+txt}``, ``/flightz`` -> ``telemetry_flight.json`` — the SAME artifact
+files as the in-process path, so every downstream reader
+(explain_request, dashboards, the CI smoke) works identically on a
+dump taken from a remote engine.  test_tooling pins that both paths
+produce key-identical statusz JSON.
+
 Usage:
     python tools/telemetry_dump.py [--outdir DIR] [--batch 2]
                                    [--context 24] [--new-tokens 8]
                                    [--spec-k 0] [--seed 0]
+    python tools/telemetry_dump.py --url http://host:port [--outdir DIR]
 """
 import argparse
 import json
@@ -40,12 +51,54 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-import numpy as np  # noqa: E402
 
-import paddle_tpu as paddle  # noqa: E402
-from paddle_tpu import observability, profiler  # noqa: E402
-from paddle_tpu.models.gpt import GPT, GPTConfig  # noqa: E402
-from paddle_tpu.inference.serving import DecodeEngine  # noqa: E402
+def dump_from_url(url: str, outdir: str, engine=None) -> int:
+    """Pull /metrics, /statusz and /flightz from a live ops server and
+    write the in-process dump's artifact files.  ``engine`` selects
+    one engine on a multi-engine process (without it a multi-engine
+    /statusz answers the ``{"engines": {...}}`` map form instead of
+    the single-engine dict the in-process path writes)."""
+    from urllib.error import HTTPError
+    from urllib.request import urlopen
+
+    def get(path: str, **params) -> str:
+        if engine is not None:
+            params["engine"] = engine
+        if params:
+            path += "?" + "&".join(f"{k}={v}"
+                                   for k, v in params.items())
+        with urlopen(url.rstrip("/") + path, timeout=10) as r:
+            return r.read().decode("utf-8")
+
+    os.makedirs(outdir, exist_ok=True)
+    wrote = []
+    with open(os.path.join(outdir, "telemetry.prom"), "w") as f:
+        f.write(get("/metrics"))
+    wrote.append("telemetry.prom")
+    statusz = get("/statusz")
+    json.loads(statusz)  # a torn/error payload must fail loudly HERE
+    with open(os.path.join(outdir, "telemetry_statusz.json"), "w") as f:
+        f.write(statusz)
+    with open(os.path.join(outdir, "telemetry_statusz.txt"), "w") as f:
+        f.write(get("/statusz", format="text"))
+    wrote += ["telemetry_statusz.json", "telemetry_statusz.txt"]
+    try:
+        flight = get("/flightz")
+        json.loads(flight)
+        with open(os.path.join(outdir, "telemetry_flight.json"),
+                  "w") as f:
+            f.write(flight)
+        wrote.append("telemetry_flight.json")
+    except HTTPError as e:
+        # tolerate EXACTLY the documented case — flight recorder
+        # disabled on the remote engine (404); a dead server or any
+        # other error must fail the pull, not silently drop the
+        # crash-post-mortem artifact
+        if e.code != 404:
+            raise
+    for name in wrote:
+        print(f"wrote {os.path.join(outdir, name)} (from {url})")
+    return 0
 
 
 def main():
@@ -53,6 +106,14 @@ def main():
     ap.add_argument("--outdir", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "telemetry_out"))
+    ap.add_argument("--url", default=None,
+                    help="pull from a live ops server "
+                         "(http://host:port) instead of serving a "
+                         "local workload")
+    ap.add_argument("--engine", default=None,
+                    help="pull mode: engine id to select on a "
+                         "multi-engine process (default: the "
+                         "server's single-engine form)")
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--context", type=int, default=24)
     ap.add_argument("--new-tokens", type=int, default=8)
@@ -65,6 +126,19 @@ def main():
                     help="speculative draft length (0 = classic decode)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+
+    if args.url:
+        # pull mode: no model, no jax — just HTTP + files
+        return dump_from_url(args.url, args.outdir,
+                             engine=args.engine)
+
+    # the heavy imports live here so pull mode starts in milliseconds
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability, profiler
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.inference.serving import DecodeEngine
 
     paddle.seed(args.seed)
     cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
