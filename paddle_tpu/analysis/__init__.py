@@ -105,6 +105,19 @@ REPO_LOCK_RULES: Dict[str, LockRule] = {
         locks=("_lock",),
         roots=("_ENGINES", "_FRONTENDS", "_SERVER"),
     ),
+    # profiling plane: capture state, the device-time table and the
+    # measured-MFU/drift tables mutate under the module's designated
+    # lock (/profilez and request_capture touch them from arbitrary
+    # threads).  The open-step probe dict (_probe/_probe_now) is
+    # engine-thread-private like the flight recorder's open record
+    # and deliberately unlisted.
+    "observability/profiling.py": LockRule(
+        locks=("_lock",),
+        roots=("_PROFILERS", "_forced_engines"),
+        self_attrs=("_capture_pending", "_capture_remaining",
+                    "_capture_total", "_captures", "_device_s",
+                    "_host_ratio", "_mfu", "_dev_calib", "_drift"),
+    ),
     "inference/serving.py": LockRule(
         locks=("_TELEMETRY_LOCK", "LOCK"),
         roots=("_STATS",),
@@ -188,6 +201,12 @@ REPO_ENGINE_RULE = EngineRule(
         # is read-only by contract, and an endpoint that grows a
         # mutating call flags the moment it is written.
         "observability/alerts.py": ("AlertEngine.",),
+        # the profiling plane READS the engine (blocking on dispatch
+        # outputs, scoring sealed records, the between-steps capture-
+        # arming site) — sanctioned for exactly the Profiler class, so
+        # a rogue profiler that mutates the engine ("just preempt the
+        # slot whose dispatch keeps blocking longest") still flags
+        "observability/profiling.py": ("Profiler.",),
     },
 )
 

@@ -383,6 +383,39 @@ define_flag("alert_interval_steps", 32,
             "fires unconditionally on a fatal step fault and at "
             "watchdog abandonment so the crash dump records the "
             "alerts firing at death.  <= 0 falls back to 32")
+define_flag("profile", False,
+            "profiling plane (observability.profiling): sampled "
+            "device-sync probes split each probed step's wall into "
+            "device seconds vs host overhead (the engine blocks on "
+            "the dispatched executable's output), MEASURED "
+            "per-executable MFU lands beside the cost observatory's "
+            "roofline gauges with a predicted-vs-measured drift "
+            "gauge, compile-time profiles grow a top-K per-op "
+            "FLOP/byte table, and bounded capture sessions "
+            "(profiling.request_capture) record probe spans on a "
+            "'device' chrome-trace track.  The device/host split, "
+            "measured MFU and drift ride the flight record, so they "
+            "need FLAGS_flight_window > 0 (the default); with the "
+            "recorder off, probes still feed the device-seconds "
+            "table and capture spans.  0 (default) = fully disarmed: "
+            "one `is None` check per step hook, zero probes, zero "
+            "new executables, bit-exact serving.  Engines "
+            "constructed with an explicit profile= ignore the flag")
+define_flag("profile_sample_steps", 64,
+            "engine steps between device-sync probes while "
+            "FLAGS_profile is armed (every step during an armed "
+            "capture session): each probe blocks the engine thread on "
+            "the step executable's output, trading one pipeline "
+            "bubble for a measured device-vs-host split — sampling "
+            "keeps the amortized cost negligible.  <= 1 probes every "
+            "step (the bench attribution mode)")
+define_flag("profile_dir", "",
+            "directory for capture-session device traces: while set, "
+            "profiling.request_capture additionally wraps the capture "
+            "window in jax.profiler.start_trace/stop_trace so the "
+            "XLA-level timeline lands beside the probe spans.  Empty "
+            "(default) = probe spans only (the merged chrome trace's "
+            "'device' track still works)")
 define_flag("use_rbg_rng", True,
             "on TPU, use the hardware RBG PRNG for the framework's random "
             "ops instead of threefry (measured: recovers ~60% of dropout's "

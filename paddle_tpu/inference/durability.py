@@ -340,9 +340,14 @@ def retire_engine_series(engine_id: int) -> int:
     # generation must leave /statusz, /healthz and /readyz the same
     # moment it leaves the scrape surface (recover / restore / abandon
     # all funnel through here)
-    from ..observability import opsserver
+    from ..observability import opsserver, profiling
 
     opsserver.deregister_engine(engine_id)
+    # likewise the profiling plane's capture registry: request_capture
+    # must never arm a session on a retired generation (its
+    # paddle_host_overhead_ratio series retires with the label sweep
+    # below)
+    profiling.deregister(engine_id)
     return _obs.registry.retire_label("engine", engine_id)
 
 

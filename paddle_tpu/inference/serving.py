@@ -1191,7 +1191,8 @@ class DecodeEngine:
                  prefix_cache=None, scheduler=None, fault_plan=None,
                  journal_dir=None, step_timeout_ms=None,
                  flight_window=None, flight_dir=None, kv_quant=None,
-                 cost_model=None, cost_calibration=None, alerts=None):
+                 cost_model=None, cost_calibration=None, alerts=None,
+                 profile=None, profile_sample_steps=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -1496,6 +1497,35 @@ class DecodeEngine:
             bool(_flags.flag("sched_cost_admission"))
         self._ctor["cost_model"] = bool(cost_model)
         self._ctor["cost_calibration"] = None
+
+        # profiling plane (observability.profiling): sampled device-
+        # sync probes + hot-op tables + bounded capture sessions.
+        # Explicit arg wins, else FLAGS_profile; disarmed = one
+        # `is None` check per serve-loop hook, zero probes, bit-exact.
+        from ..observability import profiling as _profiling_mod
+
+        if profile is not None and bool(profile) and \
+                not bool(_flags.flag("profile")):
+            # explicit opt-in AGAINST a disabled flag: arm hot-op
+            # extraction at the costmodel chokepoint too (the
+            # costmodel._force_enable pattern — not latched when the
+            # flag is on, so recover()/restore re-passing a resolved
+            # profile=True cannot pin extraction past a later
+            # FLAGS_profile=0)
+            _profiling_mod._force_enable()
+        if profile is None:
+            profile = bool(_flags.flag("profile"))
+        self._profiling = None
+        if bool(profile):
+            self._profiling = _profiling_mod.Profiler(
+                self, sample_steps=profile_sample_steps)
+        self._ctor["profile"] = bool(profile)
+        # the RESOLVED cadence rides wire_config so recover/restore
+        # rebuild an armed engine probing at the same rate
+        self._ctor["profile_sample_steps"] = (
+            self._profiling.sample_steps
+            if self._profiling is not None
+            else profile_sample_steps)
 
         # ops plane (observability.opsserver + observability.alerts):
         # the engine always registers with the process-global ops
@@ -2683,6 +2713,14 @@ class DecodeEngine:
                         jnp.asarray(tokens), jnp.asarray(caps),
                         jnp.asarray(sample_idx),
                         jnp.asarray(sample_mask), key)
+                if self._profiling is not None:
+                    # sampled device-sync probe (see _step_inner):
+                    # attributed to the MIXED executable regardless of
+                    # the flight phase this step dispatched under — a
+                    # chunkless full step runs the mixed program under
+                    # the "decode" phase, and scoring it against the
+                    # decode profile would poison the calibration
+                    self._profiling.probe("mixed", toks, t0, t0_ns)
             toks = self._host_fetch(toks)
         if self._kv_quant:
             self._note_refolds(int(toks[-1]))
@@ -2944,6 +2982,11 @@ class DecodeEngine:
             # error tables, roofline peaks, the HBM ledger, and the
             # capacity-headroom estimate a fleet router admits on
             out["cost"] = self._cost.statusz()
+        if self._profiling is not None:
+            # the profiling plane: probe accounting, capture status,
+            # measured device time / MFU drift, hot-op tables — the
+            # same dict the /profilez endpoint serves
+            out["profiling"] = self._profiling.statusz()
         return out
 
     def statusz_text(self, flight_records: int = 4) -> str:
@@ -3040,6 +3083,11 @@ class DecodeEngine:
         fr = self._flight
         if fr is not None:
             fr.begin_step()
+        if self._profiling is not None:
+            # profiling plane: arm any pending capture session (the
+            # between-steps engine-thread arming site) and decide
+            # whether this step's dispatches probe device time
+            self._profiling.note_step_begin()
         try:
             # "admit" phase is EXCLUSIVE of nested leaf phases: a
             # legacy one-shot prefill runs INSIDE admission, and its
@@ -3121,6 +3169,10 @@ class DecodeEngine:
             if fr is not None and not self._abandoned:
                 fr.note_fault(e)
             raise
+        if self._profiling is not None:
+            # stamp the step's probe onto the open record (and retire
+            # one captured step) BEFORE the record seals
+            self._profiling.note_step_end(fr)
         if fr is not None:
             rec = fr.end_step()
             if self._cost is not None and rec is not None:
@@ -3129,6 +3181,10 @@ class DecodeEngine:
                 # roofline / periodic ledger gauges (the calibration
                 # update site — engine thread, reads the record)
                 self._cost.observe(rec)
+            if self._profiling is not None and rec is not None:
+                # device/host split, measured MFU, and the predicted-
+                # vs-measured drift the mfu_regression rule watches
+                self._profiling.observe(rec)
         if self._alerts is not None:
             # between-steps alert cadence (FLAGS_alert_interval_steps):
             # the engine thread walks the rule table AFTER the step's
@@ -3199,6 +3255,12 @@ class DecodeEngine:
                         jnp.asarray(self._bt), jnp.asarray(self._lens),
                         jnp.asarray(self._last),
                         jnp.asarray(self._active), key)
+                if self._profiling is not None:
+                    # sampled device-sync probe: block on the step's
+                    # output INSIDE the phase (the phase wall absorbs
+                    # the wait) so dispatch-start -> ready is the
+                    # executable's measured device seconds
+                    self._profiling.probe("decode", toks, t0, t0_ns)
             toks = self._host_fetch(toks)
         if self._kv_quant:
             self._note_refolds(int(toks[-1]))

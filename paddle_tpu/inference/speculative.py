@@ -832,6 +832,11 @@ class SpeculativeDecoder:
                         eng._params, eng._k_pages, eng._v_pages,
                         jnp.asarray(eng._bt), jnp.asarray(eng._lens),
                         jnp.asarray(tokens), jnp.asarray(caps), key)
+                if eng._profiling is not None:
+                    # sampled device-sync probe (observability.
+                    # profiling): the verify executable's measured
+                    # device seconds, blocked inside the phase
+                    eng._profiling.probe("verify", targets, t0, tv_ns)
             targets = eng._host_fetch(targets)
         if eng._kv_quant:
             eng._note_refolds(int(targets[slots, 0]))

@@ -67,6 +67,11 @@ paddle_hbm_ledger_unattributed_bytes           gauge      engine
 paddle_capacity_headroom_slots                 gauge      engine
 paddle_alerts_firing                           gauge      engine, rule, severity
 paddle_alert_transitions_total                 counter    rule, state
+paddle_executable_device_seconds               gauge      fn
+paddle_host_overhead_ratio                     gauge      engine
+paddle_phase_mfu_measured                      gauge      phase
+paddle_mfu_drift                               gauge      phase
+paddle_trace_spans_dropped_total               counter    —
 =============================================  =========  ==========
 
 plus the views: ``paddle_decode_*`` (every `decode_stats` key) and
@@ -409,6 +414,57 @@ ALERT_TRANSITIONS = counter(
     "the engine's flight ring and in /alertz's recent-transitions "
     "list",
     labels=("rule", "state"))
+EXEC_DEVICE_SECONDS = gauge(
+    "paddle_executable_device_seconds",
+    "MEASURED device seconds of one step executable's most recent "
+    "probed dispatch (observability.profiling, FLAGS_profile: the "
+    "engine blocks on the executable's output every "
+    "FLAGS_profile_sample_steps-th step and every step of an armed "
+    "capture), by DISPATCHED executable kind (decode | mixed | "
+    "verify — not the flight phase: a chunkless full mixed step "
+    "dispatches the mixed program under the decode phase) — the "
+    "actual-device-time half the cost observatory's static profiles "
+    "predict against",
+    labels=("fn",))
+HOST_OVERHEAD_RATIO = gauge(
+    "paddle_host_overhead_ratio",
+    "Fraction of the most recent PROBED step's wall the probed "
+    "executables were NOT executing (step wall minus measured device "
+    "seconds, over step wall): host dispatch, the emit loop, cache "
+    "bookkeeping — a rising ratio at fixed batch shape means the "
+    "host is starving the device.  Probe coverage is the decode / "
+    "mixed / verify executables: on a speculative engine the "
+    "drafter's propose loop counts on the HOST side of this split",
+    labels=("engine",))
+PHASE_MFU_MEASURED = gauge(
+    "paddle_phase_mfu_measured",
+    "MEASURED model FLOP utilization of the most recent probed step "
+    "per dispatched executable kind (decode | mixed | verify; label "
+    "kept as `phase` beside paddle_phase_mfu): profile FLOPs / "
+    "measured device seconds / peak FLOP/s — the device-time twin of "
+    "the roofline paddle_phase_mfu (which divides by the host-timed "
+    "phase wall)",
+    labels=("phase",))
+MFU_DRIFT = gauge(
+    "paddle_mfu_drift",
+    "Predicted-vs-measured DEVICE-time drift per dispatched "
+    "executable kind (decode | mixed | verify): EWMA of "
+    "|predicted - measured| / measured device seconds, where the "
+    "prediction is the executable's raw roofline seconds times a "
+    "per-phase factor learned from earlier probes (the cost "
+    "observatory's EWMA scheme at device granularity; compile-"
+    "bearing steps never calibrate).  Sustained drift past the 50% "
+    "gate fires the mfu_regression alert rule — the static profiles "
+    "no longer describe what the device actually does (a regime "
+    "change relearns in tens of probes; the fire marks the change)",
+    labels=("phase",))
+TRACE_SPANS_DROPPED = counter(
+    "paddle_trace_spans_dropped_total",
+    "Spans the tracing buffer (observability.tracing) refused past "
+    "its MAX_SPANS cap — previously only visible via "
+    "tracing.dropped_span_count(); a nonzero counter means the "
+    "merged chrome trace (and /tracez) is missing the tail of the "
+    "timeline")
 FLIGHT_DUMPS = counter(
     "paddle_flight_dumps_total",
     "Flight-recorder windows auto-dumped to FLAGS_flight_dir, by "
@@ -467,14 +523,19 @@ registry.register_view(_dispatch_view)
 # ---------------------------------------------------------------------------
 from . import alerts  # noqa: E402,F401
 from . import opsserver  # noqa: E402,F401
+from . import profiling  # noqa: E402,F401
 from .alerts import AlertEngine, AlertRule, default_rules  # noqa: E402,F401
 from .opsserver import (  # noqa: E402,F401
     maybe_start_ops_server, ops_server_port, start_ops_server,
     stop_ops_server,
+)
+from .profiling import (  # noqa: E402,F401
+    capture_status, hot_op_table, request_capture,
 )
 
 __all__ += [
     "alerts", "opsserver", "AlertEngine", "AlertRule", "default_rules",
     "start_ops_server", "stop_ops_server", "ops_server_port",
     "maybe_start_ops_server",
+    "profiling", "request_capture", "capture_status", "hot_op_table",
 ]

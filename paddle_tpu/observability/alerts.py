@@ -212,6 +212,22 @@ def _sig_cost_error_max(eng) -> Optional[float]:
     return max(errs.values())
 
 
+def _sig_mfu_drift_max(eng) -> Optional[float]:
+    """THIS engine's worst predicted-vs-measured MFU drift across
+    device phases — read from its own Profiler table
+    (observability.profiling), not the phase-only ``paddle_mfu_drift``
+    gauge: another engine's drift must not fire this one's alert.
+    None (no evidence) while the profiling plane is disarmed or no
+    probe has scored yet."""
+    prof = getattr(eng, "_profiling", None)
+    if prof is None:
+        return None
+    drifts = prof.drift_table()
+    if not drifts:
+        return None  # no probed step scored yet: no evidence
+    return max(drifts.values())
+
+
 def _sig_journal_bytes(eng) -> Optional[float]:
     if eng._durability is None or not eng._journal_dir:
         return None
@@ -229,6 +245,7 @@ SIGNALS = {
     "pool_reclaimable_frac": _sig_pool_reclaimable_frac,
     "hbm_unattributed_ratio": _sig_hbm_unattributed_ratio,
     "cost_error_max": _sig_cost_error_max,
+    "mfu_drift_max": _sig_mfu_drift_max,
     "journal_bytes": _sig_journal_bytes,
 }
 
@@ -286,6 +303,17 @@ def default_rules(window_scale: float = 1.0) -> Tuple[AlertRule, ...]:
                         "calibration gate for any executable kind — "
                         "headroom and admission numbers are no longer "
                         "trustworthy"),
+        AlertRule(
+            "mfu_regression", signal="mfu_drift_max",
+            severity="ticket", threshold=0.5, op=">",
+            for_s=30.0 * s, resolve_after_s=30.0 * s,
+            description="predicted-vs-measured device-time drift "
+                        "(paddle_mfu_drift) above the 50% gate for "
+                        "any device phase: measured device seconds "
+                        "ran far from the profile-based prediction "
+                        "learned from earlier probes — the device "
+                        "slowed, or the static profiles went stale "
+                        "for this hardware"),
         AlertRule(
             "journal_growth", signal="journal_bytes",
             severity="ticket", threshold=256.0 * 1024 * 1024, op=">",

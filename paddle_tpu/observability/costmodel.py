@@ -88,7 +88,8 @@ from ..analysis.sanitizer import TrackedLock as _TrackedLock
 
 __all__ = ["CostProfile", "CostModel", "enabled", "note_executable",
            "profile_signature", "analytical_gpt_cost", "profiles",
-           "clear_profiles", "resolve_peaks", "LEDGER_CATEGORIES"]
+           "profile_by_key", "clear_profiles", "resolve_peaks",
+           "LEDGER_CATEGORIES"]
 
 # THE cost-observatory lock: the process-global profile table and every
 # CostModel's calibration/error tables mutate under it (statusz reads
@@ -202,18 +203,23 @@ class CostProfile:
     time (or derived analytically): total FLOPs, total HBM bytes
     accessed (reads + writes as XLA's HLO cost analysis counts them),
     and — when ``FLAGS_cost_memory_analysis`` armed the extra compile —
-    the executable's peak temp-buffer allocation."""
+    the executable's peak temp-buffer allocation.  When the profiling
+    plane (observability.profiling) is armed, ``hot_ops`` carries the
+    top-K per-op FLOP/byte rows from the same traced computation — the
+    table the vision/fusion work ranks candidates from."""
 
     site: str            # the _JitTracker site label (human-readable)
     flops: float
     bytes_accessed: float
     temp_bytes: float = 0.0
     source: str = "hlo"  # "hlo" | "analytical"
+    hot_ops: tuple = ()  # profiling.hot_op_table rows (top-K per op)
 
     def to_obj(self) -> dict:
         return {"site": self.site, "flops": self.flops,
                 "bytes_accessed": self.bytes_accessed,
-                "temp_bytes": self.temp_bytes, "source": self.source}
+                "temp_bytes": self.temp_bytes, "source": self.source,
+                "hot_ops": [dict(r) for r in self.hot_ops]}
 
 
 def profile_signature(site: str, args) -> tuple:
@@ -272,6 +278,20 @@ def _extract_cost_analysis(fn, args) -> Optional[dict]:
     return out
 
 
+def _hot_ops(fn, args) -> tuple:
+    """The profiling plane's per-op table for this executable — same
+    traced computation, no second compile; empty when the plane is
+    disarmed (`FLAGS_profile`) or the walk fails."""
+    from . import profiling
+
+    if not profiling.enabled():
+        return ()
+    try:
+        return profiling.hot_op_table(fn, args)
+    except Exception:
+        return ()
+
+
 def note_executable(site: str, fn, args) -> Optional[tuple]:
     """`_JitTracker` chokepoint hook: called once per tracker on its
     FIRST invocation (compile time — the call that follows pays the
@@ -281,8 +301,17 @@ def note_executable(site: str, fn, args) -> Optional[tuple]:
     is never fatal — the engine falls back to the analytical formula."""
     key = profile_signature(site, args)
     with _lock:
-        if key in _PROFILES:
-            return key
+        existing = _PROFILES.get(key)
+    if existing is not None:
+        if not existing.hot_ops:
+            # a profile cached by an earlier profiling-off engine:
+            # backfill the hot-op table now that the plane wants it
+            # (the signature proves the traced computation matches)
+            hot = _hot_ops(fn, args)
+            if hot:
+                with _lock:
+                    existing.hot_ops = hot
+        return key
     try:
         ca = _extract_cost_analysis(fn, args)
     except Exception:
@@ -292,13 +321,24 @@ def note_executable(site: str, fn, args) -> Optional[tuple]:
     prof = CostProfile(site=site, flops=ca["flops"],
                        bytes_accessed=ca["bytes_accessed"],
                        temp_bytes=ca.get("temp_bytes", 0.0),
-                       source="hlo")
+                       source="hlo", hot_ops=_hot_ops(fn, args))
     with _lock:
         _PROFILES[key] = prof
     from ..inference.serving import _stats_add
 
     _stats_add(cost_profiles=1)
     return key
+
+
+def profile_by_key(key: tuple) -> Optional[CostProfile]:
+    """Exact profile lookup by signature key (a tracker's
+    ``cost_sig``) — the per-engine view `Profiler.statusz` renders its
+    hot-op tables from: the site-keyed `profiles()` view is
+    last-writer-wins across every engine in the process, so two
+    engines sharing a site label at different shapes would shadow
+    each other there."""
+    with _lock:
+        return _PROFILES.get(key)
 
 
 def profiles() -> Dict[str, dict]:

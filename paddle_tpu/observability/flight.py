@@ -249,6 +249,18 @@ class FlightRecorder:
             return
         cur["cost"] = dict(info)
 
+    def note_probe(self, info: dict):
+        """Profiling-plane stamp (observability.profiling): the step's
+        per-executable measured device seconds land on the open
+        record; `end_step` completes the device/host split with the
+        measured wall so every probed record carries the attribution
+        pair (tools/explain_request.py renders the dev=/host=
+        column)."""
+        cur = self._cur  # open record: engine-thread-private, no lock
+        if cur is None:
+            return
+        cur["probe"] = dict(info)
+
     def note_emit(self, request_id: int, n: int):
         """`DecodeEngine._emit` chokepoint: ``n`` tokens landed on one
         request this step."""
@@ -358,6 +370,15 @@ class FlightRecorder:
                 # pair BEFORE the record seals (after the push the
                 # record is immutable and may serialize concurrently)
                 rec["cost"]["actual_s"] = rec["dur_s"]
+            if "probe" in rec:
+                # complete the profiling plane's device/host split the
+                # same way: device seconds were measured at the
+                # dispatch sites, the host residue needs the wall
+                pr = rec["probe"]
+                pr["device_s"] = round(
+                    sum(pr.get("device", {}).values()), 9)
+                pr["host_s"] = round(
+                    max(rec["dur_s"] - pr["device_s"], 0.0), 9)
             rec["queued"] = len(eng._queue)
             rec["pool"] = pool_stats
             if burns:

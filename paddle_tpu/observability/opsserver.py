@@ -32,6 +32,12 @@ endpoint   serves
            BEFORE the frontend abandons — stop routing first, rebuild
            second)
 /alertz    alert states + recent transitions (`AlertEngine.snapshot`)
+/profilez  the profiling plane (`Profiler.statusz`): capture status,
+           per-executable measured device time, hot-op top-K
+           (404 while ``FLAGS_profile`` is off)
+/tracez    the merged chrome trace (`merged_chrome_trace`), bounded —
+           ``?n=<events>`` caps the non-metadata events (newest kept;
+           default 20000) — plus the dropped-span count
 ========== ==============================================================
 
 The server is a stdlib `ThreadingHTTPServer` on a daemon thread,
@@ -287,7 +293,8 @@ class _OpsHandler(BaseHTTPRequestHandler):
                 self._send_json(
                     {"error": f"unknown endpoint {url.path!r}",
                      "endpoints": ["/metrics", "/statusz", "/flightz",
-                                   "/healthz", "/readyz", "/alertz"]},
+                                   "/healthz", "/readyz", "/alertz",
+                                   "/profilez", "/tracez"]},
                     code=404)
                 return
             route(query)
@@ -362,6 +369,45 @@ class _OpsHandler(BaseHTTPRequestHandler):
     def _route_readyz(self, query):
         ready = readiness()
         self._send_json(ready, code=200 if ready["ready"] else 503)
+
+    def _route_profilez(self, query):
+        eng, err = _pick_engine(query)
+        if eng is None:
+            self._send_json(err, code=404)
+            return
+        prof = getattr(eng, "_profiling", None)
+        if prof is None:
+            self._send_json({"error": "profiling plane disabled "
+                                      "(FLAGS_profile=0)"},
+                            code=404)
+            return
+        self._send_json(prof.statusz())
+
+    def _route_tracez(self, query):
+        # bounded by construction: a long-lived serve can hold up to
+        # MAX_SPANS spans — a poller asking for "the trace" must not
+        # receive hundreds of MB.  Metadata (process_name) events are
+        # always kept so the surviving spans stay labeled.
+        n = query.get("n", [None])[0]
+        cap = int(n) if n else 20000
+        data = _obs().merged_chrome_trace()
+        events = data.get("traceEvents", [])
+        meta = [e for e in events if e.get("ph") == "M"]
+        rest = [e for e in events if e.get("ph") != "M"]
+        clipped = max(len(rest) - max(cap, 0), 0)
+        if clipped:
+            # "newest kept" means newest by TIMESTAMP: the merged
+            # trace concatenates whole tracks (host first), so a
+            # positional tail would drop the entire host track before
+            # a single stale span
+            rest.sort(key=lambda e: e.get("ts", 0.0))
+            rest = rest[-cap:] if cap > 0 else []
+        self._send_json({
+            "traceEvents": meta + rest,
+            "total_events": len(events),
+            "clipped_events": clipped,
+            "dropped_spans": _obs().dropped_span_count(),
+        })
 
     def _route_alertz(self, query):
         out = {}

@@ -45,6 +45,20 @@ _dropped = [0]
 
 now_ns = native.now_ns  # one clock for spans AND host events
 
+_obs_mod = None
+
+
+def _obs():
+    # the catalog module, resolved lazily (the flight.py pattern):
+    # tracing is imported while observability/__init__ is still
+    # building the catalog, so the counter must bind at runtime
+    global _obs_mod
+    if _obs_mod is None:
+        from paddle_tpu import observability
+
+        _obs_mod = observability
+    return _obs_mod
+
 
 def record_span(track: str, name: str, start_ns: int, dur_ns: int,
                 tid: int = 0, args: Optional[dict] = None):
@@ -55,9 +69,16 @@ def record_span(track: str, name: str, start_ns: int, dur_ns: int,
     with _lock:
         if len(_spans) >= MAX_SPANS:
             _dropped[0] += 1
-            return
-        _spans.append((track, name, int(start_ns), int(dur_ns),
-                       int(tid), args))
+            dropped = True
+        else:
+            _spans.append((track, name, int(start_ns), int(dur_ns),
+                           int(tid), args))
+            dropped = False
+    if dropped:
+        # the previously-silent overflow, surfaced as a first-class
+        # counter (metric update OUTSIDE the lock, per the module's
+        # lock discipline)
+        _obs().TRACE_SPANS_DROPPED.inc()
 
 
 class span:

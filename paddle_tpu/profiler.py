@@ -97,6 +97,10 @@ DECODE_STAT_COUNTERS = (
     # profiles extracted at executable compile time, and calibration
     # updates scored against the flight recorder's measured steps
     "cost_profiles", "cost_updates",
+    # profiling plane (observability.profiling): steps whose device
+    # dispatches were sync-probed (FLAGS_profile_sample_steps cadence
+    # or an armed capture), and bounded capture sessions completed
+    "profile_probes", "profile_captures",
 )
 DECODE_STAT_DERIVED = ("avg_step_ms", "batch_occupancy",
                        "kv_block_utilization",

@@ -651,6 +651,71 @@ class TestEngineMutationLint:
         assert len(found) == 2, [f.message for f in found]
         assert all("bad_transition" in f.message for f in found)
 
+    def test_rogue_profiler_mutation_flags(self, tmp_path):
+        """The REPO rule sanctions the profiling plane's engine READS
+        only inside `Profiler` in observability/profiling.py: a rogue
+        profiler that mutates the engine from its hooks — the
+        tempting bug being 'just preempt the slot whose dispatch
+        keeps blocking longest' — must flag."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        mods = _scan_snippet(tmp_path, """
+            class RogueProfiler:
+                def observe(self, rec):
+                    self.engine.preempt(self.slowest)
+                    self.engine._chunk_budget = 1
+
+                def throttle(self, engine):
+                    engine.evict(0)
+        """, name="rogue_profiler.py")
+        found = EngineMutationPass(REPO_ENGINE_RULE).run(mods)
+        msgs = sorted(f.message for f in found)
+        assert len(found) == 3, msgs
+        assert any(".preempt()" in m for m in msgs)
+        assert any(".evict()" in m for m in msgs)
+        assert any("attribute store" in m for m in msgs)
+        assert all("RogueProfiler" in m for m in msgs)
+
+    def test_repo_rule_sanctions_profiler_reads(self, tmp_path):
+        """The sanctioned twin: the same shapes inside `Profiler` in
+        observability/profiling.py scan clean — the spec encodes 'the
+        profiler may read (and block on) engine state from inside the
+        step, and the capture-arming site runs between steps'."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        (tmp_path / "observability").mkdir()
+        mods = _scan_snippet(tmp_path, """
+            class Profiler:
+                def observe(self, rec):
+                    self.engine.preempt(self.slowest)
+                    self.engine._chunk_budget = 1
+        """, name="observability/profiling.py")
+        assert EngineMutationPass(REPO_ENGINE_RULE).run(mods) == []
+
+    def test_profiling_lock_discipline_enforced(self, tmp_path):
+        """The profiling plane's capture state and device-time table
+        are in the lock-discipline spec: unguarded mutations in a
+        module named like profiling.py flag, the locked forms scan
+        clean."""
+        from paddle_tpu.analysis import REPO_LOCK_RULES
+        from paddle_tpu.analysis.passes import LockDisciplinePass
+
+        (tmp_path / "observability").mkdir()
+        mods = _scan_snippet(tmp_path, """
+            class Profiler:
+                def bad_arm(self, dev, mfu):
+                    self._device_s["decode"] = dev
+                    self._mfu.update(mfu)
+
+                def good_arm(self, dev, mfu):
+                    with _lock:
+                        self._device_s["decode"] = dev
+                        self._mfu.update(mfu)
+        """, name="observability/profiling.py")
+        found = LockDisciplinePass(REPO_LOCK_RULES).run(mods)
+        assert len(found) == 2, [f.message for f in found]
+        assert all("bad_arm" in f.message for f in found)
+
     def test_opsserver_lock_discipline_enforced(self, tmp_path):
         """The ops registry (engines/frontends/server handle) is in
         the lock-discipline spec: unguarded registration in a module

@@ -696,6 +696,74 @@ def test_bench_cost_smoke(tmp_path):
     assert led["gauge_series"] >= len(led["categories"])
 
 
+@pytest.mark.slow
+def test_bench_profiling_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_profiling.py runs end-to-end: the
+    profiling-plane bench can't rot.  Asserts the ISSUE-15 acceptance
+    bar at smoke scale: probe-on serving bit-exact with zero new
+    executables and the profiler absent when off, hot-op tables
+    extracted, and a capture session completing with its probe spans
+    on the device trace track (the overhead / attribution / drift
+    RATIOS are gated at full scale only — smoke steps are
+    sub-millisecond and timer-noise dominated)."""
+    out = str(tmp_path / "bench_profiling.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_profiling.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    s = data["summary"]
+    assert s["parity_profile_on"] is True
+    assert s["zero_new_executables"] is True
+    assert s["off_profiler_absent"] is True
+    assert s["hot_ops_extracted"] is True
+    assert s["capture_completed"] is True
+    assert s["device_spans_cover_capture"] is True
+    att = data["legs"]["attribution"]
+    assert att["probed_records"] >= 1
+    assert att["max_mfu_drift"] is not None
+    cap = data["legs"]["capture"]
+    assert cap["device_track_present"] is True
+    assert cap["device_spans"] >= cap["requested_steps"]
+
+
+def test_bench_trajectory_smoke(tmp_path):
+    """tools/bench_trajectory.py over the repo's real bench artifacts:
+    the aggregate parses, covers every BENCH_*.json (the repo ships
+    9+), carries a machine stamp, and each entry exposes a headline
+    dict of scalars.  jax-free and sub-second — rides tier-1."""
+    out = str(tmp_path / "BENCH_trajectory.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_trajectory.py", "--root", REPO,
+         "--out", out],
+        cwd=REPO, capture_output=True, text=True, env=ENV, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["trajectory"] == 1
+    assert data["count"] >= 9
+    assert data["count"] == len(data["benches"])
+    assert "trajectory" not in data["benches"]  # never self-aggregates
+    m = data["machine"]
+    assert m["platform"] and m["python"] and m["cpu_count"] >= 1
+    assert data["generated_unix"] > 0
+    for key, entry in data["benches"].items():
+        assert entry["file"] == f"BENCH_{key}.json"
+        assert isinstance(entry["headline"], dict)
+        for v in entry["headline"].values():
+            assert isinstance(v, (int, float, bool, str))
+    # the serving benches' summary scalars surface as headlines
+    assert "median_error" in data["benches"]["cost"]["headline"]
+    assert data["skipped"] == []
+    # the shipped aggregate stays fresh: same bench set as a rebuild
+    with open(os.path.join(REPO, "BENCH_trajectory.json")) as f:
+        shipped = json.load(f)
+    assert set(shipped["benches"]) == set(data["benches"])
+
+
 def test_tracecheck_smoke(tmp_path):
     """tools/tracecheck.py end-to-end: the serving-stack targets scan
     CLEAN against the shipped (empty) baseline — the ISSUE-8

@@ -1,0 +1,361 @@
+"""Profiling-plane benchmark: probe overhead, device-time attribution,
+and capture sessions.
+
+Three legs (the ISSUE-15 acceptance bar):
+
+* **overhead** — an identical decode workload served with the
+  profiling plane ON (default ``FLAGS_profile_sample_steps`` cadence)
+  vs OFF: outputs must be bit-exact with zero new executables and 0
+  warm retraces (a probe BLOCKS, it never changes numerics or
+  compiles), and the per-step wall overhead <= ``--overhead-bound``
+  (2% by default; full scale only), on the smaller of the interleaved
+  differential and the direct probe-time accounting
+  (``Profiler.probe_seconds``) — the bench_flight/bench_cost
+  methodology.
+
+* **attribution** — the same workload probed EVERY step
+  (``profile_sample_steps=1``): after warmup, each probed flight
+  record's measured device seconds plus its host-phase walls (admit /
+  draft / emit / fetch / cache) must sum to the step wall within
+  ``--attribution-bound`` (10%), and the median predicted-vs-measured
+  MFU drift must stay under ``--drift-bound`` (the 50% gate the
+  ``mfu_regression`` alert rule documents).
+
+* **capture** — ``profiling.request_capture(steps=N)`` mid-serve: the
+  session arms at the next step boundary, probes exactly N served
+  steps, and its probe spans land on the ``device`` track of the
+  merged chrome trace.
+
+Emits BENCH_profiling.json.
+
+Usage:
+    python tools/bench_profiling.py [--out BENCH_profiling.json]
+                                    [--smoke] [--overhead-bound 0.02]
+                                    [--attribution-bound 0.10]
+                                    [--drift-bound 0.5]
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models.gpt import GPT, GPTConfig  # noqa: E402
+
+# host phases (everything the flight recorder times that is NOT a
+# device dispatch): the attribution leg sums these beside the probe's
+# measured device seconds
+_HOST_PHASES = ("admit", "draft", "emit", "fetch", "cache")
+
+
+def _build_model(args):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.prompt + args.new + 64,
+                    use_parallel_layers=False, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    return model
+
+
+def _engine(model, args, **kw):
+    from paddle_tpu.inference.serving import DecodeEngine
+
+    kw.setdefault("flight_window", 4096)  # keep every record
+    return DecodeEngine(model, max_batch_size=args.slots,
+                        max_seq_len=args.prompt + args.new + 8,
+                        page_size=args.page_size,
+                        prefill_chunk_tokens=args.chunk, **kw)
+
+
+def _prompts(args, seed=1):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(4, args.vocab, (args.prompt,)).astype(np.int32)
+            for _ in range(args.requests)]
+
+
+# ---------------------------------------------------------------------------
+# leg 1: overhead — sampled probing on vs off, bit-exact + bounded
+# ---------------------------------------------------------------------------
+def _overhead_leg(model, args):
+    from paddle_tpu.inference.serving import decode_stats, \
+        reset_decode_stats
+
+    prompts = _prompts(args)
+
+    def mk(profile):
+        kw = {"profile": profile}
+        if profile:
+            kw["profile_sample_steps"] = args.sample_steps
+        eng = _engine(model, args, **kw)
+        eng.generate([prompts[0]], max_new_tokens=2)  # warm
+        return eng
+
+    def serve(eng):
+        reqs = [eng.add_request(p, max_new_tokens=args.new)
+                for p in prompts]
+        reset_decode_stats()
+        t0 = time.perf_counter()
+        eng.run()
+        wall = time.perf_counter() - t0
+        st = decode_stats(reset=True)
+        assert st["retraces_after_warmup"] == 0
+        return [list(r.generated_ids) for r in reqs], \
+            wall / max(st["steps"], 1), st["steps"], st
+
+    eng_off = mk(False)
+    eng_on = mk(True)
+    t_off = t_on = None
+    outs_off = outs_on = None
+    steps_on = 0
+    st_off = st_on = None
+    for _ in range(args.reps):
+        outs_off, dt, _, st_off = serve(eng_off)
+        t_off = dt if t_off is None else min(t_off, dt)
+        outs_on, dt, n, st_on = serve(eng_on)
+        t_on = dt if t_on is None else min(t_on, dt)
+        steps_on += n
+    same_execs = all(
+        st_on[k] == st_off[k]
+        for k in ("decode_compiles", "mixed_compiles",
+                  "prefill_compiles"))
+    # direct accounting: the blocking time the probes actually spent
+    # (everything else on the armed path is a modulo + dict stores)
+    probe_us = eng_on._profiling.probe_seconds / max(steps_on, 1) * 1e6
+    diff_frac = t_on / t_off - 1.0
+    acct_frac = probe_us * 1e-6 / t_on
+    return {
+        "parity": outs_on == outs_off,
+        "zero_new_executables": same_execs,
+        "off_profiler_absent": eng_off._profiling is None,
+        "sample_steps": args.sample_steps,
+        "probes": eng_on._profiling.probes,
+        "step_ms_profile_off": round(t_off * 1e3, 4),
+        "step_ms_profile_on": round(t_on * 1e3, 4),
+        "overhead_frac": round(diff_frac, 4),
+        "probe_us_per_step": round(probe_us, 2),
+        "accounted_frac": round(acct_frac, 6),
+        "gated_frac": round(min(diff_frac, acct_frac), 6),
+        "reps": args.reps,
+    }
+
+
+# ---------------------------------------------------------------------------
+# leg 2: attribution — device + host sums to the step wall
+# ---------------------------------------------------------------------------
+def _attribution_leg(model, args):
+    from paddle_tpu import observability as obs
+
+    eng = _engine(model, args, profile=True, profile_sample_steps=1)
+    eng.generate(_prompts(args, seed=2), max_new_tokens=args.new)
+    recs = [r for r in eng._flight.records()
+            if r.get("kind") == "step" and r.get("probe")]
+    # warmup steps compiled (their walls include XLA); judge the tail
+    warm = recs[len(recs) // 4:] if len(recs) >= 8 else recs
+    gaps = []
+    ratios = []
+    for r in warm:
+        wall = r["dur_s"]
+        dev = r["probe"]["device_s"]
+        host = sum(r["phases"].get(p, 0.0) for p in _HOST_PHASES)
+        if wall <= 0:
+            continue
+        gaps.append(abs(dev + host - wall) / wall)
+        ratios.append(r["probe"]["host_s"] / wall)
+    drift = eng._profiling.drift_table()
+    z = eng._profiling.statusz()
+    hot = z["hot_ops"]
+    top_ops = {site: rows[0]["op"] for site, rows in hot.items()
+               if rows}
+    return {
+        "probed_records": len(recs),
+        "judged_records": len(gaps),
+        "median_attribution_gap": round(statistics.median(gaps), 4)
+        if gaps else None,
+        "p90_attribution_gap": round(
+            sorted(gaps)[int(0.9 * len(gaps))], 4) if gaps else None,
+        "median_host_overhead_ratio": round(
+            statistics.median(ratios), 4) if ratios else None,
+        "mfu_drift": {k: round(v, 4) for k, v in sorted(drift.items())},
+        "max_mfu_drift": round(max(drift.values()), 4) if drift
+        else None,
+        "mfu_measured": {k: round(v, 6)
+                         for k, v in sorted(z["mfu_measured"].items())},
+        "mfu_roofline_gauges": {
+            p: round(obs.PHASE_MFU.value(phase=p), 6)
+            for p in ("decode", "mixed")},
+        "device_seconds": z["device_seconds"],
+        "hot_op_sites": len(hot),
+        "top_op_by_site": top_ops,
+        "dot_general_ranked_first": all(
+            op == "dot_general" for op in top_ops.values())
+        if top_ops else False,
+    }, eng
+
+
+# ---------------------------------------------------------------------------
+# leg 3: capture session — bounded, device track in the merged trace
+# ---------------------------------------------------------------------------
+def _capture_leg(model, args, eng):
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import profiling
+
+    obs.clear_spans()
+    st0 = profiling.request_capture(args.capture_steps, engine=eng)
+    eng.generate(_prompts(args, seed=3), max_new_tokens=args.new)
+    status = eng._profiling.capture_status()
+    trace = obs.merged_chrome_trace()
+    tracks = {e["args"]["name"]: e["pid"] for e in trace["traceEvents"]
+              if e.get("ph") == "M" and e.get("name") == "process_name"}
+    dev_pid = tracks.get("device")
+    dev_spans = [e for e in trace["traceEvents"]
+                 if e.get("ph") == "X" and e.get("pid") == dev_pid]
+    return {
+        "requested_steps": args.capture_steps,
+        "armed_status": st0,
+        "final_status": status,
+        "captured_steps": status["captured_steps"],
+        "capture_completed": status["captures_completed"] >= 1,
+        "device_track_present": dev_pid is not None,
+        "device_spans": len(dev_spans),
+        "device_spans_cover_capture":
+            len(dev_spans) >= args.capture_steps,
+        "span_names": sorted({e["name"] for e in dev_spans}),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_profiling.json"))
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=4)
+    # DEVICE-DOMINATED, production-like steps (ctx-512 at a deeper
+    # model than the other serving benches): the attribution gate
+    # compares measured device time + host-phase walls against the
+    # step wall, and on CPU the engine's fixed per-step accounting
+    # (~0.5ms of gauges/burn/admission outside any phase) must be
+    # small relative to the device half for the comparison to say
+    # anything — ~13ms steps put it at ~5%, inside the 10% gate
+    ap.add_argument("--prompt", type=int, default=512)
+    ap.add_argument("--new", type=int, default=32)
+    ap.add_argument("--chunk", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--page-size", type=int, default=32)
+    ap.add_argument("--reps", type=int, default=4)
+    ap.add_argument("--sample-steps", type=int, default=64)
+    ap.add_argument("--capture-steps", type=int, default=6)
+    ap.add_argument("--overhead-bound", type=float, default=0.02)
+    ap.add_argument("--attribution-bound", type=float, default=0.10)
+    ap.add_argument("--drift-bound", type=float, default=0.5)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if os.environ.get("BENCH_SMOKE") == "1":
+        args.smoke = True
+    if args.smoke:
+        args.requests, args.prompt, args.new = 2, 48, 12
+        args.hidden, args.vocab, args.slots = 128, 128, 2
+        args.reps, args.capture_steps = 2, 3
+
+    import jax
+
+    from paddle_tpu import observability
+
+    observability.reset()
+    observability.clear_spans()
+    model = _build_model(args)
+
+    legs = {}
+    legs["overhead"] = _overhead_leg(model, args)
+    print(f"overhead: off {legs['overhead']['step_ms_profile_off']}ms "
+          f"on {legs['overhead']['step_ms_profile_on']}ms "
+          f"(diff {legs['overhead']['overhead_frac'] * 100:+.2f}%, "
+          f"accounted {legs['overhead']['probe_us_per_step']}us = "
+          f"+{legs['overhead']['accounted_frac'] * 100:.3f}%) parity "
+          f"{legs['overhead']['parity']}")
+    legs["attribution"], eng = _attribution_leg(model, args)
+    print(f"attribution: {legs['attribution']['judged_records']} "
+          f"records, median gap "
+          f"{legs['attribution']['median_attribution_gap']}, host "
+          f"ratio {legs['attribution']['median_host_overhead_ratio']}, "
+          f"max drift {legs['attribution']['max_mfu_drift']}")
+    legs["capture"] = _capture_leg(model, args, eng)
+    print(f"capture: {legs['capture']['captured_steps']} steps, "
+          f"{legs['capture']['device_spans']} device spans "
+          f"({legs['capture']['span_names']})")
+
+    att = legs["attribution"]
+    summary = {
+        "parity_profile_on": legs["overhead"]["parity"],
+        "zero_new_executables":
+            legs["overhead"]["zero_new_executables"],
+        "off_profiler_absent": legs["overhead"]["off_profiler_absent"],
+        "overhead_frac": legs["overhead"]["overhead_frac"],
+        "accounted_frac": legs["overhead"]["accounted_frac"],
+        "gated_frac": legs["overhead"]["gated_frac"],
+        "overhead_bound": args.overhead_bound,
+        "median_attribution_gap": att["median_attribution_gap"],
+        "attribution_bound": args.attribution_bound,
+        "attribution_within_bound":
+            att["median_attribution_gap"] is not None and
+            att["median_attribution_gap"] <= args.attribution_bound,
+        "max_mfu_drift": att["max_mfu_drift"],
+        "drift_bound": args.drift_bound,
+        "drift_within_bound": att["max_mfu_drift"] is not None and
+        att["max_mfu_drift"] <= args.drift_bound,
+        "hot_ops_extracted": att["hot_op_sites"] > 0,
+        "dot_general_ranked_first": att["dot_general_ranked_first"],
+        "capture_completed": legs["capture"]["capture_completed"],
+        "device_spans_cover_capture":
+            legs["capture"]["device_spans_cover_capture"],
+    }
+    out = {
+        "bench": "profiling plane: probe overhead, device-time "
+                 "attribution, capture sessions",
+        "device": str(jax.devices()[0].device_kind)
+        if jax.devices() else "unknown",
+        "smoke": bool(args.smoke),
+        "config": {k: getattr(args, k) for k in
+                   ("slots", "requests", "prompt", "new", "chunk",
+                    "layers", "hidden", "heads", "vocab", "page_size",
+                    "reps", "sample_steps", "capture_steps",
+                    "overhead_bound", "attribution_bound",
+                    "drift_bound")},
+        "legs": legs,
+        "summary": summary,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {args.out} "
+          f"(overhead={summary['gated_frac'] * 100:+.3f}%, "
+          f"attribution_gap={summary['median_attribution_gap']}, "
+          f"drift={summary['max_mfu_drift']})")
+    ok = all(summary[k] for k in
+             ("parity_profile_on", "zero_new_executables",
+              "off_profiler_absent", "hot_ops_extracted",
+              "capture_completed", "device_spans_cover_capture"))
+    if not args.smoke:
+        # the ratio gates hold at full scale only: smoke steps are
+        # sub-millisecond, where CPU timer noise dwarfs both the probe
+        # cost and the attribution residue
+        ok = ok and \
+            summary["gated_frac"] <= args.overhead_bound and \
+            summary["attribution_within_bound"] and \
+            summary["drift_within_bound"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,9 @@ reconstructs a single request's timeline step by step:
   (``pred=X/act=Yms``) when the window carries cost records
   (FLAGS_cost_model — a step whose actual ran far past its prediction
   is where to start digging);
+* the profiling plane's measured device/host split (``dev=X/host=Yms``)
+  when the window carries probe records (FLAGS_profile — a step whose
+  host half dominates is dispatch-bound, not device-bound);
 * its SLO burn as it evolved (budget consumed vs slo_ttft_ms /
   slo_tpot_ms / deadline_ms);
 * every ladder event that touched it or its engine — retry, degrade,
@@ -120,6 +123,14 @@ def explain(window: dict, request_id: int,
             parts.append(
                 f"pred={cost.get('predicted_s', 0) * 1e3:.2f}"
                 f"/act={cost['actual_s'] * 1e3:.2f}ms")
+        probe = rec.get("probe")
+        if probe and probe.get("device_s") is not None and \
+                (slot_entry is not None or emitted):
+            # the profiling plane's measured split (same pattern as
+            # the pred=/act= column): device-executing vs host wall
+            parts.append(
+                f"dev={probe['device_s'] * 1e3:.2f}"
+                f"/host={probe.get('host_s', 0) * 1e3:.2f}ms")
         line = " ".join(parts)
         if slot_entry is not None or emitted:
             line += _fmt_phases(rec.get("phases", {}))

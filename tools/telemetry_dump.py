@@ -20,7 +20,12 @@ a tiny GPT, serve a couple of requests through the paged decode engine
   (`observability.costmodel`): static FLOP/byte profiles per
   executable, the calibrated step-cost predictor's factors and error,
   the HBM ledger breakdown, and the roofline peaks/headroom — the
-  same dict `DecodeEngine.statusz()["cost"]` serves live.
+  same dict `DecodeEngine.statusz()["cost"]` serves live;
+* ``telemetry_profile.json`` — the profiling plane
+  (`observability.profiling`, when FLAGS_profile armed the engine):
+  capture status, per-executable measured device time, measured
+  MFU/drift, and the hot-op top-K — the same dict the ``/profilez``
+  ops endpoint serves.
 
 CI smokes this end-to-end (tests/test_tooling.py): every export format
 must parse and the core request-latency series must be present after a
@@ -94,6 +99,19 @@ def dump_from_url(url: str, outdir: str, engine=None) -> int:
         # disabled on the remote engine (404); a dead server or any
         # other error must fail the pull, not silently drop the
         # crash-post-mortem artifact
+        if e.code != 404:
+            raise
+    try:
+        prof = get("/profilez")
+        json.loads(prof)
+        with open(os.path.join(outdir, "telemetry_profile.json"),
+                  "w") as f:
+            f.write(prof)
+        wrote.append("telemetry_profile.json")
+    except HTTPError as e:
+        # same contract as /flightz: 404 = profiling plane disarmed
+        # (FLAGS_profile=0) — the one documented absence; anything
+        # else fails the pull
         if e.code != 404:
             raise
     for name in wrote:
@@ -173,6 +191,7 @@ def main():
     statusz_path = os.path.join(args.outdir, "telemetry_statusz.json")
     statusz_txt = os.path.join(args.outdir, "telemetry_statusz.txt")
     cost_path = os.path.join(args.outdir, "telemetry_cost.json")
+    profile_path = os.path.join(args.outdir, "telemetry_profile.json")
 
     with open(prom_path, "w") as f:
         f.write(observability.prometheus_text())
@@ -195,6 +214,9 @@ def main():
     if eng._cost is not None:
         with open(cost_path, "w") as f:
             json.dump(eng._cost.statusz(), f, indent=2)
+    if eng._profiling is not None:
+        with open(profile_path, "w") as f:
+            json.dump(eng._profiling.statusz(), f, indent=2)
 
     tracks = sorted(e["args"]["name"] for e in trace["traceEvents"]
                     if e.get("ph") == "M" and e.get("name") == "process_name")
@@ -208,6 +230,8 @@ def main():
     print(f"wrote {statusz_txt}")
     if eng._cost is not None:
         print(f"wrote {cost_path}")
+    if eng._profiling is not None:
+        print(f"wrote {profile_path}")
     return 0
 
 
