@@ -158,7 +158,8 @@ REPO_ENGINE_RULE = EngineRule(
         "_prefill_into", "_cancel_queued", "_cancel_running",
         "_retire_queued", "_grow_block_tables", "_mixed_step",
         "_stamp_admit", "_stamp_first_token", "_on_first_token",
-        "_register_prompt_pages", "_debug_check_pool",
+        "_register_prompt_pages", "_register_generated_pages",
+        "_debug_check_pool",
         # fault containment / recovery (inference.resilience): the
         # ladder's retry unit, slot quarantine, and admission unwind
         # mutate the engine — callable only from sanctioned sites

@@ -195,6 +195,43 @@ define_flag("spec_drafter", "prompt_lookup",
             "see inference.speculative.PromptLookupDrafter).  A draft-"
             "model drafter must be passed as an instance (it needs the "
             "draft GPT's weights)")
+define_flag("ragged_step", False,
+            "unified ragged serving step (inference.serving."
+            "DecodeEngine): decode, mixed prefill+decode, and "
+            "speculative-verify traffic all dispatch ONE step "
+            "executable whose rows each carry their own query span "
+            "(decode=1, prefill chunk=C, verify window=K+1) instead "
+            "of three phase-split executables per KV mode.  Greedy "
+            "tokens are bit-identical to the split path (the off "
+            "path compiles the exact same executables as before and "
+            "stays the parity oracle).  Engines constructed with an "
+            "explicit ragged_step ignore the flag")
+define_flag("spec_adaptive_k", False,
+            "adaptive per-slot speculation depth (inference."
+            "speculative.SpeculativeDecoder): each slot's draft "
+            "length starts at the configured spec_decode_k, halves "
+            "toward spec_k_min after spec_k_shrink_streak fully-"
+            "rejected rounds, and grows back one step after "
+            "spec_k_grow_streak fully-accepted rounds (growth is "
+            "additionally gated by the cost model's per-kind "
+            "calibration when armed).  Per-slot K only narrows a "
+            "row's span on the already-compiled verify window — no "
+            "new executable shapes.  Greedy tokens stay exactly the "
+            "target model's.  Needs spec_decode_k >= 1")
+define_flag("spec_k_min", 1,
+            "adaptive-K floor (FLAGS_spec_adaptive_k): a slot's "
+            "speculation depth never shrinks below this many drafted "
+            "tokens — 1 keeps at least classic+1 emission potential "
+            "while a drafter is cold")
+define_flag("spec_k_shrink_streak", 2,
+            "adaptive-K shrink trigger: consecutive verify rounds in "
+            "which a slot accepted NONE of its drafts before its "
+            "depth halves (multiplicative decrease)")
+define_flag("spec_k_grow_streak", 2,
+            "adaptive-K grow trigger: consecutive verify rounds in "
+            "which a slot accepted EVERY usable draft before its "
+            "depth grows by one (additive increase, capped at "
+            "spec_decode_k)")
 define_flag("metrics_report_interval_s", 0.0,
             "interval of the periodic observability reporter "
             "(paddle_tpu.observability.start_reporter): every interval a "

@@ -221,6 +221,10 @@ class _JitTracker:
                 "set: _JitTracker owns the jax.jit so the donated and "
                 "tombstoned argument sets cannot drift")
         self.site = site or compile_key
+        # compile_key doubles as the retrace-attribution key:
+        # "<kind>_compiles" -> "<kind>_retraces" (decode_stats), so a
+        # warm retrace is attributable to ONE executable by counter
+        self.compile_key = compile_key
         self._seen = 0
         self._warm = False
         # cost observatory (observability.costmodel): the profile key
@@ -272,7 +276,16 @@ class _JitTracker:
                     f"cache grew {was} -> {n} after warmup — a step "
                     f"operand's shape/dtype/weak_type changed "
                     f"mid-serve")
-            _stats_add(retraces_after_warmup=grew)
+            # aggregate counter + per-executable attribution keyed by
+            # compile_key ("<kind>_compiles" -> "<kind>_retraces"); a
+            # key outside the schema (tests passing ad-hoc keys) still
+            # lands in the aggregate
+            per_key = self.compile_key.replace("_compiles", "_retraces")
+            if per_key in _STATS:
+                _stats_add(retraces_after_warmup=grew,
+                           **{per_key: grew})
+            else:
+                _stats_add(retraces_after_warmup=grew)
 
 
 # ---------------------------------------------------------------------------
@@ -621,6 +634,13 @@ class Request:
         # the queue head is re-probed every step, and re-hashing a long
         # prompt each time would put O(prompt) host work in the loop
         self._page_hashes: Optional[List[bytes]] = None
+        # prefix-cache registration high-water mark: how many of this
+        # request's leading FULL pages are content-addressed in the
+        # pool — prompt pages at first token, then GENERATED pages as
+        # decode crosses page boundaries.  A count of hashes known to
+        # the pool, not of pages this life owns, so it survives
+        # preempt/resume.
+        self._reg_pages = 0
         self.request_id = next(Request._next_id)
         self.t_enqueue_ns: Optional[int] = None
         self.t_admit_ns: Optional[int] = None
@@ -1150,6 +1170,170 @@ def _gpt_mixed_step_q(params, k_pages, v_pages, k_scales, v_scales,
     return k_pages, v_pages, k_scales, v_scales, out
 
 
+# ---------------------------------------------------------------------------
+# The unified ragged step (FLAGS_ragged_step).
+#
+# ONE executable per KV mode serves every phase of a speculative,
+# chunk-prefilling, continuously-batched serve: each slot's row in the
+# fixed ``[slots, Q_r]`` grid carries its own query span via
+# ``write_caps`` — 1 for a decoding slot, C for a prompt chunk, K+1
+# for a verify window, 0 to sit the step out — and the ragged
+# multi-query paged-attention kernel (``q_offsets = seq_lens``) gives
+# every row its own causal offset.  The host interprets the
+# per-position targets by phase: row 0 for a decode slot, row C-1 for
+# a slot finishing its prefill, the accept loop for a verify window.
+# Collapsing `_gpt_decode_step` / `_gpt_mixed_step` /
+# `_gpt_spec_verify` (and the `_q` twins) into this one program means
+# one compile, one retrace contract, no compile-time phase branch —
+# the "ragged_compiles == 1, {decode,mixed,verify}_compiles == 0"
+# counter assertion tests/test_ragged_step.py pins.
+#
+# The split-path functions above stay byte-identical — they are the
+# FLAGS_ragged_step=off path and the greedy-parity oracle; keeping the
+# twins separate (rather than a mode flag inside one body) is what
+# lets the off path compile the exact same executables as before this
+# feature existed (zero new executables in off mode).
+# ---------------------------------------------------------------------------
+def _gpt_ragged_step(params, k_pages, v_pages, block_tables, seq_lens,
+                     tokens, write_caps, key, *, num_heads, head_dim,
+                     eps, sampler, temperature, top_k, top_p):
+    """The unified ragged step: score up to Q_r incoming tokens per
+    slot in ONE pass — write rows ``i < write_caps[b]`` into the slot's
+    already-reserved pages (capped rows are dropped by the scatter),
+    run ragged multi-query paged attention with per-sequence causal
+    offsets, and draw a target token at EVERY position with the
+    engine's own `sample_logits`.
+
+    tokens: [B, Q_r] int32 — position ``seq_lens[b] + i`` holds
+    ``tokens[b, i]``; write_caps: [B] int32 in [0, Q_r] — the row's
+    span (0 = the slot sits this step out; its targets are garbage the
+    host ignores); k_pages/v_pages donated (in-place cache update; a
+    speculative rejection only shrinks the host's ``seq_lens``).
+    Returns (k_pages, v_pages, targets [B, Q_r] int32).
+
+    Positions sample with ``fold_in(key, i)`` (the verify convention);
+    greedy ignores the key, which is why greedy tokens are
+    bit-identical to the split path — the oracle the parity tests pin.
+    Rows past a slot's span cost dense FLOPs but no extra KV traffic
+    (K/V pages are gathered once per slot for all Q_r rows), so size
+    ``prefill_q_max`` / K to the traffic when decode dominates."""
+    b, qn = tokens.shape
+    h = num_heads * head_dim
+    num_pages_total = k_pages.shape[2]
+    page = k_pages.shape[3]
+
+    pos = seq_lens[:, None] + jnp.arange(qn, dtype=jnp.int32)[None, :]
+    wpe_max = params["wpe"].shape[0] - 1
+    x = params["wte"][tokens] + params["wpe"][jnp.minimum(pos, wpe_max)]
+    page_idx, slot = pa.paged_write_indices(
+        block_tables, seq_lens, write_caps, qn, num_pages_total, page)
+    lens_now = seq_lens + write_caps
+
+    for li, blk in enumerate(params["blocks"]):
+        y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
+        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = qkv.reshape(b, qn, 3, num_heads, head_dim)
+        q = qkv[:, :, 0]                                 # [B, Q, H, D]
+        k_pages = k_pages.at[li, :, page_idx, slot, :].set(qkv[:, :, 1])
+        v_pages = v_pages.at[li, :, page_idx, slot, :].set(qkv[:, :, 2])
+        attn = pa.paged_attention(q, k_pages[li], v_pages[li],
+                                  block_tables, lens_now,
+                                  q_offsets=seq_lens)
+        x = x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"]) \
+            + blk["out_b"]
+        y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
+        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+                        approximate=True)
+        x = x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+                 ).reshape(b, qn, h)
+
+    xf = _ln(x.reshape(b * qn, h), params["lnf_w"], params["lnf_b"], eps)
+    logits = _logits_of(params, xf).astype(jnp.float32)
+    logits = logits.reshape(b, qn, -1)
+    targets = [
+        _guard_tokens(
+            logits[:, i],
+            sample_logits(logits[:, i], sampler=sampler,
+                          temperature=temperature, top_k=top_k,
+                          top_p=top_p, key=jax.random.fold_in(key, i)))
+        for i in range(qn)
+    ]
+    return k_pages, v_pages, jnp.stack(targets, axis=1)
+
+
+def _gpt_ragged_step_q(params, k_pages, v_pages, k_scales, v_scales,
+                       block_tables, seq_lens, tokens, write_caps, key,
+                       *, num_heads, head_dim, eps, sampler,
+                       temperature, top_k, top_p):
+    """Quantized-storage `_gpt_ragged_step` (FLAGS_kv_quant=int8):
+    every contributed row quantizes into its slot's pages through
+    `pa.paged_quant_write` (span-aware: capped rows never fold a
+    scale), attention reads through the fused dequant.  Returns
+    ``(k_pages, v_pages, k_scales, v_scales, out)`` with ``out``
+    [B+1, Q_r] int32: rows 0..B-1 the per-position targets, row B the
+    step's refold count packed in column 0 — the one blocking fetch
+    the step already pays carries both."""
+    b, qn = tokens.shape
+    h = num_heads * head_dim
+    num_pages_total = k_pages.shape[2]
+    page = k_pages.shape[3]
+
+    pos = seq_lens[:, None] + jnp.arange(qn, dtype=jnp.int32)[None, :]
+    wpe_max = params["wpe"].shape[0] - 1
+    x = params["wte"][tokens] + params["wpe"][jnp.minimum(pos, wpe_max)]
+    page_idx, slot = pa.paged_write_indices(
+        block_tables, seq_lens, write_caps, qn, num_pages_total, page)
+    flat_idx = page_idx.reshape(-1)
+    flat_slot = slot.reshape(-1)
+    spans = pa.paged_write_spans(
+        block_tables, seq_lens, write_caps, qn, num_pages_total, page)
+    lens_now = seq_lens + write_caps
+    refolds = jnp.int32(0)
+
+    for li, blk in enumerate(params["blocks"]):
+        y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
+        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = qkv.reshape(b, qn, 3, num_heads, head_dim)
+        q = qkv[:, :, 0]                                 # [B, Q, H, D]
+        k_pages, k_scales, rk = pa.paged_quant_write(
+            k_pages, k_scales, li,
+            qkv[:, :, 1].reshape(b * qn, num_heads, head_dim),
+            flat_idx, flat_slot, spans)
+        v_pages, v_scales, rv = pa.paged_quant_write(
+            v_pages, v_scales, li,
+            qkv[:, :, 2].reshape(b * qn, num_heads, head_dim),
+            flat_idx, flat_slot, spans)
+        refolds = refolds + rk + rv
+        attn = pa.paged_attention(q, k_pages[li], v_pages[li],
+                                  block_tables, lens_now,
+                                  q_offsets=seq_lens,
+                                  k_scales=k_scales[li],
+                                  v_scales=v_scales[li])
+        x = x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"]) \
+            + blk["out_b"]
+        y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
+        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+                        approximate=True)
+        x = x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+                 ).reshape(b, qn, h)
+
+    xf = _ln(x.reshape(b * qn, h), params["lnf_w"], params["lnf_b"], eps)
+    logits = _logits_of(params, xf).astype(jnp.float32)
+    logits = logits.reshape(b, qn, -1)
+    targets = [
+        _guard_tokens(
+            logits[:, i],
+            sample_logits(logits[:, i], sampler=sampler,
+                          temperature=temperature, top_k=top_k,
+                          top_p=top_p, key=jax.random.fold_in(key, i)))
+        for i in range(qn)
+    ]
+    out = jnp.stack(targets, axis=1).astype(jnp.int32)
+    pack = jnp.zeros((1, qn), jnp.int32).at[0, 0].set(refolds)
+    return k_pages, v_pages, k_scales, v_scales, \
+        jnp.concatenate([out, pack], axis=0)
+
+
 def _reset_kv_scales(k_scales, v_scales, fresh_idx):
     """Zero the quant-scale entries of freshly (re)allocated pages —
     one small donated executable the engine runs between steps whenever
@@ -1192,7 +1376,8 @@ class DecodeEngine:
                  journal_dir=None, step_timeout_ms=None,
                  flight_window=None, flight_dir=None, kv_quant=None,
                  cost_model=None, cost_calibration=None, alerts=None,
-                 profile=None, profile_sample_steps=None):
+                 profile=None, profile_sample_steps=None,
+                 ragged_step=None, spec_adaptive_k=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -1319,6 +1504,7 @@ class DecodeEngine:
         # executable always pays slots x Q_max rows) while the budget
         # still spreads across several prefilling slots per step —
         # decoupling per-step latency from aggregate prefill throughput
+        q_max_explicit = prefill_q_max is not None
         if prefill_q_max is None:
             prefill_q_max = self._chunk_budget
         if prefill_q_max < 1:
@@ -1362,11 +1548,55 @@ class DecodeEngine:
             raise ValueError(
                 "drafter passed but speculative decoding is off: set "
                 "spec_decode_k >= 1 (or FLAGS_spec_decode_k)")
+        # adaptive per-slot speculation depth (FLAGS_spec_adaptive_k):
+        # an explicit True without speculation is refused like a
+        # drafter without K; the flag-resolved value is simply ignored
+        # on non-speculative engines (it modifies speculation, it does
+        # not imply it)
+        if spec_adaptive_k and not spec_decode_k:
+            raise ValueError(
+                "spec_adaptive_k passed but speculative decoding is "
+                "off: set spec_decode_k >= 1 (or FLAGS_spec_decode_k)")
+        if spec_adaptive_k is None:
+            spec_adaptive_k = bool(_flags.flag("spec_adaptive_k"))
         if spec_decode_k:
             from .speculative import SpeculativeDecoder
 
             self._spec = SpeculativeDecoder(self, k=int(spec_decode_k),
-                                            drafter=drafter)
+                                            drafter=drafter,
+                                            adaptive=bool(spec_adaptive_k))
+
+        # unified ragged step (explicit arg wins, else
+        # FLAGS_ragged_step): decode, mixed prefill+decode, and
+        # speculative-verify traffic all dispatch the ONE
+        # `_gpt_ragged_step[_q]` executable, each row carrying its own
+        # query span.  Off (the default) keeps the split executables
+        # byte-identical — the greedy-parity oracle.
+        if ragged_step is None:
+            ragged_step = bool(_flags.flag("ragged_step"))
+        self._ragged = bool(ragged_step)
+        self._ragged_fn = None
+        # the unified executable's per-slot row width: wide enough for
+        # the widest span any phase contributes — a decode row (1), a
+        # prompt chunk (Q_max), a verify window (K+1).  Rows past a
+        # slot's span cost dense FLOPs but no extra KV traffic — but
+        # EVERY round pays the full grid, so a wide chunk width taxes
+        # the steady state (all-decode / all-verify rounds, which
+        # dominate any long serve) to speed the transient prefill
+        # phase.  When the caller did not pin prefill_q_max, a ragged
+        # engine therefore chunks prompts at one KV page of query span
+        # per slot (never narrower than the verify window): chunks stay
+        # page-aligned for the prefix cache and the steady-state
+        # padding is bounded.  An explicit prefill_q_max always wins —
+        # it sizes the grid verbatim.
+        if self._ragged and self._chunked and not q_max_explicit:
+            self._q_max = min(self._q_max, max(
+                self._page,
+                (self._spec.k + 1) if self._spec is not None else 1))
+        self._q_ragged = max(1,
+                             self._q_max if self._chunked else 1,
+                             (self._spec.k + 1) if self._spec is not None
+                             else 1)
 
         # admission scheduler (explicit arg wins, else FLAGS_sched_policy):
         # owns the between-steps admission ORDER and the preemption /
@@ -1445,7 +1675,10 @@ class DecodeEngine:
             scheduler=self._scheduler, fault_plan=self._fault,
             journal_dir=self._journal_dir,
             step_timeout_ms=self._step_timeout_ms,
-            kv_quant=self._kv_quant_mode)
+            kv_quant=self._kv_quant_mode,
+            ragged_step=self._ragged,
+            spec_adaptive_k=(self._spec.adaptive
+                             if self._spec is not None else False))
 
         # flight recorder (observability.flight): always-cheap bounded
         # ring of per-step records — batch composition, phase
@@ -1639,6 +1872,12 @@ class DecodeEngine:
                 tuple(sorted(self._sampling.items())),
                 self._spec.k if self._spec else 0,
                 self._chunked_cfg)).encode())
+            if self._ragged:
+                # folded CONDITIONALLY so off-path fingerprints stay
+                # byte-identical with pre-ragged journals/donors (their
+                # executables ARE identical); a ragged engine can never
+                # adopt a split-path engine's executables or vice versa
+                h.update(str(("ragged", self._q_ragged)).encode())
             self._config_fp = h.digest()
         return self._config_fp
 
@@ -1671,8 +1910,8 @@ class DecodeEngine:
         """Every live `_JitTracker` this engine (and its speculative
         subsystem) currently holds — the watchdog's compile detector
         and the handoff's donor surface."""
-        ts = [self._decode_fn, self._mixed_fn, self._scale_reset_fn,
-              *self._prefill_fns.values()]
+        ts = [self._decode_fn, self._mixed_fn, self._ragged_fn,
+              self._scale_reset_fn, *self._prefill_fns.values()]
         if self._spec is not None:
             ts.append(self._spec._verify_fn)
             d = self._spec.drafter
@@ -1702,6 +1941,10 @@ class DecodeEngine:
             n += 1
         if self._mixed_fn is None and donor._mixed_fn is not None:
             self._mixed_fn = donor._mixed_fn
+            n += 1
+        if self._ragged_fn is None and \
+                getattr(donor, "_ragged_fn", None) is not None:
+            self._ragged_fn = donor._ragged_fn
             n += 1
         if self._scale_reset_fn is None and \
                 donor._scale_reset_fn is not None:
@@ -2342,6 +2585,41 @@ class DecodeEngine:
             for i in range(req.cached_page_count, len(req._page_hashes)):
                 self.pool.register_page(req.pages[i],
                                         req._page_hashes[i])
+        req._reg_pages = len(req._page_hashes)
+
+    def _register_generated_pages(self, slot: int, req: Request):
+        """Decode just advanced ``slot``: content-address any GENERATED
+        page that became full (ROADMAP quantized-serving rung (d)), so
+        beam/agent fanout sharing a decode prefix maps it instead of
+        recomputing.  Safe to freeze: KV rows ``< lens`` are final (a
+        speculative rejection only ever shrinks lens back to the
+        accepted point BEFORE new rows are written, and every later
+        write lands at positions ``>= lens`` — past every full page).
+        The chain hashes extend the prompt's memoized chain over
+        ``prompt_ids + output_ids``; the emit-loop invariant
+        ``len(prompt + outputs) == lens + 1`` guarantees the token
+        content of every full page is on hand.  O(1) early-out keeps
+        the per-token cost of the common (mid-page) case negligible."""
+        if not self._prefix_cache or req.t_first_token_ns is None:
+            return
+        full = int(self._lens[slot]) // self._page
+        if full <= req._reg_pages:
+            return
+        toks = req.prompt_ids + req.output_ids
+        hashes = req._page_hashes
+        if hashes is None:
+            hashes = req._page_hashes = self._prefix_hashes(
+                req.prompt_ids)
+        while len(hashes) < full:
+            i = len(hashes)
+            prev = hashes[-1] if hashes else self._model_salt
+            hashes.append(_chain_hash(
+                prev, toks[i * self._page:(i + 1) * self._page]))
+        with self._phase("cache"):
+            for i in range(max(req._reg_pages, req.cached_page_count),
+                           full):
+                self.pool.register_page(req.pages[i], hashes[i])
+        req._reg_pages = full
 
     def _finish(self, slot: int, reason: str):
         req = self._by_slot[slot]
@@ -2447,6 +2725,9 @@ class DecodeEngine:
             for i in range(req.cached_page_count,
                            min(kv_len // self._page, len(replay_hashes))):
                 self.pool.register_page(req.pages[i], replay_hashes[i])
+            req._reg_pages = max(
+                req._reg_pages,
+                min(kv_len // self._page, len(replay_hashes)))
         # fold the generation into the prompt for replay; the KV-budget
         # identity (total_kv_tokens) is preserved exactly
         req.prompt_ids = req.prompt_ids + req.output_ids
@@ -2628,6 +2909,33 @@ class DecodeEngine:
                     site="DecodeEngine mixed step (_gpt_mixed_step)")
         return fn
 
+    def _ragged_fn_tracker(self) -> _JitTracker:
+        """The ONE step executable of the ragged path
+        (FLAGS_ragged_step): decode rows, prefill chunks, and
+        speculative verify windows all dispatch through this tracker,
+        so steady-state serving compiles exactly one executable per KV
+        mode (counter: ``ragged_compiles``) and a warm retrace of it is
+        attributed to ``ragged_retraces``."""
+        fn = self._ragged_fn
+        if fn is None:
+            if self._kv_quant:
+                fn = self._ragged_fn = _JitTracker(
+                    functools.partial(_gpt_ragged_step_q,
+                                      num_heads=self._num_heads,
+                                      head_dim=self._head_dim,
+                                      eps=self._eps, **self._sampling),
+                    "ragged_compiles", donate_argnums=(1, 2, 3, 4),
+                    site="DecodeEngine ragged step (_gpt_ragged_step_q)")
+            else:
+                fn = self._ragged_fn = _JitTracker(
+                    functools.partial(_gpt_ragged_step,
+                                      num_heads=self._num_heads,
+                                      head_dim=self._head_dim,
+                                      eps=self._eps, **self._sampling),
+                    "ragged_compiles", donate_argnums=(1, 2),
+                    site="DecodeEngine ragged step (_gpt_ragged_step)")
+        return fn
+
     def _mixed_step(self, decode_rows=True) -> bool:
         """One fused prefill+decode step: assemble the fixed-shape
         [slots, Q_max] mixed batch under the chunk-token budget, run the
@@ -2638,7 +2946,11 @@ class DecodeEngine:
         from ..profiler import RecordEvent
 
         slots, qmax = self._slots, self._q_max
-        tokens = np.zeros((slots, qmax), np.int32)
+        # ragged mode widens the grid to Q_r >= Q_max so the ONE
+        # executable's token shape also fits verify windows (K+1);
+        # chunk spans stay capped by Q_max (the chunk-budget invariant)
+        width = self._q_ragged if self._ragged else qmax
+        tokens = np.zeros((slots, width), np.int32)
         caps = np.zeros(slots, np.int32)
         sample_idx = np.zeros(slots, np.int32)
         sample_mask = np.zeros(slots, bool)
@@ -2676,7 +2988,8 @@ class DecodeEngine:
                     sample_mask[s] = True
         self._grow_block_tables(writes=caps)
 
-        fn = self._mixed_fn_tracker()
+        fn = self._ragged_fn_tracker() if self._ragged \
+            else self._mixed_fn_tracker()
         if self._fault is not None:
             # fault site BEFORE the invocation (and the step counter):
             # an injected raise leaves no half-donated state, so the
@@ -2697,7 +3010,28 @@ class DecodeEngine:
         t0_ns = _obs.now_ns()
         with RecordEvent("serving.mixed_step"):
             with self._phase(phase_name):
-                if self._kv_quant:
+                if self._ragged:
+                    # the unified executable takes no sample_idx /
+                    # sample_mask operands — every position draws a
+                    # target and the host selects each slot's span-end
+                    # row after the fetch below
+                    if self._kv_quant:
+                        (self._k_pages, self._v_pages, self._k_scales,
+                         self._v_scales, toks) = fn(
+                            self._params, self._k_pages, self._v_pages,
+                            self._k_scales, self._v_scales,
+                            jnp.asarray(self._bt),
+                            jnp.asarray(self._lens),
+                            jnp.asarray(tokens), jnp.asarray(caps),
+                            key)
+                    else:
+                        self._k_pages, self._v_pages, toks = fn(
+                            self._params, self._k_pages, self._v_pages,
+                            jnp.asarray(self._bt),
+                            jnp.asarray(self._lens),
+                            jnp.asarray(tokens), jnp.asarray(caps),
+                            key)
+                elif self._kv_quant:
                     (self._k_pages, self._v_pages, self._k_scales,
                      self._v_scales, toks) = fn(
                         self._params, self._k_pages, self._v_pages,
@@ -2715,16 +3049,28 @@ class DecodeEngine:
                         jnp.asarray(sample_mask), key)
                 if self._profiling is not None:
                     # sampled device-sync probe (see _step_inner):
-                    # attributed to the MIXED executable regardless of
-                    # the flight phase this step dispatched under — a
-                    # chunkless full step runs the mixed program under
-                    # the "decode" phase, and scoring it against the
-                    # decode profile would poison the calibration
-                    self._profiling.probe("mixed", toks, t0, t0_ns)
+                    # attributed to the DISPATCHED executable (ragged
+                    # or mixed) regardless of the flight phase this
+                    # step ran under — a chunkless full step runs the
+                    # program under the "decode" phase, and scoring it
+                    # against the decode profile would poison the
+                    # calibration
+                    self._profiling.probe(
+                        "ragged" if self._ragged else "mixed",
+                        toks, t0, t0_ns)
             toks = self._host_fetch(toks)
         if self._kv_quant:
-            self._note_refolds(int(toks[-1]))
+            self._note_refolds(int(toks[-1, 0] if self._ragged
+                                   else toks[-1]))
             toks = toks[:-1]
+        if self._ragged:
+            # host-side span-end selection: a decode row's token sits
+            # at column 0, a finishing chunk's at column c-1; padding
+            # columns (and sat-out slots) are garbage.  np.where keeps
+            # NAN_TOKEN (-1) for masked slots, so per-row quarantine
+            # still fires
+            toks = np.where(sample_mask,
+                            toks[np.arange(slots), sample_idx], 0)
         dt = time.perf_counter() - t0
         if self._fault is not None:
             toks = self._resilience.corrupt_tokens(
@@ -2782,6 +3128,7 @@ class DecodeEngine:
                     self._last[s] = tok
                     self._emit(req, [tok])
                     emitted += 1
+                    self._register_generated_pages(s, req)
                     reason = self._done(req, tok)
                     if reason:
                         self._finish(s, reason)
@@ -2944,6 +3291,10 @@ class DecodeEngine:
                 "kv_quant": self._kv_quant_mode,
                 "chunk_budget": int(self._chunk_budget),
                 "spec_k": self._spec.k if self._spec is not None else 0,
+                "spec_adaptive_k": bool(
+                    self._spec.adaptive if self._spec is not None
+                    else False),
+                "ragged_step": bool(self._ragged),
                 "sampling": dict(self._sampling),
             },
             "queue": [_req(r) for r in self._snapshot_queue()],
@@ -3209,6 +3560,11 @@ class DecodeEngine:
             return self._spec.step()
         if self._chunked and self._prefilling_any():
             return self._mixed_step()
+        if self._ragged:
+            # ragged unified path: a chunkless step still dispatches
+            # the ONE ragged executable (decode rows carry span 1), so
+            # steady-state serving never touches _gpt_decode_step
+            return self._mixed_step()
         self._grow_block_tables()
 
         fn = self._decode_fn
@@ -3291,6 +3647,7 @@ class DecodeEngine:
                 self._last[slot] = tok
                 self._emit(req, [tok])
                 emitted += 1
+                self._register_generated_pages(slot, req)
                 reason = self._done(req, tok)
                 if reason:
                     self._finish(slot, reason)

@@ -698,25 +698,110 @@ class SpeculativeDecoder:
     non-speculative engine and stochastic output follows the target
     distribution exactly."""
 
-    def __init__(self, engine, k: int, drafter=None):
+    def __init__(self, engine, k: int, drafter=None, adaptive=False):
+        from ..core import flags as _flags
+
         if k < 1:
             raise ValueError(f"spec_decode_k must be >= 1, got {k}")
         self.engine = engine
         self.k = int(k)
         if drafter is None:
-            from ..core import flags as _flags
-
             drafter = str(_flags.flag("spec_drafter"))
         self.drafter = make_drafter(drafter)
         self.drafter.bind(engine, self.k)
         self._verify_fn: Optional[_JitTracker] = None
+        # adaptive per-slot speculation depth (FLAGS_spec_adaptive_k):
+        # ``k_slot`` is each slot's LIVE depth, capped at the
+        # configured k — drafter frames, verify windows, and the
+        # ragged grid are all sized by k, so a per-slot depth is just
+        # a smaller per-row span, never a new executable shape.
+        # Multiplicative decrease on rejection streaks, +1 growth on
+        # acceptance runs (gated by the cost model's per-kind
+        # calibration via `_grow_ok`).
+        self.adaptive = bool(adaptive)
+        self.k_min = min(self.k,
+                         max(1, int(_flags.flag("spec_k_min"))))
+        self._shrink_after = max(
+            1, int(_flags.flag("spec_k_shrink_streak")))
+        self._grow_after = max(
+            1, int(_flags.flag("spec_k_grow_streak")))
+        self.k_slot = np.full(engine._slots, self.k, np.int32)
+        self._rej_streak = np.zeros(engine._slots, np.int32)
+        self._acc_streak = np.zeros(engine._slots, np.int32)
 
     # engine lifecycle hooks (DecodeEngine._prefill_into / _finish)
     def on_admit(self, slot: int, req):
+        self._reset_k(slot)
         self.drafter.on_admit(slot, req)
 
     def on_finish(self, slot: int, req):
+        self._reset_k(slot)
         self.drafter.on_finish(slot, req)
+
+    def _reset_k(self, slot: int):
+        """A slot changed hands: its acceptance history (and therefore
+        its learned depth) belongs to the request that generated it."""
+        self.k_slot[slot] = self.k
+        self._rej_streak[slot] = 0
+        self._acc_streak[slot] = 0
+
+    def _grow_ok(self) -> bool:
+        """Cost-model gate on depth growth: growing a slot's K only
+        pays while one verify round costs less than the K+1 decode
+        steps it replaces at full acceptance (the only regime growth
+        triggers in).  Calibrated per-label seconds when the model has
+        learned them ("spec" vs the decode-shaped label), raw roofline
+        otherwise; no cost model (or an extraction failure) -> allow —
+        the streak policy alone is still safe, just ungated."""
+        eng = self.engine
+        cost = eng._cost
+        if cost is None:
+            return True
+        try:
+            verify_kind = "ragged" if eng._ragged else "verify"
+            decode_kind = "ragged" if eng._ragged else "decode"
+            v = cost.raw_seconds(cost.profile_for(verify_kind))
+            d = cost.raw_seconds(cost.profile_for(decode_kind))
+            calib = cost.calibration_wire()
+            v *= calib.get("spec", 1.0)
+            d *= calib.get("ragged" if eng._ragged else "decode", 1.0)
+        except Exception:
+            return True
+        return v <= d * (self.k + 1)
+
+    def _adapt_k(self, slot: int, m: int, usable: int):
+        """Per-slot depth controller, fed by this round's acceptance
+        (``m`` of ``usable`` drafts matched): a full rejection extends
+        the slot's rejection streak and, at ``spec_k_shrink_streak``,
+        halves its depth toward ``spec_k_min`` (multiplicative
+        decrease — a mispredicting regime stops paying for dead draft
+        rows fast); a full acceptance extends the acceptance run and,
+        at ``spec_k_grow_streak``, grows the depth by one (additive,
+        cost-gated) back toward the configured K; a partial acceptance
+        resets both streaks (the depth is about right)."""
+        if usable <= 0:
+            return  # depth-0 round (token budget exhausted): no signal
+        if m == 0:
+            self._acc_streak[slot] = 0
+            self._rej_streak[slot] += 1
+            if self._rej_streak[slot] >= self._shrink_after and \
+                    int(self.k_slot[slot]) > self.k_min:
+                self.k_slot[slot] = max(self.k_min,
+                                        int(self.k_slot[slot]) // 2)
+                self._rej_streak[slot] = 0
+                _stats_add(spec_k_shrinks=1)
+        elif m >= usable:
+            self._rej_streak[slot] = 0
+            self._acc_streak[slot] += 1
+            if self._acc_streak[slot] >= self._grow_after and \
+                    int(self.k_slot[slot]) < self.k:
+                self._acc_streak[slot] = 0
+                if self._grow_ok():
+                    self.k_slot[slot] += 1
+                    _stats_add(spec_k_grows=1)
+        else:
+            self._rej_streak[slot] = 0
+            self._acc_streak[slot] = 0
 
     def step(self) -> bool:
         """One propose->verify->accept round over every active slot.
@@ -750,7 +835,8 @@ class SpeculativeDecoder:
                 continue
             req = eng._by_slot[s]
             need = req.max_new_tokens - len(req.output_ids)
-            caps[s] = min(self.k + 1, need)
+            k_s = int(self.k_slot[s]) if self.adaptive else self.k
+            caps[s] = min(k_s + 1, need)
         if not caps.any():
             # every live slot is still prefilling: the chunk step above
             # WAS this engine step — it owns the latency observation
@@ -789,28 +875,45 @@ class SpeculativeDecoder:
                          tid=eng._engine_id,
                          args={"drafter": self.drafter.name, "k": self.k})
 
-        fn = self._verify_fn
-        if fn is None:
-            if eng._kv_quant:
-                fn = self._verify_fn = _JitTracker(
-                    functools.partial(_gpt_spec_verify_q,
-                                      num_heads=eng._num_heads,
-                                      head_dim=eng._head_dim,
-                                      eps=eng._eps, **eng._sampling),
-                    "verify_compiles", donate_argnums=(1, 2, 3, 4),
-                    site="SpeculativeDecoder verify "
-                         "(_gpt_spec_verify_q)")
-            else:
-                fn = self._verify_fn = _JitTracker(
-                    functools.partial(_gpt_spec_verify,
-                                      num_heads=eng._num_heads,
-                                      head_dim=eng._head_dim,
-                                      eps=eng._eps, **eng._sampling),
-                    "verify_compiles", donate_argnums=(1, 2),
-                    site="SpeculativeDecoder verify (_gpt_spec_verify)")
+        if eng._ragged:
+            # FLAGS_ragged_step: the verify window is just a per-row
+            # span on the engine's ONE ragged executable — same
+            # program, same shapes as its decode/mixed dispatches, so
+            # a speculative engine still compiles exactly one step
+            # executable
+            fn = eng._ragged_fn_tracker()
+        else:
+            fn = self._verify_fn
+            if fn is None:
+                if eng._kv_quant:
+                    fn = self._verify_fn = _JitTracker(
+                        functools.partial(_gpt_spec_verify_q,
+                                          num_heads=eng._num_heads,
+                                          head_dim=eng._head_dim,
+                                          eps=eng._eps, **eng._sampling),
+                        "verify_compiles", donate_argnums=(1, 2, 3, 4),
+                        site="SpeculativeDecoder verify "
+                             "(_gpt_spec_verify_q)")
+                else:
+                    fn = self._verify_fn = _JitTracker(
+                        functools.partial(_gpt_spec_verify,
+                                          num_heads=eng._num_heads,
+                                          head_dim=eng._head_dim,
+                                          eps=eng._eps, **eng._sampling),
+                        "verify_compiles", donate_argnums=(1, 2),
+                        site="SpeculativeDecoder verify "
+                             "(_gpt_spec_verify)")
 
         tokens = np.concatenate(
             [eng._last[:, None].astype(np.int32), drafts], axis=1)
+        if eng._ragged and tokens.shape[1] < eng._q_ragged:
+            # pad the window out to the ragged grid's fixed Q_r (the
+            # chunked-prefill width may exceed K+1); padding columns
+            # sit past every cap and are never written or read
+            tokens = np.concatenate(
+                [tokens, np.zeros((slots, eng._q_ragged -
+                                   tokens.shape[1]), np.int32)],
+                axis=1)
         if eng._fault is not None:
             eng._resilience.step_fault_point("verify")
         eng._step_no += 1
@@ -836,7 +939,9 @@ class SpeculativeDecoder:
                     # sampled device-sync probe (observability.
                     # profiling): the verify executable's measured
                     # device seconds, blocked inside the phase
-                    eng._profiling.probe("verify", targets, t0, tv_ns)
+                    eng._profiling.probe(
+                        "ragged" if eng._ragged else "verify",
+                        targets, t0, tv_ns)
             targets = eng._host_fetch(targets)
         if eng._kv_quant:
             eng._note_refolds(int(targets[slots, 0]))
@@ -895,7 +1000,10 @@ class SpeculativeDecoder:
                 eng._lens[s] += n_emit
                 eng._last[s] = emit[-1]
                 emitted_total += n_emit
+                eng._register_generated_pages(s, req)
                 self.drafter.on_accept(s, int(pos_before[s]), n_emit)
+                if self.adaptive:
+                    self._adapt_k(s, m, usable)
                 reason = eng._done(req, emit[-1])
                 if reason:
                     eng._finish(s, reason)

@@ -471,10 +471,10 @@ class CostModel:
 
     def profile_for(self, kind: str) -> CostProfile:
         """The static profile of the executable a step of ``kind``
-        runs ("decode" | "mixed" | "verify" | "draft_step"): the
-        HLO-extracted profile when the tracker has compiled and the
-        backend supports cost analysis, else the analytical GPT
-        formula at the executable's fixed shapes."""
+        runs ("decode" | "mixed" | "ragged" | "verify" |
+        "draft_step"): the HLO-extracted profile when the tracker has
+        compiled and the backend supports cost analysis, else the
+        analytical GPT formula at the executable's fixed shapes."""
         eng = self.engine
         tracker = None
         batch, q = eng._slots, 1
@@ -483,6 +483,9 @@ class CostModel:
         elif kind == "mixed":
             tracker = eng._mixed_fn
             q = eng._q_max
+        elif kind == "ragged":
+            tracker = eng._ragged_fn
+            q = eng._q_ragged
         elif kind == "verify" and eng._spec is not None:
             tracker = eng._spec._verify_fn
             q = eng._spec.k + 1
@@ -522,17 +525,23 @@ class CostModel:
     def _step_plan(self, comp: Dict[str, object]):
         """(fn label, [(kind, invocations)]) for the step this
         composition dispatches to — mirrors `_step_inner`'s dispatch
-        exactly."""
+        exactly.  On a ragged-step engine (FLAGS_ragged_step) every
+        phase runs the ONE ragged executable, so the plan's kinds (and
+        the calibration label of non-spec steps) collapse to
+        "ragged"."""
+        eng = self.engine
+        ragged = bool(getattr(eng, "_ragged", False))
         if comp.get("spec"):
-            plan = [("verify", 1)]
-            eng = self.engine
+            plan = [("ragged" if ragged else "verify", 1)]
             if getattr(eng._spec.drafter, "_step_fn", None) is not None:
                 # draft-model drafter: K greedy draft steps per round
                 # (catch-up multi-query pass folded into the factor)
                 plan.append(("draft_step", eng._spec.k))
             if comp.get("prefilling"):
-                plan.append(("mixed", 1))
+                plan.append(("ragged" if ragged else "mixed", 1))
             return "spec", plan
+        if ragged:
+            return "ragged", [("ragged", 1)]
         if comp.get("chunked") and comp.get("prefilling"):
             return "mixed", [("mixed", 1)]
         return "decode", [("decode", 1)]
@@ -656,13 +665,16 @@ class CostModel:
         if err_ewma is not None:
             obs.STEP_COST_ERROR.set(err_ewma, fn=fn)
         # roofline: each device leaf phase with a known profile scores
-        # its measured time against the ceilings
+        # its measured time against the ceilings.  Flight phases keep
+        # their historical names on a ragged engine, but every one of
+        # them ran the ragged executable — score against its profile.
+        ragged = bool(getattr(eng, "_ragged", False))
         for phase, kind in (("decode", "decode"), ("mixed", "mixed"),
                             ("verify", "verify")):
             dt = rec.get("phases", {}).get(phase)
             if not dt:
                 continue
-            prof = self.profile_for(kind)
+            prof = self.profile_for("ragged" if ragged else kind)
             obs.PHASE_MFU.set(
                 prof.flops / dt / self.peaks["flops"], phase=phase)
             obs.PHASE_HBM_UTIL.set(

@@ -117,7 +117,7 @@ _EWMA_ALPHA = 0.25
 # executable, never the flight phase: a chunkless full mixed step runs
 # the mixed executable under the "decode" phase, and scoring it
 # against the decode profile would whipsaw the calibration
-PROBE_KINDS = ("decode", "mixed", "verify")
+PROBE_KINDS = ("decode", "mixed", "verify", "ragged")
 
 _obs_mod = None
 

@@ -101,6 +101,20 @@ DECODE_STAT_COUNTERS = (
     # dispatches were sync-probed (FLAGS_profile_sample_steps cadence
     # or an armed capture), and bounded capture sessions completed
     "profile_probes", "profile_captures",
+    # ragged unified step (FLAGS_ragged_step): compiles of the ONE
+    # executable that serves decode, mixed prefill+decode, and
+    # speculative verify traffic alike (every row carries its own
+    # query span), and the adaptive per-slot speculation depth's
+    # shrink/grow transitions (FLAGS_spec_adaptive_k)
+    "ragged_compiles", "spec_k_shrinks", "spec_k_grows",
+    # per-executable retrace attribution: ``retraces_after_warmup``
+    # aggregates every site; these split the same events by the
+    # tracker's compile_key (<kind>_compiles -> <kind>_retraces), so
+    # "the ragged path compiles exactly one step executable and never
+    # retraces it" is a counter assertion, not a log grep
+    "decode_retraces", "prefill_retraces", "mixed_retraces",
+    "verify_retraces", "draft_retraces", "kv_quant_retraces",
+    "ragged_retraces",
 )
 DECODE_STAT_DERIVED = ("avg_step_ms", "batch_occupancy",
                        "kv_block_utilization",

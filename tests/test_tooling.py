@@ -176,6 +176,48 @@ def test_bench_spec_decode_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_bench_ragged_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_ragged.py runs end-to-end: the
+    unified-ragged-step bench can't rot.  Asserts the emitted JSON
+    shape, greedy parity of every leg against the legacy engine, the
+    ONE-step-executable contract on the ragged legs (counter-asserted,
+    zero retraces), a nonzero MEASURED mixed-batch MFU, and the
+    trajectory-facing summary scalars."""
+    out = str(tmp_path / "bench_ragged.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_ragged.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    assert data["parity"] is True
+    legs = data["legs"]
+    assert set(legs) == {"legacy_mixed", "ragged_mixed",
+                         "spec_fixed_legacy", "spec_fixed_ragged",
+                         "spec_adaptive_ragged"}
+    for name, leg in legs.items():
+        assert leg["tokens_per_s"] > 0 and leg["wall_s"] > 0, name
+        assert leg["warmup_s"] > 0, name
+        assert leg["step_compiles_timed"] == 0, name  # steady state
+        assert leg["retraces_after_warmup"] == 0, name
+    # the unification claim: ONE step executable on every ragged leg
+    for name in ("ragged_mixed", "spec_fixed_ragged",
+                 "spec_adaptive_ragged"):
+        assert legs[name]["step_executables"] == 1, name
+        assert legs[name]["ragged_retraces"] == 0, name
+    assert legs["legacy_mixed"]["step_executables"] > 1
+    for name in ("spec_fixed_ragged", "spec_adaptive_ragged"):
+        assert 0 <= legs[name]["acceptance_rate"] <= 1
+    s = data["summary"]
+    assert s["step_executables_ragged"] == 1
+    assert s["mfu_measured_ragged"] > 0  # paddle_phase_mfu_measured
+    assert s["parity"] == 1.0
+    assert s["tokens_per_s_spec_adaptive"] > 0
+
+
+@pytest.mark.slow
 def test_bench_prefill_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_prefill.py runs end-to-end: the
     chunked-prefill bench can't rot.  Asserts the emitted JSON shape,

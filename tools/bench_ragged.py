@@ -1,0 +1,289 @@
+"""Unified ragged-step benchmark: one executable for every phase.
+
+Measures greedy serving through `inference.serving.DecodeEngine` with
+the unified ragged dispatch (FLAGS_ragged_step) against the legacy
+split executables, on a mixed-batch workload (more prompts than slots,
+chunked prefill interleaving with decode) and on a repetition-friendly
+speculative workload (prompt-lookup drafting at fixed K and at
+adaptive per-slot K).
+
+Per leg: tokens/s, the number of STEP executables compiled
+(decode+mixed+verify+ragged — the unification claim is that the ragged
+legs compile exactly ONE), per-executable retrace counters for the
+timed window, acceptance telemetry on the speculative legs, and —
+on the chunked legs, which run with the profiling plane armed — the
+MEASURED per-phase MFU (`paddle_phase_mfu_measured`, device-time
+attribution, not the roofline estimate).  Greedy token parity of every
+leg against the legacy engine is asserted.
+
+Emits BENCH_ragged.json (picked up by tools/bench_trajectory.py via
+its ``summary`` headline).
+
+Usage:
+    python tools/bench_ragged.py [--out BENCH_ragged.json]
+                                 [--context 256] [--new-tokens 64]
+                                 [--batch 4] [--k 4] [--smoke]
+
+``--smoke`` (or env BENCH_SMOKE=1) shrinks shapes so CI can assert the
+script end-to-end (tests/test_tooling.py).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models.gpt import GPT, GPTConfig  # noqa: E402
+
+STEP_KINDS = ("decode", "mixed", "verify", "ragged")
+
+
+def _build_model(args):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.context + args.new_tokens + 64,
+                    use_parallel_layers=False, dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    return model
+
+
+def _periodic_prompts(args):
+    """Periodic prompts (the prompt-lookup regime) so the speculative
+    legs run at high acceptance; the chunked legs only care that the
+    prompts are long enough to interleave prefill with decode."""
+    rng = np.random.RandomState(0)
+    prompts = []
+    for b in range(args.batch):
+        block = rng.randint(0, args.vocab, (args.period,))
+        reps = -(-args.context // args.period)
+        prompts.append(np.tile(block, reps)[:args.context]
+                       .astype(np.int32))
+    return prompts
+
+
+def _build(model, prompts, args, **engine_kw):
+    """Build + warm one leg's engine: the executable census window
+    (every step executable compiles here; the timed serves below must
+    compile and retrace NOTHING)."""
+    from paddle_tpu.inference.serving import (DecodeEngine, decode_stats,
+                                              reset_decode_stats)
+
+    reset_decode_stats()
+    t0 = time.perf_counter()
+    eng = DecodeEngine(model, max_seq_len=args.context + args.new_tokens,
+                       page_size=args.page_size, prefix_cache=False,
+                       **engine_kw)
+    eng.generate(prompts, max_new_tokens=min(args.new_tokens, 4))  # warm
+    built = decode_stats()
+    built["warmup_s"] = time.perf_counter() - t0
+    return eng, built
+
+
+def _timed(eng, prompts, args):
+    """One timed steady-state serve; returns (wall, outs, stats)."""
+    from paddle_tpu.inference.serving import (decode_stats,
+                                              reset_decode_stats)
+
+    reset_decode_stats()
+    t0 = time.perf_counter()
+    outs = eng.generate(prompts, max_new_tokens=args.new_tokens)
+    return time.perf_counter() - t0, outs, decode_stats()
+
+
+def _leg_row(wall, total, built, run, k=None):
+    row = {
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(total / wall, 2),
+        # steady-state claim, counter-asserted: the step executables
+        # compiled once at build+warm, and the timed window compiled
+        # and retraced NOTHING
+        "step_executables": sum(
+            built[f"{kind}_compiles"] for kind in STEP_KINDS),
+        # build + compile + warm-serve time: the census window.  Fewer
+        # executables = less to compile — unification's unconditional
+        # win, independent of the padding-FLOP tradeoff
+        "warmup_s": round(built["warmup_s"], 4),
+        "step_compiles_timed": sum(
+            run[f"{kind}_compiles"] for kind in STEP_KINDS),
+        "retraces_after_warmup": run["retraces_after_warmup"],
+        "ragged_retraces": run["ragged_retraces"],
+    }
+    if k is not None:
+        row.update(k=k,
+                   acceptance_rate=round(run["acceptance_rate"], 4),
+                   mean_accepted_per_step=round(
+                       run["mean_accepted_per_step"], 3),
+                   spec_steps=run["spec_steps"],
+                   spec_k_shrinks=run["spec_k_shrinks"],
+                   spec_k_grows=run["spec_k_grows"])
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_ragged.json"))
+    ap.add_argument("--context", type=int, default=256)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--period", type=int, default=16)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--prefill-q-max", type=int, default=16)
+    ap.add_argument("--k", type=int, default=4,
+                    help="speculation depth for the spec legs")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="timed serves per leg; best wall is reported")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes: CI end-to-end check")
+    args = ap.parse_args()
+    if os.environ.get("BENCH_SMOKE") == "1":
+        args.smoke = True
+    if args.smoke:
+        args.context, args.new_tokens, args.batch = 48, 8, 2
+        args.hidden, args.vocab, args.period = 64, 128, 8
+        args.prefill_q_max = 8
+        args.repeats = 1
+
+    import jax
+
+    from paddle_tpu.inference.speculative import PromptLookupDrafter
+
+    model = _build_model(args)
+    prompts = _periodic_prompts(args)
+    total = args.batch * args.new_tokens
+    slots = max(1, args.batch // 2)  # staggered: mixed batches happen
+
+    legs, mfu = {}, {}
+
+    # mixed-batch legs run with chunked prefill + the profiling plane
+    # armed (measured MFU); speculative legs compare fixed K against
+    # adaptive per-slot K
+    chunk_kw = dict(max_batch_size=slots, chunked_prefill=True,
+                    prefill_q_max=args.prefill_q_max,
+                    profile=True, profile_sample_steps=1,
+                    cost_model=True)
+    leg_defs = [
+        ("legacy_mixed", dict(chunk_kw), None),
+        ("ragged_mixed", dict(chunk_kw, ragged_step=True), None),
+        ("spec_fixed_legacy", dict(max_batch_size=slots,
+                                   spec_decode_k=args.k), args.k),
+        ("spec_fixed_ragged", dict(max_batch_size=slots,
+                                   spec_decode_k=args.k,
+                                   ragged_step=True), args.k),
+        ("spec_adaptive_ragged", dict(max_batch_size=slots,
+                                      spec_decode_k=args.k,
+                                      ragged_step=True,
+                                      spec_adaptive_k=True), args.k),
+    ]
+    engines, builts = {}, {}
+    for name, kw, _ in leg_defs:
+        if "spec_decode_k" in kw:
+            kw = dict(kw, drafter=PromptLookupDrafter())
+        engines[name], builts[name] = _build(model, prompts, args, **kw)
+
+    # timed serves INTERLEAVED across legs (round-robin, best wall per
+    # leg): slow drift in the host perturbs every leg's r-th repeat the
+    # same way instead of biasing whichever leg ran in a slow window
+    walls = {name: float("inf") for name, _, _ in leg_defs}
+    outs, runs = {}, {}
+    for _ in range(max(1, args.repeats)):
+        for name, _, _ in leg_defs:
+            w, o, r = _timed(engines[name], prompts, args)
+            if w < walls[name]:
+                walls[name], runs[name] = w, r
+            outs[name] = o
+
+    outs_base = outs["legacy_mixed"]
+    parity = True
+    for name, _, k in leg_defs:
+        legs[name] = _leg_row(walls[name], total, builts[name],
+                              runs[name], k=k)
+        ok = outs[name] == outs_base
+        parity = parity and ok
+        print(f"{name:<21}: {total / walls[name]:9.1f} tok/s  "
+              f"({legs[name]['step_executables']} step executables, "
+              f"parity={ok})")
+    wall_l, wall_r = walls["legacy_mixed"], walls["ragged_mixed"]
+    for name in ("legacy_mixed", "ragged_mixed"):
+        mfu[name] = engines[name]._profiling.statusz()["mfu_measured"]
+
+    ragged_mfu = mfu["ragged_mixed"].get("ragged", 0.0)
+    summary = {
+        # the tentpole, as trajectory-tracked scalars
+        "step_executables_legacy": legs["legacy_mixed"][
+            "step_executables"],
+        "step_executables_ragged": legs["ragged_mixed"][
+            "step_executables"],
+        "ragged_retraces": legs["ragged_mixed"]["ragged_retraces"],
+        "warmup_s_legacy": legs["legacy_mixed"]["warmup_s"],
+        "warmup_s_ragged": legs["ragged_mixed"]["warmup_s"],
+        "mfu_measured_legacy_mixed": round(float(
+            mfu["legacy_mixed"].get("mixed", 0.0)), 6),
+        "mfu_measured_ragged": round(float(ragged_mfu), 6),
+        "tokens_per_s_legacy": legs["legacy_mixed"]["tokens_per_s"],
+        "tokens_per_s_ragged": legs["ragged_mixed"]["tokens_per_s"],
+        "ragged_vs_legacy": round(wall_l / wall_r, 3),
+        # acceptance-weighted throughput, fixed vs adaptive depth
+        "tokens_per_s_spec_legacy": legs["spec_fixed_legacy"][
+            "tokens_per_s"],
+        "tokens_per_s_spec_fixed": legs["spec_fixed_ragged"][
+            "tokens_per_s"],
+        "spec_ragged_vs_legacy": round(
+            legs["spec_fixed_ragged"]["tokens_per_s"]
+            / legs["spec_fixed_legacy"]["tokens_per_s"], 3),
+        "tokens_per_s_spec_adaptive": legs["spec_adaptive_ragged"][
+            "tokens_per_s"],
+        "adaptive_vs_fixed": round(
+            legs["spec_adaptive_ragged"]["tokens_per_s"]
+            / legs["spec_fixed_ragged"]["tokens_per_s"], 3),
+        "acceptance_rate_adaptive": legs["spec_adaptive_ragged"][
+            "acceptance_rate"],
+        "parity": 1.0 if parity else 0.0,
+    }
+
+    out = {
+        "bench": "unified ragged step: executables per step, measured "
+                 "mixed-batch MFU, adaptive-K tokens/s",
+        "device": str(jax.devices()[0].device_kind)
+        if jax.devices() else "unknown",
+        "smoke": bool(args.smoke),
+        "config": {"batch": args.batch, "slots": slots,
+                   "context": args.context,
+                   "new_tokens": args.new_tokens, "period": args.period,
+                   "layers": args.layers, "hidden": args.hidden,
+                   "heads": args.heads, "vocab": args.vocab,
+                   "page_size": args.page_size,
+                   "prefill_q_max": args.prefill_q_max, "k": args.k,
+                   "repeats": args.repeats},
+        "legs": legs,
+        "mfu_measured": mfu,
+        "summary": summary,
+        "parity": bool(parity),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {args.out} (parity={parity})")
+    if not parity:
+        return 1
+    # the unification claim is a hard exit condition, not just a field
+    if summary["step_executables_ragged"] != 1 or \
+            summary["ragged_retraces"] != 0:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
