@@ -206,6 +206,20 @@ define_flag("ragged_step", False,
             "path compiles the exact same executables as before and "
             "stays the parity oracle).  Engines constructed with an "
             "explicit ragged_step ignore the flag")
+define_flag("serve_mesh", "",
+            "tensor-parallel serving mesh spec for inference.serving."
+            "DecodeEngine, e.g. 'mp=2' or 'mp=4': the engine builds a "
+            "Mesh over that many devices, shards params by the regex "
+            "partition rules in parallel.partition (column-split "
+            "qkv/fc1, row-split out/fc2, replicated norms/embeddings) "
+            "and shards the KV page pool on the head axis (each chip "
+            "holds its head-slice of every page; block tables and the "
+            "page allocator stay host-global).  Implies the unified "
+            "ragged step — the mesh shards the ONE step executable "
+            "per KV mode.  Greedy tokens stay token-identical to the "
+            "single-chip engine; '' (default) = single-chip path, "
+            "bit-exact, zero sharding machinery touched.  Engines "
+            "constructed with an explicit serve_mesh ignore the flag")
 define_flag("spec_adaptive_k", False,
             "adaptive per-slot speculation depth (inference."
             "speculative.SpeculativeDecoder): each slot's draft "
@@ -378,6 +392,14 @@ define_flag("peak_hbm_gbps", 0.0,
             "observatory's paddle_phase_hbm_util gauges and step-cost "
             "predictor; 0 (default) = autodetect from the device kind "
             "(CPU pins fixed test values)")
+define_flag("peak_ici_gbps", 0.0,
+            "roofline interconnect ceiling in GB/s for the cost "
+            "observatory's collective-bytes term (sharded executables "
+            "under FLAGS_serve_mesh): predict_step_cost adds "
+            "collective_bytes / ici_bytes_per_s to the roofline "
+            "seconds of any profile whose HLO contains collectives; "
+            "0 (default) = autodetect from the device kind (CPU pins "
+            "a fixed test value so CI gauges are deterministic)")
 define_flag("cost_memory_analysis", False,
             "additionally compile the lowered computation AOT and "
             "record each executable's peak temp-buffer allocation "

@@ -638,6 +638,21 @@ def _install_kv_sidecar(journal_dir: str, snap: SnapshotWire,
             jnp.asarray(data["ks"][:, :, :n]))
         eng._v_scales = eng._v_scales.at[:, :, idx].set(
             jnp.asarray(data["vs"][:, :, :n]))
+    if getattr(eng, "_mesh", None) is not None:
+        # the host-side scatter above ran OUTSIDE the step executables
+        # and may have left the pool with whatever sharding GSPMD
+        # propagated; re-pin the head-axis layout so the first step
+        # after restore sees the exact input shardings it compiled
+        # against (a drifted sharding would be a warm retrace)
+        import jax
+
+        eng._k_pages = jax.device_put(eng._k_pages, eng._page_sharding)
+        eng._v_pages = jax.device_put(eng._v_pages, eng._page_sharding)
+        if eng._kv_quant:
+            eng._k_scales = jax.device_put(eng._k_scales,
+                                           eng._scale_sharding)
+            eng._v_scales = jax.device_put(eng._v_scales,
+                                           eng._scale_sharding)
     installed = 0
     for pid, key in zip(ids, hashes[:n]):
         if eng.pool.register_page(pid, key):

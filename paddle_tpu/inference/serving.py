@@ -77,6 +77,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core.tensor import unwrap
 from ..ops.pallas import paged_attention as pa
@@ -1194,9 +1195,30 @@ def _gpt_mixed_step_q(params, k_pages, v_pages, k_scales, v_scales,
 # lets the off path compile the exact same executables as before this
 # feature existed (zero new executables in off mode).
 # ---------------------------------------------------------------------------
+def _mesh_constrain(mesh):
+    """Sharding-constraint applicator for the serving mesh: ``None``
+    (the single-chip path) returns an identity, so the ragged twins
+    trace EXACTLY the ops they always traced — zero sharding machinery
+    on the off path.  With a mesh, ``cst(x, *axes)`` pins ``x`` to
+    ``PartitionSpec(*axes)`` over it (``cst(x)`` = replicated), the
+    GSPMD boundary annotations that turn the one ragged executable
+    into a tensor-parallel program: column-split qkv/fc1 compute runs
+    head-/feature-local, row-split out/fc2 matmuls end in the
+    all-reduce the replicated-residual constraint forces."""
+    if mesh is None:
+        return lambda x, *spec: x
+
+    def cst(x, *spec):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+    return cst
+
+
 def _gpt_ragged_step(params, k_pages, v_pages, block_tables, seq_lens,
                      tokens, write_caps, key, *, num_heads, head_dim,
-                     eps, sampler, temperature, top_k, top_p):
+                     eps, sampler, temperature, top_k, top_p,
+                     mesh=None):
     """The unified ragged step: score up to Q_r incoming tokens per
     slot in ONE pass — write rows ``i < write_caps[b]`` into the slot's
     already-reserved pages (capped rows are dropped by the scatter),
@@ -1221,6 +1243,7 @@ def _gpt_ragged_step(params, k_pages, v_pages, block_tables, seq_lens,
     h = num_heads * head_dim
     num_pages_total = k_pages.shape[2]
     page = k_pages.shape[3]
+    cst = _mesh_constrain(mesh)
 
     pos = seq_lens[:, None] + jnp.arange(qn, dtype=jnp.int32)[None, :]
     wpe_max = params["wpe"].shape[0] - 1
@@ -1232,20 +1255,34 @@ def _gpt_ragged_step(params, k_pages, v_pages, block_tables, seq_lens,
     for li, blk in enumerate(params["blocks"]):
         y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
         qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
-        qkv = qkv.reshape(b, qn, 3, num_heads, head_dim)
+        # head axis sharded over 'mp' from here: the KV scatter and the
+        # paged-attention gather stay chip-local (each chip owns its
+        # head-slice of every page)
+        qkv = cst(qkv.reshape(b, qn, 3, num_heads, head_dim),
+                  None, None, None, "mp", None)
         q = qkv[:, :, 0]                                 # [B, Q, H, D]
-        k_pages = k_pages.at[li, :, page_idx, slot, :].set(qkv[:, :, 1])
-        v_pages = v_pages.at[li, :, page_idx, slot, :].set(qkv[:, :, 2])
-        attn = pa.paged_attention(q, k_pages[li], v_pages[li],
-                                  block_tables, lens_now,
-                                  q_offsets=seq_lens)
-        x = x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"]) \
-            + blk["out_b"]
+        k_pages = cst(
+            k_pages.at[li, :, page_idx, slot, :].set(qkv[:, :, 1]),
+            None, "mp", None, None, None)
+        v_pages = cst(
+            v_pages.at[li, :, page_idx, slot, :].set(qkv[:, :, 2]),
+            None, "mp", None, None, None)
+        attn = cst(pa.paged_attention(q, k_pages[li], v_pages[li],
+                                      block_tables, lens_now,
+                                      q_offsets=seq_lens),
+                   None, None, "mp", None)
+        # row-parallel out proj: replicating the residual forces the
+        # cross-chip all-reduce exactly here (heads fuse head-major
+        # into h, so the reshape keeps the 'mp' shards contiguous)
+        x = cst(x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"])
+                + blk["out_b"])
         y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
-        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
-                        approximate=True)
-        x = x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
-                 ).reshape(b, qn, h)
+        y = cst(jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+                            approximate=True),
+                None, "mp")
+        # row-parallel fc2: second all-reduce of the block
+        x = cst(x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+                     ).reshape(b, qn, h))
 
     xf = _ln(x.reshape(b * qn, h), params["lnf_w"], params["lnf_b"], eps)
     logits = _logits_of(params, xf).astype(jnp.float32)
@@ -1264,7 +1301,7 @@ def _gpt_ragged_step(params, k_pages, v_pages, block_tables, seq_lens,
 def _gpt_ragged_step_q(params, k_pages, v_pages, k_scales, v_scales,
                        block_tables, seq_lens, tokens, write_caps, key,
                        *, num_heads, head_dim, eps, sampler,
-                       temperature, top_k, top_p):
+                       temperature, top_k, top_p, mesh=None):
     """Quantized-storage `_gpt_ragged_step` (FLAGS_kv_quant=int8):
     every contributed row quantizes into its slot's pages through
     `pa.paged_quant_write` (span-aware: capped rows never fold a
@@ -1277,6 +1314,7 @@ def _gpt_ragged_step_q(params, k_pages, v_pages, k_scales, v_scales,
     h = num_heads * head_dim
     num_pages_total = k_pages.shape[2]
     page = k_pages.shape[3]
+    cst = _mesh_constrain(mesh)
 
     pos = seq_lens[:, None] + jnp.arange(qn, dtype=jnp.int32)[None, :]
     wpe_max = params["wpe"].shape[0] - 1
@@ -1293,29 +1331,40 @@ def _gpt_ragged_step_q(params, k_pages, v_pages, k_scales, v_scales,
     for li, blk in enumerate(params["blocks"]):
         y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
         qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
-        qkv = qkv.reshape(b, qn, 3, num_heads, head_dim)
+        # head axis sharded over 'mp' from here (see _gpt_ragged_step);
+        # the per-head quant scales shard with their pages, so the
+        # scale fold/refold reductions over head_dim stay chip-local
+        qkv = cst(qkv.reshape(b, qn, 3, num_heads, head_dim),
+                  None, None, None, "mp", None)
         q = qkv[:, :, 0]                                 # [B, Q, H, D]
         k_pages, k_scales, rk = pa.paged_quant_write(
             k_pages, k_scales, li,
             qkv[:, :, 1].reshape(b * qn, num_heads, head_dim),
             flat_idx, flat_slot, spans)
+        k_pages = cst(k_pages, None, "mp", None, None, None)
+        k_scales = cst(k_scales, None, "mp", None)
         v_pages, v_scales, rv = pa.paged_quant_write(
             v_pages, v_scales, li,
             qkv[:, :, 2].reshape(b * qn, num_heads, head_dim),
             flat_idx, flat_slot, spans)
+        v_pages = cst(v_pages, None, "mp", None, None, None)
+        v_scales = cst(v_scales, None, "mp", None)
         refolds = refolds + rk + rv
-        attn = pa.paged_attention(q, k_pages[li], v_pages[li],
-                                  block_tables, lens_now,
-                                  q_offsets=seq_lens,
-                                  k_scales=k_scales[li],
-                                  v_scales=v_scales[li])
-        x = x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"]) \
-            + blk["out_b"]
+        attn = cst(pa.paged_attention(q, k_pages[li], v_pages[li],
+                                      block_tables, lens_now,
+                                      q_offsets=seq_lens,
+                                      k_scales=k_scales[li],
+                                      v_scales=v_scales[li]),
+                   None, None, "mp", None)
+        # row-parallel out proj / fc2: the block's two all-reduces
+        x = cst(x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"])
+                + blk["out_b"])
         y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
-        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
-                        approximate=True)
-        x = x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
-                 ).reshape(b, qn, h)
+        y = cst(jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+                            approximate=True),
+                None, "mp")
+        x = cst(x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+                     ).reshape(b, qn, h))
 
     xf = _ln(x.reshape(b * qn, h), params["lnf_w"], params["lnf_b"], eps)
     logits = _logits_of(params, xf).astype(jnp.float32)
@@ -1377,7 +1426,8 @@ class DecodeEngine:
                  flight_window=None, flight_dir=None, kv_quant=None,
                  cost_model=None, cost_calibration=None, alerts=None,
                  profile=None, profile_sample_steps=None,
-                 ragged_step=None, spec_adaptive_k=None):
+                 ragged_step=None, spec_adaptive_k=None,
+                 serve_mesh=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -1572,10 +1622,83 @@ class DecodeEngine:
         # `_gpt_ragged_step[_q]` executable, each row carrying its own
         # query span.  Off (the default) keeps the split executables
         # byte-identical — the greedy-parity oracle.
+        ragged_explicit = ragged_step is not None
         if ragged_step is None:
             ragged_step = bool(_flags.flag("ragged_step"))
         self._ragged = bool(ragged_step)
         self._ragged_fn = None
+
+        # tensor-parallel serving mesh (explicit arg wins, else
+        # FLAGS_serve_mesh): 'mp=N' builds a Mesh over N devices,
+        # shards the params by the shared regex partition rules
+        # (parallel.partition.gpt_serving_rules: column-split qkv/fc1,
+        # row-split out/fc2, replicated norms/embeddings/head) and the
+        # KV page pool on the HEAD axis — each chip holds its
+        # head-slice of every page, so page ids stay logical and the
+        # allocator / block tables stay host-global, untouched.  The
+        # mesh implies the unified ragged step: it shards the ONE step
+        # executable per KV mode rather than three.  '' (default) is
+        # the single-chip path: no mesh, no shardings, bit-exact.
+        if serve_mesh is None:
+            serve_mesh = str(_flags.flag("serve_mesh"))
+        serve_mesh = str(serve_mesh or "").strip()
+        self._serve_mesh = serve_mesh
+        self._mesh = None
+        self._mesh_mp = 1
+        self._repl_sharding = None
+        self._page_sharding = None
+        self._scale_sharding = None
+        if serve_mesh:
+            from ..parallel.partition import (build_mesh,
+                                              gpt_serving_rules,
+                                              kv_pages_spec,
+                                              kv_scales_spec,
+                                              match_partition_rules,
+                                              parse_mesh_spec)
+
+            axes = parse_mesh_spec(serve_mesh)
+            if [a for a, _ in axes] != ["mp"]:
+                raise ValueError(
+                    f"serve_mesh supports a single tensor-parallel "
+                    f"axis 'mp=N', got {serve_mesh!r}")
+            mp = axes[0][1]
+            if len(jax.devices()) < mp:
+                raise ValueError(
+                    f"serve_mesh {serve_mesh!r} needs {mp} devices, "
+                    f"have {len(jax.devices())}")
+            if self._num_heads % mp:
+                raise ValueError(
+                    f"serve_mesh {serve_mesh!r}: num_heads "
+                    f"{self._num_heads} not divisible by mp={mp}")
+            if ragged_explicit and not self._ragged:
+                raise ValueError(
+                    "serve_mesh requires the unified ragged step (the "
+                    "mesh shards the ONE step executable per KV "
+                    "mode): drop ragged_step=0, or the mesh")
+            self._ragged = True
+            self._mesh = build_mesh(serve_mesh)
+            self._mesh_mp = mp
+            self._repl_sharding = NamedSharding(self._mesh,
+                                                PartitionSpec())
+            self._page_sharding = NamedSharding(self._mesh,
+                                                kv_pages_spec())
+            self._scale_sharding = NamedSharding(self._mesh,
+                                                 kv_scales_spec())
+            specs = match_partition_rules(gpt_serving_rules(),
+                                          self._params)
+            self._params = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self._mesh, s)),
+                self._params, specs)
+            self._k_pages = jax.device_put(self._k_pages,
+                                           self._page_sharding)
+            self._v_pages = jax.device_put(self._v_pages,
+                                           self._page_sharding)
+            if self._kv_quant:
+                self._k_scales = jax.device_put(self._k_scales,
+                                                self._scale_sharding)
+                self._v_scales = jax.device_put(self._v_scales,
+                                                self._scale_sharding)
         # the unified executable's per-slot row width: wide enough for
         # the widest span any phase contributes — a decode row (1), a
         # prompt chunk (Q_max), a verify window (K+1).  Rows past a
@@ -1678,7 +1801,8 @@ class DecodeEngine:
             kv_quant=self._kv_quant_mode,
             ragged_step=self._ragged,
             spec_adaptive_k=(self._spec.adaptive
-                             if self._spec is not None else False))
+                             if self._spec is not None else False),
+            serve_mesh=self._serve_mesh)
 
         # flight recorder (observability.flight): always-cheap bounded
         # ring of per-step records — batch composition, phase
@@ -1878,6 +2002,13 @@ class DecodeEngine:
                 # executables ARE identical); a ragged engine can never
                 # adopt a split-path engine's executables or vice versa
                 h.update(str(("ragged", self._q_ragged)).encode())
+            if self._mesh is not None:
+                # same conditional-fold reason: single-chip
+                # fingerprints stay byte-identical with pre-mesh
+                # journals/donors, and a sharded engine (whose
+                # executables carry mesh shardings) can never adopt a
+                # single-chip engine's executables or vice versa
+                h.update(str(("mesh", self._serve_mesh)).encode())
             self._config_fp = h.digest()
         return self._config_fp
 
@@ -2111,7 +2242,7 @@ class DecodeEngine:
         fn = self._scale_reset_tracker()
         with self._phase("cache"):
             self._k_scales, self._v_scales = fn(
-                self._k_scales, self._v_scales, jnp.asarray(buf))
+                self._k_scales, self._v_scales, self._dev(buf))
             if self._spec is not None and \
                     getattr(self._spec.drafter, "_k_scales", None) \
                     is not None:
@@ -2441,14 +2572,15 @@ class DecodeEngine:
             if self._kv_quant:
                 (self._k_pages, self._v_pages, self._k_scales,
                  self._v_scales, tok) = fn(
-                    self._params, jnp.asarray(ids), jnp.int32(p_len),
-                    jnp.asarray(self._bt[slot]), self._k_pages,
-                    self._v_pages, self._k_scales, self._v_scales, key)
+                    self._params, self._dev(ids), jnp.int32(p_len),
+                    self._dev(self._bt[slot]), self._k_pages,
+                    self._v_pages, self._k_scales, self._v_scales,
+                    self._dev(key))
             else:
                 self._k_pages, self._v_pages, tok = fn(
-                    self._params, jnp.asarray(ids), jnp.int32(p_len),
-                    jnp.asarray(self._bt[slot]), self._k_pages,
-                    self._v_pages, key)
+                    self._params, self._dev(ids), jnp.int32(p_len),
+                    self._dev(self._bt[slot]), self._k_pages,
+                    self._v_pages, self._dev(key))
         tok = self._host_fetch(tok)
         if self._kv_quant:
             self._note_refolds(int(tok[1]))
@@ -2923,7 +3055,8 @@ class DecodeEngine:
                     functools.partial(_gpt_ragged_step_q,
                                       num_heads=self._num_heads,
                                       head_dim=self._head_dim,
-                                      eps=self._eps, **self._sampling),
+                                      eps=self._eps,
+                                      mesh=self._mesh, **self._sampling),
                     "ragged_compiles", donate_argnums=(1, 2, 3, 4),
                     site="DecodeEngine ragged step (_gpt_ragged_step_q)")
             else:
@@ -2931,7 +3064,8 @@ class DecodeEngine:
                     functools.partial(_gpt_ragged_step,
                                       num_heads=self._num_heads,
                                       head_dim=self._head_dim,
-                                      eps=self._eps, **self._sampling),
+                                      eps=self._eps,
+                                      mesh=self._mesh, **self._sampling),
                     "ragged_compiles", donate_argnums=(1, 2),
                     site="DecodeEngine ragged step (_gpt_ragged_step)")
         return fn
@@ -3020,17 +3154,17 @@ class DecodeEngine:
                          self._v_scales, toks) = fn(
                             self._params, self._k_pages, self._v_pages,
                             self._k_scales, self._v_scales,
-                            jnp.asarray(self._bt),
-                            jnp.asarray(self._lens),
-                            jnp.asarray(tokens), jnp.asarray(caps),
-                            key)
+                            self._dev(self._bt),
+                            self._dev(self._lens),
+                            self._dev(tokens), self._dev(caps),
+                            self._dev(key))
                     else:
                         self._k_pages, self._v_pages, toks = fn(
                             self._params, self._k_pages, self._v_pages,
-                            jnp.asarray(self._bt),
-                            jnp.asarray(self._lens),
-                            jnp.asarray(tokens), jnp.asarray(caps),
-                            key)
+                            self._dev(self._bt),
+                            self._dev(self._lens),
+                            self._dev(tokens), self._dev(caps),
+                            self._dev(key))
                 elif self._kv_quant:
                     (self._k_pages, self._v_pages, self._k_scales,
                      self._v_scales, toks) = fn(
@@ -3197,6 +3331,18 @@ class DecodeEngine:
             live_pages=[p for r in self._by_slot if r is not None
                         for p in r.pages])
 
+    def _dev(self, x):
+        """Host->device for step-executable operands.  Single-chip:
+        plain `jnp.asarray` — the bit-exact historical behavior.
+        Under a serving mesh: the operand commits to the mesh
+        REPLICATED, so every call presents the step executable the
+        same input shardings (the jit cache keys on them; uncommitted
+        operands would leave placement to GSPMD's per-call whim and
+        risk a warm retrace)."""
+        if self._mesh is None:
+            return jnp.asarray(x)
+        return jax.device_put(x, self._repl_sharding)
+
     def _host_fetch(self, x):
         """THE engine's blocking device->host read.  Every place the
         serve loop materializes device data (sampled tokens, verify
@@ -3295,6 +3441,9 @@ class DecodeEngine:
                     self._spec.adaptive if self._spec is not None
                     else False),
                 "ragged_step": bool(self._ragged),
+                "serve_mesh": self._serve_mesh,
+                "mesh_devices": self._mesh_mp if self._mesh is not None
+                else 1,
                 "sampling": dict(self._sampling),
             },
             "queue": [_req(r) for r in self._snapshot_queue()],

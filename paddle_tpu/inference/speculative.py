@@ -928,13 +928,15 @@ class SpeculativeDecoder:
                      eng._v_scales, targets) = fn(
                         eng._params, eng._k_pages, eng._v_pages,
                         eng._k_scales, eng._v_scales,
-                        jnp.asarray(eng._bt), jnp.asarray(eng._lens),
-                        jnp.asarray(tokens), jnp.asarray(caps), key)
+                        eng._dev(eng._bt), eng._dev(eng._lens),
+                        eng._dev(tokens), eng._dev(caps),
+                        eng._dev(key))
                 else:
                     eng._k_pages, eng._v_pages, targets = fn(
                         eng._params, eng._k_pages, eng._v_pages,
-                        jnp.asarray(eng._bt), jnp.asarray(eng._lens),
-                        jnp.asarray(tokens), jnp.asarray(caps), key)
+                        eng._dev(eng._bt), eng._dev(eng._lens),
+                        eng._dev(tokens), eng._dev(caps),
+                        eng._dev(key))
                 if eng._profiling is not None:
                     # sampled device-sync probe (observability.
                     # profiling): the verify executable's measured

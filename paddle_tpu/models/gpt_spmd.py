@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..parallel.partition import gpt_train_rules, match_partition_rules
 from ..parallel.ring_attention import ring_attention_local
 from .gpt import GPTConfig
 
@@ -40,21 +41,25 @@ from .gpt import GPTConfig
 # ---------------------------------------------------------------------------
 # parameter pytree (global logical shapes) + PartitionSpecs
 # ---------------------------------------------------------------------------
+# parameter names in the train pytree; specs come from the shared
+# regex rule engine (parallel.partition) so train and serve derive
+# their tensor-parallel geometry from ONE rule table convention:
+# vocab-parallel embedding, pp-stacked blocks, column-split qkv/fc1,
+# row-split out/fc2, replicated norms.
+_PARAM_NAMES = (
+    "wte", "wpe",
+    "ln1_w", "ln1_b", "w_qkv", "b_qkv", "w_out", "b_out",
+    "ln2_w", "ln2_b", "w_fc1", "b_fc1", "w_fc2", "b_fc2",
+    "lnf_w", "lnf_b", "lm_head",
+)
+
+
 def param_specs(cfg: GPTConfig) -> Dict[str, P]:
-    return {
-        # embeddings: vocab table mp-sharded on vocab dim (vocab-parallel)
-        "wte": P("mp", None),
-        "wpe": P(),
-        # stacked blocks: leading dim L sharded over pp
-        "ln1_w": P("pp", None), "ln1_b": P("pp", None),
-        "w_qkv": P("pp", None, "mp"), "b_qkv": P("pp", "mp"),
-        "w_out": P("pp", "mp", None), "b_out": P("pp", None),
-        "ln2_w": P("pp", None), "ln2_b": P("pp", None),
-        "w_fc1": P("pp", None, "mp"), "b_fc1": P("pp", "mp"),
-        "w_fc2": P("pp", "mp", None), "b_fc2": P("pp", None),
-        "lnf_w": P(), "lnf_b": P(),
-        "lm_head": P(None, "mp"),  # [H, V] vocab-sharded
-    }
+    # 2-D placeholders: match_partition_rules replicates scalars and
+    # size-1 leaves, so specs must be derived from names alone, not
+    # from real (possibly degenerate) shapes
+    shapes = {k: jnp.zeros((2, 2)) for k in _PARAM_NAMES}
+    return match_partition_rules(gpt_train_rules(), shapes)
 
 
 def init_params(cfg: GPTConfig, key) -> Dict[str, jnp.ndarray]:

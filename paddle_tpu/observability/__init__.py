@@ -71,6 +71,8 @@ paddle_executable_device_seconds               gauge      fn
 paddle_host_overhead_ratio                     gauge      engine
 paddle_phase_mfu_measured                      gauge      phase
 paddle_mfu_drift                               gauge      phase
+paddle_collective_bytes                        gauge      fn
+paddle_chip_skew_seconds                       gauge      engine
 paddle_trace_spans_dropped_total               counter    —
 =============================================  =========  ==========
 
@@ -458,6 +460,26 @@ MFU_DRIFT = gauge(
     "no longer describe what the device actually does (a regime "
     "change relearns in tens of probes; the fire marks the change)",
     labels=("phase",))
+COLLECTIVE_BYTES = gauge(
+    "paddle_collective_bytes",
+    "Interconnect bytes ONE invocation of a sharded step executable "
+    "moves through collectives (all-reduce / all-gather / "
+    "reduce-scatter / collective-permute / all-to-all output shapes "
+    "summed from the optimized post-SPMD HLO at compile time, by "
+    "_JitTracker site) — the numerator of the cost observatory's ICI "
+    "roofline term (FLAGS_peak_ici_gbps).  Only set for executables "
+    "compiled against mesh-sharded operands (FLAGS_serve_mesh); a "
+    "single-chip engine never emits this series",
+    labels=("fn",))
+CHIP_SKEW = gauge(
+    "paddle_chip_skew_seconds",
+    "Per-chip completion skew of the most recent probed sharded step "
+    "(observability.profiling under FLAGS_serve_mesh: the probe "
+    "blocks each addressable shard of the step output in turn and "
+    "records max-minus-min completion) — sustained skew means one "
+    "chip is the straggler every step and the mesh runs at its pace. "
+    "Zero (and absent) on single-chip engines",
+    labels=("engine",))
 TRACE_SPANS_DROPPED = counter(
     "paddle_trace_spans_dropped_total",
     "Spans the tracing buffer (observability.tracing) refused past "

@@ -123,6 +123,12 @@ _EWMA_ALPHA = 0.25
 # sane ranges, never absolute truth).
 _CPU_PEAK_FLOPS = 5.0e10   # 50 GFLOP/s
 _CPU_PEAK_BYTES = 2.0e10   # 20 GB/s
+# Pinned interconnect "peak" for the collective-bytes roofline term
+# (sharded executables under FLAGS_serve_mesh).  One pinned default
+# rather than a per-device datasheet column: FLAGS_peak_ici_gbps
+# overrides for real hardware, and the pin keeps CPU CI gauges
+# deterministic (the same reason the FLOP/byte peaks pin).
+_CPU_PEAK_ICI = 1.0e10     # 10 GB/s
 
 # device_kind substring -> (peak FLOP/s dense bf16, peak HBM bytes/s).
 # Datasheet numbers; the flags override for anything unlisted.
@@ -172,14 +178,18 @@ def resolve_peaks() -> Dict[str, float]:
     """The roofline ceilings: ``FLAGS_peak_flops`` /
     ``FLAGS_peak_hbm_gbps`` when positive, else autodetected from the
     default device's kind (datasheet table above; CPU pins the fixed
-    test values so CI gauges are deterministic)."""
+    test values so CI gauges are deterministic).  ``ici_bytes_per_s``
+    (``FLAGS_peak_ici_gbps``, else the pinned default) divides the
+    collective-bytes term of sharded executables."""
     from ..core import flags as _flags
 
     flops = float(_flags.flag("peak_flops"))
     gbps = float(_flags.flag("peak_hbm_gbps"))
+    ici = float(_flags.flag("peak_ici_gbps"))
+    ici_bps = ici * 1e9 if ici > 0 else _CPU_PEAK_ICI
     if flops > 0 and gbps > 0:
         return {"flops": flops, "bytes_per_s": gbps * 1e9,
-                "source": "flags"}
+                "ici_bytes_per_s": ici_bps, "source": "flags"}
     kind = ""
     try:
         import jax
@@ -194,6 +204,7 @@ def resolve_peaks() -> Dict[str, float]:
             break
     return {"flops": flops if flops > 0 else det_f,
             "bytes_per_s": gbps * 1e9 if gbps > 0 else det_b,
+            "ici_bytes_per_s": ici_bps,
             "source": source}
 
 
@@ -214,11 +225,13 @@ class CostProfile:
     temp_bytes: float = 0.0
     source: str = "hlo"  # "hlo" | "analytical"
     hot_ops: tuple = ()  # profiling.hot_op_table rows (top-K per op)
+    collective_bytes: float = 0.0  # interconnect volume (sharded only)
 
     def to_obj(self) -> dict:
         return {"site": self.site, "flops": self.flops,
                 "bytes_accessed": self.bytes_accessed,
                 "temp_bytes": self.temp_bytes, "source": self.source,
+                "collective_bytes": self.collective_bytes,
                 "hot_ops": [dict(r) for r in self.hot_ops]}
 
 
@@ -228,26 +241,65 @@ def profile_signature(site: str, args) -> tuple:
     executables by (core.dispatch), rooted at the tracker's site label
     (two different step functions over identical operand shapes are
     different programs).  Non-array operands key by type+value, the
-    dispatch scheme's static-scalar rule."""
+    dispatch scheme's static-scalar rule.  A mesh-sharded operand
+    (FLAGS_serve_mesh) additionally keys by its PartitionSpec — the
+    jit cache re-keys on input shardings for the same reason: a
+    single-chip and a sharded engine at identical shapes run DIFFERENT
+    programs (the sharded one carries collectives), and sharing a
+    profile between them would attribute one's collective bytes (or
+    their absence) to the other.  Single-chip keys are unchanged."""
+    def _shard_tag(x):
+        sh = getattr(x, "sharding", None)
+        try:
+            if sh is not None and len(sh.device_set) > 1:
+                return str(getattr(sh, "spec", sh))
+        except Exception:
+            pass
+        return None
+
     sig = []
     for a in args:
         shape = getattr(a, "shape", None)
         dtype = getattr(a, "dtype", None)
         if shape is not None and dtype is not None:
-            sig.append((tuple(shape), str(dtype),
-                        bool(getattr(a, "weak_type", False))))
+            row = (tuple(shape), str(dtype),
+                   bool(getattr(a, "weak_type", False)))
+            tag = _shard_tag(a)
+            sig.append(row if tag is None else row + (tag,))
         elif isinstance(a, dict):
             # pytree operand (the step fns' params dict): flatten to
             # leaf shapes/dtypes so weight-shape changes re-key
             import jax
 
-            sig.append(tuple(
-                (tuple(x.shape), str(x.dtype))
-                for x in jax.tree_util.tree_leaves(a)
-                if hasattr(x, "shape")))
+            rows = []
+            for x in jax.tree_util.tree_leaves(a):
+                if not hasattr(x, "shape"):
+                    continue
+                row = (tuple(x.shape), str(x.dtype))
+                tag = _shard_tag(x)
+                rows.append(row if tag is None else row + (tag,))
+            sig.append(tuple(rows))
         else:
             sig.append(("s", type(a).__name__, repr(a)[:32]))
     return (site, tuple(sig))
+
+
+def _args_sharded(args) -> bool:
+    """True when any operand leaf is laid out across more than one
+    device — the signal that this executable runs under a mesh and its
+    optimized HLO carries collectives worth accounting."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(args):
+        sh = getattr(leaf, "sharding", None)
+        if sh is None:
+            continue
+        try:
+            if len(sh.device_set) > 1:
+                return True
+        except Exception:
+            continue
+    return False
 
 
 def _extract_cost_analysis(fn, args) -> Optional[dict]:
@@ -265,16 +317,34 @@ def _extract_cost_analysis(fn, args) -> Optional[dict]:
            "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
     from ..core import flags as _flags
 
-    if bool(_flags.flag("cost_memory_analysis")):
-        # peak temp allocation needs a real XLA compile of the lowered
-        # module (an AOT twin of the executable that just compiled) —
-        # opt-in, because a second compile per executable is real money
+    want_mem = bool(_flags.flag("cost_memory_analysis"))
+    # Collective accounting needs the OPTIMIZED (post-SPMD-partitioner)
+    # HLO, which only exists after a real XLA compile of the lowered
+    # module (the same AOT twin memory_analysis uses).  Always-on for
+    # sharded executables — the interconnect term is first-class there,
+    # and single-chip engines never pay the extra compile.
+    want_coll = _args_sharded(args)
+    if want_mem or want_coll:
         try:
-            ma = lowered.compile().memory_analysis()
-            out["temp_bytes"] = float(
-                getattr(ma, "temp_size_in_bytes", 0.0))
+            compiled = lowered.compile()
         except Exception:
-            pass
+            compiled = None
+        if compiled is not None:
+            if want_mem:
+                try:
+                    ma = compiled.memory_analysis()
+                    out["temp_bytes"] = float(
+                        getattr(ma, "temp_size_in_bytes", 0.0))
+                except Exception:
+                    pass
+            if want_coll:
+                try:
+                    from ..parallel.partition import collective_bytes
+
+                    out["collective_bytes"] = float(
+                        collective_bytes(compiled.as_text()))
+                except Exception:
+                    pass
     return out
 
 
@@ -321,9 +391,14 @@ def note_executable(site: str, fn, args) -> Optional[tuple]:
     prof = CostProfile(site=site, flops=ca["flops"],
                        bytes_accessed=ca["bytes_accessed"],
                        temp_bytes=ca.get("temp_bytes", 0.0),
-                       source="hlo", hot_ops=_hot_ops(fn, args))
+                       source="hlo", hot_ops=_hot_ops(fn, args),
+                       collective_bytes=ca.get("collective_bytes", 0.0))
     with _lock:
         _PROFILES[key] = prof
+    if prof.collective_bytes > 0:
+        from . import COLLECTIVE_BYTES
+
+        COLLECTIVE_BYTES.set(prof.collective_bytes, fn=site)
     from ..inference.serving import _stats_add
 
     _stats_add(cost_profiles=1)
@@ -500,9 +575,15 @@ class CostModel:
 
     def raw_seconds(self, prof: CostProfile) -> float:
         """Roofline time of one executable invocation: whichever of
-        the compute and bandwidth ceilings binds."""
-        return max(prof.flops / self.peaks["flops"],
-                   prof.bytes_accessed / self.peaks["bytes_per_s"])
+        the compute and bandwidth ceilings binds, plus the serialized
+        interconnect term (collective bytes over the ICI ceiling —
+        zero on single-chip profiles, where no collectives exist)."""
+        t = max(prof.flops / self.peaks["flops"],
+                prof.bytes_accessed / self.peaks["bytes_per_s"])
+        cb = getattr(prof, "collective_bytes", 0.0)
+        if cb > 0:
+            t += cb / self.peaks["ici_bytes_per_s"]
+        return t
 
     # -- the predictor -------------------------------------------------------
     def _composition(self) -> Dict[str, object]:

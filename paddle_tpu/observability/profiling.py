@@ -306,6 +306,7 @@ class Profiler:
         self._steps = 0
         self._probe_now = False
         self._probe: Optional[Dict[str, float]] = None
+        self._probe_skew: Optional[float] = None
         self.probes = 0
         self.probe_seconds = 0.0  # accounted blocking cost (bench)
         # cross-thread state (under profiling._lock): capture session
@@ -316,6 +317,9 @@ class Profiler:
             self._capture_total = 0
             self._captures = 0
             self._device_s: Dict[str, dict] = {}
+            # per-chip completion skew of probed SHARDED steps
+            # (FLAGS_serve_mesh); None until the first sharded probe
+            self._skew: Optional[dict] = None
             self._host_ratio: Optional[float] = None
             self._mfu: Dict[str, float] = {}
             # per-kind device-time calibration (EWMA of measured /
@@ -414,6 +418,7 @@ class Profiler:
         self._probe_now = capturing or \
             (self._steps % self.sample_steps == 0)
         self._probe = {} if self._probe_now else None
+        self._probe_skew = None
         self._pending_sig = self._tracker_sig() if self._probe_now \
             else None
 
@@ -442,9 +447,11 @@ class Profiler:
         import jax
 
         p0 = time.perf_counter()
-        jax.block_until_ready(arrays)
+        skew = self._block_and_skew(arrays)
         now = time.perf_counter()
         dev = now - t0
+        if skew is not None:
+            self._probe_skew = max(self._probe_skew or 0.0, skew)
         self.probe_seconds += now - p0
         self._probe[kind] = self._probe.get(kind, 0.0) + dev
         if self._capture_remaining > 0 and _state["enabled"]:
@@ -454,6 +461,41 @@ class Profiler:
                 args={"step": int(self.engine._step_no),
                       "device_ms": round(dev * 1e3, 4)})
 
+    def _block_and_skew(self, arrays) -> Optional[float]:
+        """Block until the probed outputs are ready.  On a single-chip
+        engine this is one `block_until_ready`.  When an output is
+        laid out across a mesh (FLAGS_serve_mesh) the per-device
+        sync happens shard by shard, completion-stamped in order —
+        max-minus-min is the step's observed chip skew (a lower
+        bound: shards that finish while an earlier one is blocking
+        stamp at the moment they are OBSERVED ready, not the moment
+        they finished).  Returns None on unsharded outputs."""
+        import jax
+
+        lead = None
+        for x in jax.tree_util.tree_leaves(arrays):
+            sh = getattr(x, "sharding", None)
+            try:
+                if sh is not None and len(sh.device_set) > 1:
+                    lead = x
+                    break
+            except Exception:
+                continue
+        if lead is None:
+            jax.block_until_ready(arrays)
+            return None
+        times = []
+        try:
+            for s in lead.addressable_shards:
+                jax.block_until_ready(s.data)
+                times.append(time.perf_counter())
+        except Exception:  # pragma: no cover - exotic layouts
+            times = []
+        jax.block_until_ready(arrays)
+        if len(times) > 1:
+            return max(times) - min(times)
+        return None
+
     def note_step_end(self, fr):
         """Engine thread, after the step's dispatches and before the
         flight record seals: stamp the probe onto the open record,
@@ -461,6 +503,7 @@ class Profiler:
         ``fr`` may be None (recorder off) — the table and gauges still
         update."""
         probe, self._probe = self._probe, None
+        skew, self._probe_skew = self._probe_skew, None
         probed, self._probe_now = self._probe_now, False
         if self._capture_remaining > 0:
             with _lock:
@@ -483,13 +526,25 @@ class Profiler:
                 e["last_s"] = v
                 e["total_s"] += v
                 e["probes"] += 1
+            if skew is not None:
+                if self._skew is None:
+                    self._skew = {"last_s": 0.0, "max_s": 0.0,
+                                  "total_s": 0.0, "probes": 0}
+                self._skew["last_s"] = skew
+                self._skew["max_s"] = max(self._skew["max_s"], skew)
+                self._skew["total_s"] += skew
+                self._skew["probes"] += 1
         if fr is not None:
-            fr.note_probe({"device": {k: round(v, 9)
-                                      for k, v in probe.items()}})
+            pr = {"device": {k: round(v, 9) for k, v in probe.items()}}
+            if skew is not None:
+                pr["chip_skew_s"] = round(skew, 9)
+            fr.note_probe(pr)
         if _state["enabled"] and not self.engine._abandoned:
             obs = _obs()
             for k, v in probe.items():
                 obs.EXEC_DEVICE_SECONDS.set(v, fn=k)
+            if skew is not None:
+                obs.CHIP_SKEW.set(skew, engine=self.engine._engine_id)
 
     def observe(self, rec: dict) -> None:
         """Score the sealed flight record's probe against its wall:
@@ -595,6 +650,15 @@ class Profiler:
             mfu = dict(self._mfu)
             drift = dict(self._drift)
             dev_calib = dict(self._dev_calib)
+            skew = None
+            if self._skew is not None:
+                skew = {
+                    "last_s": self._skew["last_s"],
+                    "max_s": self._skew["max_s"],
+                    "mean_s": self._skew["total_s"]
+                    / max(self._skew["probes"], 1),
+                    "probes": self._skew["probes"],
+                }
         hot = {}
         try:
             from . import costmodel
@@ -622,6 +686,7 @@ class Profiler:
             "probe_seconds": round(self.probe_seconds, 9),
             "capture": self.capture_status(),
             "device_seconds": self.device_table(),
+            "chip_skew_seconds": skew,
             "host_overhead_ratio": host_ratio,
             "mfu_measured": mfu,
             "device_calibration": dev_calib,

@@ -5,5 +5,9 @@ This package holds the kernels that need explicit per-device programs rather
 than GSPMD annotations: the 1F1B pipeline schedule and ring attention
 (sequence parallelism).  fleet routes to these when pp>1 / sp>1.
 """
+from .partition import (build_mesh, collective_bytes,  # noqa: F401
+                        gpt_serving_rules, gpt_train_rules, hlo_collectives,
+                        make_shard_and_gather_fns, match_partition_rules,
+                        parse_mesh_spec)
 from .pipeline import pipeline_spmd_step  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401

@@ -7,9 +7,17 @@ import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Tier-1 runs at XLA backend optimization level 0: the suite is
+# compile-bound on the CPU CI box (tiny models, hundreds of fresh
+# executables) and level 0 roughly halves compile time while leaving
+# semantics alone — every parity test compares two paths compiled under
+# the same flag, and the SPMD partitioner/collective insertion (what the
+# sharded HLO assertions inspect) runs regardless of backend opt level.
+# Respect an explicit caller override.
+if "xla_backend_optimization_level" not in flags:
+    flags = (flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = flags
 
 # sitecustomize may have imported jax before this conftest ran (the axon TPU
 # plugin registers at interpreter startup), in which case the env vars above

@@ -814,6 +814,37 @@ class TestDonationLint:
         hazards = TraceHazardPass().run(mods)
         assert len(hazards) == 1 and "tokens.sum()" in hazards[0].snippet
 
+    def test_mesh_wrapped_twin_sharded_pages_not_donated(self, tmp_path):
+        """The multichip serving pattern (FLAGS_serve_mesh): the ragged
+        twins are partial-bound with a ``mesh=`` kwarg and their page
+        pool operands are mesh-sharded arrays — donation coverage must
+        see straight through the wrapper, because an undonated SHARDED
+        pool is worse than the single-chip bug (every chip copies its
+        page shard every step).  Known-bad fixture: the mesh twin
+        donates the pages but not the scales → finding; the good twin
+        with the full pool tuple is clean."""
+        mods = _scan_snippet(tmp_path, """
+            import functools
+
+            MESH = object()
+
+            def ragged_step(params, k_pages, v_pages, k_scales,
+                            v_scales, tokens, mesh=None):
+                return k_pages, v_pages, k_scales, v_scales, tokens
+
+            bad = _JitTracker(
+                functools.partial(ragged_step, mesh=MESH),
+                "ragged_compiles", donate_argnums=(1, 2, 3),
+                site="bad mesh twin")
+            good = _JitTracker(
+                functools.partial(ragged_step, mesh=MESH),
+                "ragged_compiles", donate_argnums=(1, 2, 3, 4),
+                site="good mesh twin")
+        """)
+        found = DonationPass().run(mods)
+        assert len(found) == 1, [f.message for f in found]
+        assert "`v_scales`" in found[0].message
+
     def test_partial_positional_shift(self, tmp_path):
         """Positionally-bound partial args shift the donate indices."""
         mods = _scan_snippet(tmp_path, """

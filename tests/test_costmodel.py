@@ -112,13 +112,16 @@ class TestProfiles:
     def test_peaks_resolve_pinned_on_cpu(self):
         peaks = costmodel.resolve_peaks()
         assert peaks["flops"] > 0 and peaks["bytes_per_s"] > 0
+        assert peaks["ici_bytes_per_s"] > 0
         assert peaks["source"] in ("cpu-pinned", "flags") or \
             peaks["source"].startswith("autodetect")
-        # explicit flags override autodetection
+        # explicit flags override autodetection (ici keeps its pinned
+        # default unless FLAGS_peak_ici_gbps is set too)
         paddle.set_flags({"peak_flops": 123.0, "peak_hbm_gbps": 4.0})
         try:
             p2 = costmodel.resolve_peaks()
             assert p2 == {"flops": 123.0, "bytes_per_s": 4.0e9,
+                          "ici_bytes_per_s": costmodel._CPU_PEAK_ICI,
                           "source": "flags"}
         finally:
             paddle.set_flags({"peak_flops": 0.0, "peak_hbm_gbps": 0.0})

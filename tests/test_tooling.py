@@ -218,6 +218,53 @@ def test_bench_ragged_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_bench_sharded_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_sharded.py runs end-to-end: the
+    MULTICHIP_serving leg can't rot.  Asserts the emitted JSON shape,
+    greedy parity of every sharded leg (mp=2, mp=4, mp=2+spec) vs the
+    single-chip engine, the one-executable/zero-retrace contract under
+    the mesh, the serve_mesh-off leg bit-exact with identical
+    counters, collective bytes nonzero exactly on sharded legs, a
+    recorded chip-skew probe, and the MULTICHIP artifact's rc=0."""
+    out = str(tmp_path / "bench_sharded.json")
+    mc = str(tmp_path / "multichip_serving.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_sharded.py", "--out", out,
+         "--multichip-out", mc],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    assert data["parity"] is True
+    assert data["n_devices"] >= 2
+    legs = data["legs"]
+    assert {"single_chip", "mesh_off", "mp2", "mp2_spec",
+            "single_spec"} <= set(legs)
+    for name, leg in legs.items():
+        assert leg["tokens_per_s"] > 0 and leg["wall_s"] > 0, name
+        assert leg["step_executables"] == 1, name
+        assert leg["step_compiles_timed"] == 0, name  # steady state
+        assert leg["ragged_retraces"] == 0, name
+    for name in [n for n in legs if n.startswith("mp")]:
+        assert legs[name]["collective_bytes"] > 0, name
+        assert legs[name]["mesh_devices"] > 1, name
+    assert legs["single_chip"]["collective_bytes"] == 0.0
+    assert legs["mp2"]["chip_skew_max_s"] >= 0.0
+    s = data["summary"]
+    assert s["parity"] == 1.0
+    assert s["mesh_off_bit_exact"] == 1.0
+    assert s["step_executables_mp2"] == 1
+    assert s["ragged_retraces_mp2"] == 0
+    with open(mc) as f:
+        art = json.load(f)
+    assert art["ok"] is True and art["rc"] == 0
+    assert art["skipped"] is False
+    assert "parity=OK" in art["tail"]
+
+
+@pytest.mark.slow
 def test_bench_prefill_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_prefill.py runs end-to-end: the
     chunked-prefill bench can't rot.  Asserts the emitted JSON shape,
