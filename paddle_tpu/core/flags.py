@@ -165,6 +165,18 @@ define_flag("snapshot_kv", True,
             "missing/torn sidecar falls back to full recompute — "
             "restores stay bit-identical either way.  0 = snapshot "
             "host state only, as before")
+define_flag("cache_generated_pages", False,
+            "content-address GENERATED full KV pages as decode "
+            "crosses page boundaries (requires FLAGS_prefix_cache): "
+            "the prompt's chain hash extends over the generated "
+            "tokens, so beam/agent fanout sharing a DECODE prefix — "
+            "and the fleet router's prefix-affinity key — prefix-hit "
+            "the generated region too, not just the prompt.  0 "
+            "(default) registers prompt pages only: pool occupancy "
+            "and eviction order are bit-exact with the pre-fleet "
+            "engine (the parity oracle tests/test_prefix_cache.py "
+            "pins).  Engines constructed with an explicit "
+            "cache_generated_pages= ignore the flag")
 define_flag("kv_pool_debug", False,
             "audit KVBlockPool consistency (free/private/cached page "
             "partition, refcounts vs live request holds, eviction-LRU "
@@ -326,6 +338,17 @@ define_flag("snapshot_interval_steps", 32,
             "much of the journal a restore must replay (and how many "
             "tokens it must recompute).  <= 0 disables periodic "
             "snapshots — restore then replays the whole journal")
+define_flag("journal_compact", True,
+            "rewrite the write-ahead journal during durability."
+            "restore_from_dir: the compacted journal carries one cfg "
+            "record plus one admission + one watermark per request "
+            "still in flight (finished requests and superseded "
+            "watermarks drop), and the snapshot is re-anchored to it "
+            "— so a serve that restores N times starts each life from "
+            "a bounded file instead of replaying every previous "
+            "life's records (the journal_growth alert's failure "
+            "mode).  0 = append to the historical journal unmodified, "
+            "as before")
 define_flag("compile_cache_dir", "",
             "directory for JAX's persistent compilation cache: a "
             "rebuilt engine in a FRESH process (durability."

@@ -435,10 +435,16 @@ class DurabilityManager:
 
     # -- engine hooks --------------------------------------------------------
     def on_admit(self, req):
+        # journal the ORIGINAL identity (pre-replay-fold prompt,
+        # original budget) — identical for a fresh request, and for a
+        # MATERIALIZED one (fleet adoption via `admit_restored`) it
+        # keeps this journal's own replay correct: the folded prompt
+        # would double-count the generated tokens the emitted-token
+        # watermark already covers
         eos = req.eos_token_id
         self.append({"t": "a", "id": req.request_id,
-                     "p": list(req.prompt_ids),
-                     "mn": int(req.max_new_tokens),
+                     "p": list(req.prompt_ids[:req.orig_prompt_len]),
+                     "mn": int(req.max_new_tokens + req._absorbed),
                      "eos": None if eos is None else int(eos),
                      "pr": req.priority, "dl": req.deadline_ms,
                      "tt": req.slo_ttft_ms, "tp": req.slo_tpot_ms})
@@ -663,33 +669,13 @@ def _install_kv_sidecar(journal_dir: str, snap: SnapshotWire,
     return installed
 
 
-def restore_from_dir(journal_dir: str, model, scheduler=None,
-                     drafter=None, journal: bool = True, **overrides):
-    """Rebuild an engine in a FRESH process from ``journal_dir`` and
-    re-admit every request that was in flight when the previous process
-    died.  Returns ``(engine, requests)`` — ``requests`` maps each
-    journaled request id to its rebuilt `Request` (re-attach
-    ``on_token`` hooks there before driving the engine).
-
-    The caller supplies the ``model`` (weights are not journaled); the
-    journal's config record supplies every other constructor argument
-    and a config fingerprint the rebuilt engine is validated against —
-    a different model or config raises instead of silently serving
-    garbage.  State resolution: the newest VALID snapshot supplies
-    generated-token values and RNG fold counters; journal records after
-    its ``journal_pos`` replay admissions / watermarks / finishes on
-    top.  A torn tail record or torn snapshot simply falls back to the
-    last consistent state — never a crash, and the emitted-token
-    watermarks guarantee a previously streamed token is never re-fired
-    at a stream (it is recomputed behind the `_emit` gate; greedy
-    recompute is bit-identical, which is what the acceptance bench
-    pins).
-
-    ``journal=True`` (default) keeps journaling into the same
-    directory, so the restored serve survives a SECOND death.
-    ``overrides`` replace individual engine kwargs (tests/benches)."""
-    from .serving import DecodeEngine, Request, _stats_add
-
+def _journal_state(journal_dir: str):
+    """Resolve ``journal_dir``'s last consistent state:
+    ``(cfg_rec, snap, state, finished, events)`` — the shared front
+    half of `restore_from_dir`, `adopt_from_dir` and
+    `compact_journal`.  ``state`` maps each in-flight request id to
+    its `RequestWire` (snapshot values with the journal tail replayed
+    on top), ``finished`` maps retired ids to their finish reason."""
     path = os.path.join(journal_dir, JOURNAL_NAME)
     if not os.path.exists(path):
         raise FileNotFoundError(f"no serve journal at {path}")
@@ -726,6 +712,126 @@ def restore_from_dir(journal_dir: str, model, scheduler=None,
         elif t == "f":
             state.pop(int(ev["id"]), None)
             finished[int(ev["id"])] = ev.get("r", "")
+    return cfg_rec, snap, state, finished, events
+
+
+def _next_id_floor(cfg_rec, state, finished) -> int:
+    """The smallest request id a new life may issue: past every id the
+    journal still names AND past the high-water a previous compaction
+    recorded (``nid`` — compaction drops finished ids from the
+    journal, so without the floor a thrice-restored serve could reuse
+    an id a dead life already streamed under)."""
+    return max([rid + 1 for rid in (*state, *finished)] +
+               [int(cfg_rec.get("nid", 0))], default=0)
+
+
+def _compact_resolved(journal_dir: str, cfg_rec, snap, state,
+                      finished, events) -> dict:
+    """Rewrite the journal (and re-anchor the snapshot) down to the
+    already-resolved live state.  The compacted journal carries the
+    cfg record (plus the ``nid`` id high-water) and, per in-flight
+    request, one admission + one watermark — every finished request
+    and superseded watermark drops.  Both files replace atomically
+    (temp + fsync + `os.replace`): a crash mid-compaction leaves the
+    previous consistent pair.  ``snap`` is re-anchored IN PLACE
+    (``journal_pos``/``records``) so a caller holding it keeps a view
+    consistent with the file.  Returns the size-before/after stats."""
+    path = os.path.join(journal_dir, JOURNAL_NAME)
+    bytes_before = os.path.getsize(path)
+    cfg = dict(cfg_rec)
+    cfg["nid"] = _next_id_floor(cfg_rec, state, finished)
+    frames = [_frame(cfg)]
+    for w in state.values():
+        frames.append(_frame({
+            "t": "a", "id": w.request_id, "p": list(w.prompt),
+            "mn": int(w.max_new), "eos": w.eos, "pr": w.priority,
+            "dl": w.deadline_ms, "tt": w.slo_ttft_ms,
+            "tp": w.slo_tpot_ms}))
+        if w.streamed:
+            frames.append(_frame({"t": "e", "id": w.request_id,
+                                  "n": int(w.streamed)}))
+    data = b"".join(frames)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if snap is not None:
+        # the snapshot's journal_pos anchored into the OLD journal;
+        # re-anchor it to the compacted one (records = the post-tail-
+        # replay state, strictly newer than what it held) — without
+        # this the next restore would mis-align replay
+        snap.journal_pos = len(frames)
+        snap.records = list(state.values())
+        spath = os.path.join(journal_dir, SNAPSHOT_NAME)
+        stmp = spath + ".tmp"
+        with open(stmp, "wb") as f:
+            f.write(_frame(snap.to_obj()))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(stmp, spath)
+    from .serving import _stats_add
+
+    _stats_add(journal_compactions=1)
+    return {"bytes_before": int(bytes_before),
+            "bytes_after": len(data),
+            "records_before": len(events),
+            "records_after": len(frames)}
+
+
+def compact_journal(journal_dir: str) -> dict:
+    """Compact ``journal_dir``'s write-ahead journal to its live
+    state (see `_compact_resolved`); standalone entry for tools and
+    tests — `restore_from_dir` compacts inline under
+    ``FLAGS_journal_compact``."""
+    cfg_rec, snap, state, finished, events = _journal_state(journal_dir)
+    return _compact_resolved(journal_dir, cfg_rec, snap, state,
+                             finished, events)
+
+
+def restore_from_dir(journal_dir: str, model, scheduler=None,
+                     drafter=None, journal: bool = True,
+                     compact: Optional[bool] = None, **overrides):
+    """Rebuild an engine in a FRESH process from ``journal_dir`` and
+    re-admit every request that was in flight when the previous process
+    died.  Returns ``(engine, requests)`` — ``requests`` maps each
+    journaled request id to its rebuilt `Request` (re-attach
+    ``on_token`` hooks there before driving the engine).
+
+    The caller supplies the ``model`` (weights are not journaled); the
+    journal's config record supplies every other constructor argument
+    and a config fingerprint the rebuilt engine is validated against —
+    a different model or config raises instead of silently serving
+    garbage.  State resolution: the newest VALID snapshot supplies
+    generated-token values and RNG fold counters; journal records after
+    its ``journal_pos`` replay admissions / watermarks / finishes on
+    top.  A torn tail record or torn snapshot simply falls back to the
+    last consistent state — never a crash, and the emitted-token
+    watermarks guarantee a previously streamed token is never re-fired
+    at a stream (it is recomputed behind the `_emit` gate; greedy
+    recompute is bit-identical, which is what the acceptance bench
+    pins).
+
+    ``journal=True`` (default) keeps journaling into the same
+    directory, so the restored serve survives a SECOND death.
+    ``compact`` (default ``FLAGS_journal_compact``) rewrites the
+    journal down to its live state BEFORE the rebuilt engine reopens
+    it, so a serve that restores repeatedly starts each life from a
+    bounded file instead of an ever-growing one.
+    ``overrides`` replace individual engine kwargs (tests/benches)."""
+    from ..core import flags as _flags
+    from .serving import DecodeEngine, Request, _stats_add
+
+    cfg_rec, snap, state, finished, events = _journal_state(journal_dir)
+    if compact is None:
+        compact = bool(_flags.flag("journal_compact"))
+    comp = None
+    if journal and compact:
+        # BEFORE engine construction: the DurabilityManager the engine
+        # builds reopens (and appends to) the compacted file
+        comp = _compact_resolved(journal_dir, cfg_rec, snap, state,
+                                 finished, events)
 
     kw = dict(cfg_rec["cfg"])
     if kw.get("dtype") is not None:
@@ -763,10 +869,11 @@ def restore_from_dir(journal_dir: str, model, scheduler=None,
         if snap is not None else 0
 
     # journaled ids key the watermarks: new requests in this process
-    # must never collide with them
-    max_id = max([*state, *finished], default=-1)
+    # must never collide with them (nor with ids a previous
+    # compaction dropped — the cfg record's ``nid`` high-water)
     Request._next_id = itertools.count(
-        max(max_id + 1, next(Request._next_id)))
+        max(_next_id_floor(cfg_rec, state, finished),
+            next(Request._next_id)))
 
     t0 = _obs.now_ns()
     reqs: Dict[int, "object"] = {}
@@ -796,12 +903,96 @@ def restore_from_dir(journal_dir: str, model, scheduler=None,
         tid=eng._engine_id,
         args={"requests": len(reqs), "journal_events": len(events),
               "snapshot": snap is not None,
-              "kv_pages_installed": installed_pages})
+              "kv_pages_installed": installed_pages,
+              **({"compacted_bytes": comp["bytes_after"],
+                  "journal_bytes_before": comp["bytes_before"]}
+                 if comp else {})})
     if eng._flight is not None:
         eng._flight.event("restore", requests=len(reqs),
                           journal_events=len(events),
                           snapshot=snap is not None)
     return eng, reqs
+
+
+def adopt_from_dir(journal_dir: str, engine,
+                   delivered: Optional[Dict[int, int]] = None,
+                   on_token_factory=None):
+    """Fleet failover: replay a DEAD sibling replica's journal into a
+    LIVE survivor ``engine`` (contrast `restore_from_dir`, which
+    builds a fresh engine around the journal).  Every in-flight
+    request materializes through the replay fold and re-admits via
+    `DecodeEngine.admit_restored` — fresh ids (the donor's id space
+    may collide with the survivor's), validated, and re-journaled
+    into the SURVIVOR's journal so a second death loses nothing.
+
+    ``delivered`` maps donor request ids to the number of generated
+    tokens the consumer of record actually received.  The journal's
+    streamed watermark is written AHEAD of the socket, so a replica
+    can die having journaled a token nobody got: tokens past
+    ``delivered`` re-deliver — snapshot-known values return
+    immediately as ``backfill``, the rest recompute live — while
+    everything at or below it stays behind the emit gate and is never
+    re-fired.  Omitted ids (or ``delivered=None``) trust the journal
+    watermark, the lossless-but-maybe-duplicating default.
+
+    ``on_token_factory(donor_id)`` (optional) returns the ``on_token``
+    hook to attach per adopted request.  Returns ``(requests, meta)``
+    keyed by DONOR ids: ``requests`` the materialized `Request`s (the
+    survivor's fresh ids are on them), ``meta`` per-request
+    ``{"request_id", "start_index", "backfill", "done"}`` — the
+    resume contract the fleet edge serves to reconnecting streams."""
+    from .serving import _stats_add
+
+    cfg_rec, snap, state, finished, events = _journal_state(journal_dir)
+    fp = cfg_rec.get("fp")
+    if fp and engine.config_fingerprint().hex() != fp:
+        raise ValueError(
+            "journal config fingerprint does not match the adopting "
+            "engine — fleet replicas must share model weights and "
+            "construction config for zero-loss failover")
+    delivered = dict(delivered or {})
+    t0 = _obs.now_ns()
+    reqs: Dict[int, "object"] = {}
+    meta: Dict[int, dict] = {}
+    for rid, w in state.items():
+        d = delivered.get(rid, w.streamed)
+        d = max(0, min(int(d), w.streamed))
+        # generated values the snapshot preserved past the delivered
+        # point need no recompute: hand them straight back
+        backfill = [int(t) for t in w.generated[d:]]
+        req = w.materialize()
+        # the router's delivered count supersedes the journal
+        # watermark: gate exactly what the consumer saw
+        req._emit_gate = max(0, d - len(w.generated))
+        done = w.max_new - len(w.generated) <= 0
+        if done:
+            # fully generated before death (finish record lost):
+            # terminal — the backfill above is the whole undelivered
+            # tail, nothing to recompute
+            req.state = "done"
+            req.finish_reason = "length"
+        else:
+            req.fault_info = FaultInfo(
+                site="failover", step=snap.step_no if snap else 0,
+                recovered=True,
+                message="adopted from a dead replica's journal")
+            on_token = on_token_factory(rid) if on_token_factory \
+                else None
+            engine.admit_restored(req, on_token=on_token)
+        reqs[rid] = req
+        meta[rid] = {"request_id": int(req.request_id),
+                     "start_index": int(d), "backfill": backfill,
+                     "done": bool(done)}
+    _stats_add(adoptions=1)
+    _obs.record_span(
+        "engine", "adopt", t0, _obs.now_ns() - t0,
+        tid=engine._engine_id,
+        args={"requests": len(reqs), "journal_events": len(events),
+              "donor": journal_dir})
+    if engine._flight is not None:
+        engine._flight.event("adopt", requests=len(reqs),
+                             donor=journal_dir)
+    return reqs, meta
 
 
 # ---------------------------------------------------------------------------

@@ -70,7 +70,7 @@ See docs/SERVING.md for the user-facing API walk-through.
 from __future__ import annotations
 
 import asyncio
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from .. import observability as _obs
 
@@ -568,6 +568,27 @@ class ServingFrontend:
         self._kick()
         return await fut
 
+    async def adopt(self, journal_dir: str,
+                    delivered: Optional[Dict[int, int]] = None) -> dict:
+        """Fleet failover entry: replay a dead sibling replica's
+        journal (`durability.adopt_from_dir`) into THIS frontend's
+        engine, between steps on the driver like any other mutation,
+        and open a `TokenStream` per adopted request.  Returns a dict
+        keyed by DONOR request id: ``{"stream": TokenStream,
+        "request_id": <fresh id>, "start_index": <tokens the consumer
+        already holds>, "backfill": [snapshot-known undelivered
+        tokens], "done": bool}`` — the edge relays backfill first,
+        then drains the stream, and the reconnected consumer sees
+        token-for-token continuity."""
+        if self._closing or self._closed:
+            raise RuntimeError("frontend is closing; no new requests")
+        await self.start()
+        self._check_driver()
+        fut = self._loop.create_future()
+        self._control.append(("adopt", (journal_dir, delivered), fut))
+        self._kick()
+        return await fut
+
     async def _cancel(self, req):
         if self._driver is None or self._driver.done() or \
                 req.state == "done":
@@ -670,6 +691,40 @@ class ServingFrontend:
                     stream_box.append(stream)
                     self._streams[req] = stream
                     fut.set_result(stream)
+                elif action == "adopt":
+                    journal_dir, delivered = payload
+                    from . import durability
+
+                    boxes: dict = {}
+
+                    def factory(rid, _boxes=boxes, _loop=self._loop):
+                        box: list = []
+                        _boxes[rid] = box
+
+                        def on_token(tok, _box=box, _loop=_loop):
+                            try:
+                                _loop.call_soon_threadsafe(
+                                    _box[0]._push, tok)
+                            except RuntimeError:
+                                pass
+                        return on_token
+                    # admission happens HERE, between steps on the
+                    # driver — no step can emit before the stream
+                    # boxes below are filled
+                    reqs, meta = durability.adopt_from_dir(
+                        journal_dir, self.engine, delivered=delivered,
+                        on_token_factory=factory)
+                    out = {}
+                    for rid, req in reqs.items():
+                        stream = TokenStream(self, req)
+                        if rid in boxes:
+                            boxes[rid].append(stream)
+                        # done-state adoptees flush a _DONE on the
+                        # next _flush_finished pass like any other
+                        # terminal request
+                        self._streams[req] = stream
+                        out[rid] = {"stream": stream, **meta[rid]}
+                    fut.set_result(out)
                 else:  # cancel
                     payload.cancel()
                     fut.set_result(None)

@@ -1427,7 +1427,7 @@ class DecodeEngine:
                  cost_model=None, cost_calibration=None, alerts=None,
                  profile=None, profile_sample_steps=None,
                  ragged_step=None, spec_adaptive_k=None,
-                 serve_mesh=None):
+                 serve_mesh=None, cache_generated_pages=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -1580,6 +1580,20 @@ class DecodeEngine:
         self._prefix_cache = bool(prefix_cache)
         self._model_salt = self._model_fingerprint() \
             if self._prefix_cache else b""
+        # generated-page registration (explicit arg wins, else
+        # FLAGS_cache_generated_pages): extend the prompt's chain hash
+        # over the DECODE stream and content-address each generated
+        # page the moment it fills, so fanout sharing a decode prefix
+        # (and the fleet router's affinity key) prefix-hits it.  Off
+        # (default) keeps pool occupancy bit-exact with the
+        # prompt-pages-only engine; meaningless without the prefix
+        # cache, so it resolves False there rather than refusing (the
+        # flag must not break prefix_cache=0 engines).
+        if cache_generated_pages is None:
+            cache_generated_pages = bool(
+                _flags.flag("cache_generated_pages"))
+        self._cache_generated = bool(cache_generated_pages) and \
+            self._prefix_cache
         self._evictions_seen = 0
         # FLAGS_kv_pool_debug: audit the pool partition + refcounts at
         # every step boundary (engine idle point — host-only cost)
@@ -1795,6 +1809,7 @@ class DecodeEngine:
             prefill_chunk_tokens=self._chunk_budget,
             prefill_q_max=self._q_max,
             prefix_cache=self._prefix_cache,
+            cache_generated_pages=self._cache_generated,
             scheduler=self._scheduler, fault_plan=self._fault,
             journal_dir=self._journal_dir,
             step_timeout_ms=self._step_timeout_ms,
@@ -2195,6 +2210,78 @@ class DecodeEngine:
         if self._durability is not None:
             self._durability.on_admit(req)
         return req
+
+    def admit_restored(self, req: Request, on_token=None) -> Request:
+        """Admit a request another engine's journal materialized
+        (`durability.adopt_from_dir` — fleet failover into a LIVE
+        survivor).  Unlike the in-place `restore_from_dir` path, the
+        adopting engine has its own journal and its own id space: the
+        request gets a FRESH id here (the donor's id may collide with
+        one this engine already journaled), is validated like any
+        admission, and is journaled under its restored identity — the
+        ORIGINAL prompt/budget split plus the streamed watermark — so
+        a second death of THIS engine replays it correctly too."""
+        if req.state == "done":
+            raise ValueError(
+                "admit_restored takes an in-flight materialized "
+                "request, not a finished one")
+        if not req.prompt_ids:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(req.prompt_ids) + req.max_new_tokens > self._max_seq_len:
+            raise ValueError(
+                f"prompt ({len(req.prompt_ids)}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds max_seq_len "
+                f"{self._max_seq_len}")
+        if self._pages_for(req.total_kv_tokens()) > self.pool.num_pages:
+            raise ValueError(
+                "request needs more KV pages than the pool holds")
+        req.request_id = next(Request._next_id)
+        req.on_token = on_token
+        req._engine = self
+        req.t_enqueue_ns = _obs.now_ns()
+        if req.deadline_ms is not None:
+            req._deadline_ns = req.t_enqueue_ns + \
+                int(req.deadline_ms * 1e6)
+        _obs.REQUESTS_ENQUEUED.inc()
+        self._queue.append(req)
+        if self._durability is not None:
+            self._durability.on_admit(req)
+            if req._absorbed + req._emit_gate:
+                # the adopted watermark must be durable HERE too: a
+                # crash of this engine before the next emit would
+                # otherwise replay the donor's already-streamed tokens
+                # straight into the stream
+                self._durability.on_emit(req)
+        return req
+
+    # -- fleet export hooks ---------------------------------------------------
+    def route_prefix_hashes(self, prompt_ids) -> List[str]:
+        """The fleet router's affinity key: hex chain hashes of every
+        FULL page of ``prompt_ids`` under THIS engine's salt (same
+        digests `_probe_prefix` matches against, so a router keyed on
+        them lands a request exactly where its pages are cached).
+        Empty when the prefix cache is off or the prompt spans no full
+        page."""
+        if not self._prefix_cache:
+            return []
+        return [h.hex() for h in self._prefix_hashes(list(prompt_ids))]
+
+    def journal_info(self) -> Optional[dict]:
+        """Where this engine journals (the fleet failover donor
+        surface) — directory, record count, on-disk bytes, fsync
+        policy; None when durability is off."""
+        if self._durability is None:
+            return None
+        d = self._durability
+        try:
+            size = os.path.getsize(d.path)
+        except OSError:
+            size = 0
+        return {"dir": d.journal_dir, "path": d.path,
+                "records": int(d.seq), "bytes": int(size),
+                "fsync": d.fsync}
 
     def _pages_for(self, tokens: int) -> int:
         return -(-tokens // self._page)  # ceil
@@ -2731,8 +2818,11 @@ class DecodeEngine:
         ``prompt_ids + output_ids``; the emit-loop invariant
         ``len(prompt + outputs) == lens + 1`` guarantees the token
         content of every full page is on hand.  O(1) early-out keeps
-        the per-token cost of the common (mid-page) case negligible."""
-        if not self._prefix_cache or req.t_first_token_ns is None:
+        the per-token cost of the common (mid-page) case negligible.
+        Gated by ``cache_generated_pages`` (default off): prompt-only
+        registration is the bit-exact-occupancy parity oracle."""
+        if not self._cache_generated or not self._prefix_cache or \
+                req.t_first_token_ns is None:
             return
         full = int(self._lens[slot]) // self._page
         if full <= req._reg_pages:

@@ -70,7 +70,8 @@ from ..analysis.sanitizer import TrackedLock as _TrackedLock
 
 __all__ = [
     "register_engine", "deregister_engine", "register_frontend",
-    "deregister_frontend", "live_engines", "engine_ready",
+    "deregister_frontend", "register_fleet", "deregister_fleet",
+    "live_engines", "engine_ready",
     "readiness", "start_ops_server", "stop_ops_server",
     "maybe_start_ops_server", "ops_server_port",
 ]
@@ -82,6 +83,7 @@ _lock = _TrackedLock(threading.RLock(), "opsserver._lock")
 
 _ENGINES: Dict[int, "weakref.ref"] = {}
 _FRONTENDS: Dict[int, "weakref.ref"] = {}
+_FLEET: Optional["weakref.ref"] = None  # the process's FleetRouter
 _SERVER: Optional[tuple] = None  # (ThreadingHTTPServer, thread)
 
 _obs_mod = None
@@ -138,6 +140,31 @@ def deregister_frontend(frontend):
             _FRONTENDS.pop(k, None)
 
 
+def register_fleet(router):
+    """Called by `fleet.FleetRouter` at construction: this process's
+    ``/alertz`` then carries the fleet-level rollup (reachability,
+    fleet-wide firing set, failover narration) beside the local
+    engines' alert state.  One router per process (latest wins —
+    routers are process singletons in practice); weakref, so a
+    dropped router leaves the endpoint with the object."""
+    global _FLEET
+    with _lock:
+        _FLEET = weakref.ref(router)
+
+
+def deregister_fleet(router):
+    global _FLEET
+    with _lock:
+        if _FLEET is not None and _FLEET() in (router, None):
+            _FLEET = None
+
+
+def _fleet_router():
+    with _lock:
+        ref = _FLEET
+    return ref() if ref is not None else None
+
+
 def live_engines() -> List[object]:
     """Registered engines still alive, id order."""
     with _lock:
@@ -178,9 +205,14 @@ def engine_ready(engine) -> dict:
             "serving": health in ("live", "degraded")}
     # capacity headroom: the cost observatory's admission number when
     # armed (free slots, pool capacity, SLO ceiling); plain free slots
-    # otherwise
+    # otherwise.  ONE headroom() call — the fleet router reads the
+    # predicted-cost fields beside the verdict, and two calls could
+    # straddle a step and disagree with each other
     if engine._cost is not None:
-        headroom = int(engine._cost.headroom()["admissible_slots"])
+        hr = engine._cost.headroom()
+        headroom = int(hr["admissible_slots"])
+        crit["predicted_step_s"] = hr.get("predicted_step_s")
+        crit["slo_ok"] = hr.get("slo_ok")
     else:
         headroom = len(engine._free_slots)
     crit["headroom_slots"] = headroom
@@ -415,7 +447,14 @@ class _OpsHandler(BaseHTTPRequestHandler):
             al = getattr(eng, "_alerts", None)
             if al is not None:
                 out[str(eng._engine_id)] = al.snapshot()
-        self._send_json({"engines": out})
+        doc = {"engines": out}
+        router = _fleet_router()
+        if router is not None:
+            # the fleet-level story beside the local engines': which
+            # replicas are reachable/ready fleet-wide, every rule
+            # firing anywhere, and the router's failover narration
+            doc["fleet"] = router.alertz_rollup()
+        self._send_json(doc)
 
 
 # ---------------------------------------------------------------------------

@@ -83,6 +83,11 @@ DECODE_STAT_COUNTERS = (
     # classified as hung (FLAGS_step_timeout_ms)
     "journal_records", "journal_snapshots", "restores", "exec_handoffs",
     "hung_steps",
+    # fleet serving (paddle_tpu.fleet): a dead replica's journal
+    # replayed into a LIVE survivor engine (zero-loss failover), and
+    # journals rewritten down to their live state during restore
+    # (FLAGS_journal_compact)
+    "adoptions", "journal_compactions",
     # flight recorder (observability.flight): sealed per-step records
     # pushed into the bounded ring, and crash-safe window auto-dumps
     # (fatal fault / hung step / watchdog abandonment black boxes)

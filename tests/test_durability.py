@@ -385,6 +385,105 @@ class TestRestore:
 
 
 # ---------------------------------------------------------------------------
+# journal compaction on restore (fleet PR satellite)
+# ---------------------------------------------------------------------------
+class TestJournalCompaction:
+    def _dead_serve(self, model, tmp_path, steps=9):
+        d = str(tmp_path / "j")
+        paddle.set_flags({"snapshot_interval_steps": 4})
+        try:
+            eng = _engine(model, journal_dir=d)
+            reqs, streamed = _streamed_serve(eng)
+            for _ in range(steps):
+                eng.step()
+        finally:
+            paddle.set_flags({"snapshot_interval_steps": 32})
+        eng._durability.flush()
+        return d, reqs, streamed
+
+    def test_restore_compacts_with_size_assertion(self, model,
+                                                  tmp_path, reference):
+        """A long-lived serve accretes one watermark record per emit
+        round; `restore_from_dir` (FLAGS_journal_compact, default on)
+        rewrites the journal down to cfg + one admission + one
+        watermark per in-flight request — bounded by LIVE work, not by
+        history — while the restored serve stays bit-identical."""
+        d, reqs, streamed = self._dead_serve(model, tmp_path)
+        path = os.path.join(d, "journal.wal")
+        bytes_before = os.path.getsize(path)
+        recs_before = len(read_journal(path)[0])
+        eng2, rmap = restore_from_dir(d, model)
+        bytes_after = os.path.getsize(path)
+        recs_after = len(read_journal(path)[0])
+        assert bytes_after < bytes_before  # the satellite's bar
+        # compacted floor: cfg + ("a" + "e") per live request, plus
+        # the re-admission records the restored engine itself appends
+        assert recs_before > 1 + 4 * len(rmap)
+        assert recs_after <= 1 + 4 * len(rmap)
+        assert decode_stats()["journal_compactions"] == 1
+        _rewire(rmap, streamed)
+        eng2.run()
+        order = sorted(rmap)
+        assert [list(rmap[r].generated_ids) for r in order] == reference
+        assert [streamed[r] for r in order] == reference
+
+    def test_compacted_ids_keep_monotonic(self, model, tmp_path):
+        """Compaction drops finished requests' records, but their ids
+        must stay burned (the compacted cfg carries the id high-water)
+        — a fresh admission after TWO restores can never collide with
+        a pre-death id."""
+        d = str(tmp_path / "j")
+        eng = _engine(model, journal_dir=d)
+        finished = eng.add_request(PROMPTS[0], max_new_tokens=2)
+        live = eng.add_request(PROMPTS[1], max_new_tokens=NEW)
+        while finished.state != "done":
+            eng.step()
+        eng._durability.flush()
+        eng2, rmap = restore_from_dir(d, model)
+        assert sorted(rmap) == [live.request_id]
+        eng2._durability.flush()
+        eng3, _ = restore_from_dir(d, model)  # from a compacted file
+        fresh = eng3.add_request(PROMPTS[0], max_new_tokens=2)
+        assert fresh.request_id > finished.request_id
+        assert fresh.request_id > live.request_id
+
+    def test_compact_flag_off_appends_only(self, model, tmp_path,
+                                           reference):
+        """``compact=False`` (or FLAGS_journal_compact=0) must leave
+        the journal strictly append-only: the pre-death bytes survive
+        verbatim and the serve is still bit-identical."""
+        d, reqs, streamed = self._dead_serve(model, tmp_path)
+        path = os.path.join(d, "journal.wal")
+        raw_before = open(path, "rb").read()
+        eng2, rmap = restore_from_dir(d, model, compact=False)
+        raw_after = open(path, "rb").read()
+        assert raw_after[:len(raw_before)] == raw_before
+        assert decode_stats()["journal_compactions"] == 0
+        _rewire(rmap, streamed)
+        eng2.run()
+        order = sorted(rmap)
+        assert [list(rmap[r].generated_ids) for r in order] == reference
+
+    def test_compact_journal_public_api(self, model, tmp_path,
+                                        reference):
+        """`compact_journal` works standalone (an operator trimming a
+        dead replica's journal before hand-off) and reports the
+        before/after sizes it achieved."""
+        d, reqs, streamed = self._dead_serve(model, tmp_path)
+        path = os.path.join(d, "journal.wal")
+        stats = durability.compact_journal(d)
+        assert stats["bytes_after"] < stats["bytes_before"]
+        assert stats["bytes_after"] == os.path.getsize(path)
+        assert stats["records_after"] < stats["records_before"]
+        eng2, rmap = restore_from_dir(d, model, compact=False)
+        _rewire(rmap, streamed)
+        eng2.run()
+        order = sorted(rmap)
+        assert [list(rmap[r].generated_ids) for r in order] == reference
+        assert [streamed[r] for r in order] == reference
+
+
+# ---------------------------------------------------------------------------
 # executable handoff (fast in-process recovery)
 # ---------------------------------------------------------------------------
 class TestExecutableHandoff:

@@ -1,0 +1,544 @@
+"""Fleet front door (paddle_tpu.fleet, ISSUE 18): HTTP/SSE edge +
+prefix-affinity router with zero-loss failover.
+
+Contracts pinned here:
+
+* the `EdgeServer` speaks real HTTP: ``POST /v1/generate`` streams
+  greedy tokens as SSE bit-identical to an in-process
+  ``engine.generate``, with contiguous token indexes, a meta event
+  first and a terminal done event; validation failures are 400s, an
+  unknown resume is a 404; ``GET /v1/info`` describes the replica
+  (routing salt, page size, config fingerprint, ops port, journal);
+* the router's routing key is byte-identical to the engine's prefix
+  chain (`FleetRouter._route_key` == `DecodeEngine
+  .route_prefix_hashes`) — affinity routing and the prefix cache key
+  on the SAME digests;
+* `add_replica` fails LOUDLY (`FleetConfigError`) for a replica with
+  no ops plane (``FLAGS_ops_port=0``: the router cannot poll what it
+  cannot reach) and for a config-fingerprint mismatch (failover
+  requires interchangeable replicas);
+* placement: affinity policy sends a repeated prefix back to the
+  replica holding its pages (longest-hash match wins), round_robin
+  cycles, admission respects headroom minus not-yet-polled
+  assignments;
+* zero-loss failover, durability level (`adopt_from_dir`): a dead
+  engine's journal replays into a LIVE survivor with per-request
+  delivered-token counts; delivered tokens are never re-emitted, the
+  snapshot-known undelivered suffix comes back as backfill, the
+  live continuation is token-for-token the uninterrupted oracle, a
+  request whose budget was exhausted adopts as done (never admitted),
+  and a fingerprint mismatch refuses adoption;
+* zero-loss failover, HTTP level: ``/v1/adopt`` + ``/v1/resume``
+  continue an interrupted stream mid-generation with SSE indexes
+  carrying on exactly where the delivered count stopped;
+* the fleet ``/alertz`` rollup merges per-replica alert snapshots
+  (unreachable replicas page), and a registered router surfaces it
+  under the ops server's ``/alertz``.
+"""
+import gc
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.fleet import (EdgeServer, FleetConfigError, FleetRouter,
+                              ReplicaHandle)
+from paddle_tpu.fleet.router import _sse_events
+from paddle_tpu.inference import durability
+from paddle_tpu.inference.serving import (DecodeEngine, decode_stats,
+                                          reset_decode_stats)
+from paddle_tpu.observability import opsserver
+from paddle_tpu.observability.alerts import fleet_rollup
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    gc.collect()
+    reset_decode_stats()
+    obs.reset()
+    obs.clear_spans()
+    yield
+    obs.stop_ops_server()
+    reset_decode_stats()
+    obs.reset()
+    obs.clear_spans()
+
+
+TINY = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                 num_heads=4, max_seq_len=256,
+                 use_parallel_layers=False, dropout=0.0)
+
+P1 = [1, 2, 3, 4, 5, 1, 2, 3, 4, 5, 1, 2]
+P2 = [7, 8, 9, 7, 8, 9, 7, 8]
+NEW = 12
+
+
+def _tiny_gpt(seed=0):
+    paddle.seed(seed)
+    m = GPT(TINY)
+    m.eval()
+    return m
+
+
+def _engine(m, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prefix_cache", True)
+    return DecodeEngine(m, **kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_gpt()
+
+
+@pytest.fixture(scope="module")
+def oracle(model):
+    """Uninterrupted greedy outputs every edge/fleet/failover serve
+    must reproduce bit for bit."""
+    eng = _engine(model)
+    outs = eng.generate([P1, P2], max_new_tokens=NEW)
+    return {tuple(P1): list(outs[0]), tuple(P2): list(outs[1])}
+
+
+def _post(url, body, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _drain_sse(resp):
+    """(meta, tokens, done_event) off one generation stream, asserting
+    contiguous token indexes."""
+    ev = _sse_events(resp)
+    meta = next(ev)
+    toks, done = [], None
+    for e in ev:
+        if e.get("done"):
+            done = e
+            break
+        assert e["i"] == meta.get("start_index", 0) + len(toks), e
+        toks.append(int(e["t"]))
+    return meta, toks, done
+
+
+# ---------------------------------------------------------------------------
+# the HTTP/SSE edge
+# ---------------------------------------------------------------------------
+class TestEdge:
+    def test_generate_sse_round_trip_matches_oracle(self, model,
+                                                    oracle):
+        edge = EdgeServer(_engine(model))
+        port = edge.start()
+        try:
+            for p in (P1, P2):
+                resp = _post(f"http://127.0.0.1:{port}/v1/generate",
+                             {"prompt_ids": p, "max_new_tokens": NEW})
+                assert resp.status == 200
+                assert resp.headers["Content-Type"] \
+                    .startswith("text/event-stream")
+                meta, toks, done = _drain_sse(resp)
+                assert meta["start_index"] == 0
+                assert isinstance(meta["request_id"], int)
+                assert toks == oracle[tuple(p)]
+                assert done["finish_reason"] in ("eos", "length")
+                assert done["n"] == len(toks)
+        finally:
+            edge.close()
+
+    def test_info_document(self, model):
+        eng = _engine(model)
+        edge = EdgeServer(eng)
+        port = edge.start()
+        try:
+            info = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/info",
+                timeout=10).read())
+            assert info["engine_id"] == eng._engine_id
+            assert info["config_fp"] == eng.config_fingerprint().hex()
+            assert info["page_size"] == 4
+            assert info["prefix_cache"] is True
+            assert info["route_salt"] == eng._model_salt.hex()
+            assert info["ops_port"] is None  # no ops server running
+            assert info["journal"] is None   # no journal armed
+        finally:
+            edge.close()
+
+    def test_validation_errors_are_400s(self, model):
+        edge = EdgeServer(_engine(model))
+        port = edge.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            for body in ({"prompt_ids": [], "max_new_tokens": 4},
+                         {"prompt_ids": P1, "max_new_tokens": 0},
+                         {"max_new_tokens": 4}):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _post(base + "/v1/generate", body)
+                assert ei.value.code == 400
+                assert "error" in json.loads(ei.value.read())
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(base + "/v1/adopt", {})
+            assert ei.value.code == 400
+        finally:
+            edge.close()
+
+    def test_resume_unknown_request_is_404(self, model):
+        edge = EdgeServer(_engine(model))
+        port = edge.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/resume?request=999",
+                    timeout=10)
+            assert ei.value.code == 404
+        finally:
+            edge.close()
+
+
+# ---------------------------------------------------------------------------
+# routing key + placement policy (no HTTP)
+# ---------------------------------------------------------------------------
+def _fake_replica(name, headroom=2, ready=True, slo_ok=None):
+    rep = ReplicaHandle(name, f"http://127.0.0.1:1/{name}")
+    rep.ready = ready
+    rep.headroom = headroom
+    rep.slo_ok = slo_ok
+    return rep
+
+
+def _bare_router(reps, policy="affinity"):
+    router = FleetRouter(policy=policy)
+    for rep in reps:
+        router._replicas[rep.name] = rep
+        router._inflight[rep.name] = set()
+    return router
+
+
+class TestRouting:
+    def test_route_key_matches_engine_prefix_chain(self, model):
+        """The cross-layer contract affinity routing stands on: the
+        router's digests are the ONES the engine's prefix cache keys
+        on."""
+        eng = _engine(model)
+        router = FleetRouter()
+        try:
+            router._salt = eng._model_salt
+            router._page = 4
+            for p in (P1, P2, [5] * 3):  # 3 pages, 2 pages, 0 pages
+                assert router._route_key(p) == \
+                    eng.route_prefix_hashes(p)
+            assert router._route_key([5] * 3) == []
+        finally:
+            router.close()
+
+    def test_affinity_prefers_longest_prefix_holder(self):
+        a, b = _fake_replica("a"), _fake_replica("b")
+        router = _bare_router([a, b])
+        try:
+            router._affinity["h0"] = "a"   # 1-page prefix -> a
+            router._affinity["h1"] = "b"   # 2-page prefix -> b
+            chosen, hit = router._pick([a, b], ["h0", "h1"])
+            assert (chosen.name, hit) == ("b", True)
+            # the longest hash's holder gone: falls back to the
+            # shorter prefix's holder, still a hit
+            chosen, hit = router._pick([a], ["h0", "h1"])
+            assert (chosen.name, hit) == ("a", True)
+            # no hash known: least-loaded, a miss
+            b.headroom = 5
+            chosen, hit = router._pick([a, b], ["hx"])
+            assert (chosen.name, hit) == ("b", False)
+        finally:
+            router.close()
+
+    def test_round_robin_cycles(self):
+        reps = [_fake_replica(n) for n in ("a", "b", "c")]
+        router = _bare_router(reps, policy="round_robin")
+        try:
+            picks = [router._pick(reps, [])[0].name for _ in range(6)]
+            assert picks == ["a", "b", "c", "a", "b", "c"]
+        finally:
+            router.close()
+
+    def test_admission_counts_unpolled_assignments(self):
+        a = _fake_replica("a", headroom=1)
+        assert a.admissible()
+        a.assigned_since_poll = 1  # headroom snapshot already spent
+        assert not a.admissible()
+        a.assigned_since_poll = 0
+        a.ready = False
+        assert not a.admissible()
+
+    def test_cost_gate_prefers_slo_ok_replicas(self):
+        slow = _fake_replica("slow", headroom=5, slo_ok=False)
+        fast = _fake_replica("fast", headroom=1, slo_ok=True)
+        router = _bare_router([slow, fast])
+        try:
+            # slow has more raw headroom, but its calibrated predictor
+            # says the next step blows the SLO: fast wins
+            chosen, _ = router._pick([slow, fast], [])
+            assert chosen.name == "fast"
+            # with every replica predicted-slow, capacity decides
+            fast.slo_ok = False
+            chosen, _ = router._pick([slow, fast], [])
+            assert chosen.name == "slow"
+        finally:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet wiring validation
+# ---------------------------------------------------------------------------
+class TestFleetConfig:
+    def test_replica_without_ops_plane_refused(self, model):
+        """FLAGS_ops_port=0 means no /readyz listener: the router must
+        refuse the replica loudly instead of reading it never-ready
+        forever."""
+        edge = EdgeServer(_engine(model))
+        port = edge.start()
+        router = FleetRouter()
+        try:
+            with pytest.raises(FleetConfigError) as ei:
+                router.add_replica("r0", f"http://127.0.0.1:{port}")
+            msg = str(ei.value)
+            assert "FLAGS_ops_port" in msg and "readyz" in msg
+        finally:
+            router.close()
+            edge.close()
+
+    def test_config_fingerprint_mismatch_refused(self, model):
+        e1, e2 = _engine(model), _engine(model, page_size=8)
+        edge1, edge2 = EdgeServer(e1), EdgeServer(e2)
+        p1, p2 = edge1.start(), edge2.start()
+        opsserver.start_ops_server(port=0)
+        router = FleetRouter()
+        try:
+            router.add_replica("r0", f"http://127.0.0.1:{p1}")
+            with pytest.raises(FleetConfigError) as ei:
+                router.add_replica("r1", f"http://127.0.0.1:{p2}")
+            assert "fingerprint" in str(ei.value)
+        finally:
+            router.close()
+            edge1.close()
+            edge2.close()
+
+
+# ---------------------------------------------------------------------------
+# zero-loss adoption: durability level
+# ---------------------------------------------------------------------------
+class TestAdoptFromDir:
+    def _dead_replica(self, model, tmp_path, steps=6):
+        """A journaling engine that 'dies' mid-serve: returns its
+        journal dir, its requests, and what each streamed."""
+        jd = str(tmp_path / "journal")
+        eng = _engine(model, journal_dir=jd)
+        streamed = {}
+        reqs = []
+        for p in (P1, P2):
+            req = eng.add_request(p, max_new_tokens=NEW)
+            req.on_token = (lambda rid: lambda t: streamed.setdefault(
+                rid, []).append(t))(req.request_id)
+            reqs.append(req)
+        for _ in range(steps):
+            eng.step()
+        return jd, reqs, streamed
+
+    def test_token_for_token_continuity(self, model, oracle,
+                                        tmp_path):
+        jd, reqs, streamed = self._dead_replica(model, tmp_path)
+        assert any(streamed.values()), "kill must land mid-generation"
+        # the router reports what each stream actually DELIVERED —
+        # exercise under-delivery (2 behind) and exact delivery
+        delivered = {reqs[0].request_id: max(0, len(
+            streamed.get(reqs[0].request_id, [])) - 2)}
+        if reqs[1].request_id in streamed:
+            delivered[reqs[1].request_id] = \
+                len(streamed[reqs[1].request_id])
+        survivor = _engine(model)
+        got = {}
+        factory = (lambda rid: lambda t: got.setdefault(
+            rid, []).append(t))
+        rmap, meta = durability.adopt_from_dir(
+            jd, survivor, delivered=delivered,
+            on_token_factory=factory)
+        assert sorted(rmap) == sorted(r.request_id for r in reqs)
+        survivor.run()
+        for req in reqs:
+            d = delivered.get(req.request_id, 0)
+            m = meta[req.request_id]
+            assert m["start_index"] == d
+            # delivered prefix + backfill + live tokens == the oracle,
+            # token for token: nothing lost, nothing re-emitted
+            full = (streamed.get(req.request_id, [])[:d] +
+                    m["backfill"] + got.get(req.request_id, []))
+            assert full == oracle[tuple(req.prompt_ids)], \
+                (req.request_id, d, m)
+        assert decode_stats()["adoptions"] == 1
+
+    def test_finished_requests_never_re_adopt(self, model, tmp_path):
+        """A request that finished cleanly before the death (its "f"
+        record made the journal) must NOT come back to life on the
+        survivor — only genuinely in-flight work migrates."""
+        jd = str(tmp_path / "journal")
+        eng = _engine(model, journal_dir=jd)
+        done = eng.add_request(P1, max_new_tokens=4)
+        while done.state != "done":
+            eng.step()
+        live = eng.add_request(P2, max_new_tokens=NEW)
+        for _ in range(2):
+            eng.step()
+        assert live.state != "done"
+        survivor = _engine(model)
+        rmap, meta = durability.adopt_from_dir(jd, survivor)
+        assert sorted(rmap) == [live.request_id]
+        survivor.run()
+        assert rmap[live.request_id].state == "done"
+
+    def test_fingerprint_mismatch_refused(self, model, tmp_path):
+        jd, _, _ = self._dead_replica(model, tmp_path, steps=2)
+        survivor = _engine(model, page_size=8)
+        with pytest.raises(ValueError, match="fingerprint"):
+            durability.adopt_from_dir(jd, survivor)
+
+    def test_adopted_ids_never_collide_with_survivor(self, model,
+                                                     tmp_path):
+        jd, reqs, _ = self._dead_replica(model, tmp_path, steps=2)
+        survivor = _engine(model)
+        own = survivor.add_request(P2, max_new_tokens=4)
+        rmap, _ = durability.adopt_from_dir(jd, survivor)
+        ids = [own.request_id] + [r.request_id for r in rmap.values()]
+        assert len(ids) == len(set(ids))
+        survivor.run()
+        assert own.state == "done"
+
+
+# ---------------------------------------------------------------------------
+# zero-loss failover: the HTTP surface (/v1/adopt + /v1/resume)
+# ---------------------------------------------------------------------------
+class TestFailoverHTTP:
+    def test_adopt_and_resume_continue_the_stream(self, model, oracle,
+                                                  tmp_path):
+        jd = str(tmp_path / "journal")
+        dead = _engine(model, journal_dir=jd)
+        req = dead.add_request(P1, max_new_tokens=NEW)
+        streamed = []
+        req.on_token = streamed.append
+        for _ in range(6):
+            dead.step()
+        assert len(streamed) >= 3
+        delivered = len(streamed) - 1  # one token never reached a client
+
+        edge = EdgeServer(_engine(model))
+        port = edge.start()
+        try:
+            out = json.loads(_post(
+                f"http://127.0.0.1:{port}/v1/adopt",
+                {"journal_dir": jd,
+                 "delivered": {req.request_id: delivered}}).read())
+            entry = out["migrated"][str(req.request_id)]
+            assert entry["start_index"] == delivered
+            assert not entry["done"]
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/resume"
+                f"?request={req.request_id}", timeout=60)
+            meta, toks, done = _drain_sse(resp)
+            assert meta["start_index"] == delivered
+            assert streamed[:delivered] + toks == oracle[tuple(P1)]
+            assert done["finish_reason"] in ("eos", "length")
+            # a resume is one-shot: the relay was claimed
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/resume"
+                    f"?request={req.request_id}", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            edge.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: router over live edges (single process, real HTTP)
+# ---------------------------------------------------------------------------
+class TestFleetEndToEnd:
+    def test_affinity_routes_repeat_prefix_to_same_replica(
+            self, model, oracle):
+        e1, e2 = _engine(model), _engine(model)
+        edge1, edge2 = EdgeServer(e1), EdgeServer(e2)
+        p1, p2 = edge1.start(), edge2.start()
+        opsserver.start_ops_server(port=0)
+        router = FleetRouter(poll_interval_s=0.02)
+        try:
+            router.add_replica("r0", f"http://127.0.0.1:{p1}")
+            router.add_replica("r1", f"http://127.0.0.1:{p2}")
+            router.start()
+            s1 = router.submit(P1, max_new_tokens=NEW)
+            assert s1.result(timeout=120) == oracle[tuple(P1)]
+            assert s1.finish_reason in ("eos", "length")
+            first = s1.replica
+            # the same prefix again: an affinity hit, same replica
+            s2 = router.submit(P1, max_new_tokens=NEW)
+            assert s2.result(timeout=120) == oracle[tuple(P1)]
+            assert s2.affinity_hit is True
+            assert s2.replica == first
+            assert router.stats["affinity_hits"] >= 1
+            assert router.stats["submitted"] == 2
+        finally:
+            router.close()
+            edge1.close()
+            edge2.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet /alertz rollup
+# ---------------------------------------------------------------------------
+class TestFleetRollup:
+    def test_merges_firing_and_pages_on_unreachable(self):
+        doc = {"engines": {"0": {
+            "firing": ["kv_pressure"],
+            "rules": {"kv_pressure": {"state": "firing",
+                                      "severity": "page",
+                                      "value": 0.99},
+                      "quiet": {"state": "ok",
+                                "severity": "ticket"}}}}}
+        roll = fleet_rollup({"r0": doc, "r1": None},
+                            events=[{"event": "failover"}],
+                            replicas_ready=1)
+        assert roll["replicas"]["r0"]["reachable"]
+        assert not roll["replicas"]["r1"]["reachable"]
+        assert roll["reachable"] == 1
+        assert roll["replicas_ready"] == 1
+        assert roll["firing"]["page"] == ["r0/0/kv_pressure"]
+        assert roll["paging"] is True  # page alert + dead replica
+        assert roll["events"] == [{"event": "failover"}]
+        # an all-quiet reachable fleet does not page
+        quiet = fleet_rollup({"r0": {"engines": {"0": {
+            "firing": [], "rules": {}}}}})
+        assert quiet["paging"] is False
+
+    def test_registered_router_surfaces_on_alertz(self, model):
+        class _Stub:
+            def alertz_rollup(self):
+                return {"replicas": {"r9": {"reachable": True,
+                                            "firing": []}},
+                        "reachable": 1, "firing": {},
+                        "paging": False}
+
+        eng = _engine(model)  # noqa: F841  (a live engine for /alertz)
+        port = opsserver.start_ops_server(port=0)
+        stub = _Stub()
+        opsserver.register_fleet(stub)
+        try:
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/alertz", timeout=10).read())
+            assert doc["fleet"]["replicas"]["r9"]["reachable"]
+        finally:
+            opsserver.deregister_fleet(stub)
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/alertz", timeout=10).read())
+        assert "fleet" not in doc

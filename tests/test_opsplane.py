@@ -456,6 +456,54 @@ class TestReadiness:
         assert wd.overdue() is False
         assert opsserver.engine_ready(eng)["ready"]
 
+    def test_verdict_self_consistent_under_concurrent_polling(
+            self, model):
+        """Fleet satellite: poller threads hammer `engine_ready` (the
+        exact function /readyz serves) while the main thread flips
+        every input the verdict consults — health live/hung, capacity
+        headroom, the watchdog armed bit.  The verdict is computed
+        from ONE snapshot of captured locals, so no poller may ever
+        observe a dict whose ready bit disagrees with the conjunction
+        of its own criteria — a torn verdict would route traffic into
+        a hung or full replica."""
+        from paddle_tpu.inference.durability import clear_health, \
+            set_health
+
+        eng = _engine(model, step_timeout_ms=500.0)
+        stop = threading.Event()
+        torn = []
+
+        def poll():
+            while not stop.is_set():
+                c = opsserver.engine_ready(eng)
+                expect = (c["serving"] and c["headroom_slots"] > 0
+                          and not c["page_alerts"]
+                          and not c["watchdog_overdue"])
+                if bool(c["ready"]) != bool(expect):
+                    torn.append(c)
+
+        pollers = [threading.Thread(target=poll) for _ in range(4)]
+        for t in pollers:
+            t.start()
+        try:
+            wd = eng._watchdog
+            for i in range(300):
+                set_health(eng._engine_id,
+                           "hung" if i % 2 else "live")
+                if i % 3 == 0:  # headroom 2 -> 0 -> 2
+                    drained = [eng._free_slots.pop()
+                               for _ in range(len(eng._free_slots))]
+                    eng._free_slots.extend(drained)
+                (wd.arm if i % 2 else wd.disarm)()
+        finally:
+            stop.set()
+            for t in pollers:
+                t.join()
+            wd.disarm()
+            set_health(eng._engine_id, "live")
+            clear_health(eng._engine_id)
+        assert not torn, torn[:3]
+
     def test_abandoned_engine_leaves_registry(self, model):
         eng = _engine(model, step_timeout_ms=500.0)
         eng.add_request(np.array(PROMPTS[0], np.int32),

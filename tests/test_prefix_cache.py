@@ -522,6 +522,45 @@ class TestDraftCacheSharing:
 
 
 # ---------------------------------------------------------------------------
+# satellite (fleet PR): generated-page registration is opt-in
+# ---------------------------------------------------------------------------
+class TestGeneratedPageFlag:
+    """FLAGS_cache_generated_pages gates registering GENERATED full KV
+    pages as decode crosses page boundaries — default OFF (the PR 17
+    behavior becomes opt-in); on or off, greedy output is untouched."""
+
+    def test_default_off_and_parity(self):
+        m = _tiny_gpt()
+        p = np.arange(1, 9, dtype=np.int32)  # 2 full pages
+        off = _engine(m, prefix_cache=True)
+        assert off._cache_generated is False  # flag default
+        out_off = list(off.generate([p], max_new_tokens=10)[0])
+        on = _engine(m, prefix_cache=True, cache_generated_pages=True)
+        out_on = list(on.generate([p], max_new_tokens=10)[0])
+        assert out_on == out_off  # registration never alters sampling
+
+        # fanout prompt extending prompt+output: with the flag ON the
+        # generated pages hit; OFF they're novel (prompt pages only)
+        p2 = np.concatenate([p, np.asarray(out_off[:8], np.int32)])
+        outs = {}
+        for name, eng, expect in (("off", off, 2), ("on", on, 3)):
+            reset_decode_stats()
+            outs[name] = list(eng.generate([p2], max_new_tokens=4)[0])
+            assert decode_stats()["prefix_hits"] == expect
+        # parity on the fanout too: hits change work, never tokens
+        assert outs["on"] == outs["off"]
+
+    def test_flag_without_prefix_cache_resolves_off(self):
+        m = _tiny_gpt()
+        eng = _engine(m, prefix_cache=False,
+                      cache_generated_pages=True)
+        assert eng._cache_generated is False
+        p = np.arange(1, 9, dtype=np.int32)
+        eng.generate([p], max_new_tokens=8)
+        assert decode_stats()["prefix_hits"] == 0
+
+
+# ---------------------------------------------------------------------------
 # satellite: request ids are race-free
 # ---------------------------------------------------------------------------
 class TestRequestIds:

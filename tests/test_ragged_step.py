@@ -373,6 +373,9 @@ class TestGeneratedPagePrefix:
         kw.setdefault("max_seq_len", 64)
         kw.setdefault("page_size", 4)
         kw.setdefault("prefix_cache", True)
+        # generated-page registration went flag-gated (default off) in
+        # the fleet PR; this class exists to pin its on-behavior
+        kw.setdefault("cache_generated_pages", True)
         return _engine(m, **kw)
 
     def test_fanout_hits_generated_pages(self):

@@ -451,6 +451,41 @@ def test_bench_chaos_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_bench_fleet_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_fleet.py runs end-to-end: the fleet
+    chaos bench can't rot.  Asserts the fleet acceptance bar at smoke
+    scale (2 replica child processes): prefix-affinity routing lands a
+    strictly higher fleet-wide prefix-cache hit rate than round-robin,
+    and a kill -9'd replica's inflight streams migrate to the survivor
+    with zero request loss, token-for-token SSE continuity vs the
+    greedy oracle, a bounded post-failover TTFT, and the /alertz
+    rollup narrating the failover.  Slow lane: multi-replica chaos
+    spawns + compiles several engine processes."""
+    out = str(tmp_path / "bench_fleet.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_fleet.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    s = data["summary"]
+    assert s["affinity_wins"] is True
+    assert s["affinity_hit_rate"] > s["round_robin_hit_rate"]
+    assert s["killed_by_sigkill"] is True
+    assert s["zero_request_loss"] is True
+    assert s["token_continuity"] is True
+    assert s["streams_migrated"] >= 1
+    assert s["ttft_after_kill_bounded"] is True
+    assert s["rollup_narrates_failover"] is True
+    chaos = data["legs"]["chaos"]
+    assert chaos["victim_exit"] == -9
+    assert chaos["inflight_on_victim"] >= 1
+    assert chaos["failovers"] >= 1
+
+
+@pytest.mark.slow
 def test_bench_recovery_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_recovery.py runs end-to-end: the
     durable-serving bench can't rot.  Asserts the acceptance bar at
