@@ -7,8 +7,9 @@ Two halves:
   executed, no jax import).  `tools/tracecheck.py` is the CLI;
   `run_tracecheck()` is the library entry.  The **repo spec** below
   names the designated locks, guarded registries, engine-mutation
-  sanction sites, and default scan targets — the invariants the
-  serving stack's docstrings promise, made machine-checkable.
+  sanction sites, the fleet-trace control-plane allowlist, and the
+  default scan targets — the invariants the serving stack's
+  docstrings promise, made machine-checkable.
 * `sanitizer` — runtime mode (``FLAGS_sanitize``): donated-buffer
   tombstones with use-after-donate errors naming the donation site,
   lock-order cycle detection over the designated locks, warm retraces
@@ -31,17 +32,21 @@ import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .passes import (  # noqa: F401
-    DonationPass, EngineMutationPass, EngineRule, Finding, LockRule,
+    DonationPass, EngineMutationPass, EngineRule, Finding,
+    FleetTracePass, FleetTraceRule, LockRule,
     LockDisciplinePass, SourceModule, TraceHazardPass, run_passes,
     scan_paths,
 )
 from . import sanitizer  # noqa: F401
 
 __all__ = [
-    "Finding", "LockRule", "EngineRule", "SourceModule",
+    "Finding", "LockRule", "EngineRule", "FleetTraceRule",
+    "SourceModule",
     "TraceHazardPass", "LockDisciplinePass", "EngineMutationPass",
-    "DonationPass", "run_passes", "scan_paths", "run_tracecheck",
-    "REPO_LOCK_RULES", "REPO_ENGINE_RULE", "DEFAULT_TARGETS",
+    "DonationPass", "FleetTracePass", "run_passes", "scan_paths",
+    "run_tracecheck",
+    "REPO_LOCK_RULES", "REPO_ENGINE_RULE", "REPO_FLEET_TRACE_RULE",
+    "DEFAULT_TARGETS",
     "load_baseline", "write_baseline", "split_baselined", "sanitizer",
 ]
 
@@ -211,13 +216,34 @@ REPO_ENGINE_RULE = EngineRule(
     },
 )
 
+# Fleet trace propagation (docs/FLEET_TRACING.md): every HTTP site
+# under paddle_tpu/fleet/ must carry the x-paddle-trace plumbing or
+# sit on this allowlist — control-plane endpoints that carry no
+# request identity, so there is nothing to trace:
+#   _get_json / _post_json    router's generic JSON fetch/post helpers
+#   FleetRouter._fetch_text   /metrics scrape for the /fleetz rollup
+#   ReplicaHandle.fetch_info  /v1/info identity card at add_replica
+#   ReplicaHandle.poll        /readyz admission poll (its t0/t1/now_ns
+#                             FEED the clock sync, but the poll itself
+#                             belongs to no request)
+#   ReplicaHandle.alertz      /alertz scrape for the fleet rollup
+REPO_FLEET_TRACE_RULE = FleetTraceRule(
+    path_markers=("paddle_tpu/fleet/",),
+    allowlist=(
+        "_get_json", "_post_json", "FleetRouter._fetch_text",
+        "ReplicaHandle.fetch_info", "ReplicaHandle.poll",
+        "ReplicaHandle.alertz",
+    ),
+)
+
 # What `tools/tracecheck.py` scans by default (repo-root relative):
-# the serving stack plus the dispatch cache — the modules whose
-# invariants the passes encode.
+# the serving stack plus the dispatch cache and the fleet's network
+# plane — the modules whose invariants the passes encode.
 DEFAULT_TARGETS: Tuple[str, ...] = (
     "paddle_tpu/inference",
     "paddle_tpu/observability",
     "paddle_tpu/core/dispatch.py",
+    "paddle_tpu/fleet",
 )
 
 
@@ -229,7 +255,8 @@ def repo_root() -> str:
 def run_tracecheck(paths: Optional[Sequence[str]] = None,
                    root: Optional[str] = None,
                    lock_rules: Optional[Dict[str, LockRule]] = None,
-                   engine_rule: Optional[EngineRule] = None
+                   engine_rule: Optional[EngineRule] = None,
+                   fleet_rule: Optional[FleetTraceRule] = None
                    ) -> List[Finding]:
     """Run every static pass over ``paths`` (default: the repo's
     serving-stack targets) and return the sorted findings."""
@@ -239,7 +266,9 @@ def run_tracecheck(paths: Optional[Sequence[str]] = None,
         modules,
         lock_rules=REPO_LOCK_RULES if lock_rules is None else lock_rules,
         engine_rule=REPO_ENGINE_RULE if engine_rule is None
-        else engine_rule)
+        else engine_rule,
+        fleet_rule=REPO_FLEET_TRACE_RULE if fleet_rule is None
+        else fleet_rule)
 
 
 # ---------------------------------------------------------------------------

@@ -38,6 +38,14 @@ otherwise only prose in a docstring:
   pool state under FLAGS_kv_quant — must appear in ``donate_argnums``
   (a missed donation means a full extra copy of the KV pool, or a
   silently copied scale buffer, per step).
+* **fleet-trace** (`FleetTracePass`) — every HTTP site under the
+  fleet plane (a client leg calling ``urlopen``, or a ``do_*``
+  server-handler method) must carry the fleet trace: reference the
+  ``x-paddle-trace`` plumbing (``fleettrace`` / ``TRACE_HEADER`` /
+  the literal header string) in its body or same-module call
+  closure, or sit on the explicit allowlist of control-plane
+  endpoints with no request identity — so a new fleet endpoint
+  cannot silently drop the trace (docs/FLEET_TRACING.md).
 
 Findings carry a content-addressed ``fingerprint`` (pass id + file +
 source line text, no line number) so the baseline grandfather file
@@ -57,9 +65,10 @@ from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
-    "Finding", "SourceModule", "LockRule", "EngineRule", "scan_paths",
+    "Finding", "SourceModule", "LockRule", "EngineRule",
+    "FleetTraceRule", "scan_paths",
     "TraceHazardPass", "LockDisciplinePass", "EngineMutationPass",
-    "DonationPass", "run_passes",
+    "DonationPass", "FleetTracePass", "run_passes",
 ]
 
 
@@ -757,11 +766,112 @@ class DonationPass:
 
 
 # ---------------------------------------------------------------------------
+# fleet-trace propagation
+# ---------------------------------------------------------------------------
+@dataclass
+class FleetTraceRule:
+    """Which modules are the fleet's network plane, which spellings
+    count as carrying the trace, and which HTTP sites are exempt
+    (control-plane endpoints with no request identity)."""
+
+    path_markers: Tuple[str, ...] = ("fleet/",)   # relpath substring
+    trace_names: Tuple[str, ...] = ("fleettrace", "TRACE_HEADER")
+    trace_literal: str = "x-paddle-trace"
+    allowlist: Tuple[str, ...] = ()               # exact qualnames
+
+
+class FleetTracePass:
+    """Every HTTP site under the fleet plane must carry the fleet
+    trace (docs/FLEET_TRACING.md): a client leg (any function calling
+    ``urlopen``) or a server handler (``do_*`` method) either
+    references the trace plumbing — ``fleettrace``, ``TRACE_HEADER``,
+    or the literal ``x-paddle-trace`` string — in its body or its
+    same-module call closure, or sits on the explicit allowlist.  A
+    new fleet endpoint that silently drops the trace flags the moment
+    it is written."""
+
+    def __init__(self, rule: FleetTraceRule):
+        self.rule = rule
+
+    def _in_scope(self, relpath: str) -> bool:
+        return any(mark in relpath for mark in self.rule.path_markers)
+
+    @staticmethod
+    def _site_kind(fn: ast.AST) -> Optional[str]:
+        if getattr(fn, "name", "").startswith("do_"):
+            return "HTTP handler"
+        for node in EngineMutationPass._own_nodes(fn):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d is not None and d.split(".")[-1] == "urlopen":
+                    return "HTTP client leg"
+        return None
+
+    def _carries_trace(self, fn: ast.AST, mod: SourceModule,
+                       visited: Optional[set] = None) -> bool:
+        """Body or same-module transitive call closure references the
+        trace plumbing.  The closure walk matters: ``do_POST``
+        dispatches to ``_generate`` which reads the header — the
+        handler itself never spells the name."""
+        if visited is None:
+            visited = set()
+        if id(fn) in visited:
+            return False
+        visited.add(id(fn))
+        called: List[str] = []
+        for node in EngineMutationPass._own_nodes(fn):
+            if isinstance(node, ast.Name) and \
+                    node.id in self.rule.trace_names:
+                return True
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in self.rule.trace_names:
+                return True
+            if isinstance(node, ast.Constant) and \
+                    node.value == self.rule.trace_literal:
+                return True
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d is not None:
+                    called.append(d.split(".")[-1])
+        for name in called:
+            target = mod.functions.get(name)
+            if target is not None and \
+                    self._carries_trace(target, mod, visited):
+                return True
+        return False
+
+    def run(self, modules: Sequence[SourceModule]) -> List[Finding]:
+        out: List[Finding] = []
+        for m in modules:
+            if not self._in_scope(m.relpath):
+                continue
+            for qualname, fn in _qualname_walk(m.tree):
+                kind = self._site_kind(fn)
+                if kind is None:
+                    continue
+                if qualname in self.rule.allowlist:
+                    continue
+                if self._carries_trace(fn, m):
+                    continue
+                f = m.finding(
+                    "fleet-trace", fn,
+                    f"{kind} `{qualname}` neither propagates the fleet "
+                    f"trace (x-paddle-trace / fleettrace.TRACE_HEADER) "
+                    f"nor sits on the control-plane allowlist — fleet "
+                    f"HTTP surfaces must carry the trace or be "
+                    f"explicitly exempted (docs/FLEET_TRACING.md)")
+                if f:
+                    out.append(f)
+        return out
+
+
+# ---------------------------------------------------------------------------
 # combined runner
 # ---------------------------------------------------------------------------
 def run_passes(modules: Sequence[SourceModule],
                lock_rules: Optional[Dict[str, LockRule]] = None,
-               engine_rule: Optional[EngineRule] = None
+               engine_rule: Optional[EngineRule] = None,
+               fleet_rule: Optional[FleetTraceRule] = None
                ) -> List[Finding]:
     findings: List[Finding] = []
     sites = collect_jit_sites(modules)  # shared: one AST walk, 2 users
@@ -771,6 +881,8 @@ def run_passes(modules: Sequence[SourceModule],
     if engine_rule:
         findings.extend(EngineMutationPass(engine_rule).run(modules))
     findings.extend(DonationPass().run(modules, sites))
+    if fleet_rule:
+        findings.extend(FleetTracePass(fleet_rule).run(modules))
     findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
     seen: Dict[tuple, int] = {}
     out = []

@@ -498,6 +498,20 @@ define_flag("profile_dir", "",
             "XLA-level timeline lands beside the probe spans.  Empty "
             "(default) = probe spans only (the merged chrome trace's "
             "'device' track still works)")
+define_flag("fleet_trace", False,
+            "fleet-scope distributed tracing (observability."
+            "fleettrace): FleetRouter.submit mints a trace id that "
+            "rides every /v1/generate, /v1/adopt and /v1/resume leg "
+            "as an x-paddle-trace header, the edge threads it into "
+            "the frontend so engine-side request spans and flight "
+            "records carry it, a failover leg reuses the donor's id "
+            "(two segments of one trace), routing / SSE-delivery / "
+            "failover decisions become spans on router+edge tracks, "
+            "each edge serves /tracez/spans, and the router's "
+            "/fleetz rollup merges replica span sets into one "
+            "clock-offset-corrected chrome trace.  False (default) "
+            "= fully off: zero new wire headers, zero new spans, "
+            "zero extra probes, bit-exact serving")
 define_flag("use_rbg_rng", True,
             "on TPU, use the hardware RBG PRNG for the framework's random "
             "ops instead of threefry (measured: recovers ~60% of dropout's "

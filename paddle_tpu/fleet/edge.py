@@ -33,7 +33,19 @@ GET /v1/resume      ``?request=<donor id>`` — one-shot: stream the
 GET /v1/info        replica identity: engine id, config fingerprint,
                     routing salt + page size (the router's hash inputs),
                     ops-plane port, journal directory
+GET /tracez/spans   this replica's span-buffer slice as JSON
+                    (``?trace=<id>`` and/or ``?since_ns=&until_ns=``),
+                    plus the replica clock (``now_ns``) — the fleet
+                    rollup's merge input (observability.fleettrace)
 =================== ======================================================
+
+With ``FLAGS_fleet_trace`` on, ``/v1/generate`` / ``/v1/adopt`` /
+``/v1/resume`` read the ``x-paddle-trace`` header (the router mints the
+id) and thread it through the frontend onto the engine request, so
+engine-side request spans and flight records tag themselves with the
+fleet-wide trace id; SSE delivery, adoption and resume each record
+spans on an ``edge`` track.  Flag off (default): the header is never
+read, no edge spans record — bit-exact with the pre-trace edge.
 
 A disconnected ``/v1/generate`` consumer cancels its request (queued or
 running); a disconnected ``/v1/resume`` consumer does NOT — the adopted
@@ -43,6 +55,7 @@ failover) still loses nothing.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import queue
 import threading
@@ -50,11 +63,24 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from ..observability import fleettrace
+
 __all__ = ["EdgeServer"]
 
 # generation kwargs the edge forwards verbatim to add_request
 _REQUEST_KWARGS = ("eos_token_id", "priority", "deadline_ms",
                    "slo_ttft_ms", "slo_tpot_ms")
+
+
+def _edge_span(name: str, tid: int = 0, **args):
+    """RAII span on the ``edge`` track — a no-op context unless
+    FLAGS_fleet_trace, so the default edge records nothing."""
+    if not fleettrace.enabled():
+        return contextlib.nullcontext()
+    from ..observability import tracing
+
+    kept = {k: v for k, v in args.items() if v is not None}
+    return tracing.span("edge", name, tid=int(tid), args=kept or None)
 
 
 class _Relay:
@@ -69,6 +95,7 @@ class _Relay:
         self.start_index = int(start_index)
         self.request_id: Optional[int] = None
         self.stream = None  # TokenStream, for cancel-on-disconnect
+        self.trace: Optional[str] = None  # fleet trace id, if any
 
 
 class EdgeServer:
@@ -202,6 +229,7 @@ class EdgeServer:
         """Submit one request; returns its relay (meta already
         resolved).  Raises whatever `add_request` would."""
         relay = _Relay()
+        relay.trace = kwargs.get("trace_id")
 
         async def _submit():
             stream = await self.frontend.submit(
@@ -226,23 +254,30 @@ class EdgeServer:
             pass
 
     def adopt(self, journal_dir: str,
-              delivered: Optional[Dict[int, int]] = None) -> dict:
+              delivered: Optional[Dict[int, int]] = None,
+              traces: Optional[Dict[int, str]] = None) -> dict:
         """Failover entry (``POST /v1/adopt``): replay the dead
         sibling's journal into this replica's engine and park one
         relay per migrated request for ``/v1/resume``.  Returns the
-        JSON-safe migration map keyed by donor request id."""
+        JSON-safe migration map keyed by donor request id.  ``traces``
+        (router-supplied donor id -> fleet trace id) is the fallback
+        for trace-less journals — the journal's own record wins."""
         delivered = {int(k): int(v)
                      for k, v in (delivered or {}).items()}
+        traces = {int(k): str(v) for k, v in (traces or {}).items()}
 
         async def _adopt():
             return await self.frontend.adopt(journal_dir,
-                                             delivered=delivered)
-        out = self._run(_adopt(), self.submit_timeout_s)
+                                             delivered=delivered,
+                                             traces=traces or None)
+        with _edge_span("adopt", donor=journal_dir):
+            out = self._run(_adopt(), self.submit_timeout_s)
         migrated = {}
         with self._adopt_lock:
             for rid, info in out.items():
                 relay = _Relay(start_index=info["start_index"])
                 relay.request_id = int(info["request_id"])
+                relay.trace = info.get("trace")
                 # backfill BEFORE the pump is scheduled: the relay
                 # queue then orders snapshot-known tokens ahead of
                 # live recompute by construction
@@ -257,6 +292,8 @@ class EdgeServer:
                     "backfill_tokens": len(info["backfill"]),
                     "done": bool(info["done"]),
                 }
+                if relay.trace is not None:
+                    migrated[int(rid)]["trace"] = relay.trace
         return migrated
 
     def pop_adopted(self, donor_id: int) -> Optional[_Relay]:
@@ -347,6 +384,8 @@ class _EdgeHandler(BaseHTTPRequestHandler):
                 self._send_json(self.edge.info())
             elif url.path == "/v1/resume":
                 self._resume(parse_qs(url.query))
+            elif url.path == "/tracez/spans":
+                self._tracez_spans(parse_qs(url.query))
             else:
                 self._send_json({"error": f"unknown endpoint "
                                           f"{url.path!r}"}, code=404)
@@ -368,7 +407,8 @@ class _EdgeHandler(BaseHTTPRequestHandler):
                     return
                 self._send_json({"migrated": self.edge.adopt(
                     str(body["journal_dir"]),
-                    body.get("delivered") or {})})
+                    body.get("delivered") or {},
+                    traces=self._traces_in(body))})
             else:
                 self._send_json({"error": f"unknown endpoint "
                                           f"{url.path!r}"}, code=404)
@@ -384,6 +424,19 @@ class _EdgeHandler(BaseHTTPRequestHandler):
         except Exception:
             pass
 
+    def _trace_in(self) -> Optional[str]:
+        """The request's fleet trace id — only read while
+        FLAGS_fleet_trace is on (default off never inspects headers)."""
+        if not fleettrace.enabled():
+            return None
+        trace = self.headers.get(fleettrace.TRACE_HEADER)
+        return str(trace) if trace else None
+
+    def _traces_in(self, body: dict) -> Optional[dict]:
+        if not fleettrace.enabled():
+            return None
+        return body.get("traces") or None
+
     def _generate(self):
         body = self._body()
         prompt = body.get("prompt_ids")
@@ -391,6 +444,9 @@ class _EdgeHandler(BaseHTTPRequestHandler):
             self._send_json({"error": "prompt_ids required"}, code=400)
             return
         kwargs = {k: body[k] for k in _REQUEST_KWARGS if k in body}
+        trace = self._trace_in()
+        if trace is not None:
+            kwargs["trace_id"] = trace
         try:
             relay = self.edge.open_stream(
                 prompt, body.get("max_new_tokens", 32), kwargs)
@@ -401,7 +457,9 @@ class _EdgeHandler(BaseHTTPRequestHandler):
                             code=400)
             return
         try:
-            self._sse_drain(relay)
+            with _edge_span("sse", tid=relay.request_id or 0,
+                            request=relay.request_id, trace=relay.trace):
+                self._sse_drain(relay)
         except (BrokenPipeError, ConnectionResetError):
             self.edge.cancel_stream(relay)  # consumer went away
 
@@ -420,4 +478,25 @@ class _EdgeHandler(BaseHTTPRequestHandler):
         # a dropped resume consumer does NOT cancel the request: the
         # engine keeps generating and a re-adoption (second failover)
         # still covers every token
-        self._sse_drain(relay)
+        with _edge_span("resume", tid=relay.request_id or 0,
+                        request=relay.request_id, trace=relay.trace):
+            self._sse_drain(relay)
+
+    def _tracez_spans(self, query):
+        """``GET /tracez/spans`` — this replica's span-buffer slice
+        (read-only; served regardless of FLAGS_fleet_trace so a
+        router-side merge can still collect engine spans)."""
+        from ..observability import tracing
+
+        trace = query.get("trace", [None])[0]
+        since = query.get("since_ns", [None])[0]
+        until = query.get("until_ns", [None])[0]
+        out = fleettrace.span_slice(
+            tracing.spans(), trace=trace,
+            since_ns=None if since is None else int(since),
+            until_ns=None if until is None else int(until))
+        self._send_json({
+            "engine_id": int(self.edge.engine._engine_id),
+            "now_ns": int(tracing.now_ns()),
+            "spans": out,
+        })

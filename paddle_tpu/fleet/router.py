@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 
 from .. import observability as _obs
 from ..inference.serving import _chain_hash
+from ..observability import fleettrace
 
 __all__ = ["FleetConfigError", "ReplicaHandle", "FleetStream",
            "FleetRouter"]
@@ -109,6 +110,11 @@ class ReplicaHandle:
         self.alive = True
         self.ready = False
         self.headroom = 0
+        # measured /readyz round-trip (the router's only per-replica
+        # latency signal) + the NTP-style clock-offset estimator the
+        # fleet trace merge maps replica timestamps with
+        self.poll_rtt_s: Optional[float] = None
+        self._clock = fleettrace.ClockSync()
         self.slo_ok: Optional[bool] = None
         self.predicted_step_s: Optional[float] = None
         self.failures = 0          # consecutive poll failures
@@ -131,13 +137,24 @@ class ReplicaHandle:
     def poll(self) -> bool:
         """One ``/readyz`` round; returns the ready bit.  A poll that
         cannot reach the replica counts a consecutive failure (the
-        death detector's input) and reads not-ready."""
+        death detector's input) and reads not-ready.  The measured
+        round-trip lands on `paddle_fleet_poll_rtt_seconds{replica}`;
+        when the replica reports its own clock (``now_ns``, served
+        with FLAGS_fleet_trace on) the same handshake feeds the
+        NTP-style offset estimate the trace merge uses."""
+        t0 = _obs.now_ns()
         try:
             doc = _get_json(self.ops_url + "/readyz", self.timeout_s)
         except Exception:
             self.failures += 1
             self.ready = False
             return False
+        t1 = _obs.now_ns()
+        self.poll_rtt_s = (t1 - t0) / 1e9
+        _obs.FLEET_POLL_RTT.set(self.poll_rtt_s, replica=self.name)
+        server_ns = doc.get("now_ns")
+        if server_ns is not None:
+            self._clock.observe(self.name, t0, t1, int(server_ns))
         self.failures = 0
         engines = doc.get("engines") or {}
         crit = engines.get(str(self.info.get("engine_id")))
@@ -155,6 +172,11 @@ class ReplicaHandle:
         self.assigned_since_poll = 0
         return self.ready
 
+    def clock_offset_ns(self) -> int:
+        """Estimated replica-clock minus router-clock (0 until the
+        replica reports ``now_ns`` on a poll)."""
+        return self._clock.offset_ns(self.name)
+
     def admissible(self) -> bool:
         """May the router place NEW work here right now?  The /readyz
         verdict plus the router's own not-yet-polled assignments
@@ -164,16 +186,21 @@ class ReplicaHandle:
             self.headroom - self.assigned_since_poll > 0
 
     def generate(self, prompt_ids, max_new_tokens: int, kwargs: dict,
-                 timeout_s: float = 600.0):
+                 timeout_s: float = 600.0,
+                 trace: Optional[str] = None):
         """Open one streaming generation; returns ``(resp, meta)`` —
-        the live SSE response plus its already-parsed meta event."""
+        the live SSE response plus its already-parsed meta event.
+        ``trace`` (a fleet trace id) rides the ``x-paddle-trace``
+        header; None sends the pre-trace wire format byte for byte."""
         body = {"prompt_ids": list(prompt_ids),
                 "max_new_tokens": int(max_new_tokens), **kwargs}
+        headers = {"Content-Type": "application/json"}
+        if trace is not None:
+            headers[fleettrace.TRACE_HEADER] = str(trace)
         req = urllib.request.Request(
             self.edge_url + "/v1/generate",
             data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST")
+            headers=headers, method="POST")
         resp = urllib.request.urlopen(req, timeout=timeout_s)
         if resp.status != 200:
             raise RuntimeError(
@@ -181,18 +208,27 @@ class ReplicaHandle:
         meta = next(_sse_events(resp))
         return resp, meta
 
-    def adopt(self, journal_dir: str,
-              delivered: Dict[int, int]) -> dict:
-        out = _post_json(self.edge_url + "/v1/adopt",
-                         {"journal_dir": journal_dir,
-                          "delivered": delivered},
+    def adopt(self, journal_dir: str, delivered: Dict[int, int],
+              traces: Optional[Dict[int, str]] = None) -> dict:
+        body = {"journal_dir": journal_dir, "delivered": delivered}
+        if traces:
+            # donor id -> trace id: the adopting edge's fallback for
+            # journals written before FLAGS_fleet_trace was flipped
+            body["traces"] = {str(k): str(v)
+                              for k, v in traces.items()}
+        out = _post_json(self.edge_url + "/v1/adopt", body,
                          timeout_s := max(self.timeout_s, 60.0))
         return out["migrated"]
 
-    def resume(self, donor_id: int, timeout_s: float = 600.0):
-        resp = urllib.request.urlopen(
-            self.edge_url + f"/v1/resume?request={int(donor_id)}",
-            timeout=timeout_s)
+    def resume(self, donor_id: int, timeout_s: float = 600.0,
+               trace: Optional[str] = None):
+        url = self.edge_url + f"/v1/resume?request={int(donor_id)}"
+        if trace is not None:
+            req = urllib.request.Request(
+                url, headers={fleettrace.TRACE_HEADER: str(trace)})
+            resp = urllib.request.urlopen(req, timeout=timeout_s)
+        else:
+            resp = urllib.request.urlopen(url, timeout=timeout_s)
         meta = next(_sse_events(resp))
         return resp, meta
 
@@ -215,11 +251,16 @@ class FleetStream:
     _DONE = object()
 
     def __init__(self, router: "FleetRouter", prompt_ids,
-                 max_new_tokens: int, kwargs: dict):
+                 max_new_tokens: int, kwargs: dict,
+                 trace_id: Optional[str] = None):
         self.router = router
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = int(max_new_tokens)
         self.kwargs = dict(kwargs)
+        # fleet trace id (observability.fleettrace): minted by
+        # FleetRouter.submit while FLAGS_fleet_trace is on; every leg
+        # — including post-failover resume — carries the SAME id
+        self.trace_id = trace_id
         self.tokens: List[int] = []
         self.finish_reason: Optional[str] = None
         self.error: Optional[BaseException] = None
@@ -333,7 +374,8 @@ class FleetStream:
             if self._resume_from is not None:
                 donor_rid, survivor = self._resume_from
                 self._resume_from = None
-                resp, meta = survivor.resume(donor_rid)
+                resp, meta = survivor.resume(donor_rid,
+                                             trace=self.trace_id)
                 self.remote_id = int(meta["request_id"])
                 start = int(meta["start_index"])
                 if start > len(self.tokens):
@@ -343,7 +385,8 @@ class FleetStream:
                         f"resumes at {start}")
             else:
                 resp, meta = replica.generate(
-                    self.prompt_ids, self.max_new_tokens, self.kwargs)
+                    self.prompt_ids, self.max_new_tokens, self.kwargs,
+                    trace=self.trace_id)
                 self.remote_id = int(meta["request_id"])
             self.router._stream_attached(self, replica)
         except (OSError, urllib.error.URLError):
@@ -470,12 +513,16 @@ class FleetRouter:
                **request_kwargs) -> FleetStream:
         """Route one request and stream its tokens (returns
         immediately; the `FleetStream`'s reader thread does the
-        work)."""
+        work).  With FLAGS_fleet_trace on, mints the request's fleet
+        trace id here — the one identity that survives every HTTP hop
+        and failover."""
         self.start()
         with self._lock:
             self.stats["submitted"] += 1
+        trace_id = fleettrace.mint_trace_id() if fleettrace.enabled() \
+            else None
         return FleetStream(self, prompt_ids, max_new_tokens,
-                           request_kwargs)
+                           request_kwargs, trace_id=trace_id)
 
     # -- routing -------------------------------------------------------------
     def _route_key(self, prompt_ids) -> List[str]:
@@ -499,6 +546,7 @@ class FleetRouter:
         while no replica is admissible (bounded by
         ``admit_timeout_s``)."""
         hashes = self._route_key(stream.prompt_ids)
+        t0_ns = _obs.now_ns() if fleettrace.enabled() else 0
         deadline = time.perf_counter() + self.admit_timeout_s
         with self._cond:
             while True:
@@ -518,6 +566,19 @@ class FleetRouter:
                     (_obs.FLEET_AFFINITY_HITS if hit else
                      _obs.FLEET_AFFINITY_MISSES).inc(
                         replica=chosen.name)
+                    if fleettrace.enabled():
+                        # the routing decision as a span: which
+                        # replica, why (affinity vs load), and how
+                        # long admission blocked
+                        args = {"replica": chosen.name,
+                                "affinity_hit": bool(hit),
+                                "prefix_hashes": len(hashes),
+                                "headroom": int(chosen.headroom)}
+                        if stream.trace_id is not None:
+                            args["trace"] = stream.trace_id
+                        _obs.record_span(
+                            "router", "route", t0_ns,
+                            _obs.now_ns() - t0_ns, args=args)
                     return chosen
                 if time.perf_counter() >= deadline:
                     raise RuntimeError(
@@ -602,15 +663,23 @@ class FleetRouter:
                 return
             dead.failed_over = True
         t0 = time.perf_counter()
+        t0_ns = _obs.now_ns() if fleettrace.enabled() else 0
         with self._lock:
             dead.alive = False
             dead.ready = False
             inflight = list(self._inflight.get(dead.name, ()))
         delivered = {s.remote_id: len(s.tokens) for s in inflight
                      if s.remote_id is not None}
+        traces = {s.remote_id: s.trace_id for s in inflight
+                  if s.remote_id is not None and
+                  s.trace_id is not None}
         self._events.append({
             "event": "replica_dead", "replica": dead.name,
             "inflight": len(inflight)})
+        if fleettrace.enabled():
+            _obs.record_span("router", "replica_dead", t0_ns, 0,
+                             args={"replica": dead.name,
+                                   "inflight": len(inflight)})
         survivor = None
         deadline = time.perf_counter() + self.admit_timeout_s
         migrated: dict = {}
@@ -621,8 +690,18 @@ class FleetRouter:
             if cands:
                 survivor = max(cands, key=lambda r: r.headroom)
                 try:
+                    t_adopt = _obs.now_ns() if fleettrace.enabled() \
+                        else 0
                     migrated = survivor.adopt(dead.journal_dir,
-                                              delivered)
+                                              delivered,
+                                              traces=traces or None)
+                    if fleettrace.enabled():
+                        _obs.record_span(
+                            "router", "adopt", t_adopt,
+                            _obs.now_ns() - t_adopt,
+                            args={"donor": dead.name,
+                                  "survivor": survivor.name,
+                                  "migrated": len(migrated)})
                     break
                 except Exception as e:
                     self._events.append({
@@ -648,6 +727,11 @@ class FleetRouter:
             self.stats["failover_seconds"] = dt
         _obs.FLEET_FAILOVERS.inc()
         _obs.FLEET_FAILOVER_SECONDS.set(dt)
+        if fleettrace.enabled():
+            _obs.record_span(
+                "router", "failover", t0_ns, _obs.now_ns() - t0_ns,
+                args={"replica": dead.name, "survivor": survivor.name,
+                      "migrated": len(migrated)})
         self._events.append({
             "event": "failover", "replica": dead.name,
             "survivor": survivor.name, "migrated": len(migrated),
@@ -716,3 +800,82 @@ class FleetRouter:
                 return dict(self._rollup)
             return {"replicas": {}, "reachable": 0, "firing": {},
                     "paging": False, "pending": True}
+
+    # -- fleet rollup (/fleetz) ----------------------------------------------
+    @staticmethod
+    def _fetch_text(url: str, timeout: float) -> Optional[str]:
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return resp.read().decode("utf-8", "replace")
+        except Exception:
+            return None
+
+    def fleetz(self, trace: Optional[str] = None) -> dict:
+        """The fleet-wide rollup surface (served at ``/fleetz``): one
+        document aggregating every replica's ``/metrics`` (Prometheus
+        text), ``/alertz`` and ``/statusz``, the poll-measured RTT and
+        NTP-style clock-offset estimates, the router's failover event
+        log, and — the point of the exercise — ONE merged chrome trace
+        (`observability.fleettrace.merge_fleet_trace`): each replica's
+        span buffer is pulled over its edge's ``/tracez/spans``,
+        shifted onto the router's clock, and folded together with the
+        router's own routing/failover spans, so a killed-and-adopted
+        request renders as a single contiguous lane under its trace
+        id.  ``trace`` narrows the span pull to one trace id.
+
+        Synchronous by design (unlike `alertz_rollup`): ``/fleetz`` is
+        served by the ROUTER process's ops plane but fetches REPLICA
+        endpoints, so there is no handler recursion to avoid."""
+        with self._lock:
+            reps = list(self._replicas.items())
+            events = list(self._events)
+            stats = dict(self.stats)
+        replicas: Dict[str, dict] = {}
+        spans: Dict[str, list] = {}
+        offsets: Dict[str, int] = {}
+        q = "?trace=" + urllib.parse.quote(trace) if trace else ""
+        for name, rep in reps:
+            entry = {
+                "alive": rep.alive, "ready": rep.ready,
+                "headroom": int(rep.headroom),
+                "poll_rtt_s": rep.poll_rtt_s,
+                "clock_offset_ns": rep.clock_offset_ns(),
+            }
+            if rep.alive and rep.ops_url:
+                entry["metrics"] = self._fetch_text(
+                    rep.ops_url + "/metrics", rep.timeout_s)
+                try:
+                    entry["alertz"] = _get_json(
+                        rep.ops_url + "/alertz", rep.timeout_s)
+                except Exception:
+                    entry["alertz"] = None
+                try:
+                    entry["statusz"] = _get_json(
+                        rep.ops_url + "/statusz", rep.timeout_s)
+                except Exception:
+                    entry["statusz"] = None
+            if rep.alive:
+                try:
+                    doc = _get_json(
+                        rep.edge_url + "/tracez/spans" + q,
+                        rep.timeout_s)
+                    spans[name] = doc.get("spans") or []
+                    offsets[name] = rep.clock_offset_ns()
+                except Exception:
+                    pass
+            replicas[name] = entry
+        # the router's own span buffer joins the merge at offset 0 —
+        # routing and failover spans share the fleet timeline
+        from ..observability import tracing
+
+        local = fleettrace.span_slice(tracing.spans(), trace=trace)
+        if local:
+            spans["router"] = local
+            offsets["router"] = 0
+        return {
+            "replicas": replicas,
+            "events": events,
+            "stats": stats,
+            "alerts": self.alertz_rollup(),
+            "trace": fleettrace.merge_fleet_trace(spans, offsets),
+        }

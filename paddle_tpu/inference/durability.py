@@ -153,6 +153,10 @@ class RequestWire:
     deadline_ms: Optional[float] = None
     slo_ttft_ms: Optional[float] = None
     slo_tpot_ms: Optional[float] = None
+    # fleet-scope trace id (observability.fleettrace): persisted so a
+    # failover adoption keeps the donor's trace — the one piece of
+    # request identity that must survive the process boundary
+    trace: Optional[str] = None
 
     @classmethod
     def from_request(cls, req) -> "RequestWire":
@@ -165,7 +169,8 @@ class RequestWire:
             streamed=len(gen) + req._emit_gate,
             eos=req.eos_token_id, priority=req.priority,
             deadline_ms=req.deadline_ms, slo_ttft_ms=req.slo_ttft_ms,
-            slo_tpot_ms=req.slo_tpot_ms)
+            slo_tpot_ms=req.slo_tpot_ms,
+            trace=getattr(req, "trace_id", None))
 
     @classmethod
     def from_record(cls, rec) -> "RequestWire":
@@ -181,14 +186,19 @@ class RequestWire:
             streamed=rec.streamed,
             eos=req.eos_token_id, priority=req.priority,
             deadline_ms=req.deadline_ms, slo_ttft_ms=req.slo_ttft_ms,
-            slo_tpot_ms=req.slo_tpot_ms)
+            slo_tpot_ms=req.slo_tpot_ms,
+            trace=getattr(req, "trace_id", None))
 
     def to_obj(self) -> dict:
-        return {"id": self.request_id, "p": self.prompt,
-                "g": self.generated, "mn": self.max_new,
-                "sm": self.streamed, "eos": self.eos,
-                "pr": self.priority, "dl": self.deadline_ms,
-                "tt": self.slo_ttft_ms, "tp": self.slo_tpot_ms}
+        obj = {"id": self.request_id, "p": self.prompt,
+               "g": self.generated, "mn": self.max_new,
+               "sm": self.streamed, "eos": self.eos,
+               "pr": self.priority, "dl": self.deadline_ms,
+               "tt": self.slo_ttft_ms, "tp": self.slo_tpot_ms}
+        if self.trace is not None:
+            # conditional so pre-fleet-trace journals stay byte-stable
+            obj["tr"] = self.trace
+        return obj
 
     @classmethod
     def from_obj(cls, obj: dict) -> "RequestWire":
@@ -196,7 +206,8 @@ class RequestWire:
                    generated=list(obj["g"]), max_new=int(obj["mn"]),
                    streamed=int(obj["sm"]), eos=obj.get("eos"),
                    priority=obj.get("pr"), deadline_ms=obj.get("dl"),
-                   slo_ttft_ms=obj.get("tt"), slo_tpot_ms=obj.get("tp"))
+                   slo_ttft_ms=obj.get("tt"), slo_tpot_ms=obj.get("tp"),
+                   trace=obj.get("tr"))
 
     def materialize(self):
         """A fresh `Request` carrying this wire state, re-admittable
@@ -215,6 +226,8 @@ class RequestWire:
         req._absorbed = len(self.generated)
         req._emit_gate = max(0, self.streamed - len(self.generated))
         req.request_id = self.request_id
+        if self.trace is not None:
+            req.trace_id = self.trace
         return req
 
 
@@ -442,12 +455,18 @@ class DurabilityManager:
         # would double-count the generated tokens the emitted-token
         # watermark already covers
         eos = req.eos_token_id
-        self.append({"t": "a", "id": req.request_id,
-                     "p": list(req.prompt_ids[:req.orig_prompt_len]),
-                     "mn": int(req.max_new_tokens + req._absorbed),
-                     "eos": None if eos is None else int(eos),
-                     "pr": req.priority, "dl": req.deadline_ms,
-                     "tt": req.slo_ttft_ms, "tp": req.slo_tpot_ms})
+        rec = {"t": "a", "id": req.request_id,
+               "p": list(req.prompt_ids[:req.orig_prompt_len]),
+               "mn": int(req.max_new_tokens + req._absorbed),
+               "eos": None if eos is None else int(eos),
+               "pr": req.priority, "dl": req.deadline_ms,
+               "tt": req.slo_ttft_ms, "tp": req.slo_tpot_ms}
+        if getattr(req, "trace_id", None) is not None:
+            # fleet trace id rides the admission record (conditional:
+            # trace-less journals stay byte-identical) so an adopting
+            # engine can stitch donor + adopter spans into one trace
+            rec["tr"] = req.trace_id
+        self.append(rec)
 
     def on_emit(self, req):
         # streamed watermark = generated + still-gated (a gated token
@@ -704,7 +723,7 @@ def _journal_state(journal_dir: str):
                 generated=[], max_new=int(ev["mn"]), streamed=0,
                 eos=ev.get("eos"), priority=ev.get("pr"),
                 deadline_ms=ev.get("dl"), slo_ttft_ms=ev.get("tt"),
-                slo_tpot_ms=ev.get("tp")))
+                slo_tpot_ms=ev.get("tp"), trace=ev.get("tr")))
         elif t == "e":
             w = state.get(int(ev["id"]))
             if w is not None:
@@ -742,11 +761,13 @@ def _compact_resolved(journal_dir: str, cfg_rec, snap, state,
     cfg["nid"] = _next_id_floor(cfg_rec, state, finished)
     frames = [_frame(cfg)]
     for w in state.values():
-        frames.append(_frame({
-            "t": "a", "id": w.request_id, "p": list(w.prompt),
-            "mn": int(w.max_new), "eos": w.eos, "pr": w.priority,
-            "dl": w.deadline_ms, "tt": w.slo_ttft_ms,
-            "tp": w.slo_tpot_ms}))
+        adm = {"t": "a", "id": w.request_id, "p": list(w.prompt),
+               "mn": int(w.max_new), "eos": w.eos, "pr": w.priority,
+               "dl": w.deadline_ms, "tt": w.slo_ttft_ms,
+               "tp": w.slo_tpot_ms}
+        if w.trace is not None:
+            adm["tr"] = w.trace
+        frames.append(_frame(adm))
         if w.streamed:
             frames.append(_frame({"t": "e", "id": w.request_id,
                                   "n": int(w.streamed)}))
@@ -916,7 +937,8 @@ def restore_from_dir(journal_dir: str, model, scheduler=None,
 
 def adopt_from_dir(journal_dir: str, engine,
                    delivered: Optional[Dict[int, int]] = None,
-                   on_token_factory=None):
+                   on_token_factory=None,
+                   traces: Optional[Dict[int, str]] = None):
     """Fleet failover: replay a DEAD sibling replica's journal into a
     LIVE survivor ``engine`` (contrast `restore_from_dir`, which
     builds a fresh engine around the journal).  Every in-flight
@@ -936,11 +958,15 @@ def adopt_from_dir(journal_dir: str, engine,
     watermark, the lossless-but-maybe-duplicating default.
 
     ``on_token_factory(donor_id)`` (optional) returns the ``on_token``
-    hook to attach per adopted request.  Returns ``(requests, meta)``
-    keyed by DONOR ids: ``requests`` the materialized `Request`s (the
-    survivor's fresh ids are on them), ``meta`` per-request
-    ``{"request_id", "start_index", "backfill", "done"}`` — the
-    resume contract the fleet edge serves to reconnecting streams."""
+    hook to attach per adopted request.  ``traces`` (optional) maps
+    donor ids to fleet trace ids — a fallback for journals written
+    before FLAGS_fleet_trace was on; the journal's own ``tr`` record
+    wins when present.  Returns ``(requests, meta)`` keyed by DONOR
+    ids: ``requests`` the materialized `Request`s (the survivor's
+    fresh ids are on them), ``meta`` per-request ``{"request_id",
+    "start_index", "backfill", "done"}`` (plus ``"trace"`` when the
+    request carries one) — the resume contract the fleet edge serves
+    to reconnecting streams."""
     from .serving import _stats_add
 
     cfg_rec, snap, state, finished, events = _journal_state(journal_dir)
@@ -961,6 +987,12 @@ def adopt_from_dir(journal_dir: str, engine,
         # point need no recompute: hand them straight back
         backfill = [int(t) for t in w.generated[d:]]
         req = w.materialize()
+        if req.trace_id is None and traces and rid in traces:
+            # router-supplied fallback (observability.fleettrace): a
+            # journal written before FLAGS_fleet_trace was flipped has
+            # no "tr" record, but the router still knows the stream's
+            # trace id — the adoption keeps it either way
+            req.trace_id = str(traces[rid])
         # the router's delivered count supersedes the journal
         # watermark: gate exactly what the consumer saw
         req._emit_gate = max(0, d - len(w.generated))
@@ -983,6 +1015,8 @@ def adopt_from_dir(journal_dir: str, engine,
         meta[rid] = {"request_id": int(req.request_id),
                      "start_index": int(d), "backfill": backfill,
                      "done": bool(done)}
+        if req.trace_id is not None:
+            meta[rid]["trace"] = req.trace_id
     _stats_add(adoptions=1)
     _obs.record_span(
         "engine", "adopt", t0, _obs.now_ns() - t0,

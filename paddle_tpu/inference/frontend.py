@@ -569,7 +569,8 @@ class ServingFrontend:
         return await fut
 
     async def adopt(self, journal_dir: str,
-                    delivered: Optional[Dict[int, int]] = None) -> dict:
+                    delivered: Optional[Dict[int, int]] = None,
+                    traces: Optional[Dict[int, str]] = None) -> dict:
         """Fleet failover entry: replay a dead sibling replica's
         journal (`durability.adopt_from_dir`) into THIS frontend's
         engine, between steps on the driver like any other mutation,
@@ -579,13 +580,16 @@ class ServingFrontend:
         already holds>, "backfill": [snapshot-known undelivered
         tokens], "done": bool}`` — the edge relays backfill first,
         then drains the stream, and the reconnected consumer sees
-        token-for-token continuity."""
+        token-for-token continuity.  ``traces`` (optional) maps donor
+        ids to fleet trace ids, the `durability.adopt_from_dir`
+        fallback for trace-less journals."""
         if self._closing or self._closed:
             raise RuntimeError("frontend is closing; no new requests")
         await self.start()
         self._check_driver()
         fut = self._loop.create_future()
-        self._control.append(("adopt", (journal_dir, delivered), fut))
+        self._control.append(
+            ("adopt", (journal_dir, delivered, traces), fut))
         self._kick()
         return await fut
 
@@ -692,7 +696,7 @@ class ServingFrontend:
                     self._streams[req] = stream
                     fut.set_result(stream)
                 elif action == "adopt":
-                    journal_dir, delivered = payload
+                    journal_dir, delivered, traces = payload
                     from . import durability
 
                     boxes: dict = {}
@@ -713,7 +717,7 @@ class ServingFrontend:
                     # boxes below are filled
                     reqs, meta = durability.adopt_from_dir(
                         journal_dir, self.engine, delivered=delivered,
-                        on_token_factory=factory)
+                        on_token_factory=factory, traces=traces)
                     out = {}
                     for rid, req in reqs.items():
                         stream = TokenStream(self, req)

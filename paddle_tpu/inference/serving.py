@@ -643,6 +643,12 @@ class Request:
         # preempt/resume.
         self._reg_pages = 0
         self.request_id = next(Request._next_id)
+        # fleet-scope trace id (observability.fleettrace): minted by
+        # the router, carried on every HTTP leg, preserved across
+        # failover by the durability journal.  None unless
+        # FLAGS_fleet_trace propagated one — span args and flight
+        # records tag themselves with it only when set.
+        self.trace_id: Optional[str] = None
         self.t_enqueue_ns: Optional[int] = None
         self.t_admit_ns: Optional[int] = None
         self.t_first_token_ns: Optional[int] = None
@@ -731,6 +737,19 @@ class Request:
             self._engine._cancel_queued(self)
         else:
             self._engine._cancel_running(self)
+
+
+def _req_span_args(req: "Request", **extra) -> dict:
+    """Span args for a request-carrying span: always the engine
+    request id, plus the fleet trace id when one propagated
+    (observability.fleettrace) — the key `/tracez/spans` and the
+    fleet merge filter on.  No trace id -> byte-identical args to the
+    pre-fleet-trace layout."""
+    args = {"request": req.request_id}
+    if req.trace_id is not None:
+        args["trace"] = req.trace_id
+    args.update(extra)
+    return args
 
 
 # ---------------------------------------------------------------------------
@@ -2180,7 +2199,7 @@ class DecodeEngine:
     def add_request(self, prompt_ids, max_new_tokens=32,
                     eos_token_id=..., priority=None, deadline_ms=None,
                     slo_ttft_ms=None, slo_tpot_ms=None,
-                    on_token=None) -> Request:
+                    on_token=None, trace_id=None) -> Request:
         # sentinel default: eos_token_id=None is a real per-request
         # opt-out of the engine-level eos, not "use the default"
         req = Request(prompt_ids, max_new_tokens,
@@ -2188,6 +2207,8 @@ class DecodeEngine:
                       priority=priority, deadline_ms=deadline_ms,
                       slo_ttft_ms=slo_ttft_ms, slo_tpot_ms=slo_tpot_ms,
                       on_token=on_token)
+        if trace_id is not None:
+            req.trace_id = str(trace_id)
         if not req.prompt_ids:
             raise ValueError("empty prompt")
         if req.max_new_tokens < 1:
@@ -2527,7 +2548,7 @@ class DecodeEngine:
             _obs.record_span("requests", "queued", req.t_enqueue_ns,
                              req.t_admit_ns - req.t_enqueue_ns,
                              tid=req.request_id,
-                             args={"request": req.request_id})
+                             args=_req_span_args(req))
 
     def _alloc_prompt_pages(self, req: Request, slot: int,
                             total_pages: int, hit_pages=()):
@@ -2682,8 +2703,8 @@ class DecodeEngine:
         _obs.record_span("engine", "prefill", t0_ns,
                          _obs.now_ns() - t0_ns,
                          tid=self._engine_id,
-                         args={"request": req.request_id,
-                               "bucket": bucket, "slot": slot})
+                         args=_req_span_args(req, bucket=bucket,
+                                             slot=slot))
 
         req.state = "running"
         req.slot = slot
@@ -2786,8 +2807,7 @@ class DecodeEngine:
             _obs.record_span("requests", "prefill", req.t_admit_ns,
                              req.t_first_token_ns - req.t_admit_ns,
                              tid=req.request_id,
-                             args={"request": req.request_id,
-                                   **span_args})
+                             args=_req_span_args(req, **span_args))
 
     def _register_prompt_pages(self, req: Request):
         """Prefill complete: content-address every freshly computed
@@ -2879,8 +2899,8 @@ class DecodeEngine:
                 "requests", "decode", req.t_first_token_ns,
                 req.t_finish_ns - req.t_first_token_ns,
                 tid=req.request_id,
-                args={"request": req.request_id, "tokens": n_out,
-                      "finish_reason": reason})
+                args=_req_span_args(req, tokens=n_out,
+                                    finish_reason=reason))
         if reason in ("eos", "length") and req._deadline_ns is not None \
                 and req.t_finish_ns > req._deadline_ns:
             # it ran to completion, but past its deadline: a violation,
@@ -2987,8 +3007,7 @@ class DecodeEngine:
             _obs.record_span("requests", "preempted", req.t_admit_ns,
                              _obs.now_ns() - req.t_admit_ns,
                              tid=req.request_id,
-                             args={"request": req.request_id,
-                                   "generated": n_gen})
+                             args=_req_span_args(req, generated=n_gen))
 
     def _cancel_running(self, req: Request):
         if req.state != "running" or req.slot is None or \
@@ -3026,8 +3045,8 @@ class DecodeEngine:
             _obs.record_span("requests", "queued", req.t_enqueue_ns,
                              req.t_finish_ns - req.t_enqueue_ns,
                              tid=req.request_id,
-                             args={"request": req.request_id,
-                                   "finish_reason": reason})
+                             args=_req_span_args(req,
+                                                 finish_reason=reason))
         if self._flight is not None:
             self._flight.note_finish(req)
 
@@ -3405,8 +3424,7 @@ class DecodeEngine:
             req.fault_info.recovered = False
         _obs.record_span("engine", "quarantine", _obs.now_ns(), 0,
                          tid=self._engine_id,
-                         args={"request": req.request_id, "slot": slot,
-                               "site": site})
+                         args=_req_span_args(req, slot=slot, site=site))
         if self._flight is not None:
             self._flight.event("quarantine", request=req.request_id,
                                slot=slot, site=site)

@@ -528,6 +528,15 @@ FLEET_FAILOVER_SECONDS = gauge(
     "through journal adoption on the survivor (streams reconnect "
     "immediately after) — the fleet-wide TTFT-spike bound "
     "tools/bench_fleet.py pins rides on this")
+FLEET_POLL_RTT = gauge(
+    "paddle_fleet_poll_rtt_seconds",
+    "Measured HTTP round-trip of the fleet router's most recent "
+    "/readyz poll, per replica (fleet.ReplicaHandle.poll) — the "
+    "router's only per-replica latency signal, and the error bound "
+    "(rtt/2) on the NTP-style clock-offset estimate the fleet trace "
+    "merge maps replica timestamps with "
+    "(observability.fleettrace.ClockSync)",
+    labels=("replica",))
 
 
 # ---------------------------------------------------------------------------

@@ -238,6 +238,29 @@ def _sig_journal_bytes(eng) -> Optional[float]:
         return None
 
 
+# per-evaluator last-seen dropped-span count, keyed by engine id —
+# engine-thread-private by the evaluation contract (each engine's
+# alert engine runs on that engine's own thread between steps, and no
+# two engines share an id), the _RuleHist lock-free pattern
+_trace_drop_seen: Dict[int, float] = {}
+
+
+def _sig_trace_span_drop_delta(eng) -> Optional[float]:
+    """Growth of `tracing.dropped_span_count()` since THIS engine's
+    previous evaluation.  Overflow is process-wide, but the delta is
+    tracked per evaluator so co-resident engines don't consume each
+    other's evidence.  First look (or a post-`clear_spans` reset,
+    which makes the count fall) returns no-breach."""
+    from . import tracing
+
+    cur = float(tracing.dropped_span_count())
+    prev = _trace_drop_seen.get(eng._engine_id)
+    _trace_drop_seen[eng._engine_id] = cur
+    if prev is None:
+        return None  # no baseline yet: no evidence either way
+    return max(cur - prev, 0.0)
+
+
 SIGNALS = {
     "slo_burn": _sig_slo_burn,
     "engine_hung": _sig_engine_hung,
@@ -247,6 +270,7 @@ SIGNALS = {
     "cost_error_max": _sig_cost_error_max,
     "mfu_drift_max": _sig_mfu_drift_max,
     "journal_bytes": _sig_journal_bytes,
+    "trace_span_drop_delta": _sig_trace_span_drop_delta,
 }
 
 
@@ -322,6 +346,17 @@ def default_rules(window_scale: float = 1.0) -> Tuple[AlertRule, ...]:
                         "replay the whole journal; compact it "
                         "(rewrite on restore) before it dominates "
                         "recovery time"),
+        AlertRule(
+            "trace_span_drops", signal="trace_span_drop_delta",
+            severity="ticket", threshold=0.0, op=">",
+            resolve_after_s=30.0 * s,
+            description="paddle_trace_spans_dropped_total grew since "
+                        "the previous evaluation: the span buffer is "
+                        "at MAX_SPANS and new spans are counted, not "
+                        "stored — export and clear the trace.  Ticket "
+                        "severity BY DESIGN (page-exempt): a full "
+                        "trace buffer must never flip /readyz and "
+                        "drain a healthy replica"),
     )
 
 

@@ -206,7 +206,7 @@ class FlightRecorder:
                 continue
             p_len = len(req.prompt_ids)
             pos = int(eng._prefill_pos[s])
-            slots.append({
+            rec = {
                 "slot": s,
                 "request": req.request_id,
                 "phase": "prefill" if pos < p_len else "decode",
@@ -214,7 +214,12 @@ class FlightRecorder:
                 "prompt_len": p_len,
                 "prefill_pos": pos,
                 "out": len(req.output_ids) + req._absorbed,
-            })
+            }
+            if getattr(req, "trace_id", None) is not None:
+                # fleet trace id (observability.fleettrace): the key
+                # explain_request joins donor+adopter flight dumps on
+                rec["trace"] = req.trace_id
+            slots.append(rec)
         cur = self._cur  # open record: engine-thread-private, no lock
         if cur is None:
             return

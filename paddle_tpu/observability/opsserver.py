@@ -38,6 +38,11 @@ endpoint   serves
 /tracez    the merged chrome trace (`merged_chrome_trace`), bounded —
            ``?n=<events>`` caps the non-metadata events (newest kept;
            default 20000) — plus the dropped-span count
+/fleetz    the fleet-wide rollup (`fleet.FleetRouter.fleetz`): every
+           replica's /metrics + /alertz + /statusz, poll RTT and
+           clock-offset estimates, and the cross-replica merged chrome
+           trace (``?trace=<id>`` narrows the span pull); 404 unless a
+           FleetRouter is registered in this process
 ========== ==============================================================
 
 The server is a stdlib `ThreadingHTTPServer` on a daemon thread,
@@ -233,13 +238,22 @@ def engine_ready(engine) -> dict:
 
 def readiness() -> dict:
     """Fleet-level readiness: per-engine verdicts + the any-ready
-    bit `/readyz` statuses on."""
+    bit `/readyz` statuses on.  With FLAGS_fleet_trace armed the
+    verdict also reports this process's span clock (``now_ns``) — the
+    router brackets its poll around it for the NTP-style clock-offset
+    estimate (observability.fleettrace.ClockSync); flag off keeps the
+    payload byte-identical to the pre-trace contract."""
     engines = live_engines()
     per = {str(e._engine_id): engine_ready(e) for e in engines}
-    return {
+    doc = {
         "ready": any(c["ready"] for c in per.values()),
         "engines": per,
     }
+    from . import fleettrace, tracing
+
+    if fleettrace.enabled():
+        doc["now_ns"] = int(tracing.now_ns())
+    return doc
 
 
 def _liveness() -> dict:
@@ -455,6 +469,21 @@ class _OpsHandler(BaseHTTPRequestHandler):
             # firing anywhere, and the router's failover narration
             doc["fleet"] = router.alertz_rollup()
         self._send_json(doc)
+
+    def _route_fleetz(self, query):
+        # the fleet-wide rollup (fleet.FleetRouter.fleetz): replica
+        # metrics/alertz/statusz + the cross-replica merged chrome
+        # trace.  Synchronous replica fetches are safe here — this
+        # handler runs in the ROUTER's process and calls out to
+        # REPLICA ops planes, never back into itself.
+        router = _fleet_router()
+        if router is None:
+            self._send_json(
+                {"error": "no fleet router registered in this "
+                          "process"}, code=404)
+            return
+        trace = query.get("trace", [None])[0]
+        self._send_json(router.fleetz(trace=trace))
 
 
 # ---------------------------------------------------------------------------

@@ -19,7 +19,8 @@ import pytest
 
 import paddle_tpu
 from paddle_tpu.analysis import (
-    DonationPass, EngineMutationPass, EngineRule, LockRule,
+    DonationPass, EngineMutationPass, EngineRule, FleetTracePass,
+    FleetTraceRule, LockRule,
     LockDisciplinePass, TraceHazardPass, load_baseline, run_passes,
     run_tracecheck, sanitizer, scan_paths, split_baselined,
     write_baseline,
@@ -863,6 +864,108 @@ class TestDonationLint:
         found = DonationPass().run(mods)
         assert len(found) == 1
         assert "`v_pages` (argnum 1)" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# fleet-trace lint
+# ---------------------------------------------------------------------------
+# fixture rule: every file is "fleet plane" so tmp-path snippets scan
+_ANY_FLEET = FleetTraceRule(path_markers=("",))
+
+
+class TestFleetTraceLint:
+    def test_client_leg_without_trace_flags(self, tmp_path):
+        mods = _scan_snippet(tmp_path, """
+            import urllib.request
+
+            def fetch_result(url, timeout):
+                with urllib.request.urlopen(url, timeout=timeout) as r:
+                    return r.read()
+        """)
+        found = FleetTracePass(_ANY_FLEET).run(mods)
+        assert len(found) == 1
+        assert found[0].pass_id == "fleet-trace"
+        assert "HTTP client leg `fetch_result`" in found[0].message
+
+    def test_handler_without_trace_flags(self, tmp_path):
+        mods = _scan_snippet(tmp_path, """
+            class Handler:
+                def do_GET(self):
+                    self._send_json({"ok": True})
+
+                def _send_json(self, doc):
+                    pass
+        """)
+        found = FleetTracePass(_ANY_FLEET).run(mods)
+        assert len(found) == 1
+        assert "HTTP handler `Handler.do_GET`" in found[0].message
+
+    def test_propagating_sites_are_clean(self, tmp_path):
+        """Direct TRACE_HEADER use, the literal header string, and a
+        handler whose helper reads the header (the call-closure walk)
+        all count as carrying the trace."""
+        mods = _scan_snippet(tmp_path, """
+            import urllib.request
+            from paddle_tpu.observability import fleettrace
+
+            def generate(url, trace):
+                req = urllib.request.Request(
+                    url, headers={fleettrace.TRACE_HEADER: trace})
+                return urllib.request.urlopen(req)
+
+            def resume(url, trace):
+                req = urllib.request.Request(
+                    url, headers={"x-paddle-trace": trace})
+                return urllib.request.urlopen(req)
+
+            class Handler:
+                def do_POST(self):
+                    self._generate(self._trace_in())
+
+                def _trace_in(self):
+                    return self.headers.get(fleettrace.TRACE_HEADER)
+
+                def _generate(self, trace):
+                    pass
+        """)
+        found = FleetTracePass(_ANY_FLEET).run(mods)
+        assert found == [], [f.render() for f in found]
+
+    def test_allowlist_is_exact_qualname(self, tmp_path):
+        mods = _scan_snippet(tmp_path, """
+            import urllib.request
+
+            def _get_json(url, timeout):
+                with urllib.request.urlopen(url, timeout=timeout) as r:
+                    return r.read()
+
+            class ReplicaHandle:
+                def poll(self):
+                    return urllib.request.urlopen(self.url)
+
+            class Other:
+                def poll(self):
+                    return urllib.request.urlopen(self.url)
+        """)
+        rule = FleetTraceRule(path_markers=("",),
+                              allowlist=("_get_json",
+                                         "ReplicaHandle.poll"))
+        found = FleetTracePass(rule).run(mods)
+        assert len(found) == 1, [f.render() for f in found]
+        assert "`Other.poll`" in found[0].message
+
+    def test_scope_is_fleet_only(self, tmp_path):
+        """The default rule only scans the fleet plane: the same bad
+        client leg in a non-fleet module is out of scope."""
+        src = """
+            import urllib.request
+
+            def fetch_result(url):
+                return urllib.request.urlopen(url)
+        """
+        mods = _scan_snippet(tmp_path, src)  # relpath: fixture_mod.py
+        assert FleetTracePass(FleetTraceRule()).run(mods) == []
+        assert len(FleetTracePass(_ANY_FLEET).run(mods)) == 1
 
 
 # ---------------------------------------------------------------------------

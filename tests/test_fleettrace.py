@@ -1,0 +1,451 @@
+"""Fleet-scope distributed tracing (FLAGS_fleet_trace, ISSUE 19).
+
+Contracts pinned here:
+
+* **off is off** — with the flag at its default, `FleetRouter.submit`
+  mints nothing, the edge never reads trace headers (a stray
+  ``x-paddle-trace`` on the wire is ignored), request span args carry
+  no ``trace`` key, no ``router``/``edge`` track spans exist, and the
+  write-ahead journal is byte-free of ``"tr"`` — bit-exact with
+  pre-trace serving;
+* **propagation** — flag on, an ``x-paddle-trace`` header on
+  ``POST /v1/generate`` reaches `Request.trace_id`, tags every
+  requests-track span and flight-recorder slot, persists as the
+  journal's ``"tr"`` key, and ``GET /tracez/spans?trace=`` slices it
+  back out;
+* **failover continuity** — a journaling engine dies mid-generation;
+  ``/v1/adopt`` + ``/v1/resume`` finish the stream on a survivor
+  whose engine spans and flight slots carry the ORIGINAL trace id
+  (fresh request id, same trace), both flight dumps join into one
+  story (`tools.explain_request.explain_trace`), and the merged
+  chrome trace renders the request as exactly ONE requests-track
+  lane;
+* **clock sync** — `ClockSync` keeps the minimum-RTT NTP-midpoint
+  offset estimate per replica;
+* **fleet rollup** — a live two-replica fleet with the flag on mints
+  a trace per submit, records router ``route`` spans, measures poll
+  RTT (`paddle_fleet_poll_rtt_seconds`), and serves `/fleetz` with
+  replica cards + the merged trace;
+* **span-buffer pressure** — the ``trace_span_drops`` alert signal
+  fires on dropped-span growth between evaluations, at ticket
+  severity (page-exempt by design).
+"""
+import gc
+import json
+import os
+import sys
+import time
+import types
+import urllib.request
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.fleet import EdgeServer, FleetRouter
+from paddle_tpu.fleet.router import _sse_events
+from paddle_tpu.inference.serving import DecodeEngine, reset_decode_stats
+from paddle_tpu.observability import alerts, fleettrace, opsserver, tracing
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+import explain_request  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    gc.collect()
+    reset_decode_stats()
+    obs.reset()
+    obs.clear_spans()
+    yield
+    obs.stop_ops_server()
+    paddle.set_flags({"fleet_trace": False})
+    reset_decode_stats()
+    obs.reset()
+    obs.clear_spans()
+
+
+@pytest.fixture
+def trace_on():
+    paddle.set_flags({"fleet_trace": True})
+    yield
+    paddle.set_flags({"fleet_trace": False})
+
+
+TINY = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                 num_heads=4, max_seq_len=256,
+                 use_parallel_layers=False, dropout=0.0)
+
+P1 = [1, 2, 3, 4, 5, 1, 2, 3, 4, 5, 1, 2]
+P2 = [7, 8, 9, 7, 8, 9, 7, 8]
+NEW = 12
+
+
+def _tiny_gpt(seed=0):
+    paddle.seed(seed)
+    m = GPT(TINY)
+    m.eval()
+    return m
+
+
+def _engine(m, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prefix_cache", True)
+    return DecodeEngine(m, **kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_gpt()
+
+
+def _post(url, body, headers=None, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _drain_sse(resp):
+    ev = _sse_events(resp)
+    meta = next(ev)
+    toks, done = [], None
+    for e in ev:
+        if e.get("done"):
+            done = e
+            break
+        toks.append(int(e["t"]))
+    return meta, toks, done
+
+
+def _wait_for(pred, timeout_s=10.0):
+    """Poll until pred() is truthy (server-side spans record when the
+    handler exits its context, a beat after the client drains)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.02)
+    return pred()
+
+
+def _request_span_traces():
+    """trace values seen on requests-track span args."""
+    return [
+        (args or {}).get("trace")
+        for track, _name, _s, _d, _tid, args in tracing.spans()
+        if track == "requests"]
+
+
+def _journal_text(jdir):
+    out = []
+    for name in sorted(os.listdir(jdir)):
+        with open(os.path.join(jdir, name), "r", errors="replace") as f:
+            out.append(f.read())
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# off is off: bit-exact default
+# ---------------------------------------------------------------------------
+class TestFlagOffBitExact:
+    def test_spans_and_journal_carry_no_trace(self, model, tmp_path):
+        jd = str(tmp_path / "journal")
+        eng = _engine(model, journal_dir=jd)
+        eng.add_request(P1, max_new_tokens=NEW)
+        eng.run()
+        traces = _request_span_traces()
+        assert traces and all(t is None for t in traces)
+        assert all(track not in ("edge", "router")
+                   for track, *_ in tracing.spans())
+        assert '"tr"' not in _journal_text(jd)
+
+    def test_edge_ignores_stray_header_when_off(self, model):
+        edge = EdgeServer(_engine(model))
+        port = edge.start()
+        try:
+            resp = _post(f"http://127.0.0.1:{port}/v1/generate",
+                         {"prompt_ids": P1, "max_new_tokens": NEW},
+                         headers={"x-paddle-trace": "deadbeefdeadbeef"})
+            _meta, toks, done = _drain_sse(resp)
+            assert done["finish_reason"] in ("eos", "length")
+            assert len(toks) == done["n"]
+        finally:
+            edge.close()
+        assert all(t is None for t in _request_span_traces())
+        assert all(track != "edge" for track, *_ in tracing.spans())
+
+
+# ---------------------------------------------------------------------------
+# the id, the slice, the clock, the merge (pure units)
+# ---------------------------------------------------------------------------
+class TestPrimitives:
+    def test_mint_is_64bit_hex(self):
+        ids = {fleettrace.mint_trace_id() for _ in range(64)}
+        assert len(ids) == 64
+        assert all(len(t) == 16 and int(t, 16) >= 0 for t in ids)
+
+    def test_span_slice_filters_trace_and_window(self):
+        spans = [
+            ("requests", "decode", 100, 50, 1, {"trace": "aa"}),
+            ("requests", "decode", 300, 50, 2, {"trace": "bb"}),
+            ("engine", "prefill", 900, 10, 0, None),
+        ]
+        by_trace = fleettrace.span_slice(spans, trace="aa")
+        assert [s["tid"] for s in by_trace] == [1]
+        assert by_trace[0]["args"]["trace"] == "aa"
+        # window keeps overlapping spans (span [300,350] vs [320,_])
+        windowed = fleettrace.span_slice(spans, since_ns=320,
+                                         until_ns=800)
+        assert [s["start_ns"] for s in windowed] == [300]
+
+    def test_clock_sync_keeps_min_rtt_sample(self):
+        cs = fleettrace.ClockSync()
+        assert cs.offset_ns("r0") == 0
+        cs.observe("r0", t0_ns=0, t1_ns=1000, server_ns=10_500)
+        assert cs.offset_ns("r0") == 10_000  # server - midpoint(500)
+        # a worse (higher-RTT) sample never degrades the estimate
+        cs.observe("r0", t0_ns=0, t1_ns=9000, server_ns=77_777)
+        assert cs.offset_ns("r0") == 10_000
+        # a tighter sample replaces it
+        cs.observe("r0", t0_ns=100, t1_ns=500, server_ns=20_300)
+        assert cs.offset_ns("r0") == 20_000
+
+    def test_merge_single_requests_lane_across_replicas(self):
+        t = "feedfacefeedface"
+        merged = fleettrace.merge_fleet_trace({
+            "r0": [{"track": "requests", "name": "prefill",
+                    "start_ns": 1_000, "dur_ns": 500, "tid": 5,
+                    "args": {"trace": t}},
+                   {"track": "engine", "name": "prefill",
+                    "start_ns": 1_000, "dur_ns": 500, "tid": 0,
+                    "args": None}],
+            "r1": [{"track": "requests", "name": "decode",
+                    "start_ns": 9_000, "dur_ns": 500, "tid": 31,
+                    "args": {"trace": t}}],
+        }, offsets_ns={"r0": 0, "r1": 2_000})
+        events = merged["traceEvents"]
+        procs = {ev["pid"]: ev["args"]["name"] for ev in events
+                 if ev.get("ph") == "M"
+                 and ev.get("name") == "process_name"}
+        assert "requests" in procs.values()
+        assert "r0/engine" in procs.values()
+        req = [ev for ev in events if ev.get("ph") == "X"
+               and procs[ev["pid"]] == "requests"]
+        # one lane: both replicas' spans share (pid, tid) for the trace
+        assert len({(ev["pid"], ev["tid"]) for ev in req}) == 1
+        assert {ev["args"]["replica"] for ev in req} == {"r0", "r1"}
+        # r1's timestamps shift onto the reference clock
+        decode = next(ev for ev in req if ev["name"] == "decode")
+        assert decode["ts"] == (9_000 - 2_000) / 1e3
+
+
+# ---------------------------------------------------------------------------
+# on-mode propagation: header -> request -> spans/flight/journal
+# ---------------------------------------------------------------------------
+class TestPropagation:
+    def test_header_to_spans_flight_journal_and_tracez(
+            self, model, tmp_path, trace_on):
+        t = fleettrace.mint_trace_id()
+        jd = str(tmp_path / "journal")
+        eng = _engine(model, journal_dir=jd, flight_window=64)
+        edge = EdgeServer(eng)
+        port = edge.start()
+        try:
+            resp = _post(f"http://127.0.0.1:{port}/v1/generate",
+                         {"prompt_ids": P1, "max_new_tokens": NEW},
+                         headers={fleettrace.TRACE_HEADER: t})
+            _meta, toks, done = _drain_sse(resp)
+            assert done["finish_reason"] in ("eos", "length")
+
+            traces = _request_span_traces()
+            assert traces and all(tr == t for tr in traces)
+            assert _wait_for(lambda: [
+                1 for track, name, _s, _d, _tid, args
+                in tracing.spans() if track == "edge"
+                and name == "sse" and (args or {}).get("trace") == t])
+            slots = [s for rec in eng._flight.snapshot()["records"]
+                     for s in rec.get("slots", [])]
+            assert slots and all(s.get("trace") == t for s in slots)
+            assert f'"tr":"{t}"' in _journal_text(jd)
+
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/tracez/spans?trace={t}",
+                timeout=10).read())
+            assert doc["spans"]
+            assert all(s["args"]["trace"] == t for s in doc["spans"])
+            assert isinstance(doc["now_ns"], int)
+        finally:
+            edge.close()
+
+    def test_readyz_serves_now_ns_only_when_on(self, model):
+        eng = _engine(model)  # noqa: F841  (a live engine to report)
+        assert "now_ns" not in opsserver.readiness()
+        paddle.set_flags({"fleet_trace": True})
+        try:
+            doc = opsserver.readiness()
+            assert isinstance(doc["now_ns"], int)
+        finally:
+            paddle.set_flags({"fleet_trace": False})
+
+
+# ---------------------------------------------------------------------------
+# failover: same trace id across the adoption, one merged lane
+# ---------------------------------------------------------------------------
+class TestFailoverContinuity:
+    def test_adopted_stream_keeps_trace_and_single_lane(
+            self, model, tmp_path, trace_on):
+        t = fleettrace.mint_trace_id()
+        jd = str(tmp_path / "journal")
+        dead = _engine(model, journal_dir=jd, flight_window=64)
+        req = dead.add_request(P1, max_new_tokens=NEW, trace_id=t)
+        streamed = []
+        req.on_token = streamed.append
+        for _ in range(6):
+            dead.step()
+        assert len(streamed) >= 3 and req.state != "done"
+        donor_dump = dead._flight.snapshot()
+        delivered = len(streamed) - 1
+
+        survivor = _engine(model, flight_window=64)
+        edge = EdgeServer(survivor)
+        port = edge.start()
+        try:
+            out = json.loads(_post(
+                f"http://127.0.0.1:{port}/v1/adopt",
+                {"journal_dir": jd,
+                 "delivered": {req.request_id: delivered}}).read())
+            entry = out["migrated"][str(req.request_id)]
+            assert entry["trace"] == t  # journal's "tr" survived
+            new_rid = int(entry["request_id"])
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/resume"
+                f"?request={req.request_id}", timeout=60)
+            _meta, _toks, done = _drain_sse(resp)
+            assert done["finish_reason"] in ("eos", "length")
+        finally:
+            edge.close()
+        adopter_dump = survivor._flight.snapshot()
+
+        # the adopter admitted under a FRESH request id, same trace:
+        # the survivor's engine spans carry t under the new rid
+        assert _wait_for(lambda: [
+            1 for track, _n, _s, _d, tid, args in tracing.spans()
+            if track == "requests" and tid == new_rid
+            and (args or {}).get("trace") == t]), \
+            "survivor must span the SAME trace under its new rid"
+
+        # both flight dumps carry the original trace id, and the
+        # cross-replica explain joins them into one story
+        for dump in (donor_dump, adopter_dump):
+            assert explain_request.trace_requests(dump, t)
+        report = "\n".join(explain_request.explain_trace(
+            [("donor", donor_dump), ("adopter", adopter_dump)], t))
+        assert "[donor]" in report and "[adopter]" in report
+
+        # merged chrome trace: exactly ONE requests-track lane even
+        # with both engines' spans split across "replicas"
+        spans = fleettrace.span_slice(tracing.spans(), trace=t)
+        merged = fleettrace.merge_fleet_trace(
+            {"dead": spans, "survivor": spans})
+        procs = {ev["pid"]: ev["args"]["name"]
+                 for ev in merged["traceEvents"]
+                 if ev.get("ph") == "M"
+                 and ev.get("name") == "process_name"}
+        lanes = {(ev["pid"], ev["tid"])
+                 for ev in merged["traceEvents"]
+                 if ev.get("ph") == "X"
+                 and procs[ev["pid"]] == "requests"}
+        assert len(lanes) == 1
+
+
+# ---------------------------------------------------------------------------
+# the live fleet: minted ids, route spans, poll RTT, /fleetz
+# ---------------------------------------------------------------------------
+class TestFleetRollup:
+    def test_router_mints_and_fleetz_merges(self, model, trace_on):
+        e1, e2 = _engine(model), _engine(model)
+        edge1, edge2 = EdgeServer(e1), EdgeServer(e2)
+        p1, p2 = edge1.start(), edge2.start()
+        opsserver.start_ops_server(port=0)
+        router = FleetRouter(poll_interval_s=0.02)
+        try:
+            router.add_replica("r0", f"http://127.0.0.1:{p1}")
+            router.add_replica("r1", f"http://127.0.0.1:{p2}")
+            router.start()
+            s = router.submit(P1, max_new_tokens=NEW)
+            s.result(timeout=120)
+            assert s.trace_id and len(s.trace_id) == 16
+            route = [(args or {}) for track, name, _s, _d, _t, args
+                     in tracing.spans()
+                     if track == "router" and name == "route"]
+            assert any(a.get("trace") == s.trace_id for a in route)
+
+            doc = router.fleetz()
+            cards = doc["replicas"]
+            assert set(cards) == {"r0", "r1"}
+            assert all(c["poll_rtt_s"] is not None
+                       and "clock_offset_ns" in c
+                       for c in cards.values())
+            assert "paddle_fleet_poll_rtt_seconds" in \
+                obs.prometheus_text()
+            events = doc["trace"]["traceEvents"]
+            procs = {ev["pid"]: ev["args"]["name"] for ev in events
+                     if ev.get("ph") == "M"
+                     and ev.get("name") == "process_name"}
+            lanes = {(ev["pid"], ev["tid"]) for ev in events
+                     if ev.get("ph") == "X"
+                     and procs.get(ev["pid"]) == "requests"
+                     and (ev.get("args") or {}).get("trace")
+                     == s.trace_id}
+            assert len(lanes) == 1
+        finally:
+            router.close()
+            edge1.close()
+            edge2.close()
+
+    def test_flag_off_fleet_mints_nothing(self, model):
+        e1 = _engine(model)
+        edge1 = EdgeServer(e1)
+        p1 = edge1.start()
+        opsserver.start_ops_server(port=0)
+        router = FleetRouter(poll_interval_s=0.02)
+        try:
+            router.add_replica("r0", f"http://127.0.0.1:{p1}")
+            router.start()
+            s = router.submit(P1, max_new_tokens=NEW)
+            s.result(timeout=120)
+            assert s.trace_id is None
+            assert all(track not in ("router", "edge")
+                       for track, *_ in tracing.spans())
+        finally:
+            router.close()
+            edge1.close()
+
+
+# ---------------------------------------------------------------------------
+# span-buffer pressure: the page-exempt drop alert
+# ---------------------------------------------------------------------------
+class TestDropAlert:
+    def test_rule_is_ticket_severity(self):
+        rule = next(r for r in alerts.default_rules()
+                    if r.name == "trace_span_drops")
+        assert rule.severity == "ticket"  # page-exempt BY DESIGN
+
+    def test_signal_fires_on_growth_between_evaluations(
+            self, monkeypatch):
+        eng = types.SimpleNamespace(_engine_id=987654)
+        sig = alerts.SIGNALS["trace_span_drop_delta"]
+        counts = iter([10.0, 10.0, 25.0])
+        monkeypatch.setattr(tracing, "dropped_span_count",
+                            lambda: next(counts))
+        assert sig(eng) is None          # first look: no delta yet
+        assert sig(eng) == 0.0           # no growth
+        assert sig(eng) == 15.0          # growth between evaluations
+        alerts._trace_drop_seen.pop(987654, None)

@@ -486,6 +486,44 @@ def test_bench_fleet_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_bench_fleettrace_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_fleettrace.py runs end-to-end: the
+    fleet-tracing chaos bench can't rot.  Asserts the ISSUE-19
+    acceptance bar at smoke scale (2 replica child processes per arm):
+    every submitted stream minted a trace id, a kill -9'd replica's
+    migrated streams finish under the SAME trace id on the survivor,
+    the merged fleet chrome trace renders each trace as exactly ONE
+    requests-track lane (donor + adopter segments stitched), and the
+    router's /fleetz rollup round-trips with replica cards + the
+    merged trace (the <1% propagation-overhead RATIO is gated at full
+    scale only — smoke requests are timer-noise dominated).  Slow
+    lane: multi-replica chaos spawns + compiles engine processes for
+    BOTH the flag-off and flag-on arms."""
+    out = str(tmp_path / "bench_fleettrace.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_fleettrace.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    s = data["summary"]
+    assert s["overhead_bounded"] is True
+    assert s["killed_by_sigkill"] is True
+    assert s["zero_request_loss"] is True
+    assert s["streams_migrated"] >= 1
+    assert s["single_lane_per_trace"] is True
+    assert s["migrated_traces_complete"] == 1.0
+    assert s["fleetz_has_merged_trace"] is True
+    chaos = data["legs"]["chaos"]
+    assert chaos["victim"]  # a real replica was SIGKILLed
+    assert chaos["failovers"] >= 1
+    assert chaos["traced_lanes"] >= chaos["requests"]
+    assert chaos["fleetz_replica_cards"] >= 1
+
+
+@pytest.mark.slow
 def test_bench_recovery_smoke(tmp_path):
     """BENCH_SMOKE=1 tools/bench_recovery.py runs end-to-end: the
     durable-serving bench can't rot.  Asserts the acceptance bar at

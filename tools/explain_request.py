@@ -28,16 +28,26 @@ With ``--trace`` (a merged chrome-trace JSON) the request's lifecycle
 spans (queued / prefill / decode) are appended so the flight window's
 step-level view and the span-level view line up on one report.
 
+With ``--trace-id`` (FLAGS_fleet_trace; docs/FLEET_TRACING.md) the
+report joins **multiple** flight windows — the dead donor's crash
+dump and the adopting survivor's window — into one request story:
+each window's slots are matched on their ``"trace"`` field, so a
+request killed on one replica and finished on another reads as one
+timeline, replica-labelled per line.
+
 Usage:
     python tools/explain_request.py FLIGHT.json --request ID
                                     [--trace TRACE.json] [--all]
+    python tools/explain_request.py DONOR.json ADOPTER.json
+                                    --trace-id ID [--trace TRACE.json]
 
 ``--all`` lists every request id seen in the window (discovery mode).
-`explain(window, request_id)` is the library entry the benches and
-tests call in-process.
+`explain(window, request_id)` and `explain_trace(windows, trace_id)`
+are the library entries the benches and tests call in-process.
 """
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
@@ -161,6 +171,58 @@ def explain(window: dict, request_id: int,
     return lines
 
 
+def trace_requests(window: dict, trace_id: str) -> List[int]:
+    """Request ids whose flight slots carry this fleet trace id."""
+    ids = set()
+    for rec in window.get("records", []):
+        for s in rec.get("slots", []):
+            if s.get("trace") == trace_id:
+                ids.add(int(s["request"]))
+    return sorted(ids)
+
+
+def explain_trace(windows, trace_id: str,
+                  spans: Optional[list] = None) -> List[str]:
+    """Join donor + adopter flight windows into ONE request story by
+    fleet trace id (FLAGS_fleet_trace; docs/FLEET_TRACING.md).
+
+    ``windows`` is a sequence of ``(label, window-dict)``.  Each
+    window's slot records are matched on their ``"trace"`` field (the
+    adopter admits the request under a FRESH request id, so the trace
+    id is the only join key that survives failover); every matching
+    request's timeline renders under its window label.  ``spans``
+    (optional) is a merged fleet chrome trace's ``traceEvents`` list:
+    request-track spans tagged with the trace id are appended,
+    replica-attributed."""
+    tid = str(trace_id)
+    lines = [f"trace {tid}"]
+    hits = 0
+    for label, window in windows:
+        rids = trace_requests(window, tid)
+        if not rids:
+            lines.append(f"[{label}] (trace not seen in this window)")
+            continue
+        for rid in rids:
+            hits += 1
+            for ln in explain(window, rid):
+                lines.append(f"[{label}] {ln}")
+    if not hits:
+        lines.append("  (trace seen in no flight window)")
+    if spans:
+        lines.append("spans:")
+        for ev in spans:
+            if ev.get("ph") != "X":
+                continue
+            args = ev.get("args") or {}
+            if args.get("trace") != tid:
+                continue
+            lines.append(
+                f"  {str(args.get('replica', '?')):<12} "
+                f"{ev.get('name', ''):<10} "
+                f"{ev.get('dur', 0) / 1e3:9.3f}ms  {args}")
+    return lines
+
+
 def _load_spans(trace_path: str) -> list:
     with open(trace_path) as f:
         return json.load(f).get("traceEvents", [])
@@ -168,22 +230,40 @@ def _load_spans(trace_path: str) -> list:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("flight", help="flight window JSON (auto-dump or "
-                                   "telemetry_flight.json)")
+    ap.add_argument("flight", nargs="+",
+                    help="flight window JSON(s) (auto-dump or "
+                         "telemetry_flight.json); several files + "
+                         "--trace-id joins them by fleet trace")
     ap.add_argument("--request", type=int, default=None)
     ap.add_argument("--trace", default=None,
                     help="merged chrome-trace JSON for span alignment")
+    ap.add_argument("--trace-id", default=None,
+                    help="fleet trace id (FLAGS_fleet_trace): join "
+                         "every flight window given — e.g. the dead "
+                         "donor's dump and the survivor's — into one "
+                         "cross-replica report")
     ap.add_argument("--all", action="store_true",
                     help="list every request id in the window")
     args = ap.parse_args()
-    with open(args.flight) as f:
-        window = json.load(f)
+    windows = []
+    for path in args.flight:
+        with open(path) as f:
+            windows.append((os.path.basename(path), json.load(f)))
+    spans = _load_spans(args.trace) if args.trace else None
+    if args.trace_id is not None:
+        print("\n".join(explain_trace(windows, args.trace_id,
+                                      spans=spans)))
+        return 0
+    if len(windows) > 1:
+        print("explain_request: multiple flight files need --trace-id",
+              file=sys.stderr)
+        return 2
+    window = windows[0][1]
     if args.all or args.request is None:
         ids = request_ids(window)
         print(f"requests in window: {ids}")
         if args.request is None:
             return 0 if args.all else 2
-    spans = _load_spans(args.trace) if args.trace else None
     print("\n".join(explain(window, args.request, spans=spans)))
     return 0
 

@@ -25,6 +25,10 @@ Passes (see docs/STATIC_ANALYSIS.md for the catalog):
                     between-steps sites
 * donation        — jax.jit sites whose *_pages pool parameters are
                     not all donated
+* fleet-trace     — HTTP sites under paddle_tpu/fleet/ (urlopen client
+                    legs, do_* handlers) that neither propagate the
+                    x-paddle-trace header nor sit on the control-plane
+                    allowlist (docs/FLEET_TRACING.md)
 
 The baseline file grandfathers findings by CONTENT fingerprint (pass +
 file + source-line text): pre-existing debt never blocks CI, but any
