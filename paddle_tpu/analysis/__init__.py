@@ -173,6 +173,13 @@ REPO_ENGINE_RULE = EngineRule(
         # durable serving (inference.durability): executable handoff
         # to a rebuilt engine and watchdog abandonment of a hung one
         "adopt_executables", "_abandon_inflight",
+        # quantized weight storage (FLAGS_serve_weights=int8): the
+        # construction-time fold replacing the engine's f32 matmul
+        # leaves with int8+scale pairs — a param-tree mutation no
+        # observer (cost model, profiler, alert evaluator) may ever
+        # invoke: re-quantizing a live tree would silently re-trace
+        # every warm executable
+        "_fold_weight_quant",
     ),
     receivers=("eng", "engine", "self.engine", "self._engine"),
     sanctioned={

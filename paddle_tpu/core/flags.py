@@ -153,6 +153,25 @@ define_flag("kv_quant", "off",
             "quality is gated by measurement, not just plumbing: see "
             "tools/bench_kv_quant.py / docs/DECODE_PERF.md.  Engines "
             "constructed with an explicit kv_quant= ignore the flag")
+define_flag("serve_weights", "off",
+            "serving weight-storage quantization "
+            "(inference.serving.DecodeEngine): 'int8' folds every "
+            "matmul weight of the step executables — qkv/out/fc1/fc2 "
+            "projections, the untied LM head, and a bound draft "
+            "model's weights — to per-out-channel symmetric int8 "
+            "(quantization.int8.quantize_weight) with f32 scales in "
+            "parallel `*_q`/`*_s` param leaves; embeddings, position "
+            "tables, layernorms and biases stay f32.  The matmul sites "
+            "dequantize fused at use (mixed f32xs8 dot + scale in the "
+            "dot epilogue), so weights stream from HBM as int8 — ~4x "
+            "less weight traffic per step on the bandwidth-bound "
+            "decode path.  'off' (default) is the bit-exact "
+            "full-precision path and constructs the exact same "
+            "executables as before the feature existed.  Output "
+            "quality is gated by measurement, not just plumbing: see "
+            "tools/bench_wquant.py / docs/INT8_PERF.md.  Engines "
+            "constructed with an explicit serve_weights= ignore the "
+            "flag")
 define_flag("snapshot_kv", True,
             "serialize the content-addressed (prefix-cached) KV page "
             "payloads — int8 + scales under FLAGS_kv_quant — into a "

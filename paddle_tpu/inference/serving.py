@@ -783,6 +783,80 @@ def _extract_gpt_params(model):
     return params
 
 
+# Weight leaves `_quantize_gpt_params` folds to int8 storage: every
+# [in, out] matmul weight of the step functions.  Embeddings (wte is
+# gathered, and the tied head needs its f32 transpose), position
+# tables, layernorm params and biases stay f32 — they are a rounding
+# error of the per-step weight traffic and some (wte) are read by
+# non-matmul ops.
+_QUANT_WEIGHT_KEYS = ("qkv_w", "out_w", "fc1_w", "fc2_w")
+
+
+def _quantize_gpt_params(params):
+    """The quantizing twin of `_extract_gpt_params`'s output
+    (FLAGS_serve_weights=int8): every matmul weight leaf ``name`` is
+    REPLACED by a ``name + "_q"`` int8 leaf (per-out-channel symmetric,
+    `quantization.int8.quantize_weight` with quant_axis=1) and a
+    ``name + "_s"`` f32 scale leaf holding ``absmax / Q_MAX`` — the
+    multiplier the use-site dequant applies AFTER the int8 dot, so
+    ``(x @ q) * s == x @ dequant(q)`` exactly (the per-out-channel
+    scale commutes past the contraction).  Everything else passes
+    through untouched, f32.  Returns ``(params, mats, bytes_saved)``:
+    the new tree, the number of weight matrices folded, and the HBM
+    bytes the fold reclaimed net of the scale leaves it added."""
+    from ..quantization.int8 import Q_MAX, quantize_weight
+
+    def fold(d):
+        mats = 0
+        saved = 0
+        out = dict(d)
+        for name in _QUANT_WEIGHT_KEYS + ("head_w",):
+            w = out.get(name)
+            if w is None:
+                continue
+            q, scale = quantize_weight(w, quant_axis=1)
+            s = (scale / Q_MAX).astype(jnp.float32)
+            del out[name]
+            out[name + "_q"] = q
+            out[name + "_s"] = s
+            mats += 1
+            saved += w.size * w.dtype.itemsize \
+                - q.size * q.dtype.itemsize - s.size * s.dtype.itemsize
+        return out, mats, saved
+
+    top, mats, saved = fold(params)
+    blocks = []
+    for blk in params["blocks"]:
+        b, m, s = fold(blk)
+        blocks.append(b)
+        mats += m
+        saved += s
+    top["blocks"] = blocks
+    return top, mats, saved
+
+
+def _wmm(x, container, name):
+    """Weight matmul, storage-dtype-polymorphic: the ONE use-site shape
+    every step function routes its weight matmuls through.  With the
+    f32 leaf present (serve_weights=off) this is literally
+    ``jnp.matmul`` — the trace emits the exact op it always emitted, so
+    off-mode executables stay byte-identical.  With the quantized pair
+    present, the dot runs MIXED f32×s8 (`preferred_element_type`
+    keeps the accumulator f32) and the per-out-channel scale applies in
+    the dot epilogue, where XLA fuses it — the weight streams from HBM
+    as int8, and `hot_op_table` sees a distinct ``dot_general[f32xs8]``
+    row.  The branch is Python-level on dict membership, resolved at
+    trace time: one mode per executable, no in-graph select."""
+    w = container.get(name)
+    if w is not None:
+        return jnp.matmul(x, w)
+    acc = jax.lax.dot_general(
+        x, container[name + "_q"],
+        dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return acc * container[name + "_s"]
+
+
 def _ln(x2d, w, b, eps):
     # the SAME layer_norm implementation the eager path runs on CPU
     # (ops/pallas/layer_norm._fwd_xla) — row-local, so applying it to a
@@ -793,11 +867,14 @@ def _ln(x2d, w, b, eps):
 
 
 def _logits_of(params, h):
-    if "head_w" in params:
-        out = jnp.matmul(h, params["head_w"])
+    if "head_w" in params or "head_w_q" in params:
+        out = _wmm(h, params, "head_w")
         if params.get("head_b") is not None:
             out = out + params["head_b"]
         return out
+    # tied head: wte stays f32 in every serve_weights mode (it is
+    # gathered by the embedding lookup), so the tied logits matmul is
+    # always the full-precision transpose
     return jnp.matmul(h, params["wte"].T)
 
 
@@ -844,7 +921,7 @@ def _gpt_prefill(params, ids, true_len, bt_row, k_pages, v_pages, key, *,
 
     for li, blk in enumerate(params["blocks"]):
         y = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
-        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = _wmm(y, blk, "qkv_w") + blk["qkv_b"]
         qkv = qkv.reshape(s_pad, 3, num_heads, head_dim)
         q = qkv[:, 0].transpose(1, 0, 2)[None]  # [1, H, S, D]
         k = qkv[:, 1].transpose(1, 0, 2)[None]
@@ -858,11 +935,11 @@ def _gpt_prefill(params, ids, true_len, bt_row, k_pages, v_pages, key, *,
             v[0].transpose(1, 0, 2))
         attn = _sdpa_reference(q, k, v, None, 0.0, None, True)[0]
         attn = attn.transpose(1, 0, 2).reshape(s_pad, h)
-        x = x + jnp.matmul(attn, blk["out_w"]) + blk["out_b"]
+        x = x + _wmm(attn, blk, "out_w") + blk["out_b"]
         y = _ln(x, blk["ln2_w"], blk["ln2_b"], eps)
-        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+        y = jax.nn.gelu(_wmm(y, blk, "fc1_w") + blk["fc1_b"],
                         approximate=True)
-        x = x + jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+        x = x + _wmm(y, blk, "fc2_w") + blk["fc2_b"]
 
     h_last = jnp.take(x, true_len - 1, axis=0)[None]  # [1, h]
     h_last = _ln(h_last, params["lnf_w"], params["lnf_b"], eps)
@@ -895,7 +972,7 @@ def _gpt_decode_step(params, k_pages, v_pages, block_tables, seq_lens,
 
     for li, blk in enumerate(params["blocks"]):
         y = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
-        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = _wmm(y, blk, "qkv_w") + blk["qkv_b"]
         qkv = qkv.reshape(b, 3, num_heads, head_dim)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, H, D]
         # slice shape [B, Hkv, D] (int layer index joins the advanced
@@ -905,11 +982,11 @@ def _gpt_decode_step(params, k_pages, v_pages, block_tables, seq_lens,
         v_pages = v_pages.at[li, :, page_idx, slot, :].set(v)
         attn = pa.paged_attention(q, k_pages[li], v_pages[li],
                                   block_tables, lens_now)
-        x = x + jnp.matmul(attn.reshape(b, h), blk["out_w"]) + blk["out_b"]
+        x = x + _wmm(attn.reshape(b, h), blk, "out_w") + blk["out_b"]
         y = _ln(x, blk["ln2_w"], blk["ln2_b"], eps)
-        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+        y = jax.nn.gelu(_wmm(y, blk, "fc1_w") + blk["fc1_b"],
                         approximate=True)
-        x = x + jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+        x = x + _wmm(y, blk, "fc2_w") + blk["fc2_b"]
 
     x = _ln(x, params["lnf_w"], params["lnf_b"], eps)
     logits = _logits_of(params, x).astype(jnp.float32)
@@ -959,7 +1036,7 @@ def _gpt_mixed_step(params, k_pages, v_pages, block_tables, seq_lens,
 
     for li, blk in enumerate(params["blocks"]):
         y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
-        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = _wmm(y, blk, "qkv_w") + blk["qkv_b"]
         qkv = qkv.reshape(b, qn, 3, num_heads, head_dim)
         q = qkv[:, :, 0]                                 # [B, Q, H, D]
         # slice shape [B, Q, Hkv, D] (the int layer index joins the
@@ -970,12 +1047,12 @@ def _gpt_mixed_step(params, k_pages, v_pages, block_tables, seq_lens,
         attn = pa.paged_attention(q, k_pages[li], v_pages[li],
                                   block_tables, lens_now,
                                   q_offsets=seq_lens)
-        x = x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"]) \
+        x = x + _wmm(attn.reshape(b, qn, h), blk, "out_w") \
             + blk["out_b"]
         y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
-        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+        y = jax.nn.gelu(_wmm(y, blk, "fc1_w") + blk["fc1_b"],
                         approximate=True)
-        x = x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+        x = x + (_wmm(y, blk, "fc2_w") + blk["fc2_b"]
                  ).reshape(b, qn, h)
 
     # sample ONE row per slot (not all Q like the verify step): the
@@ -1042,7 +1119,7 @@ def _gpt_prefill_q(params, ids, true_len, bt_row, k_pages, v_pages,
 
     for li, blk in enumerate(params["blocks"]):
         y = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
-        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = _wmm(y, blk, "qkv_w") + blk["qkv_b"]
         qkv = qkv.reshape(s_pad, 3, num_heads, head_dim)
         q = qkv[:, 0].transpose(1, 0, 2)[None]  # [1, H, S, D]
         k = qkv[:, 1].transpose(1, 0, 2)[None]
@@ -1056,11 +1133,11 @@ def _gpt_prefill_q(params, ids, true_len, bt_row, k_pages, v_pages,
         refolds = refolds + rk + rv
         attn = _sdpa_reference(q, k, v, None, 0.0, None, True)[0]
         attn = attn.transpose(1, 0, 2).reshape(s_pad, h)
-        x = x + jnp.matmul(attn, blk["out_w"]) + blk["out_b"]
+        x = x + _wmm(attn, blk, "out_w") + blk["out_b"]
         y = _ln(x, blk["ln2_w"], blk["ln2_b"], eps)
-        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+        y = jax.nn.gelu(_wmm(y, blk, "fc1_w") + blk["fc1_b"],
                         approximate=True)
-        x = x + jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+        x = x + _wmm(y, blk, "fc2_w") + blk["fc2_b"]
 
     h_last = jnp.take(x, true_len - 1, axis=0)[None]  # [1, h]
     h_last = _ln(h_last, params["lnf_w"], params["lnf_b"], eps)
@@ -1096,7 +1173,7 @@ def _gpt_decode_step_q(params, k_pages, v_pages, k_scales, v_scales,
 
     for li, blk in enumerate(params["blocks"]):
         y = _ln(x, blk["ln1_w"], blk["ln1_b"], eps)
-        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = _wmm(y, blk, "qkv_w") + blk["qkv_b"]
         qkv = qkv.reshape(b, 3, num_heads, head_dim)
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, H, D]
         k_pages, k_scales, rk = pa.paged_quant_write(
@@ -1108,11 +1185,11 @@ def _gpt_decode_step_q(params, k_pages, v_pages, k_scales, v_scales,
                                   block_tables, lens_now,
                                   k_scales=k_scales[li],
                                   v_scales=v_scales[li])
-        x = x + jnp.matmul(attn.reshape(b, h), blk["out_w"]) + blk["out_b"]
+        x = x + _wmm(attn.reshape(b, h), blk, "out_w") + blk["out_b"]
         y = _ln(x, blk["ln2_w"], blk["ln2_b"], eps)
-        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+        y = jax.nn.gelu(_wmm(y, blk, "fc1_w") + blk["fc1_b"],
                         approximate=True)
-        x = x + jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+        x = x + _wmm(y, blk, "fc2_w") + blk["fc2_b"]
 
     x = _ln(x, params["lnf_w"], params["lnf_b"], eps)
     logits = _logits_of(params, x).astype(jnp.float32)
@@ -1153,7 +1230,7 @@ def _gpt_mixed_step_q(params, k_pages, v_pages, k_scales, v_scales,
 
     for li, blk in enumerate(params["blocks"]):
         y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
-        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = _wmm(y, blk, "qkv_w") + blk["qkv_b"]
         qkv = qkv.reshape(b, qn, 3, num_heads, head_dim)
         q = qkv[:, :, 0]                                 # [B, Q, H, D]
         k_pages, k_scales, rk = pa.paged_quant_write(
@@ -1170,12 +1247,12 @@ def _gpt_mixed_step_q(params, k_pages, v_pages, k_scales, v_scales,
                                   q_offsets=seq_lens,
                                   k_scales=k_scales[li],
                                   v_scales=v_scales[li])
-        x = x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"]) \
+        x = x + _wmm(attn.reshape(b, qn, h), blk, "out_w") \
             + blk["out_b"]
         y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
-        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+        y = jax.nn.gelu(_wmm(y, blk, "fc1_w") + blk["fc1_b"],
                         approximate=True)
-        x = x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+        x = x + (_wmm(y, blk, "fc2_w") + blk["fc2_b"]
                  ).reshape(b, qn, h)
 
     sel = x[jnp.arange(b), sample_idx]                   # [B, h]
@@ -1273,7 +1350,7 @@ def _gpt_ragged_step(params, k_pages, v_pages, block_tables, seq_lens,
 
     for li, blk in enumerate(params["blocks"]):
         y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
-        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = _wmm(y, blk, "qkv_w") + blk["qkv_b"]
         # head axis sharded over 'mp' from here: the KV scatter and the
         # paged-attention gather stay chip-local (each chip owns its
         # head-slice of every page)
@@ -1293,14 +1370,14 @@ def _gpt_ragged_step(params, k_pages, v_pages, block_tables, seq_lens,
         # row-parallel out proj: replicating the residual forces the
         # cross-chip all-reduce exactly here (heads fuse head-major
         # into h, so the reshape keeps the 'mp' shards contiguous)
-        x = cst(x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"])
+        x = cst(x + _wmm(attn.reshape(b, qn, h), blk, "out_w")
                 + blk["out_b"])
         y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
-        y = cst(jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+        y = cst(jax.nn.gelu(_wmm(y, blk, "fc1_w") + blk["fc1_b"],
                             approximate=True),
                 None, "mp")
         # row-parallel fc2: second all-reduce of the block
-        x = cst(x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+        x = cst(x + (_wmm(y, blk, "fc2_w") + blk["fc2_b"]
                      ).reshape(b, qn, h))
 
     xf = _ln(x.reshape(b * qn, h), params["lnf_w"], params["lnf_b"], eps)
@@ -1349,7 +1426,7 @@ def _gpt_ragged_step_q(params, k_pages, v_pages, k_scales, v_scales,
 
     for li, blk in enumerate(params["blocks"]):
         y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
-        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = _wmm(y, blk, "qkv_w") + blk["qkv_b"]
         # head axis sharded over 'mp' from here (see _gpt_ragged_step);
         # the per-head quant scales shard with their pages, so the
         # scale fold/refold reductions over head_dim stay chip-local
@@ -1376,13 +1453,13 @@ def _gpt_ragged_step_q(params, k_pages, v_pages, k_scales, v_scales,
                                       v_scales=v_scales[li]),
                    None, None, "mp", None)
         # row-parallel out proj / fc2: the block's two all-reduces
-        x = cst(x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"])
+        x = cst(x + _wmm(attn.reshape(b, qn, h), blk, "out_w")
                 + blk["out_b"])
         y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
-        y = cst(jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+        y = cst(jax.nn.gelu(_wmm(y, blk, "fc1_w") + blk["fc1_b"],
                             approximate=True),
                 None, "mp")
-        x = cst(x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+        x = cst(x + (_wmm(y, blk, "fc2_w") + blk["fc2_b"]
                      ).reshape(b, qn, h))
 
     xf = _ln(x.reshape(b * qn, h), params["lnf_w"], params["lnf_b"], eps)
@@ -1446,7 +1523,8 @@ class DecodeEngine:
                  cost_model=None, cost_calibration=None, alerts=None,
                  profile=None, profile_sample_steps=None,
                  ragged_step=None, spec_adaptive_k=None,
-                 serve_mesh=None, cache_generated_pages=None):
+                 serve_mesh=None, cache_generated_pages=None,
+                 serve_weights=None):
         cfg = model.cfg
         if getattr(cfg, "dropout", 0.0) and model.training:
             # don't silently flip the caller's train/eval mode — dropout
@@ -1484,6 +1562,32 @@ class DecodeEngine:
                 f"kv_quant must be 'off' or 'int8', got {kv_quant!r}")
         self._kv_quant = kv_quant == "int8"
         self._kv_quant_mode = kv_quant
+        # quantized weight storage (explicit arg wins, else
+        # FLAGS_serve_weights): "int8" folds every matmul weight of the
+        # step executables to per-out-channel symmetric int8 + f32
+        # scales (`_quantize_gpt_params`) so weights stream from HBM at
+        # a quarter the bytes; "off" (default) keeps the f32 leaves and
+        # the step functions trace the exact same ops as before the
+        # feature existed — zero new executables, bit-exact tokens.
+        if serve_weights is None:
+            serve_weights = str(_early_flags.flag("serve_weights"))
+        serve_weights = str(serve_weights)
+        if serve_weights not in ("off", "int8"):
+            raise ValueError(
+                f"serve_weights must be 'off' or 'int8', got "
+                f"{serve_weights!r}")
+        self._weight_quant = serve_weights == "int8"
+        self._serve_weights_mode = serve_weights
+        # fingerprint sample rows, captured from the F32 tree before
+        # any weight fold: `_model_fingerprint`/`config_fingerprint`
+        # hash one qkv row per block, and quantization RENAMES that
+        # leaf — sampling here keeps both fingerprints a pure function
+        # of the model's weights, identical across serve_weights modes
+        # (the mode itself folds into config_fingerprint separately)
+        self._fp_wrows = [
+            np.asarray(jax.device_get(blk["qkv_w"][0]),
+                       np.float32).tobytes()
+            for blk in self._params["blocks"]]
         # the page-size autotune cache keys on the STORAGE dtype of the
         # pages — an int8 pool must never reuse an fp32-picked page
         # size (a quarter the bytes per page changes the VMEM-fit
@@ -1547,6 +1651,13 @@ class DecodeEngine:
         # FLAGS_metrics_report_interval_s > 0 -> periodic snapshot
         # reporter, started once per process
         _obs.maybe_start_reporter()
+        # fold the weights to int8 storage now, before anything
+        # downstream consumes the tree: the drafter quantizes against
+        # `engine._weight_quant` at bind, and the mesh block shards
+        # whatever leaves exist (`gpt_serving_rules` carries the
+        # `*_q`/`*_s` pairs on the same axes as their f32 originals)
+        if self._weight_quant:
+            self._fold_weight_quant()
 
         from ..core import flags as _flags
 
@@ -1833,6 +1944,7 @@ class DecodeEngine:
             journal_dir=self._journal_dir,
             step_timeout_ms=self._step_timeout_ms,
             kv_quant=self._kv_quant_mode,
+            serve_weights=self._serve_weights_mode,
             ragged_step=self._ragged,
             spec_adaptive_k=(self._spec.adaptive
                              if self._spec is not None else False),
@@ -1970,6 +2082,21 @@ class DecodeEngine:
         fr = self._flight
         return fr.exclusive_phase(name) if fr is not None else _NULL_CTX
 
+    def _fold_weight_quant(self) -> None:
+        """Fold this engine's matmul weights to int8 storage
+        (serve_weights=int8): every f32 ``*_w`` matmul leaf of
+        ``self._params`` is replaced by the ``*_q``/``*_s`` pair the
+        `_wmm` use sites dequantize fused at the dot — the sanctioned
+        construction-time param-tree mutation `analysis`'s
+        engine-mutation pass names.  Runs ONCE, before any executable
+        traces (and before the mesh shards the tree); the counters it
+        bumps are how the off mode's zero stays provable."""
+        self._params, mats, saved = _quantize_gpt_params(self._params)
+        _stats_add(weight_quant_mats=mats,
+                   weight_quant_bytes_saved=saved)
+        _obs.WEIGHT_QUANT_SAVED_BYTES.set(saved,
+                                          engine=self._engine_id)
+
     def _model_fingerprint(self) -> bytes:
         """Sampling-invariant model identity — the chain-hash root.
         Cached KV is a function of the weights and the token prefix
@@ -1988,9 +2115,11 @@ class DecodeEngine:
         p = self._params
         h.update(np.asarray(jax.device_get(p["wte"][0]),
                             np.float32).tobytes())
-        for blk in p["blocks"]:
-            h.update(np.asarray(jax.device_get(blk["qkv_w"][0]),
-                                np.float32).tobytes())
+        for row in self._fp_wrows:
+            # f32 qkv rows sampled at construction, BEFORE any
+            # serve_weights fold renamed the leaf — the fingerprint is
+            # a function of the model, not of the storage dtype
+            h.update(row)
         h.update(str((tuple(p["wte"].shape), len(p["blocks"]),
                       self._num_heads, self._head_dim,
                       self._page)).encode())
@@ -2012,9 +2141,9 @@ class DecodeEngine:
             p = self._params
             h.update(np.asarray(jax.device_get(p["wte"][0]),
                                 np.float32).tobytes())
-            for blk in p["blocks"]:
-                h.update(np.asarray(jax.device_get(blk["qkv_w"][0]),
-                                    np.float32).tobytes())
+            for row in self._fp_wrows:
+                # construction-time f32 samples (see _model_fingerprint)
+                h.update(row)
             h.update(str((
                 tuple(p["wte"].shape), len(p["blocks"]),
                 self._num_heads, self._head_dim, self._eps,
@@ -2043,6 +2172,15 @@ class DecodeEngine:
                 # executables carry mesh shardings) can never adopt a
                 # single-chip engine's executables or vice versa
                 h.update(str(("mesh", self._serve_mesh)).encode())
+            if self._weight_quant:
+                # same conditional-fold reason again: off-mode
+                # fingerprints stay byte-identical with pre-feature
+                # journals/donors (their executables ARE identical),
+                # while an int8-weight engine (whose dots read s8
+                # operands) can never adopt an f32 engine's
+                # executables or vice versa
+                h.update(str(("serve_weights",
+                              self._serve_weights_mode)).encode())
             self._config_fp = h.digest()
         return self._config_fp
 
@@ -3543,6 +3681,7 @@ class DecodeEngine:
                 "chunked_prefill": bool(self._chunked),
                 "prefix_cache": bool(self._prefix_cache),
                 "kv_quant": self._kv_quant_mode,
+                "serve_weights": self._serve_weights_mode,
                 "chunk_budget": int(self._chunk_budget),
                 "spec_k": self._spec.k if self._spec is not None else 0,
                 "spec_adaptive_k": bool(

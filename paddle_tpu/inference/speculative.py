@@ -75,7 +75,8 @@ from .serving import (RNG_DECODE_DOMAIN, _JitTracker,
                       _gpt_decode_step, _gpt_decode_step_q,
                       _gpt_mixed_step, _gpt_mixed_step_q, _gpt_prefill,
                       _gpt_prefill_q, _guard_tokens, _ln, _logits_of,
-                      _reset_kv_scales, _stats_add, sample_logits)
+                      _quantize_gpt_params, _reset_kv_scales,
+                      _stats_add, _wmm, sample_logits)
 from .. import observability as _obs
 from ..ops.pallas import paged_attention as pa
 
@@ -118,7 +119,7 @@ def _gpt_spec_verify(params, k_pages, v_pages, block_tables, seq_lens,
 
     for li, blk in enumerate(params["blocks"]):
         y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
-        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = _wmm(y, blk, "qkv_w") + blk["qkv_b"]
         qkv = qkv.reshape(b, qn, 3, num_heads, head_dim)
         q = qkv[:, :, 0]                                 # [B, Q, H, D]
         # slice shape [B, Q, Hkv, D] (the int layer index joins the
@@ -129,12 +130,12 @@ def _gpt_spec_verify(params, k_pages, v_pages, block_tables, seq_lens,
         attn = pa.paged_attention(q, k_pages[li], v_pages[li],
                                   block_tables, lens_now,
                                   q_offsets=seq_lens)
-        x = x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"]) \
+        x = x + _wmm(attn.reshape(b, qn, h), blk, "out_w") \
             + blk["out_b"]
         y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
-        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+        y = jax.nn.gelu(_wmm(y, blk, "fc1_w") + blk["fc1_b"],
                         approximate=True)
-        x = x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+        x = x + (_wmm(y, blk, "fc2_w") + blk["fc2_b"]
                  ).reshape(b, qn, h)
 
     xf = _ln(x.reshape(b * qn, h), params["lnf_w"], params["lnf_b"], eps)
@@ -194,7 +195,7 @@ def _gpt_spec_verify_q(params, k_pages, v_pages, k_scales, v_scales,
 
     for li, blk in enumerate(params["blocks"]):
         y = _ln(x.reshape(b * qn, h), blk["ln1_w"], blk["ln1_b"], eps)
-        qkv = jnp.matmul(y, blk["qkv_w"]) + blk["qkv_b"]
+        qkv = _wmm(y, blk, "qkv_w") + blk["qkv_b"]
         qkv = qkv.reshape(b, qn, 3, num_heads, head_dim)
         q = qkv[:, :, 0]                                 # [B, Q, H, D]
         k_pages, k_scales, rk = pa.paged_quant_write(
@@ -211,12 +212,12 @@ def _gpt_spec_verify_q(params, k_pages, v_pages, k_scales, v_scales,
                                   q_offsets=seq_lens,
                                   k_scales=k_scales[li],
                                   v_scales=v_scales[li])
-        x = x + jnp.matmul(attn.reshape(b, qn, h), blk["out_w"]) \
+        x = x + _wmm(attn.reshape(b, qn, h), blk, "out_w") \
             + blk["out_b"]
         y = _ln(x.reshape(b * qn, h), blk["ln2_w"], blk["ln2_b"], eps)
-        y = jax.nn.gelu(jnp.matmul(y, blk["fc1_w"]) + blk["fc1_b"],
+        y = jax.nn.gelu(_wmm(y, blk, "fc1_w") + blk["fc1_b"],
                         approximate=True)
-        x = x + (jnp.matmul(y, blk["fc2_w"]) + blk["fc2_b"]
+        x = x + (_wmm(y, blk, "fc2_w") + blk["fc2_b"]
                  ).reshape(b, qn, h)
 
     xf = _ln(x.reshape(b * qn, h), params["lnf_w"], params["lnf_b"], eps)
@@ -393,6 +394,18 @@ class DraftModelDrafter(Drafter):
             raise ValueError(
                 f"draft position table ({self._max_pos}) shorter than "
                 f"the engine horizon ({engine._max_seq_len})")
+        # the draft weights quantize WITH the engine: a serve_weights=
+        # int8 target with an f32 drafter would leave the drafter's
+        # K-1 steps per round streaming 4-byte weights on the same
+        # bandwidth-bound path the fold just relieved.  Guarded so a
+        # rebound drafter never quantizes already-int8 leaves.
+        if engine._weight_quant and \
+                "qkv_w" in self._params["blocks"][0]:
+            self._params, mats, saved = _quantize_gpt_params(self._params)
+            _stats_add(weight_quant_mats=mats,
+                       weight_quant_bytes_saved=saved)
+            _obs.WEIGHT_QUANT_SAVED_BYTES.inc(
+                saved, engine=engine._engine_id)
         n_layers = len(self._params["blocks"])
         shape = (n_layers, self._num_heads, engine.pool.num_pages,
                  engine._page, self._head_dim)

@@ -59,6 +59,7 @@ paddle_flight_dumps_total                      counter    reason
 paddle_kv_quant_pages_total                    counter    —
 paddle_kv_quant_refolds_total                  counter    —
 paddle_kv_quant_bytes_per_token                gauge      engine
+paddle_weight_quant_saved_bytes                gauge      engine
 paddle_step_cost_error_ratio                   gauge      fn
 paddle_phase_mfu                               gauge      phase
 paddle_phase_hbm_util                          gauge      phase
@@ -340,6 +341,16 @@ KV_QUANT_BYTES_PER_TOKEN = gauge(
     "engine's most recent step — the density lever FLAGS_kv_quant "
     "halves/quarters; int8 and fp32 engines serving side by side "
     "read their true relative footprint here",
+    labels=("engine",))
+WEIGHT_QUANT_SAVED_BYTES = gauge(
+    "paddle_weight_quant_saved_bytes",
+    "HBM bytes the serve_weights=int8 fold reclaimed on this engine "
+    "(f32 matmul-weight storage replaced by int8 + per-out-channel "
+    "f32 scales, net of the scale leaves; drafter weights fold into "
+    "the same engine's gauge at bind) — also the per-STEP weight "
+    "traffic the fold removes from the bandwidth-bound decode path, "
+    "since every step streams every weight once.  0 on serve_weights="
+    "off engines",
     labels=("engine",))
 STEP_COST_ERROR = gauge(
     "paddle_step_cost_error_ratio",

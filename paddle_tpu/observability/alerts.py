@@ -150,8 +150,8 @@ class AlertRule:
 # resolving on a phantom zero).
 # ---------------------------------------------------------------------------
 _BURN_KINDS = ("ttft", "tpot", "deadline")
-_LEDGER_CATEGORIES = ("weights", "kv_pages", "kv_scales", "draft_pool",
-                      "misc")
+_LEDGER_CATEGORIES = ("weights", "weights_int8", "weight_scales",
+                      "kv_pages", "kv_scales", "draft_pool", "misc")
 
 
 def _sig_slo_burn(eng) -> Optional[float]:

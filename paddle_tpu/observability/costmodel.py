@@ -104,8 +104,15 @@ _PROFILES: Dict[tuple, "CostProfile"] = {}
 
 # HBM ledger category vocabulary (the paddle_hbm_ledger_bytes label
 # set).  ``temp_scratch`` is XLA-owned executable scratch — reported,
-# but outside the live-array reconciliation (see hbm_ledger).
-LEDGER_CATEGORIES = ("weights", "kv_pages", "kv_scales", "draft_pool",
+# but outside the live-array reconciliation (see hbm_ledger).  Weight
+# bytes itemize by STORAGE dtype: serve_weights=int8 engines carry
+# their matmul payloads under ``weights_int8`` and the per-out-channel
+# dequant scales under ``weight_scales``, so the bytes the fold
+# reclaimed read straight off the ledger (f32 leaves — embeddings,
+# norms, biases, and everything on an off-mode engine — stay under
+# ``weights``).
+LEDGER_CATEGORIES = ("weights", "weights_int8", "weight_scales",
+                     "kv_pages", "kv_scales", "draft_pool",
                      "temp_scratch", "misc")
 
 # steps between error/roofline gauge refreshes (see CostModel.observe)
@@ -534,11 +541,16 @@ class CostModel:
         p = eng._params
         hidden = eng._num_heads * eng._head_dim
         vocab = int(p["wte"].shape[0])
+        # serve_weights=int8 stores every matmul weight at one byte
+        # (the f32 wte would overstate the stream 4x; the per-channel
+        # scale overhead is noise at 1/in_features of the payload)
+        wb = 1 if getattr(eng, "_weight_quant", False) \
+            else p["wte"].dtype.itemsize
         c = analytical_gpt_cost(
             batch=batch, q=q, kv_len=max(int(kv_len), 1),
             layers=eng._num_layers, hidden=hidden, vocab=vocab,
             num_heads=eng._num_heads,
-            weight_bytes=p["wte"].dtype.itemsize,
+            weight_bytes=wb,
             kv_bytes=eng._k_pages.dtype.itemsize)
         return CostProfile(site="analytical", flops=c["flops"],
                            bytes_accessed=c["bytes_accessed"],
@@ -784,8 +796,23 @@ class CostModel:
             if arr is not None and hasattr(arr, "nbytes"):
                 owner.setdefault(id(arr), cat)
 
-        for leaf in jax.tree_util.tree_leaves(eng._params):
-            claim(leaf, "weights")
+        def claim_weights(tree):
+            # itemized by storage dtype: serve_weights=int8 payloads
+            # -> weights_int8, their `*_s` dequant scales ->
+            # weight_scales, every f32 leaf (and the whole tree of an
+            # off-mode engine) -> weights.  Keyed by dtype + leaf name
+            # so a future bf16 scale would still land as a scale.
+            leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+            for path, leaf in leaves:
+                name = str(getattr(path[-1], "key", "")) if path else ""
+                if str(getattr(leaf, "dtype", "")) == "int8":
+                    claim(leaf, "weights_int8")
+                elif name.endswith("_s"):
+                    claim(leaf, "weight_scales")
+                else:
+                    claim(leaf, "weights")
+
+        claim_weights(eng._params)
         claim(eng._k_pages, "kv_pages")
         claim(eng._v_pages, "kv_pages")
         claim(eng._k_scales, "kv_scales")
@@ -793,9 +820,7 @@ class CostModel:
         claim(eng._key, "misc")
         if eng._spec is not None:
             d = eng._spec.drafter
-            for leaf in jax.tree_util.tree_leaves(
-                    getattr(d, "_params", None) or {}):
-                claim(leaf, "weights")
+            claim_weights(getattr(d, "_params", None) or {})
             for name in ("_k_pages", "_v_pages", "_k_scales",
                          "_v_scales"):
                 claim(getattr(d, name, None), "draft_pool")

@@ -240,6 +240,35 @@ def _sub_jaxprs(params):
                 yield x.jaxpr
 
 
+# short dtype names for the per-op table keys — the same spelling the
+# partition plane's byte table uses (f32/bf16/s8/...), so a row reads
+# `dot_general[f32xs8]` rather than the numpy long form
+_SHORT_DTYPE = {
+    "float32": "f32", "float64": "f64", "float16": "f16",
+    "bfloat16": "bf16", "int8": "s8", "int16": "s16", "int32": "s32",
+    "int64": "s64", "uint8": "u8", "uint16": "u16", "uint32": "u32",
+    "uint64": "u64", "bool": "pred",
+}
+
+
+def _short_dtype(dtype) -> str:
+    return _SHORT_DTYPE.get(str(dtype), str(dtype))
+
+
+def _op_key(eqn) -> str:
+    """Aggregation key for one eqn.  `dot_general` rows key by operand
+    dtypes (``dot_general[f32xs8]``): a serve_weights=int8 engine runs
+    mixed f32×s8 weight dots NEXT TO f32×f32 activation math, and
+    aggregating them into one row would blind the exact before/after
+    instrument the weight-quant bench reads."""
+    name = eqn.primitive.name
+    if name == "dot_general":
+        lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+        return (f"{name}[{_short_dtype(lhs.dtype)}"
+                f"x{_short_dtype(rhs.dtype)}]")
+    return name
+
+
 def _walk_jaxpr(jaxpr, agg):
     for eqn in jaxpr.eqns:
         subs = list(_sub_jaxprs(eqn.params))
@@ -250,7 +279,7 @@ def _walk_jaxpr(jaxpr, agg):
                 _walk_jaxpr(sub, agg)
             continue
         f, b = _eqn_cost(eqn)
-        row = agg.setdefault(eqn.primitive.name, [0.0, 0.0, 0])
+        row = agg.setdefault(_op_key(eqn), [0.0, 0.0, 0])
         row[0] += f
         row[1] += b
         row[2] += 1

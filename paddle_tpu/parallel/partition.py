@@ -149,14 +149,22 @@ def gpt_serving_rules() -> List[Tuple[str, P]]:
     Embeddings, norms and the LM head replicate — decode is
     latency-bound on the per-block matmuls, and a replicated head
     keeps the greedy argmax bit-identical to one chip.  Catch-all
-    replicates: serving has no vocab/pp axes to cover."""
+    replicates: serving has no vocab/pp axes to cover.
+
+    serve_weights=int8 engines carry ``*_q``/``*_s`` pairs instead of
+    the f32 originals; each pair shards on the SAME geometry — the
+    int8 payload like its f32 twin, the per-out-channel scale like the
+    column-split bias (it is a vector over the out axis), so the
+    dequant multiply stays chip-local.  Row-split weights (out/fc2)
+    leave the out axis unsharded, so their scales — and the replicated
+    head's pair — fall through to the catch-all."""
     return [
-        (r"qkv_w$", P(None, "mp")),
-        (r"qkv_b$", P("mp")),
-        (r"out_w$", P("mp", None)),
-        (r"fc1_w$", P(None, "mp")),
-        (r"fc1_b$", P("mp")),
-        (r"fc2_w$", P("mp", None)),
+        (r"qkv_w(_q)?$", P(None, "mp")),
+        (r"(qkv_b|qkv_w_s)$", P("mp")),
+        (r"out_w(_q)?$", P("mp", None)),
+        (r"fc1_w(_q)?$", P(None, "mp")),
+        (r"(fc1_b|fc1_w_s)$", P("mp")),
+        (r"fc2_w(_q)?$", P("mp", None)),
         (r".*", P()),
     ]
 

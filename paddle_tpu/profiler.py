@@ -98,6 +98,13 @@ DECODE_STAT_COUNTERS = (
     # (the write-path "refold"), and the tiny scale-reset executable's
     # compiles (target pool + draft pool, one signature each)
     "kv_quant_pages", "kv_quant_refolds", "kv_quant_compiles",
+    # quantized weight storage (FLAGS_serve_weights=int8): matmul
+    # weight matrices folded to int8 + per-out-channel f32 scales at
+    # engine construction / drafter bind ("mats"), and the HBM bytes
+    # that fold reclaimed net of the scale leaves it added — both stay
+    # 0 on serve_weights=off engines (the off-mode-quiet proof the
+    # bench's parity leg pins)
+    "weight_quant_mats", "weight_quant_bytes_saved",
     # cost observatory (observability.costmodel): static FLOP/byte
     # profiles extracted at executable compile time, and calibration
     # updates scored against the flight recorder's measured steps

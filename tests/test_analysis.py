@@ -464,6 +464,40 @@ class TestEngineMutationLint:
         """, name="inference/durability.py")
         assert EngineMutationPass(REPO_ENGINE_RULE).run(mods) == []
 
+    def test_rogue_weight_quant_fold_flags(self, tmp_path):
+        """The serve_weights=int8 param fold (`_fold_weight_quant`) is
+        a sanctioned construction-time engine mutation: a rogue module
+        invoking it on a LIVE engine — the tempting bug being 'just
+        re-quantize the tree after the weights moved' — must flag
+        (re-folding a live tree silently re-traces every warm
+        executable)."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        mods = _scan_snippet(tmp_path, """
+            class RogueQuantizer:
+                def densify(self, engine):
+                    engine._fold_weight_quant()
+                    self.engine._params = self.f32_tree
+        """, name="rogue_quantizer.py")
+        found = EngineMutationPass(REPO_ENGINE_RULE).run(mods)
+        msgs = sorted(f.message for f in found)
+        assert len(found) == 2, msgs
+        assert any("._fold_weight_quant()" in m for m in msgs)
+        assert any("attribute store" in m for m in msgs)
+        assert all("RogueQuantizer.densify" in m for m in msgs)
+
+    def test_repo_rule_sanctions_weight_quant_fold(self, tmp_path):
+        """The identical fold inside the sanctioned serving module
+        scans clean — the construction-time call site itself."""
+        from paddle_tpu.analysis import REPO_ENGINE_RULE
+
+        (tmp_path / "inference").mkdir()
+        mods = _scan_snippet(tmp_path, """
+            def construct(engine):
+                engine._fold_weight_quant()
+        """, name="inference/serving.py")
+        assert EngineMutationPass(REPO_ENGINE_RULE).run(mods) == []
+
     def test_rogue_flight_recorder_mutation_flags(self, tmp_path):
         """The REPO rule sanctions the flight recorder's engine READS
         only inside `FlightRecorder` in observability/flight.py: a

@@ -198,7 +198,8 @@ class TestHotOps:
             assert len(rows) <= profiling.HOT_OP_TOP_K
             flops = [r["flops"] for r in rows]
             assert flops == sorted(flops, reverse=True)
-            assert rows[0]["op"] == "dot_general"  # a GPT step
+            # a GPT step; rows key dot_general by operand dtypes
+            assert rows[0]["op"] == "dot_general[f32xf32]"
             for r in rows:
                 assert 0.0 <= r["flops_frac"] <= 1.0
                 assert 0.0 <= r["bytes_frac"] <= 1.0
@@ -218,10 +219,43 @@ class TestHotOps:
         rows = profiling.hot_op_table(
             f, (jnp.ones((8, 16)), jnp.ones((16, 4))))
         by_op = {r["op"]: r for r in rows}
-        assert rows[0]["op"] == "dot_general"
-        assert by_op["dot_general"]["flops"] == \
+        assert rows[0]["op"] == "dot_general[f32xf32]"
+        assert by_op["dot_general[f32xf32]"]["flops"] == \
             pytest.approx(2 * 8 * 16 * 4)
         assert "tanh" in by_op
+
+    def test_hot_op_table_splits_dot_dtypes(self):
+        """The satellite bugfix this PR rides on: an int8-weight dot
+        and an f32 dot in ONE executable must land in SEPARATE rows —
+        aggregated, the weight-quant before/after instrument is
+        blind."""
+        import jax
+        from jax import lax
+        import jax.numpy as jnp
+
+        def f(x, w_f32, w_q, s):
+            a = x @ w_f32
+            b = lax.dot_general(
+                x, w_q,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * s
+            return a + b
+
+        M, K, N = 8, 16, 4
+        rows = profiling.hot_op_table(jax.jit(f), (
+            jnp.ones((M, K)), jnp.ones((K, N)),
+            jnp.ones((K, N), jnp.int8), jnp.ones((N,))))
+        by_op = {r["op"]: r for r in rows}
+        assert "dot_general[f32xf32]" in by_op
+        assert "dot_general[f32xs8]" in by_op
+        assert by_op["dot_general[f32xf32]"]["flops"] == \
+            pytest.approx(2 * M * K * N)
+        assert by_op["dot_general[f32xs8]"]["flops"] == \
+            pytest.approx(2 * M * K * N)
+        # the s8 operand is the byte win: the int8 dot's traffic must
+        # be smaller than the f32 dot's by about the weight shrink
+        assert by_op["dot_general[f32xs8]"]["bytes"] < \
+            by_op["dot_general[f32xf32]"]["bytes"]
 
     def test_hot_op_table_grouped_conv_flops(self):
         """Grouping is already folded into the kernel's in-channel

@@ -642,6 +642,49 @@ def test_bench_kv_quant_smoke(tmp_path):
     assert legs["quality"]["total"] > 0
 
 
+@pytest.mark.slow
+def test_bench_wquant_smoke(tmp_path):
+    """BENCH_SMOKE=1 tools/bench_wquant.py runs end-to-end: the
+    int8-weight bench can't rot.  Asserts the ISSUE-20 acceptance bar
+    at smoke scale: >=3x matmul-weight bytes reclaimed (cross-checked
+    against the HBM ledger's weights_int8/weight_scales rows),
+    teacher-forced greedy token match >= 99% with the logit-drift
+    probe self-checked against the engine, the serve_weights=off leg
+    bit-exact with ZERO new executables and zero weight-quant
+    counters, and 0 warm retraces in every leg (the tokens/s and
+    streaming ratios are gated at full scale only — smoke shapes are
+    too small to pin wall-clock)."""
+    out = str(tmp_path / "bench_wquant.json")
+    r = subprocess.run(
+        [sys.executable, "tools/bench_wquant.py", "--out", out],
+        cwd=REPO, capture_output=True, text=True,
+        env={**ENV, "BENCH_SMOKE": "1"}, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    with open(out) as f:
+        data = json.load(f)
+    assert data["smoke"] is True
+    s = data["summary"]
+    assert s["weight_bytes_ratio"] >= 3.0
+    assert s["token_match_rate"] >= 0.99
+    assert s["probe_self_check"] is True
+    assert s["ledger_matches_tree"] is True
+    assert s["max_logit_drift"] <= s["drift_bound"]
+    assert s["parity_off_bit_exact"] is True
+    assert s["zero_new_executables_off"] is True
+    assert s["quant_counters_zero_off"] is True
+    assert s["zero_warm_retraces"] is True
+    legs = data["legs"]
+    # the budget leg really served quantized: every matmul weight
+    # folded, reclaimed bytes counted, and the reclaimed bytes bought
+    # strictly more concurrent slots at the same budget
+    assert legs["budget"]["int8"]["weight_quant_mats"] > 0
+    assert legs["budget"]["int8"]["weight_quant_bytes_saved"] > 0
+    assert legs["budget"]["int8"]["slots"] > legs["budget"]["off"]["slots"]
+    assert legs["budget"]["int8"]["ledger"]["weights_int8"] > 0
+    assert legs["parity_off"]["fingerprint_identical"] is True
+    assert legs["quality"]["total"] > 0
+
+
 def test_telemetry_dump_smoke(tmp_path):
     """tools/telemetry_dump.py runs a small engine workload end-to-end
     and every export format parses: Prometheus text has the core

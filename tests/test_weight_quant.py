@@ -1,0 +1,422 @@
+"""Int8-weight serving (FLAGS_serve_weights=int8) — ISSUE 20 acceptance.
+
+Contracts pinned here:
+
+* ``serve_weights="off"`` (the default) is BIT-EXACT with the
+  historical engine and constructs the exact same executables (zero
+  new executables, zero weight-quant counters, byte-identical config
+  fingerprint) — the parity oracle;
+* the quantizing twin of `_extract_gpt_params` replaces every matmul
+  weight (qkv/out/fc1/fc2 per block + the untied head) with an int8
+  ``*_q`` payload and an f32 per-out-channel ``*_s`` scale, and leaves
+  embeddings / position tables / norms / biases f32 — the exact
+  shape/dtype pins the `_wmm` use sites and the partition rules key
+  on;
+* int8-weight serving is deterministic (same engine config twice ->
+  identical tokens), tracks the f32 engine at high token agreement
+  (the hard >=99% teacher-forced gate lives in tools/bench_wquant.py
+  where the workload is controlled), and composes with speculative
+  decoding, chunked prefill, the unified ragged step, kv_quant, and
+  the mp=2 virtual mesh (the `*_q`/`*_s` pairs shard on the same axes
+  as their f32 originals);
+* `wire_config` / `config_fingerprint` / recover / restore carry the
+  mode: a restored serve_weights=int8 engine re-quantizes
+  deterministically from the model's f32 weights and finishes an
+  interrupted serve identically to the uninterrupted reference;
+* the fold surfaces everywhere the stack reports: decode_stats
+  counters (`weight_quant_mats` / `weight_quant_bytes_saved`), the
+  `paddle_weight_quant_saved_bytes` gauge, statusz config, and the
+  HBM ledger's `weights_int8` / `weight_scales` categories.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.inference.serving import (DecodeEngine, decode_stats,
+                                          reset_decode_stats,
+                                          _extract_gpt_params,
+                                          _quantize_gpt_params)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    reset_decode_stats()
+    obs.reset()
+    obs.clear_spans()
+    yield
+    obs.reset()
+    obs.clear_spans()
+
+
+TINY = GPTConfig(vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+                 max_seq_len=128, use_parallel_layers=False, dropout=0.0)
+PAGE = 4
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="needs the virtual CPU mesh (conftest)")
+
+
+def _tiny_gpt(seed=0, cfg=TINY):
+    paddle.seed(seed)
+    m = GPT(cfg)
+    m.eval()
+    return m
+
+
+def _engine(m, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 48)
+    kw.setdefault("page_size", PAGE)
+    kw.setdefault("prefill_chunk_tokens", 8)
+    return DecodeEngine(m, **kw)
+
+
+def _prompts(n=3, ln=14, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, TINY.vocab_size, (ln,)).astype(np.int32)
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# the quantizing twin: param-tree shape/dtype pins
+# ---------------------------------------------------------------------------
+class TestQuantizedParamTree:
+    def test_block_leaves_replaced_and_pinned(self):
+        p = _extract_gpt_params(_tiny_gpt())
+        q, mats, saved = _quantize_gpt_params(p)
+        h = TINY.hidden_size
+        # 4 matmul weights per block (tied embeddings: no head_w)
+        assert mats == 4 * TINY.num_layers
+        assert saved > 0
+        for blk in q["blocks"]:
+            for name, out_dim in (("qkv_w", 3 * h), ("out_w", h),
+                                  ("fc1_w", 4 * h), ("fc2_w", h)):
+                assert name not in blk  # replaced, not duplicated
+                assert blk[name + "_q"].dtype == jnp.int8
+                assert blk[name + "_q"].shape[-1] == out_dim
+                assert blk[name + "_s"].dtype == jnp.float32
+                assert blk[name + "_s"].shape == (out_dim,)
+            # everything that is not a matmul weight stays f32
+            for name in ("ln1_w", "ln1_b", "ln2_w", "ln2_b", "qkv_b",
+                         "out_b", "fc1_b", "fc2_b"):
+                assert blk[name].dtype == jnp.float32
+        for name in ("wte", "wpe", "lnf_w", "lnf_b"):
+            assert q[name].dtype == jnp.float32
+
+    def test_untied_head_quantizes(self):
+        cfg = GPTConfig(vocab_size=96, hidden_size=32, num_layers=1,
+                        num_heads=4, max_seq_len=128,
+                        use_parallel_layers=False, dropout=0.0,
+                        tie_embeddings=False)
+        p = _extract_gpt_params(_tiny_gpt(cfg=cfg))
+        q, mats, _ = _quantize_gpt_params(p)
+        assert mats == 4 * 1 + 1
+        assert "head_w" not in q
+        assert q["head_w_q"].dtype == jnp.int8
+        assert q["head_w_s"].shape == (cfg.vocab_size,)
+
+    def test_dequant_scale_commutes(self):
+        """(x @ q) * s == x @ (q * s) up to accumulation rounding —
+        the identity the mp=2 row-parallel legs lean on (scale applies
+        AFTER the cross-chip all-reduce).  Not asserted bitwise: the
+        mixed-dtype dot and the dequant-then-matmul lower to different
+        accumulation kernels."""
+        from paddle_tpu.inference.serving import _wmm
+
+        p = _extract_gpt_params(_tiny_gpt())
+        q, _, _ = _quantize_gpt_params(p)
+        blk = q["blocks"][0]
+        x = jnp.asarray(
+            np.random.RandomState(3).randn(5, TINY.hidden_size),
+            jnp.float32)
+        fused = _wmm(x, blk, "out_w")
+        dense = jnp.matmul(
+            x, blk["out_w_q"].astype(jnp.float32) * blk["out_w_s"])
+        np.testing.assert_allclose(np.asarray(fused),
+                                   np.asarray(dense),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+class TestWeightQuantEngine:
+    def test_off_mode_bit_exact_and_quiet(self):
+        m = _tiny_gpt()
+        prompts = _prompts()
+        default = _engine(m)
+        out_default = default.generate(prompts, max_new_tokens=4)
+        assert default._weight_quant is False
+        assert "qkv_w" in default._params["blocks"][0]
+        reset_decode_stats()
+        off = _engine(m, serve_weights="off")
+        out_off = off.generate(prompts, max_new_tokens=4)
+        assert out_off == out_default
+        st = decode_stats()
+        assert st["weight_quant_mats"] == 0
+        assert st["weight_quant_bytes_saved"] == 0
+        assert st["retraces_after_warmup"] == 0
+        # byte-identical executable identity: an off engine can adopt
+        # a pre-feature engine's executables and vice versa
+        assert off.config_fingerprint() == default.config_fingerprint()
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError, match="serve_weights"):
+            _engine(_tiny_gpt(), serve_weights="fp4")
+
+    def test_quant_serve_deterministic_and_counted(self):
+        m = _tiny_gpt()
+        prompts = _prompts(2)
+        e1 = _engine(m, serve_weights="int8")
+        out1 = e1.generate(prompts, max_new_tokens=4)
+        st = decode_stats()
+        assert st["weight_quant_mats"] == 4 * TINY.num_layers
+        assert st["weight_quant_bytes_saved"] > 0
+        assert st["retraces_after_warmup"] == 0
+        assert "qkv_w" not in e1._params["blocks"][0]
+        assert e1._params["blocks"][0]["qkv_w_q"].dtype == jnp.int8
+        e2 = _engine(m, serve_weights="int8")
+        out2 = e2.generate(prompts, max_new_tokens=4)
+        assert out1 == out2
+
+    def test_quant_tracks_f32_outputs(self):
+        """Free-running token agreement with the f32 engine.  The hard
+        >=99% teacher-forced gate lives in tools/bench_wquant.py;
+        here the bar is that weight quantization is not nonsense."""
+        m = _tiny_gpt()
+        prompts = _prompts(3, 14)
+        ref = _engine(m).generate(prompts, max_new_tokens=6)
+        out = _engine(m, serve_weights="int8").generate(
+            prompts, max_new_tokens=6)
+        total = sum(len(s) for s in ref)
+        match = sum(int(a == b) for sr, so in zip(ref, out)
+                    for a, b in zip(sr, so))
+        assert match / total >= 0.5, (match, total, ref, out)
+
+    def test_teacher_forced_match(self):
+        """Teacher-forced next-token agreement vs the f32 reference —
+        the cascade-free form of the quality gate: every position is
+        scored from the REFERENCE prefix, so one early disagreement
+        cannot snowball."""
+        m = _tiny_gpt()
+        prompt = _prompts(1, 12, seed=5)[0]
+        ref_eng = _engine(m, max_batch_size=1)
+        ref = ref_eng.generate([prompt], max_new_tokens=8)[0]
+        q_eng = _engine(m, max_batch_size=1, serve_weights="int8")
+        hits = 0
+        for i in range(len(ref)):
+            prefix = np.concatenate(
+                [prompt, np.asarray(ref[:i], np.int32)])
+            got = q_eng.generate([prefix], max_new_tokens=1)[0][0]
+            hits += int(got == ref[i])
+        assert hits / len(ref) >= 0.75, (hits, len(ref), ref)
+
+    def test_composes_with_spec_chunked_ragged_kv_quant(self):
+        """One engine arming EVERYTHING: int8 weights + int8 KV +
+        chunked prefill + the unified ragged step + speculation, vs
+        the same stack over f32 weights — agreement plus the ragged
+        one-executable/zero-retrace contract."""
+        m = _tiny_gpt()
+        prompts = _prompts(2, 14)
+        kw = dict(kv_quant="int8", chunked_prefill=True,
+                  ragged_step=True, spec_decode_k=2)
+        base = _engine(m, **kw).generate(prompts, max_new_tokens=6)
+        reset_decode_stats()
+        eng = _engine(m, serve_weights="int8", **kw)
+        out = eng.generate(prompts, max_new_tokens=6)
+        st = decode_stats()
+        assert st["ragged_compiles"] == 1
+        assert st["ragged_retraces"] == 0
+        assert st["retraces_after_warmup"] == 0
+        assert st["spec_steps"] > 0
+        total = sum(len(s) for s in base)
+        match = sum(int(a == b) for sb, so in zip(base, out)
+                    for a, b in zip(sb, so))
+        assert match / total >= 0.5, (base, out)
+
+    def test_draft_model_weights_quantize_at_bind(self):
+        from paddle_tpu.inference.speculative import DraftModelDrafter
+
+        m = _tiny_gpt()
+        dm = GPT(TINY.draft_config())
+        dm.eval()
+        eng = _engine(m, serve_weights="int8", spec_decode_k=2,
+                      drafter=DraftModelDrafter(dm))
+        d = eng._spec.drafter
+        assert "qkv_w" not in d._params["blocks"][0]
+        assert d._params["blocks"][0]["qkv_w_q"].dtype == jnp.int8
+        st = decode_stats()
+        # target mats + draft mats, both counted
+        assert st["weight_quant_mats"] > 4 * TINY.num_layers
+        out = eng.generate(_prompts(2), max_new_tokens=6)
+        assert decode_stats()["retraces_after_warmup"] == 0
+        assert all(len(s) == 6 for s in out)
+
+    def test_telemetry_surfaces(self):
+        m = _tiny_gpt()
+        eng = _engine(m, serve_weights="int8")
+        eng.generate(_prompts(2), max_new_tokens=4)
+        snap = obs.snapshot()
+        saved = next(
+            s["value"]
+            for s in snap["paddle_weight_quant_saved_bytes"]["series"]
+            if str(s["labels"].get("engine")) == str(eng._engine_id))
+        assert saved == decode_stats()["weight_quant_bytes_saved"] > 0
+        assert eng.statusz()["config"]["serve_weights"] == "int8"
+        off = _engine(m)
+        assert off.statusz()["config"]["serve_weights"] == "off"
+
+    def test_hbm_ledger_itemizes_weight_dtypes(self):
+        from paddle_tpu.observability import costmodel
+
+        m = _tiny_gpt()
+        eng = _engine(m, serve_weights="int8", cost_model=True)
+        led = eng._cost.hbm_ledger()
+        cats = led["categories"]
+        assert set(cats) == set(costmodel.LEDGER_CATEGORIES)
+        assert cats["weights_int8"] > 0
+        assert cats["weight_scales"] > 0
+        # embeddings/norms/biases stay f32 under plain `weights`
+        assert cats["weights"] > 0
+        # the int8 payload dominates its scales by ~in_features
+        assert cats["weights_int8"] > 4 * cats["weight_scales"]
+        off = _engine(m, cost_model=True)
+        led_off = off._cost.hbm_ledger()
+        assert led_off["categories"]["weights_int8"] == 0
+        assert led_off["categories"]["weight_scales"] == 0
+        # the f32 weight bytes the fold reclaims: int8 engine stores
+        # ~4x less matmul-weight payload than the off engine
+        f32_mats = led_off["categories"]["weights"] - cats["weights"]
+        assert cats["weights_int8"] * 3 < f32_mats
+
+    def test_cost_model_shrinks_byte_profile_and_calibrates(self):
+        """satellite: predict_step_cost picks up the shrunk stream —
+        the analytical decode profile reads fewer bytes at identical
+        flops under int8 weights, and calibrated prediction stays
+        within the cost model's 25% error gate while serving."""
+        m = _tiny_gpt()
+        off = _engine(m, cost_model=True)
+        q = _engine(m, serve_weights="int8", cost_model=True)
+        a_off = off._cost._analytical(batch=2, q=1, kv_len=16)
+        a_q = q._cost._analytical(batch=2, q=1, kv_len=16)
+        assert a_q.flops == a_off.flops
+        assert a_q.bytes_accessed < a_off.bytes_accessed
+        q.generate(_prompts(3), max_new_tokens=12)
+        assert q._cost.predict_step_cost() > 0
+        err = q.statusz()["cost"]["error_ratio"]
+        assert "decode" in err
+        assert err["decode"] <= 0.25, err
+
+    def test_wire_config_carries_mode(self):
+        eng = _engine(_tiny_gpt(), serve_weights="int8")
+        assert eng.wire_config()["serve_weights"] == "int8"
+        assert _engine(_tiny_gpt()).wire_config()["serve_weights"] \
+            == "off"
+
+    def test_fingerprints_differ_by_mode_not_model_identity(self):
+        m = _tiny_gpt()
+        off, q = _engine(m), _engine(m, serve_weights="int8")
+        assert off.config_fingerprint() != q.config_fingerprint()
+        # the chain-hash root is a function of the MODEL, not of the
+        # storage dtype: prefix pages hash identically across modes
+        assert off._model_fingerprint() == q._model_fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# mp=2 virtual mesh parity
+# ---------------------------------------------------------------------------
+@needs_mesh
+class TestShardedWeightQuant:
+    def test_mp2_int8_weight_parity(self):
+        """The `*_q`/`*_s` pairs shard on the same axes as their f32
+        originals: mp=2 int8-weight serving is token-identical to the
+        single-chip int8-weight engine, through ONE ragged executable
+        that never retraces."""
+        m = _tiny_gpt(seed=25)
+        rng = np.random.RandomState(15)
+        prompts = [rng.randint(0, TINY.vocab_size, (n,)).astype(np.int32)
+                   for n in (6, 11)]
+        refs = _engine(m, max_seq_len=64, page_size=16,
+                       serve_weights="int8").generate(
+            prompts, max_new_tokens=8)
+        reset_decode_stats()
+        eng = _engine(m, max_seq_len=64, page_size=16,
+                      serve_weights="int8", serve_mesh="mp=2")
+        outs = eng.generate(prompts, max_new_tokens=8)
+        for o, r in zip(outs, refs):
+            assert o == r, (o, r)
+        st = decode_stats()
+        assert st["ragged_compiles"] == 1
+        assert st["ragged_retraces"] == 0
+
+    @pytest.mark.slow  # tier-1 budget: the both-quant leg
+    def test_mp2_int8_weights_and_kv_parity(self):
+        m = _tiny_gpt(seed=26)
+        rng = np.random.RandomState(16)
+        prompts = [rng.randint(0, TINY.vocab_size, (n,)).astype(np.int32)
+                   for n in (6, 11)]
+        refs = _engine(m, max_seq_len=64, page_size=16,
+                       serve_weights="int8", kv_quant="int8").generate(
+            prompts, max_new_tokens=8)
+        reset_decode_stats()
+        eng = _engine(m, max_seq_len=64, page_size=16,
+                      serve_weights="int8", kv_quant="int8",
+                      serve_mesh="mp=2")
+        outs = eng.generate(prompts, max_new_tokens=8)
+        for o, r in zip(outs, refs):
+            assert o == r, (o, r)
+        st = decode_stats()
+        assert st["ragged_compiles"] == 1
+        assert st["ragged_retraces"] == 0
+
+
+# ---------------------------------------------------------------------------
+# durability / recovery round-trip
+# ---------------------------------------------------------------------------
+class TestWeightQuantDurability:
+    def test_restore_requantizes_and_continues(self, tmp_path):
+        """snapshot + restore of an int8-weight engine: wire_config
+        carries the mode, the rebuilt engine re-quantizes
+        deterministically from the model's f32 weights, and the
+        restored serve finishes identically to the uninterrupted
+        reference."""
+        from paddle_tpu.inference.durability import restore_from_dir
+
+        m = _tiny_gpt()
+        prompts = _prompts(3, 14)
+        d = tmp_path / "wq"
+        eng = _engine(m, serve_weights="int8", journal_dir=str(d))
+        reqs = [eng.add_request(p, max_new_tokens=12) for p in prompts]
+        for _ in range(8):
+            eng.step()
+        assert all(r.state != "done" for r in reqs)
+        eng._durability.flush()
+        eng._durability.write_snapshot()
+        eng2, rmap = restore_from_dir(str(d), m)
+        assert eng2._weight_quant
+        assert eng2._serve_weights_mode == "int8"
+        assert "qkv_w_q" in eng2._params["blocks"][0]
+        assert eng2.config_fingerprint() == eng.config_fingerprint()
+        eng2.run()
+        ref = _engine(m, serve_weights="int8").generate(
+            prompts, max_new_tokens=12)
+        got = [list(rmap[r.request_id].generated_ids) for r in reqs]
+        assert got == ref
+
+    def test_recover_rebuilds_int8_engine(self):
+        from paddle_tpu.inference.resilience import recover
+
+        m = _tiny_gpt()
+        eng = _engine(m, serve_weights="int8")
+        eng.generate(_prompts(1), max_new_tokens=2)
+        eng2 = recover(eng)
+        assert eng2._weight_quant
+        assert eng2.config_fingerprint() == eng.config_fingerprint()
+        out = eng2.generate(_prompts(2, seed=2), max_new_tokens=4)
+        assert all(len(s) == 4 for s in out)
